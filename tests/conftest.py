@@ -1,0 +1,9 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax loads,
+so sharding tests exercise a real multi-device mesh without TPU hardware."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

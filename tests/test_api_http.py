@@ -1,0 +1,198 @@
+"""End-to-end HTTP tier: real ThreadingHTTPServer + fake backends.
+
+Exercises the full stack the way the reference's sample-interface transcripts
+do (api/gpu-docker-api-sample-interface.md), asserting the uniform
+``{code,msg,data}`` envelope with HTTP 200 on every path (response.go:15-29).
+"""
+
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from tpu_docker_api.api.app import ApiServer, build_router
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.scheduler.topology import HostTopology
+from tpu_docker_api.service.container import ContainerService
+from tpu_docker_api.service.volume import VolumeService
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import WorkQueue
+
+
+@pytest.fixture
+def server(tmp_path):
+    kv = MemoryKV()
+    store = StateStore(kv)
+    runtime = FakeRuntime(root=str(tmp_path), allow_exec=True)
+    chips = ChipScheduler(HostTopology.build("v5e-8"), kv)
+    ports = PortScheduler(kv, 40000, 40099)
+    wq = WorkQueue(kv)
+    wq.start()
+    c_svc = ContainerService(
+        runtime, store, chips, ports,
+        VersionMap(kv, keys.VERSIONS_CONTAINER_KEY), wq,
+    )
+    v_svc = VolumeService(runtime, store, VersionMap(kv, keys.VERSIONS_VOLUME_KEY), wq)
+    srv = ApiServer(build_router(c_svc, v_svc, chips, ports), port=0)
+    srv.start()
+    srv.wq = wq  # test hook for draining
+    yield srv
+    srv.close()
+    wq.close()
+
+
+def call(server, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200  # envelope carries the real outcome
+        return json.loads(resp.read())
+
+
+class TestContainerRoutes:
+    def test_create_exec_delete_happy_path(self, server):
+        """BASELINE.json config #1: cardless container + exec smoke test."""
+        out = call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax:latest", "containerName": "smoke", "chipCount": 0,
+        })
+        assert out["code"] == 200
+        assert out["data"]["name"] == "smoke-0"
+
+        out = call(server, "POST", "/api/v1/containers/smoke-0/execute", {
+            "cmd": [sys.executable, "-c", "print(21 * 2)"],
+        })
+        assert out["code"] == 200
+        assert out["data"]["stdout"].strip() == "42"
+
+        out = call(server, "DELETE", "/api/v1/containers/smoke-0", {
+            "force": True, "delEtcdInfoAndVersionRecord": True,
+        })
+        assert out["code"] == 200
+
+    def test_tpu_container_and_patch(self, server):
+        out = call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax:latest", "containerName": "train", "chipCount": 2,
+        })
+        assert out["data"]["chipIds"] == [0, 1]
+
+        out = call(server, "PATCH", "/api/v1/containers/train-0/tpu",
+                   {"chipCount": 4})
+        assert out["code"] == 200
+        assert out["data"]["name"] == "train-1"
+        server.wq.drain()
+
+        out = call(server, "GET", "/api/v1/containers/train-1")
+        assert out["data"]["runtime"]["running"]
+
+    def test_gpu_route_alias(self, server):
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "t", "chipCount": 1,
+        })
+        out = call(server, "PATCH", "/api/v1/containers/t-0/gpu", {"gpuCount": 2})
+        assert out["code"] == 200
+
+    def test_validation_name_with_dash_rejected(self, server):
+        out = call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "bad-name", "chipCount": 0,
+        })
+        assert out["code"] == 10001
+
+    def test_validation_missing_image(self, server):
+        out = call(server, "POST", "/api/v1/containers",
+                   {"containerName": "x", "chipCount": 0})
+        assert out["code"] == 10001
+
+    def test_chip_exhaustion_maps_to_code(self, server):
+        out = call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "big", "chipCount": 99,
+        })
+        assert out["code"] == 10601  # ChipNotEnough (reference CodeContainerGpuNotEnough)
+
+    def test_missing_container_info(self, server):
+        out = call(server, "GET", "/api/v1/containers/ghost-0")
+        assert out["code"] == 10302
+
+    def test_stop_restart(self, server):
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "t", "chipCount": 1,
+        })
+        assert call(server, "POST", "/api/v1/containers/t-0/stop", {})["code"] == 200
+        out = call(server, "PATCH", "/api/v1/containers/t-0/restart", {})
+        assert out["code"] == 200
+        assert out["data"]["name"] == "t-1"  # stopped carded ⇒ new version
+
+    def test_commit(self, server):
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "t", "chipCount": 0,
+        })
+        out = call(server, "POST", "/api/v1/containers/t-0/commit",
+                   {"newImageName": "snap:v1"})
+        assert out["code"] == 200 and out["data"]["imageId"].startswith("sha256:")
+
+    def test_invalid_json_enveloped(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/v1/containers",
+            method="POST", data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert out["code"] == 10001
+
+    def test_unknown_route(self, server):
+        out = call(server, "GET", "/api/v1/nope")
+        assert out["code"] == 10001
+
+
+class TestVolumeRoutes:
+    def test_create_resize_info_delete(self, server):
+        out = call(server, "POST", "/api/v1/volumes",
+                   {"volumeName": "data", "size": "10GB"})
+        assert out["data"]["name"] == "data-0"
+
+        out = call(server, "PATCH", "/api/v1/volumes/data-0/size", {"size": "20GB"})
+        assert out["data"]["name"] == "data-1"
+        server.wq.drain()
+
+        out = call(server, "GET", "/api/v1/volumes/data-1")
+        assert out["data"]["state"]["size"] == "20GB"
+
+        out = call(server, "DELETE", "/api/v1/volumes/data-1",
+                   {"delEtcdInfoAndVersionRecord": True})
+        assert out["code"] == 200
+
+    def test_bad_size_unit(self, server):
+        out = call(server, "POST", "/api/v1/volumes",
+                   {"volumeName": "data", "size": "10XB"})
+        assert out["code"] != 200
+
+
+class TestResourceRoutes:
+    def test_tpus_view(self, server):
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "t", "chipCount": 4,
+        })
+        out = call(server, "GET", "/api/v1/resources/tpus")
+        data = out["data"]
+        assert data["totalChips"] == 8 and data["freeChips"] == 4
+        owners = {c["owner"] for c in data["chips"] if c["used"]}
+        assert owners == {"t"}
+        # alias kept for reference compatibility
+        assert call(server, "GET", "/api/v1/resources/gpus")["data"]["totalChips"] == 8
+
+    def test_ports_view(self, server):
+        out = call(server, "GET", "/api/v1/resources/ports")
+        assert out["data"]["startPort"] == 40000
+
+    def test_healthz(self, server):
+        assert call(server, "GET", "/healthz")["data"]["status"] == "ok"

@@ -1,0 +1,133 @@
+"""Runtime layer: fake engine behavior and TPU attachment rendering."""
+
+import sys
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.spec import (
+    ContainerSpec,
+    PortBinding,
+    render_tpu_attachment,
+)
+from tpu_docker_api.scheduler.topology import HostTopology
+
+
+@pytest.fixture
+def rt(tmp_path):
+    runtime = FakeRuntime(root=str(tmp_path))
+    yield runtime
+
+
+class TestFakeRuntime:
+    def test_container_lifecycle(self, rt):
+        spec = ContainerSpec(name="web-0", image="busybox")
+        cid = rt.container_create(spec)
+        assert len(cid) == 12
+        info = rt.container_inspect("web-0")
+        assert not info.running and info.data_dir
+        rt.container_start("web-0")
+        assert rt.container_inspect("web-0").running
+        rt.container_stop("web-0")
+        assert not rt.container_inspect("web-0").running
+        rt.container_remove("web-0")
+        assert not rt.container_exists("web-0")
+
+    def test_create_duplicate_raises(self, rt):
+        rt.container_create(ContainerSpec(name="a-0", image="x"))
+        with pytest.raises(errors.ContainerExisted):
+            rt.container_create(ContainerSpec(name="a-0", image="x"))
+
+    def test_inspect_missing_raises(self, rt):
+        with pytest.raises(errors.ContainerNotExist):
+            rt.container_inspect("ghost-0")
+
+    def test_remove_running_needs_force(self, rt):
+        rt.container_create(ContainerSpec(name="a-0", image="x"))
+        rt.container_start("a-0")
+        with pytest.raises(errors.ApiError):
+            rt.container_remove("a-0")
+        rt.container_remove("a-0", force=True)
+
+    def test_volume_lifecycle(self, rt):
+        info = rt.volume_create("data-0", {"size": "10GB"})
+        assert info.mountpoint
+        assert rt.volume_inspect("data-0").driver_opts == {"size": "10GB"}
+        rt.volume_remove("data-0")
+        with pytest.raises(errors.VolumeNotExist):
+            rt.volume_inspect("data-0")
+
+    def test_exec_requires_running(self, rt):
+        rt.container_create(ContainerSpec(name="a-0", image="x"))
+        with pytest.raises(errors.ApiError):
+            rt.container_exec("a-0", ["true"])
+
+    def test_real_exec_runs_subprocess(self, tmp_path):
+        rt = FakeRuntime(root=str(tmp_path), allow_exec=True)
+        rt.container_create(ContainerSpec(name="a-0", image="x", env=["FOO=bar"]))
+        rt.container_start("a-0")
+        res = rt.container_exec(
+            "a-0", [sys.executable, "-c", "import os; print(os.environ['FOO'])"]
+        )
+        assert res.exit_code == 0
+        assert res.output.strip() == "bar"
+
+    def test_commit(self, rt):
+        rt.container_create(ContainerSpec(name="a-0", image="x"))
+        img = rt.container_commit("a-0", "snapshot:v1")
+        assert img.startswith("sha256:")
+
+
+class TestTpuAttachment:
+    def setup_method(self):
+        self.topo = HostTopology.build("v5e-8")
+
+    def test_render_devices_and_env(self):
+        spec = ContainerSpec(name="t-0", image="jax")
+        render_tpu_attachment(spec, [0, 1, 2, 3], self.topo)
+        dev_paths = [d.host_path for d in spec.devices]
+        assert dev_paths == ["/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"]
+        env = dict(e.split("=", 1) for e in spec.env)
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+        # chips 0-3 of a 2x4 mesh form a 2x2 block
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+        assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+        assert env["CLOUD_TPU_TASK_ID"] == "0"
+
+    def test_cardless_renders_nothing(self):
+        spec = ContainerSpec(name="t-0", image="jax")
+        render_tpu_attachment(spec, [], self.topo)
+        assert spec.devices == [] and spec.env == [] and spec.chip_ids == []
+
+    def test_rerender_does_not_stack(self):
+        """Patching chip count must replace, not append, the TPU artifacts."""
+        spec = ContainerSpec(name="t-0", image="jax", env=["USER_VAR=1"])
+        render_tpu_attachment(spec, [0, 1, 2, 3], self.topo)
+        render_tpu_attachment(spec, [0, 1], self.topo)
+        env = [e for e in spec.env if e.startswith("TPU_VISIBLE_CHIPS=")]
+        assert env == ["TPU_VISIBLE_CHIPS=0,1"]
+        assert "USER_VAR=1" in spec.env
+        assert len(spec.devices) == 2
+
+    def test_scattered_pick_falls_back_to_line_bounds(self):
+        spec = ContainerSpec(name="t-0", image="jax")
+        # chips 0 and 7 are opposite corners: bounding box 2x4 != count 2
+        render_tpu_attachment(spec, [0, 7], self.topo, ici_contiguous=False)
+        env = dict(e.split("=", 1) for e in spec.env)
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+
+    def test_libtpu_bind_mount(self):
+        spec = ContainerSpec(name="t-0", image="jax")
+        render_tpu_attachment(spec, [0], self.topo, libtpu_path="/opt/libtpu.so")
+        assert "/opt/libtpu.so:/lib/libtpu.so:ro" in spec.binds
+        assert "TPU_LIBRARY_PATH=/lib/libtpu.so" in spec.env
+
+    def test_spec_roundtrip(self):
+        spec = ContainerSpec(
+            name="t-0", image="jax",
+            port_bindings=[PortBinding(8080, 40000)],
+        )
+        render_tpu_attachment(spec, [0, 1], self.topo)
+        again = ContainerSpec.from_dict(spec.to_dict())
+        assert again == spec

@@ -1,0 +1,205 @@
+"""Schedulers: port allocator and the ICI-topology-aware chip/slice allocator."""
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler, candidate_shapes
+from tpu_docker_api.scheduler.topology import (
+    HostTopology,
+    default_mesh_shape,
+    parse_accelerator_type,
+    parse_slice_shape,
+)
+from tpu_docker_api.state.kv import MemoryKV
+
+
+class TestTopology:
+    def test_parse_accelerator_type(self):
+        gen, chips = parse_accelerator_type("v5e-8")
+        assert gen.name == "v5e" and chips == 8
+        gen, chips = parse_accelerator_type("v5p-16")  # 16 cores = 8 chips
+        assert gen.name == "v5p" and chips == 8
+        gen, chips = parse_accelerator_type("v4-8")
+        assert gen.name == "v4" and chips == 4
+        with pytest.raises(ValueError):
+            parse_accelerator_type("h100-8")
+
+    def test_parse_slice_shape(self):
+        assert parse_slice_shape("2x2") == (2, 2, 1)
+        assert parse_slice_shape("2x2x4") == (2, 2, 4)
+        assert parse_slice_shape("4") == (4, 1, 1)
+        with pytest.raises(ValueError):
+            parse_slice_shape("2x0")
+
+    def test_default_mesh_shapes(self):
+        gen, _ = parse_accelerator_type("v5e-8")
+        assert default_mesh_shape(gen, 8) == (2, 4, 1)
+        assert default_mesh_shape(gen, 16) == (2, 8, 1)
+        gen_p, _ = parse_accelerator_type("v5p-8")
+        assert default_mesh_shape(gen_p, 4) == (2, 2, 1)
+        assert default_mesh_shape(gen_p, 8) == (2, 2, 2)  # 3D torus tiles in z
+
+    def test_build_topology(self):
+        topo = HostTopology.build("v5e-8")
+        assert topo.n_chips == 8
+        assert topo.mesh_shape == (2, 4, 1)
+        assert sorted(topo.coords) == list(range(8))
+        # coordinates are unique and in-bounds
+        assert len(set(topo.coords.values())) == 8
+        for x, y, z in topo.coords.values():
+            assert 0 <= x < 2 and 0 <= y < 4 and z == 0
+
+
+class TestCandidateShapes:
+    def test_compact_first(self):
+        shapes = candidate_shapes(4, (4, 4, 4))
+        assert shapes[0] == (2, 2, 1)  # most compact before lines
+        assert (4, 1, 1) in shapes and (1, 1, 4) in shapes
+
+    def test_respects_mesh_bounds(self):
+        shapes = candidate_shapes(8, (2, 4, 1))
+        assert (2, 4, 1) in shapes
+        assert all(a <= 2 and b <= 4 and c <= 1 for a, b, c in shapes)
+
+
+class TestChipScheduler:
+    def make(self, acc="v5e-8"):
+        kv = MemoryKV()
+        return ChipScheduler(HostTopology.build(acc), kv), kv
+
+    def test_alloc_contiguous_2x2(self):
+        sched, _ = self.make()
+        ids, contiguous = sched.apply_chips(4)
+        assert contiguous and len(ids) == 4
+        coords = [sched.topology.coords[c] for c in ids]
+        xs = {c[0] for c in coords}
+        ys = {c[1] for c in coords}
+        assert len(xs) == 2 and len(ys) == 2  # a 2x2 block, not a line
+
+    def test_deterministic(self):
+        """Reference iterates a Go map ⇒ nondeterministic pick
+        (gpuscheduler/scheduler.go:74-82). Ours must be reproducible."""
+        picks = set()
+        for _ in range(5):
+            sched, _ = self.make()
+            ids, _ = sched.apply_chips(2)
+            picks.add(tuple(ids))
+        assert len(picks) == 1
+
+    def test_explicit_shape(self):
+        sched, _ = self.make()
+        ids, contiguous = sched.apply_chips(0, shape="2x2")
+        assert contiguous and len(ids) == 4
+
+    def test_explicit_shape_exhausted_raises(self):
+        sched, _ = self.make()
+        sched.apply_chips(0, shape="2x4")  # takes the whole host
+        with pytest.raises(errors.ChipNotEnough):
+            sched.apply_chips(0, shape="2x2")
+
+    def test_scattered_fallback(self):
+        """When fragmentation prevents a contiguous block, allocation still
+        succeeds (parity: reference never guarantees adjacency) but reports
+        non-contiguous."""
+        sched, _ = self.make()
+        everything, _ = sched.apply_chips(8)
+        # free two opposite corners of the 2x4 mesh: (0,0) and (1,3)
+        corner_a = sched.topology.chip_at((0, 0, 0))
+        corner_b = sched.topology.chip_at((1, 3, 0))
+        sched.restore_chips([corner_a, corner_b])
+        ids, contiguous = sched.apply_chips(2)
+        assert sorted(ids) == sorted([corner_a, corner_b])
+        assert not contiguous  # corners share no ICI link
+
+    def test_exhaustion_raises(self):
+        sched, _ = self.make()
+        sched.apply_chips(8)
+        with pytest.raises(errors.ChipNotEnough):
+            sched.apply_chips(1)
+
+    def test_restore_and_refill(self):
+        sched, _ = self.make()
+        ids, _ = sched.apply_chips(8)
+        sched.restore_chips(ids[:4])
+        assert len(sched.free_chips) == 4
+        again, _ = sched.apply_chips(4)
+        assert sorted(again) == sorted(ids[:4])
+
+    def test_state_survives_restart(self):
+        """Reference persists only on graceful Close (scheduler.go:59-61);
+        ours persists on every mutation."""
+        sched, kv = self.make()
+        ids, _ = sched.apply_chips(4, owner="train")
+        sched2 = ChipScheduler(HostTopology.build("v5e-8"), kv)
+        assert sched2.free_chips == sched.free_chips
+        status = sched2.status()
+        owners = {c["chipId"]: c["owner"] for c in status["chips"] if c["used"]}
+        assert all(o == "train" for o in owners.values())
+
+    def test_status_is_snapshot(self):
+        sched, _ = self.make()
+        st = sched.status()
+        st["chips"][0]["used"] = True  # mutating the view must not leak
+        assert not sched.status()["chips"][0]["used"]
+
+    def test_zero_request(self):
+        sched, _ = self.make()
+        ids, contiguous = sched.apply_chips(0)
+        assert ids == [] and contiguous
+
+    def test_largest_free_block_tracks_fragmentation(self):
+        sched, _ = self.make()
+        assert sched.status()["largestFreeBlock"] == 8
+        sched.apply_chips(0, shape="2x2")
+        assert sched.status()["largestFreeBlock"] == 4
+
+    def test_v5p_3d_block(self):
+        sched, _ = self.make("v5p-16")  # 8 chips, mesh 2x2x2
+        ids, contiguous = sched.apply_chips(0, shape="2x2x2")
+        assert contiguous and len(ids) == 8
+
+
+class TestPortScheduler:
+    def test_alloc_and_restore(self):
+        kv = MemoryKV()
+        ps = PortScheduler(kv, 40000, 40009)
+        ports = ps.apply_ports(3)
+        assert ports == [40000, 40001, 40002]
+        ps.restore_ports(ports[:1])
+        assert ps.n_free == 8
+
+    def test_cursor_avoids_immediate_reuse(self):
+        ps = PortScheduler(MemoryKV(), 40000, 40009)
+        a = ps.apply_ports(2)
+        ps.restore_ports(a)
+        b = ps.apply_ports(2)
+        assert b == [40002, 40003]  # cursor moved past the released pair
+
+    def test_exhaustion(self):
+        ps = PortScheduler(MemoryKV(), 40000, 40002)
+        ps.apply_ports(3)
+        with pytest.raises(errors.PortNotEnough):
+            ps.apply_ports(1)
+
+    def test_wraparound(self):
+        ps = PortScheduler(MemoryKV(), 40000, 40004)
+        first = ps.apply_ports(4)
+        ps.restore_ports(first[:2])  # free 40000, 40001
+        got = ps.apply_ports(3)      # must wrap: 40004 then 40000, 40001
+        assert got == [40004, 40000, 40001]
+
+    def test_state_survives_restart(self):
+        kv = MemoryKV()
+        ps = PortScheduler(kv, 40000, 40009)
+        ps.apply_ports(5)
+        ps2 = PortScheduler(kv, 40000, 40009)
+        assert ps2.n_free == 5
+        assert ps2.status()["usedPorts"] == [40000, 40001, 40002, 40003, 40004]
+
+    def test_status_sorted(self):
+        ps = PortScheduler(MemoryKV(), 40000, 40009)
+        ps.apply_ports(3)
+        st = ps.status()
+        assert st["usedPorts"] == sorted(st["usedPorts"])
+        assert st["usedCount"] == 3

@@ -1,0 +1,322 @@
+"""Container service flows on the fake runtime — the hermetic tier the
+reference never had (SURVEY.md §4)."""
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.scheduler.topology import HostTopology
+from tpu_docker_api.schemas.container import (
+    Bind,
+    ContainerCommit,
+    ContainerDelete,
+    ContainerExecute,
+    ContainerPatchChips,
+    ContainerPatchVolume,
+    ContainerPort,
+    ContainerRun,
+    ContainerStop,
+)
+from tpu_docker_api.service.container import ContainerService
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import WorkQueue
+
+
+class Env:
+    def __init__(self, tmp_path, acc="v5e-8"):
+        self.kv = MemoryKV()
+        self.store = StateStore(self.kv)
+        self.runtime = FakeRuntime(root=str(tmp_path))
+        self.chips = ChipScheduler(HostTopology.build(acc), self.kv)
+        self.ports = PortScheduler(self.kv, 40000, 40099)
+        self.versions = VersionMap(self.kv, keys.VERSIONS_CONTAINER_KEY)
+        self.wq = WorkQueue(self.kv)
+        self.wq.start()
+        self.svc = ContainerService(
+            self.runtime, self.store, self.chips, self.ports,
+            self.versions, self.wq,
+        )
+
+    def close(self):
+        self.wq.close()
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Env(tmp_path)
+    yield e
+    e.close()
+
+
+def run_default(env, name="train", chips=4, **kw):
+    out = env.svc.run_container(ContainerRun(
+        image_name="jax:latest", container_name=name, chip_count=chips, **kw
+    ))
+    env.wq.drain()
+    return out
+
+
+class TestRun:
+    def test_run_tpu_container(self, env):
+        out = run_default(env)
+        assert out["name"] == "train-0"
+        assert len(out["chipIds"]) == 4 and out["iciContiguous"]
+        info = env.runtime.container_inspect("train-0")
+        assert info.running
+        assert [d.host_path for d in info.spec.devices] == [
+            f"/dev/accel{i}" for i in out["chipIds"]
+        ]
+        # state persisted asynchronously (reference :528-532)
+        state = env.store.get_container("train-0")
+        assert state.version == 0
+
+    def test_run_cardless(self, env):
+        out = run_default(env, name="smoke", chips=0)
+        info = env.runtime.container_inspect("smoke-0")
+        assert info.spec.devices == [] and info.spec.chip_ids == []
+
+    def test_run_with_ports(self, env):
+        out = env.svc.run_container(ContainerRun(
+            image_name="jax", container_name="srv", chip_count=0,
+            container_ports=[ContainerPort(8080), ContainerPort(2222)],
+        ))
+        env.wq.drain()
+        info = env.runtime.container_inspect("srv-0")
+        hosts = [pb.host_port for pb in info.spec.port_bindings]
+        assert hosts == [40000, 40001]
+
+    def test_duplicate_family_rejected(self, env):
+        run_default(env)
+        with pytest.raises(errors.ContainerExisted):
+            run_default(env)
+
+    def test_chip_exhaustion_rejected_and_rolls_back(self, env):
+        with pytest.raises(errors.ChipNotEnough):
+            run_default(env, chips=9)
+        assert len(env.chips.free_chips) == 8
+        assert env.versions.get("train") is None
+
+    def test_explicit_slice_shape(self, env):
+        out = run_default(env, name="slice", chips=0, slice_shape="2x2")
+        assert len(out["chipIds"]) == 4 and out["iciContiguous"]
+
+    def test_start_failure_rolls_back_everything(self, env, monkeypatch):
+        def boom(name):
+            raise RuntimeError("start failed")
+
+        monkeypatch.setattr(env.runtime, "container_start", boom)
+        with pytest.raises(RuntimeError):
+            env.svc.run_container(ContainerRun(
+                image_name="jax", container_name="bad", chip_count=2,
+                container_ports=[ContainerPort(80)],
+            ))
+        # container removed, chips+ports returned, version rolled back
+        assert not env.runtime.container_exists("bad-0")
+        assert len(env.chips.free_chips) == 8
+        assert env.ports.n_free == 100
+        assert env.versions.get("bad") is None
+
+
+class TestPatchChips:
+    def test_grow_rolls_new_version(self, env):
+        run_default(env, chips=2)
+        out = env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=4))
+        env.wq.drain()
+        assert out["name"] == "train-1"
+        assert len(out["chipIds"]) == 4
+        # old stopped, new running (quiesce→copy→start, SURVEY.md §5.4)
+        assert not env.runtime.container_inspect("train-0").running
+        assert env.runtime.container_inspect("train-1").running
+        # 4 chips in use total
+        assert len(env.chips.free_chips) == 4
+
+    def test_data_migrated_before_start(self, env, tmp_path):
+        run_default(env, chips=2)
+        # write "checkpoint" data into the old container's fs
+        old_dir = env.runtime.container_data_dir("train-0")
+        with open(f"{old_dir}/ckpt.txt", "w") as f:
+            f.write("step=100")
+        env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=4))
+        env.wq.drain()
+        new_dir = env.runtime.container_data_dir("train-1")
+        with open(f"{new_dir}/ckpt.txt") as f:
+            assert f.read() == "step=100"
+        # engine saw: stop(old) strictly before start(new)
+        calls = env.runtime.calls
+        assert calls.index(("stop", "train-0")) < calls.index(("start", "train-1"))
+
+    def test_shrink(self, env):
+        run_default(env, chips=4)
+        out = env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=1))
+        env.wq.drain()
+        assert len(out["chipIds"]) == 1
+        assert len(env.chips.free_chips) == 7
+
+    def test_shrink_to_cardless(self, env):
+        run_default(env, chips=2)
+        out = env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=0))
+        env.wq.drain()
+        info = env.runtime.container_inspect(out["name"])
+        assert info.spec.devices == []
+        assert len(env.chips.free_chips) == 8
+
+    def test_cardless_to_carded(self, env):
+        run_default(env, name="cpu", chips=0)
+        out = env.svc.patch_container_chips("cpu-0", ContainerPatchChips(chip_count=2))
+        env.wq.drain()
+        assert len(out["chipIds"]) == 2
+
+    def test_noop_patch_rejected(self, env):
+        run_default(env, chips=2)
+        with pytest.raises(errors.NoPatchRequired):
+            env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=2))
+
+    def test_version_mismatch_rejected(self, env):
+        run_default(env, chips=2)
+        env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=3))
+        env.wq.drain()
+        with pytest.raises(errors.VersionNotMatch):
+            env.svc.patch_container_chips("train-0", ContainerPatchChips(chip_count=4))
+
+    def test_patch_by_base_name_hits_latest(self, env):
+        run_default(env, chips=2)
+        out = env.svc.patch_container_chips("train", ContainerPatchChips(chip_count=3))
+        env.wq.drain()
+        assert out["name"] == "train-1"
+
+    def test_fresh_ports_on_new_version(self, env):
+        env.svc.run_container(ContainerRun(
+            image_name="jax", container_name="srv", chip_count=1,
+            container_ports=[ContainerPort(8080)],
+        ))
+        env.wq.drain()
+        old_port = env.runtime.container_inspect("srv-0").spec.port_bindings[0].host_port
+        env.svc.patch_container_chips("srv-0", ContainerPatchChips(chip_count=2))
+        env.wq.drain()
+        new_port = env.runtime.container_inspect("srv-1").spec.port_bindings[0].host_port
+        assert new_port != old_port
+        # old port returned to the pool
+        assert old_port not in env.ports.status()["usedPorts"]
+
+
+class TestPatchVolume:
+    def test_swap_bind(self, env, tmp_path):
+        (tmp_path / "v1").mkdir()
+        (tmp_path / "v2").mkdir()
+        env.svc.run_container(ContainerRun(
+            image_name="jax", container_name="train", chip_count=1,
+            binds=[Bind(str(tmp_path / "v1"), "/data")],
+        ))
+        env.wq.drain()
+        out = env.svc.patch_container_volume("train-0", ContainerPatchVolume(
+            old_bind=Bind(str(tmp_path / "v1"), "/data"),
+            new_bind=Bind(str(tmp_path / "v2"), "/data"),
+        ))
+        env.wq.drain()
+        info = env.runtime.container_inspect(out["name"])
+        assert info.spec.binds == [f"{tmp_path}/v2:/data"]
+
+    def test_unknown_old_bind_rejected(self, env):
+        run_default(env, chips=0)
+        with pytest.raises(errors.BadRequest):
+            env.svc.patch_container_volume("train-0", ContainerPatchVolume(
+                old_bind=Bind("/nope", "/data"), new_bind=Bind("/x", "/data"),
+            ))
+
+    def test_identical_bind_noop(self, env):
+        run_default(env, chips=0)
+        with pytest.raises(errors.NoPatchRequired):
+            env.svc.patch_container_volume("train-0", ContainerPatchVolume(
+                old_bind=Bind("/a", "/d"), new_bind=Bind("/a", "/d"),
+            ))
+
+
+class TestStopRestartDeleteExecCommitInfo:
+    def test_stop_restores_resources(self, env):
+        run_default(env, chips=4)
+        env.svc.stop_container("train-0")
+        assert len(env.chips.free_chips) == 8
+        assert not env.runtime.container_inspect("train-0").running
+
+    def test_restart_cardless_in_place(self, env):
+        run_default(env, name="cpu", chips=0)
+        out = env.svc.restart_container("cpu-0")
+        assert out["name"] == "cpu-0"  # no version bump
+
+    def test_restart_running_carded_in_place(self, env):
+        run_default(env, chips=2)
+        out = env.svc.restart_container("train-0")
+        assert out["name"] == "train-0"
+
+    def test_restart_stopped_carded_rolls_version(self, env):
+        """Stopped carded container lost its chips; restart re-allocates and
+        rolls a new version (reference :390-425)."""
+        run_default(env, chips=2)
+        env.svc.stop_container("train-0")
+        out = env.svc.restart_container("train-0")
+        env.wq.drain()
+        assert out["name"] == "train-1"
+        assert len(out["chipIds"]) == 2
+        assert env.runtime.container_inspect("train-1").running
+
+    def test_delete_returns_resources(self, env):
+        env.svc.run_container(ContainerRun(
+            image_name="jax", container_name="train", chip_count=4,
+            container_ports=[ContainerPort(8080)],
+        ))
+        env.wq.drain()
+        env.svc.delete_container("train-0", ContainerDelete(
+            force=True, del_etcd_info_and_version_record=True,
+        ))
+        env.wq.drain()
+        assert len(env.chips.free_chips) == 8
+        assert env.ports.n_free == 100
+        assert env.versions.get("train") is None
+        with pytest.raises(errors.NotExistInStore):
+            env.store.get_container("train-0")
+
+    def test_delete_keeps_state_without_flag(self, env):
+        run_default(env, chips=1)
+        env.svc.delete_container("train-0", ContainerDelete(force=True))
+        env.wq.drain()
+        assert env.store.get_container("train-0").version == 0
+        assert env.versions.get("train") == 0
+
+    def test_execute(self, tmp_path):
+        e = Env(tmp_path)
+        e.runtime._allow_exec = True
+        try:
+            e.svc.run_container(ContainerRun(
+                image_name="jax", container_name="smoke", chip_count=0
+            ))
+            import sys
+            out = e.svc.execute_container("smoke-0", ContainerExecute(
+                cmd=[sys.executable, "-c", "print(6 * 7)"]
+            ))
+            assert out.strip() == "42"
+        finally:
+            e.close()
+
+    def test_commit_requires_image_name(self, env):
+        run_default(env, chips=0)
+        with pytest.raises(errors.BadRequest):
+            env.svc.commit_container("train-0", ContainerCommit())
+        img = env.svc.commit_container("train-0", ContainerCommit("snap:v1"))
+        assert img.startswith("sha256:")
+
+    def test_info(self, env):
+        run_default(env, chips=2)
+        info = env.svc.get_container_info("train-0")
+        assert info["state"]["version"] == 0
+        assert info["runtime"]["running"]
+
+    def test_ops_on_missing_container(self, env):
+        with pytest.raises(errors.ContainerNotExist):
+            env.svc.stop_container("ghost-0")
+        with pytest.raises(errors.ContainerNotExist):
+            env.svc.get_container_info("ghost")

@@ -1,0 +1,223 @@
+"""Resource-safety regressions from code review: double-free, shrink rollback,
+concurrent flows, family-wide delete, copy-failure compensation."""
+
+import threading
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.scheduler.topology import HostTopology
+from tpu_docker_api.schemas.container import (
+    ContainerDelete,
+    ContainerPatchChips,
+    ContainerPort,
+    ContainerRun,
+)
+from tpu_docker_api.schemas.volume import parse_size
+from tpu_docker_api.service.container import ContainerService
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import WorkQueue
+
+
+class Env:
+    def __init__(self, tmp_path, acc="v5e-8", **wq_kwargs):
+        self.kv = MemoryKV()
+        self.store = StateStore(self.kv)
+        self.runtime = FakeRuntime(root=str(tmp_path))
+        self.chips = ChipScheduler(HostTopology.build(acc), self.kv)
+        self.ports = PortScheduler(self.kv, 40000, 40099)
+        self.versions = VersionMap(self.kv, keys.VERSIONS_CONTAINER_KEY)
+        self.wq = WorkQueue(self.kv, **wq_kwargs)
+        self.wq.start()
+        self.svc = ContainerService(
+            self.runtime, self.store, self.chips, self.ports,
+            self.versions, self.wq,
+        )
+
+    def run(self, name, chips=0, **kw):
+        out = self.svc.run_container(ContainerRun(
+            image_name="jax", container_name=name, chip_count=chips, **kw
+        ))
+        self.wq.drain()
+        return out
+
+    def close(self):
+        self.wq.close()
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Env(tmp_path)
+    yield e
+    e.close()
+
+
+def test_stop_then_delete_does_not_double_free(env):
+    """A's chips, freed on stop and re-allocated to B, must survive A's delete."""
+    env.run("a", chips=2)
+    env.svc.stop_container("a-0")          # chips 0,1 freed
+    out_b = env.run("b", chips=2)          # B takes chips 0,1
+    assert out_b["chipIds"] == [0, 1]
+    env.svc.delete_container("a-0", ContainerDelete(force=True))
+    env.wq.drain()
+    # B's chips must still be allocated to B
+    status = env.chips.status()
+    owners = {c["chipId"]: c["owner"] for c in status["chips"] if c["used"]}
+    assert owners == {0: "b", 1: "b"}
+
+
+def test_stop_then_delete_does_not_double_free_ports(env):
+    env.svc.run_container(ContainerRun(
+        image_name="jax", container_name="a", chip_count=0,
+        container_ports=[ContainerPort(80)],
+    ))
+    env.wq.drain()
+    env.svc.stop_container("a-0")          # port 40000 freed
+    env.svc.run_container(ContainerRun(
+        image_name="jax", container_name="b", chip_count=0,
+        container_ports=[ContainerPort(80)],
+    ))
+    env.wq.drain()
+    env.svc.delete_container("a-0", ContainerDelete(force=True))
+    env.wq.drain()
+    # b's port must still be held
+    assert env.ports.status()["usedCount"] == 1
+
+
+def test_failed_shrink_keeps_chips_allocated(env, monkeypatch):
+    """If the replacement create fails mid-shrink, the still-running old
+    container's chips must remain allocated."""
+    env.run("t", chips=4)
+
+    def boom(spec):
+        raise RuntimeError("create failed")
+
+    monkeypatch.setattr(env.runtime, "container_create", boom)
+    with pytest.raises(RuntimeError):
+        env.svc.patch_container_chips("t-0", ContainerPatchChips(chip_count=2))
+    # old container untouched, all 4 chips still allocated
+    assert env.runtime.container_inspect("t-0").running
+    assert len(env.chips.free_chips) == 4
+
+
+def test_concurrent_same_name_creates_one_family(tmp_path):
+    env = Env(tmp_path)
+    try:
+        results, errs = [], []
+
+        def create():
+            try:
+                results.append(env.svc.run_container(ContainerRun(
+                    image_name="jax", container_name="dup", chip_count=1
+                )))
+            except errors.ContainerExisted:
+                errs.append(1)
+
+        threads = [threading.Thread(target=create) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 1 and len(errs) == 3
+        assert env.versions.get("dup") == 0
+    finally:
+        env.close()
+
+
+def test_concurrent_patches_serialize(tmp_path):
+    env = Env(tmp_path)
+    try:
+        env.run("t", chips=1)
+        outcomes = []
+
+        def patch(n):
+            try:
+                outcomes.append(
+                    env.svc.patch_container_chips("t", ContainerPatchChips(chip_count=n))
+                )
+            except errors.ApiError as e:
+                outcomes.append(e)
+
+        threads = [threading.Thread(target=patch, args=(n,)) for n in (2, 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        env.wq.drain()
+        # both may succeed (serialized), but versions must be distinct
+        names = [o["name"] for o in outcomes if isinstance(o, dict)]
+        assert len(names) == len(set(names))
+        latest = env.versions.get("t")
+        assert latest == len(names)  # 0 + one bump per successful patch
+    finally:
+        env.close()
+
+
+def test_delete_removes_all_versions(env):
+    env.run("t", chips=1)
+    env.svc.patch_container_chips("t-0", ContainerPatchChips(chip_count=2))
+    env.wq.drain()
+    env.svc.patch_container_chips("t-1", ContainerPatchChips(chip_count=3))
+    env.wq.drain()
+    assert env.runtime.container_list() == ["t-0", "t-1", "t-2"]
+    env.svc.delete_container("t-2", ContainerDelete(
+        force=True, del_etcd_info_and_version_record=True
+    ))
+    env.wq.drain()
+    assert env.runtime.container_list() == []
+    assert len(env.chips.free_chips) == 8
+    # recreate after purge works from version 0
+    out = env.run("t", chips=1)
+    assert out["name"] == "t-0"
+
+
+def test_spec_persist_is_synchronous(env):
+    """A patch immediately after run must find the spec (no async persist
+    race) — store write happens before run_container returns."""
+    env.svc.run_container(ContainerRun(
+        image_name="jax", container_name="t", chip_count=1
+    ))
+    # note: no wq.drain() here
+    out = env.svc.patch_container_chips("t-0", ContainerPatchChips(chip_count=2))
+    assert out["name"] == "t-1"
+    env.wq.drain()
+
+
+def test_copy_dead_letter_restarts_old_container(tmp_path, monkeypatch):
+    env = Env(tmp_path, max_retries=2, backoff_base_s=0.001)
+    try:
+        env.run("t", chips=1)
+
+        def bad_copy(src, dst):
+            raise OSError("disk full")
+
+        env.wq._copy = bad_copy
+        env.svc.patch_container_chips("t-0", ContainerPatchChips(chip_count=2))
+        env.wq.drain()
+        # copy dead-lettered: compensation restarted the old container
+        assert len(env.wq.dead_letters) == 1
+        assert env.runtime.container_inspect("t-0").running
+        assert not env.runtime.container_inspect("t-1").running
+        assert env.wq.dead_letter_view()[0]["error"].startswith("OSError")
+    finally:
+        env.close()
+
+
+def test_info_serves_historical_versions(env):
+    env.run("t", chips=1)
+    env.svc.patch_container_chips("t-0", ContainerPatchChips(chip_count=2))
+    env.wq.drain()
+    old = env.svc.get_container_info("t-0")
+    assert old["state"]["version"] == 0
+    assert old["runtime"]["running"] is False
+
+
+def test_parse_size_fractional():
+    assert parse_size("1.5GB") == int(1.5 * 1024**3)
+    assert parse_size("0.5MB") == 512 * 1024

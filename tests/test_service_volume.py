@@ -1,0 +1,126 @@
+"""Volume service flows on the fake runtime."""
+
+import os
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.schemas.volume import VolumeCreate, VolumeDelete, VolumeSize
+from tpu_docker_api.service.volume import VolumeService
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import WorkQueue
+
+
+@pytest.fixture
+def env(tmp_path):
+    class E:
+        pass
+
+    e = E()
+    e.kv = MemoryKV()
+    e.store = StateStore(e.kv)
+    e.runtime = FakeRuntime(root=str(tmp_path))
+    e.versions = VersionMap(e.kv, keys.VERSIONS_VOLUME_KEY)
+    e.wq = WorkQueue(e.kv)
+    e.wq.start()
+    e.svc = VolumeService(e.runtime, e.store, e.versions, e.wq)
+    yield e
+    e.wq.close()
+
+
+class TestCreate:
+    def test_create_sized(self, env):
+        out = env.svc.create_volume(VolumeCreate(volume_name="data", size="10GB"))
+        env.wq.drain()
+        assert out["name"] == "data-0"
+        info = env.runtime.volume_inspect("data-0")
+        assert info.driver_opts == {"size": "10GB"}
+        assert env.store.get_volume("data-0").size == "10GB"
+
+    def test_create_unsized(self, env):
+        out = env.svc.create_volume(VolumeCreate(volume_name="scratch"))
+        env.wq.drain()
+        assert env.runtime.volume_inspect("scratch-0").driver_opts == {}
+
+    def test_bad_unit_rejected(self, env):
+        with pytest.raises(ValueError):
+            env.svc.create_volume(VolumeCreate(volume_name="x", size="10XB"))
+
+    def test_duplicate_rejected(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        with pytest.raises(errors.VolumeExisted):
+            env.svc.create_volume(VolumeCreate(volume_name="data", size="2GB"))
+
+
+class TestResize:
+    def test_grow_copies_data(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        mp = env.runtime.volume_data_dir("data-0")
+        with open(os.path.join(mp, "ckpt.bin"), "wb") as f:
+            f.write(b"\x01" * 2048)
+        out = env.svc.patch_volume_size("data-0", VolumeSize(size="2GB"))
+        env.wq.drain()
+        assert out["name"] == "data-1"
+        new_mp = env.runtime.volume_data_dir("data-1")
+        with open(os.path.join(new_mp, "ckpt.bin"), "rb") as f:
+            assert f.read() == b"\x01" * 2048
+
+    def test_shrink_guard(self, env):
+        """Reference shrink guard: bytes used > target ⇒ error
+        (volume.go:151-166)."""
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        mp = env.runtime.volume_data_dir("data-0")
+        with open(os.path.join(mp, "big.bin"), "wb") as f:
+            f.write(b"\x00" * (2 * 1024 * 1024))  # 2MB used
+        with pytest.raises(errors.VolumeSizeUsedGreaterThanReduced):
+            env.svc.patch_volume_size("data-0", VolumeSize(size="1MB"))
+
+    def test_shrink_within_used_ok(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        out = env.svc.patch_volume_size("data-0", VolumeSize(size="500MB"))
+        env.wq.drain()
+        assert out["name"] == "data-1"
+
+    def test_same_size_noop(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        with pytest.raises(errors.NoPatchRequired):
+            env.svc.patch_volume_size("data-0", VolumeSize(size="1GB"))
+
+    def test_version_mismatch(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        env.svc.patch_volume_size("data-0", VolumeSize(size="2GB"))
+        env.wq.drain()
+        with pytest.raises(errors.VersionNotMatch):
+            env.svc.patch_volume_size("data-0", VolumeSize(size="3GB"))
+
+
+class TestDeleteInfo:
+    def test_delete_with_purge(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        env.svc.delete_volume("data-0", VolumeDelete(
+            del_etcd_info_and_version_record=True
+        ))
+        env.wq.drain()
+        assert not env.runtime.volume_exists("data-0")
+        assert env.versions.get("data") is None
+
+    def test_info(self, env):
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1GB"))
+        env.wq.drain()
+        info = env.svc.get_volume_info("data")
+        assert info["state"]["size"] == "1GB"
+        assert info["runtime"]["mountpoint"]
+
+    def test_missing_raises(self, env):
+        with pytest.raises(errors.VolumeNotExist):
+            env.svc.get_volume_info("ghost")

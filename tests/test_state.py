@@ -1,0 +1,224 @@
+"""State layer: KV backends, per-version store, version maps, work queue."""
+
+import json
+import threading
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.schemas.state import ContainerState, VolumeState
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.keys import Resource, split_versioned_name
+from tpu_docker_api.state.kv import MemoryKV, SqliteKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import (
+    CopyTask,
+    DelKeyTask,
+    FnTask,
+    PutKVTask,
+    WorkQueue,
+)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def kv(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryKV()
+    else:
+        store = SqliteKV(str(tmp_path / "state.db"))
+        yield store
+        store.close()
+
+
+class TestKV:
+    def test_put_get_delete(self, kv):
+        kv.put("/a", "1")
+        assert kv.get("/a") == "1"
+        kv.put("/a", "2")
+        assert kv.get("/a") == "2"
+        kv.delete("/a")
+        with pytest.raises(errors.NotExistInStore):
+            kv.get("/a")
+        kv.delete("/a")  # idempotent, etcd semantics
+
+    def test_range_prefix_sorted(self, kv):
+        for k in ["/x/b", "/x/a", "/y/a", "/x/c"]:
+            kv.put(k, k)
+        assert list(kv.range_prefix("/x/")) == ["/x/a", "/x/b", "/x/c"]
+        kv.delete_prefix("/x/")
+        assert kv.range_prefix("/x/") == {}
+        assert kv.get("/y/a") == "/y/a"
+
+    def test_sqlite_survives_reopen(self, tmp_path):
+        path = str(tmp_path / "s.db")
+        s1 = SqliteKV(path)
+        s1.put("/k", "v")
+        s1.close()
+        s2 = SqliteKV(path)
+        assert s2.get("/k") == "v"
+        s2.close()
+
+
+class TestKeys:
+    def test_split_versioned_name(self):
+        assert split_versioned_name("train-3") == ("train", 3)
+        assert split_versioned_name("train") == ("train", None)
+        assert split_versioned_name("train-x") == ("train-x", None)
+
+    def test_version_keys_sort_numerically(self):
+        k2 = keys.version_key(Resource.CONTAINERS, "a", 2)
+        k10 = keys.version_key(Resource.CONTAINERS, "a", 10)
+        assert k2 < k10  # zero-padding keeps lexicographic == numeric
+
+
+class TestStateStore:
+    def test_container_versions_retained(self, kv):
+        """Unlike the reference (one key per family, latest wins —
+        etcd/common.go:75-81), every version must be retrievable."""
+        store = StateStore(kv)
+        for v in range(3):
+            store.put_container(ContainerState(f"web-{v}", v, {"name": f"web-{v}"}))
+        assert store.get_container("web").container_name == "web-2"  # latest
+        assert store.get_container("web-0").container_name == "web-0"
+        assert store.get_container("web-1").container_name == "web-1"
+        assert store.history(Resource.CONTAINERS, "web") == [0, 1, 2]
+        assert store.latest_version(Resource.CONTAINERS, "web") == 2
+
+    def test_delete_family(self, kv):
+        store = StateStore(kv)
+        store.put_volume(VolumeState("data-0", 0, "10GB"))
+        store.put_volume(VolumeState("data-1", 1, "20GB"))
+        store.delete_family(Resource.VOLUMES, "data-1")
+        with pytest.raises(errors.NotExistInStore):
+            store.get_volume("data")
+
+    def test_missing_raises(self, kv):
+        store = StateStore(kv)
+        with pytest.raises(errors.NotExistInStore):
+            store.get_container("ghost")
+        with pytest.raises(errors.NotExistInStore):
+            store.get_container("ghost-4")
+
+
+class TestVersionMap:
+    def test_bump_sequence(self, kv):
+        vm = VersionMap(kv, "/test/versions")
+        assert vm.get("a") is None
+        assert vm.next_version("a") == 0
+        assert vm.next_version("a") == 1
+        assert vm.next_version("b") == 0
+        assert vm.get("a") == 1
+
+    def test_persisted_every_mutation(self, kv):
+        """Reference flushes only on Close (version.go:55-63) — we persist on
+        every bump so a crash loses nothing."""
+        vm = VersionMap(kv, "/test/versions")
+        vm.next_version("a")
+        vm2 = VersionMap(kv, "/test/versions")  # simulated restart
+        assert vm2.get("a") == 0
+
+    def test_rollback(self, kv):
+        vm = VersionMap(kv, "/test/versions")
+        vm.next_version("a")
+        vm.rollback("a", None)
+        assert vm.get("a") is None
+        vm.next_version("a")
+        vm.next_version("a")
+        vm.rollback("a", 0)
+        assert vm.get("a") == 0
+
+    def test_concurrent_bumps_unique(self, kv):
+        vm = VersionMap(kv, "/test/versions")
+        got: list[int] = []
+        lock = threading.Lock()
+
+        def bump():
+            v = vm.next_version("x")
+            with lock:
+                got.append(v)
+
+        threads = [threading.Thread(target=bump) for _ in range(20)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(got) == list(range(20))
+
+
+class TestWorkQueue:
+    def test_put_and_del(self, kv):
+        wq = WorkQueue(kv)
+        wq.start()
+        wq.submit(PutKVTask("/wq/a", "1"))
+        wq.submit(DelKeyTask("/wq/a"))
+        wq.submit(PutKVTask("/wq/b", "2"))
+        wq.drain()
+        wq.close()
+        assert kv.get_or("/wq/a") is None
+        assert kv.get("/wq/b") == "2"
+
+    def test_copy_task_moves_data_then_fires_on_done(self, kv, tmp_path):
+        src = tmp_path / "old"
+        dst = tmp_path / "new"
+        src.mkdir()
+        (src / "ckpt.bin").write_bytes(b"\x00" * 1024)
+        (src / "sub").mkdir()
+        (src / "sub" / "x.txt").write_text("hi")
+        fired = []
+
+        wq = WorkQueue(kv)
+        wq.start()
+        wq.submit(CopyTask(
+            resource="volumes", old_name="old", new_name="new",
+            resolve=lambda n: str(tmp_path / n),
+            on_done=lambda: fired.append(True),
+        ))
+        wq.drain()
+        wq.close()
+        assert (dst / "ckpt.bin").read_bytes() == b"\x00" * 1024
+        assert (dst / "sub" / "x.txt").read_text() == "hi"
+        assert fired == [True]
+
+    def test_bounded_retry_dead_letters(self, kv):
+        """Reference re-enqueues forever with no backoff (workQueue.go:33-47);
+        we retry a bounded number of times then dead-letter."""
+        attempts = []
+
+        def boom():
+            attempts.append(1)
+            raise RuntimeError("nope")
+
+        wq = WorkQueue(kv, max_retries=3, backoff_base_s=0.001)
+        wq.start()
+        wq.submit(FnTask(fn=boom, description="boom"))
+        wq.drain()
+        wq.close()
+        assert len(attempts) == 3
+        assert len(wq.dead_letters) == 1
+
+    def test_tasks_execute_in_order(self, kv):
+        order = []
+        wq = WorkQueue(kv)
+        wq.start()
+        for i in range(10):
+            wq.submit(FnTask(fn=lambda i=i: order.append(i)))
+        wq.drain()
+        wq.close()
+        assert order == list(range(10))
+
+    def test_close_drains_submitted(self, kv):
+        wq = WorkQueue(kv)
+        wq.start()
+        for i in range(50):
+            wq.submit(PutKVTask(f"/drain/{i:02d}", str(i)))
+        wq.close()  # no explicit drain: close itself must finish the backlog
+        assert len(kv.range_prefix("/drain/")) == 50
+
+
+class TestEtcdKVHelpers:
+    def test_prefix_end(self):
+        from tpu_docker_api.state.kv import _prefix_end
+
+        assert _prefix_end("/a/") == "/a0"  # '/' + 1 == '0'
+        assert _prefix_end("ab") == "ac"

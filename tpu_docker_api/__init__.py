@@ -1,0 +1,21 @@
+"""tpu-docker-api: a TPU-native container control plane.
+
+A REST service that provisions TPU-chip-attached Docker containers with
+versioned rolling updates, sized volumes, in-container exec, commit-to-image,
+and resource views — backed by a pluggable KV state store (etcd-compatible),
+an async work queue, and exclusive device + host-port schedulers.
+
+Feature-parity target: `henrywangx/gpu-docker-api` (Go, surveyed in SURVEY.md).
+The architecture here is TPU-first: the GPU-UUID bitmap scheduler becomes an
+ICI-topology-aware chip/slice allocator (`tpu_docker_api.scheduler`), the
+nvidia-container-runtime `DeviceRequests` become `/dev/accel*` mounts plus
+libtpu + JAX distributed env injection (`tpu_docker_api.runtime.spec`), and the
+NVML sidecar becomes a libtpu telemetry shim (`tpu_docker_api.telemetry`).
+
+The compute path (`models/`, `ops/`, `parallel/`, `train/`) is the JAX/XLA
+workload layer the control plane provisions: Llama-family transformers sharded
+over a `jax.sharding.Mesh` with dp/fsdp/tp/sp axes, ring attention for long
+context, and Pallas TPU kernels for the hot ops.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from tpu_docker_api.daemon import main
+
+main()

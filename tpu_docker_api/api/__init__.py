@@ -1,0 +1,3 @@
+"""HTTP API layer (parity: reference L1 — ``internal/api/``)."""
+
+from tpu_docker_api.api.app import ApiServer, build_handler  # noqa: F401

@@ -1,0 +1,295 @@
+"""HTTP server: routing, validation, error mapping.
+
+Parity: the reference's gin routes (api/container.go:19-38, volume.go:19-28,
+resource.go:12-15) on a stdlib ThreadingHTTPServer — 14 routes + health.
+Name-format validation follows the reference: base names must not contain
+``-`` on create (api/container.go:66-70); other ops accept ``name`` (latest)
+or ``name-version`` (optimistic check). The reference's six fall-through
+validation bugs (missing ``return`` after ResponseError, SURVEY.md appendix)
+are structurally impossible here: validation raises.
+
+Route table:
+
+    POST   /api/v1/containers                  run container
+    GET    /api/v1/containers/{name}           info
+    DELETE /api/v1/containers/{name}           delete
+    POST   /api/v1/containers/{name}/execute   exec
+    PATCH  /api/v1/containers/{name}/tpu       patch chip count (alias: /gpu)
+    PATCH  /api/v1/containers/{name}/volume    patch bind
+    POST   /api/v1/containers/{name}/stop      stop
+    PATCH  /api/v1/containers/{name}/restart   restart
+    POST   /api/v1/containers/{name}/commit    commit to image
+    POST   /api/v1/volumes                     create volume
+    GET    /api/v1/volumes/{name}              info
+    DELETE /api/v1/volumes/{name}              delete
+    PATCH  /api/v1/volumes/{name}/size         resize
+    GET    /api/v1/resources/tpus              chip scheduler view (alias: /gpus)
+    GET    /api/v1/resources/ports             port scheduler view
+    GET    /healthz
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpu_docker_api import errors
+from tpu_docker_api.api import codes, response
+from tpu_docker_api.schemas.container import (
+    Bind,
+    ContainerCommit,
+    ContainerDelete,
+    ContainerExecute,
+    ContainerPatchChips,
+    ContainerPatchVolume,
+    ContainerRun,
+)
+from tpu_docker_api.schemas.volume import VolumeCreate, VolumeDelete, VolumeSize
+from tpu_docker_api.service.container import ContainerService
+from tpu_docker_api.service.volume import VolumeService
+
+log = logging.getLogger(__name__)
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9_.]+$")
+_VERSIONED_RE = re.compile(r"^[a-zA-Z0-9_.]+(-\d+)?$")
+
+
+def _validate_base_name(name: str) -> None:
+    """Create-time rule: nonempty, no '-' (reference api/container.go:66-70)."""
+    if not name or not _NAME_RE.match(name):
+        raise errors.BadRequest(
+            f"invalid base name {name!r}: must be nonempty without '-'"
+        )
+
+
+def _validate_ref_name(name: str) -> None:
+    if not name or not _VERSIONED_RE.match(name):
+        raise errors.BadRequest(f"invalid container/volume name {name!r}")
+
+
+class Router:
+    """Tiny method+pattern router; patterns use ``{name}`` segments."""
+
+    def __init__(self) -> None:
+        self._routes: list[tuple[str, re.Pattern, callable]] = []
+
+    def add(self, method: str, pattern: str, handler) -> None:
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
+        )
+        self._routes.append((method, regex, handler))
+
+    def dispatch(self, method: str, path: str, body: dict):
+        for m, regex, handler in self._routes:
+            if m != method:
+                continue
+            match = regex.match(path)
+            if match:
+                return handler(body=body, **match.groupdict())
+        raise errors.BadRequest(f"no route for {method} {path}")
+
+
+def build_router(container_svc: ContainerService, volume_svc: VolumeService,
+                 chip_scheduler, port_scheduler, work_queue=None) -> Router:
+    r = Router()
+
+    # -- containers (reference api/container.go:19-38) ---------------------------
+
+    def run(body, **_):
+        req = ContainerRun.from_dict(body)
+        if not req.image_name:
+            raise errors.BadRequest("imageName is required")
+        _validate_base_name(req.container_name)
+        if req.chip_count < 0:
+            raise errors.BadRequest("chipCount must be >= 0")
+        return container_svc.run_container(req)
+
+    def c_info(body, name):
+        _validate_ref_name(name)
+        return container_svc.get_container_info(name)
+
+    def c_delete(body, name):
+        _validate_ref_name(name)
+        container_svc.delete_container(name, ContainerDelete(
+            force=bool(body.get("force", True)),
+            del_etcd_info_and_version_record=bool(
+                body.get("delEtcdInfoAndVersionRecord", False)),
+        ))
+        return None
+
+    def c_exec(body, name):
+        _validate_ref_name(name)
+        cmd = body.get("cmd", [])
+        if not cmd:
+            raise errors.BadRequest("cmd is required")
+        out = container_svc.execute_container(
+            name, ContainerExecute(work_dir=body.get("workDir", ""), cmd=list(cmd))
+        )
+        return {"stdout": out}
+
+    def c_patch_chips(body, name):
+        _validate_ref_name(name)
+        if "chipCount" not in body and "gpuCount" not in body:
+            raise errors.BadRequest("chipCount is required")
+        want = int(body.get("chipCount", body.get("gpuCount", 0)))
+        return container_svc.patch_container_chips(
+            name, ContainerPatchChips(chip_count=want)
+        )
+
+    def c_patch_volume(body, name):
+        _validate_ref_name(name)
+        old, new = body.get("oldBind"), body.get("newBind")
+        if not old or not new:
+            raise errors.BadRequest("oldBind and newBind are required")
+        return container_svc.patch_container_volume(name, ContainerPatchVolume(
+            old_bind=Bind(old["src"], old["dest"]),
+            new_bind=Bind(new["src"], new["dest"]),
+        ))
+
+    def c_stop(body, name):
+        _validate_ref_name(name)
+        container_svc.stop_container(name)
+        return None
+
+    def c_restart(body, name):
+        _validate_ref_name(name)
+        return container_svc.restart_container(name)
+
+    def c_commit(body, name):
+        _validate_ref_name(name)
+        image_id = container_svc.commit_container(
+            name, ContainerCommit(new_image_name=body.get("newImageName", ""))
+        )
+        return {"imageId": image_id}
+
+    r.add("POST", "/api/v1/containers", run)
+    r.add("GET", "/api/v1/containers/{name}", c_info)
+    r.add("DELETE", "/api/v1/containers/{name}", c_delete)
+    r.add("POST", "/api/v1/containers/{name}/execute", c_exec)
+    r.add("PATCH", "/api/v1/containers/{name}/tpu", c_patch_chips)
+    r.add("PATCH", "/api/v1/containers/{name}/gpu", c_patch_chips)  # reference path
+    r.add("PATCH", "/api/v1/containers/{name}/volume", c_patch_volume)
+    r.add("POST", "/api/v1/containers/{name}/stop", c_stop)
+    r.add("PATCH", "/api/v1/containers/{name}/restart", c_restart)
+    r.add("POST", "/api/v1/containers/{name}/commit", c_commit)
+
+    # -- volumes (reference api/volume.go:19-28) ---------------------------------
+
+    def v_create(body, **_):
+        name = body.get("volumeName", "")
+        _validate_base_name(name)
+        return volume_svc.create_volume(
+            VolumeCreate(volume_name=name, size=body.get("size", ""))
+        )
+
+    def v_info(body, name):
+        _validate_ref_name(name)
+        return volume_svc.get_volume_info(name)
+
+    def v_delete(body, name):
+        _validate_ref_name(name)
+        volume_svc.delete_volume(name, VolumeDelete(
+            del_etcd_info_and_version_record=bool(
+                body.get("delEtcdInfoAndVersionRecord", False)),
+        ))
+        return None
+
+    def v_patch_size(body, name):
+        _validate_ref_name(name)
+        size = body.get("size", "")
+        if not size:
+            raise errors.BadRequest("size is required")
+        return volume_svc.patch_volume_size(name, VolumeSize(size=size))
+
+    r.add("POST", "/api/v1/volumes", v_create)
+    r.add("GET", "/api/v1/volumes/{name}", v_info)
+    r.add("DELETE", "/api/v1/volumes/{name}", v_delete)
+    r.add("PATCH", "/api/v1/volumes/{name}/size", v_patch_size)
+
+    # -- resource views (reference api/resource.go:12-29) ------------------------
+
+    r.add("GET", "/api/v1/resources/tpus", lambda body, **_: chip_scheduler.status())
+    r.add("GET", "/api/v1/resources/gpus", lambda body, **_: chip_scheduler.status())
+    r.add("GET", "/api/v1/resources/ports", lambda body, **_: port_scheduler.status())
+    r.add("GET", "/healthz", lambda body, **_: {"status": "ok"})
+    if work_queue is not None:
+        # failed async tasks must be observable (fix for the reference's
+        # silent infinite-retry loop, workQueue.go:33-47)
+        r.add("GET", "/api/v1/debug/deadletters",
+              lambda body, **_: work_queue.dead_letter_view())
+    return r
+
+
+def build_handler(router: Router):
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "tpu-docker-api"
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging, not stderr
+            log.debug("http: " + fmt, *args)
+
+        def _handle(self, method: str) -> None:
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(length) if length else b""
+                body = json.loads(raw) if raw else {}
+                if not isinstance(body, dict):
+                    raise errors.BadRequest("body must be a JSON object")
+                data = router.dispatch(method, self.path.split("?")[0], body)
+                payload = response.success(data)
+            except errors.ApiError as e:
+                payload = response.error(e.code, str(e))
+            except json.JSONDecodeError as e:
+                payload = response.error(codes.BAD_REQUEST, f"invalid JSON: {e}")
+            except Exception as e:  # noqa: BLE001 — envelope every failure
+                log.exception("unhandled error on %s %s", method, self.path)
+                payload = response.error(codes.SERVER_ERROR, str(e))
+            # reference: always HTTP 200, app code in envelope (response.go:15-29)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):  # noqa: N802
+            self._handle("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._handle("POST")
+
+        def do_DELETE(self):  # noqa: N802
+            self._handle("DELETE")
+
+        def do_PATCH(self):  # noqa: N802
+            self._handle("PATCH")
+
+    return Handler
+
+
+class ApiServer:
+    """Serving wrapper: bind, serve in a thread, close (reference
+    program.Start's gin goroutine, main.go:95-110)."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._httpd = ThreadingHTTPServer((host, port), build_handler(router))
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-serve", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join()
+            self._thread = None

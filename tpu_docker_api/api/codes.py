@@ -1,0 +1,34 @@
+"""Response codes.
+
+Parity: reference ``internal/api/code.go`` — app-level codes carried in a
+uniform envelope with HTTP 200 (their messages are Chinese; ours English).
+Codes live on the exception classes in ``tpu_docker_api.errors``; this module
+adds the non-error codes and the fallback messages.
+"""
+
+from __future__ import annotations
+
+SUCCESS = 200
+SERVER_ERROR = 500
+BAD_REQUEST = 10001
+
+MESSAGES: dict[int, str] = {
+    SUCCESS: "success",
+    SERVER_ERROR: "internal server error",
+    BAD_REQUEST: "bad request",
+    10201: "no patch required",
+    10202: "version does not match the latest",
+    10301: "container already exists",
+    10302: "container does not exist",
+    10401: "volume already exists",
+    10402: "volume does not exist",
+    10403: "bytes in use exceed the requested size",
+    10501: "not found in state store",
+    10601: "not enough free TPU chips",
+    10602: "not enough free host ports",
+    10603: "unknown TPU topology",
+}
+
+
+def message(code: int) -> str:
+    return MESSAGES.get(code, "unknown error")

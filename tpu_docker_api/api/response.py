@@ -1,0 +1,22 @@
+"""Uniform JSON envelope ``{code, msg, data}`` (parity: reference
+``internal/api/response.go:9-29`` — always HTTP 200; the app code carries the
+outcome)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from tpu_docker_api.api import codes
+
+
+def success(data: Any = None) -> bytes:
+    return json.dumps(
+        {"code": codes.SUCCESS, "msg": codes.message(codes.SUCCESS), "data": data}
+    ).encode()
+
+
+def error(code: int, msg: str = "") -> bytes:
+    return json.dumps(
+        {"code": code, "msg": msg or codes.message(code), "data": None}
+    ).encode()

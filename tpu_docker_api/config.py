@@ -1,0 +1,57 @@
+"""Process configuration.
+
+Parity: the reference's 6-field TOML config (``internal/config/config.go:9-24``,
+defaults in ``etc/config.toml``). Fields here are the TPU-shaped equivalents:
+the GPU count becomes a TPU topology description (accelerator type + per-host
+chip count), ``detect_gpu_addr`` becomes the telemetry sidecar address, and the
+state store grows a backend selector so tests run hermetically without etcd.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+
+
+@dataclasses.dataclass
+class Config:
+    # HTTP serve address, reference `Port` (config.go:10)
+    port: int = 2378
+    # state store: "memory" | "sqlite" | "etcd"
+    store_backend: str = "memory"
+    # etcd grpc-gateway address (used when store_backend == "etcd"),
+    # reference `EtcdAddr` (config.go:11)
+    etcd_addr: str = "http://localhost:2379"
+    # sqlite database path (used when store_backend == "sqlite")
+    sqlite_path: str = "/var/lib/tpu-docker-api/state.db"
+    # telemetry sidecar address, reference `DetectGPUAddr` (config.go:12);
+    # empty ⇒ local probe via tpu_docker_api.telemetry
+    detect_tpu_addr: str = ""
+    # accelerator type of this host's slice, e.g. "v5e-8", "v5p-8";
+    # replaces the reference's bare `AvailableGpuNums` (config.go:13)
+    accelerator_type: str = "v5e-8"
+    # host port pool, reference `StartPort`/`EndPort` (config.go:14-15)
+    start_port: int = 40000
+    end_port: int = 65535
+    # container runtime: "docker" | "fake"
+    runtime_backend: str = "docker"
+    # docker engine socket (runtime_backend == "docker")
+    docker_host: str = "unix:///var/run/docker.sock"
+    # path to libtpu.so to bind-mount into TPU containers ("" ⇒ image's own)
+    libtpu_path: str = ""
+
+
+def load(path: str | None = None) -> Config:
+    """Load TOML config from ``path``; missing file or None ⇒ all defaults.
+
+    Reference: ``NewConfigWithFile`` (config.go:18-24) errors on a missing
+    file; we default instead so the hermetic test path needs no fixture file.
+    """
+    cfg = Config()
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for field in dataclasses.fields(Config):
+            if field.name in data:
+                setattr(cfg, field.name, data[field.name])
+    return cfg

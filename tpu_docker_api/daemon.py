@@ -1,0 +1,140 @@
+"""Process bootstrap / lifecycle.
+
+Parity: reference ``cmd/gpu-docker-api/main.go`` — the go-svc ``Init/Start/
+Stop`` triple. Init wires config → runtime → store → workQueue → schedulers →
+versions in the same order (main.go:50-86); Start launches the HTTP server and
+the work-queue sync loop (main.go:88-115); Stop drains and closes every
+subsystem (main.go:117-130). Unlike the reference, scheduler/version state is
+already durably persisted on every mutation, so Stop is not load-bearing for
+correctness.
+
+CLI: ``python -m tpu_docker_api -c etc/config.toml``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api.api.app import ApiServer, build_router
+from tpu_docker_api.runtime import open_runtime
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.scheduler.topology import HostTopology
+from tpu_docker_api.service.container import ContainerService
+from tpu_docker_api.service.volume import VolumeService
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import open_store
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import WorkQueue
+
+log = logging.getLogger(__name__)
+
+
+class Program:
+    def __init__(self, cfg: config_mod.Config, host: str = "0.0.0.0") -> None:
+        self.cfg = cfg
+        self.host = host
+        self.api_server: ApiServer | None = None
+
+    def init(self) -> None:
+        cfg = self.cfg
+        self.kv = open_store(
+            cfg.store_backend, etcd_addr=cfg.etcd_addr, sqlite_path=cfg.sqlite_path
+        )
+        self.store = StateStore(self.kv)
+        self.runtime = (
+            open_runtime("docker", docker_host=cfg.docker_host)
+            if cfg.runtime_backend == "docker"
+            else open_runtime("fake", allow_exec=True)
+        )
+        self.wq = WorkQueue(self.kv)
+        topology = self._discover_topology()
+        self.chip_scheduler = ChipScheduler(topology, self.kv)
+        self.port_scheduler = PortScheduler(
+            self.kv, cfg.start_port, cfg.end_port
+        )
+        self.container_versions = VersionMap(self.kv, keys.VERSIONS_CONTAINER_KEY)
+        self.volume_versions = VersionMap(self.kv, keys.VERSIONS_VOLUME_KEY)
+        self.container_svc = ContainerService(
+            self.runtime, self.store, self.chip_scheduler, self.port_scheduler,
+            self.container_versions, self.wq, libtpu_path=cfg.libtpu_path,
+        )
+        self.volume_svc = VolumeService(
+            self.runtime, self.store, self.volume_versions, self.wq
+        )
+
+    def _discover_topology(self) -> HostTopology:
+        """Topology from the telemetry sidecar if configured (the reference's
+        first-boot detect-gpu fetch, gpuscheduler/scheduler.go:142-158), else
+        from local probe, else synthesized from config accelerator_type."""
+        cfg = self.cfg
+        if cfg.detect_tpu_addr:
+            import requests
+
+            resp = requests.get(
+                cfg.detect_tpu_addr.rstrip("/") + "/api/v1/detect/tpu", timeout=5
+            )
+            resp.raise_for_status()
+            from tpu_docker_api.schemas.tpu import HostTopologyInfo
+            from tpu_docker_api.telemetry.probe import topology_from_info
+
+            return topology_from_info(HostTopologyInfo.from_dict(resp.json()["data"]))
+        from tpu_docker_api.telemetry.probe import probe_local_topology
+
+        local = probe_local_topology()
+        if local is not None:
+            log.info("using locally probed topology: %d chips", local.n_chips)
+            return local
+        log.info("no TPU hardware detected; topology from config %s",
+                 cfg.accelerator_type)
+        return HostTopology.build(cfg.accelerator_type)
+
+    def start(self) -> None:
+        self.wq.start()
+        router = build_router(
+            self.container_svc, self.volume_svc,
+            self.chip_scheduler, self.port_scheduler, work_queue=self.wq,
+        )
+        self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
+        self.api_server.start()
+        log.info("tpu-docker-api serving on %s:%d (%d chips, ports %d-%d)",
+                 self.host, self.api_server.port,
+                 self.chip_scheduler.topology.n_chips,
+                 self.cfg.start_port, self.cfg.end_port)
+
+    def stop(self) -> None:
+        if self.api_server:
+            self.api_server.close()
+        self.wq.close()
+        self.runtime.close()
+        self.kv.close()
+        log.info("tpu-docker-api stopped")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="tpu-docker-api")
+    parser.add_argument("-c", "--config", default=None, help="TOML config path")
+    parser.add_argument("--host", default="0.0.0.0")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    prg = Program(config_mod.load(args.config), host=args.host)
+    prg.init()
+    prg.start()
+
+    done = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    prg.stop()
+
+
+if __name__ == "__main__":
+    main()

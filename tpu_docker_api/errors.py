@@ -1,0 +1,90 @@
+"""Error taxonomy for the control plane.
+
+Parity: the reference's string-sentinel errors + ``Is*`` predicates
+(``internal/xerrors/{common,container,volume,etcd,scheduler}.go``). Here each
+sentinel is a distinct exception class so callers use ``except``/``isinstance``
+instead of string matching, and every class carries the API error code it maps
+to (``tpu_docker_api.api.codes``) so the HTTP layer needs no lookup table.
+"""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    """Base class: every control-plane error maps to one API response code."""
+
+    #: numeric code from tpu_docker_api.api.codes (filled per subclass)
+    code: int = 500
+
+    def __init__(self, msg: str = ""):
+        super().__init__(msg or self.__class__.__doc__ or self.__class__.__name__)
+
+
+# --- common (xerrors/common.go:7-10) ------------------------------------------
+
+class NoPatchRequired(ApiError):
+    """The patch requests the state the resource is already in."""
+    code = 10201
+
+
+class VersionNotMatch(ApiError):
+    """Optimistic-concurrency failure: request names version N but latest is M."""
+    code = 10202
+
+
+class BadRequest(ApiError):
+    """Request validation failure (missing field, malformed name, bad unit)."""
+    code = 10001
+
+
+# --- container (xerrors/container.go:7) ---------------------------------------
+
+class ContainerExisted(ApiError):
+    """A container family with this base name already exists."""
+    code = 10301
+
+
+class ContainerNotExist(ApiError):
+    """No such container (neither running nor in the state store)."""
+    code = 10302
+
+
+# --- volume (xerrors/volume.go:8-10) ------------------------------------------
+
+class VolumeExisted(ApiError):
+    """A volume family with this base name already exists."""
+    code = 10401
+
+
+class VolumeNotExist(ApiError):
+    """No such volume."""
+    code = 10402
+
+
+class VolumeSizeUsedGreaterThanReduced(ApiError):
+    """Shrink guard: bytes in use exceed the requested new size."""
+    code = 10403
+
+
+# --- state store (xerrors/etcd.go:8) ------------------------------------------
+
+class NotExistInStore(ApiError):
+    """Key not found in the state store."""
+    code = 10501
+
+
+# --- schedulers (xerrors/scheduler.go:8-10) -----------------------------------
+
+class ChipNotEnough(ApiError):
+    """Not enough free TPU chips (or no ICI-contiguous block) to satisfy the ask."""
+    code = 10601
+
+
+class PortNotEnough(ApiError):
+    """Host-port pool exhausted."""
+    code = 10602
+
+
+class TopologyUnknown(ApiError):
+    """The requested slice shape/type is not a known TPU topology."""
+    code = 10603

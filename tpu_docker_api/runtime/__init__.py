@@ -1,0 +1,29 @@
+"""Container runtime adapter layer (parity: reference L5 — ``internal/docker/``).
+
+The reference exposes a raw global docker SDK client with no seam
+(docker/client.go:7-14), which is why it has zero tests (SURVEY.md §4). Here
+the docker surface the service layer actually uses (enumerated from the call
+stacks in SURVEY.md §3) is an abstract ``ContainerRuntime`` with two
+implementations: ``DockerRuntime`` (Engine REST API over the unix socket, no
+SDK dependency) and ``FakeRuntime`` (in-memory, real tmp dirs, optional real
+exec) for hermetic tests.
+"""
+
+from tpu_docker_api.runtime.base import (  # noqa: F401
+    ContainerInfo,
+    ContainerRuntime,
+    ExecResult,
+    VolumeInfo,
+)
+from tpu_docker_api.runtime.fake import FakeRuntime  # noqa: F401
+from tpu_docker_api.runtime.spec import ContainerSpec, PortBinding, render_tpu_attachment  # noqa: F401
+
+
+def open_runtime(backend: str, **kwargs):
+    if backend == "fake":
+        return FakeRuntime(**kwargs)
+    if backend == "docker":
+        from tpu_docker_api.runtime.docker_http import DockerRuntime
+
+        return DockerRuntime(**kwargs)
+    raise ValueError(f"unknown runtime backend {backend!r}")

@@ -1,0 +1,293 @@
+"""Docker Engine runtime over the unix-socket REST API.
+
+The reference drives dockerd through the Go SDK (docker/client.go:11-14). We
+speak the Engine HTTP API directly (stdlib http.client over the unix socket)
+— no docker-py dependency — implementing exactly the endpoints the service
+layer needs. TPU device attachment is plain ``HostConfig.Devices`` entries
+(no runtime hook, unlike nvidia's DeviceRequests — SURVEY.md §2.2 row 2).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import struct
+import urllib.parse
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.base import (
+    ContainerInfo,
+    ContainerRuntime,
+    ExecResult,
+    VolumeInfo,
+)
+from tpu_docker_api.runtime.spec import ContainerSpec, DeviceMount, PortBinding
+
+API_VERSION = "v1.41"  # negotiated floor; reference SDK pins v24 ~ API 1.43
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 60.0) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._socket_path)
+        self.sock = sock
+
+
+class DockerRuntime(ContainerRuntime):
+    def __init__(self, docker_host: str = "unix:///var/run/docker.sock") -> None:
+        if not docker_host.startswith("unix://"):
+            raise ValueError(f"only unix:// docker hosts supported, got {docker_host}")
+        self._socket_path = docker_host[len("unix://"):]
+        self.ping()
+
+    # -- transport ---------------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        params: dict | None = None,
+        body: dict | None = None,
+        timeout: float = 60.0,
+    ) -> tuple[int, bytes]:
+        qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        conn = _UnixHTTPConnection(self._socket_path, timeout=timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, f"/{API_VERSION}{path}{qs}", body=payload,
+                         headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data
+        finally:
+            conn.close()
+
+    def _json(self, method: str, path: str, params: dict | None = None,
+              body: dict | None = None, ok: tuple[int, ...] = (200, 201, 204)):
+        status, data = self._request(method, path, params, body)
+        if status == 404:
+            raise _NotFound(data.decode(errors="replace"))
+        if status not in ok:
+            raise errors.ApiError(
+                f"docker {method} {path} -> {status}: {data.decode(errors='replace')}"
+            )
+        return json.loads(data) if data else None
+
+    def ping(self) -> None:
+        status, _ = self._request("GET", "/_ping", timeout=5.0)
+        if status != 200:
+            raise errors.ApiError(f"docker ping failed: {status}")
+
+    # -- containers --------------------------------------------------------------
+
+    def container_create(self, spec: ContainerSpec) -> str:
+        exposed = {f"{p.container_port}/{p.protocol}": {} for p in spec.port_bindings}
+        port_bindings = {
+            f"{p.container_port}/{p.protocol}": [{"HostPort": str(p.host_port)}]
+            for p in spec.port_bindings
+        }
+        body = {
+            "Image": spec.image,
+            "Cmd": spec.cmd or None,
+            "Env": spec.env,
+            "OpenStdin": spec.open_stdin,
+            "Tty": spec.tty,
+            "ExposedPorts": exposed,
+            "Labels": {
+                "tpu-docker-api.chips": ",".join(map(str, spec.chip_ids)),
+                "tpu-docker-api.ici": "1" if spec.ici_contiguous else "0",
+            },
+            "HostConfig": {
+                "Binds": spec.binds,
+                "PortBindings": port_bindings,
+                "Privileged": spec.privileged,
+                "Devices": [
+                    {
+                        "PathOnHost": d.host_path,
+                        "PathInContainer": d.container_path,
+                        "CgroupPermissions": d.permissions,
+                    }
+                    for d in spec.devices
+                ],
+            },
+        }
+        try:
+            resp = self._json("POST", "/containers/create",
+                              params={"name": spec.name}, body=body)
+        except _NotFound as e:
+            raise errors.ApiError(f"image {spec.image} not found: {e}") from e
+        return resp["Id"]
+
+    def container_start(self, name: str) -> None:
+        self._container_op(name, "start")
+
+    def container_stop(self, name: str, timeout_s: int = 10) -> None:
+        self._container_op(name, "stop", params={"t": timeout_s})
+
+    def container_restart(self, name: str) -> None:
+        self._container_op(name, "restart")
+
+    def _container_op(self, name: str, op: str, params: dict | None = None) -> None:
+        try:
+            # 304 = already in desired state
+            status, data = self._request("POST", f"/containers/{name}/{op}", params)
+            if status == 404:
+                raise errors.ContainerNotExist(name)
+            if status not in (204, 304):
+                raise errors.ApiError(
+                    f"docker {op} {name} -> {status}: {data.decode(errors='replace')}"
+                )
+        except _NotFound:
+            raise errors.ContainerNotExist(name) from None
+
+    def container_remove(self, name: str, force: bool = False) -> None:
+        try:
+            self._json("DELETE", f"/containers/{name}",
+                       params={"force": "true" if force else "false"})
+        except _NotFound:
+            raise errors.ContainerNotExist(name) from None
+
+    def container_inspect(self, name: str) -> ContainerInfo:
+        try:
+            raw = self._json("GET", f"/containers/{name}/json")
+        except _NotFound:
+            raise errors.ContainerNotExist(name) from None
+        return self._to_info(raw)
+
+    def _to_info(self, raw: dict) -> ContainerInfo:
+        cfg, host = raw.get("Config", {}), raw.get("HostConfig", {})
+        ports = []
+        for key, binds in (host.get("PortBindings") or {}).items():
+            cport, _, proto = key.partition("/")
+            for b in binds or []:
+                ports.append(PortBinding(int(cport), int(b.get("HostPort") or 0), proto))
+        chips_label = (cfg.get("Labels") or {}).get("tpu-docker-api.chips", "")
+        spec = ContainerSpec(
+            name=raw["Name"].lstrip("/"),
+            image=cfg.get("Image", ""),
+            cmd=cfg.get("Cmd") or [],
+            env=cfg.get("Env") or [],
+            binds=host.get("Binds") or [],
+            port_bindings=ports,
+            devices=[
+                DeviceMount(d["PathOnHost"], d["PathInContainer"],
+                            d.get("CgroupPermissions", "rwm"))
+                for d in host.get("Devices") or []
+            ],
+            chip_ids=[int(c) for c in chips_label.split(",") if c],
+            ici_contiguous=(cfg.get("Labels") or {}).get("tpu-docker-api.ici", "1") == "1",
+            open_stdin=bool(cfg.get("OpenStdin")),
+            tty=bool(cfg.get("Tty")),
+            privileged=bool(host.get("Privileged")),
+        )
+        state = raw.get("State", {})
+        # overlay2 MergedDir, the copy-task source/target (workQueue/copy.go:16)
+        merged = (raw.get("GraphDriver", {}).get("Data") or {}).get("MergedDir", "")
+        return ContainerInfo(
+            name=spec.name,
+            id=raw.get("Id", ""),
+            running=bool(state.get("Running")),
+            spec=spec,
+            data_dir=merged,
+            pid=int(state.get("Pid") or 0),
+            exit_code=int(state.get("ExitCode") or 0),
+        )
+
+    def container_exists(self, name: str) -> bool:
+        try:
+            self.container_inspect(name)
+            return True
+        except errors.ContainerNotExist:
+            return False
+
+    def container_list(self) -> list[str]:
+        raw = self._json("GET", "/containers/json", params={"all": "true"})
+        names = []
+        for c in raw:
+            names.extend(n.lstrip("/") for n in c.get("Names", []))
+        return sorted(names)
+
+    def container_exec(self, name: str, cmd: list[str], workdir: str = "") -> ExecResult:
+        body = {
+            "AttachStdout": True,
+            "AttachStderr": True,
+            "Cmd": cmd,
+        }
+        if workdir:
+            body["WorkingDir"] = workdir
+        try:
+            exec_id = self._json("POST", f"/containers/{name}/exec", body=body)["Id"]
+        except _NotFound:
+            raise errors.ContainerNotExist(name) from None
+        status, data = self._request(
+            "POST", f"/exec/{exec_id}/start",
+            body={"Detach": False, "Tty": False}, timeout=600.0,
+        )
+        if status != 200:
+            raise errors.ApiError(f"exec start -> {status}")
+        output = _demux_docker_stream(data)
+        inspect = self._json("GET", f"/exec/{exec_id}/json")
+        return ExecResult(exit_code=int(inspect.get("ExitCode") or 0), output=output)
+
+    def container_commit(self, name: str, image_ref: str) -> str:
+        repo, _, tag = image_ref.partition(":")
+        resp = self._json(
+            "POST", "/commit",
+            params={"container": name, "repo": repo, "tag": tag or "latest"},
+        )
+        return resp["Id"]
+
+    # -- volumes -----------------------------------------------------------------
+
+    def volume_create(self, name: str, driver_opts: dict[str, str]) -> VolumeInfo:
+        body = {"Name": name, "Driver": "local", "DriverOpts": driver_opts}
+        raw = self._json("POST", "/volumes/create", body=body)
+        return VolumeInfo(name=raw["Name"], mountpoint=raw.get("Mountpoint", ""),
+                          driver_opts=raw.get("Options") or {})
+
+    def volume_remove(self, name: str, force: bool = False) -> None:
+        try:
+            self._json("DELETE", f"/volumes/{name}",
+                       params={"force": "true" if force else "false"})
+        except _NotFound:
+            raise errors.VolumeNotExist(name) from None
+
+    def volume_inspect(self, name: str) -> VolumeInfo:
+        try:
+            raw = self._json("GET", f"/volumes/{name}")
+        except _NotFound:
+            raise errors.VolumeNotExist(name) from None
+        return VolumeInfo(name=raw["Name"], mountpoint=raw.get("Mountpoint", ""),
+                          driver_opts=raw.get("Options") or {})
+
+    def volume_exists(self, name: str) -> bool:
+        try:
+            self.volume_inspect(name)
+            return True
+        except errors.VolumeNotExist:
+            return False
+
+
+class _NotFound(Exception):
+    pass
+
+
+def _demux_docker_stream(data: bytes) -> str:
+    """Demultiplex docker's 8-byte-header stdout/stderr stream (the Go side
+    uses stdcopy.StdCopy, service/container.go:169-172)."""
+    out = []
+    i = 0
+    while i + 8 <= len(data):
+        _stream, _, _, size = struct.unpack(">BxxxL", data[i:i + 8])
+        out.append(data[i + 8:i + 8 + size])
+        i += 8 + size
+    if not out:  # tty mode: raw stream, no headers
+        return data.decode(errors="replace")
+    return b"".join(out).decode(errors="replace")

@@ -1,0 +1,171 @@
+"""In-memory fake container engine.
+
+The hermetic seam SURVEY.md §4 prescribes: containers and volumes are dicts,
+but their data directories are REAL directories under a tmp root, so the
+rolling-replacement copy flows (workQueue CopyTask) exercise actual file IO.
+With ``allow_exec=True``, ``container_exec`` runs the command as a host
+subprocess inside the container's data dir — enough to run the JAX-CPU matmul
+smoke test of BASELINE.json config #1 without a docker daemon.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+import threading
+import uuid
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.base import (
+    ContainerInfo,
+    ContainerRuntime,
+    ExecResult,
+    VolumeInfo,
+)
+from tpu_docker_api.runtime.spec import ContainerSpec
+
+
+class FakeRuntime(ContainerRuntime):
+    def __init__(self, root: str | None = None, allow_exec: bool = False) -> None:
+        self._root = root or tempfile.mkdtemp(prefix="tpu-docker-api-fake-")
+        self._owns_root = root is None
+        self._allow_exec = allow_exec
+        self._mu = threading.RLock()
+        self._containers: dict[str, ContainerInfo] = {}
+        self._volumes: dict[str, VolumeInfo] = {}
+        self._images: dict[str, str] = {}  # image_ref → id
+        #: ordered log of engine calls, for flow assertions in tests
+        self.calls: list[tuple[str, str]] = []
+
+    # -- containers --------------------------------------------------------------
+
+    def container_create(self, spec: ContainerSpec) -> str:
+        with self._mu:
+            if spec.name in self._containers:
+                raise errors.ContainerExisted(spec.name)
+            data_dir = os.path.join(self._root, "containers", spec.name, "merged")
+            os.makedirs(data_dir, exist_ok=True)
+            cid = uuid.uuid4().hex[:12]
+            self._containers[spec.name] = ContainerInfo(
+                name=spec.name, id=cid, running=False, spec=spec, data_dir=data_dir
+            )
+            self.calls.append(("create", spec.name))
+            return cid
+
+    def _get(self, name: str) -> ContainerInfo:
+        info = self._containers.get(name)
+        if info is None:
+            raise errors.ContainerNotExist(name)
+        return info
+
+    def container_start(self, name: str) -> None:
+        with self._mu:
+            info = self._get(name)
+            info.running = True
+            info.pid = os.getpid()
+            self.calls.append(("start", name))
+
+    def container_stop(self, name: str, timeout_s: int = 10) -> None:
+        with self._mu:
+            info = self._get(name)
+            info.running = False
+            info.pid = 0
+            self.calls.append(("stop", name))
+
+    def container_restart(self, name: str) -> None:
+        with self._mu:
+            info = self._get(name)
+            info.running = True
+            self.calls.append(("restart", name))
+
+    def container_remove(self, name: str, force: bool = False) -> None:
+        with self._mu:
+            info = self._get(name)
+            if info.running and not force:
+                raise errors.ApiError(f"container {name} is running; use force")
+            shutil.rmtree(os.path.dirname(info.data_dir), ignore_errors=True)
+            del self._containers[name]
+            self.calls.append(("remove", name))
+
+    def container_inspect(self, name: str) -> ContainerInfo:
+        with self._mu:
+            return self._get(name)
+
+    def container_exists(self, name: str) -> bool:
+        with self._mu:
+            return name in self._containers
+
+    def container_list(self) -> list[str]:
+        with self._mu:
+            return sorted(self._containers)
+
+    def container_exec(self, name: str, cmd: list[str], workdir: str = "") -> ExecResult:
+        with self._mu:
+            info = self._get(name)
+            if not info.running:
+                raise errors.ApiError(f"container {name} is not running")
+            env = dict(os.environ)
+            for e in info.spec.env:
+                k, _, v = e.partition("=")
+                env[k] = v
+        self.calls.append(("exec", name))
+        if not self._allow_exec:
+            return ExecResult(exit_code=0, output=f"[fake exec] {' '.join(cmd)}")
+        proc = subprocess.run(
+            cmd,
+            cwd=workdir or info.data_dir,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        return ExecResult(
+            exit_code=proc.returncode, output=proc.stdout + proc.stderr
+        )
+
+    def container_commit(self, name: str, image_ref: str) -> str:
+        with self._mu:
+            self._get(name)
+            img_id = "sha256:" + uuid.uuid4().hex
+            self._images[image_ref] = img_id
+            self.calls.append(("commit", name))
+            return img_id
+
+    # -- volumes -----------------------------------------------------------------
+
+    def volume_create(self, name: str, driver_opts: dict[str, str]) -> VolumeInfo:
+        with self._mu:
+            if name in self._volumes:
+                raise errors.VolumeExisted(name)
+            mountpoint = os.path.join(self._root, "volumes", name, "_data")
+            os.makedirs(mountpoint, exist_ok=True)
+            info = VolumeInfo(name=name, mountpoint=mountpoint, driver_opts=dict(driver_opts))
+            self._volumes[name] = info
+            self.calls.append(("volume_create", name))
+            return info
+
+    def volume_remove(self, name: str, force: bool = False) -> None:
+        with self._mu:
+            if name not in self._volumes:
+                raise errors.VolumeNotExist(name)
+            shutil.rmtree(os.path.dirname(self._volumes[name].mountpoint),
+                          ignore_errors=True)
+            del self._volumes[name]
+            self.calls.append(("volume_remove", name))
+
+    def volume_inspect(self, name: str) -> VolumeInfo:
+        with self._mu:
+            info = self._volumes.get(name)
+            if info is None:
+                raise errors.VolumeNotExist(name)
+            return info
+
+    def volume_exists(self, name: str) -> bool:
+        with self._mu:
+            return name in self._volumes
+
+    def close(self) -> None:
+        if self._owns_root:
+            shutil.rmtree(self._root, ignore_errors=True)

@@ -1,0 +1,151 @@
+"""Runtime-neutral container spec + TPU attachment rendering.
+
+This is the TPU replacement for the reference's nvidia plumbing: where
+``newContainerResource`` renders ``DeviceRequests{Driver:"nvidia",
+DeviceIDs:[UUIDs], Capabilities:[["gpu"]]}`` for the NVIDIA container runtime
+(service/container.go:581-588), TPU containers need no runtime hook at all —
+just ``/dev/accel*`` device nodes, the libtpu shared object, and the chip
+topology env libtpu reads (SURVEY.md §2.2 row 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from tpu_docker_api.scheduler.topology import HostTopology
+
+
+@dataclasses.dataclass
+class PortBinding:
+    container_port: int
+    host_port: int
+    protocol: str = "tcp"
+
+
+@dataclasses.dataclass
+class DeviceMount:
+    host_path: str
+    container_path: str
+    permissions: str = "rwm"
+
+
+@dataclasses.dataclass
+class ContainerSpec:
+    """Everything needed to (re)create a container — the persisted payload
+    that makes rolling replacement possible (model/etcd.go EtcdContainerInfo
+    analog; stored via schemas.state.ContainerState)."""
+
+    name: str
+    image: str
+    cmd: list[str] = dataclasses.field(default_factory=list)
+    env: list[str] = dataclasses.field(default_factory=list)
+    binds: list[str] = dataclasses.field(default_factory=list)  # "src:dest"
+    port_bindings: list[PortBinding] = dataclasses.field(default_factory=list)
+    devices: list[DeviceMount] = dataclasses.field(default_factory=list)
+    chip_ids: list[int] = dataclasses.field(default_factory=list)
+    ici_contiguous: bool = True
+    open_stdin: bool = True   # reference sets OpenStdin/Tty so idle containers stay up
+    tty: bool = True          # (service/container.go:51-57)
+    privileged: bool = False
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ContainerSpec":
+        return ContainerSpec(
+            name=d["name"],
+            image=d["image"],
+            cmd=list(d.get("cmd", [])),
+            env=list(d.get("env", [])),
+            binds=list(d.get("binds", [])),
+            port_bindings=[PortBinding(**p) for p in d.get("port_bindings", [])],
+            devices=[DeviceMount(**m) for m in d.get("devices", [])],
+            chip_ids=list(d.get("chip_ids", [])),
+            ici_contiguous=bool(d.get("ici_contiguous", True)),
+            open_stdin=bool(d.get("open_stdin", True)),
+            tty=bool(d.get("tty", True)),
+            privileged=bool(d.get("privileged", False)),
+        )
+
+
+#: env vars we manage; stripped before re-rendering so patches don't stack
+_TPU_ENV_PREFIXES = (
+    "TPU_VISIBLE_CHIPS=",
+    "TPU_CHIPS_PER_PROCESS_BOUNDS=",
+    "TPU_PROCESS_BOUNDS=",
+    "TPU_PROCESS_PORT=",
+    "TPU_PROCESS_ADDRESSES=",
+    "CLOUD_TPU_TASK_ID=",
+    "TPU_LIBRARY_PATH=",
+)
+
+
+def render_tpu_attachment(
+    spec: ContainerSpec,
+    chip_ids: list[int],
+    topology: HostTopology,
+    ici_contiguous: bool = True,
+    libtpu_path: str = "",
+    process_bounds: str = "1,1,1",
+    task_id: int = 0,
+    process_addresses: list[str] | None = None,
+    process_port: int = 8476,
+) -> ContainerSpec:
+    """Mutate ``spec`` in place to attach ``chip_ids`` and return it.
+
+    Renders, per chip, a ``/dev/accel<N>`` device mount, plus the libtpu
+    visibility/topology env (the documented vars for running a JAX process on
+    a subset of a host's chips):
+
+    - ``TPU_VISIBLE_CHIPS`` — which host chips this container may open;
+    - ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — the sub-mesh shape of those chips,
+      derived from their scheduler coordinates;
+    - ``TPU_PROCESS_BOUNDS`` / ``TPU_PROCESS_ADDRESSES`` / ``CLOUD_TPU_TASK_ID``
+      — multi-process layout for multi-container or multi-host slices
+      (rendered by the workload layer for distributed jobs).
+
+    Chip count 0 clears every TPU artifact — the "cardless" container
+    (service/container.go RunGpuContainer with gpuCount 0).
+    """
+    spec.devices = [d for d in spec.devices if not d.host_path.startswith("/dev/accel")]
+    spec.env = [e for e in spec.env if not e.startswith(_TPU_ENV_PREFIXES)]
+    spec.chip_ids = sorted(chip_ids)
+    spec.ici_contiguous = ici_contiguous
+    if not chip_ids:
+        return spec
+
+    for cid in spec.chip_ids:
+        spec.devices.append(DeviceMount(f"/dev/accel{cid}", f"/dev/accel{cid}"))
+
+    # local index remap: inside the container libtpu sees chips 0..n-1
+    spec.env.append("TPU_VISIBLE_CHIPS=" + ",".join(str(c) for c in spec.chip_ids))
+    spec.env.append(
+        "TPU_CHIPS_PER_PROCESS_BOUNDS=" + _bounds_of(spec.chip_ids, topology)
+    )
+    spec.env.append(f"TPU_PROCESS_BOUNDS={process_bounds}")
+    spec.env.append(f"CLOUD_TPU_TASK_ID={task_id}")
+    spec.env.append(f"TPU_PROCESS_PORT={process_port}")
+    if process_addresses:
+        spec.env.append("TPU_PROCESS_ADDRESSES=" + ",".join(process_addresses))
+    if libtpu_path:
+        spec.binds.append(f"{libtpu_path}:/lib/libtpu.so:ro")
+        spec.env.append("TPU_LIBRARY_PATH=/lib/libtpu.so")
+    return spec
+
+
+def _bounds_of(chip_ids: list[int], topology: HostTopology) -> str:
+    """Bounding-box shape "x,y,z" of the chips' mesh coordinates."""
+    coords = [topology.coords[c] for c in chip_ids if c in topology.coords]
+    if not coords:
+        return f"{len(chip_ids)},1,1"
+    spans = []
+    for d in range(3):
+        vals = [c[d] for c in coords]
+        spans.append(max(vals) - min(vals) + 1)
+    # a scattered pick may not fill its bounding box; fall back to a line,
+    # which libtpu accepts for any chip count
+    if spans[0] * spans[1] * spans[2] != len(coords):
+        return f"{len(coords)},1,1"
+    return f"{spans[0]},{spans[1]},{spans[2]}"

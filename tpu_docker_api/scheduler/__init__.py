@@ -1,0 +1,18 @@
+"""Resource schedulers (parity: reference L3 — ``internal/scheduler/``).
+
+The port scheduler is a near-direct functional port. The chip scheduler is the
+core TPU upgrade (SURVEY.md §2.3 last row): where the reference hands out
+arbitrary GPU UUIDs by nondeterministic map iteration
+(gpuscheduler/scheduler.go:64-90), this one models chips as coordinates in the
+host's ICI mesh and allocates **contiguous sub-slices** so collectives ride
+ICI, tracking fragmentation.
+"""
+
+from tpu_docker_api.scheduler.ports import PortScheduler  # noqa: F401
+from tpu_docker_api.scheduler.slices import ChipScheduler  # noqa: F401
+from tpu_docker_api.scheduler.topology import (  # noqa: F401
+    GENERATIONS,
+    Generation,
+    HostTopology,
+    parse_accelerator_type,
+)

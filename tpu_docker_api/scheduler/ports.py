@@ -1,0 +1,108 @@
+"""Host-port scheduler.
+
+Parity: reference ``internal/scheduler/portscheduler/scheduler.go`` — exclusive
+allocation over ``[start_port, end_port]`` (default 40000–65535,
+scheduler.go:17-19) with linear scan. Fixes: persist on every mutation (not
+only Close, scheduler.go:80-82) and return snapshots, not the live set
+(scheduler.go:128-132). A rotating cursor replaces the reference's
+always-from-start scan so freshly released ports aren't immediately reused
+(kinder to TIME_WAIT).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from tpu_docker_api import errors
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import KV
+
+
+class PortScheduler:
+    def __init__(
+        self,
+        kv: KV,
+        start_port: int = 40000,
+        end_port: int = 65535,
+        store_key: str = keys.SCHEDULER_PORTS_KEY,
+    ) -> None:
+        if start_port > end_port:
+            raise ValueError("start_port > end_port")
+        self.start_port = start_port
+        self.end_port = end_port
+        self._kv = kv
+        self._key = store_key
+        self._mu = threading.Lock()
+        # port → owner name ("" when allocated anonymously)
+        self._used: dict[int, str] = {}
+        self._cursor = start_port
+        raw = kv.get_or(store_key)
+        if raw:
+            state = json.loads(raw)
+            used = state["used"]
+            if isinstance(used, list):  # legacy ownerless layout
+                used = {p: "" for p in used}
+            self._used = {int(p): o for p, o in used.items()
+                          if start_port <= int(p) <= end_port}
+            self._cursor = state.get("cursor", start_port)
+            if not start_port <= self._cursor <= end_port:
+                self._cursor = start_port
+
+    def _persist_locked(self) -> None:
+        self._kv.put(
+            self._key,
+            json.dumps({"used": {str(p): o for p, o in sorted(self._used.items())},
+                        "cursor": self._cursor}),
+        )
+
+    @property
+    def n_free(self) -> int:
+        with self._mu:
+            return (self.end_port - self.start_port + 1) - len(self._used)
+
+    def apply_ports(self, n: int, owner: str = "") -> list[int]:
+        """Allocate ``n`` distinct host ports (reference ApplyPorts,
+        scheduler.go:85-111)."""
+        if n <= 0:
+            return []
+        with self._mu:
+            span = self.end_port - self.start_port + 1
+            if span - len(self._used) < n:
+                raise errors.PortNotEnough(f"want {n}, free {span - len(self._used)}")
+            out: list[int] = []
+            p = self._cursor
+            for _ in range(span):
+                if p not in self._used:
+                    self._used[p] = owner
+                    out.append(p)
+                    if len(out) == n:
+                        break
+                p = p + 1 if p < self.end_port else self.start_port
+            self._cursor = out[-1] + 1 if out[-1] < self.end_port else self.start_port
+            self._persist_locked()
+            return out
+
+    def restore_ports(self, ports: list[int], owner: str | None = None) -> None:
+        """Return ports to the pool (reference RestorePorts, scheduler.go:114-125).
+        With ``owner`` set, only ports still held by that owner are freed
+        (double-free guard, mirroring ChipScheduler.restore_chips)."""
+        with self._mu:
+            for p in ports:
+                if owner is not None and self._used.get(p) != owner:
+                    continue
+                self._used.pop(p, None)
+            self._persist_locked()
+
+    def status(self) -> dict:
+        """Snapshot for GET /resources/ports (reference GetPortStatus +
+        sorted MarshalJSON, scheduler.go:47-56,128-132)."""
+        with self._mu:
+            used = dict(sorted(self._used.items()))
+        return {
+            "startPort": self.start_port,
+            "endPort": self.end_port,
+            "usedCount": len(used),
+            "usedPorts": list(used),
+            "owners": used,
+        }

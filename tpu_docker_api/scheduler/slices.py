@@ -1,0 +1,214 @@
+"""ICI-topology-aware chip/slice allocator.
+
+The TPU replacement for the reference's GPU scheduler
+(gpuscheduler/scheduler.go). Differences, all deliberate (SURVEY.md §2.3,
+§7 step 3):
+
+- **Topology-aware**: chips are mesh coordinates; an allocation prefers an
+  ICI-contiguous axis-aligned sub-block (so the job's collectives ride ICI)
+  and only then falls back to scattered chips, reporting which it got.
+- **Deterministic**: candidate shapes and offsets are scanned in sorted order
+  (the reference iterates a Go map ⇒ nondeterministic pick,
+  scheduler.go:74-82).
+- **Crash-safe**: state persists to the KV store on every mutation, not only
+  on graceful Close (scheduler.go:59-61).
+- **Status snapshots are copies**, not the live map handed to the JSON
+  encoder (scheduler.go:107-112 quirk).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+
+from tpu_docker_api import errors
+from tpu_docker_api.scheduler.topology import HostTopology, parse_slice_shape
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import KV
+
+Shape = tuple[int, int, int]
+Coord = tuple[int, int, int]
+
+
+def candidate_shapes(n: int, mesh: Shape) -> list[Shape]:
+    """Axis-aligned block shapes of volume ``n`` that fit in ``mesh``,
+    most-compact first (minimal surface area ⇒ max ICI bisection), then
+    lexicographic for determinism."""
+    shapes = set()
+    for a in range(1, min(n, mesh[0]) + 1):
+        if n % a:
+            continue
+        rest = n // a
+        for b in range(1, min(rest, mesh[1]) + 1):
+            if rest % b:
+                continue
+            c = rest // b
+            if c <= mesh[2]:
+                shapes.add((a, b, c))
+
+    def surface(s: Shape) -> int:
+        a, b, c = s
+        return a * b + b * c + a * c
+
+    # tie-break surface ties toward x-major shapes (2,2,1) over (1,2,2)
+    return sorted(shapes, key=lambda s: (surface(s), tuple(-d for d in s)))
+
+
+class ChipScheduler:
+    """Host-wide exclusive TPU chip allocator (singleton per process, like
+    reference ``Scheduler`` gpuscheduler/scheduler.go:25)."""
+
+    def __init__(
+        self,
+        topology: HostTopology,
+        kv: KV,
+        store_key: str = keys.SCHEDULER_CHIPS_KEY,
+    ) -> None:
+        self.topology = topology
+        self._kv = kv
+        self._key = store_key
+        self._mu = threading.Lock()
+        # chip_id → owner name ("" when allocated anonymously)
+        self._used: dict[int, str] = {}
+        raw = kv.get_or(store_key)
+        if raw:
+            # restore-from-store path (reference initFormEtcd, scheduler.go:123-140)
+            self._used = {int(k): v for k, v in json.loads(raw).items()
+                          if int(k) in topology.coords}
+            self._persist_locked()
+
+    # -- persistence -------------------------------------------------------------
+
+    def _persist_locked(self) -> None:
+        self._kv.put(self._key, json.dumps({str(k): v for k, v in sorted(self._used.items())}))
+
+    # -- queries -----------------------------------------------------------------
+
+    @property
+    def free_chips(self) -> list[int]:
+        with self._mu:
+            return sorted(set(self.topology.coords) - set(self._used))
+
+    def status(self) -> dict:
+        """Resource view for GET /resources/tpus (reference GetGpusStatus,
+        scheduler.go:107-112 — but a snapshot, not the live map)."""
+        with self._mu:
+            used = dict(self._used)
+        chips = []
+        for cid in sorted(self.topology.coords):
+            chips.append({
+                "chipId": cid,
+                "coords": list(self.topology.coords[cid]),
+                "used": cid in used,
+                "owner": used.get(cid, ""),
+            })
+        return {
+            "generation": self.topology.generation.name,
+            "meshShape": list(self.topology.mesh_shape),
+            "totalChips": self.topology.n_chips,
+            "freeChips": self.topology.n_chips - len(used),
+            "largestFreeBlock": self._largest_free_block(set(self.topology.coords) - set(used)),
+            "chips": chips,
+        }
+
+    # -- allocation --------------------------------------------------------------
+
+    def apply_chips(
+        self, n: int, shape: str = "", owner: str = ""
+    ) -> tuple[list[int], bool]:
+        """Allocate ``n`` chips (or an explicit ``shape`` like "2x2").
+
+        Returns ``(chip_ids, ici_contiguous)``. Raises ChipNotEnough when the
+        pool cannot satisfy the ask; with an explicit shape, scattered
+        fallback is disabled (the caller asked for a real slice).
+
+        Reference analog: ApplyGpus first-fit bit scan (scheduler.go:64-90).
+        """
+        if n <= 0 and not shape:
+            return [], True
+        with self._mu:
+            free = set(self.topology.coords) - set(self._used)
+            if shape:
+                want = parse_slice_shape(shape)
+                n = want[0] * want[1] * want[2]
+                block = self._find_block_locked(want, free, allow_rotations=True)
+                if block is None:
+                    raise errors.ChipNotEnough(
+                        f"no free ICI-contiguous {shape} block "
+                        f"(free={len(free)}/{self.topology.n_chips})"
+                    )
+                self._claim_locked(block, owner)
+                return block, True
+            if n > len(free):
+                raise errors.ChipNotEnough(
+                    f"want {n} chips, only {len(free)} free"
+                )
+            # prefer a contiguous block of any shape with volume n
+            for cand in candidate_shapes(n, self.topology.mesh_shape):
+                block = self._find_block_locked(cand, free)
+                if block is not None:
+                    self._claim_locked(block, owner)
+                    return block, True
+            # scattered fallback (parity: the reference never guarantees
+            # adjacency at all) — deterministic lowest-id-first
+            picked = sorted(free)[:n]
+            self._claim_locked(picked, owner)
+            return picked, False
+
+    def restore_chips(self, chip_ids: list[int], owner: str | None = None) -> None:
+        """Return chips to the pool (reference RestoreGpus, scheduler.go:93-104).
+
+        With ``owner`` set, only chips still held by that owner are freed —
+        the double-free guard: a stop followed by a delete must not free
+        chips that were re-allocated to another container in between.
+        """
+        with self._mu:
+            for cid in chip_ids:
+                if owner is not None and self._used.get(cid) != owner:
+                    continue
+                self._used.pop(cid, None)
+            self._persist_locked()
+
+    def _claim_locked(self, chip_ids: list[int], owner: str) -> None:
+        for cid in chip_ids:
+            self._used[cid] = owner
+        self._persist_locked()
+
+    # -- block search ------------------------------------------------------------
+
+    def _find_block_locked(
+        self, want: Shape, free: set[int], allow_rotations: bool = False
+    ) -> list[int] | None:
+        """First free axis-aligned block of shape ``want``, scanning offsets in
+        sorted order (deterministic). Rotations are tried only for explicit
+        user shapes — the count path already enumerates every orientation via
+        candidate_shapes, in compactness order."""
+        coord_to_chip = {c: cid for cid, c in self.topology.coords.items()}
+        mx, my, mz = self.topology.mesh_shape
+        rotations = sorted(set(itertools.permutations(want))) if allow_rotations else [want]
+        for rot in rotations:
+            a, b, c = rot
+            if a > mx or b > my or c > mz:
+                continue
+            for ox in range(mx - a + 1):
+                for oy in range(my - b + 1):
+                    for oz in range(mz - c + 1):
+                        cells = [
+                            coord_to_chip.get((ox + dx, oy + dy, oz + dz))
+                            for dx in range(a)
+                            for dy in range(b)
+                            for dz in range(c)
+                        ]
+                        if all(cid is not None and cid in free for cid in cells):
+                            return sorted(cells)  # type: ignore[arg-type]
+        return None
+
+    def _largest_free_block(self, free: set[int]) -> int:
+        """Fragmentation gauge: volume of the largest allocatable block."""
+        total = len(free)
+        for n in range(total, 0, -1):
+            for cand in candidate_shapes(n, self.topology.mesh_shape):
+                if self._find_block_locked(cand, free) is not None:
+                    return n
+        return 0

@@ -1,0 +1,143 @@
+"""TPU topology model.
+
+The reference has no topology concept — its GPU scheduler is a flat
+UUID→bit map (gpuscheduler/scheduler.go:27-32). TPU chips are nodes in an ICI
+mesh/torus, and slice allocation must be shape-aware so intra-job collectives
+stay on ICI (SURVEY.md §2.3). This module knows the public per-generation
+facts: chips per host, host mesh shape, HBM, and peak bf16 FLOPs (the MFU
+denominator used by bench.py).
+
+Accelerator-type strings follow Cloud TPU convention: ``<gen>-<N>`` where N is
+the *core* count for v2–v4/v5p (2 TensorCores per chip) and the *chip* count
+for v5e/v6e (1 core per chip that XLA sees).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+
+@dataclasses.dataclass(frozen=True)
+class Generation:
+    name: str
+    cores_per_chip: int            # cores XLA addresses per chip
+    host_mesh: tuple[int, int, int]  # physical chips per host as (x, y, z)
+    hbm_bytes_per_chip: int
+    peak_bf16_flops: float         # per chip
+    torus_dims: int                # 2 ⇒ 2D ICI (v2/v3/v5e/v6e), 3 ⇒ 3D (v4/v5p)
+
+
+_GB = 1024**3
+
+GENERATIONS: dict[str, Generation] = {
+    "v2":  Generation("v2", 2, (2, 2, 1), 16 * _GB, 46e12, 2),
+    "v3":  Generation("v3", 2, (2, 2, 1), 32 * _GB, 123e12, 2),
+    "v4":  Generation("v4", 2, (2, 2, 1), 32 * _GB, 275e12, 3),
+    "v5e": Generation("v5e", 1, (2, 4, 1), 16 * _GB, 197e12, 2),
+    "v5p": Generation("v5p", 2, (2, 2, 1), 95 * _GB, 459e12, 3),
+    "v6e": Generation("v6e", 1, (2, 4, 1), 32 * _GB, 918e12, 2),
+}
+
+
+def parse_accelerator_type(acc_type: str) -> tuple[Generation, int]:
+    """``"v5e-8"`` → (Generation(v5e), 8 chips); ``"v5p-16"`` → (v5p, 8 chips).
+
+    Raises ValueError on unknown generation (mapped to TopologyUnknown by
+    callers).
+    """
+    try:
+        gen_name, _, n = acc_type.partition("-")
+        gen = GENERATIONS[gen_name]
+        count = int(n)
+    except (KeyError, ValueError) as e:
+        raise ValueError(f"unknown accelerator type {acc_type!r}") from e
+    chips = count // gen.cores_per_chip if gen.cores_per_chip > 1 else count
+    return gen, max(chips, 1)
+
+
+def default_mesh_shape(gen: Generation, n_chips: int) -> tuple[int, int, int]:
+    """A plausible physical mesh for ``n_chips`` of ``gen``.
+
+    Hosts tile along y then z: e.g. v5e 2×4 hosts tile to 2×8 (16 chips),
+    4×4... For odd counts, fall back to an n×1×1 line. Used when the telemetry
+    sidecar cannot report real coordinates (CPU dev hosts, tests).
+    """
+    hx, hy, hz = gen.host_mesh
+    per_host = hx * hy * hz
+    if n_chips <= per_host:
+        # sub-host: cut the host mesh along x then y
+        for shape in _sub_shapes((hx, hy, hz)):
+            if shape[0] * shape[1] * shape[2] == n_chips:
+                return shape
+        return (n_chips, 1, 1)
+    if n_chips % per_host == 0:
+        k = n_chips // per_host
+        if gen.torus_dims == 3:
+            return (hx, hy, hz * k)
+        return (hx, hy * k, 1)
+    return (n_chips, 1, 1)
+
+
+def _sub_shapes(host: tuple[int, int, int]):
+    hx, hy, hz = host
+    shapes = set()
+    for x, y, z in itertools.product(range(1, hx + 1), range(1, hy + 1), range(1, hz + 1)):
+        shapes.add((x, y, z))
+    # smallest-volume first, then most cubic
+    return sorted(shapes, key=lambda s: (s[0] * s[1] * s[2], -min(s), s))
+
+
+def parse_slice_shape(shape: str) -> tuple[int, int, int]:
+    """``"2x2"`` → (2,2,1); ``"2x2x4"`` → (2,2,4)."""
+    parts = [int(p) for p in shape.lower().split("x")]
+    if not 1 <= len(parts) <= 3 or any(p < 1 for p in parts):
+        raise ValueError(f"bad slice shape {shape!r}")
+    while len(parts) < 3:
+        parts.append(1)
+    return (parts[0], parts[1], parts[2])
+
+
+@dataclasses.dataclass
+class HostTopology:
+    """The scheduler's world: a mesh of chips with ids and coordinates."""
+
+    generation: Generation
+    mesh_shape: tuple[int, int, int]
+    # chip_id → (x, y, z); chip ids are host-local /dev/accel numbers
+    coords: dict[int, tuple[int, int, int]]
+
+    @staticmethod
+    def build(acc_type: str) -> "HostTopology":
+        """Synthesize a topology from an accelerator-type string (the path
+        used when no telemetry sidecar is configured)."""
+        gen, n_chips = parse_accelerator_type(acc_type)
+        shape = default_mesh_shape(gen, n_chips)
+        coords: dict[int, tuple[int, int, int]] = {}
+        cid = 0
+        for z in range(shape[2]):
+            for y in range(shape[1]):
+                for x in range(shape[0]):
+                    if cid >= n_chips:
+                        break
+                    coords[cid] = (x, y, z)
+                    cid += 1
+        return HostTopology(generation=gen, mesh_shape=shape, coords=coords)
+
+    @staticmethod
+    def from_chips(gen: Generation, chips: dict[int, tuple[int, int, int]]) -> "HostTopology":
+        """Build from real sidecar-reported coordinates."""
+        if not chips:
+            return HostTopology(gen, (0, 0, 0), {})
+        shape = tuple(max(c[d] for c in chips.values()) + 1 for d in range(3))
+        return HostTopology(gen, shape, dict(chips))  # type: ignore[arg-type]
+
+    @property
+    def n_chips(self) -> int:
+        return len(self.coords)
+
+    def chip_at(self, coord: tuple[int, int, int]) -> int | None:
+        for cid, c in self.coords.items():
+            if c == coord:
+                return cid
+        return None

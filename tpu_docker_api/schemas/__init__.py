@@ -1,0 +1,21 @@
+"""Request / state DTOs (parity: reference ``internal/model/``)."""
+
+from tpu_docker_api.schemas.container import (  # noqa: F401
+    Bind,
+    ContainerCommit,
+    ContainerDelete,
+    ContainerExecute,
+    ContainerPatchChips,
+    ContainerPatchVolume,
+    ContainerPort,
+    ContainerRun,
+    ContainerStop,
+)
+from tpu_docker_api.schemas.state import ContainerState, VolumeState  # noqa: F401
+from tpu_docker_api.schemas.tpu import ChipInfo, HostTopologyInfo  # noqa: F401
+from tpu_docker_api.schemas.volume import (  # noqa: F401
+    VOLUME_SIZE_UNITS,
+    VolumeCreate,
+    VolumeDelete,
+    VolumeSize,
+)

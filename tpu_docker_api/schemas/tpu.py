@@ -1,0 +1,77 @@
+"""TPU telemetry wire format.
+
+Parity: reference ``internal/model/gpu.go:3-28`` — the NVML-shaped
+``GpuInfo/Memory/ProcessInfo`` structs returned by the detect-gpu sidecar.
+The TPU equivalents carry what libtpu / the accel sysfs expose: chip id,
+mesh coordinates, ICI neighbours, HBM, duty cycle, and the host topology
+summary the scheduler seeds from (SURVEY.md §2.2 row 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class ChipInfo:
+    """One TPU chip as reported by the telemetry sidecar (NVML GpuInfo analog)."""
+    chip_id: int                      # host-local index (the /dev/accel<N> number)
+    device_path: str                  # e.g. "/dev/accel0"
+    coords: tuple[int, int, int]      # (x, y, z) in the slice mesh
+    cores_per_chip: int = 1
+    hbm_total_bytes: int = 0
+    hbm_used_bytes: int = 0
+    duty_cycle_pct: float = 0.0       # TensorCore duty cycle (power/util analog)
+    pid: int = 0                      # owning process if attached, else 0
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["coords"] = list(self.coords)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ChipInfo":
+        return ChipInfo(
+            chip_id=int(d["chip_id"]),
+            device_path=d.get("device_path", f"/dev/accel{d['chip_id']}"),
+            coords=tuple(d.get("coords", (0, 0, 0))),  # type: ignore[arg-type]
+            cores_per_chip=int(d.get("cores_per_chip", 1)),
+            hbm_total_bytes=int(d.get("hbm_total_bytes", 0)),
+            hbm_used_bytes=int(d.get("hbm_used_bytes", 0)),
+            duty_cycle_pct=float(d.get("duty_cycle_pct", 0.0)),
+            pid=int(d.get("pid", 0)),
+        )
+
+
+@dataclasses.dataclass
+class HostTopologyInfo:
+    """The sidecar's host summary: what `GET /api/v1/detect/tpu` returns.
+
+    The scheduler seeds from this on first boot, the way the reference seeds
+    its GPU map from detect-gpu (gpuscheduler/scheduler.go:142-158).
+    """
+    accelerator_type: str             # e.g. "v5e-8", "v5p-16"
+    generation: str                   # "v5e", "v5p", ...
+    chips: list[ChipInfo] = dataclasses.field(default_factory=list)
+    mesh_shape: tuple[int, int, int] = (0, 0, 0)   # host-local physical mesh
+    libtpu_version: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "accelerator_type": self.accelerator_type,
+            "generation": self.generation,
+            "chips": [c.to_dict() for c in self.chips],
+            "mesh_shape": list(self.mesh_shape),
+            "libtpu_version": self.libtpu_version,
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "HostTopologyInfo":
+        return HostTopologyInfo(
+            accelerator_type=d["accelerator_type"],
+            generation=d.get("generation", d["accelerator_type"].split("-")[0]),
+            chips=[ChipInfo.from_dict(c) for c in d.get("chips", [])],
+            mesh_shape=tuple(d.get("mesh_shape", (0, 0, 0))),  # type: ignore[arg-type]
+            libtpu_version=d.get("libtpu_version", ""),
+        )

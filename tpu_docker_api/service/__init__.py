@@ -1,0 +1,4 @@
+"""Service / orchestration layer (parity: reference L2 — ``internal/service/``)."""
+
+from tpu_docker_api.service.container import ContainerService  # noqa: F401
+from tpu_docker_api.service.volume import VolumeService  # noqa: F401

@@ -1,0 +1,438 @@
+"""Container orchestration service.
+
+Parity: reference ``internal/service/container.go`` — all nine flows (run,
+delete, execute, patch-chips, patch-volume, stop, restart, commit, info) with
+the immutable-versioned rolling-replacement model: a container is never
+mutated; every update creates ``base-(n+1)`` and retires ``base-n``.
+
+Deliberate fixes over the reference (SURVEY.md §5.4, appendix):
+
+- **quiesce→copy→start**: the old container is stopped *before* its data is
+  copied, and the new container starts only *after* the copy completes (the
+  reference copies async while the old container may still write and the new
+  one is already running, service/container.go:249-266); a dead-lettered copy
+  triggers compensation (restart the old container) instead of stranding the
+  workload;
+- **per-family locking**: each flow is serialized per container family, so
+  concurrent requests cannot double-create or double-replace (the reference's
+  flows are unserialized check-then-act);
+- **owner-checked resource returns**: chips/ports are freed only if still
+  held by this family, so stop-then-delete cannot free a resource that was
+  re-allocated in between;
+- the container spec is persisted **synchronously** with the version bump —
+  a crash can never leave a version pointer without its spec;
+- optimistic-concurrency checks accept a bare base name (operate on latest)
+  or a versioned name (must equal latest), matching the reference's
+  ``name-version`` contract (api/container.go:102-106).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.base import ContainerRuntime
+from tpu_docker_api.runtime.spec import ContainerSpec, PortBinding, render_tpu_attachment
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.schemas.container import (
+    ContainerCommit,
+    ContainerDelete,
+    ContainerExecute,
+    ContainerPatchChips,
+    ContainerPatchVolume,
+    ContainerRun,
+    ContainerStop,
+)
+from tpu_docker_api.schemas.state import ContainerState
+from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import CopyTask, FnTask, WorkQueue
+
+log = logging.getLogger(__name__)
+
+
+class _FamilyLocks:
+    """Named locks so flows serialize per container family, not globally."""
+
+    def __init__(self) -> None:
+        self._locks: dict[str, threading.RLock] = {}
+        self._mu = threading.Lock()
+
+    @contextlib.contextmanager
+    def hold(self, base: str):
+        with self._mu:
+            lock = self._locks.setdefault(base, threading.RLock())
+        with lock:
+            yield
+
+
+class ContainerService:
+    def __init__(
+        self,
+        runtime: ContainerRuntime,
+        store: StateStore,
+        chip_scheduler: ChipScheduler,
+        port_scheduler: PortScheduler,
+        versions: VersionMap,
+        work_queue: WorkQueue,
+        libtpu_path: str = "",
+    ) -> None:
+        self.runtime = runtime
+        self.store = store
+        self.chips = chip_scheduler
+        self.ports = port_scheduler
+        self.versions = versions
+        self.wq = work_queue
+        self.libtpu_path = libtpu_path
+        self._locks = _FamilyLocks()
+
+    # -- helpers -----------------------------------------------------------------
+
+    def _resolve_latest(self, name: str) -> tuple[str, int, str]:
+        """(base, latest_version, latest_name); optimistic-concurrency check
+        when ``name`` carries a version suffix (reference
+        service/container.go:195-198)."""
+        base, version = split_versioned_name(name)
+        latest = self.versions.get(base)
+        if latest is None:
+            raise errors.ContainerNotExist(name)
+        if version is not None and version != latest:
+            raise errors.VersionNotMatch(f"{name}: latest version is {latest}")
+        return base, latest, versioned_name(base, latest)
+
+    def _family_runtime_members(self, base: str) -> list[str]:
+        """Every version of ``base`` present in the runtime (old retired
+        versions are kept stopped for manual rollback until delete)."""
+        out = []
+        for name in self.runtime.container_list():
+            b, v = split_versioned_name(name)
+            if b == base and v is not None:
+                out.append(name)
+        return out
+
+    # -- 1. run (POST /containers; reference RunGpuContainer :36-100) -------------
+
+    def run_container(self, req: ContainerRun) -> dict:
+        base = req.container_name
+        with self._locks.hold(base):
+            if self.versions.contains(base) or self._family_runtime_members(base):
+                raise errors.ContainerExisted(base)
+
+            spec = ContainerSpec(
+                name="",  # versioned name assigned in _run_new_version
+                image=req.image_name,
+                cmd=list(req.cmd),
+                env=list(req.env),
+                binds=[b.render() for b in req.binds],
+                port_bindings=[
+                    PortBinding(p.container_port, p.host_port, p.protocol)
+                    for p in req.container_ports
+                ],
+            )
+            chip_ids, contiguous = self.chips.apply_chips(
+                req.chip_count, shape=req.slice_shape, owner=base
+            )
+            try:
+                render_tpu_attachment(
+                    spec, chip_ids, self.chips.topology,
+                    ici_contiguous=contiguous, libtpu_path=self.libtpu_path,
+                )
+                name = self._run_new_version(base, spec, start_now=True)
+            except Exception:
+                self.chips.restore_chips(chip_ids, owner=base)
+                raise
+            log.info("run container %s (chips=%s contiguous=%s)", name, chip_ids,
+                     contiguous)
+            return {"name": name, "chipIds": chip_ids, "iciContiguous": contiguous}
+
+    def _run_new_version(self, base: str, spec: ContainerSpec, start_now: bool) -> str:
+        """Version bump → port alloc → create [→ start] → persist, with full
+        rollback on failure (reference runContainer, service/container.go:463-535).
+        The spec persists synchronously so a version pointer always has its
+        spec, even across a crash."""
+        prev = self.versions.get(base)
+        version = self.versions.next_version(base)
+        name = versioned_name(base, version)
+        spec.name = name
+
+        fresh_ports: list[int] = []
+        need = [pb for pb in spec.port_bindings if pb.host_port == 0]
+        try:
+            fresh_ports = self.ports.apply_ports(len(need), owner=base)
+            for pb, hp in zip(need, fresh_ports):
+                pb.host_port = hp
+            self.runtime.container_create(spec)
+            try:
+                self.store.put_container(
+                    ContainerState(container_name=name, version=version,
+                                   spec=spec.to_dict())
+                )
+                if start_now:
+                    self.runtime.container_start(name)
+            except Exception:
+                # rollback half-created container (reference :511-516)
+                self.runtime.container_remove(name, force=True)
+                self.store.delete_version(Resource.CONTAINERS, name)
+                raise
+        except Exception:
+            self.ports.restore_ports(fresh_ports, owner=base)
+            self.versions.rollback(base, prev)
+            raise
+        return name
+
+    # -- 2. delete (DELETE /containers/{name}; reference :104-137) ----------------
+
+    def delete_container(self, name: str, req: ContainerDelete) -> None:
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            # remove EVERY runtime version of the family, not only the latest —
+            # retired versions are kept stopped for rollback and must not leak
+            for member in self._family_runtime_members(base):
+                try:
+                    info = self.runtime.container_inspect(member)
+                    self.runtime.container_remove(member, force=req.force)
+                    self.chips.restore_chips(info.spec.chip_ids, owner=base)
+                    self.ports.restore_ports(
+                        [pb.host_port for pb in info.spec.port_bindings], owner=base
+                    )
+                except errors.ContainerNotExist:
+                    continue
+            if req.del_etcd_info_and_version_record:
+                self.versions.remove(base)
+                self.wq.submit(FnTask(
+                    fn=lambda: self.store.delete_family(Resource.CONTAINERS, base),
+                    description=f"delete state family {base}",
+                ))
+            log.info("deleted container family %s (purge_state=%s)",
+                     base, req.del_etcd_info_and_version_record)
+
+    # -- 3. execute (POST /containers/{name}/execute; reference :140-175) ---------
+
+    def execute_container(self, name: str, req: ContainerExecute) -> str:
+        _, _, latest_name = self._resolve_latest(name)
+        # no family lock held: exec may be long-running and must not block
+        # control-plane mutations
+        res = self.runtime.container_exec(latest_name, req.cmd, workdir=req.work_dir)
+        return res.output
+
+    # -- 4. patch chips (PATCH /containers/{name}/gpu; reference :181-270) --------
+
+    def patch_container_chips(self, name: str, req: ContainerPatchChips) -> dict:
+        base, version, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            # re-resolve under the lock (another patch may have won the race)
+            base, version, latest_name = self._resolve_latest(name)
+            state = self.store.get_container(latest_name)
+            spec = ContainerSpec.from_dict(state.spec)
+
+            current = list(spec.chip_ids)
+            want = req.chip_count
+            if want == len(current):
+                raise errors.NoPatchRequired(f"{name} already has {want} chips")
+            if want < 0:
+                raise errors.BadRequest("chipCount must be >= 0")
+
+            to_release: list[int] = []
+            extra: list[int] = []
+            if want > len(current):  # grow (reference :211-229)
+                extra, contiguous = self.chips.apply_chips(
+                    want - len(current), owner=base
+                )
+                new_chips = sorted(current + extra)
+                contiguous = contiguous and spec.ici_contiguous
+            else:  # shrink (reference :230-246): release only AFTER the
+                # replacement exists, so a failed replace leaves the old
+                # container's chips untouched
+                new_chips = sorted(current)[: want]
+                to_release = sorted(current)[want:]
+                contiguous = spec.ici_contiguous
+            try:
+                render_tpu_attachment(
+                    spec, new_chips, self.chips.topology,
+                    ici_contiguous=contiguous, libtpu_path=self.libtpu_path,
+                )
+                new_name = self._rolling_replace(base, latest_name, spec)
+            except Exception:
+                self.chips.restore_chips(extra, owner=base)
+                raise
+            self.chips.restore_chips(to_release, owner=base)
+            log.info("patched %s chips %d -> %d as %s", latest_name,
+                     len(current), want, new_name)
+            return {"name": new_name, "chipIds": new_chips}
+
+    # -- 5. patch volume (PATCH /containers/{name}/volume; reference :275-328) ----
+
+    def patch_container_volume(self, name: str, req: ContainerPatchVolume) -> dict:
+        if req.old_bind is None or req.new_bind is None:
+            raise errors.BadRequest("oldBind and newBind are required")
+        base, version, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            base, version, latest_name = self._resolve_latest(name)
+            state = self.store.get_container(latest_name)
+            spec = ContainerSpec.from_dict(state.spec)
+
+            old_str, new_str = req.old_bind.render(), req.new_bind.render()
+            if old_str == new_str:
+                raise errors.NoPatchRequired("binds identical")
+            if old_str not in spec.binds:
+                raise errors.BadRequest(f"bind {old_str} not present on {latest_name}")
+            spec.binds = [new_str if b == old_str else b for b in spec.binds]
+
+            new_name = self._rolling_replace(base, latest_name, spec)
+            log.info("patched %s volume %s -> %s as %s", latest_name, old_str,
+                     new_str, new_name)
+            return {"name": new_name}
+
+    # -- 6. stop (POST /containers/{name}/stop; reference :333-360) ---------------
+
+    def stop_container(self, name: str, opts: ContainerStop | None = None) -> None:
+        opts = opts or ContainerStop(restore_chips=True, restore_ports=True)
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            info = self.runtime.container_inspect(latest_name)
+            self.runtime.container_stop(latest_name)
+            if opts.restore_chips:
+                self.chips.restore_chips(info.spec.chip_ids, owner=base)
+            if opts.restore_ports:
+                self.ports.restore_ports(
+                    [pb.host_port for pb in info.spec.port_bindings], owner=base
+                )
+            log.info("stopped container %s", latest_name)
+
+    # -- 7. restart (PATCH /containers/{name}/restart; reference :365-425) --------
+
+    def restart_container(self, name: str) -> dict:
+        base, version, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            base, version, latest_name = self._resolve_latest(name)
+            state = self.store.get_container(latest_name)
+            spec = ContainerSpec.from_dict(state.spec)
+
+            if not spec.chip_ids:
+                # cardless short-circuit: plain runtime restart (reference :372-386)
+                self.runtime.container_restart(latest_name)
+                return {"name": latest_name}
+
+            info = self.runtime.container_inspect(latest_name)
+            if info.running:
+                # running carded container: devices still attached; plain restart
+                self.runtime.container_restart(latest_name)
+                return {"name": latest_name}
+
+            # stopped carded container: its chips/ports were restored on stop, so
+            # re-allocate (possibly different chips) and roll a new version
+            # (reference :390-425)
+            chip_ids, contiguous = self.chips.apply_chips(
+                len(spec.chip_ids), owner=base
+            )
+            try:
+                render_tpu_attachment(
+                    spec, chip_ids, self.chips.topology,
+                    ici_contiguous=contiguous, libtpu_path=self.libtpu_path,
+                )
+                for pb in spec.port_bindings:
+                    pb.host_port = 0  # ports were restored on stop; re-allocate
+                new_name = self._rolling_replace(base, latest_name, spec,
+                                                 old_running=False)
+            except Exception:
+                self.chips.restore_chips(chip_ids, owner=base)
+                raise
+            log.info("restarted %s as %s (chips=%s)", latest_name, new_name, chip_ids)
+            return {"name": new_name, "chipIds": chip_ids}
+
+    # -- 8. commit (POST /containers/{name}/commit; reference :428-447) -----------
+
+    def commit_container(self, name: str, req: ContainerCommit) -> str:
+        _, _, latest_name = self._resolve_latest(name)
+        if not req.new_image_name:
+            # the reference tags "" in this case (quirk catalog); we reject
+            raise errors.BadRequest("newImageName is required")
+        return self.runtime.container_commit(latest_name, req.new_image_name)
+
+    # -- 9. info (GET /containers/{name}; reference :449-459) ---------------------
+
+    def get_container_info(self, name: str) -> dict:
+        base, version = split_versioned_name(name)
+        if self.versions.get(base) is None:
+            raise errors.ContainerNotExist(name)
+        # reads are allowed on historical versions — the per-version store
+        # retains them (unlike the reference's latest-wins etcd layout)
+        try:
+            state = self.store.get_container(name)
+        except errors.NotExistInStore:
+            raise errors.ContainerNotExist(name) from None
+        out = {"state": state.to_dict(), "runtime": None}
+        try:
+            info = self.runtime.container_inspect(state.container_name)
+            out["runtime"] = {
+                "id": info.id,
+                "running": info.running,
+                "pid": info.pid,
+                "exitCode": info.exit_code,
+                "dataDir": info.data_dir,
+            }
+        except errors.ContainerNotExist:
+            pass
+        return out
+
+    # -- rolling replacement core -------------------------------------------------
+
+    def _rolling_replace(
+        self, base: str, old_name: str, new_spec: ContainerSpec,
+        old_running: bool = True,
+    ) -> str:
+        """Create ``base-(n+1)`` from ``new_spec``, migrate data from
+        ``old_name``, start the replacement.
+
+        Fixed sequencing (SURVEY.md §5.4): quiesce the old container first,
+        then copy, and only then start the new one — ordered on the work
+        queue. If the copy dead-letters, compensation restarts the old
+        container so the workload isn't stranded. The API returns the new
+        name immediately; `GET /containers/{name}` shows runtime state while
+        the migration completes.
+        """
+        for pb in new_spec.port_bindings:
+            pb.host_port = 0  # fresh host ports for the new version (reference :489-501)
+        new_name = self._run_new_version(base, new_spec, start_now=False)
+
+        if old_running:
+            # quiesce: stop old, keep its chips (the new version inherits
+            # them), release its old ports (reference stop opts :263-266)
+            try:
+                old_info = self.runtime.container_inspect(old_name)
+                self.runtime.container_stop(old_name)
+                self.ports.restore_ports(
+                    [pb.host_port for pb in old_info.spec.port_bindings], owner=base
+                )
+            except errors.ContainerNotExist:
+                old_running = False
+
+        def _resolve(n: str) -> str:
+            return self.runtime.container_data_dir(n)
+
+        def _start_new() -> None:
+            self.runtime.container_start(new_name)
+            log.info("rolling replace %s -> %s complete", old_name, new_name)
+
+        def _compensate() -> None:
+            log.error("data migration %s -> %s dead-lettered; restarting old "
+                      "container", old_name, new_name)
+            with contextlib.suppress(Exception):
+                self.runtime.container_start(old_name)
+
+        if self.runtime.container_exists(old_name):
+            self.wq.submit(CopyTask(
+                resource="containers",
+                old_name=old_name,
+                new_name=new_name,
+                resolve=_resolve,
+                on_done=_start_new,
+                on_fail=_compensate,
+            ))
+        else:
+            self.wq.submit(FnTask(fn=_start_new, description=f"start {new_name}"))
+        return new_name

@@ -1,0 +1,160 @@
+"""Volume orchestration service.
+
+Parity: reference ``internal/service/volume.go`` — create a named+sized volume
+(local driver with a ``size`` opt, which requires overlay2-on-xfs project
+quotas, docs/volume/volume-size-scale-en.md), delete, resize via
+new-volume-plus-copy with the shrink guard, and info. Same immutable
+``name-(n)`` versioning as containers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.base import ContainerRuntime
+from tpu_docker_api.schemas.state import VolumeState
+from tpu_docker_api.schemas.volume import VolumeCreate, VolumeDelete, VolumeSize, parse_size
+from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import CopyTask, FnTask, WorkQueue
+from tpu_docker_api.utils.files import dir_size
+
+log = logging.getLogger(__name__)
+
+
+class VolumeService:
+    def __init__(
+        self,
+        runtime: ContainerRuntime,
+        store: StateStore,
+        versions: VersionMap,
+        work_queue: WorkQueue,
+    ) -> None:
+        self.runtime = runtime
+        self.store = store
+        self.versions = versions
+        self.wq = work_queue
+        self._locks: dict[str, threading.RLock] = {}
+        self._locks_mu = threading.Lock()
+
+    @contextlib.contextmanager
+    def _hold(self, base: str):
+        with self._locks_mu:
+            lock = self._locks.setdefault(base, threading.RLock())
+        with lock:
+            yield
+
+    def _resolve_latest(self, name: str) -> tuple[str, int, str]:
+        base, version = split_versioned_name(name)
+        latest = self.versions.get(base)
+        if latest is None:
+            raise errors.VolumeNotExist(name)
+        if version is not None and version != latest:
+            raise errors.VersionNotMatch(f"{name}: latest version is {latest}")
+        return base, latest, versioned_name(base, latest)
+
+    # -- create (POST /volumes; reference CreateVolume :28-53) --------------------
+
+    def create_volume(self, req: VolumeCreate) -> dict:
+        base = req.volume_name
+        with self._hold(base):
+            if self.versions.contains(base):
+                raise errors.VolumeExisted(base)
+            if req.size:
+                parse_size(req.size)  # validate unit early (api/volume.go:118-124)
+            name = self._create_version(base, req.size)
+            return {"name": name, "size": req.size}
+
+    def _create_version(self, base: str, size: str) -> str:
+        """Version bump → docker VolumeCreate with size opt → async persist
+        (reference createVolume :56-95)."""
+        prev = self.versions.get(base)
+        version = self.versions.next_version(base)
+        name = versioned_name(base, version)
+        opts = {"size": size} if size else {}
+        try:
+            self.runtime.volume_create(name, opts)
+        except Exception:
+            self.versions.rollback(base, prev)
+            raise
+        # persist synchronously: a version pointer must always have its state
+        self.store.put_volume(VolumeState(volume_name=name, version=version,
+                                          size=size, driver_opts=opts))
+        log.info("created volume %s (size=%s)", name, size or "unsized")
+        return name
+
+    # -- delete (DELETE /volumes/{name}; reference DeleteVolume :98-116) ----------
+
+    def delete_volume(self, name: str, req: VolumeDelete) -> None:
+        base, latest, latest_name = self._resolve_latest(name)
+        with self._hold(base):
+            # remove every runtime version of the family (old versions are
+            # retained after resize for rollback and must not leak)
+            for v in self.store.history(Resource.VOLUMES, base) or [latest]:
+                with contextlib.suppress(errors.VolumeNotExist):
+                    self.runtime.volume_remove(versioned_name(base, v), force=True)
+            if req.del_etcd_info_and_version_record:
+                self.versions.remove(base)
+                self.wq.submit(FnTask(
+                    fn=lambda: self.store.delete_family(Resource.VOLUMES, base),
+                    description=f"delete volume state {base}",
+                ))
+            log.info("deleted volume family %s", base)
+
+    # -- resize (PATCH /volumes/{name}/size; reference PatchVolumeSize :122-187) --
+
+    def patch_volume_size(self, name: str, req: VolumeSize) -> dict:
+        base, version, latest_name = self._resolve_latest(name)
+        with self._hold(base):
+            return self._patch_volume_size_locked(name, req)
+
+    def _patch_volume_size_locked(self, name: str, req: VolumeSize) -> dict:
+        base, version, latest_name = self._resolve_latest(name)
+        state = self.store.get_volume(latest_name)
+        new_bytes = parse_size(req.size)
+
+        if state.size and parse_size(state.size) == new_bytes:
+            raise errors.NoPatchRequired(f"{latest_name} is already {req.size}")
+
+        # shrink guard (reference :151-166 + utils DirSize)
+        mountpoint = self.runtime.volume_data_dir(latest_name)
+        used = dir_size(mountpoint)
+        if used > new_bytes:
+            raise errors.VolumeSizeUsedGreaterThanReduced(
+                f"{latest_name}: {used} bytes in use > target {req.size}"
+            )
+
+        new_name = self._create_version(base, req.size)
+
+        def _resolve(n: str) -> str:
+            return self.runtime.volume_data_dir(n)
+
+        self.wq.submit(CopyTask(
+            resource="volumes",
+            old_name=latest_name,
+            new_name=new_name,
+            resolve=_resolve,
+        ))
+        log.info("resized volume %s -> %s (%s)", latest_name, new_name, req.size)
+        return {"name": new_name, "size": req.size}
+
+    # -- info (GET /volumes/{name}; reference GetVolumeInfo :189-199) -------------
+
+    def get_volume_info(self, name: str) -> dict:
+        _, _, latest_name = self._resolve_latest(name)
+        state = self.store.get_volume(latest_name)
+        out = {"state": state.to_dict(), "runtime": None}
+        try:
+            info = self.runtime.volume_inspect(latest_name)
+            out["runtime"] = {
+                "mountpoint": info.mountpoint,
+                "driverOpts": info.driver_opts,
+                "usedBytes": dir_size(info.mountpoint),
+            }
+        except errors.VolumeNotExist:
+            pass
+        return out

@@ -1,0 +1,14 @@
+"""State & versioning layer (parity: reference L4 — ``internal/etcd/``,
+``internal/version/``, ``internal/workQueue/``)."""
+
+from tpu_docker_api.state.kv import KV, MemoryKV, SqliteKV, open_store  # noqa: F401
+from tpu_docker_api.state.keys import Resource, family_key, version_key  # noqa: F401
+from tpu_docker_api.state.store import StateStore  # noqa: F401
+from tpu_docker_api.state.version import VersionMap  # noqa: F401
+from tpu_docker_api.state.workqueue import (  # noqa: F401
+    CopyTask,
+    DelKeyTask,
+    FnTask,
+    PutKVTask,
+    WorkQueue,
+)

@@ -1,0 +1,71 @@
+"""State-store key layout.
+
+Parity + fix: the reference keeps ONE key per resource family —
+``/apis/v1/<resource>/<basename>`` with the ``-version`` suffix stripped
+(etcd/common.go:75-81) — so each new version overwrites the last and the
+documented rollback (README.md:142-144) is impossible. Here every version gets
+its own key plus a ``latest`` pointer:
+
+    /apis/v1/containers/<base>/v/<NNNNNNNNNN>   (zero-padded ⇒ key-sorted)
+    /apis/v1/containers/<base>/latest            → version number
+    /apis/v1/volumes/<base>/v/<NNNNNNNNNN>
+    /apis/v1/volumes/<base>/latest
+
+Scheduler / version-map state lives under the same tree as in the reference
+(``gpus/gpuStatusMapKey`` → ``/apis/v1/scheduler/*``, ``versions/*`` →
+``/apis/v1/versions/*``).
+"""
+
+from __future__ import annotations
+
+import enum
+
+PREFIX = "/apis/v1"
+
+
+class Resource(str, enum.Enum):
+    """Resource kinds (reference etcd/common.go:24-29 enums)."""
+    CONTAINERS = "containers"
+    VOLUMES = "volumes"
+
+
+def split_versioned_name(name: str) -> tuple[str, int | None]:
+    """``"train-3"`` → ("train", 3); ``"train"`` → ("train", None).
+
+    The reference requires versioned names on every op but create
+    (api/container.go:102-106); base names must not contain '-'
+    (api/container.go:66-70) so the split is unambiguous.
+    """
+    base, sep, tail = name.rpartition("-")
+    if sep and tail.isdigit():
+        return base, int(tail)
+    return name, None
+
+
+def versioned_name(base: str, version: int) -> str:
+    return f"{base}-{version}"
+
+
+def family_prefix(resource: Resource, base: str) -> str:
+    return f"{PREFIX}/{resource.value}/{base}/"
+
+
+def version_key(resource: Resource, base: str, version: int) -> str:
+    return f"{PREFIX}/{resource.value}/{base}/v/{version:010d}"
+
+
+def latest_key(resource: Resource, base: str) -> str:
+    return f"{PREFIX}/{resource.value}/{base}/latest"
+
+
+def family_key(resource: Resource, name: str) -> str:
+    """Key for a possibly-versioned name's family latest pointer."""
+    base, _ = split_versioned_name(name)
+    return latest_key(resource, base)
+
+
+# cross-cutting singletons
+SCHEDULER_CHIPS_KEY = f"{PREFIX}/scheduler/chips"
+SCHEDULER_PORTS_KEY = f"{PREFIX}/scheduler/ports"
+VERSIONS_CONTAINER_KEY = f"{PREFIX}/versions/containers"
+VERSIONS_VOLUME_KEY = f"{PREFIX}/versions/volumes"

@@ -1,0 +1,220 @@
+"""Pluggable key-value backends.
+
+Parity: reference ``internal/etcd/{client,common}.go`` — a clientv3 wrapper with
+``Put/Get/Del``. Here the surface is an abstract ``KV`` with three backends:
+
+- ``MemoryKV`` — hermetic tests (the seam SURVEY.md §4 calls for),
+- ``SqliteKV`` — durable single-host deployments without an etcd cluster,
+- ``EtcdKV``  — etcd v3 via its grpc-gateway JSON API (``/v3/kv/*``), keeping
+  the reference's deployment shape without a grpc/protobuf dependency.
+
+All backends add ``range_prefix``/``delete_prefix``, which the reference lacks
+and which per-version key layout (state/keys.py) needs.
+"""
+
+from __future__ import annotations
+
+import abc
+import base64
+import sqlite3
+import threading
+
+from tpu_docker_api import errors
+
+
+class KV(abc.ABC):
+    """Minimal KV surface (reference etcd.Put/Get/Del, common.go:45-73)."""
+
+    @abc.abstractmethod
+    def put(self, key: str, value: str) -> None: ...
+
+    @abc.abstractmethod
+    def get(self, key: str) -> str:
+        """Return the value; raise errors.NotExistInStore if absent."""
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None:
+        """Delete the key (no error if absent, matching etcd semantics)."""
+
+    @abc.abstractmethod
+    def range_prefix(self, prefix: str) -> dict[str, str]:
+        """All key→value pairs whose key starts with ``prefix``, key-sorted."""
+
+    def delete_prefix(self, prefix: str) -> None:
+        for k in self.range_prefix(prefix):
+            self.delete(k)
+
+    def get_or(self, key: str, default: str | None = None) -> str | None:
+        try:
+            return self.get(key)
+        except errors.NotExistInStore:
+            return default
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+
+class MemoryKV(KV):
+    """In-process dict store for hermetic tests."""
+
+    def __init__(self) -> None:
+        self._d: dict[str, str] = {}
+        self._mu = threading.Lock()
+
+    def put(self, key: str, value: str) -> None:
+        with self._mu:
+            self._d[key] = value
+
+    def get(self, key: str) -> str:
+        with self._mu:
+            if key not in self._d:
+                raise errors.NotExistInStore(key)
+            return self._d[key]
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            self._d.pop(key, None)
+
+    def range_prefix(self, prefix: str) -> dict[str, str]:
+        with self._mu:
+            return {k: v for k, v in sorted(self._d.items()) if k.startswith(prefix)}
+
+
+class SqliteKV(KV):
+    """Durable store on sqlite (WAL). One table, synchronous writes.
+
+    Unlike the reference — which flushes scheduler/version state only on
+    graceful Stop (SURVEY.md §3.1) — every ``put`` here commits, so a hard
+    crash loses nothing.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._mu = threading.Lock()
+        with self._mu:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT NOT NULL)"
+            )
+            self._conn.commit()
+
+    def put(self, key: str, value: str) -> None:
+        with self._mu:
+            self._conn.execute(
+                "INSERT INTO kv(k, v) VALUES(?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (key, value),
+            )
+            self._conn.commit()
+
+    def get(self, key: str) -> str:
+        with self._mu:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        if row is None:
+            raise errors.NotExistInStore(key)
+        return row[0]
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def range_prefix(self, prefix: str) -> dict[str, str]:
+        with self._mu:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k GLOB ? ORDER BY k",
+                (prefix.replace("[", "[[]") + "*",),
+            ).fetchall()
+        return dict(rows)
+
+    def close(self) -> None:
+        with self._mu:
+            self._conn.close()
+
+
+class EtcdKV(KV):
+    """etcd v3 over its grpc-gateway JSON API.
+
+    The reference dials etcd gRPC with a 2 s blocking connect and 1 s per-op
+    timeout (etcd/client.go:14-23, common.go:31); we keep the same budgets on
+    HTTP. Keys/values are base64 on the wire per the gateway contract.
+    """
+
+    DIAL_TIMEOUT_S = 2.0
+    OP_TIMEOUT_S = 1.0
+
+    def __init__(self, addr: str) -> None:
+        import requests  # lazy: hermetic paths never import it
+
+        self._addr = addr.rstrip("/")
+        self._session = requests.Session()
+        # fail fast if unreachable, like the reference's blocking dial
+        self._post("/v3/kv/range", {"key": _b64("probe"), "limit": 1},
+                   timeout=self.DIAL_TIMEOUT_S)
+
+    def _post(self, path: str, body: dict, timeout: float | None = None) -> dict:
+        r = self._session.post(
+            self._addr + path, json=body, timeout=timeout or self.OP_TIMEOUT_S
+        )
+        r.raise_for_status()
+        return r.json()
+
+    def put(self, key: str, value: str) -> None:
+        self._post("/v3/kv/put", {"key": _b64(key), "value": _b64(value)})
+
+    def get(self, key: str) -> str:
+        resp = self._post("/v3/kv/range", {"key": _b64(key)})
+        kvs = resp.get("kvs", [])
+        if not kvs:
+            raise errors.NotExistInStore(key)
+        return _unb64(kvs[0]["value"])
+
+    def delete(self, key: str) -> None:
+        self._post("/v3/kv/deleterange", {"key": _b64(key)})
+
+    def range_prefix(self, prefix: str) -> dict[str, str]:
+        resp = self._post(
+            "/v3/kv/range",
+            {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
+        )
+        out = {_unb64(kv["key"]): _unb64(kv["value"]) for kv in resp.get("kvs", [])}
+        return dict(sorted(out.items()))
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._post(
+            "/v3/kv/deleterange",
+            {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
+        )
+
+    def close(self) -> None:
+        self._session.close()
+
+
+def _b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def _unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+def _prefix_end(prefix: str) -> str:
+    """etcd range_end for a prefix scan: prefix with last byte incremented."""
+    b = bytearray(prefix.encode())
+    for i in reversed(range(len(b))):
+        if b[i] < 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1]).decode(errors="surrogateescape")
+        b.pop()
+    return "\0"  # prefix was all 0xff: scan everything
+
+
+def open_store(backend: str, *, etcd_addr: str = "", sqlite_path: str = "") -> KV:
+    """Open a KV backend by name (config.store_backend)."""
+    if backend == "memory":
+        return MemoryKV()
+    if backend == "sqlite":
+        return SqliteKV(sqlite_path)
+    if backend == "etcd":
+        return EtcdKV(etcd_addr)
+    raise ValueError(f"unknown store backend {backend!r}")

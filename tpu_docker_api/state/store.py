@@ -1,0 +1,76 @@
+"""Typed state store over the KV backends.
+
+The service layer's one stop for persisted specs. Wraps `state.kv.KV` with the
+per-version key layout from `state.keys`, giving the rollback-capable history
+the reference advertises but cannot deliver (SURVEY.md appendix, etcd quirk).
+"""
+
+from __future__ import annotations
+
+import json
+
+from tpu_docker_api import errors
+from tpu_docker_api.schemas.state import ContainerState, VolumeState
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.keys import Resource
+from tpu_docker_api.state.kv import KV
+
+
+class StateStore:
+    def __init__(self, kv: KV) -> None:
+        self.kv = kv
+
+    # -- generic ----------------------------------------------------------------
+
+    def _put(self, resource: Resource, base: str, version: int, payload: dict) -> None:
+        self.kv.put(keys.version_key(resource, base, version), json.dumps(payload))
+        self.kv.put(keys.latest_key(resource, base), str(version))
+
+    def _get(self, resource: Resource, name: str) -> dict:
+        """Fetch by versioned name, or by base name (⇒ latest version)."""
+        base, version = keys.split_versioned_name(name)
+        if version is None:
+            latest = self.kv.get_or(keys.latest_key(resource, base))
+            if latest is None:
+                raise errors.NotExistInStore(name)
+            version = int(latest)
+        raw = self.kv.get_or(keys.version_key(resource, base, version))
+        if raw is None:
+            raise errors.NotExistInStore(name)
+        return json.loads(raw)
+
+    def latest_version(self, resource: Resource, base: str) -> int | None:
+        raw = self.kv.get_or(keys.latest_key(resource, base))
+        return None if raw is None else int(raw)
+
+    def history(self, resource: Resource, base: str) -> list[int]:
+        prefix = f"{keys.PREFIX}/{resource.value}/{base}/v/"
+        return [int(k.rsplit("/", 1)[1]) for k in self.kv.range_prefix(prefix)]
+
+    def delete_family(self, resource: Resource, name: str) -> None:
+        """Drop every version + the latest pointer (delEtcdInfo semantics)."""
+        base, _ = keys.split_versioned_name(name)
+        self.kv.delete_prefix(keys.family_prefix(resource, base))
+
+    def delete_version(self, resource: Resource, name: str) -> None:
+        base, version = keys.split_versioned_name(name)
+        if version is not None:
+            self.kv.delete(keys.version_key(resource, base, version))
+
+    # -- containers -------------------------------------------------------------
+
+    def put_container(self, st: ContainerState) -> None:
+        base, _ = keys.split_versioned_name(st.container_name)
+        self._put(Resource.CONTAINERS, base, st.version, st.to_dict())
+
+    def get_container(self, name: str) -> ContainerState:
+        return ContainerState.from_dict(self._get(Resource.CONTAINERS, name))
+
+    # -- volumes ----------------------------------------------------------------
+
+    def put_volume(self, st: VolumeState) -> None:
+        base, _ = keys.split_versioned_name(st.volume_name)
+        self._put(Resource.VOLUMES, base, st.version, st.to_dict())
+
+    def get_volume(self, name: str) -> VolumeState:
+        return VolumeState.from_dict(self._get(Resource.VOLUMES, name))

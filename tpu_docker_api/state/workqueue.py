@@ -1,0 +1,193 @@
+"""Async reconciliation work queue.
+
+Parity: reference ``internal/workQueue/workQueue.go`` — a buffered channel
+(cap 110) drained by ``SyncLoop`` which type-switches on task kind. Fixes
+applied (SURVEY.md §5.3):
+
+- **bounded retry with exponential backoff** instead of infinite re-enqueue
+  with no backoff (workQueue.go:33-47);
+- **dead-letter list** instead of silent poison-pill spin;
+- **ordered task chains** (``FnTask`` sequences) so data migration can run
+  quiesce→copy→start instead of racing the old container's writes
+  (the reference fires copy async and stops the old container immediately,
+  service/container.go:255-266).
+
+Graceful close drains in-flight tasks (waitgroup semantics, main.go:117-119).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+#: reference channel capacity (workQueue/workQueue.go:12)
+DEFAULT_CAPACITY = 110
+DEFAULT_MAX_RETRIES = 5
+BACKOFF_BASE_S = 0.05
+
+
+@dataclasses.dataclass
+class PutKVTask:
+    """Persist a key/value (reference PutKeyValue, etcd/common.go:34-39)."""
+    key: str
+    value: str
+
+
+@dataclasses.dataclass
+class DelKeyTask:
+    """Delete a key or prefix (reference DelKey, etcd/common.go:41-43)."""
+    key: str
+    prefix: bool = False
+
+
+@dataclasses.dataclass
+class CopyTask:
+    """Copy resource data old→new (reference CopyTask, workQueue/copy.go:19-23).
+
+    Paths are resolved lazily via ``resolve`` at execution time, mirroring the
+    reference's inspect-at-copy-time (copy.go:34-58), so the task tolerates the
+    runtime recreating a resource between enqueue and execution.
+    """
+    resource: str          # "containers" | "volumes", for logs
+    old_name: str
+    new_name: str
+    resolve: Callable[[str], str]  # name → host directory to copy
+    on_done: Callable[[], None] | None = None  # e.g. start the new container
+    on_fail: Callable[[], None] | None = None  # compensation when dead-lettered
+                                               # (e.g. restart the old container)
+
+
+@dataclasses.dataclass
+class FnTask:
+    """Arbitrary ordered work (the reference has no equivalent; used for
+    quiesce→copy→start chains and scheduler state flushes)."""
+    fn: Callable[[], None]
+    description: str = ""
+
+
+Task = PutKVTask | DelKeyTask | CopyTask | FnTask
+
+
+class WorkQueue:
+    def __init__(
+        self,
+        kv,
+        copy_fn: Callable[[str, str], None] | None = None,
+        capacity: int = DEFAULT_CAPACITY,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        backoff_base_s: float = BACKOFF_BASE_S,
+    ) -> None:
+        from tpu_docker_api.utils.files import copy_dir_contents
+
+        self._kv = kv
+        self._copy = copy_fn or copy_dir_contents
+        self._q: queue.Queue[Task | None] = queue.Queue(maxsize=capacity)
+        self._max_retries = max_retries
+        self._backoff_base_s = backoff_base_s
+        self._thread: threading.Thread | None = None
+        self.dead_letters: list[tuple[Task, str]] = []
+
+    # -- producer side -----------------------------------------------------------
+
+    def submit(self, task: Task) -> None:
+        self._q.put(task)
+
+    # -- lifecycle ---------------------------------------------------------------
+
+    def start(self) -> None:
+        """Launch the sync loop thread (reference: go workQueue.SyncLoop,
+        main.go:112)."""
+        self._thread = threading.Thread(
+            target=self._sync_loop, name="workqueue-sync", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        """Drain queued tasks, then stop the loop (reference drains only
+        in-flight tasks and drops queued ones, workQueue.go:20-22 — we do
+        better and finish everything already submitted)."""
+        if self._thread is None:
+            return
+        self._q.put(None)  # sentinel
+        self._thread.join()
+        self._thread = None
+
+    def drain(self) -> None:
+        """Block until everything submitted so far is processed (test hook)."""
+        self._q.join()
+
+    # -- consumer side -----------------------------------------------------------
+
+    def _sync_loop(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                self._q.task_done()
+                return
+            try:
+                self._run_with_retry(task)
+            finally:
+                self._q.task_done()
+
+    def _run_with_retry(self, task: Task) -> None:
+        last_err = ""
+        for attempt in range(self._max_retries):
+            try:
+                self._execute(task)
+                return
+            except Exception as e:  # noqa: BLE001 — queue must never die
+                last_err = f"{type(e).__name__}: {e}"
+                log.warning("workqueue task %r failed (attempt %d/%d): %s",
+                            task, attempt + 1, self._max_retries, last_err)
+                time.sleep(self._backoff_base_s * (2**attempt))
+        log.error("workqueue task %r dead-lettered: %s", task, last_err)
+        self.dead_letters.append((task, last_err))
+        if isinstance(task, CopyTask) and task.on_fail is not None:
+            try:
+                task.on_fail()
+            except Exception:  # noqa: BLE001
+                log.exception("copy-task compensation for %s failed", task.new_name)
+
+    def dead_letter_view(self) -> list[dict]:
+        """Snapshot for the debug endpoint — dead letters must be observable,
+        not an in-memory secret."""
+        return [{"task": repr(t), "error": e} for t, e in self.dead_letters]
+
+    def _execute(self, task: Task) -> None:
+        if isinstance(task, PutKVTask):
+            self._kv.put(task.key, task.value)
+        elif isinstance(task, DelKeyTask):
+            if task.prefix:
+                self._kv.delete_prefix(task.key)
+            else:
+                self._kv.delete(task.key)
+        elif isinstance(task, CopyTask):
+            src = task.resolve(task.old_name)
+            dst = task.resolve(task.new_name)
+            log.info("copying %s data %s -> %s (%s -> %s)",
+                     task.resource, task.old_name, task.new_name, src, dst)
+            self._copy(src, dst)
+            if task.on_done is not None:
+                task.on_done()
+        elif isinstance(task, FnTask):
+            task.fn()
+        else:  # pragma: no cover
+            raise TypeError(f"unknown task type {type(task)}")
+
+
+def queue_depth(wq: WorkQueue) -> int:
+    return wq._q.qsize()
+
+
+def submit_state_put(wq: WorkQueue, key: str, payload: Any) -> None:
+    """Convenience used by services: async JSON persist (reference
+    Queue <- PutKeyValue, service/container.go:528-532)."""
+    import json
+
+    wq.submit(PutKVTask(key=key, value=json.dumps(payload)))

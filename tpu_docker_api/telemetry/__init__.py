@@ -1,0 +1,18 @@
+"""TPU telemetry (parity: the reference's external ``detect-gpu`` NVML sidecar,
+README.md:194-195, consumed at gpuscheduler/scheduler.go:142-158).
+
+Three pieces:
+
+- ``probe``: local chip discovery — ``/dev/accel*`` + ``/sys/class/accel``
+  (optionally through the native C++ shim in ``tpu_native/``);
+- ``sidecar``: the standalone HTTP service exporting
+  ``GET /api/v1/detect/tpu`` (the reference's ``GET /api/v1/detect/gpu``);
+- ``shim``: ctypes binding to the native ``libtpushim.so`` with a pure-Python
+  fallback.
+"""
+
+from tpu_docker_api.telemetry.probe import (  # noqa: F401
+    probe_host_info,
+    probe_local_topology,
+    topology_from_info,
+)

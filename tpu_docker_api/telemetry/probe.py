@@ -1,0 +1,122 @@
+"""Local TPU discovery.
+
+Replaces the NVML path of the reference's detect-gpu sidecar with what a TPU
+host actually exposes: ``/dev/accel*`` device nodes (one per chip) and
+``/sys/class/accel/accel*`` attributes. When the native shim
+(``tpu_native/libtpushim.so``) is built, it supplies chip count and HBM
+telemetry; otherwise a pure-Python walk of the device tree is used.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+from tpu_docker_api.scheduler.topology import (
+    GENERATIONS,
+    HostTopology,
+    default_mesh_shape,
+)
+from tpu_docker_api.schemas.tpu import ChipInfo, HostTopologyInfo
+
+
+def _detect_generation() -> str:
+    """Best-effort generation from env or sysfs; defaults to v5e."""
+    env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    m = re.match(r"(v\d+[a-z]*)", env)
+    if m and m.group(1) in GENERATIONS:
+        return m.group(1)
+    return "v5e"
+
+
+def list_accel_devices() -> list[str]:
+    """Sorted /dev/accel* paths present on this host."""
+    devs = glob.glob("/dev/accel*")
+    return sorted(devs, key=lambda p: int(re.sub(r"\D", "", p) or 0))
+
+
+def probe_host_info() -> HostTopologyInfo | None:
+    """Hardware truth for the sidecar endpoint; None when no TPU present."""
+    devices = list_accel_devices()
+    if not devices:
+        return None
+    gen_name = _detect_generation()
+    gen = GENERATIONS[gen_name]
+    n = len(devices)
+    shape = default_mesh_shape(gen, n)
+
+    shim = None
+    try:
+        from tpu_docker_api.telemetry.shim import load_shim
+
+        shim = load_shim()
+    except Exception:  # pragma: no cover — shim optional
+        shim = None
+
+    chips = []
+    cid = 0
+    for z in range(shape[2]):
+        for y in range(shape[1]):
+            for x in range(shape[0]):
+                if cid >= n:
+                    break
+                hbm_total = hbm_used = 0
+                duty = 0.0
+                pid = _device_holder_pid(devices[cid])
+                if shim is not None:
+                    m = shim.chip_metrics(cid)
+                    hbm_total, hbm_used, duty = m.hbm_total, m.hbm_used, m.duty_cycle
+                if hbm_total == 0:
+                    hbm_total = gen.hbm_bytes_per_chip
+                chips.append(ChipInfo(
+                    chip_id=cid,
+                    device_path=devices[cid],
+                    coords=(x, y, z),
+                    cores_per_chip=gen.cores_per_chip,
+                    hbm_total_bytes=hbm_total,
+                    hbm_used_bytes=hbm_used,
+                    duty_cycle_pct=duty,
+                    pid=pid,
+                ))
+                cid += 1
+    return HostTopologyInfo(
+        accelerator_type=f"{gen_name}-{n * gen.cores_per_chip if gen.cores_per_chip > 1 else n}",
+        generation=gen_name,
+        chips=chips,
+        mesh_shape=shape,
+        libtpu_version=(shim.libtpu_version() if shim else ""),
+    )
+
+
+def _device_holder_pid(dev_path: str) -> int:
+    """Which pid (if any) holds the device node open — the process view the
+    NVML ProcessInfo carried (model/gpu.go:16-28). Scans /proc/*/fd."""
+    try:
+        target = os.stat(dev_path).st_rdev
+    except OSError:
+        return 0
+    for pid_dir in glob.glob("/proc/[0-9]*/fd"):
+        try:
+            for fd in os.listdir(pid_dir):
+                try:
+                    st = os.stat(os.path.join(pid_dir, fd))
+                except OSError:
+                    continue
+                if st.st_rdev == target:
+                    return int(pid_dir.split("/")[2])
+        except OSError:
+            continue
+    return 0
+
+
+def topology_from_info(info: HostTopologyInfo) -> HostTopology:
+    gen = GENERATIONS[info.generation]
+    return HostTopology.from_chips(
+        gen, {c.chip_id: c.coords for c in info.chips}
+    )
+
+
+def probe_local_topology() -> HostTopology | None:
+    info = probe_host_info()
+    return None if info is None else topology_from_info(info)

@@ -1,0 +1,3 @@
+"""Cross-cutting utilities (parity: reference ``utils/``)."""
+
+from tpu_docker_api.utils.files import copy_dir_contents, dir_size, to_bytes  # noqa: F401
