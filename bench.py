@@ -1,0 +1,125 @@
+"""Headline benchmark: Llama training tokens/sec/chip on real TPU hardware.
+
+The reference publishes no benchmark numbers (BASELINE.md — `published: {}`);
+the north-star target from BASELINE.json is MaxText-class Llama throughput at
+≥40% MFU. So ``vs_baseline`` reports **measured MFU / 0.40** — 1.0 means the
+north-star MFU target is met on this chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/s/chip, "unit": ..., "vs_baseline": ...}
+
+Usage:
+  python bench.py                    # full bench on the available accelerator
+  python bench.py --preset tiny --platform cpu   # seconds-fast smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="bench-350m")
+    parser.add_argument("--batch", type=int, default=0, help="0 = auto")
+    parser.add_argument("--seq", type=int, default=0, help="0 = preset default")
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--platform", default="", help="force jax platform")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import dataclasses
+
+    from tpu_docker_api.models.llama import llama_presets, param_count
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.scheduler.topology import GENERATIONS
+    from tpu_docker_api.train.trainer import (
+        create_train_state,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    preset = args.preset
+    devices = jax.devices()[:1]  # tokens/sec **per chip**: bench on one
+    platform = devices[0].platform
+    on_tpu = platform == "tpu"
+    if not on_tpu and preset == "bench-350m":
+        preset = "tiny"  # CPU fallback so the bench runs without hardware
+
+    cfg = llama_presets()[preset]
+    if args.seq:
+        cfg = dataclasses.replace(cfg, max_seq_len=args.seq)
+        seq = args.seq
+    else:
+        seq = min(cfg.max_seq_len, 2048)
+    batch = args.batch or (8 if on_tpu else 2)
+
+    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1), devices=devices)
+    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    n_params = param_count(state.params)
+    step_fn = make_train_step(cfg, mesh, opt)
+
+    tokens = synthetic_batch(jax.random.PRNGKey(1), batch, seq, cfg.vocab_size)
+
+    t_compile = time.perf_counter()
+    for _ in range(max(args.warmup, 1)):  # ≥1: the first step compiles
+        state, metrics = step_fn(state, tokens)
+    jax.block_until_ready(metrics["loss"])
+    compile_s = time.perf_counter() - t_compile
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step_fn(state, tokens)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_s = args.steps / dt
+    tokens_per_s = steps_per_s * batch * seq
+    flops_per_token = cfg.flops_per_token(seq)
+    achieved_flops = tokens_per_s * flops_per_token
+
+    # peak flops for the chip actually benched
+    device_kind = getattr(devices[0], "device_kind", "").lower()
+    peak = None
+    for gen_key, gen in GENERATIONS.items():
+        probe = {"v5e": ("v5 lite", "v5e"), "v5p": ("v5p",), "v4": ("v4",),
+                 "v6e": ("v6", "trillium"), "v3": ("v3",), "v2": ("v2",)}
+        if any(p in device_kind for p in probe.get(gen_key, ())):
+            peak = gen.peak_bf16_flops
+            break
+    if peak is None:
+        peak = GENERATIONS["v5e"].peak_bf16_flops if on_tpu else 1e12
+    mfu = achieved_flops / peak
+
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "preset": preset,
+            "params": n_params,
+            "batch": batch,
+            "seq": seq,
+            "steps_per_sec": round(steps_per_s, 4),
+            "mfu": round(mfu, 4),
+            "model_tflops_per_sec": round(achieved_flops / 1e12, 2),
+            "compile_plus_warmup_s": round(compile_s, 1),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", ""),
+            "final_loss": round(float(metrics["loss"]), 4),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

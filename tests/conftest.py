@@ -1,9 +1,22 @@
-"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax loads,
-so sharding tests exercise a real multi-device mesh without TPU hardware."""
+"""Test bootstrap: force an 8-device virtual CPU platform so sharding tests
+exercise a real multi-device mesh without TPU hardware.
+
+This environment preimports jax via an axon sitecustomize, so exporting
+JAX_PLATFORMS/XLA_FLAGS before pytest is too late (and pre-startup
+JAX_PLATFORMS=cpu hangs the axon plugin registration). The reliable sequence
+is: set XLA_FLAGS in os.environ (the CPU client reads it at backend init),
+then flip the platform with jax.config.update BEFORE any backend use.
+"""
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# the CPU backend's default matmul precision is low; exactness tests
+# (flash vs dense, ring vs dense) need deterministic f32 accumulation
+jax.config.update("jax_default_matmul_precision", "float32")

@@ -1,0 +1,97 @@
+"""Sharded training: state creation, train step on dp/fsdp/tp meshes,
+loss descent, donation."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_docker_api.models.llama import llama_presets
+from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+from tpu_docker_api.train.trainer import (
+    create_train_state,
+    default_optimizer,
+    make_train_step,
+    synthetic_batch,
+)
+
+TINY = llama_presets()["tiny"]
+
+
+@pytest.mark.parametrize("plan", [
+    MeshPlan(dp=8, fsdp=1, tp=1, sp=1),
+    MeshPlan(dp=2, fsdp=2, tp=2, sp=1),
+    MeshPlan(dp=1, fsdp=4, tp=2, sp=1),
+])
+def test_train_step_runs_on_mesh(plan):
+    mesh = build_mesh(plan)
+    state, opt = create_train_state(TINY, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(TINY, mesh, opt)
+    tokens = synthetic_batch(jax.random.PRNGKey(1), 8, 32, TINY.vocab_size)
+    state, metrics = step(state, tokens)
+    assert int(metrics["step"]) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    state, metrics = step(state, tokens)
+    assert int(metrics["step"]) == 2
+
+
+def test_params_actually_sharded():
+    mesh = build_mesh(MeshPlan(dp=1, fsdp=4, tp=2, sp=1))
+    state, _ = create_train_state(TINY, mesh, jax.random.PRNGKey(0))
+    wq = state.params["layers"]["attn"]["wq"]
+    assert len(wq.addressable_shards) == 8
+    # fsdp axis shards dim=1 (64/4=16), tp shards dim=2
+    shard_shape = wq.addressable_shards[0].data.shape
+    assert shard_shape == (TINY.n_layers, TINY.dim // 4,
+                           TINY.n_heads * TINY.head_dim // 2)
+    # adam moments follow param shardings
+    mu = state.opt_state[1][0].mu["layers"]["attn"]["wq"]
+    assert mu.addressable_shards[0].data.shape == shard_shape
+
+
+def test_loss_descends_on_repeated_batch():
+    mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+    state, opt = create_train_state(
+        TINY, mesh, jax.random.PRNGKey(0),
+        optimizer=default_optimizer(lr=1e-2),
+    )
+    step = make_train_step(TINY, mesh, opt)
+    tokens = synthetic_batch(jax.random.PRNGKey(1), 4, 32, TINY.vocab_size)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, tokens)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_dp_equals_single_device_math():
+    """The same batch gives the same loss whether sharded dp=8 or dp=1 —
+    GSPMD must not change the numbers, only the placement."""
+    tokens = synthetic_batch(jax.random.PRNGKey(1), 8, 32, TINY.vocab_size)
+
+    def loss_on(plan, devices=None):
+        mesh = build_mesh(plan, devices=devices)
+        state, opt = create_train_state(TINY, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(TINY, mesh, opt)
+        _, metrics = step(state, tokens)
+        return float(metrics["loss"])
+
+    l_dp = loss_on(MeshPlan(dp=8, fsdp=1, tp=1, sp=1))
+    l_single = loss_on(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                       devices=jax.devices()[:1])
+    np.testing.assert_allclose(l_dp, l_single, rtol=1e-4)
+
+
+def test_train_step_with_ring_attention():
+    """Full train step with the sequence axis sharded (sp=2) and ring
+    attention inside the scanned blocks."""
+    cfg = dataclasses.replace(TINY, attention_impl="ring")
+    mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=2, sp=2))
+    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, opt)
+    # seq must shard over sp: 32 tokens + 1 → train on 32
+    tokens = synthetic_batch(jax.random.PRNGKey(1), 4, 32, cfg.vocab_size)
+    state, metrics = step(state, tokens)
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,16 @@
+"""Model families the control plane provisions (BASELINE.json configs).
+
+The reference ships no models (SURVEY.md §0) — these are the TPU-native
+workloads: the Llama family (pretrain/inference north star) and the MNIST MLP
+(single-chip smoke config #2). Pure-functional JAX: params are nested dicts,
+forward passes are jit/pjit-compatible functions, sharding comes from
+``parallel.sharding`` rules rather than framework metadata.
+"""
+
+from tpu_docker_api.models.llama import (  # noqa: F401
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_presets,
+)
+from tpu_docker_api.models.mlp import mlp_forward, mlp_init  # noqa: F401

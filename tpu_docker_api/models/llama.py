@@ -1,0 +1,213 @@
+"""Llama-family decoder transformer, TPU-first.
+
+Design choices (vs a torch-style port):
+
+- **Stacked layers + lax.scan**: all per-layer weights carry a leading
+  ``n_layers`` dim and the forward scans over them — compile time is O(1) in
+  depth and remat policy applies uniformly (MaxText-style).
+- **bf16 params/activations, f32 where it matters**: norms, softmax, rope and
+  the final logits run in f32; matmuls feed the MXU in bf16.
+- **Sharding by annotation**: ``parallel.sharding.LLAMA_RULES`` map param
+  paths to (fsdp, tp) PartitionSpecs; activations are constrained to
+  (dp+fsdp, sp) — XLA inserts the collectives.
+- **Attention dispatch**: Pallas flash kernel on TPU, dense fallback, ring
+  attention (parallel/ring.py) when the mesh has a real sp axis.
+- **Remat**: each scanned block is wrapped in ``jax.checkpoint`` with a
+  dots-saveable policy, trading FLOPs for HBM as depth grows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_docker_api.ops.attention import multihead_attention
+from tpu_docker_api.ops.norms import rms_norm
+from tpu_docker_api.ops.rope import apply_rope, rope_frequencies
+from tpu_docker_api.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = "auto"  # ops.attention impls, or "ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self, seq_len: int | None = None) -> float:
+        """Training FLOPs/token (fwd+bwd ≈ 3× forward matmul FLOPs) — the
+        MFU numerator used by bench.py."""
+        seq = seq_len or self.max_seq_len
+        d, h = self.dim, self.head_dim
+        per_layer = (
+            2 * d * (self.n_heads * h)          # wq
+            + 2 * 2 * d * (self.n_kv_heads * h)  # wk, wv
+            + 2 * (self.n_heads * h) * d        # wo
+            + 3 * 2 * d * self.ffn_dim          # gate, up, down
+        )
+        embed = 2 * d * self.vocab_size         # lm_head matmul
+        fwd = self.n_layers * per_layer + embed
+        # attention score+value matmuls, causal ⇒ half the k positions
+        attn = self.n_layers * 2 * 2 * seq * (self.n_heads * h) / 2
+        return 3.0 * (fwd + attn)  # fwd + 2x bwd
+
+
+def llama_presets() -> dict[str, LlamaConfig]:
+    return {
+        # parity target: MaxText Llama-3-8B (BASELINE.json north star)
+        "llama3-8b": LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+        ),
+        "llama3-1b": LlamaConfig(
+            vocab_size=128256, dim=2048, n_layers=16, n_heads=32,
+            n_kv_heads=8, ffn_dim=8192, max_seq_len=8192,
+        ),
+        # single-v5e-chip bench config (fits 16GB HBM with optimizer state;
+        # head_dim 128 so the Pallas flash path tiles cleanly on the MXU)
+        "bench-350m": LlamaConfig(
+            vocab_size=32000, dim=1024, n_layers=24, n_heads=8,
+            n_kv_heads=8, ffn_dim=2816, max_seq_len=2048,
+            rope_theta=10000.0,
+        ),
+        # CPU-fast configs for tests / dryrun
+        "tiny": LlamaConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, max_seq_len=128, rope_theta=10000.0, remat=False,
+        ),
+    }
+
+
+def llama_init(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize a parameter pytree (truncated-normal fan-in scaling)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, hd = cfg.dim, cfg.head_dim
+    L = cfg.n_layers
+
+    def init(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in**-0.5)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params = {
+        "embed": {"tokens": init(k_embed, (cfg.vocab_size, d), d)},
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "attn": {
+                "wq": init(ks[0], (L, d, cfg.n_heads * hd), d),
+                "wk": init(ks[1], (L, d, cfg.n_kv_heads * hd), d),
+                "wv": init(ks[2], (L, d, cfg.n_kv_heads * hd), d),
+                "wo": init(ks[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+            },
+            "mlp": {
+                "w_gate": init(ks[4], (L, d, cfg.ffn_dim), d),
+                "w_up": init(ks[5], (L, d, cfg.ffn_dim), d),
+                "w_down": init(ks[6], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+            },
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": init(k_head, (d, cfg.vocab_size), d),
+    }
+    return params
+
+
+def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh):
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    q = (x @ layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = (x @ layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (x @ layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, rope_cos, rope_sin)
+    k = apply_rope(k, rope_cos, rope_sin)
+    if cfg.attention_impl == "ring":
+        from tpu_docker_api.parallel.ring import ring_attention
+
+        out = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        out = multihead_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+    return out.reshape(b, s, cfg.n_heads * hd) @ layer["attn"]["wo"]
+
+
+def _mlp(x, layer):
+    gate = jax.nn.silu(x @ layer["mlp"]["w_gate"])
+    up = x @ layer["mlp"]["w_up"]
+    return (gate * up) @ layer["mlp"]["w_down"]
+
+
+def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh):
+    bspec = P(("dp", "fsdp"), "sp")
+    x = x + _attention(
+        rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg,
+        rope_cos, rope_sin, mesh,
+    )
+    x = constrain(x, mesh, bspec) if mesh is not None else x
+    x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer)
+    x = constrain(x, mesh, bspec) if mesh is not None else x
+    return x
+
+
+def llama_forward(
+    params: dict,
+    tokens: jnp.ndarray,  # (batch, seq) int32
+    cfg: LlamaConfig,
+    mesh: Mesh | None = None,
+) -> jnp.ndarray:
+    """Next-token logits (batch, seq, vocab) in f32."""
+    seq = tokens.shape[1]
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    if mesh is not None:
+        x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+
+    block = functools.partial(
+        _block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin, mesh=mesh
+    )
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    if mesh is not None:
+        logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
+    return logits
+
+
+def llama_loss(
+    params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+    mesh: Mesh | None = None,
+) -> jnp.ndarray:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    logits = llama_forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def param_count(params: dict) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

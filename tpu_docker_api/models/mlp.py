@@ -1,0 +1,34 @@
+"""MNIST MLP — BASELINE.json config #2 (single-chip smoke workload)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def mlp_init(key: jax.Array, sizes=(784, 512, 256, 10), dtype=jnp.float32) -> dict:
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (k, fan_in, fan_out) in enumerate(zip(keys, sizes[:-1], sizes[1:])):
+        params[f"dense_{i}"] = {
+            "w": (jax.random.normal(k, (fan_in, fan_out)) * fan_in**-0.5).astype(dtype),
+            "b": jnp.zeros((fan_out,), dtype),
+        }
+    return params
+
+
+def mlp_forward(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    """(batch, 784) → (batch, 10) logits."""
+    n = len(params)
+    for i in range(n):
+        layer = params[f"dense_{i}"]
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params: dict, x: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    logits = mlp_forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

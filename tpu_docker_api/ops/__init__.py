@@ -1,0 +1,11 @@
+"""TPU-first neural net ops.
+
+The reference control plane ships no kernels (SURVEY.md §2.3) — this package
+is the compute path its provisioned workloads run: fused-friendly pure-JAX ops
+that XLA maps onto the MXU/VPU, plus Pallas TPU kernels for the ops XLA can't
+fuse optimally (flash attention's online softmax).
+"""
+
+from tpu_docker_api.ops.attention import multihead_attention  # noqa: F401
+from tpu_docker_api.ops.norms import rms_norm  # noqa: F401
+from tpu_docker_api.ops.rope import apply_rope, rope_frequencies  # noqa: F401

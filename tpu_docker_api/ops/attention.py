@@ -1,0 +1,72 @@
+"""Multi-head attention dispatcher.
+
+Two implementations behind one call:
+
+- ``dense``: plain einsum attention in f32 — the XLA-fused baseline and the
+  correctness reference (also what runs on CPU test meshes);
+- ``flash``: the Pallas TPU kernel (ops/flash_pallas.py) — O(seq) memory via
+  online softmax.
+
+``impl="auto"`` picks flash on TPU when shapes are tile-aligned, else dense.
+Inputs are (batch, seq, heads, head_dim) — the model's natural layout; the
+flash path transposes to (batch, heads, seq, head_dim) which is the layout
+the kernel tiles over.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense_attention(
+    q: jnp.ndarray,  # (batch, seq, num_heads, head_dim)
+    k: jnp.ndarray,  # (batch, seq, num_kv_heads, head_dim)
+    v: jnp.ndarray,
+    causal: bool,
+) -> jnp.ndarray:
+    batch, seq, num_heads, head_dim = q.shape
+    num_kv = k.shape[2]
+    group = num_heads // num_kv
+    qf = q.astype(jnp.float32) / (head_dim**0.5)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # fold GQA group into the einsum instead of repeating kv
+    qg = qf.reshape(batch, seq, num_kv, group, head_dim)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)
+    if causal:
+        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    return out.reshape(batch, seq, num_heads, head_dim).astype(q.dtype)
+
+
+def multihead_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """(batch, seq, heads, head_dim) attention with GQA support."""
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu"
+        # seq must tile by 128; head_dim 64 works too (Mosaic pads lanes),
+        # and dense would materialize O(seq^2) scores — far worse than padding
+        aligned = q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0
+        impl = "flash" if (on_tpu and aligned) else "dense"
+    if impl == "dense":
+        return _dense_attention(q, k, v, causal)
+    if impl in ("flash", "flash_interpret"):
+        from tpu_docker_api.ops.flash_pallas import flash_attention
+
+        out = flash_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            causal=causal,
+            interpret=(impl == "flash_interpret"),
+        )
+        return out.transpose(0, 2, 1, 3)
+    raise ValueError(f"unknown attention impl {impl!r}")

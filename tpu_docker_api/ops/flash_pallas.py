@@ -1,0 +1,208 @@
+"""Pallas TPU flash attention (causal, GQA-aware).
+
+Online-softmax attention tiled for the MXU: the q block lives in VMEM, k/v are
+walked block-by-block with running (max, sum, acc) statistics in f32, so the
+S×S score matrix never materializes in HBM — the op that XLA's automatic
+fusion cannot produce on its own (it would re-materialize scores for the
+softmax). Layout follows the pallas guide (/opt/skills/guides/pallas_guide.md):
+128-aligned tiles, f32 accumulation via ``preferred_element_type``, causal
+masking with ``broadcasted_iota``, and a dynamic ``fori_loop`` bound so causal
+q blocks skip never-visible k blocks entirely.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int, scale: float,
+    causal: bool,
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, head_dim)
+    head_dim = q.shape[-1]
+    num_k_blocks = k_ref.shape[2] // block_k
+
+    # causal: k blocks strictly after this q block's last row are all masked
+    if causal:
+        k_limit = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+    else:
+        k_limit = num_k_blocks
+
+    def body(kj, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = lax.fori_loop(0, k_limit, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_kernel_kvgrid(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+    block_q: int, block_k: int, scale: float, causal: bool,
+):
+    """kv-blocked variant: the kv axis is the innermost GRID dimension, so
+    only (block_k, head_dim) of k/v ever sits in VMEM — unbounded seq.
+    Accumulators persist across kv grid steps in VMEM scratch."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip blocks where every k position is after every q position
+    visible = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(visible)
+    def _step():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+#: k+v bf16 VMEM budget under which the fori-loop variant (whole kv resident,
+#: causal early-exit) is preferred; above it, the kv-grid variant streams
+_KV_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,  # (batch, num_heads, seq, head_dim)
+    k: jnp.ndarray,  # (batch, num_kv_heads, seq, head_dim)
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Tiled causal attention. seq must divide by the block sizes (the model
+    layer pads to a multiple of 128); head grouping (GQA) is expressed in the
+    k/v BlockSpec index maps, so kv heads are never materially repeated."""
+    batch, num_heads, seq, head_dim = q.shape
+    num_kv_heads = k.shape[1]
+    assert num_heads % num_kv_heads == 0
+    group = num_heads // num_kv_heads
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    assert seq % block_q == 0 and seq % block_k == 0
+
+    scale = 1.0 / (head_dim**0.5)
+    kv_bytes = 2 * seq * head_dim * 2  # k + v, bf16
+    if kv_bytes <= _KV_VMEM_BUDGET_BYTES:
+        # short/medium seq: whole k/v resident, causal rows stop their k loop
+        # early (dynamic fori bound) — no wasted grid steps
+        kernel = functools.partial(
+            _flash_kernel, block_q=block_q, block_k=block_k,
+            scale=scale, causal=causal,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid=(batch, num_heads, seq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, head_dim),
+                             lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, seq, head_dim),
+                             lambda b, h, i, g=group: (b, h // g, 0, 0)),
+                pl.BlockSpec((1, 1, seq, head_dim),
+                             lambda b, h, i, g=group: (b, h // g, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
+                                   lambda b, h, i: (b, h, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=interpret,
+        )(q, k, v)
+
+    # long seq: kv as innermost grid axis, only one (block_k, head_dim) tile
+    # of k/v in VMEM at a time; accumulators live in scratch across kv steps
+    kernel = functools.partial(
+        _flash_kernel_kvgrid, block_q=block_q, block_k=block_k,
+        scale=scale, causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(batch, num_heads, seq // block_q, seq // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, i, j, g=group: (b, h // g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)

@@ -1,0 +1,20 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """RMSNorm (Llama-style, no mean subtraction, no bias).
+
+    Computed in float32 regardless of input dtype — bf16 accumulation of
+    x**2 loses too much precision — then cast back, so XLA fuses the whole
+    thing into neighbouring ops as a single VPU pass.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)

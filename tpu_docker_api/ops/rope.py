@@ -1,0 +1,42 @@
+"""Rotary position embeddings (RoPE).
+
+Split-halves convention (rotate_half), precomputed cos/sin tables: the tables
+are tiny, static-shaped, and XLA folds their application into the surrounding
+QK projections — no gather, no dynamic shapes, MXU-friendly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_seq_len: int, theta: float = 10000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(cos, sin) tables of shape (max_seq_len, head_dim // 2), float32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (seq, head_dim/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # (batch, seq, heads, head_dim)
+    cos: jnp.ndarray,  # (max_seq, head_dim/2)
+    sin: jnp.ndarray,
+    positions: jnp.ndarray | None = None,  # (batch, seq) absolute positions
+) -> jnp.ndarray:
+    """Rotate q/k by position-dependent phases; computed in f32, cast back."""
+    _, seq, _, head_dim = x.shape
+    if positions is None:
+        c = cos[:seq][None, :, None, :]  # (1, seq, 1, hd/2)
+        s = sin[:seq][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]  # (batch, seq, 1, hd/2)
+        s = sin[positions][:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : head_dim // 2], xf[..., head_dim // 2:]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

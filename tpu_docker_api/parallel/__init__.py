@@ -1,0 +1,21 @@
+"""Parallelism layer: device meshes, sharding rules, ring collectives.
+
+The reference has no parallelism concepts at all (SURVEY.md §2.3) — this
+package supplies the strategies its TPU-provisioned jobs need, the GSPMD way:
+declare a mesh + named shardings, let XLA insert the collectives over ICI.
+
+Axes:
+- ``dp``   — pure data parallel (gradients all-reduced),
+- ``fsdp`` — data parallel with parameter/optimizer sharding (ZeRO-3 style:
+  params are all-gathered per layer, grads reduce-scattered),
+- ``tp``   — tensor parallel (megatron-style column/row splits),
+- ``sp``   — sequence/context parallel (ring attention over ICI).
+"""
+
+from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh  # noqa: F401
+from tpu_docker_api.parallel.ring import ring_attention  # noqa: F401
+from tpu_docker_api.parallel.sharding import (  # noqa: F401
+    batch_spec,
+    param_specs,
+    param_shardings,
+)

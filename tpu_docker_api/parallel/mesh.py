@@ -1,0 +1,65 @@
+"""Device mesh construction.
+
+Wraps ``jax.sharding.Mesh`` with a plan object that knows which axes exist and
+how large each is, so models/training code never hard-codes axis sizes. Mesh
+axes map onto the physical ICI mesh via ``mesh_utils.create_device_mesh``
+(which optimizes adjacency for TPU topologies), the control-plane analog being
+the slice allocator's contiguous placement (scheduler/slices.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Axis sizes; -1 on dp means 'absorb remaining devices'."""
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        known = [s for s in (self.dp, self.fsdp, self.tp, self.sp) if s != -1]
+        prod = int(np.prod(known)) if known else 1
+        if self.dp == -1:
+            if n_devices % prod:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*tp*sp={prod}"
+                )
+            return (n_devices // prod, self.fsdp, self.tp, self.sp)
+        if prod != n_devices:
+            raise ValueError(
+                f"mesh plan {self} needs {prod} devices, have {n_devices}"
+            )
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+
+def build_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
+    """Build a (dp, fsdp, tp, sp) mesh over ``devices`` (default: all).
+
+    ``create_device_mesh`` lays logical axes onto the physical topology so the
+    innermost axes (tp, sp) land on adjacent chips — the collectives that ride
+    them are the latency-sensitive ones.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    plan = plan or MeshPlan()
+    shape = plan.resolve(len(devices))
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        # non-TPU or odd shapes: plain reshape keeps things working
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)

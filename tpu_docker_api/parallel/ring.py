@@ -1,0 +1,111 @@
+"""Ring attention: exact causal attention over sequence-parallel shards.
+
+Long-context strategy (SURVEY.md §5.7): the sequence axis is sharded over the
+``sp`` mesh axis; each device holds a q chunk and rotates the k/v chunks
+around the ICI ring with ``lax.ppermute``, maintaining online-softmax
+statistics (same math as the Pallas flash kernel, ops/flash_pallas.py) so the
+result is EXACT — not an approximation — while no device ever holds more than
+seq/sp of k/v. Communication rides the ring one neighbour at a time, which
+XLA overlaps with the per-block matmuls.
+
+Causal blocks that can never attend (k chunk entirely after the q chunk) are
+skipped via ``jnp.where`` masking, keeping control flow static for XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-device body under shard_map. Shapes are the local chunks."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    h_kv = k.shape[2]
+    group = h // h_kv
+
+    qf = q.astype(jnp.float32) * scale
+    qg = qf.reshape(b, sq, h_kv, group, d)
+
+    acc0 = jnp.zeros((b, h_kv, group, sq, d), jnp.float32)
+    m0 = jnp.full((b, h_kv, group, sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h_kv, group, sq, 1), jnp.float32)
+
+    def accumulate(step, carry, k_blk, v_blk):
+        """Online-softmax update against the chunk currently held, which
+        originated on device (my_idx - step) mod n."""
+        acc, m_prev, l_prev = carry
+        src_idx = (my_idx - step) % n
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)
+        if causal:
+            rows = my_idx * sq + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            cols = src_idx * sk + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            s = jnp.where((rows >= cols)[None, None, None], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhgqk,bkhd->bhgqd", p, vf)
+        return acc_new, m_new, l_new
+
+    def body(step, carry):
+        acc, m_prev, l_prev, k_blk, v_blk = carry
+        new = accumulate(step, (acc, m_prev, l_prev), k_blk, v_blk)
+        # rotate k/v to the next device on the ring (device i -> i+1), so at
+        # step s we hold the chunk originally on (my_idx - s) mod n
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (*new, k_next, v_next)
+
+    # n-1 (compute, rotate) rounds, then a final compute with no rotation —
+    # the last chunk's ppermute would be pure wasted ICI traffic
+    acc, m, l, k_last, v_last = lax.fori_loop(0, n - 1, body, (acc0, m0, l0, k, v))
+    acc, m, l = accumulate(n - 1, (acc, m, l), k_last, v_last)
+    out = acc / jnp.maximum(l, 1e-30)  # (b, h_kv, g, sq, d)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, d).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,  # (batch, seq, num_heads, head_dim), seq sharded on sp
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Exact causal attention with the sequence axis sharded over ``sp``.
+
+    Batch rides (dp, fsdp) and heads ride tp, composing with the other
+    parallelism axes; only the seq-axis communication is explicit here.
+    """
+    head_dim = q.shape[-1]
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    local = functools.partial(
+        _ring_attn_local,
+        axis_name=axis_name,
+        causal=causal,
+        scale=1.0 / (head_dim**0.5),
+    )
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        fn = shard_map(local, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover — older jax
+        fn = shard_map(local, check_rep=False, **kwargs)
+    return fn(q, k, v)

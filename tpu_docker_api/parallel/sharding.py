@@ -1,0 +1,87 @@
+"""Sharding rules: parameter-path patterns → PartitionSpec.
+
+The t5x/MaxText "logical axis rules" idea (SNIPPETS.md [3]) reduced to its
+useful core: params live in a nested dict; each leaf's spec is chosen by the
+last matching (suffix-pattern → spec) rule. Megatron layout: column-parallel
+weights shard their output dim on ``tp``, row-parallel their input dim on
+``tp``; every weight additionally shards a non-tp dim on ``fsdp`` (ZeRO-3).
+XLA turns these annotations into all-gathers/reduce-scatters on ICI.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: (glob over "path/like/this", PartitionSpec). First match wins. Layer-
+#: stacked params (models/llama.py scans over a leading n_layers dim) get a
+#: leading None so the scan axis is never sharded.
+LLAMA_RULES: list[tuple[str, P]] = [
+    ("embed/tokens",        P("tp", "fsdp")),            # (vocab, d)
+    ("layers/attn/wq",      P(None, "fsdp", "tp")),      # (L, d, qh*hd) column
+    ("layers/attn/wk",      P(None, "fsdp", "tp")),      # (L, d, kvh*hd) column
+    ("layers/attn/wv",      P(None, "fsdp", "tp")),
+    ("layers/attn/wo",      P(None, "tp", "fsdp")),      # (L, qh*hd, d) row
+    ("layers/mlp/w_gate",   P(None, "fsdp", "tp")),      # (L, d, ff) column
+    ("layers/mlp/w_up",     P(None, "fsdp", "tp")),
+    ("layers/mlp/w_down",   P(None, "tp", "fsdp")),      # (L, ff, d) row
+    ("*norm*",              P()),                        # replicated vectors
+    ("lm_head",             P("fsdp", "tp")),            # (d, vocab)
+    ("*",                   P()),                        # fallback: replicate
+]
+
+
+def flatten_paths(params: dict, prefix: str = "") -> dict[str, Any]:
+    out = {}
+    for k, v in params.items():
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(flatten_paths(v, path))
+        else:
+            out[path] = v
+    return out
+
+
+def spec_for(path: str, rules: list[tuple[str, P]] | None = None) -> P:
+    for pattern, spec in rules or LLAMA_RULES:
+        if fnmatch.fnmatch(path, pattern):
+            return spec
+    return P()
+
+
+def param_specs(params: dict, rules: list[tuple[str, P]] | None = None):
+    """Pytree of PartitionSpec matching ``params``' structure."""
+
+    def walk(subtree: dict, prefix: str):
+        out = {}
+        for k, v in subtree.items():
+            path = f"{prefix}/{k}" if prefix else k
+            out[k] = walk(v, path) if isinstance(v, dict) else spec_for(path, rules)
+        return out
+
+    return walk(params, "")
+
+
+def param_shardings(params: dict, mesh: Mesh,
+                    rules: list[tuple[str, P]] | None = None):
+    """Pytree of NamedSharding for ``jax.device_put`` / pjit in_shardings."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(params, rules),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_spec() -> P:
+    """Activations (batch, seq, ...): batch over dp+fsdp, seq over sp."""
+    return P(("dp", "fsdp"), "sp")
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint that is a no-op outside a mesh context."""
+    if mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

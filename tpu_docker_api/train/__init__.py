@@ -1,0 +1,7 @@
+"""Training loop layer: sharded train step, checkpointing, data."""
+
+from tpu_docker_api.train.trainer import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_train_step,
+)
