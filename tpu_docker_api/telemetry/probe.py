@@ -63,10 +63,14 @@ def probe_host_info() -> HostTopologyInfo | None:
                     break
                 hbm_total = hbm_used = 0
                 duty = 0.0
-                pid = _device_holder_pid(devices[cid])
                 if shim is not None:
+                    # native shim supplies everything incl. the holder pid —
+                    # avoid a second /proc walk from Python
                     m = shim.chip_metrics(cid)
                     hbm_total, hbm_used, duty = m.hbm_total, m.hbm_used, m.duty_cycle
+                    pid = m.pid
+                else:
+                    pid = _device_holder_pid(devices[cid])
                 if hbm_total == 0:
                     hbm_total = gen.hbm_bytes_per_chip
                 chips.append(ChipInfo(

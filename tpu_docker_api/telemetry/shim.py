@@ -1,0 +1,96 @@
+"""ctypes binding to the native telemetry shim (tpu_native/libtpushim.so).
+
+The C++ shim is the NVML-analog native component (SURVEY.md §2.2): device
+enumeration, per-chip HBM/duty metrics, libtpu version probing. This binding
+loads it lazily and raises if absent — callers (telemetry.probe) fall back to
+the pure-Python walk, so the control plane works unbuilt, just with less
+telemetry.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import functools
+import os
+
+
+@dataclasses.dataclass
+class ChipMetrics:
+    chip_id: int
+    device_path: str
+    hbm_total: int
+    hbm_used: int
+    duty_cycle: float
+    pid: int
+
+
+class _CChipMetrics(ctypes.Structure):
+    _fields_ = [
+        ("chip_id", ctypes.c_int32),
+        ("device_path", ctypes.c_char * 64),
+        ("hbm_total_bytes", ctypes.c_int64),
+        ("hbm_used_bytes", ctypes.c_int64),
+        ("duty_cycle_pct", ctypes.c_double),
+        ("pid", ctypes.c_int32),
+    ]
+
+
+class TpuShim:
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.tpushim_chip_count.restype = ctypes.c_int32
+        lib.tpushim_chip_metrics.restype = ctypes.c_int32
+        lib.tpushim_chip_metrics.argtypes = [
+            ctypes.c_int32, ctypes.POINTER(_CChipMetrics)
+        ]
+        lib.tpushim_libtpu_version.restype = ctypes.c_int32
+        lib.tpushim_libtpu_version.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32
+        ]
+        abi = lib.tpushim_abi_version()
+        if abi != 1:
+            raise RuntimeError(f"libtpushim ABI mismatch: {abi}")
+
+    def chip_count(self) -> int:
+        return int(self._lib.tpushim_chip_count())
+
+    def chip_metrics(self, index: int) -> ChipMetrics:
+        raw = _CChipMetrics()
+        rc = self._lib.tpushim_chip_metrics(index, ctypes.byref(raw))
+        if rc != 0:
+            raise IndexError(f"no TPU chip {index}")
+        return ChipMetrics(
+            chip_id=int(raw.chip_id),
+            device_path=raw.device_path.decode(),
+            hbm_total=int(raw.hbm_total_bytes),
+            hbm_used=int(raw.hbm_used_bytes),
+            duty_cycle=float(raw.duty_cycle_pct),
+            pid=int(raw.pid),
+        )
+
+    def libtpu_version(self, libtpu_path: str = "") -> str:
+        buf = ctypes.create_string_buffer(256)
+        rc = self._lib.tpushim_libtpu_version(libtpu_path.encode(), buf, 256)
+        return buf.value.decode() if rc == 0 else ""
+
+
+_SHIM_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "tpu_native",
+                 "libtpushim.so"),
+    "/usr/local/lib/libtpushim.so",
+    "libtpushim.so",
+)
+
+
+@functools.lru_cache(maxsize=1)
+def load_shim() -> TpuShim:
+    """Load the native shim; raises OSError when not built/installed."""
+    last: Exception | None = None
+    for path in _SHIM_PATHS:
+        try:
+            return TpuShim(ctypes.CDLL(os.path.abspath(path)
+                                       if os.path.sep in path else path))
+        except OSError as e:
+            last = e
+    raise OSError(f"libtpushim.so not found ({last}); run make -C tpu_native")

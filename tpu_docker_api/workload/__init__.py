@@ -1,0 +1,8 @@
+"""Workload layer: what the control plane injects into containers so the JAX
+job inside finds its slice, its peers, and its mesh (SURVEY.md §5.8)."""
+
+from tpu_docker_api.workload.jaxenv import (  # noqa: F401
+    DistributedJob,
+    render_distributed_env,
+    render_job_specs,
+)

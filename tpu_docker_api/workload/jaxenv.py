@@ -1,0 +1,109 @@
+"""JAX distributed-environment rendering.
+
+The reference's only cross-container duty is port mapping
+(service/container.go:489-501); a TPU control plane must also render the
+distributed bootstrap so N containers initialize one JAX job over ICI/DCN
+(SURVEY.md §2.3 "Communication backend" row):
+
+- ``JAX_COORDINATOR_ADDRESS`` + ``JAX_NUM_PROCESSES`` + ``JAX_PROCESS_ID`` —
+  consumed by ``jax.distributed.initialize`` inside the container;
+- ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` /
+  ``TPU_PROCESS_ADDRESSES`` / ``TPU_PROCESS_PORT`` / ``CLOUD_TPU_TASK_ID`` —
+  consumed by libtpu to assemble the slice mesh from per-process chip subsets.
+
+Within one host ICI does the transport; across hosts the coordinator address
+rides DCN. The coordinator's host port comes from the port scheduler — the
+TPU analog of the reference's host-port rendering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.scheduler.topology import HostTopology
+
+
+@dataclasses.dataclass
+class ProcessPlacement:
+    """One JAX process (= one container) in a distributed job."""
+    process_id: int
+    host: str                 # routable address of the host running it
+    chip_ids: list[int]       # host-local chips handed to this process
+    tpu_process_port: int     # libtpu mesh port (host side)
+
+
+@dataclasses.dataclass
+class DistributedJob:
+    """A placement of N processes forming one JAX job."""
+    name: str
+    placements: list[ProcessPlacement]
+    coordinator_port: int
+
+    @property
+    def coordinator_address(self) -> str:
+        return f"{self.placements[0].host}:{self.coordinator_port}"
+
+
+def _process_bounds(n_processes: int) -> str:
+    """Arrange processes on a 1D DCN axis: "n,1,1" — the safe default that
+    matches any chips-per-process shape; topology-shaped bounds are an
+    optimization the scheduler can layer on later."""
+    return f"{n_processes},1,1"
+
+
+def render_distributed_env(job: DistributedJob, placement: ProcessPlacement) -> list[str]:
+    """The JAX-side (DCN bootstrap) env for ONE process of the job; the
+    libtpu-side TPU_* vars come from runtime.spec.render_tpu_attachment."""
+    return [
+        f"JAX_COORDINATOR_ADDRESS={job.coordinator_address}",
+        f"JAX_NUM_PROCESSES={len(job.placements)}",
+        f"JAX_PROCESS_ID={placement.process_id}",
+    ]
+
+
+def render_job_specs(
+    job: DistributedJob,
+    topology: HostTopology,
+    image: str,
+    cmd: list[str],
+    base_env: list[str] | None = None,
+    libtpu_path: str = "",
+) -> list[ContainerSpec]:
+    """ContainerSpecs for every process of a distributed job — what the
+    service layer submits to the runtime, one container per process
+    (BASELINE.json config #4: scheduler places GSPMD DP ranks).
+
+    Device mounts + TPU_* env come from the one renderer the container flows
+    already use (runtime.spec.render_tpu_attachment), so patches stay
+    idempotent; the coordinator and libtpu mesh ports are published as real
+    PortBindings so bridge-networked containers are reachable.
+    """
+    from tpu_docker_api.runtime.spec import PortBinding, render_tpu_attachment
+
+    peers = [f"{p.host}:{p.tpu_process_port}" for p in job.placements]
+    specs = []
+    for p in job.placements:
+        spec = ContainerSpec(
+            name=f"{job.name}-p{p.process_id}",
+            image=image,
+            cmd=list(cmd),
+            env=list(base_env or []) + render_distributed_env(job, p),
+            port_bindings=[
+                PortBinding(p.tpu_process_port, p.tpu_process_port)
+            ],
+        )
+        if p.process_id == 0:
+            spec.port_bindings.append(
+                PortBinding(job.coordinator_port, job.coordinator_port)
+            )
+        render_tpu_attachment(
+            spec, sorted(p.chip_ids), topology,
+            libtpu_path=libtpu_path,
+            process_bounds=_process_bounds(len(job.placements)),
+            task_id=p.process_id,
+            process_addresses=peers,
+            process_port=p.tpu_process_port,
+        )
+        specs.append(spec)
+    return specs

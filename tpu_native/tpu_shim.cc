@@ -1,0 +1,160 @@
+// libtpushim — native TPU host telemetry shim.
+//
+// The TPU-native replacement for the NVML C library behind the reference's
+// detect-gpu sidecar (SURVEY.md §2.2 row 1): enumerates /dev/accel* device
+// nodes and /sys/class/accel attributes, reports per-chip HBM + duty-cycle
+// telemetry, and (when a libtpu.so is present) dlopen()s it for its version
+// string — all behind a minimal C ABI consumed from Python via ctypes
+// (tpu_docker_api/telemetry/shim.py). No JAX, no Python, no allocations
+// shared across the ABI except caller-owned structs.
+//
+// Build: make -C tpu_native   (produces libtpushim.so)
+
+#include <dirent.h>
+#include <dlfcn.h>
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+struct ChipMetrics {
+  int32_t chip_id;
+  char device_path[64];
+  int64_t hbm_total_bytes;
+  int64_t hbm_used_bytes;
+  double duty_cycle_pct;
+  int32_t pid;  // pid holding the device node open, 0 if free
+};
+
+}  // extern "C"
+
+namespace {
+
+// Sorted list of /dev/accel<N> paths.
+std::vector<std::string> ListAccelDevices() {
+  std::vector<std::string> out;
+  DIR* dev = opendir("/dev");
+  if (dev == nullptr) return out;
+  while (dirent* e = readdir(dev)) {
+    if (strncmp(e->d_name, "accel", 5) == 0 &&
+        isdigit(static_cast<unsigned char>(e->d_name[5]))) {
+      out.push_back(std::string("/dev/") + e->d_name);
+    }
+  }
+  closedir(dev);
+  std::sort(out.begin(), out.end(), [](const std::string& a, const std::string& b) {
+    return strtol(a.c_str() + 10, nullptr, 10) < strtol(b.c_str() + 10, nullptr, 10);
+  });
+  return out;
+}
+
+// Read a small integer file like /sys/class/accel/accel0/device/mem_total.
+int64_t ReadInt64File(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (f == nullptr) return 0;
+  long long v = 0;
+  if (fscanf(f, "%lld", &v) != 1) v = 0;
+  fclose(f);
+  return static_cast<int64_t>(v);
+}
+
+// Which pid (if any) has this device node open: scan /proc/<pid>/fd/* and
+// compare st_rdev — the process attribution NVML's ProcessInfo carried.
+int32_t DeviceHolderPid(const std::string& dev_path) {
+  struct stat dev_st;
+  if (stat(dev_path.c_str(), &dev_st) != 0) return 0;
+  DIR* proc = opendir("/proc");
+  if (proc == nullptr) return 0;
+  int32_t holder = 0;
+  while (dirent* e = readdir(proc)) {
+    if (!isdigit(static_cast<unsigned char>(e->d_name[0]))) continue;
+    std::string fd_dir = std::string("/proc/") + e->d_name + "/fd";
+    DIR* fds = opendir(fd_dir.c_str());
+    if (fds == nullptr) continue;
+    while (dirent* fe = readdir(fds)) {
+      if (fe->d_name[0] == '.') continue;
+      struct stat st;
+      if (stat((fd_dir + "/" + fe->d_name).c_str(), &st) == 0 &&
+          S_ISCHR(st.st_mode) && st.st_rdev == dev_st.st_rdev) {
+        holder = static_cast<int32_t>(strtol(e->d_name, nullptr, 10));
+        break;
+      }
+    }
+    closedir(fds);
+    if (holder != 0) break;
+  }
+  closedir(proc);
+  return holder;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of TPU chips visible on this host (device nodes).
+int32_t tpushim_chip_count() {
+  return static_cast<int32_t>(ListAccelDevices().size());
+}
+
+// Fill metrics for chip `index` (0-based). Returns 0 on success, -1 if the
+// chip does not exist. HBM totals come from the accel sysfs when the driver
+// exports them; 0 means "unknown — caller substitutes the generation table".
+int32_t tpushim_chip_metrics(int32_t index, ChipMetrics* out) {
+  std::vector<std::string> devices = ListAccelDevices();
+  if (index < 0 || index >= static_cast<int32_t>(devices.size()) || out == nullptr) {
+    return -1;
+  }
+  const std::string& path = devices[index];
+  memset(out, 0, sizeof(*out));
+  out->chip_id = index;
+  snprintf(out->device_path, sizeof(out->device_path), "%s", path.c_str());
+
+  // accel class sysfs (vfio-pc/accel drivers export varying subsets)
+  std::string accel_name = path.substr(5);  // "accelN"
+  std::string sys_base = "/sys/class/accel/" + accel_name + "/device/";
+  out->hbm_total_bytes = ReadInt64File(sys_base + "hbm_total");
+  out->hbm_used_bytes = ReadInt64File(sys_base + "hbm_used");
+  int64_t duty = ReadInt64File(sys_base + "duty_cycle_pct");
+  out->duty_cycle_pct = static_cast<double>(duty);
+  out->pid = DeviceHolderPid(path);
+  return 0;
+}
+
+// libtpu version string via dlopen, "" when unavailable. The result buffer is
+// caller-owned; truncates at len.
+int32_t tpushim_libtpu_version(const char* libtpu_path, char* out, int32_t len) {
+  if (out == nullptr || len <= 0) return -1;
+  out[0] = '\0';
+  const char* path = (libtpu_path != nullptr && libtpu_path[0] != '\0')
+                         ? libtpu_path
+                         : "libtpu.so";
+  void* handle = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
+  if (handle == nullptr) return -1;
+  // TpuDriver/PJRT builds export one of these version hooks
+  using VersionFn = const char* (*)();
+  for (const char* sym : {"TpuDriver_Version", "PJRT_Plugin_Version",
+                          "TpuVersion"}) {
+    if (auto fn = reinterpret_cast<VersionFn>(dlsym(handle, sym))) {
+      snprintf(out, static_cast<size_t>(len), "%s", fn());
+      dlclose(handle);
+      return 0;
+    }
+  }
+  snprintf(out, static_cast<size_t>(len), "present(unversioned)");
+  dlclose(handle);
+  return 0;
+}
+
+// ABI version for the ctypes binding to sanity-check.
+int32_t tpushim_abi_version() { return 1; }
+
+}  // extern "C"
