@@ -118,6 +118,16 @@ class TestJaxEnv:
         assert env["CLOUD_TPU_TASK_ID"] == "1"
         assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
 
+    def test_coordinator_address_tracks_process_0_not_list_order(self):
+        placements = [
+            ProcessPlacement(1, "10.0.0.2", [0, 1, 2, 3], 8476),
+            ProcessPlacement(0, "10.0.0.1", [0, 1, 2, 3], 8476),
+        ]
+        job = DistributedJob("train", placements, coordinator_port=40000)
+        # process 0 publishes the coordinator port; the address must be its
+        # host even when it is not placements[0]
+        assert job.coordinator_address == "10.0.0.1:40000"
+
     def test_job_specs(self):
         topo = HostTopology.build("v5e-8")
         specs = render_job_specs(

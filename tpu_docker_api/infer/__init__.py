@@ -1,0 +1,17 @@
+"""Inference: KV-cached prefill/decode + samplers (BASELINE config #3).
+
+The reference provisions opaque containers and has no serving path
+(SURVEY.md §2.3); here the inference engine for the in-tree model family is
+part of the framework: static-shape KV cache, jitted prefill, scanned decode,
+tp/dp-sharded serving on the same mesh machinery as training.
+"""
+
+from tpu_docker_api.infer.engine import (  # noqa: F401
+    GenerateConfig,
+    KVCache,
+    decode_one,
+    init_kv_cache,
+    make_generate_fn,
+    prefill_and_first_token,
+)
+from tpu_docker_api.infer.sampling import make_sampler  # noqa: F401

@@ -1,0 +1,214 @@
+"""Llama inference engine: KV-cached prefill + decode.
+
+This is the serving half of the BASELINE configs (config #3: Llama inference
+on an API-provisioned slice). TPU-first shape of the design:
+
+- **One compiled program per phase**: prefill (prompt → cache + first token)
+  and decode (one token per step) are each jitted once; the decode loop is a
+  ``lax.scan`` over steps, so the whole generation is a single XLA program —
+  no per-token dispatch from Python.
+- **Static shapes**: the KV cache is a fixed ``(layers, batch, max_seq, kv,
+  hd)`` buffer; ``start_pos`` is a traced scalar, masking handles validity.
+  Nothing reshapes between steps, so XLA keeps buffers in place.
+- **Sharded serving**: cache kv-heads shard over ``tp``, batch over
+  ``dp``+``fsdp`` — same mesh/rules machinery as training
+  (parallel/sharding.py); XLA inserts the collectives.
+- **bf16 cache**: decode is HBM-bandwidth-bound; halving cache bytes ≈
+  doubles decode throughput at the memory roof.
+
+The reference has no inference path at all (SURVEY.md §2.3) — its containers
+are opaque. Here the model family the control plane provisions is in-tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_docker_api.models.llama import (
+    LlamaConfig,
+    llama_forward_cached,
+)
+from tpu_docker_api.infer.sampling import make_sampler
+
+#: cache layout: (layer, batch, seq, kv_head, head_dim)
+CACHE_SPEC = P(None, ("dp", "fsdp"), None, "tp", None)
+
+
+@dataclasses.dataclass
+class KVCache:
+    k: jnp.ndarray  # (n_layers, batch, max_seq, n_kv_heads, head_dim)
+    v: jnp.ndarray
+
+
+jax.tree_util.register_pytree_node(
+    KVCache,
+    lambda c: ((c.k, c.v), None),
+    lambda _, kids: KVCache(*kids),
+)
+
+
+def init_kv_cache(
+    cfg: LlamaConfig,
+    batch: int,
+    max_seq: int | None = None,
+    mesh: Mesh | None = None,
+    dtype: Any = jnp.bfloat16,
+) -> KVCache:
+    """Zero-filled cache, allocated directly into its shards when a mesh is
+    given (never materialized replicated on one device)."""
+    max_seq = max_seq or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    if mesh is not None and not mesh.empty:
+        sharding = NamedSharding(mesh, CACHE_SPEC)
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+        )
+        with mesh:
+            k, v = zeros(), zeros()
+    else:
+        k, v = jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+    return KVCache(k=k, v=v)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateConfig:
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: int | None = None
+    pad_id: int = 0
+    max_seq: int | None = None  # cache capacity; default model max_seq_len
+
+
+def make_generate_fn(
+    cfg: LlamaConfig,
+    gen: GenerateConfig,
+    mesh: Mesh | None = None,
+) -> Callable:
+    """Build a jitted ``(params, prompt (b, s) int32, key) → dict`` generator.
+
+    Returns {"tokens": (b, max_new_tokens), "lengths": (b,)} where lengths
+    counts emitted tokens up to and including eos (rows that never hit eos
+    have length == max_new_tokens). Positions after eos hold pad_id.
+
+    Prompts are dense (b, s): every row uses the full s prompt tokens.
+    Ragged batches should be right-aligned/padded by the caller before entry
+    (left-pad with pad_id and drop the padded columns' logits — standard
+    serving practice) so the cache write stays a single dynamic slice.
+    """
+    if gen.max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {gen.max_new_tokens}"
+        )
+    sampler = make_sampler(gen.temperature, gen.top_k, gen.top_p)
+
+    def _sample_step(logits_last, key, done):
+        tok = sampler(logits_last, key)
+        tok = jnp.where(done, jnp.int32(gen.pad_id), tok)
+        if gen.eos_id is not None:
+            done = done | (tok == gen.eos_id)
+        return tok, done
+
+    def generate(params: dict, prompt: jnp.ndarray, key: jax.Array) -> dict:
+        b, prompt_len = prompt.shape
+        max_seq = gen.max_seq or cfg.max_seq_len
+        # last written cache slot is prompt_len + max_new_tokens - 2 (the
+        # final sampled token is never fed back); past capacity the dynamic
+        # slice writes CLAMP and silently corrupt — fail at trace time instead
+        if prompt_len + gen.max_new_tokens - 1 > max_seq:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens "
+                f"({gen.max_new_tokens}) exceeds cache capacity {max_seq}"
+            )
+        cache = init_kv_cache(cfg, b, max_seq, mesh=None)  # inside jit: traced
+
+        # ---- prefill: whole prompt in one pass, logits for the LAST
+        # position only (skips the (b, prompt, vocab) f32 intermediate)
+        logits, k_cache, v_cache = llama_forward_cached(
+            params, prompt, cfg, cache.k, cache.v,
+            jnp.int32(0), mesh, last_only=True,
+        )
+        done = jnp.zeros((b,), bool)
+        key, sub = jax.random.split(key)
+        tok, done = _sample_step(logits[:, -1], sub, done)
+
+        # ---- decode: one token per scan step, single compiled body
+        def body(carry, step_key):
+            k_cache, v_cache, pos, tok, done = carry
+            logits, k_cache, v_cache = llama_forward_cached(
+                params, tok[:, None], cfg, k_cache, v_cache, pos, mesh
+            )
+            next_tok, done = _sample_step(logits[:, -1], step_key, done)
+            return (k_cache, v_cache, pos + 1, next_tok, done), next_tok
+
+        steps = gen.max_new_tokens - 1
+        step_keys = jax.random.split(key, max(steps, 1))
+        if steps > 0:
+            carry = (k_cache, v_cache, jnp.int32(prompt_len), tok, done)
+            (_, _, _, _, done), rest = lax.scan(body, carry, step_keys[:steps])
+            tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
+        else:
+            tokens = tok[:, None]
+
+        if gen.eos_id is not None:
+            # length = index of first eos + 1, else max_new_tokens
+            is_eos = tokens == gen.eos_id
+            any_eos = jnp.any(is_eos, axis=1)
+            first_eos = jnp.argmax(is_eos, axis=1)
+            lengths = jnp.where(any_eos, first_eos + 1, tokens.shape[1])
+        else:
+            lengths = jnp.full((b,), tokens.shape[1], jnp.int32)
+        return {"tokens": tokens, "lengths": lengths.astype(jnp.int32)}
+
+    if mesh is not None and not mesh.empty:
+        prompt_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+        jitted = jax.jit(generate)
+
+        def run(params, prompt, key):
+            prompt = jax.device_put(prompt, prompt_sharding)
+            with mesh:
+                return jitted(params, prompt, key)
+
+        return run
+    return jax.jit(generate)
+
+
+def prefill_and_first_token(
+    params: dict,
+    prompt: jnp.ndarray,
+    cfg: LlamaConfig,
+    cache: KVCache,
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Standalone prefill for callers that drive decode themselves (serving
+    loops with continuous batching): greedy first token + filled cache."""
+    logits, k, v = llama_forward_cached(
+        params, prompt, cfg, cache.k, cache.v, jnp.int32(0), mesh,
+        last_only=True,
+    )
+    tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    return tok, KVCache(k=k, v=v)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+def decode_one(
+    params: dict,
+    tok: jnp.ndarray,        # (batch,) int32
+    pos: jnp.ndarray,        # scalar int32
+    cache: KVCache,
+    cfg: LlamaConfig,
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Single greedy decode step — the building block for external loops."""
+    logits, k, v = llama_forward_cached(
+        params, tok[:, None], cfg, cache.k, cache.v, pos, mesh
+    )
+    return logits[:, -1], KVCache(k=k, v=v)

@@ -1,0 +1,68 @@
+"""Token samplers — jit-compatible, static-shaped.
+
+Greedy / temperature / top-k / top-p behind one factory. All filtering is
+mask-based (``lax.top_k`` + sort), no dynamic shapes, so the sampler composes
+into the jitted decode scan. Configuration is Python-level (baked into the
+compiled program); the per-step inputs are just (logits, key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the k highest logits per row; mask the rest to -inf."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # (batch, 1)
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the sorted distribution
+    whose cumulative probability reaches p (the first token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept iff the mass BEFORE it is < p
+    keep_sorted = (cum - probs) < p
+    # threshold = smallest kept logit; everything below it is dropped
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def make_sampler(
+    temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0
+):
+    """(logits (batch, vocab) f32, key) → tokens (batch,) int32.
+
+    temperature 0 ⇒ greedy argmax (top_k/top_p ignored).
+    """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+
+    if temperature == 0.0:
+
+        def greedy(logits, key):
+            del key
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        return greedy
+
+    def sampler(logits, key):
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k:
+            logits = _apply_top_k(logits, top_k)
+        if top_p < 1.0:
+            logits = _apply_top_p(logits, top_p)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    return sampler

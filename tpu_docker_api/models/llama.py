@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_docker_api.ops.attention import multihead_attention
+from tpu_docker_api.ops.attention import dense_attention, multihead_attention
 from tpu_docker_api.ops.norms import rms_norm
 from tpu_docker_api.ops.rope import apply_rope, rope_frequencies
 from tpu_docker_api.parallel.sharding import constrain
@@ -130,12 +130,33 @@ def llama_init(cfg: LlamaConfig, key: jax.Array) -> dict:
     return params
 
 
-def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh):
+def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
+               cache=None, start_pos=None):
+    """Self-attention. With ``cache=(k_cache, v_cache)`` of shape
+    (batch, max_seq, n_kv_heads, head_dim) runs the KV-cached path — writes
+    the new k/v at ``start_pos`` and attends against the full buffer via
+    ``dense_attention``'s q_offset mask (which covers both in-block causality
+    and not-yet-written slots) — and returns (out, new_cache) instead of out.
+    """
     b, s, d = x.shape
     hd = cfg.head_dim
     q = (x @ layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
     k = (x @ layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
     v = (x @ layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    if cache is not None:
+        positions = jnp.broadcast_to(
+            start_pos + jnp.arange(s, dtype=jnp.int32)[None, :], (b, s)
+        )
+        q = apply_rope(q, rope_cos, rope_sin, positions)
+        k = apply_rope(k, rope_cos, rope_sin, positions)
+        k_cache = lax.dynamic_update_slice_in_dim(
+            cache[0], k.astype(cache[0].dtype), start_pos, axis=1)
+        v_cache = lax.dynamic_update_slice_in_dim(
+            cache[1], v.astype(cache[1].dtype), start_pos, axis=1)
+        out = dense_attention(q, k_cache, v_cache, causal=True,
+                              q_offset=start_pos)
+        return out.reshape(b, s, cfg.n_heads * hd) @ layer["attn"]["wo"], (
+            k_cache, v_cache)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
     if cfg.attention_impl == "ring":
@@ -153,16 +174,25 @@ def _mlp(x, layer):
     return (gate * up) @ layer["mlp"]["w_down"]
 
 
-def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh):
-    bspec = P(("dp", "fsdp"), "sp")
-    x = x + _attention(
+def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
+           cache=None, start_pos=None):
+    """One transformer block; the single source of truth for the residual /
+    norm wiring of BOTH the training forward (cache=None) and the KV-cached
+    decode path (returns (x, new_cache)). Decode's seq dim is 1 so it never
+    shards on sp."""
+    bspec = P(("dp", "fsdp"), "sp" if cache is None else None)
+    attn_out = _attention(
         rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg,
-        rope_cos, rope_sin, mesh,
+        rope_cos, rope_sin, mesh, cache=cache, start_pos=start_pos,
     )
+    new_cache = None
+    if cache is not None:
+        attn_out, new_cache = attn_out
+    x = x + attn_out
     x = constrain(x, mesh, bspec) if mesh is not None else x
     x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer)
     x = constrain(x, mesh, bspec) if mesh is not None else x
-    return x
+    return x if cache is None else (x, new_cache)
 
 
 def llama_forward(
@@ -195,6 +225,52 @@ def llama_forward(
     if mesh is not None:
         logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
     return logits
+
+
+def llama_forward_cached(
+    params: dict,
+    tokens: jnp.ndarray,      # (batch, seq) int32 — the NEW tokens only
+    cfg: LlamaConfig,
+    k_cache: jnp.ndarray,     # (n_layers, batch, max_seq, n_kv_heads, head_dim)
+    v_cache: jnp.ndarray,
+    start_pos: jnp.ndarray,   # scalar int32: absolute position of tokens[:, 0]
+    mesh: Mesh | None = None,
+    last_only: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """KV-cached forward: logits for the new tokens + updated caches.
+
+    Block math is ``_block`` itself (cache threaded through it — one source
+    of truth with ``llama_forward``); the layer scan carries the per-layer
+    cache slices as scan xs/ys so compile time stays O(1) in depth.
+    ``start_pos`` is a traced scalar — one compiled program serves every
+    decode step. ``last_only=True`` applies lm_head to the final position
+    only (prefill wants just the next-token logits; skipping the
+    (b, seq, vocab) f32 intermediate saves prompt_len× the logits memory and
+    FLOPs).
+    """
+    b, s = tokens.shape
+    max_seq = k_cache.shape[2]
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    if mesh is not None:
+        x = constrain(x, mesh, P(("dp", "fsdp"), None))
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, max_seq, cfg.rope_theta)
+
+    def scan_body(x, layer_and_cache):
+        layer, kc, vc = layer_and_cache
+        x, (new_kc, new_vc) = _block(
+            x, layer, cfg, rope_cos, rope_sin, mesh,
+            cache=(kc, vc), start_pos=start_pos,
+        )
+        return x, (new_kc, new_vc)
+
+    x, (new_k, new_v) = lax.scan(
+        scan_body, x, (params["layers"], k_cache, v_cache)
+    )
+    if last_only:
+        x = x[:, -1:]
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return logits, new_k, new_v
 
 
 def llama_loss(

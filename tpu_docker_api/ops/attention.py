@@ -19,14 +19,20 @@ import jax
 import jax.numpy as jnp
 
 
-def _dense_attention(
-    q: jnp.ndarray,  # (batch, seq, num_heads, head_dim)
-    k: jnp.ndarray,  # (batch, seq, num_kv_heads, head_dim)
+def dense_attention(
+    q: jnp.ndarray,  # (batch, q_seq, num_heads, head_dim)
+    k: jnp.ndarray,  # (batch, kv_seq, num_kv_heads, head_dim)
     v: jnp.ndarray,
     causal: bool,
+    q_offset: jnp.ndarray | int | None = None,
 ) -> jnp.ndarray:
+    """Einsum attention with GQA folding. ``q_offset`` gives query i the
+    absolute position ``q_offset + i`` so KV-cached decode (queries near the
+    end of a longer, partially-filled key buffer) uses the same numerics as
+    the q_seq == kv_seq training path: key slot j attends iff
+    j <= q_offset + i, which also masks not-yet-written cache slots."""
     batch, seq, num_heads, head_dim = q.shape
-    num_kv = k.shape[2]
+    kv_seq, num_kv = k.shape[1], k.shape[2]
     group = num_heads // num_kv
     qf = q.astype(jnp.float32) / (head_dim**0.5)
     kf = k.astype(jnp.float32)
@@ -35,11 +41,18 @@ def _dense_attention(
     qg = qf.reshape(batch, seq, num_kv, group, head_dim)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)
     if causal:
-        mask = jnp.tril(jnp.ones((seq, seq), bool))
+        q_pos = jnp.arange(seq, dtype=jnp.int32)
+        if q_offset is not None:
+            q_pos = q_pos + q_offset
+        k_pos = jnp.arange(kv_seq, dtype=jnp.int32)
+        mask = k_pos[None, :] <= q_pos[:, None]  # (q_seq, kv_seq)
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
     return out.reshape(batch, seq, num_heads, head_dim).astype(q.dtype)
+
+
+_dense_attention = dense_attention  # back-compat alias
 
 
 def multihead_attention(
@@ -57,7 +70,7 @@ def multihead_attention(
         aligned = q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0
         impl = "flash" if (on_tpu and aligned) else "dense"
     if impl == "dense":
-        return _dense_attention(q, k, v, causal)
+        return dense_attention(q, k, v, causal)
     if impl in ("flash", "flash_interpret"):
         from tpu_docker_api.ops.flash_pallas import flash_attention
 

@@ -42,7 +42,10 @@ class DistributedJob:
 
     @property
     def coordinator_address(self) -> str:
-        return f"{self.placements[0].host}:{self.coordinator_port}"
+        # process 0 publishes the coordinator PortBinding (render_job_specs),
+        # so the address must name ITS host — placements order is not assumed
+        coord = next(p for p in self.placements if p.process_id == 0)
+        return f"{coord.host}:{self.coordinator_port}"
 
 
 def _process_bounds(n_processes: int) -> str:
