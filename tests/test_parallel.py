@@ -26,11 +26,18 @@ def test_eight_devices_available():
 class TestMesh:
     def test_default_plan_absorbs_devices(self):
         mesh = build_mesh(MeshPlan())
-        assert mesh.shape == {"dp": 8, "fsdp": 1, "tp": 1, "sp": 1}
+        assert dict(mesh.shape) == {"pp": 1, "dp": 8, "fsdp": 1, "ep": 1,
+                                    "tp": 1, "sp": 1}
 
     def test_explicit_plan(self):
         mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
-        assert mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+        assert dict(mesh.shape) == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1,
+                                    "tp": 2, "sp": 1}
+
+    def test_pp_ep_axes(self):
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1, pp=2, ep=4))
+        assert dict(mesh.shape) == {"pp": 2, "dp": 1, "fsdp": 1, "ep": 4,
+                                    "tp": 1, "sp": 1}
 
     def test_bad_plan_raises(self):
         with pytest.raises(ValueError):

@@ -1,10 +1,14 @@
 """Model families the control plane provisions (BASELINE.json configs).
 
 The reference ships no models (SURVEY.md §0) — these are the TPU-native
-workloads: the Llama family (pretrain/inference north star) and the MNIST MLP
+workloads: the Llama family (pretrain/inference north star), the Mixtral-style
+sparse MoE family (expert parallelism, SURVEY.md §2.3), and the MNIST MLP
 (single-chip smoke config #2). Pure-functional JAX: params are nested dicts,
 forward passes are jit/pjit-compatible functions, sharding comes from
 ``parallel.sharding`` rules rather than framework metadata.
+
+``model_fns(cfg)`` is the trainer's dispatch seam: any config type maps to its
+(init, loss, sharding-rules) triple, so train/trainer.py stays model-agnostic.
 """
 
 from tpu_docker_api.models.llama import (  # noqa: F401
@@ -14,3 +18,22 @@ from tpu_docker_api.models.llama import (  # noqa: F401
     llama_presets,
 )
 from tpu_docker_api.models.mlp import mlp_forward, mlp_init  # noqa: F401
+from tpu_docker_api.models.moe import (  # noqa: F401
+    MoEConfig,
+    moe_forward,
+    moe_init,
+    moe_presets,
+)
+
+
+def model_fns(cfg):
+    """(init_fn(cfg, key), loss_fn(params, tokens, cfg, mesh), rules)."""
+    from tpu_docker_api.models.llama import llama_loss
+    from tpu_docker_api.models.moe import MOE_RULES, moe_loss
+    from tpu_docker_api.parallel.sharding import LLAMA_RULES
+
+    if isinstance(cfg, MoEConfig):
+        return moe_init, moe_loss, MOE_RULES
+    if isinstance(cfg, LlamaConfig):
+        return llama_init, llama_loss, LLAMA_RULES
+    raise TypeError(f"no model registered for config type {type(cfg)!r}")

@@ -1,0 +1,270 @@
+"""Mixtral-family sparse Mixture-of-Experts decoder, TPU-first.
+
+Expert parallelism (SURVEY.md §2.3 — absent in the reference, first-class
+here): expert weights and the dispatched token buffers shard over the ``ep``
+mesh axis; the dispatch/combine einsums are annotated with sharding
+constraints and XLA lowers the token shuffle to ``all_to_all`` collectives on
+ICI — the TPU-native equivalent of the NCCL all-to-all a GPU MoE stack would
+hand-write.
+
+Routing is GShard/Switch-style with static shapes (XLA needs them): top-k
+gating, per-expert capacity ``C``, one-hot dispatch/combine tensors built with
+cumsum position assignment, tokens over capacity dropped (residual stream
+carries them unchanged). Attention, norms, rope, remat and the layer-stacked
+``lax.scan`` are shared with models/llama.py — one source of truth.
+
+Reference parity note: gpu-docker-api has no model zoo at all (SURVEY.md §0);
+this module exists to satisfy the EP row of the §2.3 checklist.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_docker_api.models.llama import _attention
+from tpu_docker_api.ops.norms import rms_norm
+from tpu_docker_api.ops.rope import rope_frequencies
+from tpu_docker_api.parallel.sharding import LLAMA_RULES, constrain
+
+#: param-path sharding rules (parallel/sharding.py machinery, first match
+#: wins): MoE-specific rows here, everything shared with Llama (embed, attn,
+#: norms, lm_head) composed from LLAMA_RULES. Experts shard on ep; within an
+#: expert the ffn dims shard on tp, model dim on fsdp — the Megatron layout
+#: per expert.
+MOE_RULES: list[tuple[str, P]] = [
+    ("layers/moe/router",    P(None, "fsdp", None)),
+    ("layers/moe/w_gate",    P(None, "ep", "fsdp", "tp")),
+    ("layers/moe/w_up",      P(None, "ep", "fsdp", "tp")),
+    ("layers/moe/w_down",    P(None, "ep", "tp", "fsdp")),
+    *LLAMA_RULES,
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token capacity for a flat batch of ``n_tokens``."""
+        c = math.ceil(self.top_k * n_tokens * self.capacity_factor
+                      / self.n_experts)
+        return max(int(c), 1)
+
+    def flops_per_token(self, seq_len: int | None = None) -> float:
+        """Training FLOPs/token — only ``top_k`` experts fire per token."""
+        seq = seq_len or self.max_seq_len
+        d, h = self.dim, self.head_dim
+        per_layer = (
+            2 * d * (self.n_heads * h)
+            + 2 * 2 * d * (self.n_kv_heads * h)
+            + 2 * (self.n_heads * h) * d
+            + 2 * d * self.n_experts                       # router
+            + self.top_k * 3 * 2 * d * self.ffn_dim        # active experts
+        )
+        embed = 2 * d * self.vocab_size
+        fwd = self.n_layers * per_layer + embed
+        attn = self.n_layers * 2 * 2 * seq * (self.n_heads * h) / 2
+        return 3.0 * (fwd + attn)
+
+
+def moe_presets() -> dict[str, MoEConfig]:
+    return {
+        # parity-scale flagship: Mixtral-8x7B geometry
+        "mixtral-8x7b": MoEConfig(
+            vocab_size=32000, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, ffn_dim=14336, n_experts=8, top_k=2,
+            max_seq_len=32768, rope_theta=1e6,
+        ),
+        # CPU-fast config for tests / dryrun
+        "moe-tiny": MoEConfig(
+            vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=128, n_experts=4, top_k=2, max_seq_len=128,
+            rope_theta=10000.0, remat=False,
+        ),
+    }
+
+
+def moe_init(cfg: MoEConfig, key: jax.Array) -> dict:
+    """Parameter pytree; expert weights carry (n_layers, n_experts, ...)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, hd, L, E = cfg.dim, cfg.head_dim, cfg.n_layers, cfg.n_experts
+
+    def init(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in**-0.5)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 8)
+    return {
+        "embed": {"tokens": init(k_embed, (cfg.vocab_size, d), d)},
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "attn": {
+                "wq": init(ks[0], (L, d, cfg.n_heads * hd), d),
+                "wk": init(ks[1], (L, d, cfg.n_kv_heads * hd), d),
+                "wv": init(ks[2], (L, d, cfg.n_kv_heads * hd), d),
+                "wo": init(ks[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+            },
+            "moe": {
+                # router in f32 end-to-end: tiny, and routing decisions are
+                # precision-sensitive (bf16 logit ties flip top-k picks)
+                "router": (jax.random.truncated_normal(
+                    ks[4], -2, 2, (L, d, E), jnp.float32) * (d**-0.5)),
+                "w_gate": init(ks[5], (L, E, d, cfg.ffn_dim), d),
+                "w_up": init(ks[6], (L, E, d, cfg.ffn_dim), d),
+                "w_down": init(ks[7], (L, E, cfg.ffn_dim, d), cfg.ffn_dim),
+            },
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": init(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig):
+    """Top-k routing → (dispatch (t,E,C), combine (t,E,C), aux_loss).
+
+    Static shapes throughout: one-hot dispatch with cumsum capacity
+    assignment (GShard eq. 2), overflow tokens dropped.
+    """
+    t = x_flat.shape[0]
+    E, K, C = cfg.n_experts, cfg.top_k, cfg.capacity(x_flat.shape[0])
+    logits = x_flat.astype(jnp.float32) @ router          # (t, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, K)             # (t, K)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # expert choice one-hots, ranked: k=0 claims capacity slots first
+    onehots = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (t, K, E)
+    # position of each (token, choice) in its expert's queue: cumsum over the
+    # flattened (K, t) order so all k=0 picks rank ahead of k=1 picks
+    ranked = onehots.transpose(1, 0, 2).reshape(K * t, E)   # (K*t, E)
+    pos_ranked = jnp.cumsum(ranked, axis=0) - ranked        # 0-based slots
+    pos = pos_ranked.reshape(K, t, E).transpose(1, 0, 2)    # (t, K, E)
+    pos = jnp.sum(pos * onehots, axis=-1)                   # (t, K)
+    keep = pos < C                                          # capacity mask
+
+    # dispatch: bool (t, E, C); combine: gate-weighted (t, E, C)
+    slot_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (t, K, C)
+    disp_k = onehots.astype(jnp.float32)[..., None] * slot_onehot[:, :, None, :]
+    disp_k = disp_k * keep[:, :, None, None]
+    dispatch = jnp.sum(disp_k, axis=1)                       # (t, E, C)
+    combine = jnp.sum(disp_k * gate_vals[:, :, None, None], axis=1)
+
+    # load-balance aux loss (Switch eq. 4): E * Σ_e f_e · P_e
+    top1 = jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def _moe_mlp(x, layer_moe, cfg: MoEConfig, mesh: Mesh | None):
+    """Sparse FFN: route → all-to-all dispatch → batched expert SwiGLU →
+    all-to-all combine. Returns (out, aux_loss)."""
+    b, s, d = x.shape
+    x_flat = x.reshape(b * s, d)
+    dispatch, combine, aux = _route(x_flat, layer_moe["router"], cfg)
+
+    # (E, C, d) expert buffers — sharded on ep, so this einsum IS the
+    # all-to-all (tokens leave their data-parallel home shard for their
+    # expert's shard)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x_flat)
+    if mesh is not None:
+        xe = constrain(xe, mesh, P("ep", None, "fsdp"))
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, layer_moe["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", xe, layer_moe["w_up"])
+    ye = jnp.einsum("ecf,efd->ecd", gate * up, layer_moe["w_down"])
+    if mesh is not None:
+        ye = constrain(ye, mesh, P("ep", None, "fsdp"))
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+    return out.reshape(b, s, d), aux
+
+
+def _moe_block(x, layer, cfg: MoEConfig, rope_cos, rope_sin, mesh):
+    """Transformer block: Llama attention (shared code) + sparse FFN.
+    Returns (x, aux_loss)."""
+    bspec = P(("dp", "fsdp"), "sp")
+    attn_out = _attention(
+        rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg,
+        rope_cos, rope_sin, mesh,
+    )
+    x = x + attn_out
+    x = constrain(x, mesh, bspec) if mesh is not None else x
+    moe_out, aux = _moe_mlp(
+        rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["moe"], cfg, mesh)
+    x = x + moe_out
+    x = constrain(x, mesh, bspec) if mesh is not None else x
+    return x, aux
+
+
+def moe_forward(
+    params: dict,
+    tokens: jnp.ndarray,  # (batch, seq) int32
+    cfg: MoEConfig,
+    mesh: Mesh | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(logits (b, s, vocab) f32, mean router aux loss)."""
+    seq = tokens.shape[1]
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    if mesh is not None:
+        x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+
+    block = functools.partial(
+        _moe_block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin, mesh=mesh
+    )
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    def scan_body(x, layer):
+        x, aux = block(x, layer)
+        return x, aux
+
+    x, aux_per_layer = lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    if mesh is not None:
+        logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
+    return logits, jnp.mean(aux_per_layer)
+
+
+def moe_loss(
+    params: dict, tokens: jnp.ndarray, cfg: MoEConfig,
+    mesh: Mesh | None = None,
+) -> jnp.ndarray:
+    """Causal LM loss + router load-balance penalty."""
+    logits, aux = moe_forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + cfg.router_aux_coef * aux

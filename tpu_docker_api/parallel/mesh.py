@@ -5,6 +5,12 @@ how large each is, so models/training code never hard-codes axis sizes. Mesh
 axes map onto the physical ICI mesh via ``mesh_utils.create_device_mesh``
 (which optimizes adjacency for TPU topologies), the control-plane analog being
 the slice allocator's contiguous placement (scheduler/slices.py).
+
+Axis order encodes locality priority: ``pp`` is outermost (stage-to-stage
+point-to-point traffic is the cheapest collective, and pipeline stages may
+even span DCN), then ``dp``/``fsdp`` (gradient all-reduce / param all-gather),
+then ``ep`` (MoE all-to-all), with ``tp``/``sp`` innermost so their
+latency-critical collectives land on physically adjacent chips.
 """
 
 from __future__ import annotations
@@ -16,7 +22,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("pp", "dp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -26,25 +32,37 @@ class MeshPlan:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
+    ep: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        known = [s for s in (self.dp, self.fsdp, self.tp, self.sp) if s != -1]
+    def _sizes(self) -> tuple[int, ...]:
+        """Sizes in AXES order."""
+        return (self.pp, self.dp, self.fsdp, self.ep, self.tp, self.sp)
+
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        for name, size in zip(("fsdp", "tp", "sp", "pp", "ep"),
+                              (self.fsdp, self.tp, self.sp, self.pp, self.ep)):
+            if size < 1:
+                raise ValueError(f"axis {name} must be ≥1 (only dp may be -1)")
+        known = [s for s in self._sizes() if s != -1]
         prod = int(np.prod(known)) if known else 1
         if self.dp == -1:
             if n_devices % prod:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*tp*sp={prod}"
+                    f"{n_devices} devices not divisible by "
+                    f"pp*fsdp*ep*tp*sp={prod}"
                 )
-            return (n_devices // prod, self.fsdp, self.tp, self.sp)
+            dp = n_devices // prod
+            return (self.pp, dp, self.fsdp, self.ep, self.tp, self.sp)
         if prod != n_devices:
             raise ValueError(
                 f"mesh plan {self} needs {prod} devices, have {n_devices}"
             )
-        return (self.dp, self.fsdp, self.tp, self.sp)
+        return self._sizes()
 
 
 def build_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
-    """Build a (dp, fsdp, tp, sp) mesh over ``devices`` (default: all).
+    """Build a (pp, dp, fsdp, ep, tp, sp) mesh over ``devices`` (default: all).
 
     ``create_device_mesh`` lays logical axes onto the physical topology so the
     innermost axes (tp, sp) land on adjacent chips — the collectives that ride
@@ -62,4 +80,4 @@ def build_mesh(plan: MeshPlan | None = None, devices=None) -> Mesh:
 
 
 def single_device_mesh() -> Mesh:
-    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.asarray(jax.devices()[:1]).reshape((1,) * len(AXES)), AXES)

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_docker_api.models.llama import LlamaConfig, llama_init, llama_loss
+from tpu_docker_api.models import model_fns
 from tpu_docker_api.parallel.sharding import param_shardings
 
 
@@ -47,35 +47,37 @@ def default_optimizer(
 
 
 def create_train_state(
-    cfg: LlamaConfig,
+    cfg,
     mesh: Mesh,
     key: jax.Array,
     optimizer: optax.GradientTransformation | None = None,
 ) -> tuple[TrainState, optax.GradientTransformation]:
     """Init params DIRECTLY into their shards: jit the initializer with
-    sharded out_shardings so no host ever materializes the full model."""
+    sharded out_shardings so no host ever materializes the full model.
+    ``cfg`` may be any registered model config (Llama, MoE, ...)."""
     optimizer = optimizer or default_optimizer()
-    abstract = jax.eval_shape(lambda k: llama_init(cfg, k), key)
-    p_shardings = param_shardings(abstract, mesh)
+    model_init, _, rules = model_fns(cfg)
+    abstract = jax.eval_shape(lambda k: model_init(cfg, k), key)
+    p_shardings = param_shardings(abstract, mesh, rules)
 
     init_fn = jax.jit(
-        lambda k: llama_init(cfg, k), out_shardings=p_shardings
+        lambda k: model_init(cfg, k), out_shardings=p_shardings
     )
     with mesh:
         params = init_fn(key)
         opt_state = jax.jit(
             optimizer.init,
-            out_shardings=_opt_shardings(optimizer, abstract, mesh),
+            out_shardings=_opt_shardings(optimizer, abstract, mesh, rules),
         )(params)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=opt_state), optimizer
 
 
-def _opt_shardings(optimizer, abstract_params, mesh: Mesh):
+def _opt_shardings(optimizer, abstract_params, mesh: Mesh, rules=None):
     """Optimizer-state shardings: any subtree with the params' structure
     (adam mu/nu) reuses the param shardings; everything else (step counts)
     replicates. Walks optax's NamedTuple states recursively."""
-    param_sh = param_shardings(abstract_params, mesh)
+    param_sh = param_shardings(abstract_params, mesh, rules)
     param_def = jax.tree_util.tree_structure(abstract_params)
     replicated = NamedSharding(mesh, P())
     abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
@@ -92,15 +94,16 @@ def _opt_shardings(optimizer, abstract_params, mesh: Mesh):
 
 
 def make_train_step(
-    cfg: LlamaConfig,
+    cfg,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     loss_fn: Callable | None = None,
 ) -> Callable:
-    """jitted (state, tokens) → (state, metrics); state buffers donated."""
-    loss_fn = loss_fn or (
-        lambda params, tokens: llama_loss(params, tokens, cfg, mesh)
-    )
+    """jitted (state, tokens) → (state, metrics); state buffers donated.
+    ``cfg`` may be any registered model config (Llama, MoE, ...)."""
+    if loss_fn is None:
+        _, model_loss, _ = model_fns(cfg)
+        loss_fn = lambda params, tokens: model_loss(params, tokens, cfg, mesh)
     batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
 
     @functools.partial(jax.jit, donate_argnums=(0,))
