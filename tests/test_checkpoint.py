@@ -1,0 +1,104 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4 — workload state half).
+
+Covers: sharded save/restore roundtrip equality, resume-or-init semantics,
+restore onto a DIFFERENT mesh shape (the rolling-rescale contract), and
+retention (max_to_keep).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from tpu_docker_api.models.llama import llama_presets
+from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+from tpu_docker_api.train.checkpoint import CheckpointManager, resume_or_init
+from tpu_docker_api.train.trainer import (
+    create_train_state,
+    default_optimizer,
+    make_train_step,
+    synthetic_batch,
+)
+
+
+def tiny_cfg():
+    return dataclasses.replace(llama_presets()["tiny"], n_layers=2)
+
+
+def params_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestRoundtrip:
+    def test_save_restore_sharded_equality(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+        opt = default_optimizer()
+        state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+        step = make_train_step(cfg, mesh, opt)
+        tokens = synthetic_batch(jax.random.PRNGKey(1), 4, 16, cfg.vocab_size)
+        state, _ = step(state, tokens)
+
+        with CheckpointManager(tmp_path / "ckpt") as mgr:
+            assert mgr.save(state)
+            mgr.wait()
+            restored = mgr.restore(cfg, mesh, opt)
+        assert int(restored.step) == int(state.step) == 1
+        params_equal(restored.params, state.params)
+        params_equal(restored.opt_state, state.opt_state)
+
+    def test_restore_onto_different_mesh(self, tmp_path):
+        """The rescale contract: write on a 4-way mesh, restore on 8-way."""
+        cfg = tiny_cfg()
+        opt = default_optimizer()
+        mesh_a = build_mesh(MeshPlan(dp=1, fsdp=2, tp=2, sp=1),
+                            devices=jax.devices()[:4])
+        state, opt = create_train_state(cfg, mesh_a, jax.random.PRNGKey(0), opt)
+        with CheckpointManager(tmp_path / "ckpt") as mgr:
+            mgr.save(state, step=0)
+            mgr.wait()
+            mesh_b = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+            restored = mgr.restore(cfg, mesh_b, opt)
+        params_equal(restored.params, state.params)
+        # and the restored state trains on the new mesh
+        step = make_train_step(cfg, mesh_b, opt)
+        tokens = synthetic_batch(jax.random.PRNGKey(1), 4, 16, cfg.vocab_size)
+        restored, metrics = step(restored, tokens)
+        assert np.isfinite(float(metrics["loss"]))
+
+
+class TestResumeOrInit:
+    def test_fresh_then_resume(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = build_mesh(MeshPlan(dp=8, fsdp=1, tp=1, sp=1))
+        d = tmp_path / "run"
+        state, opt, mgr = resume_or_init(d, cfg, mesh, jax.random.PRNGKey(0))
+        assert mgr.latest_step() is None  # fresh init, nothing on disk
+        step = make_train_step(cfg, mesh, opt)
+        tokens = synthetic_batch(jax.random.PRNGKey(1), 8, 16, cfg.vocab_size)
+        for _ in range(2):
+            state, _ = step(state, tokens)
+        mgr.save(state)
+        mgr.close()
+
+        state2, _, mgr2 = resume_or_init(d, cfg, mesh, jax.random.PRNGKey(9))
+        assert int(state2.step) == 2
+        params_equal(state2.params, state.params)
+        mgr2.close()
+
+    def test_retention(self, tmp_path):
+        cfg = tiny_cfg()
+        mesh = build_mesh(MeshPlan(dp=8, fsdp=1, tp=1, sp=1))
+        opt = default_optimizer()
+        state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+        with CheckpointManager(tmp_path / "ckpt", max_to_keep=2) as mgr:
+            for s in range(4):
+                mgr.save(state, step=s)
+                mgr.wait()
+            assert mgr.all_steps() == [2, 3]

@@ -11,7 +11,8 @@ Design choices (vs a torch-style port):
   paths to (fsdp, tp) PartitionSpecs; activations are constrained to
   (dp+fsdp, sp) — XLA inserts the collectives.
 - **Attention dispatch**: Pallas flash kernel on TPU, dense fallback, ring
-  attention (parallel/ring.py) when the mesh has a real sp axis.
+  attention (parallel/ring.py) or Ulysses all-to-all (parallel/ulysses.py)
+  when the mesh has a real sp axis.
 - **Remat**: each scanned block is wrapped in ``jax.checkpoint`` with a
   dots-saveable policy, trading FLOPs for HBM as depth grows.
 """
@@ -46,7 +47,7 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    attention_impl: str = "auto"  # ops.attention impls, or "ring"
+    attention_impl: str = "auto"  # ops.attention impls, "ring", or "ulysses"
 
     @property
     def head_dim(self) -> int:
@@ -163,6 +164,10 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
         from tpu_docker_api.parallel.ring import ring_attention
 
         out = ring_attention(q, k, v, mesh, causal=True)
+    elif cfg.attention_impl == "ulysses":
+        from tpu_docker_api.parallel.ulysses import ulysses_attention
+
+        out = ulysses_attention(q, k, v, mesh, causal=True)
     else:
         out = multihead_attention(q, k, v, causal=True, impl=cfg.attention_impl)
     return out.reshape(b, s, cfg.n_heads * hd) @ layer["attn"]["wo"]
@@ -220,8 +225,7 @@ def llama_forward(
         return block(x, layer), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    logits = lm_head(params, x, cfg)
     if mesh is not None:
         logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
     return logits
@@ -268,9 +272,22 @@ def llama_forward_cached(
     )
     if last_only:
         x = x[:, -1:]
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    logits = lm_head(params, x, cfg)
     return logits, new_k, new_v
+
+
+def cross_entropy(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross-entropy; the single loss body shared by every
+    training path (llama_loss, moe_loss, parallel.pipeline.pipeline_loss)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def lm_head(params: dict, h: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
+    """Final norm + f32 logits projection — shared model tail."""
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps)
+    return h.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
 
 
 def llama_loss(
@@ -279,10 +296,7 @@ def llama_loss(
 ) -> jnp.ndarray:
     """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
     logits = llama_forward(params, tokens[:, :-1], cfg, mesh)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return cross_entropy(logits, tokens[:, 1:])
 
 
 def param_count(params: dict) -> int:

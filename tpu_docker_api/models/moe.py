@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_docker_api.models.llama import _attention
+from tpu_docker_api.models.llama import _attention, cross_entropy, lm_head
 from tpu_docker_api.ops.norms import rms_norm
 from tpu_docker_api.ops.rope import rope_frequencies
 from tpu_docker_api.parallel.sharding import LLAMA_RULES, constrain
@@ -251,8 +251,7 @@ def moe_forward(
         return x, aux
 
     x, aux_per_layer = lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    logits = lm_head(params, x, cfg)
     if mesh is not None:
         logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
     return logits, jnp.mean(aux_per_layer)
@@ -264,7 +263,4 @@ def moe_loss(
 ) -> jnp.ndarray:
     """Causal LM loss + router load-balance penalty."""
     logits, aux = moe_forward(params, tokens[:, :-1], cfg, mesh)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll) + cfg.router_aux_coef * aux
+    return cross_entropy(logits, tokens[:, 1:]) + cfg.router_aux_coef * aux

@@ -1,0 +1,151 @@
+"""Pipeline parallelism (GPipe schedule) under GSPMD.
+
+SURVEY.md §2.3 PP row — absent in the reference, first-class here. Rather
+than hand-writing per-stage programs (the torch way), the pipeline is
+expressed as sharded-tensor algebra and XLA lowers the communication:
+
+- the layer stack (leading ``n_layers`` dim) is reshaped to
+  ``(n_stages, layers_per_stage, ...)`` and the stage dim is sharded on the
+  ``pp`` mesh axis — each device group holds only its stage's weights;
+- one pipeline tick applies every stage to the activation it currently holds
+  via ``vmap`` over the stage dim (purely local compute, since activations
+  and weights share the ``pp`` sharding);
+- ``jnp.roll`` on the stage dim hands each stage's output to the next stage —
+  XLA lowers it to a ``collective-permute`` on ICI/DCN, the TPU-native
+  analog of NCCL send/recv that a GPU pipeline would hand-schedule;
+- a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks drives the GPipe
+  fill/steady/drain schedule with static control flow.
+
+Because everything is ordinary sharded jax, reverse-mode autodiff gives the
+backward pipeline for free, and pp composes with dp/fsdp/tp/sp from the same
+mesh (tp/fsdp collectives are still inserted by XLA inside each stage).
+Embedding, final norm and lm_head run outside the pipelined scan as plain
+GSPMD ops (vocab sharded on tp) — on TPU there is no reason to pin them to
+the first/last stage the way NCCL pipelines must.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_docker_api.models.llama import (
+    LlamaConfig,
+    _block,
+    cross_entropy,
+    lm_head,
+)
+from tpu_docker_api.ops.rope import rope_frequencies
+from tpu_docker_api.parallel.sharding import constrain
+
+
+def pipeline_rules(rules: list[tuple[str, P]]) -> list[tuple[str, P]]:
+    """Make param sharding rules pipeline-aware: the stacked-layer dim
+    (leading ``None`` in every ``layers/*`` rule) shards on ``pp``, so stage
+    ``s`` owns the contiguous block of layers it executes."""
+    out = []
+    for pattern, spec in rules:
+        if pattern.startswith("layers/") and len(spec) and spec[0] is None:
+            out.append((pattern, P("pp", *spec[1:])))
+        else:
+            out.append((pattern, spec))
+    return out
+
+
+def _stage_layers(params: dict, n_stages: int):
+    """Reshape the flat (L, ...) layer stack to (n_stages, L/n_stages, ...)."""
+    L = params["layers"]["attn_norm"].shape[0]
+    if L % n_stages:
+        raise ValueError(f"n_layers={L} not divisible by pp={n_stages}")
+    per = L // n_stages
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape(n_stages, per, *p.shape[1:]), params["layers"]
+    )
+
+
+def pipeline_forward(
+    params: dict,
+    tokens: jnp.ndarray,  # (batch, seq) int32; batch = n_micro * microbatch
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    n_micro: int,
+) -> jnp.ndarray:
+    """Next-token logits (batch, seq, vocab) f32, computed through the
+    pp-sharded GPipe schedule."""
+    n_stages = mesh.shape["pp"]
+    batch, seq = tokens.shape
+    if batch % n_micro:
+        raise ValueError(f"batch={batch} not divisible by n_micro={n_micro}")
+    mb = batch // n_micro
+
+    stages = _stage_layers(params, n_stages)
+    d = cfg.dim
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+
+    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)  # (batch, s, d)
+    x_mb = x.reshape(n_micro, mb, seq, d)
+    x_mb = constrain(x_mb, mesh, P(None, ("dp", "fsdp"), "sp", None))
+
+    block = functools.partial(
+        _block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin, mesh=None
+    )
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    def apply_stage(layers_stage, h):
+        """Run this stage's layers_per_stage blocks; vmapped over stages."""
+        def body(h, layer):
+            return block(h, layer), None
+
+        h, _ = lax.scan(body, h, layers_stage)
+        return h
+
+    buf_spec = P("pp", ("dp", "fsdp"), "sp", None)
+    buf = jnp.zeros((n_stages, mb, seq, d), x.dtype)
+    outs = jnp.zeros((n_micro, mb, seq, d), x.dtype)
+    total = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        buf, outs = carry
+        # fill: microbatch t enters stage 0 (drain ticks recompute garbage
+        # there, which is discarded — the structural GPipe bubble)
+        inp0 = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        buf = buf.at[0].set(jnp.where(t < n_micro, inp0, buf[0]))
+        buf = constrain(buf, mesh, buf_spec)
+        new_buf = jax.vmap(apply_stage)(stages, buf)
+        new_buf = constrain(new_buf, mesh, buf_spec)
+        # drain: stage S-1 just finished microbatch t-(S-1)
+        out_idx = t - (n_stages - 1)
+        updated = lax.dynamic_update_slice_in_dim(
+            outs, new_buf[-1:].astype(outs.dtype),
+            jnp.clip(out_idx, 0, n_micro - 1), axis=0)
+        outs = jnp.where(out_idx >= 0, updated, outs)
+        # hand each stage's output to the next stage: collective-permute
+        buf = jnp.roll(new_buf, 1, axis=0)
+        return (buf, outs), None
+
+    (_, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(total))
+
+    h = outs.reshape(batch, seq, d)
+    h = constrain(h, mesh, P(("dp", "fsdp"), "sp", None))
+    logits = lm_head(params, h, cfg)
+    return constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
+
+
+def pipeline_loss(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh: Mesh,
+    n_micro: int,
+) -> jnp.ndarray:
+    """Causal LM loss through the pipeline; backward pipeline via autodiff."""
+    logits = pipeline_forward(params, tokens[:, :-1], cfg, mesh, n_micro)
+    return cross_entropy(logits, tokens[:, 1:])

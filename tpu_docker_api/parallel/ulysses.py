@@ -1,0 +1,77 @@
+"""Ulysses sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second long-context strategy from SURVEY.md §2.3 (alongside
+parallel/ring.py). Where ring attention rotates k/v chunks around the ICI
+ring, Ulysses re-shards: activations arrive sequence-sharded on ``sp``, an
+``all_to_all`` trades the sequence shards for head shards (each device gets
+the FULL sequence for h/sp heads), plain local attention runs, and a second
+``all_to_all`` restores sequence sharding. Two collectives total per
+attention call — cheaper than the ring when seq ≫ heads·head_dim, and the
+local attention can use the Pallas flash kernel unchanged.
+
+Trade-off vs ring (why both exist): Ulysses caps sp at the head count
+(n_kv_heads for GQA) and moves q+k+v+o activations over ICI; ring has no
+head-count cap and moves only k/v but needs n-1 rotation steps.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_docker_api.ops.attention import multihead_attention
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool, impl: str):
+    """Per-device body. Local shapes in: (b, s/sp, h_local, d)."""
+    sp = lax.psum(1, axis_name)
+    # heads → sequence: after this each device holds ALL positions for its
+    # h_local/sp heads. split_axis/concat_axis are array dims.
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = multihead_attention(qg, kg, vg, causal=causal, impl=impl)
+    # sequence → heads: restore the sp-sharded layout
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,  # (batch, seq, n_heads, head_dim), seq sharded on sp
+    k: jnp.ndarray,  # (batch, seq, n_kv_heads, head_dim)
+    v: jnp.ndarray,
+    mesh: Mesh,
+    causal: bool = True,
+    axis_name: str = "sp",
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Exact attention with seq sharded on ``sp`` via two all-to-alls.
+
+    Requires the per-device head counts (after tp sharding) to be divisible
+    by sp for q AND k/v — with GQA that bounds sp by n_kv_heads/tp.
+    """
+    sp = mesh.shape[axis_name]
+    tp = mesh.shape["tp"]
+    for name, heads in (("q", q.shape[2]), ("kv", k.shape[2])):
+        local = heads // tp
+        if heads % tp or local % sp:
+            raise ValueError(
+                f"ulysses needs {name} heads/tp divisible by sp: "
+                f"heads={heads} tp={tp} sp={sp}"
+            )
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    local = functools.partial(
+        _ulysses_local, axis_name=axis_name, causal=causal, impl=impl)
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        fn = shard_map(local, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover — older jax
+        fn = shard_map(local, check_rep=False, **kwargs)
+    return fn(q, k, v)

@@ -1,0 +1,125 @@
+"""Checkpoint / resume for training state (SURVEY.md §5.4).
+
+The reference checkpoints only control-plane state (etcd specs) and has no
+workload-state concept at all; this module supplies the workload half: orbax
+saves of the sharded ``TrainState``, async by default so the train loop
+doesn't stall on HBM→disk, restored **directly into the target shardings**
+(each host/chip reads only its own shards — no full-model host
+materialization, the same property create_train_state has on init).
+
+This is also the quiesce point for the control plane's rolling rescale
+(service/container.py): save() → migrate the checkpoint volume → restore on
+the new mesh. Restoring onto a *different* mesh shape works by construction:
+orbax lays the on-disk array out by global shape and the restore shardings
+decide how it is re-split.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import optax
+import orbax.checkpoint as ocp
+
+from tpu_docker_api.models import model_fns
+from tpu_docker_api.parallel.sharding import param_shardings
+from tpu_docker_api.train.trainer import TrainState, _opt_shardings
+
+
+class CheckpointManager:
+    """Thin orbax CheckpointManager wrapper bound to one run directory."""
+
+    def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self._mgr = ocp.CheckpointManager(
+            os.fspath(os.path.abspath(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, state: TrainState, step: int | None = None) -> bool:
+        """Async save; returns whether a save was started (interval gate)."""
+        step = int(state.step) if step is None else step
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, cfg, mesh, optimizer: optax.GradientTransformation,
+                step: int | None = None, rules=None) -> TrainState:
+        """Restore into the shardings implied by (cfg, mesh, rules) — the
+        mesh may differ from the one the checkpoint was written on."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint steps in directory")
+        model_init, _, model_rules = model_fns(cfg)
+        rules = rules if rules is not None else model_rules
+        abstract_params = jax.eval_shape(
+            lambda k: model_init(cfg, k), jax.random.PRNGKey(0))
+        p_sh = param_shardings(abstract_params, mesh, rules)
+        abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+        o_sh = _opt_shardings(optimizer, abstract_params, mesh, rules,
+                              param_sh=p_sh, abstract_opt=abstract_opt)
+
+        def as_abstract(tree, shardings):
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                tree, shardings)
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        target = TrainState(
+            step=jax.ShapeDtypeStruct((), np.int32,
+                                      sharding=NamedSharding(mesh, P())),
+            params=as_abstract(abstract_params, p_sh),
+            opt_state=as_abstract(abstract_opt, o_sh),
+        )
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable (pre-migration barrier
+        for the rescale flow)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def resume_or_init(
+    directory: str | os.PathLike,
+    cfg,
+    mesh,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation | None = None,
+    rules=None,
+    max_to_keep: int = 3,
+) -> tuple[TrainState, optax.GradientTransformation, CheckpointManager]:
+    """The crash-safe entry point: restore the latest step if one exists,
+    else fresh-init — the workload analog of the schedulers' restore-from-
+    etcd-on-boot (SURVEY.md §3.1)."""
+    from tpu_docker_api.train.trainer import create_train_state, default_optimizer
+
+    optimizer = optimizer or default_optimizer()
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
+    if mgr.latest_step() is not None:
+        state = mgr.restore(cfg, mesh, optimizer, rules=rules)
+        return state, optimizer, mgr
+    state, optimizer = create_train_state(cfg, mesh, key, optimizer,
+                                          rules=rules)
+    return state, optimizer, mgr

@@ -51,12 +51,16 @@ def create_train_state(
     mesh: Mesh,
     key: jax.Array,
     optimizer: optax.GradientTransformation | None = None,
+    rules=None,
 ) -> tuple[TrainState, optax.GradientTransformation]:
     """Init params DIRECTLY into their shards: jit the initializer with
     sharded out_shardings so no host ever materializes the full model.
-    ``cfg`` may be any registered model config (Llama, MoE, ...)."""
+    ``cfg`` may be any registered model config (Llama, MoE, ...); ``rules``
+    overrides the model's sharding rules (e.g. parallel.pipeline's pp-aware
+    variant)."""
     optimizer = optimizer or default_optimizer()
-    model_init, _, rules = model_fns(cfg)
+    model_init, _, model_rules = model_fns(cfg)
+    rules = rules if rules is not None else model_rules
     abstract = jax.eval_shape(lambda k: model_init(cfg, k), key)
     p_shardings = param_shardings(abstract, mesh, rules)
 
@@ -73,14 +77,19 @@ def create_train_state(
                       opt_state=opt_state), optimizer
 
 
-def _opt_shardings(optimizer, abstract_params, mesh: Mesh, rules=None):
+def _opt_shardings(optimizer, abstract_params, mesh: Mesh, rules=None,
+                   param_sh=None, abstract_opt=None):
     """Optimizer-state shardings: any subtree with the params' structure
     (adam mu/nu) reuses the param shardings; everything else (step counts)
-    replicates. Walks optax's NamedTuple states recursively."""
-    param_sh = param_shardings(abstract_params, mesh, rules)
+    replicates. Walks optax's NamedTuple states recursively. Callers that
+    already traced ``param_sh``/``abstract_opt`` pass them in to skip the
+    re-trace (train/checkpoint.py restores)."""
+    if param_sh is None:
+        param_sh = param_shardings(abstract_params, mesh, rules)
     param_def = jax.tree_util.tree_structure(abstract_params)
     replicated = NamedSharding(mesh, P())
-    abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
+    if abstract_opt is None:
+        abstract_opt = jax.eval_shape(optimizer.init, abstract_params)
 
     def assign(node):
         if jax.tree_util.tree_structure(node) == param_def:
