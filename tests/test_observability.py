@@ -1,0 +1,247 @@
+"""Observability tests: metrics registry, /metrics + request tracing on the
+HTTP surface, health watcher transitions and bounded auto-restart
+(SURVEY.md §5.1/§5.3/§5.5 — all absent in the reference)."""
+
+from __future__ import annotations
+
+import http.client
+import json
+
+import pytest
+
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.service.watch import HealthWatcher
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+
+class TestMetricsRegistry:
+    def test_counter_and_labels(self):
+        r = MetricsRegistry()
+        r.counter_inc("reqs", {"route": "/a"}, help="requests")
+        r.counter_inc("reqs", {"route": "/a"})
+        r.counter_inc("reqs", {"route": "/b"})
+        text = r.render()
+        assert '# TYPE reqs counter' in text
+        assert 'reqs{route="/a"} 2' in text
+        assert 'reqs{route="/b"} 1' in text
+
+    def test_gauge_fn_pull(self):
+        r = MetricsRegistry()
+        vals = {"x": 3.0}
+        r.gauge_fn("depth", lambda: vals["x"], help="queue depth")
+        assert "depth 3" in r.render()
+        vals["x"] = 7.0
+        assert "depth 7" in r.render()
+
+    def test_histogram_buckets(self):
+        r = MetricsRegistry()
+        for v in (0.001, 0.03, 2.0):
+            r.observe("lat", v, {"route": "/a"}, buckets=(0.01, 0.1, 1.0))
+        text = r.render()
+        assert 'lat_bucket{le="0.01",route="/a"} 1' in text
+        assert 'lat_bucket{le="0.1",route="/a"} 2' in text
+        assert 'lat_bucket{le="1",route="/a"} 2' in text
+        assert 'lat_bucket{le="+Inf",route="/a"} 3' in text
+        assert 'lat_count{route="/a"} 3' in text
+
+    def test_broken_gauge_fn_never_breaks_render(self):
+        r = MetricsRegistry()
+        r.gauge_fn("bad", lambda: 1 / 0)
+        r.counter_inc("ok")
+        assert "ok 1" in r.render()
+
+
+@pytest.fixture
+def api_server():
+    """Minimal live server on the fake runtime (daemon wiring, port 0)."""
+    from tpu_docker_api.api.app import ApiServer, build_router
+    from tpu_docker_api.scheduler.ports import PortScheduler
+    from tpu_docker_api.scheduler.slices import ChipScheduler
+    from tpu_docker_api.scheduler.topology import HostTopology
+    from tpu_docker_api.service.container import ContainerService
+    from tpu_docker_api.service.volume import VolumeService
+    from tpu_docker_api.state import keys
+    from tpu_docker_api.state.kv import open_store
+    from tpu_docker_api.state.store import StateStore
+    from tpu_docker_api.state.version import VersionMap
+    from tpu_docker_api.state.workqueue import WorkQueue
+
+    kv = open_store("memory")
+    store = StateStore(kv)
+    runtime = FakeRuntime()
+    wq = WorkQueue(kv)
+    wq.start()
+    chips = ChipScheduler(HostTopology.build("v5e-8"), kv)
+    ports = PortScheduler(kv, 41000, 41099)
+    csvc = ContainerService(
+        runtime, store, chips, ports,
+        VersionMap(kv, keys.VERSIONS_CONTAINER_KEY), wq)
+    vsvc = VolumeService(runtime, store,
+                         VersionMap(kv, keys.VERSIONS_VOLUME_KEY), wq)
+    watcher = HealthWatcher(runtime, interval_s=3600,  # manual ticks only
+                            restart_policy="on-failure",
+                            crash_handler=csvc.handle_crash)
+    router = build_router(csvc, vsvc, chips, ports, work_queue=wq,
+                          health_watcher=watcher)
+    server = ApiServer(router, port=0)
+    server.start()
+    yield server, runtime, watcher, csvc, chips, wq
+    server.close()
+    wq.close()
+    kv.close()
+
+
+def _req(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request(method, path, json.dumps(body) if body else None)
+    resp = conn.getresponse()
+    raw = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return raw, headers
+
+
+class TestHttpObservability:
+    def test_metrics_endpoint_and_request_id(self, api_server):
+        server, *_ = api_server
+        raw, headers = _req(server.port, "POST", "/api/v1/containers",
+                            {"imageName": "jax", "containerName": "m",
+                             "chipCount": 1})
+        assert json.loads(raw)["code"] == 200
+        assert "X-Request-Id" in headers
+
+        raw, headers = _req(server.port, "GET", "/metrics")
+        text = raw.decode()
+        assert "text/plain" in headers["Content-Type"]
+        assert 'api_requests_total{code="200",method="POST",route="/api/v1/containers"} 1' in text
+        assert "api_request_duration_seconds_bucket" in text
+        assert "tpu_chips_free 7" in text
+        assert "tpu_chips_total 8" in text
+
+    def test_request_id_propagates(self, api_server):
+        server, *_ = api_server
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        conn.request("GET", "/healthz", headers={"X-Request-Id": "abc123"})
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.getheader("X-Request-Id") == "abc123"
+        conn.close()
+
+    def test_events_endpoint(self, api_server):
+        server, runtime, watcher, *_ = api_server
+        runtime.container_create(ContainerSpec(image="i", name="e-0"))
+        runtime.container_start("e-0")
+        watcher.poll_once()
+        raw, _ = _req(server.port, "GET", "/api/v1/events")
+        events = json.loads(raw)["data"]
+        assert any(e["container"] == "e-0" and e["event"] == "observed"
+                   for e in events)
+
+
+class TestHealthWatcher:
+    def _mk(self, policy="none", max_restarts=3):
+        rt = FakeRuntime()
+        w = HealthWatcher(rt, interval_s=3600, restart_policy=policy,
+                          max_restarts=max_restarts)
+        return rt, w
+
+    def test_records_lifecycle_transitions(self):
+        rt, w = self._mk()
+        rt.container_create(ContainerSpec(image="i", name="c-0"))
+        rt.container_start("c-0")
+        w.poll_once()
+        rt.container_stop("c-0")
+        w.poll_once()
+        rt.container_remove("c-0", force=True)
+        w.poll_once()
+        kinds = [e["event"] for e in w.events_view()]
+        assert kinds == ["observed", "died", "removed"]
+
+    def test_on_failure_restarts_crashed_container(self):
+        rt, w = self._mk(policy="on-failure")
+        rt.container_create(ContainerSpec(image="i", name="c-0"))
+        rt.container_start("c-0")
+        w.poll_once()
+        rt.crash_container("c-0", exit_code=137)
+        w.poll_once()
+        assert rt.container_inspect("c-0").running  # restarted
+        kinds = [e["event"] for e in w.events_view()]
+        assert "died" in kinds and "restarted" in kinds
+
+    def test_clean_exit_not_restarted(self):
+        rt, w = self._mk(policy="on-failure")
+        rt.container_create(ContainerSpec(image="i", name="c-0"))
+        rt.container_start("c-0")
+        w.poll_once()
+        rt.crash_container("c-0", exit_code=0)
+        w.poll_once()
+        assert not rt.container_inspect("c-0").running
+
+    def test_restart_budget_bounded(self):
+        rt, w = self._mk(policy="on-failure", max_restarts=2)
+        rt.container_create(ContainerSpec(image="i", name="c-0"))
+        rt.container_start("c-0")
+        w.poll_once()
+        for _ in range(4):
+            rt.crash_container("c-0", exit_code=1)
+            w.poll_once()
+        kinds = [e["event"] for e in w.events_view()]
+        assert kinds.count("restarted") == 2
+        assert "restart-budget-exhausted" in kinds
+
+
+class TestCrashRecoveryIntegration:
+    """Watcher + ContainerService.handle_crash: recovery honors declarative
+    liveness and scheduler accounting (no double allocation)."""
+
+    def test_crash_of_desired_running_container_recovers(self, api_server):
+        from tpu_docker_api.schemas.container import ContainerRun
+
+        _, runtime, watcher, csvc, chips, wq = api_server
+        csvc.run_container(ContainerRun.from_dict(
+            {"imageName": "jax", "containerName": "crashy", "chipCount": 2}))
+        watcher.poll_once()
+        free_before = chips.status()["freeChips"]
+        runtime.crash_container("crashy-0", exit_code=137)
+        watcher.poll_once()
+        assert runtime.container_inspect("crashy-0").running
+        # crash + recovery must not touch chip accounting
+        assert chips.status()["freeChips"] == free_before
+
+    def test_user_stop_never_resurrected(self, api_server):
+        from tpu_docker_api.schemas.container import ContainerRun
+
+        _, runtime, watcher, csvc, chips, wq = api_server
+        csvc.run_container(ContainerRun.from_dict(
+            {"imageName": "jax", "containerName": "stoppy", "chipCount": 2}))
+        watcher.poll_once()
+        csvc.stop_container("stoppy-0")  # releases chips, desired_running=False
+        free_after_stop = chips.status()["freeChips"]
+        # docker-style: deliberate stop still reports a nonzero exit code
+        runtime.crash_container("stoppy-0", exit_code=143)
+        watcher.poll_once()
+        assert not runtime.container_inspect("stoppy-0").running
+        kinds = [e["event"] for e in watcher.events_view()]
+        assert "restart-declined" in kinds
+        # and the released chips stay released (no double allocation setup)
+        assert chips.status()["freeChips"] == free_after_stop
+
+    def test_retired_version_not_resurrected(self, api_server):
+        from tpu_docker_api.schemas.container import (
+            ContainerPatchChips,
+            ContainerRun,
+        )
+
+        _, runtime, watcher, csvc, chips, wq = api_server
+        csvc.run_container(ContainerRun.from_dict(
+            {"imageName": "jax", "containerName": "roll", "chipCount": 1}))
+        watcher.poll_once()
+        csvc.patch_container_chips("roll-0", ContainerPatchChips(chip_count=2))
+        wq.drain()  # quiesce->copy->start is ordered on the work queue
+        watcher.poll_once()  # observe roll-1, see roll-0 died
+        if runtime.container_inspect("roll-0").running:
+            runtime.crash_container("roll-0", exit_code=1)
+        watcher.poll_once()
+        assert not runtime.container_inspect("roll-0").running
+        assert runtime.container_inspect("roll-1").running

@@ -34,6 +34,9 @@ import json
 import logging
 import re
 import threading
+import time
+import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_docker_api import errors
@@ -71,30 +74,45 @@ def _validate_ref_name(name: str) -> None:
 
 
 class Router:
-    """Tiny method+pattern router; patterns use ``{name}`` segments."""
+    """Tiny method+pattern router; patterns use ``{name}`` segments. Carries
+    its own metrics registry so each server instance exposes only its own
+    series at /metrics."""
 
-    def __init__(self) -> None:
-        self._routes: list[tuple[str, re.Pattern, callable]] = []
+    def __init__(self, metrics=None) -> None:
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        self._routes: list[tuple[str, re.Pattern, str, callable]] = []
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
 
     def add(self, method: str, pattern: str, handler) -> None:
         regex = re.compile(
             "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
         )
-        self._routes.append((method, regex, handler))
+        self._routes.append((method, regex, pattern, handler))
 
-    def dispatch(self, method: str, path: str, body: dict):
-        for m, regex, handler in self._routes:
+    def match(self, method: str, path: str):
+        """(handler, path_params, route_pattern) or None. The pattern is the
+        low-cardinality metrics label (never the raw path)."""
+        for m, regex, pattern, handler in self._routes:
             if m != method:
                 continue
             match = regex.match(path)
             if match:
-                return handler(body=body, **match.groupdict())
-        raise errors.BadRequest(f"no route for {method} {path}")
+                return handler, match.groupdict(), pattern
+        return None
+
+    def dispatch(self, method: str, path: str, body: dict):
+        found = self.match(method, path)
+        if found is None:
+            raise errors.BadRequest(f"no route for {method} {path}")
+        handler, params, _ = found
+        return handler(body=body, **params)
 
 
 def build_router(container_svc: ContainerService, volume_svc: VolumeService,
-                 chip_scheduler, port_scheduler, work_queue=None) -> Router:
-    r = Router()
+                 chip_scheduler, port_scheduler, work_queue=None,
+                 health_watcher=None, metrics=None) -> Router:
+    r = Router(metrics=metrics)
 
     # -- containers (reference api/container.go:19-38) ---------------------------
 
@@ -215,15 +233,48 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/resources/gpus", lambda body, **_: chip_scheduler.status())
     r.add("GET", "/api/v1/resources/ports", lambda body, **_: port_scheduler.status())
     r.add("GET", "/healthz", lambda body, **_: {"status": "ok"})
+    if health_watcher is not None:
+        # liveness transitions + auto-restart bookkeeping (SURVEY.md §5.3)
+        def h_events(body, **_):
+            try:
+                limit = int(body.get("limit", 100))
+            except (TypeError, ValueError):
+                raise errors.BadRequest("limit must be an integer") from None
+            return health_watcher.events_view(limit=limit)
+
+        r.add("GET", "/api/v1/events", h_events)
+        r.add("GET", "/api/v1/health/containers",
+              lambda body, **_: health_watcher.status_view())
     if work_queue is not None:
         # failed async tasks must be observable (fix for the reference's
         # silent infinite-retry loop, workQueue.go:33-47)
         r.add("GET", "/api/v1/debug/deadletters",
               lambda body, **_: work_queue.dead_letter_view())
+
+    # pull-time utilization gauges for /metrics (SURVEY.md §5.5)
+    r.metrics.gauge_fn(
+        "tpu_chips_free",
+        lambda: chip_scheduler.status().get("freeChips", 0),
+        help="Unallocated TPU chips on this host")
+    r.metrics.gauge_fn(
+        "tpu_chips_total",
+        lambda: chip_scheduler.status().get("totalChips", 0),
+        help="Total TPU chips on this host")
+    r.metrics.gauge_fn(
+        "host_ports_used",
+        lambda: len(port_scheduler.status().get("usedPorts", [])),
+        help="Host ports handed out by the port scheduler")
+    if work_queue is not None:
+        from tpu_docker_api.state.workqueue import queue_depth
+
+        r.metrics.gauge_fn("workqueue_depth", lambda: queue_depth(work_queue),
+                           help="Pending async tasks")
     return r
 
 
 def build_handler(router: Router):
+    registry = router.metrics
+
     class Handler(BaseHTTPRequestHandler):
         server_version = "tpu-docker-api"
         protocol_version = "HTTP/1.1"
@@ -232,24 +283,63 @@ def build_handler(router: Router):
             log.debug("http: " + fmt, *args)
 
         def _handle(self, method: str) -> None:
+            # tracing (SURVEY.md §5.1 — absent in the reference): every
+            # request gets an id, a span log line, and metric series keyed by
+            # route pattern
+            req_id = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:12]
+            path, _, query = self.path.partition("?")
+            if method == "GET" and path == "/metrics":
+                body_bytes = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body_bytes)))
+                self.end_headers()
+                self.wfile.write(body_bytes)
+                return
+            found = router.match(method, path)
+            route = found[2] if found else "unmatched"
+            t0 = time.perf_counter()
+            app_code = codes.SUCCESS
             try:
+                if found is None:
+                    raise errors.BadRequest(f"no route for {method} {path}")
+                handler, params, _ = found
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
                 body = json.loads(raw) if raw else {}
                 if not isinstance(body, dict):
                     raise errors.BadRequest("body must be a JSON object")
-                data = router.dispatch(method, self.path.split("?")[0], body)
+                # query params merge under the body (body wins): GET handlers
+                # take options like ?limit=5 the natural way
+                for k, vs in urllib.parse.parse_qs(query).items():
+                    body.setdefault(k, vs[-1])
+                data = handler(body=body, **params)
                 payload = response.success(data)
             except errors.ApiError as e:
+                app_code = e.code
                 payload = response.error(e.code, str(e))
             except json.JSONDecodeError as e:
+                app_code = codes.BAD_REQUEST
                 payload = response.error(codes.BAD_REQUEST, f"invalid JSON: {e}")
             except Exception as e:  # noqa: BLE001 — envelope every failure
-                log.exception("unhandled error on %s %s", method, self.path)
+                app_code = codes.SERVER_ERROR
+                log.exception("unhandled error on %s %s id=%s",
+                              method, self.path, req_id)
                 payload = response.error(codes.SERVER_ERROR, str(e))
+            dur = time.perf_counter() - t0
+            labels = {"method": method, "route": route, "code": str(app_code)}
+            registry.counter_inc("api_requests_total", labels,
+                                 help="API requests by route and app code")
+            registry.observe("api_request_duration_seconds",
+                             dur, {"method": method, "route": route},
+                             help="API request latency")
+            log.info("%s %s code=%d dur=%.1fms id=%s",
+                     method, path, app_code, dur * 1e3, req_id)
             # reference: always HTTP 200, app code in envelope (response.go:15-29)
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
+            self.send_header("X-Request-Id", req_id)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)

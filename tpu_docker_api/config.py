@@ -39,6 +39,10 @@ class Config:
     docker_host: str = "unix:///var/run/docker.sock"
     # path to libtpu.so to bind-mount into TPU containers ("" ⇒ image's own)
     libtpu_path: str = ""
+    # health watcher (service/watch.py): poll interval; 0 disables the watcher
+    health_watch_interval: float = 5.0
+    # "none" (observe only) | "on-failure" (bounded auto-restart)
+    restart_policy: str = "none"
 
 
 def load(path: str | None = None) -> Config:
@@ -54,4 +58,8 @@ def load(path: str | None = None) -> Config:
         for field in dataclasses.fields(Config):
             if field.name in data:
                 setattr(cfg, field.name, data[field.name])
+    if cfg.restart_policy not in ("none", "on-failure"):
+        raise ValueError(
+            f"restart_policy must be 'none' or 'on-failure', "
+            f"got {cfg.restart_policy!r}")
     return cfg

@@ -95,10 +95,26 @@ class Program:
         return HostTopology.build(cfg.accelerator_type)
 
     def start(self) -> None:
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
         self.wq.start()
+        self.metrics = MetricsRegistry()
+        self.health_watcher = None
+        if self.cfg.health_watch_interval > 0:
+            from tpu_docker_api.service.watch import HealthWatcher
+
+            self.health_watcher = HealthWatcher(
+                self.runtime,
+                interval_s=self.cfg.health_watch_interval,
+                restart_policy=self.cfg.restart_policy,
+                crash_handler=self.container_svc.handle_crash,
+                registry=self.metrics,
+            )
+            self.health_watcher.start()
         router = build_router(
             self.container_svc, self.volume_svc,
             self.chip_scheduler, self.port_scheduler, work_queue=self.wq,
+            health_watcher=self.health_watcher, metrics=self.metrics,
         )
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
         self.api_server.start()
@@ -110,6 +126,8 @@ class Program:
     def stop(self) -> None:
         if self.api_server:
             self.api_server.close()
+        if getattr(self, "health_watcher", None) is not None:
+            self.health_watcher.close()
         self.wq.close()
         self.runtime.close()
         self.kv.close()

@@ -78,7 +78,18 @@ class FakeRuntime(ContainerRuntime):
         with self._mu:
             info = self._get(name)
             info.running = True
+            info.exit_code = 0
             self.calls.append(("restart", name))
+
+    def crash_container(self, name: str, exit_code: int = 137) -> None:
+        """Fault injection (SURVEY.md §5.3 — absent in the reference): make a
+        running container die out-of-band, as OOM/preemption would."""
+        with self._mu:
+            info = self._get(name)
+            info.running = False
+            info.pid = 0
+            info.exit_code = exit_code
+            self.calls.append(("crash", name))
 
     def container_remove(self, name: str, force: bool = False) -> None:
         with self._mu:

@@ -20,6 +20,10 @@ class ContainerState:
     container_name: str  # versioned name, e.g. "train-3"
     version: int
     spec: dict[str, Any]  # runtime.spec.ContainerSpec.to_dict()
+    # declarative liveness: False after a deliberate stop. The health
+    # watcher's crash recovery only resurrects containers whose latest
+    # version wants to run (SURVEY.md §5.3)
+    desired_running: bool = True
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -30,6 +34,7 @@ class ContainerState:
             container_name=d["container_name"],
             version=int(d["version"]),
             spec=d["spec"],
+            desired_running=bool(d.get("desired_running", True)),
         )
 
 

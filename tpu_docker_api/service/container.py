@@ -301,6 +301,7 @@ class ContainerService:
                 self.ports.restore_ports(
                     [pb.host_port for pb in info.spec.port_bindings], owner=base
                 )
+            self._set_desired_running(latest_name, False)
             log.info("stopped container %s", latest_name)
 
     # -- 7. restart (PATCH /containers/{name}/restart; reference :365-425) --------
@@ -315,12 +316,14 @@ class ContainerService:
             if not spec.chip_ids:
                 # cardless short-circuit: plain runtime restart (reference :372-386)
                 self.runtime.container_restart(latest_name)
+                self._set_desired_running(latest_name, True)
                 return {"name": latest_name}
 
             info = self.runtime.container_inspect(latest_name)
             if info.running:
                 # running carded container: devices still attached; plain restart
                 self.runtime.container_restart(latest_name)
+                self._set_desired_running(latest_name, True)
                 return {"name": latest_name}
 
             # stopped carded container: its chips/ports were restored on stop, so
@@ -343,6 +346,47 @@ class ContainerService:
                 raise
             log.info("restarted %s as %s (chips=%s)", latest_name, new_name, chip_ids)
             return {"name": new_name, "chipIds": chip_ids}
+
+    def _set_desired_running(self, versioned: str, value: bool) -> None:
+        """Record declarative liveness on the persisted state (synchronous —
+        the crash-recovery decision must survive a control-plane restart)."""
+        try:
+            state = self.store.get_container(versioned)
+        except errors.NotExistInStore:
+            return
+        if state.desired_running != value:
+            state.desired_running = value
+            self.store.put_container(state)
+
+    def handle_crash(self, name: str) -> bool:
+        """Crash-recovery entry for the health watcher (service/watch.py).
+
+        Restart ``name`` only when (a) it is its family's LATEST version —
+        retired versions from rolling replaces stay down — and (b) the
+        control plane last wanted it running (stop_container records
+        desired_running=False, so a user stop that exits 143 is never
+        mistaken for a crash). Holds the family lock so recovery cannot race
+        user mutations. A crash releases no chips/ports (only stop does), so
+        the plain runtime restart keeps scheduler accounting consistent.
+        Returns whether the container is running again.
+        """
+        base, version = split_versioned_name(name)
+        with self._locks.hold(base):
+            latest = self.versions.get(base)
+            if latest is None or versioned_name(base, latest) != name:
+                return False
+            try:
+                state = self.store.get_container(name)
+            except errors.NotExistInStore:
+                return False
+            if not state.desired_running:
+                return False
+            info = self.runtime.container_inspect(name)
+            if info.running:
+                return True  # already recovered out-of-band
+            self.runtime.container_restart(name)
+            log.info("crash recovery: restarted %s", name)
+            return True
 
     # -- 8. commit (POST /containers/{name}/commit; reference :428-447) -----------
 

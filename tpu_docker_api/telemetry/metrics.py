@@ -1,0 +1,142 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+SURVEY.md §5.5: the reference has no metrics at all (logging only, two pull
+endpoints for scheduler maps). This supplies the missing layer: counters,
+gauges and histograms behind one lock, rendered in Prometheus text format at
+``GET /metrics``. Stdlib-only — no prometheus_client dependency to gate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Iterable
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                    10.0)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Thread-safe metric store. All mutators take a labels dict; each
+    distinct label set is its own series, Prometheus-style."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, dict[tuple, float]] = {}
+        self._gauges: dict[str, dict[tuple, float]] = {}
+        self._hists: dict[str, dict[tuple, list]] = {}
+        self._hist_buckets: dict[str, tuple[float, ...]] = {}
+        self._help: dict[str, tuple[str, str]] = {}  # name -> (type, help)
+        self._gauge_fns: dict[str, Callable[[], float]] = {}
+        self._label_names: dict[str, tuple[str, ...]] = {}
+
+    def _series_key(self, name: str, labels: dict | None) -> tuple:
+        labels = labels or {}
+        self._label_names.setdefault(name, tuple(sorted(labels)))
+        return tuple(sorted(labels.items()))
+
+    def counter_inc(self, name: str, labels: dict | None = None,
+                    value: float = 1.0, help: str = "") -> None:
+        with self._lock:
+            self._help.setdefault(name, ("counter", help))
+            series = self._counters.setdefault(name, {})
+            key = self._series_key(name, labels)
+            series[key] = series.get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float, labels: dict | None = None,
+                  help: str = "") -> None:
+        with self._lock:
+            self._help.setdefault(name, ("gauge", help))
+            self._gauges.setdefault(name, {})[
+                self._series_key(name, labels)] = value
+
+    def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
+        """Register a pull-time gauge (queue depth, free chips, ...)."""
+        with self._lock:
+            self._help.setdefault(name, ("gauge", help))
+            self._gauge_fns[name] = fn
+
+    def observe(self, name: str, value: float, labels: dict | None = None,
+                buckets: Iterable[float] = _DEFAULT_BUCKETS,
+                help: str = "") -> None:
+        with self._lock:
+            self._help.setdefault(name, ("histogram", help))
+            bks = self._hist_buckets.setdefault(name, tuple(buckets))
+            series = self._hists.setdefault(name, {})
+            key = self._series_key(name, labels)
+            if key not in series:
+                series[key] = [[0] * (len(bks) + 1), 0.0, 0]  # bucket counts, sum, n
+            counts, total, n = series[key]
+            for i, b in enumerate(bks):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+            series[key] = [counts, total + value, n + 1]
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: list[str] = []
+        with self._lock:
+            for name, (typ, hlp) in sorted(self._help.items()):
+                if hlp:
+                    out.append(f"# HELP {name} {hlp}")
+                out.append(f"# TYPE {name} {typ}")
+                if typ == "counter":
+                    for key, v in sorted(self._counters.get(name, {}).items()):
+                        out.append(f"{name}{_fmt_labels(dict(key))} {v:g}")
+                elif typ == "gauge":
+                    if name in self._gauge_fns:
+                        try:
+                            v = float(self._gauge_fns[name]())
+                        except Exception:  # pragma: no cover — never break /metrics
+                            continue
+                        out.append(f"{name} {v:g}")
+                    for key, v in sorted(self._gauges.get(name, {}).items()):
+                        out.append(f"{name}{_fmt_labels(dict(key))} {v:g}")
+                else:  # histogram
+                    bks = self._hist_buckets.get(name, ())
+                    for key, (counts, total, n) in sorted(
+                            self._hists.get(name, {}).items()):
+                        labels = dict(key)
+                        # counts are already cumulative (observe() increments
+                        # every bucket the value fits in)
+                        for i, b in enumerate(bks):
+                            out.append(
+                                f"{name}_bucket"
+                                f"{_fmt_labels({**labels, 'le': f'{b:g}'})} "
+                                f"{counts[i]}")
+                        out.append(
+                            f"{name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {n}")
+                        out.append(f"{name}_sum{_fmt_labels(labels)} {total:g}")
+                        out.append(f"{name}_count{_fmt_labels(labels)} {n}")
+        return "\n".join(out) + "\n"
+
+
+#: process-wide default registry (api/app.py, service watchers)
+REGISTRY = MetricsRegistry()
+
+
+class Timer:
+    """Context manager: observe elapsed seconds into a histogram."""
+
+    def __init__(self, registry: MetricsRegistry, name: str,
+                 labels: dict | None = None):
+        self._r, self._name, self._labels = registry, name, labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._r.observe(self._name, time.perf_counter() - self._t0,
+                        self._labels)
