@@ -1,0 +1,325 @@
+"""Multi-host pod scheduler + distributed JobService.
+
+The capability tier the reference lacks entirely (single docker socket,
+single GPU map — SURVEY.md §4 "multi-node is untested and unsupported"):
+a v5p-64-class pod of 8 fake hosts, host-granular slice allocation, one
+process container per host with the JAX/libtpu bootstrap env, and rolling
+job rescale with quiesce→replace ordering.
+"""
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.scheduler.pod import Pod, PodHost, PodScheduler
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.scheduler.topology import GENERATIONS, HostTopology
+from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun
+from tpu_docker_api.service.job import JobService
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+
+
+def make_pod(kv, grid=(2, 2, 2), acc="v5p-8"):
+    """Pod of v5p hosts (4 chips each, 2x2x1) on a host grid — (2,2,2) grid
+    = 32 chips = a v5p-64 slice."""
+    hosts = []
+    i = 0
+    for z in range(grid[2]):
+        for y in range(grid[1]):
+            for x in range(grid[0]):
+                hid = f"h{i}"
+                topo = HostTopology.build(acc)
+                hosts.append(PodHost(
+                    host_id=hid,
+                    address=f"10.0.0.{i + 1}",
+                    grid_coord=(x, y, z),
+                    topology=topo,
+                    runtime=FakeRuntime(),
+                    chips=ChipScheduler(topo, kv, keys.host_chips_key(hid)),
+                    ports=PortScheduler(kv, 40000, 40100,
+                                        store_key=keys.host_ports_key(hid)),
+                ))
+                i += 1
+    return Pod(GENERATIONS["v5p"], grid, hosts)
+
+
+@pytest.fixture
+def kv():
+    return MemoryKV()
+
+
+@pytest.fixture
+def pod(kv):
+    return make_pod(kv)
+
+
+@pytest.fixture
+def sched(pod, kv):
+    return PodScheduler(pod, kv)
+
+
+@pytest.fixture
+def svc(pod, sched, kv):
+    return JobService(pod, sched, StateStore(kv), VersionMap(kv, keys.VERSIONS_JOB_KEY))
+
+
+class TestPodScheduler:
+    def test_full_pod_slice(self, sched):
+        grant = sched.apply_slice(n_chips=32, owner="big-1")
+        assert grant.n_chips == 32
+        assert len(grant.hosts) == 8
+        assert grant.host_block_shape == (2, 2, 2)
+        assert grant.ici_contiguous
+
+    def test_multi_host_block_is_contiguous(self, pod, sched):
+        grant = sched.apply_slice(n_chips=16, owner="j-1")
+        assert len(grant.hosts) == 4
+        coords = [pod.hosts[h].grid_coord for h, _ in grant.hosts]
+        spans = [max(c[d] for c in coords) - min(c[d] for c in coords) + 1
+                 for d in range(3)]
+        assert spans[0] * spans[1] * spans[2] == 4  # fills its bounding box
+
+    def test_host_granularity_enforced(self, sched):
+        with pytest.raises(errors.ChipNotEnough):
+            sched.apply_slice(n_chips=6, owner="odd-1")  # 1.5 hosts
+
+    def test_sub_host_delegates_to_one_host(self, pod, sched):
+        grant = sched.apply_slice(n_chips=2, owner="small-1")
+        assert len(grant.hosts) == 1
+        host_id, chips = grant.hosts[0]
+        assert len(chips) == 2
+        assert set(pod.hosts[host_id].chips.free_chips) == {0, 1, 2, 3} - set(chips)
+
+    def test_sub_host_tightest_fit(self, pod, sched):
+        sched.apply_slice(n_chips=2, owner="a-1")
+        # next 2-chip ask should pack onto the same (now tightest) host
+        grant = sched.apply_slice(n_chips=2, owner="b-1")
+        assert grant.hosts[0][0] == "h0"
+        assert pod.hosts["h0"].chips.free_chips == []
+
+    def test_partial_host_blocks_multi_host_slice(self, sched):
+        sched.apply_slice(n_chips=1, owner="frag-1")  # dirties one host
+        grant = sched.apply_slice(n_chips=16, owner="j-1")  # still 7 clean hosts? need 4
+        assert len(grant.hosts) == 4
+        with pytest.raises(errors.ChipNotEnough):
+            sched.apply_slice(n_chips=16, owner="j2-1")  # only 3 clean hosts left
+
+    def test_restore_slice_owner_guarded(self, pod, sched):
+        grant = sched.apply_slice(n_chips=8, owner="j-1")
+        sched.restore_slice("j-1")
+        for host_id, chips in grant.hosts:
+            assert set(chips) <= set(pod.hosts[host_id].chips.free_chips)
+        sched.restore_slice("j-1")  # double restore is a no-op
+        assert sched.get_grant("j-1") is None
+
+    def test_duplicate_owner_rejected(self, sched):
+        sched.apply_slice(n_chips=4, owner="j-1")
+        with pytest.raises(errors.ContainerExisted):
+            sched.apply_slice(n_chips=4, owner="j-1")
+
+    def test_grants_survive_restart(self, pod, kv, sched):
+        sched.apply_slice(n_chips=16, owner="j-1")
+        # new scheduler over the same KV (crash-restart) sees the grant and
+        # the per-host chip claims
+        pod2 = make_pod(kv)
+        sched2 = PodScheduler(pod2, kv)
+        g = sched2.get_grant("j-1")
+        assert g is not None and g.n_chips == 16
+        with pytest.raises(errors.ChipNotEnough):
+            sched2.apply_slice(n_chips=32, owner="j2-1")
+
+    def test_status_view(self, sched):
+        sched.apply_slice(n_chips=8, owner="j-1")
+        st = sched.status()
+        assert st["totalChips"] == 32
+        assert st["chipsPerHost"] == 4
+        assert st["freeHosts"] == 6
+        assert st["globalMeshShape"] == [4, 4, 2]
+        assert "j-1" in st["slices"]
+
+
+class TestJobService:
+    def test_run_multi_host_job(self, pod, svc):
+        info = svc.run_job(JobRun(image_name="maxtext:tpu", job_name="train",
+                                  chip_count=16, cmd=["python", "train.py"],
+                                  binds=["/nfs/ckpt:/ckpt"]))
+        assert info["name"] == "train-0"
+        assert len(info["processes"]) == 4
+        # one container per host, running, with the distributed bootstrap env
+        seen_hosts = set()
+        for proc in info["processes"]:
+            host = pod.hosts[proc["hostId"]]
+            seen_hosts.add(proc["hostId"])
+            ci = host.runtime.container_inspect(proc["container"])
+            assert ci.running
+            env = dict(e.split("=", 1) for e in ci.spec.env)
+            assert env["JAX_PROCESS_ID"] == str(proc["processId"])
+            assert env["JAX_NUM_PROCESSES"] == "4"
+            assert env["CLOUD_TPU_TASK_ID"] == str(proc["processId"])
+            assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+            assert env["TPU_PROCESS_BOUNDS"].count(",") == 2
+            assert len(env["TPU_PROCESS_ADDRESSES"].split(",")) == 4
+            assert "/nfs/ckpt:/ckpt" in ci.spec.binds
+            # coordinator names process 0's host
+            assert env["JAX_COORDINATOR_ADDRESS"].startswith(
+                pod.hosts[info["processes"][0]["hostId"]].address)
+        assert len(seen_hosts) == 4
+
+    def test_process_bounds_match_host_block(self, pod, svc):
+        info = svc.run_job(JobRun(image_name="i", job_name="j", chip_count=32))
+        ci = pod.hosts[info["processes"][0]["hostId"]].runtime.container_inspect(
+            info["processes"][0]["container"])
+        env = dict(e.split("=", 1) for e in ci.spec.env)
+        assert env["TPU_PROCESS_BOUNDS"] == "2,2,2"
+
+    def test_single_host_job(self, pod, svc):
+        info = svc.run_job(JobRun(image_name="i", job_name="small", chip_count=2))
+        assert len(info["processes"]) == 1
+        ci = pod.hosts[info["processes"][0]["hostId"]].runtime.container_inspect(
+            info["processes"][0]["container"])
+        env = dict(e.split("=", 1) for e in ci.spec.env)
+        assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+    def test_accelerator_type_ask(self, svc):
+        info = svc.run_job(JobRun(image_name="i", job_name="j",
+                                  accelerator_type="v5p-64"))
+        assert info["chipCount"] == 32
+        assert len(info["processes"]) == 8
+
+    def test_duplicate_job_rejected(self, svc):
+        svc.run_job(JobRun(image_name="i", job_name="j", chip_count=4))
+        with pytest.raises(errors.ContainerExisted):
+            svc.run_job(JobRun(image_name="i", job_name="j", chip_count=4))
+
+    def test_rolling_rescale_grows(self, pod, svc, sched):
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8,
+                           binds=["/nfs/ckpt:/ckpt"]))
+        info = svc.patch_job_chips("t", JobPatchChips(chip_count=16))
+        assert info["name"] == "t-1"
+        assert info["chipCount"] == 16
+        # old version quiesced (stopped, not removed), new running, old slice freed
+        old = svc.store.get_job("t-0")
+        assert old.desired_running is False
+        for host_id, cname, *_ in old.placements:
+            assert pod.hosts[host_id].runtime.container_inspect(cname).running is False
+        assert sched.get_grant("t-0") is None
+        assert sched.get_grant("t-1") is not None
+        # checkpoint bind carried over
+        p0 = info["processes"][0]
+        ci = pod.hosts[p0["hostId"]].runtime.container_inspect(p0["container"])
+        assert "/nfs/ckpt:/ckpt" in ci.spec.binds
+
+    def test_rescale_noop(self, svc):
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        with pytest.raises(errors.NoPatchRequired):
+            svc.patch_job_chips("t", JobPatchChips(chip_count=8))
+
+    def test_rescale_uses_freed_capacity(self, svc):
+        """Grow 16→32 on a 32-chip pod: only possible because the old slice is
+        quiesced and freed before the new allocation."""
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=16))
+        info = svc.patch_job_chips("t", JobPatchChips(chip_count=32))
+        assert info["chipCount"] == 32
+
+    def test_rescale_version_check(self, svc):
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        with pytest.raises(errors.VersionNotMatch):
+            svc.patch_job_chips("t-7", JobPatchChips(chip_count=16))
+
+    def test_stop_restart(self, pod, svc):
+        info = svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        svc.stop_job("t")
+        for proc in info["processes"]:
+            assert not pod.hosts[proc["hostId"]].runtime.container_inspect(
+                proc["container"]).running
+        svc.restart_job("t")
+        for proc in info["processes"]:
+            assert pod.hosts[proc["hostId"]].runtime.container_inspect(
+                proc["container"]).running
+
+    def test_delete_frees_everything(self, pod, svc, sched):
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=16))
+        svc.patch_job_chips("t", JobPatchChips(chip_count=8))
+        svc.delete_job("t", JobDelete(force=True, del_state_and_version_record=True))
+        for host in pod.hosts.values():
+            assert len(host.chips.free_chips) == 4
+            assert host.ports.status()["usedPorts"] == []
+            assert host.runtime.container_list() == []
+        assert svc.versions.get("t") is None
+
+    def test_job_info_live_state(self, svc):
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        info = svc.get_job_info("t")
+        assert all(p["running"] for p in info["processes"])
+        svc.stop_job("t")
+        info = svc.get_job_info("t")
+        assert not any(p["running"] for p in info["processes"])
+
+    def test_rescale_fast_path_frees_old_slice(self, svc, sched):
+        """Grow 8→16 with room for both: allocate-first path; old slice freed
+        after the swap, historical version still inspectable."""
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        info = svc.patch_job_chips("t", JobPatchChips(chip_count=16))
+        assert info["chipCount"] == 16
+        assert sched.get_grant("t-0") is None
+        assert sched.get_grant("t-1") is not None
+        old = svc.get_job_info("t-0")  # historical read allowed
+        assert old["desiredRunning"] is False
+        assert not any(p["running"] for p in old["processes"])
+
+    def test_bad_job_names_rejected(self, svc):
+        for bad in ("", "a/b", "a b", "a-b"):
+            with pytest.raises(errors.BadRequest):
+                svc.run_job(JobRun(image_name="i", job_name=bad, chip_count=4))
+
+    def test_daemon_local_host_shares_chip_accounting(self):
+        """A pod_hosts entry with local=true must reuse the container
+        service's chip scheduler — chips handed to a local container are not
+        re-grantable to a job."""
+        from tpu_docker_api.config import Config
+        from tpu_docker_api.daemon import Program
+        from tpu_docker_api.schemas.container import ContainerRun
+
+        cfg = Config(port=0, runtime_backend="fake", accelerator_type="v5p-8",
+                     health_watch_interval=0,
+                     pod_hosts=[
+                         {"host_id": "me", "address": "10.0.0.1",
+                          "grid_coord": [0, 0, 0], "local": True},
+                         {"host_id": "h1", "address": "10.0.0.2",
+                          "grid_coord": [1, 0, 0], "runtime_backend": "fake"},
+                     ])
+        prg = Program(cfg)
+        prg.init()
+        try:
+            assert prg.pod.hosts["me"].chips is prg.chip_scheduler
+            assert prg.pod.hosts["me"].runtime is prg.runtime
+            prg.container_svc.run_container(ContainerRun(
+                image_name="i", container_name="c", chip_count=3))
+            # "me" now has 1 free chip; an 8-chip (2-host) job cannot use it
+            with pytest.raises(errors.ChipNotEnough):
+                prg.job_svc.run_job(JobRun(image_name="i", job_name="j",
+                                           chip_count=8))
+            # but a 4-chip job fits on the clean remote host
+            info = prg.job_svc.run_job(JobRun(image_name="i", job_name="j2",
+                                              chip_count=4))
+            assert info["processes"][0]["hostId"] == "h1"
+        finally:
+            prg.wq.close()
+
+    def test_create_failure_rolls_back(self, pod, svc, sched):
+        # occupy a name on one host so container_create collides
+        victim_host = pod.hosts["h0"]
+        from tpu_docker_api.runtime.spec import ContainerSpec
+        victim_host.runtime.container_create(
+            ContainerSpec(name="boom-0-p0", image="x"))
+        with pytest.raises(errors.ContainerExisted):
+            svc.run_job(JobRun(image_name="i", job_name="boom", chip_count=32))
+        # everything returned: slice grant gone, chips free, no version record
+        assert sched.get_grant("boom-0") is None
+        for host in pod.hosts.values():
+            assert len(host.chips.free_chips) == 4
+        assert svc.versions.get("boom") is None

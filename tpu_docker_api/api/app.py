@@ -1,7 +1,9 @@
 """HTTP server: routing, validation, error mapping.
 
 Parity: the reference's gin routes (api/container.go:19-38, volume.go:19-28,
-resource.go:12-15) on a stdlib ThreadingHTTPServer — 14 routes + health.
+resource.go:12-15) on a stdlib ThreadingHTTPServer — the reference's 14
+routes + health, plus the TPU-native additions: 6 ``/api/v1/jobs/*`` routes
+(distributed multi-host jobs) and ``GET /api/v1/resources/slices``.
 Name-format validation follows the reference: base names must not contain
 ``-`` on create (api/container.go:66-70); other ops accept ``name`` (latest)
 or ``name-version`` (optimistic check). The reference's six fall-through
@@ -111,7 +113,8 @@ class Router:
 
 def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  chip_scheduler, port_scheduler, work_queue=None,
-                 health_watcher=None, metrics=None) -> Router:
+                 health_watcher=None, metrics=None,
+                 job_svc=None, pod_scheduler=None) -> Router:
     r = Router(metrics=metrics)
 
     # -- containers (reference api/container.go:19-38) ---------------------------
@@ -226,6 +229,49 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/volumes/{name}", v_info)
     r.add("DELETE", "/api/v1/volumes/{name}", v_delete)
     r.add("PATCH", "/api/v1/volumes/{name}/size", v_patch_size)
+
+    # -- distributed jobs (TPU-native addition: multi-host slices,
+    #    SURVEY.md hard part #3; no reference analog) -----------------------------
+
+    if job_svc is not None:
+        from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun
+
+        def j_run(body, **_):
+            req = JobRun.from_dict(body)
+            _validate_base_name(req.job_name)
+            return job_svc.run_job(req)
+
+        def j_info(body, name):
+            _validate_ref_name(name)
+            return job_svc.get_job_info(name)
+
+        def j_delete(body, name):
+            _validate_ref_name(name)
+            job_svc.delete_job(name, JobDelete.from_dict(body))
+            return None
+
+        def j_patch_chips(body, name):
+            _validate_ref_name(name)
+            return job_svc.patch_job_chips(name, JobPatchChips.from_dict(body))
+
+        def j_stop(body, name):
+            _validate_ref_name(name)
+            job_svc.stop_job(name)
+            return None
+
+        def j_restart(body, name):
+            _validate_ref_name(name)
+            return job_svc.restart_job(name)
+
+        r.add("POST", "/api/v1/jobs", j_run)
+        r.add("GET", "/api/v1/jobs/{name}", j_info)
+        r.add("DELETE", "/api/v1/jobs/{name}", j_delete)
+        r.add("PATCH", "/api/v1/jobs/{name}/tpu", j_patch_chips)
+        r.add("POST", "/api/v1/jobs/{name}/stop", j_stop)
+        r.add("PATCH", "/api/v1/jobs/{name}/restart", j_restart)
+    if pod_scheduler is not None:
+        r.add("GET", "/api/v1/resources/slices",
+              lambda body, **_: pod_scheduler.status())
 
     # -- resource views (reference api/resource.go:12-29) ------------------------
 

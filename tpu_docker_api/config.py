@@ -43,6 +43,13 @@ class Config:
     health_watch_interval: float = 5.0
     # "none" (observe only) | "on-failure" (bounded auto-restart)
     restart_policy: str = "none"
+    # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
+    # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
+    # local=true on the entry for THIS machine so it shares the container
+    # service's runtime/schedulers (one accounting for local chips). Empty ⇒
+    # a single-host pod wrapping this host (jobs still work, sub-host slices
+    # only). All hosts share accelerator_type.
+    pod_hosts: list = dataclasses.field(default_factory=list)
 
 
 def load(path: str | None = None) -> Config:

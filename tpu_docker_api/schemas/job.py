@@ -1,0 +1,101 @@
+"""Distributed-job DTOs.
+
+The reference has no job concept — its unit is one container on one host. A
+TPU control plane's headline object is a **distributed JAX job**: N containers
+(one per host) over one ICI-contiguous slice, bootstrapped into a single JAX
+runtime (BASELINE.json configs #3-#5). Jobs carry the same immutable-versioned
+rolling-replacement semantics as containers: patching a job's chip count
+creates ``job-(n+1)`` on a fresh slice and retires ``job-n``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class JobRun:
+    """POST /jobs body."""
+    image_name: str
+    job_name: str
+    chip_count: int = 0          # total chips; whole-host multiples span hosts
+    accelerator_type: str = ""   # alternative ask: "v5p-64" ⇒ chip count
+    binds: list[str] = dataclasses.field(default_factory=list)   # "src:dest"
+    env: list[str] = dataclasses.field(default_factory=list)
+    cmd: list[str] = dataclasses.field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "JobRun":
+        return JobRun(
+            image_name=d.get("imageName", ""),
+            job_name=d.get("jobName", ""),
+            chip_count=int(d.get("chipCount", 0)),
+            accelerator_type=d.get("acceleratorType", ""),
+            binds=list(d.get("binds", [])),
+            env=list(d.get("env", [])),
+            cmd=list(d.get("cmd", [])),
+        )
+
+
+@dataclasses.dataclass
+class JobPatchChips:
+    """PATCH /jobs/{name}/tpu body — rolling rescale onto a new slice."""
+    chip_count: int = 0
+    accelerator_type: str = ""
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "JobPatchChips":
+        return JobPatchChips(
+            chip_count=int(d.get("chipCount", 0)),
+            accelerator_type=d.get("acceleratorType", ""),
+        )
+
+
+@dataclasses.dataclass
+class JobDelete:
+    force: bool = False
+    del_state_and_version_record: bool = False
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "JobDelete":
+        return JobDelete(
+            force=bool(d.get("force", False)),
+            del_state_and_version_record=bool(
+                d.get("delStateAndVersionRecord", d.get("delEtcdInfoAndVersionRecord", False))
+            ),
+        )
+
+
+@dataclasses.dataclass
+class JobState:
+    """Persisted per job version — everything needed to rebuild or rescale."""
+    job_name: str            # versioned, e.g. "train-2"
+    version: int
+    image: str
+    cmd: list[str]
+    env: list[str]
+    binds: list[str]
+    chip_count: int
+    coordinator_port: int
+    # [(host_id, container_name, process_id, [chip_ids], tpu_port), ...]
+    placements: list[list[Any]]
+    desired_running: bool = True
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "JobState":
+        return JobState(
+            job_name=d["job_name"],
+            version=int(d["version"]),
+            image=d["image"],
+            cmd=list(d.get("cmd", [])),
+            env=list(d.get("env", [])),
+            binds=list(d.get("binds", [])),
+            chip_count=int(d.get("chip_count", 0)),
+            coordinator_port=int(d.get("coordinator_port", 0)),
+            placements=[list(p) for p in d.get("placements", [])],
+            desired_running=bool(d.get("desired_running", True)),
+        )

@@ -1,0 +1,414 @@
+"""Distributed-job orchestration over a multi-host pod.
+
+The capability the reference cannot express (single docker socket,
+internal/docker/client.go:11-14): one API call places N containers on N hosts
+whose chips form one ICI domain, rendered as one JAX job
+(BASELINE.json configs #3-#5). Flows mirror the container service's
+immutable-versioned rolling-replacement semantics:
+
+- ``run_job``   — allocate a slice (host-granular when it spans hosts), render
+  one process container per host with the JAX/libtpu bootstrap env, create
+  and start them all (coordinator = process 0), persist the versioned spec.
+- ``patch_job_chips`` — rolling rescale with SURVEY.md §5.4's sequencing fix:
+  when the pool has room, the new slice is allocated and its containers
+  **created first** (minimal downtime), then the old job is quiesced
+  (graceful stop ⇒ the training loop's checkpoint hook flushes), and only
+  then do the new containers **start** — never two versions writing the
+  shared checkpoint at once. When the pool is too small for both slices, the
+  old job is quiesced and freed before allocating (rescale-in-place). Old
+  containers stay (stopped) for rollback until delete, like retired
+  container versions.
+- ``delete_job`` / ``stop_job`` / ``restart_job`` / ``get_job_info``.
+
+Checkpoint continuity across rescales rides a shared bind (e.g. NFS, the
+cross-container channel the reference also leans on, README.md:41): every
+process of every version mounts the same ``binds``, so ``job-(n+1)`` resumes
+from the step ``job-n`` checkpointed at quiesce.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.scheduler.pod import Pod, PodScheduler, SliceAllocation
+from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun, JobState
+from tpu_docker_api.service.container import _FamilyLocks
+from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.workload.jaxenv import (
+    DistributedJob,
+    ProcessPlacement,
+    render_job_specs,
+)
+
+log = logging.getLogger(__name__)
+
+#: default libtpu inter-process mesh port (container side)
+_TPU_PORT = 8476
+
+#: same charset rule as container/volume base names (api/app.py _NAME_RE) —
+#: anything else would corrupt the KV key layout ('/' nests prefixes) or the
+#: derived container names
+_BASE_NAME_RE = re.compile(r"^[a-zA-Z0-9_.]+$")
+
+
+class JobService:
+    def __init__(
+        self,
+        pod: Pod,
+        slices: PodScheduler,
+        store: StateStore,
+        versions: VersionMap,
+        libtpu_path: str = "",
+    ) -> None:
+        self.pod = pod
+        self.slices = slices
+        self.store = store
+        self.versions = versions
+        self.libtpu_path = libtpu_path
+        self._locks = _FamilyLocks()
+
+    # -- helpers -----------------------------------------------------------------
+
+    def _resolve_latest(self, name: str) -> tuple[str, int, str]:
+        base, version = split_versioned_name(name)
+        latest = self.versions.get(base)
+        if latest is None:
+            raise errors.ContainerNotExist(f"job {name}")
+        if version is not None and version != latest:
+            raise errors.VersionNotMatch(f"{name}: latest version is {latest}")
+        return base, latest, versioned_name(base, latest)
+
+    def _build_placements(
+        self, grant: SliceAllocation, owner: str
+    ) -> tuple[list[ProcessPlacement], int, dict[str, list[int]]]:
+        """Placements in slice process order + coordinator port + the host
+        ports claimed per host (for rollback/free)."""
+        claimed: dict[str, list[int]] = {}
+        placements: list[ProcessPlacement] = []
+        try:
+            for pid, (host_id, chips) in enumerate(grant.hosts):
+                host = self.pod.hosts[host_id]
+                n_ports = 2 if pid == 0 else 1  # process 0 also publishes the coordinator
+                ports = host.ports.apply_ports(n_ports, owner=owner)
+                claimed[host_id] = ports
+                placements.append(ProcessPlacement(
+                    process_id=pid,
+                    host=host.address,
+                    chip_ids=chips,
+                    tpu_process_port=ports[0],
+                    topology=host.topology,
+                ))
+            coordinator_port = claimed[grant.hosts[0][0]][1]
+        except Exception:
+            self._free_ports(claimed, owner)
+            raise
+        return placements, coordinator_port, claimed
+
+    def _free_ports(self, claimed: dict[str, list[int]], owner: str) -> None:
+        for host_id, ports in claimed.items():
+            self.pod.hosts[host_id].ports.restore_ports(ports, owner=owner)
+
+    def _specs_for(self, job_versioned: str, grant: SliceAllocation,
+                   placements: list[ProcessPlacement], coordinator_port: int,
+                   req_image: str, req_cmd: list[str], req_env: list[str],
+                   req_binds: list[str]) -> list[ContainerSpec]:
+        gx, gy, gz = grant.host_block_shape
+        job = DistributedJob(
+            name=job_versioned,
+            placements=placements,
+            coordinator_port=coordinator_port,
+            process_bounds=f"{gx},{gy},{gz}" if grant.multi_host else "1,1,1",
+        )
+        specs = render_job_specs(
+            job,
+            self.pod.hosts[grant.hosts[0][0]].topology,
+            image=req_image,
+            cmd=req_cmd,
+            base_env=req_env,
+            libtpu_path=self.libtpu_path,
+        )
+        for spec in specs:
+            spec.binds = list(req_binds) + spec.binds
+        return specs
+
+    def _create_and_start(self, grant: SliceAllocation,
+                          specs: list[ContainerSpec],
+                          start_now: bool = True) -> None:
+        """Create every process container, then (optionally) start all
+        (coordinator first so peers find it); on any failure remove what was
+        created. ``start_now=False`` is the rescale path: containers are
+        created alongside the running old version and started only after it
+        quiesces."""
+        created: list[tuple[str, str]] = []  # (host_id, container name)
+        try:
+            for (host_id, _), spec in zip(grant.hosts, specs):
+                self.pod.hosts[host_id].runtime.container_create(spec)
+                created.append((host_id, spec.name))
+            if start_now:
+                for host_id, name in created:
+                    self.pod.hosts[host_id].runtime.container_start(name)
+        except Exception:
+            for host_id, name in created:
+                try:
+                    self.pod.hosts[host_id].runtime.container_remove(name, force=True)
+                except Exception:
+                    log.exception("rollback remove of %s on %s failed", name, host_id)
+            raise
+
+    def _run_version(self, base: str, image: str, cmd: list[str], env: list[str],
+                     binds: list[str], n_chips: int,
+                     accelerator_type: str = "", start_now: bool = True) -> JobState:
+        """Slice alloc → version bump → ports → render → create[+start] →
+        persist, with full rollback (the job-level _run_new_version)."""
+        prev = self.versions.get(base)
+        version = self.versions.next_version(base)
+        job_versioned = versioned_name(base, version)
+        try:
+            grant = self.slices.apply_slice(
+                n_chips=n_chips, accelerator_type=accelerator_type,
+                owner=job_versioned,
+            )
+            try:
+                placements, coordinator_port, claimed = self._build_placements(
+                    grant, job_versioned
+                )
+                try:
+                    specs = self._specs_for(
+                        job_versioned, grant, placements, coordinator_port,
+                        image, cmd, env, binds,
+                    )
+                    self._create_and_start(grant, specs, start_now=start_now)
+                except Exception:
+                    self._free_ports(claimed, job_versioned)
+                    raise
+            except Exception:
+                self.slices.restore_slice(job_versioned)
+                raise
+        except Exception:
+            self.versions.rollback(base, prev)
+            raise
+        st = JobState(
+            job_name=job_versioned,
+            version=version,
+            image=image, cmd=list(cmd), env=list(env), binds=list(binds),
+            chip_count=grant.n_chips,
+            coordinator_port=coordinator_port,
+            placements=[
+                [host_id, spec.name, pid, list(chips), placements[pid].tpu_process_port]
+                for pid, ((host_id, chips), spec) in enumerate(zip(grant.hosts, specs))
+            ],
+        )
+        self.store.put_job(st)
+        return st
+
+    # -- flows -------------------------------------------------------------------
+
+    def run_job(self, req: JobRun) -> dict:
+        base = req.job_name
+        if not base or not _BASE_NAME_RE.match(base):
+            raise errors.BadRequest(
+                f"invalid job name {base!r}: must be nonempty, [a-zA-Z0-9_.] only"
+            )
+        if not req.image_name:
+            raise errors.BadRequest("imageName required")
+        if req.chip_count <= 0 and not req.accelerator_type:
+            raise errors.BadRequest("chipCount or acceleratorType required")
+        with self._locks.hold(base):
+            if self.versions.contains(base):
+                raise errors.ContainerExisted(f"job {base}")
+            st = self._run_version(
+                base, req.image_name, req.cmd, req.env, req.binds,
+                req.chip_count, req.accelerator_type,
+            )
+            log.info("run job %s: %d chips over %d hosts", st.job_name,
+                     st.chip_count, len(st.placements))
+            return self._info_dict(st)
+
+    def patch_job_chips(self, name: str, req: JobPatchChips) -> dict:
+        """Rolling rescale (BASELINE config #5), sequenced per SURVEY.md §5.4:
+
+        Fast path (pool fits old+new): allocate the new slice and **create**
+        its containers while the old job still runs, quiesce the old job
+        (graceful stop ⇒ checkpoint flush), then **start** the new one —
+        downtime is only the stop+start window, and the two versions never
+        run concurrently against the shared checkpoint binds.
+
+        Fallback (pool too small for both): quiesce and free the old slice
+        first, then allocate; on failure, re-launch the old shape
+        (best-effort compensation — another family could race for the freed
+        capacity; the failure is logged and re-raised either way).
+        """
+        if req.chip_count <= 0 and not req.accelerator_type:
+            raise errors.BadRequest("chipCount or acceleratorType required")
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            base, _, latest_name = self._resolve_latest(name)
+            old = self.store.get_job(latest_name)
+            want = req.chip_count
+            if req.accelerator_type:
+                from tpu_docker_api.scheduler.topology import parse_accelerator_type
+                _, want = parse_accelerator_type(req.accelerator_type)
+            if want == old.chip_count:
+                raise errors.NoPatchRequired(f"job {latest_name} already has {want} chips")
+
+            def _quiesce_old() -> None:
+                self._stop_members(old)
+                self.store.put_job(JobState.from_dict(
+                    {**old.to_dict(), "desired_running": False}
+                ))
+
+            def _free_old() -> None:
+                self.slices.restore_slice(old.job_name)
+                self._free_state_ports(old)
+
+            try:
+                # fast path: reserve new capacity first, containers created
+                # but NOT started while the old version still runs
+                st = self._run_version(
+                    base, old.image, old.cmd, old.env, old.binds,
+                    want, req.accelerator_type, start_now=False,
+                )
+                _quiesce_old()
+                self._start_members(st)
+                _free_old()
+            except errors.ChipNotEnough:
+                # rescale-in-place: the freed old slice is the capacity
+                _quiesce_old()
+                _free_old()
+                try:
+                    st = self._run_version(
+                        base, old.image, old.cmd, old.env, old.binds,
+                        want, req.accelerator_type,
+                    )
+                except Exception:
+                    log.exception("rescale of %s failed; re-launching old shape",
+                                  base)
+                    self._run_version(base, old.image, old.cmd, old.env,
+                                      old.binds, old.chip_count)
+                    raise
+            log.info("rescaled job %s: %d → %d chips (%s)", base,
+                     old.chip_count, st.chip_count, st.job_name)
+            return self._info_dict(st)
+
+    def stop_job(self, name: str) -> None:
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            st = self.store.get_job(latest_name)
+            self._stop_members(st)
+            self.store.put_job(JobState.from_dict(
+                {**st.to_dict(), "desired_running": False}
+            ))
+
+    def restart_job(self, name: str) -> dict:
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            st = self.store.get_job(latest_name)
+            for host_id, cname, *_ in st.placements:
+                self.pod.hosts[host_id].runtime.container_restart(cname)
+            st = JobState.from_dict({**st.to_dict(), "desired_running": True})
+            self.store.put_job(st)
+            return self._info_dict(st)
+
+    def delete_job(self, name: str, req: JobDelete) -> None:
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            history = self.store.history(Resource.JOBS, base)
+            for version in history:
+                vname = versioned_name(base, version)
+                try:
+                    st = self.store.get_job(vname)
+                except errors.NotExistInStore:
+                    continue
+                for host_id, cname, *_ in st.placements:
+                    host = self.pod.hosts.get(host_id)
+                    if host is None:
+                        continue
+                    try:
+                        host.runtime.container_remove(cname, force=req.force)
+                    except errors.ContainerNotExist:
+                        pass
+                self.slices.restore_slice(vname)
+                self._free_state_ports(st)
+            if req.del_state_and_version_record:
+                self.store.delete_family(Resource.JOBS, base)
+                self.versions.remove(base)
+            else:
+                # keep specs for re-run; drop only the runtime artifacts
+                pass
+            log.info("deleted job %s (%d versions)", base, len(history))
+
+    def get_job_info(self, name: str) -> dict:
+        """Reads are allowed on historical versions — retired versions are
+        the rollback material (mirrors get_container_info semantics)."""
+        base, _ = split_versioned_name(name)
+        if self.versions.get(base) is None:
+            raise errors.ContainerNotExist(f"job {name}")
+        try:
+            st = self.store.get_job(name)
+        except errors.NotExistInStore:
+            raise errors.ContainerNotExist(f"job {name}") from None
+        return self._info_dict(st, live=True)
+
+    # -- internals ---------------------------------------------------------------
+
+    def _start_members(self, st: JobState) -> None:
+        """Start in process order (coordinator first so peers find it)."""
+        for host_id, cname, *_ in st.placements:
+            self.pod.hosts[host_id].runtime.container_start(cname)
+
+    def _stop_members(self, st: JobState) -> None:
+        for host_id, cname, *_ in st.placements:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                continue
+            try:
+                host.runtime.container_stop(cname)
+            except errors.ContainerNotExist:
+                pass
+
+    def _free_state_ports(self, st: JobState) -> None:
+        for host_id, _, pid, _, tpu_port in st.placements:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                continue
+            ports = [tpu_port]
+            if pid == 0:
+                ports.append(st.coordinator_port)
+            host.ports.restore_ports(ports, owner=st.job_name)
+
+    def _info_dict(self, st: JobState, live: bool = False) -> dict:
+        out = {
+            "name": st.job_name,
+            "version": st.version,
+            "image": st.image,
+            "chipCount": st.chip_count,
+            "coordinatorPort": st.coordinator_port,
+            "desiredRunning": st.desired_running,
+            "processes": [
+                {
+                    "processId": pid,
+                    "hostId": host_id,
+                    "container": cname,
+                    "chipIds": list(chips),
+                    "tpuPort": tpu_port,
+                }
+                for host_id, cname, pid, chips, tpu_port in st.placements
+            ],
+        }
+        if live:
+            for proc in out["processes"]:
+                host = self.pod.hosts.get(proc["hostId"])
+                if host is None:
+                    proc["running"] = False
+                    continue
+                try:
+                    proc["running"] = host.runtime.container_inspect(
+                        proc["container"]).running
+                except errors.ContainerNotExist:
+                    proc["running"] = False
+        return out

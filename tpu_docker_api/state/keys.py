@@ -24,9 +24,11 @@ PREFIX = "/apis/v1"
 
 
 class Resource(str, enum.Enum):
-    """Resource kinds (reference etcd/common.go:24-29 enums)."""
+    """Resource kinds (reference etcd/common.go:24-29 enums, plus the
+    distributed-job kind the TPU control plane adds)."""
     CONTAINERS = "containers"
     VOLUMES = "volumes"
+    JOBS = "jobs"
 
 
 def split_versioned_name(name: str) -> tuple[str, int | None]:
@@ -67,5 +69,17 @@ def family_key(resource: Resource, name: str) -> str:
 # cross-cutting singletons
 SCHEDULER_CHIPS_KEY = f"{PREFIX}/scheduler/chips"
 SCHEDULER_PORTS_KEY = f"{PREFIX}/scheduler/ports"
+SCHEDULER_SLICES_KEY = f"{PREFIX}/scheduler/slices"
 VERSIONS_CONTAINER_KEY = f"{PREFIX}/versions/containers"
 VERSIONS_VOLUME_KEY = f"{PREFIX}/versions/volumes"
+VERSIONS_JOB_KEY = f"{PREFIX}/versions/jobs"
+
+
+def host_chips_key(host_id: str) -> str:
+    """Per-host chip-scheduler state for multi-host pods (each host's
+    ChipScheduler persists independently)."""
+    return f"{PREFIX}/scheduler/chips/{host_id}"
+
+
+def host_ports_key(host_id: str) -> str:
+    return f"{PREFIX}/scheduler/ports/{host_id}"

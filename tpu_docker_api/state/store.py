@@ -66,6 +66,17 @@ class StateStore:
     def get_container(self, name: str) -> ContainerState:
         return ContainerState.from_dict(self._get(Resource.CONTAINERS, name))
 
+    # -- jobs -------------------------------------------------------------------
+
+    def put_job(self, st) -> None:
+        base, _ = keys.split_versioned_name(st.job_name)
+        self._put(Resource.JOBS, base, st.version, st.to_dict())
+
+    def get_job(self, name: str):
+        from tpu_docker_api.schemas.job import JobState
+
+        return JobState.from_dict(self._get(Resource.JOBS, name))
+
     # -- volumes ----------------------------------------------------------------
 
     def put_volume(self, st: VolumeState) -> None:

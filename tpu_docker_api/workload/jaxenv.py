@@ -31,6 +31,9 @@ class ProcessPlacement:
     host: str                 # routable address of the host running it
     chip_ids: list[int]       # host-local chips handed to this process
     tpu_process_port: int     # libtpu mesh port (host side)
+    # per-host topology for multi-host pods (hosts may differ from the
+    # control-plane host); None ⇒ use the topology passed to render_job_specs
+    topology: HostTopology | None = None
 
 
 @dataclasses.dataclass
@@ -39,6 +42,9 @@ class DistributedJob:
     name: str
     placements: list[ProcessPlacement]
     coordinator_port: int
+    # "gx,gy,gz" DCN process grid (the pod scheduler's host-block shape);
+    # "" ⇒ safe 1D default from _process_bounds
+    process_bounds: str = ""
 
     @property
     def coordinator_address(self) -> str:
@@ -101,9 +107,9 @@ def render_job_specs(
                 PortBinding(job.coordinator_port, job.coordinator_port)
             )
         render_tpu_attachment(
-            spec, sorted(p.chip_ids), topology,
+            spec, sorted(p.chip_ids), p.topology or topology,
             libtpu_path=libtpu_path,
-            process_bounds=_process_bounds(len(job.placements)),
+            process_bounds=job.process_bounds or _process_bounds(len(job.placements)),
             task_id=p.process_id,
             process_addresses=peers,
             process_port=p.tpu_process_port,
