@@ -1,0 +1,154 @@
+"""Regenerate api/sample-interface.md by driving a live daemon and capturing
+real request/response payloads — the analog of the reference's hand-written
+transcripts (api/gpu-docker-api-sample-interface.md), but reproducible:
+
+    python scripts/gen_sample_interface.py > api/sample-interface.md
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+from tpu_docker_api.config import Config
+from tpu_docker_api.daemon import Program
+
+OUT: list[str] = []
+
+
+def emit(s: str = "") -> None:
+    OUT.append(s)
+
+
+def main() -> None:
+    cfg = Config(port=0, runtime_backend="fake", accelerator_type="v5p-8",
+                 start_port=40000, end_port=40099, health_watch_interval=0,
+                 pod_hosts=[
+                     {"host_id": "me", "address": "10.0.0.1",
+                      "grid_coord": [0, 0, 0], "local": True},
+                     {"host_id": "h1", "address": "10.0.0.2",
+                      "grid_coord": [1, 0, 0], "runtime_backend": "fake"},
+                     {"host_id": "h2", "address": "10.0.0.3",
+                      "grid_coord": [0, 1, 0], "runtime_backend": "fake"},
+                     {"host_id": "h3", "address": "10.0.0.4",
+                      "grid_coord": [1, 1, 0], "runtime_backend": "fake"},
+                 ])
+    prg = Program(cfg, host="127.0.0.1")
+    prg.init()
+    prg.start()
+    port = prg.api_server.port
+
+    def call(method: str, path: str, body: dict | None = None,
+             note: str = "") -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req).read())
+        emit(f"### `{method} {path}`")
+        if note:
+            emit()
+            emit(note)
+        if body is not None:
+            emit()
+            emit("Request:")
+            emit("```json")
+            emit(json.dumps(body, indent=2))
+            emit("```")
+        emit()
+        emit("Response:")
+        emit("```json")
+        emit(json.dumps(resp, indent=2))
+        emit("```")
+        emit()
+        return resp
+
+    emit("# tpu-docker-api — sample interface walkthrough")
+    emit()
+    emit("> Generated against a live daemon (fake runtime, 4-host v5p pod) by")
+    emit("> `scripts/gen_sample_interface.py`; every payload below is a real")
+    emit("> captured response. The canonical machine contract is")
+    emit("> [openapi.json](openapi.json). All responses are HTTP 200; the")
+    emit("> outcome is the envelope `code` (200 = success, 10xxx = app error —")
+    emit("> the reference's response.go/code.go convention).")
+    emit()
+    emit("## Containers (reference parity: api/container.go)")
+    emit()
+    call("POST", "/api/v1/containers",
+         {"imageName": "python:3.11", "containerName": "demo", "chipCount": 2,
+          "binds": [{"src": "/nfs/data", "dest": "/data"}],
+          "env": ["MODE=dev"], "containerPorts": [{"containerPort": 8888}]},
+         "Create a 2-chip container. The first version is `demo-0`; chips and "
+         "host ports come from the schedulers, the validated spec persists to "
+         "the state store.")
+    call("GET", "/api/v1/containers/demo-0", None,
+         "Spec + live runtime state. Works for historical versions too.")
+    call("POST", "/api/v1/containers/demo-0/execute",
+         {"cmd": ["echo", "hello tpu"]},
+         "Exec inside the running container (demuxed stdout).")
+    call("PATCH", "/api/v1/containers/demo-0/tpu", {"chipCount": 4},
+         "Rolling chip rescale: quiesce `demo-0` → copy data dir → start "
+         "`demo-1` with 4 chips. The old version stays (stopped) for "
+         "rollback.")
+    call("PATCH", "/api/v1/containers/demo-0/tpu", {"chipCount": 1},
+         "Version check: operating on a retired version returns code 10202 "
+         "(version mismatch) — address `demo-1` or the bare base name.")
+    call("POST", "/api/v1/containers/demo/stop", None,
+         "Stop the latest version (bare base name = latest).")
+    call("PATCH", "/api/v1/containers/demo/restart", None,
+         "Restart re-applies chips via a new version when carded.")
+    call("POST", "/api/v1/containers/demo/commit",
+         {"newImageName": "demo-snapshot:v1"})
+    call("DELETE", "/api/v1/containers/demo",
+         {"force": True, "delEtcdInfoAndVersionRecord": True},
+         "Delete every version, return chips and ports to the schedulers; "
+         "with `delEtcdInfoAndVersionRecord` the state-store family and "
+         "version counter go too (reference delete semantics, "
+         "sample-interface.md:576-615).")
+    emit("## Volumes (reference parity: api/volume.go)")
+    emit()
+    call("POST", "/api/v1/volumes", {"volumeName": "ckpt", "size": "10GB"})
+    call("PATCH", "/api/v1/volumes/ckpt-0/size", {"size": "20GB"},
+         "Resize = new volume `ckpt-1` + data copy; shrinking below used "
+         "bytes is refused (code 10302).")
+    call("GET", "/api/v1/volumes/ckpt", None)
+    emit("## Distributed jobs (TPU-native; no reference analog)")
+    emit()
+    call("POST", "/api/v1/jobs",
+         {"imageName": "maxtext:tpu", "jobName": "train", "chipCount": 8,
+          "binds": ["/nfs/ckpt:/ckpt"],
+          "cmd": ["python", "train.py", "--config", "llama3-8b.yml"]},
+         "8 chips = 2 whole v5p hosts: one process container per host, "
+         "JAX coordinator on process 0, `TPU_PROCESS_BOUNDS` shaped to the "
+         "host block, peer addresses rendered for libtpu.")
+    call("GET", "/api/v1/resources/slices", None,
+         "Pod view: host grid, per-host free chips, live slice grants.")
+    call("PATCH", "/api/v1/jobs/train/tpu", {"chipCount": 16},
+         "Rolling rescale onto 4 hosts: new containers are created first, "
+         "the old job quiesces (graceful stop ⇒ checkpoint flush), then the "
+         "new version starts — the two versions never write the shared "
+         "checkpoint bind concurrently.")
+    call("GET", "/api/v1/jobs/train-0", None,
+         "Historical version: stopped but inspectable (rollback material).")
+    call("DELETE", "/api/v1/jobs/train",
+         {"force": True, "delStateAndVersionRecord": True})
+    emit("## Resources & observability")
+    emit()
+    call("GET", "/api/v1/resources/tpus", None,
+         "Chip map with coordinates, owners, and a fragmentation gauge "
+         "(`largestFreeBlock`).")
+    call("GET", "/api/v1/resources/ports", None)
+    call("GET", "/api/v1/debug/deadletters", None,
+         "Async tasks that exhausted their retries — never silently "
+         "re-queued forever (the reference's workQueue loops infinitely).")
+    call("GET", "/healthz", None)
+    emit("`GET /metrics` serves Prometheus text format (request counts, "
+         "latency histograms, chip/port/queue gauges).")
+
+    prg.stop()
+    sys.stdout.write("\n".join(OUT) + "\n")
+
+
+if __name__ == "__main__":
+    main()

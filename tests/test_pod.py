@@ -271,6 +271,60 @@ class TestJobService:
         assert old["desiredRunning"] is False
         assert not any(p["running"] for p in old["processes"])
 
+    def test_unsatisfiable_rescale_leaves_job_untouched(self, pod, svc):
+        """Deterministic validation errors (non-host-multiple, > pod size)
+        must not bounce a healthy job through quiesce/relaunch."""
+        info = svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        with pytest.raises(errors.BadRequest):
+            svc.patch_job_chips("t", JobPatchChips(chip_count=6))
+        with pytest.raises(errors.ChipNotEnough):
+            svc.patch_job_chips("t", JobPatchChips(chip_count=64))
+        assert svc.get_job_info("t")["name"] == "t-0"
+        for proc in info["processes"]:
+            assert pod.hosts[proc["hostId"]].runtime.container_inspect(
+                proc["container"]).running
+
+    def test_rescale_swap_failure_resumes_old(self, pod, svc, sched, monkeypatch):
+        """If the new version fails to start after the old quiesced, the old
+        version is resumed and the new one fully torn down."""
+        svc.run_job(JobRun(image_name="i", job_name="t", chip_count=8))
+        calls = {"n": 0}
+        orig = svc._start_members
+
+        def exploding_start(st):
+            calls["n"] += 1
+            if calls["n"] == 1:  # fail the new version; let the resume work
+                raise RuntimeError("docker daemon away")
+            return orig(st)
+
+        monkeypatch.setattr(svc, "_start_members", exploding_start)
+        with pytest.raises(RuntimeError):
+            svc.patch_job_chips("t", JobPatchChips(chip_count=16))
+        monkeypatch.setattr(svc, "_start_members", orig)
+        # old version is latest again, running, holding its slice
+        info = svc.get_job_info("t")
+        assert info["name"] == "t-0"
+        assert sched.get_grant("t-0") is not None
+        assert sched.get_grant("t-1") is None
+        for proc in info["processes"]:
+            assert pod.hosts[proc["hostId"]].runtime.container_inspect(
+                proc["container"]).running
+        # and a later rescale still works
+        assert svc.patch_job_chips("t", JobPatchChips(chip_count=16))["chipCount"] == 16
+
+    def test_heterogeneous_pod_rejected(self, kv):
+        hosts = make_pod(kv).hosts
+        lst = list(hosts.values())
+        lst[0].topology = HostTopology.build("v5e-8")
+        with pytest.raises(ValueError, match="heterogeneous"):
+            Pod(GENERATIONS["v5p"], (2, 2, 2), lst)
+
+    def test_duplicate_host_id_rejected(self, kv):
+        lst = list(make_pod(kv, grid=(2, 1, 1)).hosts.values())
+        lst[1].host_id = lst[0].host_id
+        with pytest.raises(ValueError, match="duplicate host ids"):
+            Pod(GENERATIONS["v5p"], (2, 1, 1), lst)
+
     def test_bad_job_names_rejected(self, svc):
         for bad in ("", "a/b", "a b", "a-b"):
             with pytest.raises(errors.BadRequest):

@@ -58,7 +58,8 @@ from tpu_docker_api.service.volume import VolumeService
 
 log = logging.getLogger(__name__)
 
-_NAME_RE = re.compile(r"^[a-zA-Z0-9_.]+$")
+from tpu_docker_api.state.keys import BASE_NAME_RE as _NAME_RE
+
 _VERSIONED_RE = re.compile(r"^[a-zA-Z0-9_.]+(-\d+)?$")
 
 

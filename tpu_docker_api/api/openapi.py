@@ -1,0 +1,213 @@
+"""OpenAPI 3.1 contract, generated from one source of truth.
+
+Parity: the reference ships a hand-exported OpenAPI file
+(``api/gpu-docker-api.openapi.json``, 2,187 lines) that is "the canonical
+interface doc" (api/gpu-docker-api-sample-interface.md:3) but can silently
+drift from the gin routes. Here the contract is *generated*: every path below
+is asserted against the live router in tests (test_openapi.py), so the
+committed ``api/openapi.json`` cannot drift without failing CI.
+
+Regenerate with::
+
+    python -m tpu_docker_api.api.openapi > api/openapi.json
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+_ENVELOPE_NOTE = (
+    "All responses are HTTP 200 with the outcome in the body envelope "
+    "{code, msg, data}: code 200 = success, 10xxx = application error "
+    "(see api/codes.py)."
+)
+
+
+def _obj(props: dict[str, Any], required: list[str] | None = None,
+         desc: str = "") -> dict:
+    out: dict[str, Any] = {"type": "object", "properties": props}
+    if required:
+        out["required"] = required
+    if desc:
+        out["description"] = desc
+    return out
+
+
+def _arr(items: dict) -> dict:
+    return {"type": "array", "items": items}
+
+
+_STR = {"type": "string"}
+_INT = {"type": "integer"}
+_BOOL = {"type": "boolean"}
+
+_BIND = _obj({"src": _STR, "dest": _STR}, ["src", "dest"])
+_CONTAINER_PORT = _obj(
+    {"containerPort": _INT, "hostPort": {**_INT, "description": "0 = scheduler-assigned"},
+     "protocol": {**_STR, "default": "tcp"}},
+    ["containerPort"],
+)
+
+_SCHEMAS: dict[str, dict] = {
+    "Envelope": _obj(
+        {"code": {**_INT, "description": "200 or application error code"},
+         "msg": _STR, "data": {}},
+        ["code", "msg"], _ENVELOPE_NOTE),
+    "ContainerRun": _obj(
+        {"imageName": _STR,
+         "containerName": {**_STR, "description":
+                           "base name, [a-zA-Z0-9_.]+ (no '-'); versions are name-N"},
+         "chipCount": {**_INT, "description": "TPU chips to attach; 0 = cardless"},
+         "sliceShape": {**_STR, "description":
+                        "optional explicit ICI block, e.g. \"2x2\" — disables scattered fallback"},
+         "binds": _arr(_BIND), "env": _arr(_STR), "cmd": _arr(_STR),
+         "containerPorts": _arr(_CONTAINER_PORT)},
+        ["imageName", "containerName"]),
+    "ContainerDelete": _obj({"force": _BOOL,
+                             "delEtcdInfoAndVersionRecord": _BOOL}),
+    "ContainerExecute": _obj({"workDir": _STR, "cmd": _arr(_STR)}, ["cmd"]),
+    "ContainerPatchChips": _obj(
+        {"chipCount": {**_INT, "description":
+                       "desired chip count; rolling-replaces into name-(N+1)"}},
+        ["chipCount"]),
+    "ContainerPatchVolume": _obj(
+        {"oldBind": _BIND, "newBind": _BIND}, ["oldBind", "newBind"]),
+    "ContainerCommit": _obj({"newImageName": _STR}, ["newImageName"]),
+    "VolumeCreate": _obj(
+        {"volumeName": _STR,
+         "size": {**_STR, "description": "e.g. \"20GB\"; units KB|MB|GB|TB"}},
+        ["volumeName"]),
+    "VolumeDelete": _obj({"delEtcdInfoAndVersionRecord": _BOOL}),
+    "VolumeSize": _obj({"size": _STR}, ["size"]),
+    "JobRun": _obj(
+        {"imageName": _STR,
+         "jobName": {**_STR, "description": "base name, [a-zA-Z0-9_.]+"},
+         "chipCount": {**_INT, "description":
+                       "total chips; whole-host multiples span hosts"},
+         "acceleratorType": {**_STR, "description":
+                             "alternative ask, e.g. \"v5p-64\""},
+         "binds": _arr({**_STR, "description": "\"src:dest\""}),
+         "env": _arr(_STR), "cmd": _arr(_STR)},
+        ["imageName", "jobName"]),
+    "JobPatchChips": _obj({"chipCount": _INT, "acceleratorType": _STR}),
+    "JobDelete": _obj({"force": _BOOL, "delStateAndVersionRecord": _BOOL}),
+}
+
+#: (method, path, operationId, summary, request schema name | None)
+_ROUTES: list[tuple[str, str, str, str, str | None]] = [
+    ("POST", "/api/v1/containers", "runContainer",
+     "Create a TPU (or cardless) container; allocates chips + host ports, "
+     "persists the validated spec, returns name-0", "ContainerRun"),
+    ("GET", "/api/v1/containers/{name}", "getContainerInfo",
+     "Persisted spec + live runtime state; historical versions readable", None),
+    ("DELETE", "/api/v1/containers/{name}", "deleteContainer",
+     "Remove container versions, return chips/ports to schedulers",
+     "ContainerDelete"),
+    ("POST", "/api/v1/containers/{name}/execute", "executeContainer",
+     "Exec a command in the running container, return demuxed stdout",
+     "ContainerExecute"),
+    ("PATCH", "/api/v1/containers/{name}/tpu", "patchContainerChips",
+     "Rolling chip rescale: quiesce → copy data → start name-(N+1)",
+     "ContainerPatchChips"),
+    ("PATCH", "/api/v1/containers/{name}/gpu", "patchContainerChipsCompat",
+     "Reference-compatible alias of /tpu", "ContainerPatchChips"),
+    ("PATCH", "/api/v1/containers/{name}/volume", "patchContainerVolume",
+     "Swap one bind onto name-(N+1) with data migration",
+     "ContainerPatchVolume"),
+    ("POST", "/api/v1/containers/{name}/stop", "stopContainer",
+     "Graceful stop; chips stay allocated for restart", None),
+    ("PATCH", "/api/v1/containers/{name}/restart", "restartContainer",
+     "Restart; carded containers re-apply chips via a new version", None),
+    ("POST", "/api/v1/containers/{name}/commit", "commitContainer",
+     "Snapshot container filesystem to an image", "ContainerCommit"),
+    ("POST", "/api/v1/volumes", "createVolume",
+     "Create a named, size-capped volume (overlay2/xfs analog)", "VolumeCreate"),
+    ("GET", "/api/v1/volumes/{name}", "getVolumeInfo",
+     "Persisted volume spec + mountpoint", None),
+    ("DELETE", "/api/v1/volumes/{name}", "deleteVolume",
+     "Remove volume versions", "VolumeDelete"),
+    ("PATCH", "/api/v1/volumes/{name}/size", "patchVolumeSize",
+     "Resize via new volume + data copy; shrink below used size refused",
+     "VolumeSize"),
+    ("POST", "/api/v1/jobs", "runJob",
+     "Place a distributed JAX job: one process container per host over an "
+     "ICI-contiguous slice, coordinator + TPU_PROCESS_* env rendered", "JobRun"),
+    ("GET", "/api/v1/jobs/{name}", "getJobInfo",
+     "Job spec + per-process live state; historical versions readable", None),
+    ("DELETE", "/api/v1/jobs/{name}", "deleteJob",
+     "Remove all job versions, free slices and ports", "JobDelete"),
+    ("PATCH", "/api/v1/jobs/{name}/tpu", "patchJobChips",
+     "Rolling rescale onto a new slice: create-new → quiesce-old → start-new",
+     "JobPatchChips"),
+    ("POST", "/api/v1/jobs/{name}/stop", "stopJob",
+     "Quiesce every process container (checkpoint flush)", None),
+    ("PATCH", "/api/v1/jobs/{name}/restart", "restartJob",
+     "Restart every process container of the latest version", None),
+    ("GET", "/api/v1/resources/tpus", "getTpus",
+     "Chip map: coords, owner, fragmentation (largest free block)", None),
+    ("GET", "/api/v1/resources/gpus", "getTpusCompat",
+     "Reference-compatible alias of /resources/tpus", None),
+    ("GET", "/api/v1/resources/ports", "getUsedPorts",
+     "Host-port scheduler state", None),
+    ("GET", "/api/v1/resources/slices", "getSlices",
+     "Pod view: host grid, per-host free chips, active slice grants", None),
+    ("GET", "/api/v1/events", "getHealthEvents",
+     "Container liveness transitions seen by the health watcher", None),
+    ("GET", "/api/v1/health/containers", "getHealthStatus",
+     "Per-container liveness + restart bookkeeping", None),
+    ("GET", "/api/v1/debug/deadletters", "getDeadLetters",
+     "Async tasks that exhausted retries (never silently dropped)", None),
+    ("GET", "/healthz", "healthz", "Process liveness", None),
+    ("GET", "/metrics", "metrics",
+     "Prometheus text format: request/latency/chip/port/queue gauges", None),
+]
+
+
+def build_spec() -> dict:
+    paths: dict[str, dict] = {}
+    for method, path, op_id, summary, req_schema in _ROUTES:
+        op: dict[str, Any] = {
+            "operationId": op_id,
+            "summary": summary,
+            "responses": {"200": {
+                "description": _ENVELOPE_NOTE,
+                "content": {"application/json": {
+                    "schema": {"$ref": "#/components/schemas/Envelope"}}},
+            }},
+        }
+        if "{name}" in path:
+            op["parameters"] = [{
+                "name": "name", "in": "path", "required": True,
+                "schema": _STR,
+                "description": "base name (latest version) or versioned "
+                               "name-N (optimistic concurrency check)",
+            }]
+        if req_schema:
+            op["requestBody"] = {"required": True, "content": {
+                "application/json": {"schema": {
+                    "$ref": f"#/components/schemas/{req_schema}"}}}}
+        paths.setdefault(path, {})[method.lower()] = op
+    return {
+        "openapi": "3.1.0",
+        "info": {
+            "title": "tpu-docker-api",
+            "version": "1.0.0",
+            "description": (
+                "TPU-native container control plane: versioned rolling-replace "
+                "containers and volumes (gpu-docker-api parity) plus "
+                "multi-host distributed JAX jobs over ICI-contiguous slices. "
+                + _ENVELOPE_NOTE),
+        },
+        "paths": paths,
+        "components": {"schemas": _SCHEMAS},
+    }
+
+
+def route_inventory() -> set[tuple[str, str]]:
+    """(METHOD, path) pairs — consumed by the drift test."""
+    return {(m, p) for m, p, *_ in _ROUTES}
+
+
+if __name__ == "__main__":
+    print(json.dumps(build_spec(), indent=2, sort_keys=False))

@@ -111,9 +111,20 @@ class Pod:
         self.generation = generation
         self.host_grid = host_grid
         self.hosts: dict[str, PodHost] = {h.host_id: h for h in hosts}
+        if len(self.hosts) != len(hosts):
+            raise ValueError("duplicate host ids")
         self._by_coord: dict[Coord, PodHost] = {h.grid_coord: h for h in hosts}
         if len(self._by_coord) != len(hosts):
             raise ValueError("duplicate host grid coordinates")
+        first = hosts[0].topology
+        for h in hosts:
+            if (h.topology.generation.name != first.generation.name
+                    or h.topology.n_chips != first.n_chips):
+                # chips_per_host / process-bounds math assumes homogeneity
+                raise ValueError(
+                    f"heterogeneous pod: {h.host_id} is "
+                    f"{h.topology.generation.name}/{h.topology.n_chips} chips, "
+                    f"expected {first.generation.name}/{first.n_chips}")
 
     @property
     def chips_per_host(self) -> int:

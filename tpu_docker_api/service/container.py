@@ -55,6 +55,19 @@ from tpu_docker_api.state.workqueue import CopyTask, FnTask, WorkQueue
 log = logging.getLogger(__name__)
 
 
+def resolve_latest(versions: VersionMap, name: str) -> tuple[str, int, str]:
+    """(base, latest_version, latest_name); optimistic-concurrency check when
+    ``name`` carries a version suffix (reference service/container.go:195-198).
+    Shared by the container and job services."""
+    base, version = split_versioned_name(name)
+    latest = versions.get(base)
+    if latest is None:
+        raise errors.ContainerNotExist(name)
+    if version is not None and version != latest:
+        raise errors.VersionNotMatch(f"{name}: latest version is {latest}")
+    return base, latest, versioned_name(base, latest)
+
+
 class _FamilyLocks:
     """Named locks so flows serialize per container family, not globally."""
 
@@ -93,16 +106,7 @@ class ContainerService:
     # -- helpers -----------------------------------------------------------------
 
     def _resolve_latest(self, name: str) -> tuple[str, int, str]:
-        """(base, latest_version, latest_name); optimistic-concurrency check
-        when ``name`` carries a version suffix (reference
-        service/container.go:195-198)."""
-        base, version = split_versioned_name(name)
-        latest = self.versions.get(base)
-        if latest is None:
-            raise errors.ContainerNotExist(name)
-        if version is not None and version != latest:
-            raise errors.VersionNotMatch(f"{name}: latest version is {latest}")
-        return base, latest, versioned_name(base, latest)
+        return resolve_latest(self.versions, name)
 
     def _family_runtime_members(self, base: str) -> list[str]:
         """Every version of ``base`` present in the runtime (old retired

@@ -29,14 +29,18 @@ from the step ``job-n`` checkpointed at quiesce.
 from __future__ import annotations
 
 import logging
-import re
 
 from tpu_docker_api import errors
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.pod import Pod, PodScheduler, SliceAllocation
 from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun, JobState
-from tpu_docker_api.service.container import _FamilyLocks
-from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
+from tpu_docker_api.service.container import _FamilyLocks, resolve_latest
+from tpu_docker_api.state.keys import (
+    BASE_NAME_RE,
+    Resource,
+    split_versioned_name,
+    versioned_name,
+)
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
 from tpu_docker_api.workload.jaxenv import (
@@ -49,11 +53,6 @@ log = logging.getLogger(__name__)
 
 #: default libtpu inter-process mesh port (container side)
 _TPU_PORT = 8476
-
-#: same charset rule as container/volume base names (api/app.py _NAME_RE) —
-#: anything else would corrupt the KV key layout ('/' nests prefixes) or the
-#: derived container names
-_BASE_NAME_RE = re.compile(r"^[a-zA-Z0-9_.]+$")
 
 
 class JobService:
@@ -75,13 +74,7 @@ class JobService:
     # -- helpers -----------------------------------------------------------------
 
     def _resolve_latest(self, name: str) -> tuple[str, int, str]:
-        base, version = split_versioned_name(name)
-        latest = self.versions.get(base)
-        if latest is None:
-            raise errors.ContainerNotExist(f"job {name}")
-        if version is not None and version != latest:
-            raise errors.VersionNotMatch(f"{name}: latest version is {latest}")
-        return base, latest, versioned_name(base, latest)
+        return resolve_latest(self.versions, name)
 
     def _build_placements(
         self, grant: SliceAllocation, owner: str
@@ -210,7 +203,7 @@ class JobService:
 
     def run_job(self, req: JobRun) -> dict:
         base = req.job_name
-        if not base or not _BASE_NAME_RE.match(base):
+        if not base or not BASE_NAME_RE.match(base):
             raise errors.BadRequest(
                 f"invalid job name {base!r}: must be nonempty, [a-zA-Z0-9_.] only"
             )
@@ -255,6 +248,17 @@ class JobService:
                 _, want = parse_accelerator_type(req.accelerator_type)
             if want == old.chip_count:
                 raise errors.NoPatchRequired(f"job {latest_name} already has {want} chips")
+            # reject never-satisfiable asks BEFORE touching the running job
+            # (a deterministic validation error must not bounce a healthy
+            # workload through quiesce/free/relaunch)
+            per_host = self.pod.chips_per_host
+            if want > self.pod.n_chips:
+                raise errors.ChipNotEnough(
+                    f"want {want} chips, pod has {self.pod.n_chips}")
+            if len(self.pod.hosts) > 1 and want > per_host and want % per_host:
+                raise errors.BadRequest(
+                    f"multi-host slices are host-granular: {want} is not a "
+                    f"multiple of {per_host} chips/host")
 
             def _quiesce_old() -> None:
                 self._stop_members(old)
@@ -266,6 +270,10 @@ class JobService:
                 self.slices.restore_slice(old.job_name)
                 self._free_state_ports(old)
 
+            def _resume_old() -> None:
+                self._start_members(old)
+                self.store.put_job(JobState.from_dict(old.to_dict()))
+
             try:
                 # fast path: reserve new capacity first, containers created
                 # but NOT started while the old version still runs
@@ -273,8 +281,17 @@ class JobService:
                     base, old.image, old.cmd, old.env, old.binds,
                     want, req.accelerator_type, start_now=False,
                 )
-                _quiesce_old()
-                self._start_members(st)
+                try:
+                    _quiesce_old()
+                    self._start_members(st)
+                except Exception:
+                    # the old containers are intact: tear the new version
+                    # down and resume the old one
+                    log.exception("rescale swap of %s failed; resuming old "
+                                  "version", base)
+                    self._teardown_version(st, old.version)
+                    _resume_old()
+                    raise
                 _free_old()
             except errors.ChipNotEnough:
                 # rescale-in-place: the freed old slice is the capacity
@@ -309,7 +326,11 @@ class JobService:
         with self._locks.hold(base):
             st = self.store.get_job(latest_name)
             for host_id, cname, *_ in st.placements:
-                self.pod.hosts[host_id].runtime.container_restart(cname)
+                host = self.pod.hosts.get(host_id)
+                if host is None:
+                    raise errors.ContainerNotExist(
+                        f"{cname}: host {host_id} is no longer in the pod")
+                host.runtime.container_restart(cname)
             st = JobState.from_dict({**st.to_dict(), "desired_running": True})
             self.store.put_job(st)
             return self._info_dict(st)
@@ -359,7 +380,30 @@ class JobService:
     def _start_members(self, st: JobState) -> None:
         """Start in process order (coordinator first so peers find it)."""
         for host_id, cname, *_ in st.placements:
-            self.pod.hosts[host_id].runtime.container_start(cname)
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                # stale placement (host removed from the pod config) — a
+                # meaningful error, not a raw KeyError→500
+                raise errors.ContainerNotExist(
+                    f"{cname}: host {host_id} is no longer in the pod")
+            host.runtime.container_start(cname)
+
+    def _teardown_version(self, st: JobState, rollback_to: int) -> None:
+        """Remove a (possibly half-started) version's containers and free its
+        resources — the compensation arm of the rescale fast path."""
+        base, _ = split_versioned_name(st.job_name)
+        for host_id, cname, *_ in st.placements:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                continue
+            try:
+                host.runtime.container_remove(cname, force=True)
+            except errors.ContainerNotExist:
+                pass
+        self.slices.restore_slice(st.job_name)
+        self._free_state_ports(st)
+        self.store.delete_version(Resource.JOBS, st.job_name)
+        self.versions.rollback(base, rollback_to)
 
     def _stop_members(self, st: JobState) -> None:
         for host_id, cname, *_ in st.placements:

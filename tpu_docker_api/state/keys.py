@@ -19,8 +19,13 @@ Scheduler / version-map state lives under the same tree as in the reference
 from __future__ import annotations
 
 import enum
+import re
 
 PREFIX = "/apis/v1"
+
+#: the one source of the base-name rule: names become KV key segments and
+#: container names, so no '-' (version separator), no '/' (key nesting)
+BASE_NAME_RE = re.compile(r"^[a-zA-Z0-9_.]+$")
 
 
 class Resource(str, enum.Enum):
