@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end flows (subprocess trainers)")
 # the CPU backend's default matmul precision is low; exactness tests
 # (flash vs dense, ring vs dense) need deterministic f32 accumulation
 jax.config.update("jax_default_matmul_precision", "float32")

@@ -1,0 +1,247 @@
+"""The five BASELINE.json configs as end-to-end flows.
+
+Each test drives the exact scenario the baseline names, on the hermetic
+backends (fake runtime, memory KV, virtual CPU devices) so they run anywhere;
+the real-hardware counterpart of the compute path is bench.py.
+
+  #1 cardless container + JAX-CPU matmul via POST /containers + exec
+  #2 single-chip container via chip patch
+  #3 v5e-4 single host: inference-shaped job placement
+  #4 v5p-64: GSPMD DP ranks placed over an 8-host ICI domain
+  #5 rolling rescale 4→8 chips mid-train with checkpoint migration
+     (the real trainer CLI: SIGTERM quiesce → checkpoint → resume on the
+     bigger mesh, orchestrated around the job service's rescale flow)
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_docker_api.schemas.container import (
+    ContainerExecute,
+    ContainerPatchChips,
+    ContainerRun,
+)
+from tpu_docker_api.schemas.job import JobPatchChips, JobRun
+
+from tests.test_pod import make_pod  # the 8-host v5p fixture builder
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _container_stack(acc="v5e-8"):
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.scheduler.ports import PortScheduler
+    from tpu_docker_api.scheduler.slices import ChipScheduler
+    from tpu_docker_api.scheduler.topology import HostTopology
+    from tpu_docker_api.service.container import ContainerService
+    from tpu_docker_api.state import keys
+    from tpu_docker_api.state.kv import MemoryKV
+    from tpu_docker_api.state.store import StateStore
+    from tpu_docker_api.state.version import VersionMap
+    from tpu_docker_api.state.workqueue import WorkQueue
+
+    kv = MemoryKV()
+    topo = HostTopology.build(acc)
+    rt = FakeRuntime(allow_exec=True)
+    wq = WorkQueue(kv)
+    wq.start()
+    svc = ContainerService(
+        rt, StateStore(kv), ChipScheduler(topo, kv),
+        PortScheduler(kv, 40000, 40099),
+        VersionMap(kv, keys.VERSIONS_CONTAINER_KEY), wq,
+    )
+    return svc, rt, wq
+
+
+def _job_stack(grid=(2, 2, 2), acc="v5p-8"):
+    from tpu_docker_api.scheduler.pod import PodScheduler
+    from tpu_docker_api.service.job import JobService
+    from tpu_docker_api.state import keys
+    from tpu_docker_api.state.kv import MemoryKV
+    from tpu_docker_api.state.store import StateStore
+    from tpu_docker_api.state.version import VersionMap
+
+    kv = MemoryKV()
+    pod = make_pod(kv, grid=grid, acc=acc)
+    svc = JobService(pod, PodScheduler(pod, kv), StateStore(kv),
+                     VersionMap(kv, keys.VERSIONS_JOB_KEY))
+    return svc, pod
+
+
+class TestConfig1CardlessExec:
+    """BASELINE config #1: 0-chip container, JAX-CPU matmul via exec."""
+
+    def test_cardless_matmul(self):
+        svc, rt, wq = _container_stack()
+        try:
+            out = svc.run_container(ContainerRun(
+                image_name="python:3.11", container_name="smoke", chip_count=0))
+            assert out["chipIds"] == []
+            spec = rt.container_inspect("smoke-0").spec
+            # cardless: no accel devices, no TPU env rendered
+            assert spec.devices == []
+            assert not any(e.startswith("TPU_") for e in spec.env)
+            result = svc.execute_container("smoke-0", ContainerExecute(cmd=[
+                sys.executable, "-c",
+                "import jax; jax.config.update('jax_platforms','cpu'); "
+                "import jax.numpy as jnp; "
+                "x = jnp.ones((128, 128), jnp.float32); "
+                "print(float((x @ x).sum()))",
+            ]))
+            assert "2097152.0" in result
+        finally:
+            wq.close()
+
+
+class TestConfig2SingleChip:
+    """BASELINE config #2: patch a cardless container up to one TPU chip."""
+
+    def test_patch_to_one_chip(self):
+        svc, rt, wq = _container_stack()
+        try:
+            svc.run_container(ContainerRun(
+                image_name="jax:tpu", container_name="mnist", chip_count=0))
+            out = svc.patch_container_chips("mnist-0",
+                                            ContainerPatchChips(chip_count=1))
+            assert out["name"] == "mnist-1"
+            spec = rt.container_inspect("mnist-1").spec
+            assert [d.host_path for d in spec.devices] == [
+                f"/dev/accel{spec.chip_ids[0]}"]
+            env = dict(e.split("=", 1) for e in spec.env)
+            assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+            # old version quiesced, data-copy task completed
+            assert not rt.container_inspect("mnist-0").running
+        finally:
+            wq.close()
+
+
+class TestConfig3V5e4Inference:
+    """BASELINE config #3: v5e-4 single host serving Llama inference."""
+
+    def test_v5e4_placement(self):
+        svc, pod = _job_stack(grid=(1, 1, 1), acc="v5e-8")
+        info = svc.run_job(JobRun(
+            image_name="llama-serve:tpu", job_name="serve", chip_count=4,
+            cmd=["python", "-m", "serve", "--model", "llama3-8b"]))
+        assert len(info["processes"]) == 1
+        proc = info["processes"][0]
+        assert len(proc["chipIds"]) == 4
+        spec = pod.hosts[proc["hostId"]].runtime.container_inspect(
+            proc["container"]).spec
+        env = dict(e.split("=", 1) for e in spec.env)
+        # 4 chips of a v5e host form a contiguous 2x2 ICI block
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+        assert spec.ici_contiguous
+
+    def test_v5e4_inference_engine_runs(self):
+        """The compute half: KV-cached generate on the 4-device tp mesh the
+        placement above would hand the container."""
+        import jax
+
+        from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
+        from tpu_docker_api.models.llama import llama_init, llama_presets
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=4, sp=1),
+                          devices=jax.devices()[:4])
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        gen = make_generate_fn(cfg, GenerateConfig(max_new_tokens=4, max_seq=16),
+                               mesh=mesh)
+        out = gen(params, jax.numpy.ones((2, 8), jax.numpy.int32),
+                  jax.random.PRNGKey(1))
+        assert out["tokens"].shape == (2, 4)
+
+
+class TestConfig4V5p64DataParallel:
+    """BASELINE config #4: v5p-64 pretrain, DP ranks over an 8-host pod."""
+
+    def test_dp_rank_placement(self):
+        svc, pod = _job_stack()  # 2x2x2 host grid = 32 chips = v5p-64
+        info = svc.run_job(JobRun(
+            image_name="maxtext:tpu", job_name="pretrain",
+            accelerator_type="v5p-64",
+            binds=["/nfs/ckpt:/ckpt"],
+            cmd=["python", "-m", "tpu_docker_api.train",
+                 "--preset", "llama3-8b", "--ckpt-dir", "/ckpt"]))
+        assert info["chipCount"] == 32
+        assert len(info["processes"]) == 8
+        coord_addrs = set()
+        for proc in info["processes"]:
+            spec = pod.hosts[proc["hostId"]].runtime.container_inspect(
+                proc["container"]).spec
+            env = dict(e.split("=", 1) for e in spec.env)
+            assert env["JAX_NUM_PROCESSES"] == "8"
+            assert env["TPU_PROCESS_BOUNDS"] == "2,2,2"
+            assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,2,1"
+            coord_addrs.add(env["JAX_COORDINATOR_ADDRESS"])
+        assert len(coord_addrs) == 1  # every rank agrees on the coordinator
+
+
+@pytest.mark.slow
+class TestConfig5RollingRescaleMidTrain:
+    """BASELINE config #5: foo-0 (4 chips) → foo-1 (8 chips) mid-train.
+
+    The full loop with the REAL trainer (subprocess, virtual CPU devices):
+    train on a 4-device mesh writing checkpoints to the shared dir, SIGTERM
+    (= the job service's graceful stop) → quiesce checkpoint, control-plane
+    rescale 4→8, then the trainer resumes on an 8-device mesh from the
+    quiesced step — checkpoint continuity across the mesh change.
+    """
+
+    def _launch(self, ckpt, devices, fsdp, steps):
+        env = {**os.environ, "PYTHONPATH": str(REPO)}
+        return subprocess.Popen(
+            [sys.executable, "-m", "tpu_docker_api.train",
+             "--preset", "tiny", "--steps", str(steps), "--batch", "8",
+             "--seq", "64", "--platform", "cpu",
+             "--virtual-devices", str(devices), "--fsdp", str(fsdp),
+             "--ckpt-dir", str(ckpt), "--save-every", "1000",
+             "--log-every", "5"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+
+    def test_rescale_mid_train(self, tmp_path):
+        ckpt = tmp_path / "ckpt"
+        # phase 1: job-0 on 4 devices; let it make progress, then quiesce
+        p = self._launch(ckpt, devices=4, fsdp=2, steps=10_000)
+        deadline = time.monotonic() + 240
+        progressed = False
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if '"step"' in line and json.loads(line)["step"] >= 10:
+                progressed = True
+                break
+        assert progressed, "trainer never reached step 10"
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0
+        quiesced = json.loads(
+            [ln for ln in out.splitlines() if "quiesced" in ln][-1])
+        assert quiesced["step"] >= 10
+
+        # control plane: the rescale that would relaunch the container
+        svc, pod = _job_stack(grid=(2, 1, 1))
+        svc.run_job(JobRun(image_name="trainer", job_name="foo", chip_count=4,
+                           binds=[f"{ckpt}:/ckpt"]))
+        info = svc.patch_job_chips("foo", JobPatchChips(chip_count=8))
+        assert info["name"] == "foo-1" and info["chipCount"] == 8
+
+        # phase 2: job-1 on 8 devices resumes from the quiesced step
+        p2 = self._launch(ckpt, devices=8, fsdp=2,
+                          steps=quiesced["step"] + 10)
+        out2, _ = p2.communicate(timeout=360)
+        assert p2.returncode == 0, out2
+        done = json.loads([ln for ln in out2.splitlines() if "done" in ln][-1])
+        assert done["step"] == quiesced["step"] + 10
+        steps_logged = [json.loads(ln)["step"] for ln in out2.splitlines()
+                        if '"loss"' in ln]
+        # resumed, not restarted: no step below the quiesce point is re-run
+        assert min(steps_logged) > quiesced["step"]

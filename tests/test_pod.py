@@ -279,6 +279,10 @@ class TestJobService:
             svc.patch_job_chips("t", JobPatchChips(chip_count=6))
         with pytest.raises(errors.ChipNotEnough):
             svc.patch_job_chips("t", JobPatchChips(chip_count=64))
+        with pytest.raises(errors.BadRequest):
+            # 24 chips = 6 hosts: no 6-host axis-aligned block tiles a 2x2x2
+            # grid — deterministic shape infeasibility, not capacity
+            svc.patch_job_chips("t", JobPatchChips(chip_count=24))
         assert svc.get_job_info("t")["name"] == "t-0"
         for proc in info["processes"]:
             assert pod.hosts[proc["hostId"]].runtime.container_inspect(

@@ -60,7 +60,9 @@ log = logging.getLogger(__name__)
 
 from tpu_docker_api.state.keys import BASE_NAME_RE as _NAME_RE
 
-_VERSIONED_RE = re.compile(r"^[a-zA-Z0-9_.]+(-\d+)?$")
+# base-name charset + optional "-N" version suffix, derived so the two rules
+# cannot drift
+_VERSIONED_RE = re.compile(_NAME_RE.pattern.rstrip("$") + r"(-\d+)?$")
 
 
 def _validate_base_name(name: str) -> None:

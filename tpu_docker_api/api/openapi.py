@@ -167,14 +167,22 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
 def build_spec() -> dict:
     paths: dict[str, dict] = {}
     for method, path, op_id, summary, req_schema in _ROUTES:
-        op: dict[str, Any] = {
-            "operationId": op_id,
-            "summary": summary,
-            "responses": {"200": {
+        if path == "/metrics":
+            # the one non-envelope endpoint: Prometheus exposition text
+            response = {
+                "description": "Prometheus text exposition format",
+                "content": {"text/plain": {"schema": {"type": "string"}}},
+            }
+        else:
+            response = {
                 "description": _ENVELOPE_NOTE,
                 "content": {"application/json": {
                     "schema": {"$ref": "#/components/schemas/Envelope"}}},
-            }},
+            }
+        op: dict[str, Any] = {
+            "operationId": op_id,
+            "summary": summary,
+            "responses": {"200": response},
         }
         if "{name}" in path:
             op["parameters"] = [{

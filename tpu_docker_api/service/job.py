@@ -255,10 +255,20 @@ class JobService:
             if want > self.pod.n_chips:
                 raise errors.ChipNotEnough(
                     f"want {want} chips, pod has {self.pod.n_chips}")
-            if len(self.pod.hosts) > 1 and want > per_host and want % per_host:
-                raise errors.BadRequest(
-                    f"multi-host slices are host-granular: {want} is not a "
-                    f"multiple of {per_host} chips/host")
+            if len(self.pod.hosts) > 1 and want > per_host:
+                if want % per_host:
+                    raise errors.BadRequest(
+                        f"multi-host slices are host-granular: {want} is not "
+                        f"a multiple of {per_host} chips/host")
+                from tpu_docker_api.scheduler.slices import candidate_shapes
+
+                if not candidate_shapes(want // per_host, self.pod.host_grid):
+                    # e.g. 3 hosts cannot tile a 2x2x1 grid — deterministic,
+                    # no amount of freeing will help
+                    raise errors.BadRequest(
+                        f"{want // per_host} hosts cannot form an "
+                        f"axis-aligned block in host grid "
+                        f"{'x'.join(map(str, self.pod.host_grid))}")
 
             def _quiesce_old() -> None:
                 self._stop_members(old)
@@ -271,8 +281,10 @@ class JobService:
                 self._free_state_ports(old)
 
             def _resume_old() -> None:
-                self._start_members(old)
+                # store record first: if the restart fails too, the family's
+                # latest pointer must already be back on the old version
                 self.store.put_job(JobState.from_dict(old.to_dict()))
+                self._start_members(old)
 
             try:
                 # fast path: reserve new capacity first, containers created

@@ -1,0 +1,147 @@
+"""Training job entrypoint — what a container image launched by the control
+plane actually runs (BASELINE.json north star: POST /containers provisions a
+MaxText-class JAX pretraining job).
+
+    python -m tpu_docker_api.train --preset tiny --steps 100 \
+        --ckpt-dir /ckpt --save-every 20
+
+Contracts with the control plane:
+
+- **Distributed bootstrap**: if ``JAX_NUM_PROCESSES`` > 1 (rendered by the
+  job service, workload/jaxenv.py), calls ``jax.distributed.initialize`` with
+  the coordinator/process env before touching any backend.
+- **Quiesce**: SIGTERM/SIGINT (docker stop — the rescale flow's graceful
+  stop) checkpoints the current step before exiting, so ``job-(n+1)`` resumes
+  exactly where ``job-n`` stopped. This is the in-container half of the
+  quiesce→swap sequencing in service/job.py.
+- **Resume**: boots via ``resume_or_init`` — a fresh dir trains from step 0,
+  a dir with checkpoints restores onto the CURRENT mesh shape, which may
+  differ from the writer's (orbax resharding; tests/test_checkpoint.py).
+
+Emits one JSON line per log interval: {"step", "loss", "tokens_per_sec"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="python -m tpu_docker_api.train")
+    p.add_argument("--preset", default="tiny",
+                   help="model preset (llama: tiny, bench-350m, llama3-8b...; "
+                        "moe: prefix with moe:)")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=0, help="0 = preset default")
+    p.add_argument("--ckpt-dir", default="", help="'' disables checkpointing")
+    p.add_argument("--save-every", type=int, default=50)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--dp", type=int, default=-1)
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--platform", default="",
+                   help="force a jax platform (tests: cpu)")
+    p.add_argument("--virtual-devices", type=int, default=0,
+                   help="force N virtual CPU devices (tests)")
+    args = p.parse_args(argv)
+
+    if args.virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.virtual_devices}").strip()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    n_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if n_processes > 1:
+        # coordinator/process identity rendered by the control plane
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=n_processes,
+            process_id=int(os.environ["JAX_PROCESS_ID"]),
+        )
+
+    from tpu_docker_api.models.llama import llama_presets
+    from tpu_docker_api.models.moe import moe_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.checkpoint import resume_or_init
+    from tpu_docker_api.train.trainer import (
+        create_train_state,
+        make_train_step,
+        synthetic_batch,
+    )
+
+    if args.preset.startswith("moe:"):
+        cfg = moe_presets()[args.preset[4:]]
+    else:
+        cfg = llama_presets()[args.preset]
+    if args.seq:
+        cfg = dataclasses.replace(cfg, max_seq_len=args.seq)
+    seq = min(cfg.max_seq_len, 512) if not args.seq else args.seq
+
+    mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
+                               sp=args.sp, pp=args.pp, ep=args.ep))
+    key = jax.random.PRNGKey(0)
+    mgr = None
+    if args.ckpt_dir:
+        state, optimizer, mgr = resume_or_init(args.ckpt_dir, cfg, mesh, key)
+    else:
+        state, optimizer = create_train_state(cfg, mesh, key)
+    step_fn = make_train_step(cfg, mesh, optimizer)
+    start_step = int(state.step)
+
+    # quiesce contract: graceful stop ⇒ checkpoint ⇒ exit 0
+    stop = {"now": False}
+
+    def _quiesce(signum, _frame):
+        stop["now"] = True
+
+    signal.signal(signal.SIGTERM, _quiesce)
+    signal.signal(signal.SIGINT, _quiesce)
+
+    def _save(final: bool = False) -> None:
+        if mgr is not None:
+            mgr.save(state)
+            if final:
+                mgr.wait()
+
+    tokens_per_step = args.batch * seq
+    t0 = time.monotonic()
+    for i in range(start_step, args.steps):
+        batch = synthetic_batch(jax.random.PRNGKey(i), args.batch, seq,
+                                cfg.vocab_size)
+        state, metrics = step_fn(state, batch)
+        done = int(metrics["step"])
+        if stop["now"]:
+            _save(final=True)
+            print(json.dumps({"event": "quiesced", "step": done}), flush=True)
+            return
+        if done % args.log_every == 0 or done == args.steps:
+            dt = time.monotonic() - t0
+            steps_done = done - start_step
+            print(json.dumps({
+                "step": done,
+                "loss": round(float(metrics["loss"]), 4),
+                "tokens_per_sec": round(steps_done * tokens_per_step / dt, 1),
+            }), flush=True)
+        if mgr is not None and done % args.save_every == 0:
+            _save()
+    _save(final=True)
+    print(json.dumps({"event": "done", "step": int(state.step)}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
