@@ -72,13 +72,16 @@ def main() -> None:
     t_compile = time.perf_counter()
     for _ in range(max(args.warmup, 1)):  # ≥1: the first step compiles
         state, metrics = step_fn(state, tokens)
-    jax.block_until_ready(metrics["loss"])
+    # host read, not block_until_ready: remote-tunnel platforms have been
+    # seen returning from block_until_ready before execution finishes, which
+    # inflates throughput ~1000x; a device→host value transfer cannot lie
+    float(metrics["loss"])
     compile_s = time.perf_counter() - t_compile
 
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, metrics = step_fn(state, tokens)
-    jax.block_until_ready(metrics["loss"])
+    final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     steps_per_s = args.steps / dt
@@ -115,7 +118,7 @@ def main() -> None:
             "compile_plus_warmup_s": round(compile_s, 1),
             "platform": platform,
             "device_kind": getattr(devices[0], "device_kind", ""),
-            "final_loss": round(float(metrics["loss"]), 4),
+            "final_loss": round(final_loss, 4),
         },
     }
     print(json.dumps(result))

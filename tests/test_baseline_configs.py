@@ -214,12 +214,19 @@ class TestConfig5RollingRescaleMidTrain:
         p = self._launch(ckpt, devices=4, fsdp=2, steps=10_000)
         deadline = time.monotonic() + 240
         progressed = False
+        seen: list[str] = []
         while time.monotonic() < deadline:
+            if p.poll() is not None:  # died early — stop, report its output
+                seen.extend(p.stdout.readlines())  # drain buffered traceback
+                break
             line = p.stdout.readline()
+            if not line:
+                continue
+            seen.append(line)
             if '"step"' in line and json.loads(line)["step"] >= 10:
                 progressed = True
                 break
-        assert progressed, "trainer never reached step 10"
+        assert progressed, f"trainer never reached step 10; output: {seen!r}"
         p.send_signal(signal.SIGTERM)
         out, _ = p.communicate(timeout=120)
         assert p.returncode == 0

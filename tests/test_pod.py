@@ -84,8 +84,10 @@ class TestPodScheduler:
         assert spans[0] * spans[1] * spans[2] == 4  # fills its bounding box
 
     def test_host_granularity_enforced(self, sched):
-        with pytest.raises(errors.ChipNotEnough):
+        with pytest.raises(errors.BadRequest):
             sched.apply_slice(n_chips=6, owner="odd-1")  # 1.5 hosts
+        with pytest.raises(errors.BadRequest):
+            sched.apply_slice(n_chips=24, owner="odd-2")  # 6 hosts ∤ 2x2x2
 
     def test_sub_host_delegates_to_one_host(self, pod, sched):
         grant = sched.apply_slice(n_chips=2, owner="small-1")

@@ -254,10 +254,13 @@ class PodScheduler:
             if n_chips < per_host or len(self.pod.hosts) == 1:
                 grant = self._apply_sub_host_locked(n_chips, owner)
             else:
+                # deterministic infeasibilities are BadRequest, not
+                # ChipNotEnough: callers treat ChipNotEnough as a capacity
+                # problem that freeing other slices could solve
                 if n_chips % per_host:
-                    raise errors.ChipNotEnough(
-                        f"multi-host slices are host-granular: {n_chips} chips is not "
-                        f"a multiple of {per_host} chips/host"
+                    raise errors.BadRequest(
+                        f"multi-host slices are host-granular: {n_chips} chips "
+                        f"is not a multiple of {per_host} chips/host"
                     )
                 grant = self._apply_hosts_locked(n_chips // per_host, owner)
             self._grants[owner] = grant
@@ -287,6 +290,15 @@ class PodScheduler:
         )
 
     def _apply_hosts_locked(self, n_hosts: int, owner: str) -> SliceAllocation:
+        # deterministic infeasibility (no axis-aligned tiling exists) is
+        # BadRequest, not ChipNotEnough: callers treat ChipNotEnough as a
+        # capacity problem that freeing other slices could solve
+        shapes = candidate_shapes(n_hosts, self.pod.host_grid)
+        if not shapes:
+            raise errors.BadRequest(
+                f"{n_hosts} hosts cannot form an axis-aligned block "
+                f"in host grid {'x'.join(map(str, self.pod.host_grid))}"
+            )
         free_coords = {
             h.grid_coord for h in self.pod.hosts.values()
             if len(h.chips.free_chips) == h.topology.n_chips
@@ -297,7 +309,7 @@ class PodScheduler:
             )
         block = None
         shape: Shape = (n_hosts, 1, 1)
-        for cand in candidate_shapes(n_hosts, self.pod.host_grid):
+        for cand in shapes:
             block = _block_hosts(self.pod, cand, free_coords)
             if block is not None:
                 shape = cand

@@ -251,24 +251,14 @@ class JobService:
             # reject never-satisfiable asks BEFORE touching the running job
             # (a deterministic validation error must not bounce a healthy
             # workload through quiesce/free/relaunch)
-            per_host = self.pod.chips_per_host
+            # capacity that even a freed old slice cannot provide — fail
+            # before touching the running job. Shape infeasibilities
+            # (non-multiple counts, untileable host blocks) surface as
+            # BadRequest from the scheduler itself, which the fast path below
+            # does NOT catch — so they also propagate without a quiesce.
             if want > self.pod.n_chips:
                 raise errors.ChipNotEnough(
                     f"want {want} chips, pod has {self.pod.n_chips}")
-            if len(self.pod.hosts) > 1 and want > per_host:
-                if want % per_host:
-                    raise errors.BadRequest(
-                        f"multi-host slices are host-granular: {want} is not "
-                        f"a multiple of {per_host} chips/host")
-                from tpu_docker_api.scheduler.slices import candidate_shapes
-
-                if not candidate_shapes(want // per_host, self.pod.host_grid):
-                    # e.g. 3 hosts cannot tile a 2x2x1 grid — deterministic,
-                    # no amount of freeing will help
-                    raise errors.BadRequest(
-                        f"{want // per_host} hosts cannot form an "
-                        f"axis-aligned block in host grid "
-                        f"{'x'.join(map(str, self.pod.host_grid))}")
 
             def _quiesce_old() -> None:
                 self._stop_members(old)

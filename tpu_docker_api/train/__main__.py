@@ -124,7 +124,9 @@ def main(argv: list[str] | None = None) -> None:
         batch = synthetic_batch(jax.random.PRNGKey(i), args.batch, seq,
                                 cfg.vocab_size)
         state, metrics = step_fn(state, batch)
-        done = int(metrics["step"])
+        # host-side counter: reading metrics["step"] would force a device
+        # sync every step and defeat async dispatch on TPU
+        done = i + 1
         if stop["now"]:
             _save(final=True)
             print(json.dumps({"event": "quiesced", "step": done}), flush=True)
