@@ -242,9 +242,9 @@ def moe_forward(
         _moe_block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin, mesh=mesh
     )
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
+        from tpu_docker_api.ops.flash_pallas import TRAIN_REMAT_POLICY
+
+        block = jax.checkpoint(block, policy=TRAIN_REMAT_POLICY)
 
     def scan_body(x, layer):
         x, aux = block(x, layer)

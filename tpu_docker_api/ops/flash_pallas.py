@@ -34,8 +34,26 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+#: remat policy fragment: save the flash forward's (out, lse) residuals so a
+#: rematerialized backward runs only the backward kernels instead of
+#: re-running the forward kernel first (combine with a dots policy via
+#: ``jax.checkpoint_policies.save_from_both_policies``)
+FLASH_SAVEABLE = jax.checkpoint_policies.save_only_these_names(
+    "flash_out", "flash_lse"
+)
+
+#: the framework-wide training remat policy: saveable dots (a pallas_call is
+#: not a dot, hence the explicit flash names) — use this at EVERY
+#: ``jax.checkpoint`` site that can reach the flash kernel (llama, moe,
+#: pipeline stages)
+TRAIN_REMAT_POLICY = jax.checkpoint_policies.save_from_both_policies(
+    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    FLASH_SAVEABLE,
+)
 
 _NEG_INF = -1e30
 
@@ -45,7 +63,10 @@ def _flash_kernel(
     scale: float, causal: bool,
 ):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, head_dim)
+    # dot operands stay in the storage dtype (bf16 → full-rate MXU; f32
+    # operands would run the MXU ~12x slower on v5e); accumulation and all
+    # softmax statistics are f32 via preferred_element_type
+    q = q_ref[0, 0]  # (block_q, head_dim)
     head_dim = q.shape[-1]
     num_k_blocks = k_ref.shape[2] // block_k
 
@@ -57,12 +78,12 @@ def _flash_kernel(
 
     def body(kj, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
+        ) * scale  # (block_q, block_k) f32
         if causal:
             rows = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -77,7 +98,7 @@ def _flash_kernel(
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return acc_new, m_new, l_new
@@ -114,12 +135,13 @@ def _flash_kernel_kvgrid(
 
     @pl.when(visible)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        # bf16 dot operands (full-rate MXU), f32 accumulation + stats
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        ) * scale
         if causal:
             rows = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -133,7 +155,8 @@ def _flash_kernel_kvgrid(
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
 
@@ -181,17 +204,18 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(visible)
     def _step():
-        q = q_ref[0, 0].astype(jnp.float32)           # (block_q, head_dim)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 dot operands (full-rate MXU), f32 accumulation + stats
+        q = q_ref[0, 0]                               # (block_q, head_dim)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, :1]                    # (block_q, 1)
         delta = delta_ref[0, 0, :, :1]
-        k = k_ref[0, 0].astype(jnp.float32)           # (block_k, head_dim)
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0]                               # (block_k, head_dim)
+        v = v_ref[0, 0]
         p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -227,20 +251,22 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(visible)
     def _step():
-        k = k_ref[0, 0].astype(jnp.float32)           # (block_k, head_dim)
-        v = v_ref[0, 0].astype(jnp.float32)
-        q = q_ref[0, 0].astype(jnp.float32)           # (block_q, head_dim)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 dot operands (full-rate MXU), f32 accumulation + stats
+        k = k_ref[0, 0]                               # (block_k, head_dim)
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]                               # (block_q, head_dim)
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, :1]
         delta = delta_ref[0, 0, :, :1]
         p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal)
         dv_acc_ref[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_acc_ref[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -416,6 +442,12 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _fwd_impl(q, k, v, causal, block_q, block_k, interpret)
+    # names let a remat policy keep these residuals: a pallas_call is not a
+    # dot primitive, so dots-saveable policies would otherwise discard them
+    # and re-run the whole forward kernel inside the backward pass (see
+    # FLASH_SAVEABLE / llama's remat policy)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return out, (q, k, v, out, lse)
 
 

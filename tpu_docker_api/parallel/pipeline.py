@@ -94,9 +94,9 @@ def pipeline_forward(
         _block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin, mesh=None
     )
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
+        from tpu_docker_api.ops.flash_pallas import TRAIN_REMAT_POLICY
+
+        block = jax.checkpoint(block, policy=TRAIN_REMAT_POLICY)
 
     def apply_stage(layers_stage, h):
         """Run this stage's layers_per_stage blocks; vmapped over stages."""
