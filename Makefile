@@ -1,0 +1,37 @@
+# tpu-docker-api build/test entry points.
+# Parity: reference Makefile:15-43 (build + fmt targets); the control plane
+# itself is pure Python, so "build" here means the native telemetry shim and
+# the generated API artifacts.
+
+PY ?= python
+
+.PHONY: all native test test-fast bench openapi sample-interface clean
+
+all: native openapi
+
+native:                      ## build the C++ telemetry shim (tpu_native/)
+	$(MAKE) -C tpu_native
+
+test:                        ## full hermetic suite (8-device virtual CPU mesh)
+	$(PY) -m pytest tests/ -q
+
+test-fast:                   ## control-plane tests only (no JAX compiles)
+	$(PY) -m pytest tests/ -q --ignore=tests/test_ops.py \
+	  --ignore=tests/test_models.py --ignore=tests/test_moe.py \
+	  --ignore=tests/test_parallel.py --ignore=tests/test_pipeline.py \
+	  --ignore=tests/test_trainer.py --ignore=tests/test_infer.py \
+	  --ignore=tests/test_baseline_configs.py --ignore=tests/test_checkpoint.py
+
+bench:                       ## headline bench (one JSON line)
+	$(PY) bench.py
+
+openapi:                     ## regenerate the OpenAPI contract
+	$(PY) -m tpu_docker_api.api.openapi > api/openapi.json.tmp
+	mv api/openapi.json.tmp api/openapi.json
+
+sample-interface:            ## regenerate the captured request/response doc
+	$(PY) scripts/gen_sample_interface.py > api/sample-interface.md.tmp
+	mv api/sample-interface.md.tmp api/sample-interface.md
+
+clean:
+	$(MAKE) -C tpu_native clean
