@@ -133,31 +133,42 @@ def llama_init(cfg: LlamaConfig, key: jax.Array) -> dict:
 
 def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
                cache=None, start_pos=None):
-    """Self-attention. With ``cache=(k_cache, v_cache)`` of shape
-    (batch, max_seq, n_kv_heads, head_dim) runs the KV-cached path — writes
-    the new k/v at ``start_pos`` and attends against the full buffer via
-    ``dense_attention``'s q_offset mask (which covers both in-block causality
-    and not-yet-written slots) — and returns (out, new_cache) instead of out.
-    """
+    """Self-attention. With ``cache=(k_all, v_all, layer_idx)`` — the FULL
+    (n_layers, batch, max_seq, n_kv_heads, head_dim) cache buffers plus this
+    layer's index — runs the KV-cached path: writes the new k/v into this
+    layer's slots at ``start_pos`` (a small in-place dynamic_update_slice on
+    the scan-carried buffer; rebuilding a per-layer cache as scan ys would
+    re-materialize the whole cache every decode step) and attends against
+    the layer's buffer via ``dense_attention``'s q_offset mask (which covers
+    both in-block causality and not-yet-written slots). Returns
+    (out, (k_all, v_all)) instead of out."""
     b, s, d = x.shape
     hd = cfg.head_dim
     q = (x @ layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
     k = (x @ layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
     v = (x @ layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     if cache is not None:
+        k_all, v_all, layer_idx = cache
         positions = jnp.broadcast_to(
             start_pos + jnp.arange(s, dtype=jnp.int32)[None, :], (b, s)
         )
         q = apply_rope(q, rope_cos, rope_sin, positions)
         k = apply_rope(k, rope_cos, rope_sin, positions)
-        k_cache = lax.dynamic_update_slice_in_dim(
-            cache[0], k.astype(cache[0].dtype), start_pos, axis=1)
-        v_cache = lax.dynamic_update_slice_in_dim(
-            cache[1], v.astype(cache[1].dtype), start_pos, axis=1)
+        zero = jnp.int32(0)
+        k_all = lax.dynamic_update_slice(
+            k_all, k.astype(k_all.dtype)[None],
+            (layer_idx, zero, start_pos, zero, zero))
+        v_all = lax.dynamic_update_slice(
+            v_all, v.astype(v_all.dtype)[None],
+            (layer_idx, zero, start_pos, zero, zero))
+        k_cache = lax.dynamic_index_in_dim(k_all, layer_idx, 0,
+                                           keepdims=False)
+        v_cache = lax.dynamic_index_in_dim(v_all, layer_idx, 0,
+                                           keepdims=False)
         out = dense_attention(q, k_cache, v_cache, causal=True,
                               q_offset=start_pos)
         return out.reshape(b, s, cfg.n_heads * hd) @ layer["attn"]["wo"], (
-            k_cache, v_cache)
+            k_all, v_all)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
     if cfg.attention_impl == "ring":
@@ -247,13 +258,16 @@ def llama_forward_cached(
     """KV-cached forward: logits for the new tokens + updated caches.
 
     Block math is ``_block`` itself (cache threaded through it — one source
-    of truth with ``llama_forward``); the layer scan carries the per-layer
-    cache slices as scan xs/ys so compile time stays O(1) in depth.
-    ``start_pos`` is a traced scalar — one compiled program serves every
-    decode step. ``last_only=True`` applies lm_head to the final position
-    only (prefill wants just the next-token logits; skipping the
-    (b, seq, vocab) f32 intermediate saves prompt_len× the logits memory and
-    FLOPs).
+    of truth with ``llama_forward``); the layer scan CARRIES the full cache
+    buffers and each layer writes only its new-token slots in place, so a
+    decode step's cache traffic is one small write + one layer-sized read
+    per layer — carrying the cache as scan xs/ys instead would stack fresh
+    ys and re-materialize the entire cache every step (~4x decode time at
+    bench shapes). Compile time stays O(1) in depth. ``start_pos`` is a
+    traced scalar — one compiled program serves every decode step.
+    ``last_only=True`` applies lm_head to the final position only (prefill
+    wants just the next-token logits; skipping the (b, seq, vocab) f32
+    intermediate saves prompt_len× the logits memory and FLOPs).
     """
     b, s = tokens.shape
     max_seq = k_cache.shape[2]
@@ -262,16 +276,18 @@ def llama_forward_cached(
         x = constrain(x, mesh, P(("dp", "fsdp"), None))
     rope_cos, rope_sin = rope_frequencies(cfg.head_dim, max_seq, cfg.rope_theta)
 
-    def scan_body(x, layer_and_cache):
-        layer, kc, vc = layer_and_cache
-        x, (new_kc, new_vc) = _block(
+    def scan_body(carry, layer_and_idx):
+        x, kc, vc = carry
+        layer, layer_idx = layer_and_idx
+        x, (kc, vc) = _block(
             x, layer, cfg, rope_cos, rope_sin, mesh,
-            cache=(kc, vc), start_pos=start_pos,
+            cache=(kc, vc, layer_idx), start_pos=start_pos,
         )
-        return x, (new_kc, new_vc)
+        return (x, kc, vc), None
 
-    x, (new_k, new_v) = lax.scan(
-        scan_body, x, (params["layers"], k_cache, v_cache)
+    (x, new_k, new_v), _ = lax.scan(
+        scan_body, (x, k_cache, v_cache),
+        (params["layers"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
     )
     if last_only:
         x = x[:, -1:]

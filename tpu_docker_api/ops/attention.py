@@ -2,8 +2,10 @@
 
 Two implementations behind one call:
 
-- ``dense``: plain einsum attention in f32 — the XLA-fused baseline and the
-  correctness reference (also what runs on CPU test meshes);
+- ``dense``: einsum attention with storage-dtype operands and f32
+  accumulation/softmax (bf16 products are exact in f32, so this equals
+  fully-upcast math) — the XLA-fused baseline and the correctness
+  reference (also what runs on CPU test meshes);
 - ``flash``: the Pallas TPU kernel (ops/flash_pallas.py) — O(seq) memory via
   online softmax.
 
@@ -34,12 +36,15 @@ def dense_attention(
     batch, seq, num_heads, head_dim = q.shape
     kv_seq, num_kv = k.shape[1], k.shape[2]
     group = num_heads // num_kv
-    qf = q.astype(jnp.float32) / (head_dim**0.5)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    # fold GQA group into the einsum instead of repeating kv
-    qg = qf.reshape(batch, seq, num_kv, group, head_dim)
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)
+    # q/k stay in the storage dtype with f32 accumulation: bf16 products
+    # are exact in f32, so this equals the upcast-everything numerics
+    # without writing f32 copies of the cache. probs stay f32 (a downcast
+    # would make results depend on the cache dtype) — XLA upcasts v
+    # in-register inside the fused einsum, not in HBM.
+    qg = q.reshape(batch, seq, num_kv, group, head_dim)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / head_dim**0.5)
     if causal:
         q_pos = jnp.arange(seq, dtype=jnp.int32)
         if q_offset is not None:
@@ -48,7 +53,8 @@ def dense_attention(
         mask = k_pos[None, :] <= q_pos[:, None]  # (q_seq, kv_seq)
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vf)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v,
+                     preferred_element_type=jnp.float32)
     return out.reshape(batch, seq, num_heads, head_dim).astype(q.dtype)
 
 
