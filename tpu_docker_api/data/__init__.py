@@ -1,0 +1,5 @@
+from tpu_docker_api.data.loader import (  # noqa: F401
+    TokenSource,
+    make_batch_fn,
+    open_token_files,
+)

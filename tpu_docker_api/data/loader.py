@@ -1,0 +1,175 @@
+"""Token-stream data layer: deterministic, shardable, resumable.
+
+The reference provisions opaque containers and has no data path at all
+(SURVEY.md §0); the workloads this control plane launches are MaxText-class
+pretraining jobs, so the framework ships the loader those jobs need. The
+design is TPU-first in the same sense as the trainer:
+
+- **Stateless step→batch mapping.** A batch is a pure function of
+  ``(seed, step)``: window indices come from an affine permutation of the
+  window space, so resuming at step N reproduces exactly the batch the
+  pre-quiesce job would have seen at step N — no iterator state in
+  checkpoints, nothing to migrate on rescale. This is the data-layer half of
+  the quiesce→resume contract (train/__main__.py).
+- **Process-sharded rows.** In a multi-host job every process owns a
+  disjoint row range of the global batch (``rows_for_process``) — the
+  data-parallel analog of how the job service shards chips (workload/
+  jaxenv.py renders ``JAX_PROCESS_ID``; the loader consumes it).
+- **Zero-copy reads.** Token files are memory-mapped (np.memmap); a batch
+  gathers windows without materializing the corpus. Host RAM stays O(batch).
+
+File format: flat little-endian token ids, ``.bin`` (uint16 when
+vocab < 65536, else int32) or ``.npy``. Multiple files concatenate in sorted
+order into one logical stream diced into non-overlapping (seq+1)-token
+windows (+1: the trainer shifts tokens/targets off one array).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Callable, Sequence
+
+import numpy as np
+
+
+def _coprime_stride(n: int, seed: int) -> int:
+    """Deterministic multiplier coprime to n (an affine permutation of
+    Z_n needs gcd(a, n) == 1); scans odd offsets from a seed-mixed start."""
+    if n == 1:
+        return 1
+    a = (0x9E3779B1 * (seed + 1)) % n
+    a = a | 1  # odd helps for even n
+    while np.gcd(int(a), int(n)) != 1:
+        a = (a + 2) % n or 1
+    return int(a)
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenSource:
+    """A logical token stream diced into fixed windows."""
+
+    arrays: tuple[np.ndarray, ...]  # memory-mapped, 1-D
+    window: int                     # tokens per window (seq + 1)
+
+    def __post_init__(self):
+        if self.window < 2:
+            raise ValueError(f"window must be >= 2, got {self.window}")
+        if not self.arrays or sum(a.size for a in self.arrays) < self.window:
+            total = sum(a.size for a in self.arrays) if self.arrays else 0
+            raise ValueError(
+                f"need at least {self.window} tokens, have {total}")
+
+    @property
+    def n_tokens(self) -> int:
+        return sum(a.size for a in self.arrays)
+
+    @property
+    def n_windows(self) -> int:
+        return self.n_tokens // self.window
+
+    def read_window(self, index: int) -> np.ndarray:
+        """Window ``index`` (mod n_windows ⇒ infinite epochs) as int32."""
+        index = int(index) % self.n_windows
+        start = index * self.window
+        out = np.empty(self.window, np.int32)
+        filled = 0
+        for arr in self.arrays:
+            if start >= arr.size:
+                start -= arr.size
+                continue
+            take = min(arr.size - start, self.window - filled)
+            out[filled:filled + take] = arr[start:start + take]
+            filled += take
+            start = 0
+            if filled == self.window:
+                return out
+        raise AssertionError("unreachable: n_windows bounds the index")
+
+
+def open_token_files(
+    paths: Sequence[str | pathlib.Path] | str | pathlib.Path,
+    window: int,
+    bin_dtype: str = "uint16",
+) -> TokenSource:
+    """Memory-map token files into a TokenSource. ``paths`` may be a single
+    file, a directory (all ``*.bin``/``*.npy`` inside, sorted), or a list."""
+    if isinstance(paths, (str, pathlib.Path)):
+        p = pathlib.Path(paths)
+        if p.is_dir():
+            paths = sorted(
+                q for q in p.iterdir() if q.suffix in (".bin", ".npy"))
+        else:
+            paths = [p]
+    arrays = []
+    for p in map(pathlib.Path, paths):
+        if p.suffix == ".npy":
+            arr = np.load(p, mmap_mode="r")
+            if arr.ndim != 1:
+                raise ValueError(f"{p}: token arrays must be 1-D, got {arr.shape}")
+        elif p.suffix == ".bin":
+            arr = np.memmap(p, dtype=np.dtype(bin_dtype), mode="r")
+        else:
+            raise ValueError(f"{p}: expected .bin or .npy")
+        arrays.append(arr)
+    return TokenSource(arrays=tuple(arrays), window=window)
+
+
+def rows_for_process(
+    global_batch: int, process_index: int, process_count: int
+) -> range:
+    """The contiguous row range of the global batch a process owns."""
+    if global_batch % process_count:
+        raise ValueError(
+            f"global batch {global_batch} must divide by process count "
+            f"{process_count}")
+    per = global_batch // process_count
+    return range(process_index * per, (process_index + 1) * per)
+
+
+def make_batch_fn(
+    source: TokenSource,
+    global_batch: int,
+    *,
+    seed: int = 0,
+    process_index: int = 0,
+    process_count: int = 1,
+) -> Callable[[int], np.ndarray]:
+    """``fn(step) -> (local_batch, window) int32``, a pure function.
+
+    Window selection for (step, row): position ``p = step·B + row`` in the
+    visitation order, mapped through the affine permutation
+    ``w = (a·p + b) mod n_windows`` — a full-period shuffle that changes
+    per epoch (b advances by the epoch index, so revisits interleave
+    differently) while staying O(1) stateless.
+    """
+    n = source.n_windows
+    a = _coprime_stride(n, seed)
+    rows = rows_for_process(global_batch, process_index, process_count)
+
+    def batch_at(step: int) -> np.ndarray:
+        out = np.empty((len(rows), source.window), np.int32)
+        for i, row in enumerate(rows):
+            p = step * global_batch + row
+            epoch, pos = divmod(p, n)
+            w = (a * pos + seed + epoch) % n
+            out[i] = source.read_window(w)
+        return out
+
+    return batch_at
+
+
+def write_token_file(
+    tokens: np.ndarray, path: str | pathlib.Path, bin_dtype: str = "uint16"
+) -> pathlib.Path:
+    """Write a 1-D token array in the loader's ``.bin`` format (tooling for
+    tests and corpus prep)."""
+    path = pathlib.Path(path)
+    arr = np.asarray(tokens)
+    if arr.ndim != 1:
+        raise ValueError(f"tokens must be 1-D, got {arr.shape}")
+    info = np.iinfo(np.dtype(bin_dtype))
+    if arr.min() < info.min or arr.max() > info.max:
+        raise ValueError(f"token ids do not fit {bin_dtype}")
+    arr.astype(np.dtype(bin_dtype)).tofile(path)
+    return path

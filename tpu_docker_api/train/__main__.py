@@ -40,6 +40,13 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=0, help="0 = preset default")
+    p.add_argument("--data", default="",
+                   help="token file/dir (.bin/.npy, data/loader.py); "
+                        "'' trains on synthetic tokens")
+    p.add_argument("--data-seed", type=int, default=0)
+    p.add_argument("--data-dtype", default="",
+                   help=".bin token width; '' picks by vocab size "
+                        "(uint16 below 65536, else int32)")
     p.add_argument("--ckpt-dir", default="", help="'' disables checkpointing")
     p.add_argument("--save-every", type=int, default=50)
     p.add_argument("--log-every", type=int, default=10)
@@ -118,12 +125,37 @@ def main(argv: list[str] | None = None) -> None:
             if final:
                 mgr.wait()
 
+    # get_batch returns this process's rows of the global batch (the train
+    # step's contract — trainer.py assembles the global array from process
+    # shards when JAX_NUM_PROCESSES > 1)
+    if args.data:
+        from tpu_docker_api.data import make_batch_fn, open_token_files
+
+        # stateless (seed, step) -> batch: resume at step N reads exactly
+        # the batch job-(n-1) would have seen — the data half of quiesce
+        bin_dtype = args.data_dtype or (
+            "int32" if cfg.vocab_size > 65535 else "uint16")
+        source = open_token_files(args.data, window=seq + 1,
+                                  bin_dtype=bin_dtype)
+        get_batch = make_batch_fn(
+            source, args.batch, seed=args.data_seed,
+            process_index=jax.process_index(),
+            process_count=n_processes,
+        )
+    else:
+        from tpu_docker_api.data.loader import rows_for_process
+
+        rows = rows_for_process(args.batch, jax.process_index(), n_processes)
+
+        def get_batch(i):
+            full = synthetic_batch(jax.random.PRNGKey(i), args.batch, seq,
+                                   cfg.vocab_size)
+            return full[rows.start:rows.stop]
+
     tokens_per_step = args.batch * seq
     t0 = time.monotonic()
     for i in range(start_step, args.steps):
-        batch = synthetic_batch(jax.random.PRNGKey(i), args.batch, seq,
-                                cfg.vocab_size)
-        state, metrics = step_fn(state, batch)
+        state, metrics = step_fn(state, get_batch(i))
         # host-side counter: reading metrics["step"] would force a device
         # sync every step and defeat async dispatch on TPU
         done = i + 1

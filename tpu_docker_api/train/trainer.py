@@ -128,7 +128,15 @@ def make_train_step(
         return TrainState(state.step + 1, new_params, new_opt), metrics
 
     def step(state, tokens):
-        tokens = jax.device_put(tokens, batch_sharding)
+        # contract: ``tokens`` is this process's rows of the global batch
+        # (== the whole batch in single-process runs). Multi-process runs
+        # must assemble the global array from per-process shards — a plain
+        # device_put would reinterpret the local rows as the global batch.
+        if jax.process_count() > 1:
+            tokens = jax.make_array_from_process_local_data(
+                batch_sharding, tokens)
+        else:
+            tokens = jax.device_put(tokens, batch_sharding)
         with mesh:
             return train_step(state, tokens)
 
