@@ -56,6 +56,11 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--profile-dir", default="",
+                   help="write a jax.profiler trace (TensorBoard/Perfetto "
+                        "format) covering post-compile steps")
+    p.add_argument("--profile-steps", type=int, default=5,
+                   help="how many steps the trace covers")
     p.add_argument("--platform", default="",
                    help="force a jax platform (tests: cpu)")
     p.add_argument("--virtual-devices", type=int, default=0,
@@ -152,14 +157,35 @@ def main(argv: list[str] | None = None) -> None:
                                    cfg.vocab_size)
             return full[rows.start:rows.stop]
 
+    # profiling (SURVEY.md §5.1 — the reference has none): trace a window
+    # of post-compile steps; the first step's compile would drown the trace
+    # (unless only one step remains, where compile-heavy beats no trace)
+    profile_at = min(start_step + 1, args.steps - 1) if args.profile_dir else -1
+    profiling = False
+
+    def _stop_profile(metrics) -> None:
+        nonlocal profiling
+        float(metrics["loss"])  # drain the dispatch queue into the trace
+        jax.profiler.stop_trace()
+        profiling = False
+        print(json.dumps({"event": "profile_written",
+                          "dir": args.profile_dir}), flush=True)
+
     tokens_per_step = args.batch * seq
     t0 = time.monotonic()
     for i in range(start_step, args.steps):
+        if i == profile_at:
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
         state, metrics = step_fn(state, get_batch(i))
+        if profiling and i >= profile_at + args.profile_steps - 1:
+            _stop_profile(metrics)
         # host-side counter: reading metrics["step"] would force a device
         # sync every step and defeat async dispatch on TPU
         done = i + 1
         if stop["now"]:
+            if profiling:
+                _stop_profile(metrics)
             _save(final=True)
             print(json.dumps({"event": "quiesced", "step": done}), flush=True)
             return
@@ -173,6 +199,8 @@ def main(argv: list[str] | None = None) -> None:
             }), flush=True)
         if mgr is not None and done % args.save_every == 0:
             _save()
+    if profiling:  # profile window outran the step budget
+        _stop_profile(metrics)
     _save(final=True)
     print(json.dumps({"event": "done", "step": int(state.step)}), flush=True)
 
