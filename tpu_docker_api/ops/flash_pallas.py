@@ -110,8 +110,12 @@ def _flash_kernel(
     acc, m, l = lax.fori_loop(0, k_limit, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    # lse carries an 8-wide sublane dim (TPU min f32 tile is (8, 128))
-    lse_ref[0, 0] = jnp.broadcast_to(m + jnp.log(l_safe), lse_ref.shape[2:])
+    # lse is stored TRANSPOSED, (…, 8, block_q): seq on the lane dim keeps
+    # the buffer dense — a (…, block_q, 8) layout pads lanes 8→128 (16x
+    # HBM for a saved-residual buffer). The 8 sublanes are broadcast copies
+    # (min f32 tile height).
+    lse_ref[0, 0] = jnp.broadcast_to(
+        (m + jnp.log(l_safe)).T, lse_ref.shape[2:])
 
 
 def _flash_kernel_kvgrid(
@@ -165,8 +169,9 @@ def _flash_kernel_kvgrid(
     def _finalize():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = jnp.broadcast_to(m_ref[:] + jnp.log(l_safe),
-                                         lse_ref.shape[2:])
+        # transposed store — see _flash_kernel
+        lse_ref[0, 0] = jnp.broadcast_to(
+            (m_ref[:] + jnp.log(l_safe)).T, lse_ref.shape[2:])
 
 
 def _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal):
@@ -208,8 +213,9 @@ def _flash_bwd_dq_kernel(
         # bf16 dot operands (full-rate MXU), f32 accumulation + stats
         q = q_ref[0, 0]                               # (block_q, head_dim)
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0, :, :1]                    # (block_q, 1)
-        delta = delta_ref[0, 0, :, :1]
+        # stats tiles are transposed (8, block_q) — see _flash_kernel
+        lse = lse_ref[0, 0, :1, :].T                  # (block_q, 1)
+        delta = delta_ref[0, 0, :1, :].T
         k = k_ref[0, 0]                               # (block_k, head_dim)
         v = v_ref[0, 0]
         p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal)
@@ -257,8 +263,9 @@ def _flash_bwd_dkv_kernel(
         v = v_ref[0, 0]
         q = q_ref[0, 0]                               # (block_q, head_dim)
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0, :, :1]
-        delta = delta_ref[0, 0, :, :1]
+        # stats tiles are transposed (8, block_q) — see _flash_kernel
+        lse = lse_ref[0, 0, :1, :].T
+        delta = delta_ref[0, 0, :1, :].T
         p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal)
         dv_acc_ref[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -290,9 +297,11 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
     scale = 1.0 / (head_dim**0.5)
     out_shapes = (
         jax.ShapeDtypeStruct(q.shape, q.dtype),
-        # trailing 8: f32 tiles are (8, 128), so row stats carry a
-        # broadcast sublane dim to stay tile-aligned
-        jax.ShapeDtypeStruct((batch, num_heads, seq, 8), jnp.float32),
+        # TRANSPOSED row stats, (…, 8, seq): seq on the lane dim keeps the
+        # buffer dense; a (…, seq, 8) layout would pad lanes 8→128 (16x
+        # HBM on a buffer that remat saves per layer). The 8 sublanes are
+        # broadcast copies (min f32 tile height).
+        jax.ShapeDtypeStruct((batch, num_heads, 8, seq), jnp.float32),
     )
     kv_bytes = 2 * seq * head_dim * 2  # k + v, bf16
     if kv_bytes <= _KV_VMEM_BUDGET_BYTES:
@@ -316,8 +325,8 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             out_specs=(
                 pl.BlockSpec((1, 1, block_q, head_dim),
                              lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 8),
-                             lambda b, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, 8, block_q),
+                             lambda b, h, i: (b, h, 0, i)),
             ),
             out_shape=out_shapes,
             interpret=interpret,
@@ -343,8 +352,8 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         out_specs=(
             pl.BlockSpec((1, 1, block_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 8),
-                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
         ),
         out_shape=out_shapes,
         scratch_shapes=[
@@ -362,12 +371,12 @@ def _bwd_impl(causal, block_q, block_k, interpret, residuals, dout):
     num_kv_heads = k.shape[1]
     group = num_heads // num_kv_heads
     scale = 1.0 / (head_dim**0.5)
-    # D_i = rowsum(dO ∘ O): tiny elementwise pre-pass, XLA fuses it;
-    # broadcast to the same (…, 8) sublane layout as lse
+    # D_i = rowsum(dO ∘ O): tiny elementwise pre-pass, XLA fuses it; built
+    # in the same transposed (…, 8, seq) layout as lse (dense lanes)
     delta = jnp.broadcast_to(
         jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
-                axis=-1, keepdims=True),
-        (*dout.shape[:3], 8))
+                axis=-1)[:, :, None, :],
+        (*dout.shape[:2], 8, dout.shape[2]))
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_q=block_q, block_k=block_k,
@@ -385,8 +394,8 @@ def _bwd_impl(causal, block_q, block_k, interpret, residuals, dout):
                          lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, head_dim),
                          lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 8), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 8), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, h, i, j: (b, h, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
                                lambda b, h, i, j: (b, h, i, 0)),
@@ -407,10 +416,10 @@ def _bwd_impl(causal, block_q, block_k, interpret, residuals, dout):
                          lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
             pl.BlockSpec((1, 1, block_q, head_dim),
                          lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 8),
-                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 8),
-                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, 0, i)),
+            pl.BlockSpec((1, 1, 8, block_q),
+                         lambda b, hk, j, g, i, G=group: (b, hk * G + g, 0, i)),
             pl.BlockSpec((1, 1, block_k, head_dim),
                          lambda b, hk, j, g, i: (b, hk, j, 0)),
             pl.BlockSpec((1, 1, block_k, head_dim),
