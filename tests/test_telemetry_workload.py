@@ -118,6 +118,50 @@ class TestJaxEnv:
         assert env["CLOUD_TPU_TASK_ID"] == "1"
         assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
 
+    def test_single_slice_renders_no_megascale(self):
+        topo = HostTopology.build("v5e-8")
+        specs = render_job_specs(self.make_job(), topo, image="i", cmd=["c"])
+        assert not any("MEGASCALE" in e for s in specs for e in s.env)
+
+    def test_multislice_megascale_env(self):
+        """num_slices > 1 ⇒ every process gets the MEGASCALE_* DCN vars
+        (SURVEY.md §2.3 comm-backend row) and slice 0's coordinator
+        publishes the megascale port."""
+        placements = [
+            ProcessPlacement(0, "10.0.0.1", [0, 1, 2, 3], 8476, slice_id=0),
+            ProcessPlacement(1, "10.0.0.2", [0, 1, 2, 3], 8476, slice_id=1),
+        ]
+        job = DistributedJob("train", placements, coordinator_port=40000,
+                             num_slices=2)
+        topo = HostTopology.build("v5e-8")
+        specs = render_job_specs(job, topo, image="i", cmd=["c"])
+        for i, spec in enumerate(specs):
+            env = dict(e.split("=", 1) for e in spec.env)
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "10.0.0.1:40001"
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(i)
+            assert env["MEGASCALE_PORT"] == "40001"
+            # the libtpu ICI mesh is per-slice: each slice here is a single
+            # process, so no cross-slice hosts leak into the mesh vars
+            assert env["TPU_PROCESS_ADDRESSES"] == (
+                f"10.0.0.{i + 1}:8476")
+            assert env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+            assert env["CLOUD_TPU_TASK_ID"] == "0"
+        p0_ports = {pb.host_port for pb in specs[0].port_bindings}
+        assert {8476, 40000, 40001} <= p0_ports
+
+    def test_multislice_explicit_megascale_port(self):
+        placements = [
+            ProcessPlacement(0, "10.0.0.1", [0], 8476, slice_id=0),
+            ProcessPlacement(1, "10.0.0.2", [0], 8476, slice_id=1),
+        ]
+        job = DistributedJob("train", placements, coordinator_port=40000,
+                             num_slices=2, megascale_port=45555)
+        topo = HostTopology.build("v5e-8")
+        env = dict(e.split("=", 1) for e in render_job_specs(
+            job, topo, image="i", cmd=["c"])[0].env)
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "10.0.0.1:45555"
+
     def test_coordinator_address_tracks_process_0_not_list_order(self):
         placements = [
             ProcessPlacement(1, "10.0.0.2", [0, 1, 2, 3], 8476),

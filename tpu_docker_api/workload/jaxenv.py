@@ -34,6 +34,8 @@ class ProcessPlacement:
     # per-host topology for multi-host pods (hosts may differ from the
     # control-plane host); None ⇒ use the topology passed to render_job_specs
     topology: HostTopology | None = None
+    # which ICI domain this process belongs to in a multislice job
+    slice_id: int = 0
 
 
 @dataclasses.dataclass
@@ -45,6 +47,13 @@ class DistributedJob:
     # "gx,gy,gz" DCN process grid (the pod scheduler's host-block shape);
     # "" ⇒ safe 1D default from _process_bounds
     process_bounds: str = ""
+    # multislice (SURVEY.md §2.3 "megascale flags"): >1 ⇒ the job spans
+    # num_slices ICI domains stitched over DCN; per-process slice ids live
+    # on the placements and every process gets MEGASCALE_* env
+    num_slices: int = 1
+    # megascale transport port on the slice-0 coordinator host; 0 ⇒ reuse
+    # coordinator_port + 1 (must be distinct from the JAX coordination port)
+    megascale_port: int = 0
 
     @property
     def coordinator_address(self) -> str:
@@ -52,6 +61,25 @@ class DistributedJob:
         # so the address must name ITS host — placements order is not assumed
         coord = next(p for p in self.placements if p.process_id == 0)
         return f"{coord.host}:{self.coordinator_port}"
+
+    @property
+    def resolved_megascale_port(self) -> int:
+        """Megascale transport port. NB: callers that build multislice jobs
+        must reserve this port with the host port scheduler exactly like
+        ``coordinator_port`` — the +1 default is a convention, not a
+        reservation."""
+        return self.megascale_port or self.coordinator_port + 1
+
+    @property
+    def megascale_address(self) -> str:
+        """libtpu's megascale rendezvous expects the coordinator on slice 0
+        worker 0 — anchored to slice 0's lowest process id, NOT to global
+        process 0 (which may live on another slice)."""
+        coord = min(
+            (p for p in self.placements if p.slice_id == 0),
+            key=lambda p: p.process_id,
+        )
+        return f"{coord.host}:{self.resolved_megascale_port}"
 
 
 def _process_bounds(n_processes: int) -> str:
@@ -63,12 +91,25 @@ def _process_bounds(n_processes: int) -> str:
 
 def render_distributed_env(job: DistributedJob, placement: ProcessPlacement) -> list[str]:
     """The JAX-side (DCN bootstrap) env for ONE process of the job; the
-    libtpu-side TPU_* vars come from runtime.spec.render_tpu_attachment."""
-    return [
+    libtpu-side TPU_* vars come from runtime.spec.render_tpu_attachment.
+
+    Multislice jobs (num_slices > 1) additionally get the MEGASCALE_* vars
+    libtpu's DCN transport reads — the stitching the reference's NCCL/MPI
+    jobs would have configured by hand (SURVEY.md §2.3, comm-backend row).
+    """
+    env = [
         f"JAX_COORDINATOR_ADDRESS={job.coordinator_address}",
         f"JAX_NUM_PROCESSES={len(job.placements)}",
         f"JAX_PROCESS_ID={placement.process_id}",
     ]
+    if job.num_slices > 1:
+        env += [
+            f"MEGASCALE_COORDINATOR_ADDRESS={job.megascale_address}",
+            f"MEGASCALE_NUM_SLICES={job.num_slices}",
+            f"MEGASCALE_SLICE_ID={placement.slice_id}",
+            f"MEGASCALE_PORT={job.resolved_megascale_port}",
+        ]
+    return env
 
 
 def render_job_specs(
@@ -90,9 +131,20 @@ def render_job_specs(
     """
     from tpu_docker_api.runtime.spec import PortBinding, render_tpu_attachment
 
-    peers = [f"{p.host}:{p.tpu_process_port}" for p in job.placements]
+    # the libtpu ICI mesh (TPU_PROCESS_ADDRESSES / bounds / task id) is
+    # per-SLICE: an ICI domain only spans one slice, and libtpu must not try
+    # to assemble a mesh across hosts it has no ICI path to. MEGASCALE_*
+    # (render_distributed_env) does the cross-slice stitching over DCN.
+    by_slice: dict[int, list[ProcessPlacement]] = {}
+    for p in job.placements:
+        by_slice.setdefault(p.slice_id, []).append(p)
+    for members in by_slice.values():
+        members.sort(key=lambda p: p.process_id)
+
     specs = []
     for p in job.placements:
+        slice_members = by_slice[p.slice_id]
+        peers = [f"{m.host}:{m.tpu_process_port}" for m in slice_members]
         spec = ContainerSpec(
             name=f"{job.name}-p{p.process_id}",
             image=image,
@@ -106,11 +158,16 @@ def render_job_specs(
             spec.port_bindings.append(
                 PortBinding(job.coordinator_port, job.coordinator_port)
             )
+        if (job.num_slices > 1
+                and p.slice_id == 0 and p is slice_members[0]):
+            ms_port = job.resolved_megascale_port
+            spec.port_bindings.append(PortBinding(ms_port, ms_port))
         render_tpu_attachment(
             spec, sorted(p.chip_ids), p.topology or topology,
             libtpu_path=libtpu_path,
-            process_bounds=job.process_bounds or _process_bounds(len(job.placements)),
-            task_id=p.process_id,
+            process_bounds=job.process_bounds
+            or _process_bounds(len(slice_members)),
+            task_id=slice_members.index(p),
             process_addresses=peers,
             process_port=p.tpu_process_port,
         )
