@@ -171,6 +171,61 @@ class TestJobService:
                 pod.hosts[info["processes"][0]["hostId"]].address)
         assert len(seen_hosts) == 4
 
+    def test_multislice_job(self, pod, svc, sched):
+        """numSlices=2 ⇒ two independent ICI slices stitched over DCN:
+        per-slice libtpu mesh env, MEGASCALE_* on every process, megascale
+        port published on slice 0's first container."""
+        info = svc.run_job(JobRun(image_name="i", job_name="ms",
+                                  chip_count=16, num_slices=2))
+        assert info["numSlices"] == 2
+        assert len(info["processes"]) == 4  # 2 slices x 2 hosts
+        ms_port = info["megascalePort"]
+        assert ms_port > 0
+        for proc in info["processes"]:
+            host = pod.hosts[proc["hostId"]]
+            ci = host.runtime.container_inspect(proc["container"])
+            env = dict(e.split("=", 1) for e in ci.spec.env)
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(proc["sliceId"])
+            assert env["MEGASCALE_PORT"] == str(ms_port)
+            # the libtpu ICI mesh is scoped to THIS slice (2 hosts), not
+            # the whole 4-process job
+            assert len(env["TPU_PROCESS_ADDRESSES"].split(",")) == 2
+            assert env["JAX_NUM_PROCESSES"] == "4"  # DCN level sees all
+        assert {p["sliceId"] for p in info["processes"]} == {0, 1}
+        # global process 0 carries coordinator + megascale port bindings
+        p0 = info["processes"][0]
+        ci0 = pod.hosts[p0["hostId"]].runtime.container_inspect(p0["container"])
+        bound = {pb.host_port for pb in ci0.spec.port_bindings}
+        assert info["coordinatorPort"] in bound and ms_port in bound
+
+    def test_multislice_rescale_and_delete_free_all_slices(self, pod, svc,
+                                                           sched):
+        svc.run_job(JobRun(image_name="i", job_name="ms", chip_count=8,
+                           num_slices=2))
+        free_after_run = sum(
+            len(h.chips.free_chips) for h in pod.hosts.values())
+        assert free_after_run == 32 - 8
+        # rescale keeps the slice count, doubles the chips
+        info = svc.patch_job_chips("ms-0", JobPatchChips(chip_count=16))
+        assert info["numSlices"] == 2
+        assert info["chipCount"] == 16
+        svc.delete_job("ms-1", JobDelete(force=True,
+                                         del_state_and_version_record=True))
+        assert sum(len(h.chips.free_chips) for h in pod.hosts.values()) == 32
+
+    def test_multislice_indivisible_chip_count_rejected(self, svc):
+        with pytest.raises(errors.BadRequest, match="divide"):
+            svc.run_job(JobRun(image_name="i", job_name="bad",
+                               chip_count=10, num_slices=3))
+
+    def test_multislice_accelerator_type_rejected(self, svc):
+        """acceleratorType sizes ONE slice; combined with numSlices > 1 it
+        would over-allocate the type per slice — rejected up front."""
+        with pytest.raises(errors.BadRequest, match="acceleratorType"):
+            svc.run_job(JobRun(image_name="i", job_name="bad",
+                               accelerator_type="v5p-8", num_slices=2))
+
     def test_process_bounds_match_host_block(self, pod, svc):
         info = svc.run_job(JobRun(image_name="i", job_name="j", chip_count=32))
         ci = pod.hosts[info["processes"][0]["hostId"]].runtime.container_inspect(

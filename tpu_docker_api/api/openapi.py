@@ -88,7 +88,10 @@ _SCHEMAS: dict[str, dict] = {
          "acceleratorType": {**_STR, "description":
                              "alternative ask, e.g. \"v5p-64\""},
          "binds": _arr({**_STR, "description": "\"src:dest\""}),
-         "env": _arr(_STR), "cmd": _arr(_STR)},
+         "env": _arr(_STR), "cmd": _arr(_STR),
+         "numSlices": {**_INT, "description":
+                       ">1 = multislice: chipCount splits into numSlices "
+                       "ICI slices stitched over DCN (MEGASCALE_* env)"}},
         ["imageName", "jobName"]),
     "JobPatchChips": _obj({"chipCount": _INT, "acceleratorType": _STR}),
     "JobDelete": _obj({"force": _BOOL, "delStateAndVersionRecord": _BOOL}),

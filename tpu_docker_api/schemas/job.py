@@ -24,6 +24,9 @@ class JobRun:
     binds: list[str] = dataclasses.field(default_factory=list)   # "src:dest"
     env: list[str] = dataclasses.field(default_factory=list)
     cmd: list[str] = dataclasses.field(default_factory=list)
+    # >1 ⇒ multislice: chipCount splits into numSlices separate ICI slices
+    # stitched over DCN with MEGASCALE_* env (workload/jaxenv.py)
+    num_slices: int = 1
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "JobRun":
@@ -35,6 +38,7 @@ class JobRun:
             binds=list(d.get("binds", [])),
             env=list(d.get("env", [])),
             cmd=list(d.get("cmd", [])),
+            num_slices=int(d.get("numSlices", 1)),
         )
 
 
@@ -79,8 +83,13 @@ class JobState:
     chip_count: int
     coordinator_port: int
     # [(host_id, container_name, process_id, [chip_ids], tpu_port), ...]
+    # ordered slice-major with equal process counts per slice, so
+    # slice_id(pid) = pid // (len(placements) // num_slices)
     placements: list[list[Any]]
     desired_running: bool = True
+    num_slices: int = 1
+    # megascale DCN port (multislice only), allocated on process 0's host
+    megascale_port: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -98,4 +107,6 @@ class JobState:
             coordinator_port=int(d.get("coordinator_port", 0)),
             placements=[list(p) for p in d.get("placements", [])],
             desired_running=bool(d.get("desired_running", True)),
+            num_slices=int(d.get("num_slices", 1)),
+            megascale_port=int(d.get("megascale_port", 0)),
         )

@@ -76,46 +76,101 @@ class JobService:
     def _resolve_latest(self, name: str) -> tuple[str, int, str]:
         return resolve_latest(self.versions, name)
 
+    def _slice_owner(self, vname: str, k: int, num_slices: int) -> str:
+        # single-slice owners stay the bare versioned name (back-compat with
+        # persisted scheduler state)
+        return vname if num_slices == 1 else f"{vname}#s{k}"
+
+    def _apply_slices(self, n_chips: int, num_slices: int,
+                      accelerator_type: str, vname: str
+                      ) -> list[SliceAllocation]:
+        """One ICI-slice grant per slice, all-or-nothing."""
+        if num_slices > 1 and accelerator_type:
+            # apply_slice overrides n_chips from the type, so the type would
+            # be granted PER SLICE while every size precheck assumes a total
+            # that splits — ambiguous; require the explicit chip count
+            raise errors.BadRequest(
+                "acceleratorType cannot combine with numSlices > 1; "
+                "use chipCount (total across slices)")
+        if n_chips % num_slices:
+            raise errors.BadRequest(
+                f"chipCount {n_chips} must divide by numSlices {num_slices}")
+        grants: list[SliceAllocation] = []
+        try:
+            for k in range(num_slices):
+                grants.append(self.slices.apply_slice(
+                    n_chips=n_chips // num_slices,
+                    accelerator_type=accelerator_type,
+                    owner=self._slice_owner(vname, k, num_slices),
+                ))
+        except Exception:
+            for k in range(len(grants)):
+                self.slices.restore_slice(self._slice_owner(vname, k, num_slices))
+            raise
+        return grants
+
+    def _restore_slices(self, vname: str, num_slices: int) -> None:
+        for k in range(num_slices):
+            self.slices.restore_slice(self._slice_owner(vname, k, num_slices))
+
     def _build_placements(
-        self, grant: SliceAllocation, owner: str
-    ) -> tuple[list[ProcessPlacement], int, dict[str, list[int]]]:
-        """Placements in slice process order + coordinator port + the host
+        self, grants: list[SliceAllocation], owner: str
+    ) -> tuple[list[ProcessPlacement], int, int, dict[str, list[int]]]:
+        """Placements over all slices (slice-major, global process ids) +
+        coordinator port + megascale port (0 unless multislice) + the host
         ports claimed per host (for rollback/free)."""
         claimed: dict[str, list[int]] = {}
         placements: list[ProcessPlacement] = []
+        multislice = len(grants) > 1
         try:
-            for pid, (host_id, chips) in enumerate(grant.hosts):
-                host = self.pod.hosts[host_id]
-                n_ports = 2 if pid == 0 else 1  # process 0 also publishes the coordinator
-                ports = host.ports.apply_ports(n_ports, owner=owner)
-                claimed[host_id] = ports
-                placements.append(ProcessPlacement(
-                    process_id=pid,
-                    host=host.address,
-                    chip_ids=chips,
-                    tpu_process_port=ports[0],
-                    topology=host.topology,
-                ))
-            coordinator_port = claimed[grant.hosts[0][0]][1]
+            pid = 0
+            for k, grant in enumerate(grants):
+                for host_id, chips in grant.hosts:
+                    host = self.pod.hosts[host_id]
+                    # process 0 also publishes the coordinator port (+ the
+                    # megascale DCN port when multislice)
+                    n_ports = (3 if multislice else 2) if pid == 0 else 1
+                    ports = host.ports.apply_ports(n_ports, owner=owner)
+                    claimed.setdefault(host_id, []).extend(ports)
+                    placements.append(ProcessPlacement(
+                        process_id=pid,
+                        host=host.address,
+                        chip_ids=chips,
+                        tpu_process_port=ports[0],
+                        topology=host.topology,
+                        slice_id=k,
+                    ))
+                    pid += 1
+            first_host_ports = claimed[grants[0].hosts[0][0]]
+            coordinator_port = first_host_ports[1]
+            megascale_port = first_host_ports[2] if multislice else 0
         except Exception:
             self._free_ports(claimed, owner)
             raise
-        return placements, coordinator_port, claimed
+        return placements, coordinator_port, megascale_port, claimed
 
     def _free_ports(self, claimed: dict[str, list[int]], owner: str) -> None:
         for host_id, ports in claimed.items():
             self.pod.hosts[host_id].ports.restore_ports(ports, owner=owner)
 
-    def _specs_for(self, job_versioned: str, grant: SliceAllocation,
+    def _specs_for(self, job_versioned: str, grants: list[SliceAllocation],
                    placements: list[ProcessPlacement], coordinator_port: int,
-                   req_image: str, req_cmd: list[str], req_env: list[str],
-                   req_binds: list[str]) -> list[ContainerSpec]:
+                   megascale_port: int, req_image: str, req_cmd: list[str],
+                   req_env: list[str], req_binds: list[str]
+                   ) -> list[ContainerSpec]:
+        grant = grants[0]
         gx, gy, gz = grant.host_block_shape
+        multislice = len(grants) > 1
         job = DistributedJob(
             name=job_versioned,
             placements=placements,
             coordinator_port=coordinator_port,
-            process_bounds=f"{gx},{gy},{gz}" if grant.multi_host else "1,1,1",
+            # multislice: leave bounds empty so the renderer computes the
+            # safe per-slice default (each slice is its own ICI mesh)
+            process_bounds="" if multislice else (
+                f"{gx},{gy},{gz}" if grant.multi_host else "1,1,1"),
+            num_slices=len(grants),
+            megascale_port=megascale_port,
         )
         specs = render_job_specs(
             job,
@@ -129,7 +184,13 @@ class JobService:
             spec.binds = list(req_binds) + spec.binds
         return specs
 
-    def _create_and_start(self, grant: SliceAllocation,
+    @staticmethod
+    def _host_order(grants: list[SliceAllocation]) -> list[tuple[str, list[int]]]:
+        """(host_id, chips) in global process order — slice-major, the one
+        ordering convention placements, specs, and state all share."""
+        return [(host_id, chips) for g in grants for host_id, chips in g.hosts]
+
+    def _create_and_start(self, grants: list[SliceAllocation],
                           specs: list[ContainerSpec],
                           start_now: bool = True) -> None:
         """Create every process container, then (optionally) start all
@@ -139,7 +200,7 @@ class JobService:
         quiesces."""
         created: list[tuple[str, str]] = []  # (host_id, container name)
         try:
-            for (host_id, _), spec in zip(grant.hosts, specs):
+            for (host_id, _), spec in zip(self._host_order(grants), specs):
                 self.pod.hosts[host_id].runtime.container_create(spec)
                 created.append((host_id, spec.name))
             if start_now:
@@ -155,46 +216,47 @@ class JobService:
 
     def _run_version(self, base: str, image: str, cmd: list[str], env: list[str],
                      binds: list[str], n_chips: int,
-                     accelerator_type: str = "", start_now: bool = True) -> JobState:
+                     accelerator_type: str = "", start_now: bool = True,
+                     num_slices: int = 1) -> JobState:
         """Slice alloc → version bump → ports → render → create[+start] →
         persist, with full rollback (the job-level _run_new_version)."""
         prev = self.versions.get(base)
         version = self.versions.next_version(base)
         job_versioned = versioned_name(base, version)
         try:
-            grant = self.slices.apply_slice(
-                n_chips=n_chips, accelerator_type=accelerator_type,
-                owner=job_versioned,
-            )
+            grants = self._apply_slices(
+                n_chips, num_slices, accelerator_type, job_versioned)
             try:
-                placements, coordinator_port, claimed = self._build_placements(
-                    grant, job_versioned
-                )
+                placements, coordinator_port, megascale_port, claimed = (
+                    self._build_placements(grants, job_versioned))
                 try:
                     specs = self._specs_for(
-                        job_versioned, grant, placements, coordinator_port,
-                        image, cmd, env, binds,
+                        job_versioned, grants, placements, coordinator_port,
+                        megascale_port, image, cmd, env, binds,
                     )
-                    self._create_and_start(grant, specs, start_now=start_now)
+                    self._create_and_start(grants, specs, start_now=start_now)
                 except Exception:
                     self._free_ports(claimed, job_versioned)
                     raise
             except Exception:
-                self.slices.restore_slice(job_versioned)
+                self._restore_slices(job_versioned, num_slices)
                 raise
         except Exception:
             self.versions.rollback(base, prev)
             raise
+        host_order = self._host_order(grants)
         st = JobState(
             job_name=job_versioned,
             version=version,
             image=image, cmd=list(cmd), env=list(env), binds=list(binds),
-            chip_count=grant.n_chips,
+            chip_count=sum(g.n_chips for g in grants),
             coordinator_port=coordinator_port,
             placements=[
                 [host_id, spec.name, pid, list(chips), placements[pid].tpu_process_port]
-                for pid, ((host_id, chips), spec) in enumerate(zip(grant.hosts, specs))
+                for pid, ((host_id, chips), spec) in enumerate(zip(host_order, specs))
             ],
+            num_slices=num_slices,
+            megascale_port=megascale_port,
         )
         self.store.put_job(st)
         return st
@@ -211,15 +273,19 @@ class JobService:
             raise errors.BadRequest("imageName required")
         if req.chip_count <= 0 and not req.accelerator_type:
             raise errors.BadRequest("chipCount or acceleratorType required")
+        if req.num_slices < 1:
+            raise errors.BadRequest("numSlices must be >= 1")
         with self._locks.hold(base):
             if self.versions.contains(base):
                 raise errors.ContainerExisted(f"job {base}")
             st = self._run_version(
                 base, req.image_name, req.cmd, req.env, req.binds,
                 req.chip_count, req.accelerator_type,
+                num_slices=req.num_slices,
             )
-            log.info("run job %s: %d chips over %d hosts", st.job_name,
-                     st.chip_count, len(st.placements))
+            log.info("run job %s: %d chips over %d hosts (%d slices)",
+                     st.job_name, st.chip_count, len(st.placements),
+                     st.num_slices)
             return self._info_dict(st)
 
     def patch_job_chips(self, name: str, req: JobPatchChips) -> dict:
@@ -267,7 +333,7 @@ class JobService:
                 ))
 
             def _free_old() -> None:
-                self.slices.restore_slice(old.job_name)
+                self._restore_slices(old.job_name, old.num_slices)
                 self._free_state_ports(old)
 
             def _resume_old() -> None:
@@ -282,6 +348,7 @@ class JobService:
                 st = self._run_version(
                     base, old.image, old.cmd, old.env, old.binds,
                     want, req.accelerator_type, start_now=False,
+                    num_slices=old.num_slices,
                 )
                 try:
                     _quiesce_old()
@@ -303,12 +370,14 @@ class JobService:
                     st = self._run_version(
                         base, old.image, old.cmd, old.env, old.binds,
                         want, req.accelerator_type,
+                        num_slices=old.num_slices,
                     )
                 except Exception:
                     log.exception("rescale of %s failed; re-launching old shape",
                                   base)
                     self._run_version(base, old.image, old.cmd, old.env,
-                                      old.binds, old.chip_count)
+                                      old.binds, old.chip_count,
+                                      num_slices=old.num_slices)
                     raise
             log.info("rescaled job %s: %d → %d chips (%s)", base,
                      old.chip_count, st.chip_count, st.job_name)
@@ -355,7 +424,7 @@ class JobService:
                         host.runtime.container_remove(cname, force=req.force)
                     except errors.ContainerNotExist:
                         pass
-                self.slices.restore_slice(vname)
+                self._restore_slices(vname, st.num_slices)
                 self._free_state_ports(st)
             if req.del_state_and_version_record:
                 self.store.delete_family(Resource.JOBS, base)
@@ -402,7 +471,7 @@ class JobService:
                 host.runtime.container_remove(cname, force=True)
             except errors.ContainerNotExist:
                 pass
-        self.slices.restore_slice(st.job_name)
+        self._restore_slices(st.job_name, st.num_slices)
         self._free_state_ports(st)
         self.store.delete_version(Resource.JOBS, st.job_name)
         self.versions.rollback(base, rollback_to)
@@ -425,9 +494,12 @@ class JobService:
             ports = [tpu_port]
             if pid == 0:
                 ports.append(st.coordinator_port)
+                if st.megascale_port:
+                    ports.append(st.megascale_port)
             host.ports.restore_ports(ports, owner=st.job_name)
 
     def _info_dict(self, st: JobState, live: bool = False) -> dict:
+        per_slice = max(len(st.placements) // st.num_slices, 1)
         out = {
             "name": st.job_name,
             "version": st.version,
@@ -435,6 +507,7 @@ class JobService:
             "chipCount": st.chip_count,
             "coordinatorPort": st.coordinator_port,
             "desiredRunning": st.desired_running,
+            "numSlices": st.num_slices,
             "processes": [
                 {
                     "processId": pid,
@@ -442,10 +515,13 @@ class JobService:
                     "container": cname,
                     "chipIds": list(chips),
                     "tpuPort": tpu_port,
+                    "sliceId": pid // per_slice,
                 }
                 for host_id, cname, pid, chips, tpu_port in st.placements
             ],
         }
+        if st.megascale_port:
+            out["megascalePort"] = st.megascale_port
         if live:
             for proc in out["processes"]:
                 host = self.pod.hosts.get(proc["hostId"])
