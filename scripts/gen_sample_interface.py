@@ -8,8 +8,11 @@ transcripts (api/gpu-docker-api-sample-interface.md), but reproducible:
 from __future__ import annotations
 
 import json
+import pathlib
 import sys
 import urllib.request
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from tpu_docker_api.config import Config
 from tpu_docker_api.daemon import Program
@@ -132,6 +135,15 @@ def main() -> None:
     call("GET", "/api/v1/jobs/train-0", None,
          "Historical version: stopped but inspectable (rollback material).")
     call("DELETE", "/api/v1/jobs/train",
+         {"force": True, "delStateAndVersionRecord": True})
+    call("POST", "/api/v1/jobs",
+         {"imageName": "maxtext:tpu", "jobName": "multi", "chipCount": 8,
+          "numSlices": 2},
+         "Multislice: two independent ICI slices stitched over DCN — each "
+         "slice gets its own libtpu mesh (`TPU_PROCESS_ADDRESSES` scoped "
+         "per slice), every process gets `MEGASCALE_*` env, and the "
+         "megascale port publishes on slice 0's first container.")
+    call("DELETE", "/api/v1/jobs/multi",
          {"force": True, "delStateAndVersionRecord": True})
     emit("## Resources & observability")
     emit()
