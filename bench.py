@@ -23,7 +23,7 @@ import time
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--preset", default="bench-350m")
+    parser.add_argument("--preset", default="llama3-1b")
     parser.add_argument("--batch", type=int, default=0, help="0 = auto")
     parser.add_argument("--seq", type=int, default=0, help="0 = preset default")
     parser.add_argument("--steps", type=int, default=8)
@@ -51,7 +51,10 @@ def main() -> None:
     devices = jax.devices()[:1]  # tokens/sec **per chip**: bench on one
     platform = devices[0].platform
     on_tpu = platform == "tpu"
-    if not on_tpu and preset == "bench-350m":
+    # measured-optimal single-v5e batch per TPU preset (params + adam state
+    # + activations must fit 16GB HBM; larger batches don't raise MFU)
+    tpu_preset_batch = {"llama3-1b": 2, "bench-350m": 8}
+    if not on_tpu and preset in tpu_preset_batch:
         preset = "tiny"  # CPU fallback so the bench runs without hardware
 
     cfg = llama_presets()[preset]
@@ -60,7 +63,7 @@ def main() -> None:
         seq = args.seq
     else:
         seq = min(cfg.max_seq_len, 2048)
-    batch = args.batch or (8 if on_tpu else 2)
+    batch = args.batch or (tpu_preset_batch.get(preset, 8) if on_tpu else 2)
 
     mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1), devices=devices)
     state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
