@@ -151,8 +151,23 @@ def check_inference() -> bool:
     ok = out["tokens"].shape == (8, 64)
     # one generate() = prefill(8x512) + 64 decode steps; report it as such
     # rather than a pure decode rate
-    return _emit("inference_generate", ok,
-                 new_tok_s_incl_prefill=round(8 * 64 / dt))
+    ok &= _emit("inference_generate", ok,
+                new_tok_s_incl_prefill=round(8 * 64 / dt))
+
+    # int8 weight-quantized serving (infer/quantize.py)
+    from tpu_docker_api.infer.quantize import quantize_llama_params
+
+    qparams = quantize_llama_params(params)
+    qout = fn(qparams, prompt, jax.random.PRNGKey(2))
+    int(qout["tokens"][0, 0])
+    t0 = time.perf_counter()
+    qout = fn(qparams, prompt, jax.random.PRNGKey(3))
+    int(qout["tokens"][0, 0])
+    qdt = time.perf_counter() - t0
+    return ok & _emit(
+        "inference_generate_int8", qout["tokens"].shape == (8, 64),
+        new_tok_s_incl_prefill=round(8 * 64 / qdt),
+        speedup_vs_bf16=round(dt / qdt, 2))
 
 
 def main() -> int:

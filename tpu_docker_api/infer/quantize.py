@@ -1,0 +1,55 @@
+"""Quantize a Llama param tree for int8 serving.
+
+``quantize_llama_params`` rewrites every projection weight — attention
+q/k/v/o, MLP gate/up/down (stacked per-layer, quantized along their in
+axis with per-(layer, out-channel) scales) and the lm_head — into
+``ops.quant.QuantizedLinear`` leaves. Embedding and norm vectors stay in
+the float dtype: the embedding is a gather (no matmul to accelerate) and
+norm scales are tiny.
+
+The model code needs no inference variant: every projection already routes
+through ``ops.quant.linear``, which dispatches on the leaf type, and
+``QuantizedLinear`` is a pytree so ``lax.scan`` slices the stacked int8
+weights and their scales together. Use:
+
+    params = llama_init(cfg, key)            # or checkpoint restore
+    qparams = quantize_llama_params(params)
+    fn = make_generate_fn(cfg, gen, mesh)
+    out = fn(qparams, prompt, key)           # int8 MXU decode
+"""
+
+from __future__ import annotations
+
+from tpu_docker_api.ops.quant import QuantizedLinear, quantize_weight
+
+
+def quantize_llama_params(params: dict) -> dict:
+    """New param tree with projection weights as QuantizedLinear leaves."""
+    layers = params["layers"]
+    return {
+        "embed": params["embed"],
+        "layers": {
+            "attn_norm": layers["attn_norm"],
+            "mlp_norm": layers["mlp_norm"],
+            "attn": {k: quantize_weight(w)
+                     for k, w in layers["attn"].items()},
+            "mlp": {k: quantize_weight(w)
+                    for k, w in layers["mlp"].items()},
+        },
+        "final_norm": params["final_norm"],
+        "lm_head": quantize_weight(params["lm_head"]),
+    }
+
+
+def quantized_bytes(params: dict) -> int:
+    """Serving-weight footprint in bytes (int8 + f32 scales + float rest)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedLinear)):
+        if isinstance(leaf, QuantizedLinear):
+            total += leaf.w_int8.size + leaf.scale.size * 4
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_docker_api.ops.attention import dense_attention, multihead_attention
 from tpu_docker_api.ops.norms import rms_norm
+from tpu_docker_api.ops.quant import linear
 from tpu_docker_api.ops.rope import apply_rope, rope_frequencies
 from tpu_docker_api.parallel.sharding import constrain
 
@@ -144,9 +145,9 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     (out, (k_all, v_all)) instead of out."""
     b, s, d = x.shape
     hd = cfg.head_dim
-    q = (x @ layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
-    k = (x @ layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-    v = (x @ layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    q = linear(x, layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = linear(x, layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+    v = linear(x, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     if cache is not None:
         k_all, v_all, layer_idx = cache
         positions = jnp.broadcast_to(
@@ -167,8 +168,8 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
                                            keepdims=False)
         out = dense_attention(q, k_cache, v_cache, causal=True,
                               q_offset=start_pos)
-        return out.reshape(b, s, cfg.n_heads * hd) @ layer["attn"]["wo"], (
-            k_all, v_all)
+        return linear(out.reshape(b, s, cfg.n_heads * hd),
+                      layer["attn"]["wo"]), (k_all, v_all)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
     if cfg.attention_impl == "ring":
@@ -181,13 +182,13 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
         out = ulysses_attention(q, k, v, mesh, causal=True)
     else:
         out = multihead_attention(q, k, v, causal=True, impl=cfg.attention_impl)
-    return out.reshape(b, s, cfg.n_heads * hd) @ layer["attn"]["wo"]
+    return linear(out.reshape(b, s, cfg.n_heads * hd), layer["attn"]["wo"])
 
 
 def _mlp(x, layer):
-    gate = jax.nn.silu(x @ layer["mlp"]["w_gate"])
-    up = x @ layer["mlp"]["w_up"]
-    return (gate * up) @ layer["mlp"]["w_down"]
+    gate = jax.nn.silu(linear(x, layer["mlp"]["w_gate"]))
+    up = linear(x, layer["mlp"]["w_up"])
+    return linear(gate * up, layer["mlp"]["w_down"])
 
 
 def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
@@ -313,11 +314,8 @@ def lm_head(params: dict, h: jnp.ndarray, cfg: LlamaConfig) -> jnp.ndarray:
     would run the largest matmul in the model at the f32 rate (~4x slower
     on v5e) for no extra mantissa in the inputs."""
     h = rms_norm(h, params["final_norm"], cfg.norm_eps)
-    return jax.lax.dot_general(
-        h.astype(cfg.dtype), params["lm_head"],
-        (((h.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    return linear(h.astype(cfg.dtype), params["lm_head"],
+                  out_dtype=jnp.float32)
 
 
 def llama_loss(

@@ -1,0 +1,102 @@
+"""Int8 quantization for inference serving.
+
+TPU-first rationale: decode is weight-HBM-bound (every step reads every
+weight once), and the v5e MXU runs int8×int8 at ~2× the bf16 rate with
+int32 accumulation. Weight-only storage halves the per-token weight
+traffic; quantizing activations dynamically per row lets the dot itself run
+in int8 — the AQT recipe, reduced to its serving-time core:
+
+    w_int8[i, o] = round(w[i, o] / s_w[o]),  s_w[o] = absmax_i |w| / 127
+    x_int8[r, i] = round(x[r, i] / s_x[r]),  s_x[r] = absmax_i |x| / 127
+    y[r, o]      = (x_int8 · w_int8)[int32] · s_x[r] · s_w[o]
+
+Per-output-channel weight scales and per-row activation scales keep the
+quantization error at the ~1% level that weight-only serving tolerates.
+
+Training never touches this module: ``linear`` passes raw arrays straight
+to ``@``, and only ``quantize_params`` (infer-time, explicit) rewrites a
+param tree's projection weights into ``QuantizedLinear`` leaves. Stacked
+per-layer weights quantize along their leading layer dim, and because
+``QuantizedLinear`` is a registered pytree, ``lax.scan`` slices the int8
+tensor and its scales together.
+
+The reference has no quantization (or any compute) in-tree; this is part of
+the serving stack the TPU build provides (SURVEY.md §0, §2.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class QuantizedLinear:
+    """An (…, in, out) weight stored int8 with per-out-channel f32 scales."""
+
+    w_int8: jnp.ndarray  # (…, in, out) int8
+    scale: jnp.ndarray   # (…, out) f32
+
+    @property
+    def shape(self):
+        return self.w_int8.shape
+
+    @property
+    def size(self):
+        return self.w_int8.size
+
+
+jax.tree_util.register_pytree_node(
+    QuantizedLinear,
+    lambda q: ((q.w_int8, q.scale), None),
+    lambda _, kids: QuantizedLinear(*kids),
+)
+
+_EPS = 1e-12
+
+
+def quantize_weight(w: jnp.ndarray) -> QuantizedLinear:
+    """Quantize an (…, in, out) weight along its in axis (axis -2)."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2), _EPS) / 127.0
+    w_int8 = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127)
+    return QuantizedLinear(w_int8.astype(jnp.int8), scale)
+
+
+def dequantize_weight(q: QuantizedLinear, dtype=jnp.float32) -> jnp.ndarray:
+    return (q.w_int8.astype(jnp.float32) * q.scale[..., None, :]).astype(dtype)
+
+
+def int8_linear(x: jnp.ndarray, q: QuantizedLinear,
+                out_dtype=None) -> jnp.ndarray:
+    """y = x @ dequant(q) computed as an int8×int8 MXU dot with dynamic
+    per-row activation quantization. x: (…, in); q: (in, out)."""
+    xf = x.astype(jnp.float32)
+    x_scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                          _EPS) / 127.0
+    x_int8 = jnp.clip(jnp.round(xf / x_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        x_int8, q.w_int8,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = acc.astype(jnp.float32) * x_scale * q.scale
+    return y.astype(out_dtype or x.dtype)
+
+
+def linear(x: jnp.ndarray, w, out_dtype=None) -> jnp.ndarray:
+    """The one projection entry point: raw arrays take the plain matmul
+    path (training — unchanged numerics), QuantizedLinear takes the int8
+    path (serving). ``out_dtype`` asks for widened ACCUMULATION, not a
+    cast — the raw path runs the dot with that preferred_element_type
+    (the lm_head's bf16-operands/f32-out contract)."""
+    if isinstance(w, QuantizedLinear):
+        return int8_linear(x, w, out_dtype=out_dtype)
+    if out_dtype is not None:
+        return jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=out_dtype,
+        )
+    return x @ w

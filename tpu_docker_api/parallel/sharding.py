@@ -53,13 +53,25 @@ def spec_for(path: str, rules: list[tuple[str, P]] | None = None) -> P:
 
 
 def param_specs(params: dict, rules: list[tuple[str, P]] | None = None):
-    """Pytree of PartitionSpec matching ``params``' structure."""
+    """Pytree of PartitionSpec matching ``params``' structure. A
+    ``QuantizedLinear`` leaf (int8 serving, ops/quant.py) expands into specs
+    for both its children: the int8 weight takes the rule's spec, the
+    per-out-channel scales take the spec minus the contracted (in) axis."""
+    from tpu_docker_api.ops.quant import QuantizedLinear
+
+    def leaf_spec(path: str, v):
+        spec = spec_for(path, rules)
+        if isinstance(v, QuantizedLinear):
+            # scale shape = weight shape without axis -2
+            scale_spec = P(*spec[:-2], spec[-1]) if len(spec) >= 2 else P()
+            return QuantizedLinear(w_int8=spec, scale=scale_spec)
+        return spec
 
     def walk(subtree: dict, prefix: str):
         out = {}
         for k, v in subtree.items():
             path = f"{prefix}/{k}" if prefix else k
-            out[k] = walk(v, path) if isinstance(v, dict) else spec_for(path, rules)
+            out[k] = walk(v, path) if isinstance(v, dict) else leaf_spec(path, v)
         return out
 
     return walk(params, "")
