@@ -1,0 +1,116 @@
+"""Speculative decoding: the greedy-exactness contract.
+
+The whole point of exact verification is that the output equals
+target-only greedy decoding REGARDLESS of the draft — a perfect draft
+(the target itself) accepts everything, a garbage draft accepts ~nothing,
+and both must emit identical text. These tests pin that invariant.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
+from tpu_docker_api.infer.speculative import (
+    SpeculativeConfig,
+    make_speculative_generate_fn,
+)
+from tpu_docker_api.models.llama import llama_init, llama_presets
+
+
+@pytest.fixture(scope="module")
+def models():
+    cfg = llama_presets()["tiny"]
+    target = llama_init(cfg, jax.random.PRNGKey(0))
+    draft = llama_init(cfg, jax.random.PRNGKey(7))  # different weights
+    return cfg, target, draft
+
+
+def _greedy_reference(cfg, params, prompt, n):
+    fn = make_generate_fn(
+        cfg, GenerateConfig(max_new_tokens=n, temperature=0.0, max_seq=128))
+    return np.asarray(fn(params, prompt, jax.random.PRNGKey(0))["tokens"])
+
+
+@pytest.fixture(scope="module")
+def prompt(models):
+    cfg = models[0]
+    return jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+
+
+class TestSpeculative:
+    def test_perfect_draft_accepts_everything(self, models, prompt):
+        """draft == target: every proposal accepted, output == greedy, and
+        the round count shows k+1 tokens per round."""
+        cfg, target, _ = models
+        k, n = 4, 20
+        fn = make_speculative_generate_fn(
+            cfg, cfg, SpeculativeConfig(max_new_tokens=n, n_speculative=k,
+                                        max_seq=128))
+        out = fn(target, target, prompt)
+        ref = _greedy_reference(cfg, target, prompt, n)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), ref)
+        rounds = int(out["rounds"])
+        assert rounds <= -(-(n - 1) // (k + 1)) + 1, rounds
+        # all proposals accepted in every full round
+        assert int(out["accepted"]) >= (rounds - 1) * k
+
+    def test_mismatched_draft_still_exact(self, models, prompt):
+        """A draft with different random weights: acceptance may be near
+        zero, the emitted text must not change."""
+        cfg, target, draft = models
+        n = 20
+        fn = make_speculative_generate_fn(
+            cfg, cfg, SpeculativeConfig(max_new_tokens=n, n_speculative=3,
+                                        max_seq=128))
+        out = fn(target, draft, prompt)
+        ref = _greedy_reference(cfg, target, prompt, n)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), ref)
+
+    def test_draft_architecture_may_differ(self, models, prompt):
+        """The draft can be a structurally different (smaller) model."""
+        cfg, target, _ = models
+        small_cfg = dataclasses.replace(cfg, n_layers=1)
+        small = llama_init(small_cfg, jax.random.PRNGKey(9))
+        n = 12
+        fn = make_speculative_generate_fn(
+            cfg, small_cfg,
+            SpeculativeConfig(max_new_tokens=n, n_speculative=2, max_seq=128))
+        out = fn(target, small, prompt)
+        ref = _greedy_reference(cfg, target, prompt, n)
+        np.testing.assert_array_equal(np.asarray(out["tokens"]), ref)
+
+    @pytest.mark.parametrize("k", [1, 5])
+    def test_k_extremes_exact(self, models, prompt, k):
+        cfg, target, draft = models
+        n = 11
+        fn = make_speculative_generate_fn(
+            cfg, cfg, SpeculativeConfig(max_new_tokens=n, n_speculative=k,
+                                        max_seq=128))
+        out = fn(target, draft, prompt)
+        np.testing.assert_array_equal(
+            np.asarray(out["tokens"]),
+            _greedy_reference(cfg, target, prompt, n))
+
+    def test_budget_one(self, models, prompt):
+        cfg, target, draft = models
+        fn = make_speculative_generate_fn(
+            cfg, cfg, SpeculativeConfig(max_new_tokens=1, n_speculative=4,
+                                        max_seq=128))
+        out = fn(target, draft, prompt)
+        assert out["tokens"].shape == (1, 1)
+        np.testing.assert_array_equal(
+            np.asarray(out["tokens"]),
+            _greedy_reference(cfg, target, prompt, 1))
+
+    def test_capacity_guard(self, models, prompt):
+        cfg, target, draft = models
+        fn = make_speculative_generate_fn(
+            cfg, cfg, SpeculativeConfig(max_new_tokens=200, n_speculative=4,
+                                        max_seq=128))
+        with pytest.raises(ValueError, match="capacity"):
+            fn(target, draft, prompt)

@@ -1,0 +1,170 @@
+"""Speculative decoding: a cheap draft model proposes, the target verifies.
+
+Greedy (temperature-0) speculative decoding with exact verification: per
+round the draft autoregressively proposes ``k`` tokens (cheap small-model
+decode steps), then the target runs ONE cached forward over all proposals
+at once — a (k+1)-token step whose weight reads amortize over up to k+1
+emitted tokens. Tokens are accepted while the target's own argmax agrees
+with the proposal; the first disagreement is replaced by the target's
+choice (or, when all k agree, the target's bonus token is emitted), so the
+output is **bit-identical to target-only greedy decoding** no matter how
+bad the draft is — the draft changes speed, never text. That property is
+the test contract (tests/test_speculative.py).
+
+TPU-first mechanics:
+
+- the whole generation is ONE jitted program: a ``lax.while_loop`` over
+  speculation rounds; every shape inside is static (k proposals per round,
+  fixed output buffer), only positions are traced scalars.
+- rollback is free: rejected tokens leave stale KV entries past the
+  accepted position, but attention masks every slot beyond the current
+  ``q_offset`` (ops/attention.py), and the next round's block writes start
+  at the rewound position, overwriting the stale range before it can ever
+  become visible.
+- both models ride ``llama_forward_cached`` unchanged — there is no
+  separate speculative model code.
+
+Scope: batch 1 (per-row accept lengths diverge; speculative decoding is a
+small-batch latency tool — large-batch serving wants plain decode) and a
+fixed token budget (no eos short-circuit). The reference has no serving
+stack at all (SURVEY.md §0); this joins int8 quantization in the TPU
+build's inference tier.
+
+Numerics caveat: "bit-identical" assumes the target's logits are
+deterministic across shapes. On TPU in bf16, a 1-token decode step and a
+(k+1)-token verify block fuse differently, so near-argmax ties can
+resolve differently — with RANDOM-init weights (near-uniform logits, the
+worst case) a few percent of steps flip; trained models with real logit
+gaps flip rarely. The CPU test suite pins the exactness contract under
+deterministic f32 accumulation (tests/conftest.py sets
+jax_default_matmul_precision).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_docker_api.infer.engine import init_kv_cache, prefill_and_first_token
+from tpu_docker_api.models.llama import LlamaConfig, llama_forward_cached
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    max_new_tokens: int = 64
+    n_speculative: int = 4        # draft proposals per round (k)
+    max_seq: int | None = None    # cache capacity (both models)
+    pad_id: int = 0
+
+
+def make_speculative_generate_fn(
+    target_cfg: LlamaConfig,
+    draft_cfg: LlamaConfig,
+    spec: SpeculativeConfig,
+) -> Callable:
+    """Build ``(target_params, draft_params, prompt (1, s)) → dict`` with
+    {"tokens": (1, max_new_tokens), "rounds": rounds run, "accepted":
+    total proposals accepted}. Greedy only — exact argmax verification;
+    stochastic rejection sampling is a different scheme."""
+    k = spec.n_speculative
+    if k < 1:
+        raise ValueError(f"n_speculative must be >= 1, got {k}")
+    budget = spec.max_new_tokens
+    if budget < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {budget}")
+
+    @jax.jit
+    def generate(target_params: dict, draft_params: dict,
+                 prompt: jnp.ndarray) -> dict:
+        b, prompt_len = prompt.shape
+        if b != 1:
+            raise ValueError("speculative decoding runs batch 1")
+        max_seq = spec.max_seq or min(target_cfg.max_seq_len,
+                                      draft_cfg.max_seq_len)
+        # worst-case cache high-water mark: a fully-accepted round ends with
+        # the verify block's last slot at prompt_len + budget + k
+        if prompt_len + budget + k > max_seq:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({budget}) + "
+                f"n_speculative ({k}) exceeds cache capacity {max_seq}")
+
+        # prefill both (the serving prefill primitive); the target's greedy
+        # first token is emitted token 0
+        t_tok, t_cache = prefill_and_first_token(
+            target_params, prompt, target_cfg,
+            init_kv_cache(target_cfg, 1, max_seq))
+        _, d_cache = prefill_and_first_token(
+            draft_params, prompt, draft_cfg,
+            init_kv_cache(draft_cfg, 1, max_seq))
+        tk, tv, dk, dv = t_cache.k, t_cache.v, d_cache.k, d_cache.v
+        first_tok = t_tok[0]
+
+        out = jnp.full((budget,), spec.pad_id, jnp.int32)
+        out = out.at[0].set(first_tok)
+        steps = jnp.arange(k + 1)
+
+        def cond(c):
+            return c[0] < budget
+
+        def body(c):
+            n_out, last, t_pos, d_pos, tk, tv, dk, dv, out, rounds, acc = c
+
+            # ---- draft: k+1 cached single-token steps starting from
+            # ``last``. k+1 (not k) so every proposal lands in the draft
+            # cache too; the final output is discarded.
+            def draft_step(carry, _):
+                tok, pos, dk, dv = carry
+                logits, dk, dv = llama_forward_cached(
+                    draft_params, tok[None, None], draft_cfg, dk, dv,
+                    pos, None)
+                nxt = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+                return (nxt, pos + 1, dk, dv), nxt
+
+            (_, d_end, dk, dv), drafted = lax.scan(
+                draft_step, (last, d_pos, dk, dv), None, length=k + 1)
+            proposals = drafted[:k]
+
+            # ---- target verifies all k proposals in one (k+1)-token
+            # step: row = [last, p_0 .. p_{k-1}]; position i's argmax is
+            # the target's choice AFTER seeing proposals 0..i-1
+            block = jnp.concatenate([last[None], proposals])[None]
+            t_logits, tk, tv = llama_forward_cached(
+                target_params, block, target_cfg, tk, tv, t_pos, None)
+            choices = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)
+
+            # accept while the target agrees; position n_acc emits the
+            # target's correction (== bonus token when everything agreed)
+            agree = jnp.cumprod((proposals == choices[:k]).astype(jnp.int32))
+            n_acc = jnp.sum(agree)                     # 0..k accepted
+            emitted = jnp.where(steps < n_acc, jnp.append(proposals, 0), 0)
+            emitted = jnp.where(steps == n_acc, choices, emitted)
+            n_new = jnp.minimum(n_acc + 1, budget - n_out)
+
+            # kept slots are in-range and unique; rejected ones scatter to
+            # index `budget`, which mode='drop' discards (a clip would make
+            # duplicates race a stale read-back at the last slot)
+            idx = jnp.where(steps < n_new, n_out + steps, budget)
+            out = out.at[idx].set(emitted, mode="drop")
+
+            last = emitted[n_new - 1]
+            # positions advance by what the caches verifiably hold: target
+            # cache gained [last, p_0..p_{n_acc-1}] as history (stale slots
+            # above are overwritten next round before becoming visible);
+            # draft cache identically (it wrote all k+1 inputs)
+            t_pos = t_pos + n_acc + 1
+            d_pos = d_end - (k - n_acc)
+            return (n_out + n_new, last, t_pos, d_pos, tk, tv, dk, dv, out,
+                    rounds + 1, acc + n_acc)
+
+        init = (jnp.int32(1), first_tok, jnp.int32(prompt_len),
+                jnp.int32(prompt_len), tk, tv, dk, dv, out,
+                jnp.int32(0), jnp.int32(0))
+        n_out, _, _, _, _, _, _, _, out, rounds, acc = lax.while_loop(
+            cond, body, init)
+        return {"tokens": out[None], "rounds": rounds, "accepted": acc}
+
+    return generate
