@@ -129,7 +129,8 @@ class TestConfig3V5e4Inference:
         svc, pod = _job_stack(grid=(1, 1, 1), acc="v5e-8")
         info = svc.run_job(JobRun(
             image_name="llama-serve:tpu", job_name="serve", chip_count=4,
-            cmd=["python", "-m", "serve", "--model", "llama3-8b"]))
+            cmd=["python", "-m", "tpu_docker_api.serve",
+                 "--preset", "llama3-8b", "--tp", "4"]))
         assert len(info["processes"]) == 1
         proc = info["processes"][0]
         assert len(proc["chipIds"]) == 4

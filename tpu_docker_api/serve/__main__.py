@@ -1,0 +1,206 @@
+"""Inference-serving entrypoint — what an inference container image runs
+(BASELINE.json config #3: a v5e-4 slice provisioned through the control
+plane serving Llama).
+
+    python -m tpu_docker_api.serve --preset llama3-1b --ckpt-dir /ckpt \
+        --port 8000 [--quantize] [--tp 4]
+
+HTTP surface (stdlib server, same envelope as the control plane):
+
+    GET  /healthz               → {"status": "ok", "model": ..., ...}
+    POST /generate              → {"tokens": [[...]], "lengths": [...]}
+        body: {"tokens": [[...prompt ids...]] ,
+               "maxNewTokens": 64, "temperature": 0.8,
+               "topK": 0, "topP": 1.0}
+
+Design notes, TPU-first:
+
+- one compiled generate program per (batch, prompt_len, maxNewTokens,
+  sampler) shape bucket — jax caches compilations, so repeated traffic at
+  the same shape pays zero retrace; prompts in a batch are dense (callers
+  left-pad, engine.make_generate_fn docstring).
+- sharded serving: ``--dp/--fsdp/--tp`` build the same mesh/rules the
+  trainer uses; params restore (orbax) directly into their shards.
+- ``--quantize`` rewrites projections to int8 at load
+  (infer/quantize.py) — decode is weight-bandwidth-bound.
+- the distributed bootstrap mirrors the trainer: JAX_NUM_PROCESSES > 1 ⇒
+  jax.distributed.initialize from the control plane's rendered env.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="python -m tpu_docker_api.serve")
+    p.add_argument("--preset", default="llama3-1b")
+    p.add_argument("--ckpt-dir", default="",
+                   help="orbax checkpoint to restore; '' serves random init "
+                        "(smoke/bench)")
+    p.add_argument("--quantize", action="store_true",
+                   help="int8 weight quantization at load")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-seq", type=int, default=0, help="0 = model default")
+    p.add_argument("--dp", type=int, default=-1,
+                   help="-1 = fill with remaining devices (trainer default)")
+    p.add_argument("--fsdp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--platform", default="",
+                   help="force a jax platform (tests: cpu)")
+    p.add_argument("--virtual-devices", type=int, default=0,
+                   help="force N virtual CPU devices (tests)")
+    args = p.parse_args(argv)
+
+    from tpu_docker_api.workload.jaxenv import bootstrap_jax
+
+    bootstrap_jax(args.platform, args.virtual_devices)
+    import jax
+
+    from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
+    from tpu_docker_api.models.llama import llama_init, llama_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.trainer import create_train_state
+
+    cfg = llama_presets()[args.preset]
+    mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=1))
+    if args.ckpt_dir:
+        from tpu_docker_api.train.checkpoint import resume_or_init
+
+        state, _, mgr = resume_or_init(args.ckpt_dir, cfg, mesh,
+                                       jax.random.PRNGKey(0))
+        params = state.params
+        mgr.close()
+        step = int(state.step)
+        # inference holds params only — dropping the TrainState frees the
+        # restored Adam moments (2 extra f32 copies of every weight)
+        del state
+    else:
+        if mesh.devices.size > 1:
+            state, _ = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+            params = state.params
+            del state
+        else:
+            params = llama_init(cfg, jax.random.PRNGKey(0))
+        step = 0
+    if args.quantize:
+        from tpu_docker_api.infer.quantize import quantize_llama_params
+
+        params = quantize_llama_params(params)
+
+    max_seq = args.max_seq or cfg.max_seq_len
+    # jitted generate fns keyed by sampling config. Bounded LRU: sampler
+    # params are client-controlled, and each distinct tuple costs an XLA
+    # compile — an unbounded dict would let traffic grow compile caches
+    # forever. Floats are rounded so near-equal values share a program.
+    import collections
+
+    fns: collections.OrderedDict[tuple, object] = collections.OrderedDict()
+    fn_lock = threading.Lock()
+    _FN_CACHE_MAX = 16
+
+    def get_fn(max_new: int, temperature: float, top_k: int, top_p: float):
+        key = (max_new, round(temperature, 3), top_k, round(top_p, 3))
+        with fn_lock:
+            if key in fns:
+                fns.move_to_end(key)
+                return fns[key]
+            fn = make_generate_fn(
+                cfg,
+                GenerateConfig(max_new_tokens=key[0], temperature=key[1],
+                               top_k=key[2], top_p=key[3], max_seq=max_seq),
+                mesh,
+            )
+            fns[key] = fn
+            while len(fns) > _FN_CACHE_MAX:
+                fns.popitem(last=False)
+            return fn
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng_state = {"key": jax.random.PRNGKey(int.from_bytes(os.urandom(4),
+                                                          "little"))}
+    gen_lock = threading.Lock()  # one TPU, one generation at a time
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet; structured line below instead
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok", "model": args.preset, "step": step,
+                    "quantized": args.quantize,
+                    "devices": len(jax.devices()),
+                })
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("body must be a JSON object")
+                prompts = req.get("tokens")
+                if not prompts or not all(
+                        isinstance(r, list) and r for r in prompts):
+                    raise ValueError(
+                        "tokens must be a non-empty list of non-empty "
+                        "token-id rows")
+                prompt = jnp.asarray(np.array(prompts, np.int32))
+                if int(prompt.max()) >= cfg.vocab_size or int(prompt.min()) < 0:
+                    raise ValueError(
+                        f"token ids must be in [0, {cfg.vocab_size})")
+                max_new = int(req.get("maxNewTokens", 64))
+                fn = get_fn(max_new, float(req.get("temperature", 0.0)),
+                            int(req.get("topK", 0)),
+                            float(req.get("topP", 1.0)))
+                with gen_lock:
+                    key, sub = jax.random.split(rng_state["key"])
+                    rng_state["key"] = key
+                    out = fn(params, prompt, sub)
+                self._reply(200, {
+                    "tokens": np.asarray(out["tokens"]).tolist(),
+                    "lengths": np.asarray(out["lengths"]).tolist(),
+                })
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — serving must not die
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    httpd = ThreadingHTTPServer((args.host, args.port), Handler)
+
+    def _stop(signum, _frame):
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print(json.dumps({"event": "serving", "model": args.preset,
+                      "port": httpd.server_address[1],
+                      "quantized": args.quantize}), flush=True)
+    httpd.serve_forever()
+    print(json.dumps({"event": "stopped"}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

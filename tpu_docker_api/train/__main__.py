@@ -67,24 +67,13 @@ def main(argv: list[str] | None = None) -> None:
                    help="force N virtual CPU devices (tests)")
     args = p.parse_args(argv)
 
-    if args.virtual_devices:
-        flags = os.environ.get("XLA_FLAGS", "")
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count="
-            f"{args.virtual_devices}").strip()
+    from tpu_docker_api.workload.jaxenv import bootstrap_jax
+
+    # coordinator/process identity rendered by the control plane
+    bootstrap_jax(args.platform, args.virtual_devices)
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-
     n_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
-    if n_processes > 1:
-        # coordinator/process identity rendered by the control plane
-        jax.distributed.initialize(
-            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
-            num_processes=n_processes,
-            process_id=int(os.environ["JAX_PROCESS_ID"]),
-        )
 
     from tpu_docker_api.models.llama import llama_presets
     from tpu_docker_api.models.moe import moe_presets

@@ -82,6 +82,31 @@ class DistributedJob:
         return f"{coord.host}:{self.resolved_megascale_port}"
 
 
+def bootstrap_jax(platform: str = "", virtual_devices: int = 0) -> None:
+    """Shared entrypoint bootstrap (train/serve __main__s): optional virtual
+    CPU devices + platform override, then ``jax.distributed.initialize`` from
+    the env this module renders when the control plane launched a
+    multi-process job. Must run before any backend use."""
+    import os
+
+    if virtual_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{virtual_devices}").strip()
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    n_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if n_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+            num_processes=n_processes,
+            process_id=int(os.environ["JAX_PROCESS_ID"]),
+        )
+
+
 def _process_bounds(n_processes: int) -> str:
     """Arrange processes on a 1D DCN axis: "n,1,1" — the safe default that
     matches any chips-per-process shape; topology-shaped bounds are an
