@@ -329,6 +329,8 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
                              lambda b, h, i: (b, h, 0, i)),
             ),
             out_shape=out_shapes,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel")),
             interpret=interpret,
         )(q, k, v)
 
@@ -361,6 +363,9 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        # kv axis carries scratch accumulators step-to-step → arbitrary
+        compiler_params=pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 
@@ -401,6 +406,9 @@ def _bwd_impl(causal, block_q, block_k, interpret, residuals, dout):
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        # kv axis accumulates dq in scratch → arbitrary
+        compiler_params=pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(k, v, q, dout, lse, delta)
 
@@ -439,6 +447,9 @@ def _bwd_impl(causal, block_q, block_k, interpret, residuals, dout):
             pltpu.VMEM((block_k, head_dim), jnp.float32),
             pltpu.VMEM((block_k, head_dim), jnp.float32),
         ],
+        # group + q axes accumulate dk/dv in scratch → arbitrary
+        compiler_params=pltpu.CompilerParams(dimension_semantics=(
+            "parallel", "parallel", "parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
     )(q, dout, lse, delta, k, v)
     return dq, dk, dv
