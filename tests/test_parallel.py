@@ -97,6 +97,19 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_matches_dense_bf16(self):
+        """The training dtype path: bf16 operands with f32 accumulation.
+        Looser tolerance — ring downcasts probs to bf16 for the p·v dot
+        (flash-kernel numerics), dense keeps f32 probs."""
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=4),
+                          devices=jax.devices()[:4])
+        q, k, v = self._qkv(seq=64, dtype=jnp.bfloat16)
+        ref = _dense_attention(q, k, v, causal=True)
+        got = ring_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2)
+
     def test_matches_dense_non_causal(self):
         mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=4),
                           devices=jax.devices()[:4])

@@ -37,8 +37,12 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     h_kv = k.shape[2]
     group = h // h_kv
 
-    qf = q.astype(jnp.float32) * scale
-    qg = qf.reshape(b, sq, h_kv, group, d)
+    # dot operands stay in the storage dtype (bf16 → full-rate MXU), with
+    # f32 stats/accumulation. The p·v dot downcasts p like the flash
+    # kernels do (NOT like dense_attention, which keeps f32 probs for
+    # cache-dtype-independent serving numerics) — in bf16 this costs up to
+    # ~1e-3 relative vs the dense reference
+    qg = q.reshape(b, sq, h_kv, group, d)
 
     acc0 = jnp.zeros((b, h_kv, group, sq, d), jnp.float32)
     m0 = jnp.full((b, h_kv, group, sq, 1), _NEG_INF, jnp.float32)
@@ -49,9 +53,8 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
         originated on device (my_idx - step) mod n."""
         acc, m_prev, l_prev = carry
         src_idx = (my_idx - step) % n
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
-        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kf)
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_blk,
+                       preferred_element_type=jnp.float32) * scale
         if causal:
             rows = my_idx * sq + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
             cols = src_idx * sk + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
@@ -61,7 +64,9 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jnp.einsum("bhgqk,bkhd->bhgqd", p, vf)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     def body(step, carry):
