@@ -31,10 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_docker_api.models.llama import (
-    LlamaConfig,
-    llama_forward_cached,
-)
+from tpu_docker_api.models import cached_forward_fn
+from tpu_docker_api.models.llama import LlamaConfig
 from tpu_docker_api.infer.sampling import make_sampler
 
 #: cache layout: (layer, batch, seq, kv_head, head_dim)
@@ -109,6 +107,7 @@ def make_generate_fn(
             f"max_new_tokens must be >= 1, got {gen.max_new_tokens}"
         )
     sampler = make_sampler(gen.temperature, gen.top_k, gen.top_p)
+    fwd = cached_forward_fn(cfg)  # llama or moe — resolved once
 
     def _sample_step(logits_last, key, done):
         tok = sampler(logits_last, key)
@@ -132,7 +131,7 @@ def make_generate_fn(
 
         # ---- prefill: whole prompt in one pass, logits for the LAST
         # position only (skips the (b, prompt, vocab) f32 intermediate)
-        logits, k_cache, v_cache = llama_forward_cached(
+        logits, k_cache, v_cache = fwd(
             params, prompt, cfg, cache.k, cache.v,
             jnp.int32(0), mesh, last_only=True,
         )
@@ -143,7 +142,7 @@ def make_generate_fn(
         # ---- decode: one token per scan step, single compiled body
         def body(carry, step_key):
             k_cache, v_cache, pos, tok, done = carry
-            logits, k_cache, v_cache = llama_forward_cached(
+            logits, k_cache, v_cache = fwd(
                 params, tok[:, None], cfg, k_cache, v_cache, pos, mesh
             )
             next_tok, done = _sample_step(logits[:, -1], step_key, done)
@@ -190,7 +189,7 @@ def prefill_and_first_token(
 ) -> tuple[jnp.ndarray, KVCache]:
     """Standalone prefill for callers that drive decode themselves (serving
     loops with continuous batching): greedy first token + filled cache."""
-    logits, k, v = llama_forward_cached(
+    logits, k, v = cached_forward_fn(cfg)(
         params, prompt, cfg, cache.k, cache.v, jnp.int32(0), mesh,
         last_only=True,
     )
@@ -208,7 +207,7 @@ def decode_one(
     mesh: Mesh | None = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Single greedy decode step — the building block for external loops."""
-    logits, k, v = llama_forward_cached(
+    logits, k, v = cached_forward_fn(cfg)(
         params, tok[:, None], cfg, cache.k, cache.v, pos, mesh
     )
     return logits[:, -1], KVCache(k=k, v=v)

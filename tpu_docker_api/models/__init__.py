@@ -37,3 +37,18 @@ def model_fns(cfg):
     if isinstance(cfg, LlamaConfig):
         return llama_init, llama_loss, LLAMA_RULES
     raise TypeError(f"no model registered for config type {type(cfg)!r}")
+
+
+def cached_forward_fn(cfg):
+    """The serving dispatch seam (infer/engine.py): any decoder config maps
+    to its KV-cached forward with the shared signature
+    ``(params, tokens, cfg, k_cache, v_cache, start_pos, mesh, last_only)``.
+    NB: MoEConfig subclass-checks must come first if it ever inherits."""
+    from tpu_docker_api.models.llama import llama_forward_cached
+    from tpu_docker_api.models.moe import moe_forward_cached
+
+    if isinstance(cfg, MoEConfig):
+        return moe_forward_cached
+    if isinstance(cfg, LlamaConfig):
+        return llama_forward_cached
+    raise TypeError(f"no cached forward for config type {type(cfg)!r}")

@@ -270,7 +270,21 @@ def llama_forward_cached(
     wants just the next-token logits; skipping the (b, seq, vocab) f32
     intermediate saves prompt_len× the logits memory and FLOPs).
     """
-    b, s = tokens.shape
+    def block_fn(x, layer, cache, rope_cos, rope_sin):
+        return _block(x, layer, cfg, rope_cos, rope_sin, mesh,
+                      cache=cache, start_pos=start_pos)
+
+    return decoder_forward_cached(
+        params, tokens, cfg, k_cache, v_cache, mesh, last_only, block_fn)
+
+
+def decoder_forward_cached(params, tokens, cfg, k_cache, v_cache, mesh,
+                           last_only, block_fn):
+    """The shared KV-cached decoder skeleton: embed → cache-carrying layer
+    scan → lm_head. ``block_fn(x, layer, (kc, vc, layer_idx), rope_cos,
+    rope_sin) -> (x, (kc, vc))`` supplies the block body — Llama's
+    ``_block`` or MoE's aux-discarding wrapper (models/moe.py) — so the
+    cache-as-carry mechanics live in exactly one place."""
     max_seq = k_cache.shape[2]
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
     if mesh is not None:
@@ -280,10 +294,8 @@ def llama_forward_cached(
     def scan_body(carry, layer_and_idx):
         x, kc, vc = carry
         layer, layer_idx = layer_and_idx
-        x, (kc, vc) = _block(
-            x, layer, cfg, rope_cos, rope_sin, mesh,
-            cache=(kc, vc, layer_idx), start_pos=start_pos,
-        )
+        x, (kc, vc) = block_fn(x, layer, (kc, vc, layer_idx),
+                               rope_cos, rope_sin)
         return (x, kc, vc), None
 
     (x, new_k, new_v), _ = lax.scan(

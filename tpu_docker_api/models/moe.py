@@ -147,14 +147,19 @@ def moe_init(cfg: MoEConfig, key: jax.Array) -> dict:
     }
 
 
-def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig):
+def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig,
+           drop_free: bool = False):
     """Top-k routing → (dispatch (t,E,C), combine (t,E,C), aux_loss).
 
     Static shapes throughout: one-hot dispatch with cumsum capacity
-    assignment (GShard eq. 2), overflow tokens dropped.
+    assignment (GShard eq. 2), overflow tokens dropped. ``drop_free=True``
+    sets capacity = t so NO token ever drops — the decode-serving mode,
+    where capacity drops would couple co-batched requests (a token's expert
+    contribution zeroing out depending on what else is in the batch).
     """
     t = x_flat.shape[0]
-    E, K, C = cfg.n_experts, cfg.top_k, cfg.capacity(x_flat.shape[0])
+    E, K = cfg.n_experts, cfg.top_k
+    C = t if drop_free else cfg.capacity(t)
     logits = x_flat.astype(jnp.float32) @ router          # (t, E)
     probs = jax.nn.softmax(logits, axis=-1)
     gate_vals, gate_idx = lax.top_k(probs, K)             # (t, K)
@@ -186,12 +191,14 @@ def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig):
     return dispatch, combine, aux
 
 
-def _moe_mlp(x, layer_moe, cfg: MoEConfig, mesh: Mesh | None):
+def _moe_mlp(x, layer_moe, cfg: MoEConfig, mesh: Mesh | None,
+             drop_free: bool = False):
     """Sparse FFN: route → all-to-all dispatch → batched expert SwiGLU →
     all-to-all combine. Returns (out, aux_loss)."""
     b, s, d = x.shape
     x_flat = x.reshape(b * s, d)
-    dispatch, combine, aux = _route(x_flat, layer_moe["router"], cfg)
+    dispatch, combine, aux = _route(x_flat, layer_moe["router"], cfg,
+                                    drop_free=drop_free)
 
     # (E, C, d) expert buffers — sharded on ep, so this einsum IS the
     # all-to-all (tokens leave their data-parallel home shard for their
@@ -208,21 +215,32 @@ def _moe_mlp(x, layer_moe, cfg: MoEConfig, mesh: Mesh | None):
     return out.reshape(b, s, d), aux
 
 
-def _moe_block(x, layer, cfg: MoEConfig, rope_cos, rope_sin, mesh):
+def _moe_block(x, layer, cfg: MoEConfig, rope_cos, rope_sin, mesh,
+               cache=None, start_pos=None):
     """Transformer block: Llama attention (shared code) + sparse FFN.
-    Returns (x, aux_loss)."""
-    bspec = P(("dp", "fsdp"), "sp")
+    Returns (x, aux_loss), or (x, aux_loss, new_cache) on the KV-cached
+    path (``cache=(k_all, v_all, layer_idx)`` — llama's _attention
+    contract)."""
+    bspec = P(("dp", "fsdp"), "sp" if cache is None else None)
     attn_out = _attention(
         rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg,
-        rope_cos, rope_sin, mesh,
+        rope_cos, rope_sin, mesh, cache=cache, start_pos=start_pos,
     )
+    new_cache = None
+    if cache is not None:
+        attn_out, new_cache = attn_out
     x = x + attn_out
     x = constrain(x, mesh, bspec) if mesh is not None else x
+    # decode steps (cached, seq 1) route drop-free: capacity = t is tiny
+    # there, and capacity drops would make output depend on co-batched
+    # requests. Prefill/training keep the GShard capacity heuristic —
+    # drop-free at large t would cost O(t^2 E) dispatch memory.
     moe_out, aux = _moe_mlp(
-        rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["moe"], cfg, mesh)
+        rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["moe"], cfg,
+        mesh, drop_free=(cache is not None and x.shape[1] == 1))
     x = x + moe_out
     x = constrain(x, mesh, bspec) if mesh is not None else x
-    return x, aux
+    return (x, aux) if cache is None else (x, aux, new_cache)
 
 
 def moe_forward(
@@ -255,6 +273,34 @@ def moe_forward(
     if mesh is not None:
         logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
     return logits, jnp.mean(aux_per_layer)
+
+
+def moe_forward_cached(
+    params: dict,
+    tokens: jnp.ndarray,      # (batch, seq) int32 — the NEW tokens only
+    cfg: MoEConfig,
+    k_cache: jnp.ndarray,     # (n_layers, batch, max_seq, n_kv_heads, hd)
+    v_cache: jnp.ndarray,
+    start_pos: jnp.ndarray,
+    mesh: Mesh | None = None,
+    last_only: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """KV-cached forward for serving — rides the shared decoder skeleton
+    (models/llama.py ``decoder_forward_cached``: cache carried through the
+    layer scan, new-token slots written in place) with the sparse-FFN block
+    body. Router aux loss is an inference no-op and is discarded; decode
+    steps route drop-free (see ``_moe_block``)."""
+    from tpu_docker_api.models.llama import decoder_forward_cached
+
+    def block_fn(x, layer, cache, rope_cos, rope_sin):
+        x, _aux, new_cache = _moe_block(
+            x, layer, cfg, rope_cos, rope_sin, mesh,
+            cache=cache, start_pos=start_pos,
+        )
+        return x, new_cache
+
+    return decoder_forward_cached(
+        params, tokens, cfg, k_cache, v_cache, mesh, last_only, block_fn)
 
 
 def moe_loss(
