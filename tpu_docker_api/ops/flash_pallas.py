@@ -1,13 +1,16 @@
 """Pallas TPU flash attention (causal, GQA-aware), forward + backward.
 
-Online-softmax attention tiled for the MXU: the q block lives in VMEM, k/v are
-walked block-by-block with running (max, sum, acc) statistics in f32, so the
-S×S score matrix never materializes in HBM — the op that XLA's automatic
-fusion cannot produce on its own (it would re-materialize scores for the
-softmax). Layout follows the pallas guide (/opt/skills/guides/pallas_guide.md):
-128-aligned tiles, f32 accumulation via ``preferred_element_type``, causal
-masking with ``broadcasted_iota``, and a dynamic ``fori_loop`` bound so causal
-q blocks skip never-visible k blocks entirely.
+Online-softmax attention tiled for the MXU: the q block lives in VMEM, k/v
+stream in block-by-block as the innermost grid axis (Mosaic double-buffers
+grid-step loads, overlapping the k/v DMA with compute) with running
+(max, sum, acc) statistics in f32 scratch, so the S×S score matrix never
+materializes in HBM — the op that XLA's automatic fusion cannot produce on
+its own (it would re-materialize scores for the softmax). Layout follows the
+pallas guide (/opt/skills/guides/pallas_guide.md): 128-aligned tiles, f32
+accumulation via ``preferred_element_type``, causal masking with
+``broadcasted_iota`` on diagonal tiles only (never-visible tiles are skipped,
+fully-visible tiles skip the mask compute), and the softmax runs in the
+base-2 domain (``exp2``; scale·log2(e) folded into q).
 
 Training runs through a ``jax.custom_vjp``: the forward also emits the
 per-row logsumexp L = m + log(l), and the backward is the FlashAttention-2
@@ -57,65 +60,26 @@ FLASH_SAVEABLE = jax.checkpoint_policies.save_only_these_names(
 TRAIN_REMAT_POLICY = FLASH_SAVEABLE
 
 _NEG_INF = -1e30
+#: scores are kept in the base-2 domain inside every kernel: fold log2(e)
+#: into the qk scale (applied to q once, head_dim-wide, instead of per score
+#: tile) and use exp2 for the softmax. The emitted lse stays natural-log
+#: (lse = ln2·m2 + ln l), so the kernel boundary contract is unchanged.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, block_k: int,
-    scale: float, causal: bool,
-):
-    qi = pl.program_id(2)
-    # dot operands stay in the storage dtype (bf16 → full-rate MXU; f32
-    # operands would run the MXU ~12x slower on v5e); accumulation and all
-    # softmax statistics are f32 via preferred_element_type
-    q = q_ref[0, 0]  # (block_q, head_dim)
-    head_dim = q.shape[-1]
-    num_k_blocks = k_ref.shape[2] // block_k
-
-    # causal: k blocks strictly after this q block's last row are all masked
-    if causal:
-        k_limit = lax.div((qi + 1) * block_q + block_k - 1, block_k)
-    else:
-        k_limit = num_k_blocks
-
-    def body(kj, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (block_q, block_k) f32
-        if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (block_q, 1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return acc_new, m_new, l_new
-
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = lax.fori_loop(0, k_limit, body, (acc0, m0, l0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    # lse is stored TRANSPOSED, (…, 8, block_q): seq on the lane dim keeps
-    # the buffer dense — a (…, block_q, 8) layout pads lanes 8→128 (16x
-    # HBM for a saved-residual buffer). The 8 sublanes are broadcast copies
-    # (min f32 tile height).
-    lse_ref[0, 0] = jnp.broadcast_to(
-        (m + jnp.log(l_safe)).T, lse_ref.shape[2:])
+def _causal_dispatch(step, qi, kj, block_q, block_k, causal):
+    """Run ``step(masked)`` for tile (qi, kj): diagonal tiles apply the
+    causal mask, fully-visible tiles skip the mask compute (these kernels
+    are VPU-bound — the iota/compare is real cost), never-visible tiles are
+    skipped entirely. Shared by the forward and both backward kernels."""
+    if not causal:
+        step(False)
+        return
+    fully = (kj + 1) * block_k <= qi * block_q
+    diag = (~fully) & (kj * block_k <= qi * block_q + block_q - 1)
+    pl.when(fully)(lambda: step(False))
+    pl.when(diag)(lambda: step(True))
 
 
 def _flash_kernel_kvgrid(
@@ -135,19 +99,19 @@ def _flash_kernel_kvgrid(
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: skip blocks where every k position is after every q position
-    visible = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
-
-    @pl.when(visible)
-    def _step():
-        # bf16 dot operands (full-rate MXU), f32 accumulation + stats
-        q = q_ref[0, 0]
+    def _step(masked):
+        # bf16 dot operands (full-rate MXU), f32 accumulation + stats.
+        # The base-2 softmax scale is folded into q (head_dim-sized multiply)
+        # instead of scaling the (block_q, block_k) score tile — one less
+        # full-tile VPU op in a VPU-bound kernel.
+        q = (q_ref[0, 0].astype(jnp.float32) * (scale * _LOG2E)).astype(
+            q_ref.dtype)
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
+        )  # base-2 domain — see _LOG2E
+        if masked:
             rows = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = kj * block_k + lax.broadcasted_iota(
@@ -156,8 +120,8 @@ def _flash_kernel_kvgrid(
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         l_ref[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -165,29 +129,34 @@ def _flash_kernel_kvgrid(
         )
         m_ref[:] = m_new
 
+    _causal_dispatch(_step, qi, kj, block_q, block_k, causal)
+
     @pl.when(kj == nk - 1)
     def _finalize():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        # transposed store — see _flash_kernel
+        # transposed store — see the lse comment in _fwd_impl
         lse_ref[0, 0] = jnp.broadcast_to(
-            (m_ref[:] + jnp.log(l_safe)).T, lse_ref.shape[2:])
+            (m_ref[:] * _LN2 + jnp.log(l_safe)).T, lse_ref.shape[2:])
 
 
-def _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal):
+def _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, masked):
     """Rebuild the softmax probability tile P_ij = exp(q k^T · scale − L_i)
     from saved logsumexp — the FlashAttention-2 recomputation step shared by
-    both backward kernels."""
+    both backward kernels. Computed in the base-2 domain (see _LOG2E);
+    ``masked`` applies the causal mask (diagonal tiles only — fully-visible
+    tiles skip it)."""
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
+        (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype), k,
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    if masked:
         rows = qi * block_q + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         cols = kj * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(rows >= cols, s, _NEG_INF)
-    return jnp.exp(s - lse)
+    return jnp.exp2(s - lse * _LOG2E)
 
 
 def _flash_bwd_dq_kernel(
@@ -206,19 +175,16 @@ def _flash_bwd_dq_kernel(
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    visible = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
-
-    @pl.when(visible)
-    def _step():
+    def _step(masked):
         # bf16 dot operands (full-rate MXU), f32 accumulation + stats
         q = q_ref[0, 0]                               # (block_q, head_dim)
         do = do_ref[0, 0]
-        # stats tiles are transposed (8, block_q) — see _flash_kernel
+        # stats tiles are transposed (8, block_q) — see _fwd_impl
         lse = lse_ref[0, 0, :1, :].T                  # (block_q, 1)
         delta = delta_ref[0, 0, :1, :].T
         k = k_ref[0, 0]                               # (block_k, head_dim)
         v = v_ref[0, 0]
-        p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal)
+        p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, masked)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -226,6 +192,8 @@ def _flash_bwd_dq_kernel(
         acc_ref[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+
+    _causal_dispatch(_step, qi, kj, block_q, block_k, causal)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -253,20 +221,16 @@ def _flash_bwd_dkv_kernel(
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    # causal: q blocks entirely before this k block see none of it
-    visible = (not causal) or (kj * block_k <= qi * block_q + block_q - 1)
-
-    @pl.when(visible)
-    def _step():
+    def _step(masked):
         # bf16 dot operands (full-rate MXU), f32 accumulation + stats
         k = k_ref[0, 0]                               # (block_k, head_dim)
         v = v_ref[0, 0]
         q = q_ref[0, 0]                               # (block_q, head_dim)
         do = do_ref[0, 0]
-        # stats tiles are transposed (8, block_q) — see _flash_kernel
+        # stats tiles are transposed (8, block_q) — see _fwd_impl
         lse = lse_ref[0, 0, :1, :].T
         delta = delta_ref[0, 0, :1, :].T
-        p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, causal)
+        p = _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, masked)
         dv_acc_ref[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -279,15 +243,13 @@ def _flash_bwd_dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
+    # causal: q blocks entirely before this k block see none of it
+    _causal_dispatch(_step, qi, kj, block_q, block_k, causal)
+
     @pl.when((g == ng - 1) & (qi == nq - 1))
     def _finalize():
         dk_ref[0, 0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc_ref[:].astype(dv_ref.dtype)
-
-
-#: k+v bf16 VMEM budget under which the fori-loop variant (whole kv resident,
-#: causal early-exit) is preferred; above it, the kv-grid variant streams
-_KV_VMEM_BUDGET_BYTES = 4 * 1024 * 1024
 
 
 def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
@@ -303,39 +265,12 @@ def _fwd_impl(q, k, v, causal, block_q, block_k, interpret):
         # broadcast copies (min f32 tile height).
         jax.ShapeDtypeStruct((batch, num_heads, 8, seq), jnp.float32),
     )
-    kv_bytes = 2 * seq * head_dim * 2  # k + v, bf16
-    if kv_bytes <= _KV_VMEM_BUDGET_BYTES:
-        # short/medium seq: whole k/v resident, causal rows stop their k loop
-        # early (dynamic fori bound) — no wasted grid steps
-        kernel = functools.partial(
-            _flash_kernel, block_q=block_q, block_k=block_k,
-            scale=scale, causal=causal,
-        )
-        return pl.pallas_call(
-            kernel,
-            grid=(batch, num_heads, seq // block_q),
-            in_specs=[
-                pl.BlockSpec((1, 1, block_q, head_dim),
-                             lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, seq, head_dim),
-                             lambda b, h, i, g=group: (b, h // g, 0, 0)),
-                pl.BlockSpec((1, 1, seq, head_dim),
-                             lambda b, h, i, g=group: (b, h // g, 0, 0)),
-            ],
-            out_specs=(
-                pl.BlockSpec((1, 1, block_q, head_dim),
-                             lambda b, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, 8, block_q),
-                             lambda b, h, i: (b, h, 0, i)),
-            ),
-            out_shape=out_shapes,
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "parallel")),
-            interpret=interpret,
-        )(q, k, v)
-
-    # long seq: kv as innermost grid axis, only one (block_k, head_dim) tile
-    # of k/v in VMEM at a time; accumulators live in scratch across kv steps
+    # kv as innermost grid axis, only one (block_k, head_dim) tile of k/v in
+    # VMEM at a time (unbounded seq); accumulators live in scratch across kv
+    # steps. Mosaic double-buffers grid-step block loads, which overlaps the
+    # k/v DMA with compute — measured faster than a whole-kv-resident
+    # fori-loop variant even at seq 2048 where both fit VMEM (the fori loop
+    # serializes its dot→stats dependency chain with no prefetch overlap).
     kernel = functools.partial(
         _flash_kernel_kvgrid, block_q=block_q, block_k=block_k,
         scale=scale, causal=causal,
@@ -488,19 +423,19 @@ def flash_attention(
     k: jnp.ndarray,  # (batch, num_kv_heads, seq, head_dim)
     v: jnp.ndarray,
     causal: bool = True,
-    # measured on v5e at (8, 8, 2048, 128): (512, 1024) runs the forward
-    # ~30% faster than (512, 512) and the backward ~25% faster — wider k
-    # blocks amortize the per-step lane reductions (max/sum over block_k)
-    # that bound this kernel on the VPU; (2048, *) and (*, 2048) regress
-    # or fail to fit VMEM
-    block_q: int = 512,
+    # measured on v5e at (2, 32|8, 2048, 64): under the kv-grid kernel,
+    # (1024, 1024) is fastest — fwd 1.43 ms / bwd 2.10 ms vs 1.48/2.38 for
+    # (512, 1024) and 1.93/2.52 for (512, 512); wide blocks amortize the
+    # per-step lane reductions (max/sum over block_k) that bound these
+    # kernels on the VPU, and (2048, *) / (*, 2048) regress or blow VMEM
+    block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Tiled causal attention, differentiable (custom VJP). seq must be a
     multiple of 128 (the dispatcher's contract; the model layer pads);
     requested block sizes are clamped to seq then halved until they divide
-    it — e.g. seq 640 runs with block_q 128 and block_k 640 rather than
+    it — e.g. seq 640 runs with block_q and block_k 640 rather than
     failing. Head grouping (GQA) is expressed in the k/v BlockSpec index
     maps, so kv heads are never materially repeated."""
     batch, num_heads, seq, head_dim = q.shape
