@@ -74,6 +74,31 @@ class TestLlama:
         l2 = llama_loss(params, tokens, cfg_remat)
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
 
+    def test_chunked_loss_matches_dense(self):
+        """The chunked-CE path (fused logits+CE, recompute-in-backward; the
+        bench memory saver) must match the dense loss in value AND in every
+        parameter gradient — including the lm_head, whose grad takes the
+        custom-VJP dw accumulation path. row_chunk 24 does not divide the
+        2*31=62 rows, so the zero-weight padding is exercised too."""
+        import dataclasses
+
+        cfg_chunk = dataclasses.replace(TINY, loss_chunk_rows=24)
+        params = llama_init(TINY, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 256)
+        l_dense, g_dense = jax.value_and_grad(
+            lambda p: llama_loss(p, tokens, TINY))(params)
+        l_chunk, g_chunk = jax.value_and_grad(
+            lambda p: llama_loss(p, tokens, cfg_chunk))(params)
+        np.testing.assert_allclose(float(l_dense), float(l_chunk), rtol=1e-5)
+        for (path, gd), (_, gc) in zip(
+            jax.tree_util.tree_leaves_with_path(g_dense),
+            jax.tree_util.tree_leaves_with_path(g_chunk),
+        ):
+            # grads land in bf16 (param dtype) — atol is a few bf16 ulps
+            np.testing.assert_allclose(
+                np.asarray(gd, np.float32), np.asarray(gc, np.float32),
+                rtol=5e-2, atol=2e-3, err_msg=str(path))
+
     def test_presets_well_formed(self):
         for name, cfg in llama_presets().items():
             assert cfg.dim % cfg.n_heads == 0, name

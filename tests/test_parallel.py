@@ -197,3 +197,30 @@ class TestUlyssesAttention:
             got = float(jax.jit(
                 lambda p, t: llama_loss(p, t, cfg, mesh))(params, tokens))
         np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+class TestChunkedLossOnMesh:
+    def test_chunked_loss_matches_dense_under_fsdp_tp(self):
+        """The chunked-CE training loss (ops.xent) must compile and agree
+        with the dense loss under a sharded mesh — its scan-carried f32 dw
+        accumulator and row-chunk reshapes all run through GSPMD here."""
+        import dataclasses
+
+        from tpu_docker_api.models.llama import (
+            llama_init,
+            llama_loss,
+            llama_presets,
+        )
+
+        cfg = llama_presets()["tiny"]
+        chunk_cfg = dataclasses.replace(cfg, loss_chunk_rows=16)
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                    cfg.vocab_size, dtype="int32")
+        ref = float(llama_loss(params, tokens, cfg))
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+        with mesh:
+            got = float(jax.jit(
+                lambda p, t: llama_loss(p, t, chunk_cfg, mesh))(
+                    params, tokens))
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)

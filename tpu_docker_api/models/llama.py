@@ -49,6 +49,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attention_impl: str = "auto"  # ops.attention impls, "ring", or "ulysses"
+    # >0: train loss runs ops.xent.chunked_cross_entropy with this row-chunk
+    # size instead of materializing (batch, seq, vocab) logits
+    loss_chunk_rows: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -212,13 +215,15 @@ def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     return x if cache is None else (x, new_cache)
 
 
-def llama_forward(
+def llama_hidden(
     params: dict,
     tokens: jnp.ndarray,  # (batch, seq) int32
     cfg: LlamaConfig,
     mesh: Mesh | None = None,
 ) -> jnp.ndarray:
-    """Next-token logits (batch, seq, vocab) in f32."""
+    """The trunk: embed → scanned blocks → final hidden (batch, seq, dim),
+    pre-final-norm. Shared by ``llama_forward`` (dense logits tail) and the
+    chunked-CE training loss (which never materializes full logits)."""
     seq = tokens.shape[1]
     x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
     if mesh is not None:
@@ -240,6 +245,17 @@ def llama_forward(
         return block(x, layer), None
 
     x, _ = lax.scan(scan_body, x, params["layers"])
+    return x
+
+
+def llama_forward(
+    params: dict,
+    tokens: jnp.ndarray,  # (batch, seq) int32
+    cfg: LlamaConfig,
+    mesh: Mesh | None = None,
+) -> jnp.ndarray:
+    """Next-token logits (batch, seq, vocab) in f32."""
+    x = llama_hidden(params, tokens, cfg, mesh)
     logits = lm_head(params, x, cfg)
     if mesh is not None:
         logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
@@ -334,7 +350,23 @@ def llama_loss(
     params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     mesh: Mesh | None = None,
 ) -> jnp.ndarray:
-    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]."""
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1].
+
+    With ``cfg.loss_chunk_rows`` set, the logits projection and CE fuse into
+    ``ops.xent.chunked_cross_entropy``: no (batch, seq, vocab) residual is
+    ever materialized (the backward rebuilds logits per row chunk), freeing
+    the HBM that otherwise caps the training batch size."""
+    if cfg.loss_chunk_rows:
+        from tpu_docker_api.ops.xent import chunked_cross_entropy
+
+        x = llama_hidden(params, tokens[:, :-1], cfg, mesh)
+        h = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cfg.dtype)
+        if mesh is not None:
+            # same activation sharding the dense tail's logits constraint
+            # implies on its input; the chunk scan inherits it from here
+            h = constrain(h, mesh, P(("dp", "fsdp"), "sp", None))
+        return chunked_cross_entropy(
+            h, params["lm_head"], tokens[:, 1:], cfg.loss_chunk_rows)
     logits = llama_forward(params, tokens[:, :-1], cfg, mesh)
     return cross_entropy(logits, tokens[:, 1:])
 

@@ -68,6 +68,16 @@ _LOG2E = 1.4426950408889634
 _LN2 = 0.6931471805599453
 
 
+def _causal_mask(s, qi, kj, block_q, block_k):
+    """Mask scores above the diagonal in tile (qi, kj) — the one place the
+    mask semantics live for the forward and both backward kernels."""
+    rows = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    cols = kj * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
 def _causal_dispatch(step, qi, kj, block_q, block_k, causal):
     """Run ``step(masked)`` for tile (qi, kj): diagonal tiles apply the
     causal mask, fully-visible tiles skip the mask compute (these kernels
@@ -112,11 +122,7 @@ def _flash_kernel_kvgrid(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # base-2 domain — see _LOG2E
         if masked:
-            rows = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -151,11 +157,7 @@ def _probs_tile(q, k, lse, qi, kj, block_q, block_k, scale, masked):
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
     )
     if masked:
-        rows = qi * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        cols = kj * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(rows >= cols, s, _NEG_INF)
+        s = _causal_mask(s, qi, kj, block_q, block_k)
     return jnp.exp2(s - lse * _LOG2E)
 
 

@@ -1,0 +1,121 @@
+"""Chunked softmax cross-entropy fused with the logits projection.
+
+The dense loss path (``models.llama.lm_head`` + ``cross_entropy``) saves the
+full f32 logits — (batch, seq, vocab) ≈ 2 GB at bench shapes — as a backward
+residual, and the backward materializes an equally large dlogits buffer. This
+op never materializes either: rows are processed in chunks under ``lax.scan``;
+the forward keeps only the per-row logsumexp (f32, one scalar per row) and the
+backward rebuilds each chunk's logits from (h, w) on the MXU:
+
+    fwd:  per chunk   logits = h_c·w;  lse_c = logsumexp(logits)
+          residuals = (h, w, targets, lse)            # no (rows, vocab) saved
+    bwd:  per chunk   p = exp(h_c·w − lse_c)
+          dlogits = (p − onehot(t_c)) · g/N           # never whole-T sized
+          dh_c = dlogits·wᵀ ;  dw += h_cᵀ·dlogits
+
+Trade: one extra logits matmul in the backward (~2 TFLOP at bench shapes)
+against ~6 GB of HBM residual/transient traffic — roughly time-neutral on a
+v5e at batch 2, but it frees the memory that caps the bench batch size (the
+actual win; see docs/perf-notes.md).
+
+The reference has no training stack at all (SURVEY.md §0); this op exists for
+the workload layer its BASELINE.json north star requires.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def chunked_cross_entropy(
+    h: jnp.ndarray,        # (batch, seq, d) — normed final hidden, bf16
+    w: jnp.ndarray,        # (d, vocab) lm head
+    targets: jnp.ndarray,  # (batch, seq) int32
+    row_chunk: int = 512,
+) -> jnp.ndarray:
+    """Mean next-token cross-entropy over all (batch, seq) positions,
+    numerically identical to ``cross_entropy(lm_head(h), targets)`` (same
+    bf16 operands / f32 accumulation on the logits matmul). Rows are padded
+    to a multiple of ``row_chunk`` with zero-weight rows."""
+    b, s, d = h.shape
+    t = b * s
+    n_rows = -(-t // row_chunk) * row_chunk
+    hf = h.reshape(t, d)
+    tf = targets.reshape(t)
+    # weight of each row in the mean; padding rows weigh 0
+    mask = jnp.full((t,), 1.0 / t, jnp.float32)
+    if n_rows != t:
+        hf = jnp.pad(hf, ((0, n_rows - t), (0, 0)))
+        tf = jnp.pad(tf, (0, n_rows - t))
+        mask = jnp.pad(mask, (0, n_rows - t))
+    n = n_rows // row_chunk
+    return _chunked_xent(
+        hf.reshape(n, row_chunk, d),
+        w,
+        tf.reshape(n, row_chunk),
+        mask.reshape(n, row_chunk),
+    )
+
+
+@jax.custom_vjp
+def _chunked_xent(h, w, t, mask):
+    loss, _ = _xent_fwd_scan(h, w, t, mask)
+    return loss
+
+
+def _chunk_logits(hc, w):
+    # bf16 operands (full-rate MXU), f32 accumulation
+    return lax.dot_general(
+        hc, w.astype(hc.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _xent_fwd_scan(h, w, t, mask):
+    def body(acc, xs):
+        hc, tc, mc = xs
+        logits = _chunk_logits(hc, w)                      # (rows, vocab) f32
+        lse = jax.nn.logsumexp(logits, axis=-1)            # (rows,)
+        tl = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return acc + jnp.sum((lse - tl) * mc), lse
+
+    loss, lses = lax.scan(body, jnp.float32(0.0), (h, t, mask))
+    return loss, lses
+
+
+def _xent_vjp_fwd(h, w, t, mask):
+    loss, lses = _xent_fwd_scan(h, w, t, mask)
+    return loss, (h, w, t, mask, lses)
+
+
+def _xent_vjp_bwd(res, g):
+    h, w, t, mask, lses = res
+    vocab = w.shape[1]
+
+    def body(dw_acc, xs):
+        hc, tc, mc, lsec = xs
+        logits = _chunk_logits(hc, w)                      # recompute
+        p = jnp.exp(logits - lsec[:, None])
+        onehot = (jnp.arange(vocab, dtype=tc.dtype)[None, :]
+                  == tc[:, None])
+        dlogits = ((p - onehot) * (mc * g)[:, None]).astype(hc.dtype)
+        dh_c = lax.dot_general(
+            dlogits, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(hc.dtype)
+        dw_acc = dw_acc + lax.dot_general(
+            hc, dlogits, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dw_acc, dh_c
+
+    dw, dh = lax.scan(
+        body, jnp.zeros(w.shape, jnp.float32), (h, t, mask, lses))
+    return dh, dw.astype(w.dtype), None, None
+
+
+_chunked_xent.defvjp(_xent_vjp_fwd, _xent_vjp_bwd)
