@@ -103,6 +103,16 @@ def main() -> None:
          "Restart re-applies chips via a new version when carded.")
     call("POST", "/api/v1/containers/demo/commit",
          {"newImageName": "demo-snapshot:v1"})
+    call("GET", "/api/v1/containers/demo/history", None,
+         "Every stored version of the family — the per-version state store "
+         "retains them all (the reference's latest-wins etcd layout keeps "
+         "only the newest, so the rollback its README advertises cannot "
+         "work there).")
+    call("PATCH", "/api/v1/containers/demo/rollback", {"version": 0},
+         "Roll forward to a NEW version built from `demo-0`'s spec (chip "
+         "count, image, binds). Data migrates from the latest container by "
+         "default; `\"dataFrom\": \"target\"` instead snapshot-restores from "
+         "the retained retired container.")
     call("DELETE", "/api/v1/containers/demo",
          {"force": True, "delEtcdInfoAndVersionRecord": True},
          "Delete every version, return chips and ports to the schedulers; "
@@ -116,6 +126,9 @@ def main() -> None:
          "Resize = new volume `ckpt-1` + data copy; shrinking below used "
          "bytes is refused (code 10302).")
     call("GET", "/api/v1/volumes/ckpt", None)
+    call("PATCH", "/api/v1/volumes/ckpt/rollback", {"version": 0},
+         "Back to the 10GB spec as `ckpt-2`; the shrink guard still applies "
+         "to whichever source the data copies from.")
     emit("## Distributed jobs (TPU-native; no reference analog)")
     emit()
     call("POST", "/api/v1/jobs",

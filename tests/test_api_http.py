@@ -154,6 +154,44 @@ class TestContainerRoutes:
         assert out["code"] == 10001
 
 
+class TestHistoryRollbackRoutes:
+    def test_container_history_and_rollback(self, server):
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "hr", "chipCount": 2,
+        })
+        call(server, "PATCH", "/api/v1/containers/hr-0/tpu", {"chipCount": 4})
+        server.wq.drain()
+
+        out = call(server, "GET", "/api/v1/containers/hr/history")
+        assert out["code"] == 200
+        assert [v["version"] for v in out["data"]["versions"]] == [0, 1]
+
+        out = call(server, "PATCH", "/api/v1/containers/hr/rollback",
+                   {"version": 0})
+        assert out["code"] == 200
+        assert out["data"]["name"] == "hr-2"
+        assert len(out["data"]["chipIds"]) == 2
+        server.wq.drain()
+
+        out = call(server, "PATCH", "/api/v1/containers/hr/rollback", {})
+        assert out["code"] != 200  # version required
+
+    def test_volume_history_and_rollback(self, server):
+        call(server, "POST", "/api/v1/volumes",
+             {"volumeName": "vh", "size": "10GB"})
+        call(server, "PATCH", "/api/v1/volumes/vh-0/size", {"size": "20GB"})
+        server.wq.drain()
+
+        out = call(server, "GET", "/api/v1/volumes/vh/history")
+        assert [v["size"] for v in out["data"]["versions"]] == ["10GB", "20GB"]
+
+        out = call(server, "PATCH", "/api/v1/volumes/vh/rollback",
+                   {"version": 0})
+        assert out["code"] == 200
+        assert out["data"] == {"name": "vh-2", "fromVersion": 0,
+                               "size": "10GB"}
+
+
 class TestVolumeRoutes:
     def test_create_resize_info_delete(self, server):
         out = call(server, "POST", "/api/v1/volumes",

@@ -320,3 +320,130 @@ class TestStopRestartDeleteExecCommitInfo:
             env.svc.stop_container("ghost-0")
         with pytest.raises(errors.ContainerNotExist):
             env.svc.get_container_info("ghost")
+
+
+class TestHistoryRollback:
+    """Version history + rollback — the capability the reference README
+    advertises (README.md:142-144) but its latest-wins etcd layout cannot
+    deliver (SURVEY.md appendix)."""
+
+    def _grow_family(self, env):
+        """train-0 (2 chips) → patch → train-1 (4 chips)."""
+        run_default(env, chips=2)
+        env.svc.patch_container_chips("train-0",
+                                      ContainerPatchChips(chip_count=4))
+        env.wq.drain()
+
+    def test_history_lists_all_versions(self, env):
+        self._grow_family(env)
+        hist = env.svc.get_container_history("train")
+        assert hist["latest"] == 1
+        assert [v["version"] for v in hist["versions"]] == [0, 1]
+        assert hist["versions"][0]["chipCount"] == 2
+        assert hist["versions"][1]["chipCount"] == 4
+        assert hist["versions"][1]["latest"]
+        # the retired version is retained in the runtime (rollback material)
+        assert hist["versions"][0]["inRuntime"]
+
+    def test_rollback_restores_old_spec_with_newest_data(self, env):
+        from tpu_docker_api.schemas.container import ContainerRollback
+
+        self._grow_family(env)
+        # newest data lives in train-1
+        with open(f"{env.runtime.container_data_dir('train-1')}/ckpt.txt",
+                  "w") as f:
+            f.write("step=200")
+        out = env.svc.rollback_container(
+            "train", ContainerRollback(version=0))
+        env.wq.drain()
+        assert out == {"name": "train-2", "fromVersion": 0,
+                       "chipIds": out["chipIds"]}
+        # spec rolled back: 2 chips again, scheduler freed the other 2
+        assert len(out["chipIds"]) == 2
+        assert len(env.chips.free_chips) == 6
+        assert env.runtime.container_inspect("train-2").running
+        assert not env.runtime.container_inspect("train-1").running
+        # data came from the LATEST version (default dataFrom)
+        with open(f"{env.runtime.container_data_dir('train-2')}/ckpt.txt") as f:
+            assert f.read() == "step=200"
+
+    def test_rollback_snapshot_restore_from_target(self, env):
+        from tpu_docker_api.schemas.container import ContainerRollback
+
+        run_default(env, chips=2)
+        with open(f"{env.runtime.container_data_dir('train-0')}/ckpt.txt",
+                  "w") as f:
+            f.write("old-snapshot")
+        env.svc.patch_container_chips("train-0",
+                                      ContainerPatchChips(chip_count=4))
+        env.wq.drain()
+        # diverge the new version's data
+        with open(f"{env.runtime.container_data_dir('train-1')}/ckpt.txt",
+                  "w") as f:
+            f.write("newer")
+        out = env.svc.rollback_container(
+            "train", ContainerRollback(version=0, data_from="target"))
+        env.wq.drain()
+        with open(f"{env.runtime.container_data_dir(out['name'])}/ckpt.txt") as f:
+            assert f.read() == "old-snapshot"
+
+    def test_rollback_validation(self, env):
+        from tpu_docker_api.schemas.container import ContainerRollback
+
+        self._grow_family(env)
+        with pytest.raises(errors.NoPatchRequired):
+            env.svc.rollback_container("train", ContainerRollback(version=1))
+        with pytest.raises(errors.BadRequest):
+            env.svc.rollback_container("train", ContainerRollback(version=7))
+        with pytest.raises(errors.BadRequest):
+            env.svc.rollback_container(
+                "train", ContainerRollback(version=0, data_from="nope"))
+        # optimistic concurrency: stale versioned name refused
+        with pytest.raises(errors.VersionNotMatch):
+            env.svc.rollback_container("train-0",
+                                       ContainerRollback(version=0))
+
+    def test_rollback_is_itself_versioned(self, env):
+        """Rolling back twice keeps moving forward: rollback never mutates."""
+        from tpu_docker_api.schemas.container import ContainerRollback
+
+        self._grow_family(env)
+        env.svc.rollback_container("train", ContainerRollback(version=0))
+        env.wq.drain()
+        out = env.svc.rollback_container("train", ContainerRollback(version=1))
+        env.wq.drain()
+        assert out["name"] == "train-3"
+        assert len(out["chipIds"]) == 4
+        hist = env.svc.get_container_history("train")
+        assert [v["version"] for v in hist["versions"]] == [0, 1, 2, 3]
+
+    def test_rollback_of_stopped_family_reclaims_chips(self, env):
+        """A stopped family's chips went back to the pool (and may belong to
+        someone else); rollback must claim fresh chips through the
+        scheduler, never attach the stored spec's stale chip ids."""
+        from tpu_docker_api.schemas.container import ContainerRollback
+
+        self._grow_family(env)                   # train-1 holds chips 0-3
+        env.svc.stop_container("train")          # chips 0-3 back to pool
+        run_default(env, name="other", chips=4)  # takes (some of) them
+        out = env.svc.rollback_container("train", ContainerRollback(version=0))
+        env.wq.drain()
+        other_chips = set(
+            env.runtime.container_inspect("other-0").spec.chip_ids)
+        # no double attachment, and the scheduler knows train's new claim
+        assert not (set(out["chipIds"]) & other_chips)
+        assert set(env.chips.owned_chips("train")) == set(out["chipIds"])
+        assert len(env.chips.free_chips) == 8 - 4 - 2
+
+    def test_patch_chips_of_stopped_family_reclaims_chips(self, env):
+        """Same scheduler-truth discipline on the patch path."""
+        run_default(env, chips=2)                # train-0: chips 0,1
+        env.svc.stop_container("train")          # freed
+        run_default(env, name="other", chips=2)  # takes chips
+        out = env.svc.patch_container_chips(
+            "train", ContainerPatchChips(chip_count=3))
+        env.wq.drain()
+        other_chips = set(
+            env.runtime.container_inspect("other-0").spec.chip_ids)
+        assert not (set(out["chipIds"]) & other_chips)
+        assert set(env.chips.owned_chips("train")) == set(out["chipIds"])

@@ -124,3 +124,67 @@ class TestDeleteInfo:
     def test_missing_raises(self, env):
         with pytest.raises(errors.VolumeNotExist):
             env.svc.get_volume_info("ghost")
+
+
+class TestHistoryRollback:
+    def _resized_family(self, env):
+        """data-0 (10GB, with a file) → resize → data-1 (20GB)."""
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="10GB"))
+        env.wq.drain()
+        with open(f"{env.runtime.volume_data_dir('data-0')}/a.txt", "w") as f:
+            f.write("v0-data")
+        env.svc.patch_volume_size("data", VolumeSize(size="20GB"))
+        env.wq.drain()
+
+    def test_history(self, env):
+        self._resized_family(env)
+        hist = env.svc.get_volume_history("data")
+        assert hist["latest"] == 1
+        assert [v["size"] for v in hist["versions"]] == ["10GB", "20GB"]
+        assert all(v["inRuntime"] for v in hist["versions"])
+
+    def test_rollback_to_old_size_with_newest_data(self, env):
+        from tpu_docker_api.schemas.volume import VolumeRollback
+
+        self._resized_family(env)
+        with open(f"{env.runtime.volume_data_dir('data-1')}/a.txt", "w") as f:
+            f.write("v1-data")
+        out = env.svc.rollback_volume("data", VolumeRollback(version=0))
+        env.wq.drain()
+        assert out == {"name": "data-2", "fromVersion": 0, "size": "10GB"}
+        with open(f"{env.runtime.volume_data_dir('data-2')}/a.txt") as f:
+            assert f.read() == "v1-data"
+
+    def test_rollback_snapshot_from_target(self, env):
+        from tpu_docker_api.schemas.volume import VolumeRollback
+
+        self._resized_family(env)
+        with open(f"{env.runtime.volume_data_dir('data-1')}/a.txt", "w") as f:
+            f.write("v1-data")
+        out = env.svc.rollback_volume(
+            "data", VolumeRollback(version=0, data_from="target"))
+        env.wq.drain()
+        with open(f"{env.runtime.volume_data_dir(out['name'])}/a.txt") as f:
+            assert f.read() == "v0-data"
+
+    def test_rollback_shrink_guard(self, env):
+        from tpu_docker_api.schemas.volume import VolumeRollback
+
+        env.svc.create_volume(VolumeCreate(volume_name="data", size="1KB"))
+        env.wq.drain()
+        env.svc.patch_volume_size("data", VolumeSize(size="10GB"))
+        env.wq.drain()
+        # fill the big volume beyond the rollback target's 1KB cap
+        with open(f"{env.runtime.volume_data_dir('data-1')}/big.bin", "wb") as f:
+            f.write(b"x" * 4096)
+        with pytest.raises(errors.VolumeSizeUsedGreaterThanReduced):
+            env.svc.rollback_volume("data", VolumeRollback(version=0))
+
+    def test_rollback_validation(self, env):
+        from tpu_docker_api.schemas.volume import VolumeRollback
+
+        self._resized_family(env)
+        with pytest.raises(errors.NoPatchRequired):
+            env.svc.rollback_volume("data", VolumeRollback(version=1))
+        with pytest.raises(errors.BadRequest):
+            env.svc.rollback_volume("data", VolumeRollback(version=9))

@@ -21,10 +21,14 @@ Route table:
     POST   /api/v1/containers/{name}/stop      stop
     PATCH  /api/v1/containers/{name}/restart   restart
     POST   /api/v1/containers/{name}/commit    commit to image
+    GET    /api/v1/containers/{name}/history   stored version history
+    PATCH  /api/v1/containers/{name}/rollback  roll to an older version's spec
     POST   /api/v1/volumes                     create volume
     GET    /api/v1/volumes/{name}              info
     DELETE /api/v1/volumes/{name}              delete
     PATCH  /api/v1/volumes/{name}/size         resize
+    GET    /api/v1/volumes/{name}/history      stored version history
+    PATCH  /api/v1/volumes/{name}/rollback     roll to an older version's size
     GET    /api/v1/resources/tpus              chip scheduler view (alias: /gpus)
     GET    /api/v1/resources/ports             port scheduler view
     GET    /healthz
@@ -50,9 +54,15 @@ from tpu_docker_api.schemas.container import (
     ContainerExecute,
     ContainerPatchChips,
     ContainerPatchVolume,
+    ContainerRollback,
     ContainerRun,
 )
-from tpu_docker_api.schemas.volume import VolumeCreate, VolumeDelete, VolumeSize
+from tpu_docker_api.schemas.volume import (
+    VolumeCreate,
+    VolumeDelete,
+    VolumeRollback,
+    VolumeSize,
+)
 from tpu_docker_api.service.container import ContainerService
 from tpu_docker_api.service.volume import VolumeService
 
@@ -189,6 +199,19 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         )
         return {"imageId": image_id}
 
+    def c_history(body, name):
+        _validate_ref_name(name)
+        return container_svc.get_container_history(name)
+
+    def c_rollback(body, name):
+        _validate_ref_name(name)
+        if "version" not in body:
+            raise errors.BadRequest("version is required")
+        return container_svc.rollback_container(name, ContainerRollback(
+            version=int(body["version"]),
+            data_from=body.get("dataFrom", "latest"),
+        ))
+
     r.add("POST", "/api/v1/containers", run)
     r.add("GET", "/api/v1/containers/{name}", c_info)
     r.add("DELETE", "/api/v1/containers/{name}", c_delete)
@@ -199,6 +222,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("POST", "/api/v1/containers/{name}/stop", c_stop)
     r.add("PATCH", "/api/v1/containers/{name}/restart", c_restart)
     r.add("POST", "/api/v1/containers/{name}/commit", c_commit)
+    r.add("GET", "/api/v1/containers/{name}/history", c_history)
+    r.add("PATCH", "/api/v1/containers/{name}/rollback", c_rollback)
 
     # -- volumes (reference api/volume.go:19-28) ---------------------------------
 
@@ -228,10 +253,25 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             raise errors.BadRequest("size is required")
         return volume_svc.patch_volume_size(name, VolumeSize(size=size))
 
+    def v_history(body, name):
+        _validate_ref_name(name)
+        return volume_svc.get_volume_history(name)
+
+    def v_rollback(body, name):
+        _validate_ref_name(name)
+        if "version" not in body:
+            raise errors.BadRequest("version is required")
+        return volume_svc.rollback_volume(name, VolumeRollback(
+            version=int(body["version"]),
+            data_from=body.get("dataFrom", "latest"),
+        ))
+
     r.add("POST", "/api/v1/volumes", v_create)
     r.add("GET", "/api/v1/volumes/{name}", v_info)
     r.add("DELETE", "/api/v1/volumes/{name}", v_delete)
     r.add("PATCH", "/api/v1/volumes/{name}/size", v_patch_size)
+    r.add("GET", "/api/v1/volumes/{name}/history", v_history)
+    r.add("PATCH", "/api/v1/volumes/{name}/rollback", v_rollback)
 
     # -- distributed jobs (TPU-native addition: multi-host slices,
     #    SURVEY.md hard part #3; no reference analog) -----------------------------

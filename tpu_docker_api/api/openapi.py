@@ -95,6 +95,14 @@ _SCHEMAS: dict[str, dict] = {
         ["imageName", "jobName"]),
     "JobPatchChips": _obj({"chipCount": _INT, "acceleratorType": _STR}),
     "JobDelete": _obj({"force": _BOOL, "delStateAndVersionRecord": _BOOL}),
+    "Rollback": _obj(
+        {"version": {**_INT, "description": "stored version to roll back to"},
+         "dataFrom": {**_STR, "enum": ["latest", "target"],
+                      "default": "latest",
+                      "description": "latest = keep newest data under the old "
+                      "spec; target = snapshot restore from the retained "
+                      "retired version"}},
+        ["version"]),
 }
 
 #: (method, path, operationId, summary, request schema name | None)
@@ -124,6 +132,13 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Restart; carded containers re-apply chips via a new version", None),
     ("POST", "/api/v1/containers/{name}/commit", "commitContainer",
      "Snapshot container filesystem to an image", "ContainerCommit"),
+    ("GET", "/api/v1/containers/{name}/history", "getContainerHistory",
+     "Stored version history of the family (per-version store — the "
+     "rollback the reference advertises but cannot deliver)", None),
+    ("PATCH", "/api/v1/containers/{name}/rollback", "rollbackContainer",
+     "Roll forward to a NEW version built from an older version's spec; "
+     "data from latest or from the retained target (snapshot restore)",
+     "Rollback"),
     ("POST", "/api/v1/volumes", "createVolume",
      "Create a named, size-capped volume (overlay2/xfs analog)", "VolumeCreate"),
     ("GET", "/api/v1/volumes/{name}", "getVolumeInfo",
@@ -133,6 +148,11 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("PATCH", "/api/v1/volumes/{name}/size", "patchVolumeSize",
      "Resize via new volume + data copy; shrink below used size refused",
      "VolumeSize"),
+    ("GET", "/api/v1/volumes/{name}/history", "getVolumeHistory",
+     "Stored version history of the volume family", None),
+    ("PATCH", "/api/v1/volumes/{name}/rollback", "rollbackVolume",
+     "New version with an older version's size; data from latest or the "
+     "retained target volume", "Rollback"),
     ("POST", "/api/v1/jobs", "runJob",
      "Place a distributed JAX job: one process container per host over an "
      "ICI-contiguous slice, coordinator + TPU_PROCESS_* env rendered", "JobRun"),

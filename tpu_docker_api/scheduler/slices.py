@@ -90,6 +90,13 @@ class ChipScheduler:
         with self._mu:
             return sorted(set(self.topology.coords) - set(self._used))
 
+    def owned_chips(self, owner: str) -> list[int]:
+        """Chips currently claimed by ``owner`` — the allocation truth the
+        container service checks before reusing a stored spec's chip list
+        (a stopped container's chips were already returned to the pool)."""
+        with self._mu:
+            return sorted(c for c, o in self._used.items() if o == owner)
+
     def status(self) -> dict:
         """Resource view for GET /resources/tpus (reference GetGpusStatus,
         scheduler.go:107-112 — but a snapshot, not the live map)."""

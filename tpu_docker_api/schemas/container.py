@@ -102,3 +102,12 @@ class ContainerStop:
 class ContainerCommit:
     """POST /containers/{name}/commit body (model/container.go ContainerCommit)."""
     new_image_name: str = ""
+
+
+@dataclasses.dataclass
+class ContainerRollback:
+    """PATCH /containers/{name}/rollback body. No reference analog — its
+    README advertises version rollback (README.md:142-144) but the
+    latest-wins etcd layout cannot deliver it (SURVEY.md appendix)."""
+    version: int
+    data_from: str = "latest"  # "latest" (keep newest data) | "target" (snapshot restore)

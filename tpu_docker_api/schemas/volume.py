@@ -44,3 +44,10 @@ def parse_size(size: str) -> int:
             # multiply before int() so fractional sizes ("1.5GB") keep precision
             return int(float(s[: -len(unit)]) * mult)
     raise ValueError(f"size {size!r} must end with one of {list(VOLUME_SIZE_UNITS)}")
+
+
+@dataclasses.dataclass
+class VolumeRollback:
+    """PATCH /volumes/{name}/rollback body (see ContainerRollback)."""
+    version: int
+    data_from: str = "latest"

@@ -43,6 +43,7 @@ from tpu_docker_api.schemas.container import (
     ContainerExecute,
     ContainerPatchChips,
     ContainerPatchVolume,
+    ContainerRollback,
     ContainerRun,
     ContainerStop,
 )
@@ -107,6 +108,33 @@ class ContainerService:
 
     def _resolve_latest(self, name: str) -> tuple[str, int, str]:
         return resolve_latest(self.versions, name)
+
+    def _adjust_chip_allocation(
+        self, base: str, cur_spec: ContainerSpec, want: int,
+    ) -> tuple[list[int], list[int], list[int], bool]:
+        """(new_chips, extra, to_release, contiguous): adjust the family's
+        LIVE chip claim to ``want`` chips. The claim is the scheduler's
+        ownership map, NOT the stored spec — a stopped container's chips
+        were already returned on stop and may belong to someone else now,
+        so only the intersection is reusable; the rest is re-applied.
+        ``extra`` must be restored by the caller if the replacement fails;
+        ``to_release`` is freed only after the replacement exists."""
+        owned = set(self.chips.owned_chips(base))
+        held = sorted(c for c in cur_spec.chip_ids if c in owned)
+        held_all = len(held) == len(cur_spec.chip_ids)
+        to_release: list[int] = []
+        extra: list[int] = []
+        if want > len(held):
+            extra, extra_contig = self.chips.apply_chips(
+                want - len(held), owner=base)
+            new_chips = sorted(held + extra)
+            contiguous = extra_contig if not held else (
+                cur_spec.ici_contiguous and held_all and extra_contig)
+        else:
+            new_chips = held[:want]
+            to_release = held[want:]
+            contiguous = cur_spec.ici_contiguous and held_all
+        return new_chips, extra, to_release, contiguous
 
     def _family_runtime_members(self, base: str) -> list[str]:
         """Every version of ``base`` present in the runtime (old retired
@@ -240,20 +268,11 @@ class ContainerService:
             if want < 0:
                 raise errors.BadRequest("chipCount must be >= 0")
 
-            to_release: list[int] = []
-            extra: list[int] = []
-            if want > len(current):  # grow (reference :211-229)
-                extra, contiguous = self.chips.apply_chips(
-                    want - len(current), owner=base
-                )
-                new_chips = sorted(current + extra)
-                contiguous = contiguous and spec.ici_contiguous
-            else:  # shrink (reference :230-246): release only AFTER the
-                # replacement exists, so a failed replace leaves the old
-                # container's chips untouched
-                new_chips = sorted(current)[: want]
-                to_release = sorted(current)[want:]
-                contiguous = spec.ici_contiguous
+            # grow (reference :211-229) / shrink (reference :230-246);
+            # shrink releases only AFTER the replacement exists, so a failed
+            # replace leaves the old container's chips untouched
+            new_chips, extra, to_release, contiguous = (
+                self._adjust_chip_allocation(base, spec, want))
             try:
                 render_tpu_attachment(
                     spec, new_chips, self.chips.topology,
@@ -427,14 +446,97 @@ class ContainerService:
             pass
         return out
 
+    # -- 10. history / rollback (no working reference analog: README.md:142-144
+    # advertises version rollback but the reference's latest-wins etcd layout
+    # cannot deliver it, SURVEY.md appendix; the per-version store here can) --
+
+    def get_container_history(self, name: str) -> dict:
+        """Every stored version of the family, oldest first — the material
+        rollback chooses from."""
+        base, _ = split_versioned_name(name)
+        latest = self.versions.get(base)
+        if latest is None:
+            raise errors.ContainerNotExist(name)
+        out = []
+        for v in self.store.history(Resource.CONTAINERS, base):
+            vname = versioned_name(base, v)
+            entry = {"name": vname, "version": v, "latest": v == latest,
+                     "inRuntime": self.runtime.container_exists(vname)}
+            try:
+                st = self.store.get_container(vname)
+                spec = ContainerSpec.from_dict(st.spec)
+                entry.update(image=spec.image, chipCount=len(spec.chip_ids),
+                             binds=list(spec.binds))
+            except errors.NotExistInStore:
+                pass
+            out.append(entry)
+        return {"base": base, "latest": latest, "versions": out}
+
+    def rollback_container(self, name: str, req: ContainerRollback) -> dict:
+        """Roll the family forward to a NEW version built from an older
+        version's spec (K8s-revision style — rollback is itself versioned,
+        never a mutation). Chips are re-derived from the current allocation
+        (grown/shrunk through the scheduler to the target's count); data
+        migrates from the latest container, or from the retired target
+        container itself with ``dataFrom="target"`` (snapshot restore —
+        retired versions are kept stopped precisely for this)."""
+        base, version, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            base, version, latest_name = self._resolve_latest(name)
+            target = req.version
+            if target == version:
+                raise errors.NoPatchRequired(
+                    f"{latest_name} is already version {target}")
+            if target not in self.store.history(Resource.CONTAINERS, base):
+                raise errors.BadRequest(
+                    f"version {target} of {base} is not in the stored history")
+            target_name = versioned_name(base, target)
+            new_spec = ContainerSpec.from_dict(
+                self.store.get_container(target_name).spec)
+            cur_spec = ContainerSpec.from_dict(
+                self.store.get_container(latest_name).spec)
+
+            copy_from = latest_name
+            if req.data_from == "target":
+                if not self.runtime.container_exists(target_name):
+                    raise errors.BadRequest(
+                        f"dataFrom=target but {target_name} is gone from the "
+                        "runtime")
+                copy_from = target_name
+            elif req.data_from != "latest":
+                raise errors.BadRequest(
+                    f"dataFrom must be 'latest' or 'target', got {req.data_from!r}")
+
+            # adjust the LIVE chip allocation (scheduler ownership, not the
+            # stored spec) to the target spec's count — shared discipline
+            # with patch_container_chips
+            new_chips, extra, to_release, contiguous = (
+                self._adjust_chip_allocation(
+                    base, cur_spec, len(new_spec.chip_ids)))
+            try:
+                render_tpu_attachment(
+                    new_spec, new_chips, self.chips.topology,
+                    ici_contiguous=contiguous, libtpu_path=self.libtpu_path,
+                )
+                new_name = self._rolling_replace(
+                    base, latest_name, new_spec, copy_from=copy_from)
+            except Exception:
+                self.chips.restore_chips(extra, owner=base)
+                raise
+            self.chips.restore_chips(to_release, owner=base)
+            log.info("rolled back %s to spec of v%d as %s (data from %s)",
+                     latest_name, target, new_name, copy_from)
+            return {"name": new_name, "fromVersion": target,
+                    "chipIds": new_chips}
+
     # -- rolling replacement core -------------------------------------------------
 
     def _rolling_replace(
         self, base: str, old_name: str, new_spec: ContainerSpec,
-        old_running: bool = True,
+        old_running: bool = True, copy_from: str | None = None,
     ) -> str:
         """Create ``base-(n+1)`` from ``new_spec``, migrate data from
-        ``old_name``, start the replacement.
+        ``copy_from`` (default: ``old_name``), start the replacement.
 
         Fixed sequencing (SURVEY.md §5.4): quiesce the old container first,
         then copy, and only then start the new one — ordered on the work
@@ -443,6 +545,7 @@ class ContainerService:
         name immediately; `GET /containers/{name}` shows runtime state while
         the migration completes.
         """
+        copy_from = copy_from or old_name
         for pb in new_spec.port_bindings:
             pb.host_port = 0  # fresh host ports for the new version (reference :489-501)
         new_name = self._run_new_version(base, new_spec, start_now=False)
@@ -468,14 +571,14 @@ class ContainerService:
 
         def _compensate() -> None:
             log.error("data migration %s -> %s dead-lettered; restarting old "
-                      "container", old_name, new_name)
+                      "container", copy_from, new_name)
             with contextlib.suppress(Exception):
                 self.runtime.container_start(old_name)
 
-        if self.runtime.container_exists(old_name):
+        if self.runtime.container_exists(copy_from):
             self.wq.submit(CopyTask(
                 resource="containers",
-                old_name=old_name,
+                old_name=copy_from,
                 new_name=new_name,
                 resolve=_resolve,
                 on_done=_start_new,

@@ -16,7 +16,13 @@ import threading
 from tpu_docker_api import errors
 from tpu_docker_api.runtime.base import ContainerRuntime
 from tpu_docker_api.schemas.state import VolumeState
-from tpu_docker_api.schemas.volume import VolumeCreate, VolumeDelete, VolumeSize, parse_size
+from tpu_docker_api.schemas.volume import (
+    VolumeCreate,
+    VolumeDelete,
+    VolumeRollback,
+    VolumeSize,
+    parse_size,
+)
 from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
@@ -141,6 +147,82 @@ class VolumeService:
         ))
         log.info("resized volume %s -> %s (%s)", latest_name, new_name, req.size)
         return {"name": new_name, "size": req.size}
+
+    # -- history / rollback (no working reference analog — README.md:142-144
+    # advertises rollback, the latest-wins etcd layout can't deliver it) ----------
+
+    def get_volume_history(self, name: str) -> dict:
+        base, _ = split_versioned_name(name)
+        latest = self.versions.get(base)
+        if latest is None:
+            raise errors.VolumeNotExist(name)
+        out = []
+        for v in self.store.history(Resource.VOLUMES, base):
+            vname = versioned_name(base, v)
+            entry = {"name": vname, "version": v, "latest": v == latest}
+            try:
+                self.runtime.volume_inspect(vname)
+                entry["inRuntime"] = True
+            except errors.VolumeNotExist:
+                entry["inRuntime"] = False
+            with contextlib.suppress(errors.NotExistInStore):
+                entry["size"] = self.store.get_volume(vname).size
+            out.append(entry)
+        return {"base": base, "latest": latest, "versions": out}
+
+    def rollback_volume(self, name: str, req: VolumeRollback) -> dict:
+        """New version with the target version's size; data copies from the
+        latest volume (default) or from the retained target volume itself
+        (``dataFrom="target"`` — snapshot restore). The shrink guard applies
+        to whichever source is copied."""
+        base, version, latest_name = self._resolve_latest(name)
+        with self._hold(base):
+            base, version, latest_name = self._resolve_latest(name)
+            target = req.version
+            if target == version:
+                raise errors.NoPatchRequired(
+                    f"{latest_name} is already version {target}")
+            if target not in self.store.history(Resource.VOLUMES, base):
+                raise errors.BadRequest(
+                    f"version {target} of {base} is not in the stored history")
+            target_name = versioned_name(base, target)
+            t_state = self.store.get_volume(target_name)
+
+            src_name = latest_name
+            if req.data_from == "target":
+                try:
+                    self.runtime.volume_inspect(target_name)
+                except errors.VolumeNotExist:
+                    raise errors.BadRequest(
+                        f"dataFrom=target but {target_name} is gone from the "
+                        "runtime") from None
+                src_name = target_name
+            elif req.data_from != "latest":
+                raise errors.BadRequest(
+                    f"dataFrom must be 'latest' or 'target', got {req.data_from!r}")
+
+            if t_state.size:
+                used = dir_size(self.runtime.volume_data_dir(src_name))
+                if used > parse_size(t_state.size):
+                    raise errors.VolumeSizeUsedGreaterThanReduced(
+                        f"{src_name}: {used} bytes in use > rollback target "
+                        f"size {t_state.size}")
+
+            new_name = self._create_version(base, t_state.size)
+
+            def _resolve(n: str) -> str:
+                return self.runtime.volume_data_dir(n)
+
+            self.wq.submit(CopyTask(
+                resource="volumes",
+                old_name=src_name,
+                new_name=new_name,
+                resolve=_resolve,
+            ))
+            log.info("rolled back volume %s to v%d as %s (data from %s)",
+                     latest_name, target, new_name, src_name)
+            return {"name": new_name, "fromVersion": target,
+                    "size": t_state.size}
 
     # -- info (GET /volumes/{name}; reference GetVolumeInfo :189-199) -------------
 
