@@ -102,3 +102,29 @@ class TestResumeOrInit:
                 mgr.save(state, step=s)
                 mgr.wait()
             assert mgr.all_steps() == [2, 3]
+
+
+class TestInt8OptimizerState:
+    def test_save_restore_int8_moments(self, tmp_path):
+        """orbax round-trip of the 8-bit optimizer state: int8 moment leaves
+        and (segs, bpseg, rows) f32 scales restore exactly, and training
+        continues from the restored state (the --optim adamw-int8 +
+        --ckpt-dir CLI combination)."""
+        from tpu_docker_api.train.optim import adamw_int8
+
+        cfg = tiny_cfg()
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+        opt = adamw_int8(lr=1e-2)
+        state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0), opt)
+        step = make_train_step(cfg, mesh, opt)
+        tokens = synthetic_batch(jax.random.PRNGKey(1), 4, 16, cfg.vocab_size)
+        state, _ = step(state, tokens)
+
+        with CheckpointManager(tmp_path / "ckpt") as mgr:
+            assert mgr.save(state)
+            mgr.wait()
+            restored = mgr.restore(cfg, mesh, opt)
+        params_equal(restored.params, state.params)
+        params_equal(restored.opt_state, state.opt_state)
+        restored, metrics = step(restored, tokens)
+        assert np.isfinite(float(metrics["loss"]))

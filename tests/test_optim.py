@@ -1,0 +1,150 @@
+"""8-bit Adam moments (train/optim.py): quantization round-trip, training
+behavior vs full-precision adamw, and sharded init on a virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_docker_api.train.optim import (
+    ScaleByAdamInt8State,
+    _dequant_signed,
+    _dequant_sqrt,
+    _quant_signed,
+    _quant_sqrt,
+    adamw_int8,
+)
+
+
+class TestQuantRoundTrip:
+    def test_signed_blockwise(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 512)) * 3.0
+        q, s = _quant_signed(x, 256)
+        assert q.shape == x.shape and q.dtype == jnp.int8
+        # scales are (segments, blocks_per_segment, rows): rows on lanes
+        assert s.shape == (1, 2, 4)
+        back = _dequant_signed(q, s)
+        # worst-case linear-quant error: blockmax/127 per element
+        bound = (np.repeat(np.asarray(s[0]).T.reshape(-1), 256) * 0.5
+                 + 1e-7).reshape(x.shape)
+        assert (np.abs(np.asarray(back - x)) <= bound).all()
+
+    def test_sqrt_domain_nonneg(self):
+        # nu-like data: positive, several decades of dynamic range
+        x = jnp.exp(jax.random.normal(jax.random.PRNGKey(1), (2, 256)) * 3)
+        q, s = _quant_sqrt(x, 256)
+        back = _dequant_sqrt(q, s)
+        assert (np.asarray(back) >= 0).all()
+        # block-max elements are represented to <1% relative error
+        xb = np.asarray(x).reshape(2, 1, 256)
+        mx = xb.max(axis=-1)
+        bk = np.asarray(back).reshape(2, 1, 256).max(axis=-1)
+        np.testing.assert_allclose(bk, mx, rtol=2e-2)
+
+    def test_odd_last_dim_falls_back_to_divisor(self):
+        x = jax.random.normal(jax.random.PRNGKey(2), (3, 100))
+        q, s = _quant_signed(x, 256)  # 100 < 256 → one block of 100 per row
+        assert s.shape == (1, 1, 3)
+        q2, s2 = _quant_signed(jax.random.normal(jax.random.PRNGKey(3), (330,)), 256)
+        assert s2.shape[2] == 1 and 330 % (s2.shape[0] * s2.shape[1]) == 0
+
+
+class TestAdamWInt8:
+    def test_moments_are_int8(self):
+        params = {"w": jnp.ones((8, 256), jnp.bfloat16)}
+        opt = adamw_int8()
+        st = opt.init(params)
+        inner = [s for s in jax.tree_util.tree_leaves(
+            st, is_leaf=lambda x: isinstance(x, ScaleByAdamInt8State))
+            if isinstance(s, ScaleByAdamInt8State)][0]
+        assert inner.mu_q["w"].dtype == jnp.int8
+        assert inner.nu_q["w"].dtype == jnp.int8
+        assert inner.mu_scale["w"].shape == (1, 1, 8)
+
+    def test_trains_tiny_llama_like_adamw(self):
+        """int8 moments must train the tiny model to (nearly) the same loss
+        as full-precision adamw — the 8-bit-Adam claim, checked end-to-end."""
+        from tpu_docker_api.models.llama import llama_init, llama_loss, llama_presets
+        from tpu_docker_api.train.trainer import default_optimizer
+
+        cfg = llama_presets()["tiny"]
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0,
+                                    cfg.vocab_size, dtype="int32")
+
+        def train(opt, steps=30):
+            params = llama_init(cfg, jax.random.PRNGKey(0))
+            st = opt.init(params)
+
+            @jax.jit
+            def step(params, st):
+                loss, g = jax.value_and_grad(
+                    lambda p: llama_loss(p, tokens, cfg))(params)
+                upd, st = opt.update(g, st, params)
+                return jax.tree_util.tree_map(
+                    lambda p, u: (p.astype(jnp.float32)
+                                  + u.astype(jnp.float32)).astype(p.dtype),
+                    params, upd), st, loss
+
+            for _ in range(steps):
+                params, st, loss = step(params, st)
+            return float(loss)
+
+        l_int8 = train(adamw_int8(lr=1e-2))
+        l_ref = train(default_optimizer(lr=1e-2))
+        l0 = float(jnp.log(jnp.float32(llama_presets()["tiny"].vocab_size)))
+        # both optimizers make real progress, and int8 tracks full precision
+        assert l_int8 < l0 * 0.8
+        assert l_int8 < l_ref * 1.25
+
+    def test_pallas_kernel_matches_xla_path(self):
+        """The TPU Pallas update kernel (interpret mode here) must produce
+        the same updates and quantized state as the pure-XLA reference."""
+        from tpu_docker_api.train.optim import scale_by_adam_int8
+
+        params = {
+            "w": jax.random.normal(jax.random.PRNGKey(0), (8, 512),
+                                   jnp.bfloat16),
+            "b": jax.random.normal(jax.random.PRNGKey(1), (96,), jnp.float32),
+        }
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.random.normal(jax.random.PRNGKey(2), p.shape,
+                                        p.dtype), params)
+        for step in range(3):
+            if step == 0:
+                st_x = scale_by_adam_int8(impl="xla").init(params)
+                st_p = st_x
+            ux, st_x = scale_by_adam_int8(impl="xla").update(grads, st_x)
+            up, st_p = scale_by_adam_int8(
+                impl="pallas_interpret").update(grads, st_p)
+            for (pa, lx), (_, lp) in zip(
+                jax.tree_util.tree_leaves_with_path(ux),
+                jax.tree_util.tree_leaves_with_path(up),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(lx, np.float32), np.asarray(lp, np.float32),
+                    rtol=1e-5, atol=1e-6, err_msg=f"step {step} {pa}")
+            np.testing.assert_array_equal(
+                np.asarray(st_x.mu_q["w"]), np.asarray(st_p.mu_q["w"]))
+            np.testing.assert_array_equal(
+                np.asarray(st_x.nu_q["w"]), np.asarray(st_p.nu_q["w"]))
+
+    def test_sharded_init_on_mesh(self):
+        """create_train_state with int8 moments under fsdp/tp: int8 moment
+        leaves inherit the param specs (same shapes), quantization scales
+        replicate (different shapes) — the _opt_shardings shape check."""
+        from tpu_docker_api.models.llama import llama_presets
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+        from tpu_docker_api.train.trainer import (
+            create_train_state,
+            make_train_step,
+            synthetic_batch,
+        )
+
+        cfg = llama_presets()["tiny"]
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+        state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0),
+                                        optimizer=adamw_int8())
+        step_fn = make_train_step(cfg, mesh, opt)
+        tokens = synthetic_batch(jax.random.PRNGKey(1), 8, 64, cfg.vocab_size)
+        state, metrics = step_fn(state, tokens)
+        assert np.isfinite(float(metrics["loss"]))

@@ -447,3 +447,32 @@ class TestHistoryRollback:
             env.runtime.container_inspect("other-0").spec.chip_ids)
         assert not (set(out["chipIds"]) & other_chips)
         assert set(env.chips.owned_chips("train")) == set(out["chipIds"])
+
+    def test_rollback_stopped_family_keeps_old_stopped_and_ports_clean(self, env):
+        """Rolling back a STOPPED family must not run the quiesce branch
+        (its ports were already returned on stop) and must not restart the
+        deliberately-stopped old container."""
+        from tpu_docker_api.schemas.container import ContainerRollback
+
+        run_default(env, chips=2,
+                    container_ports=[ContainerPort(container_port=8888)])
+        env.svc.patch_container_chips("train",
+                                      ContainerPatchChips(chip_count=1))
+        env.wq.drain()
+        env.svc.stop_container("train")   # train-1 stopped, ports freed
+        before = env.ports.status()["usedCount"]
+        out = env.svc.rollback_container("train", ContainerRollback(version=0))
+        env.wq.drain()
+        # old stays stopped; new running; exactly the new version's port set
+        # is allocated (no double-free, no leak)
+        assert not env.runtime.container_inspect("train-1").running
+        assert env.runtime.container_inspect(out["name"]).running
+        assert env.ports.status()["usedCount"] == before + 1
+        # train-1's only start predates its deliberate stop — the rollback
+        # flow never restarted it
+        calls = env.runtime.calls
+        last_start = max(i for i, c in enumerate(calls)
+                         if c == ("start", "train-1"))
+        stop_idx = max(i for i, c in enumerate(calls)
+                       if c == ("stop", "train-1"))
+        assert last_start < stop_idx

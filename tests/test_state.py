@@ -222,3 +222,19 @@ class TestEtcdKVHelpers:
 
         assert _prefix_end("/a/") == "/a0"  # '/' + 1 == '0'
         assert _prefix_end("ab") == "ac"
+
+
+class TestHistoryOrdering:
+    def test_history_numeric_order_past_ten_versions(self):
+        """KV prefix scans are lexicographic (v/10 < v/2); history() must
+        sort numerically — the rollback endpoints expose this ordering."""
+        from tpu_docker_api.schemas.state import VolumeState
+        from tpu_docker_api.state.keys import Resource
+        from tpu_docker_api.state.kv import MemoryKV
+        from tpu_docker_api.state.store import StateStore
+
+        store = StateStore(MemoryKV())
+        for v in range(12):
+            store.put_volume(VolumeState(
+                volume_name=f"d-{v}", version=v, size="1GB", driver_opts={}))
+        assert store.history(Resource.VOLUMES, "d") == list(range(12))

@@ -513,13 +513,23 @@ class ContainerService:
             new_chips, extra, to_release, contiguous = (
                 self._adjust_chip_allocation(
                     base, cur_spec, len(new_spec.chip_ids)))
+            # a STOPPED latest must not be quiesced (its ports were already
+            # returned on stop) nor restarted by copy-failure compensation
+            # (it was stopped deliberately) — same state check as restart
+            latest_running = False
+            try:
+                latest_running = self.runtime.container_inspect(
+                    latest_name).running
+            except errors.ContainerNotExist:
+                pass
             try:
                 render_tpu_attachment(
                     new_spec, new_chips, self.chips.topology,
                     ici_contiguous=contiguous, libtpu_path=self.libtpu_path,
                 )
                 new_name = self._rolling_replace(
-                    base, latest_name, new_spec, copy_from=copy_from)
+                    base, latest_name, new_spec, old_running=latest_running,
+                    copy_from=copy_from)
             except Exception:
                 self.chips.restore_chips(extra, owner=base)
                 raise
@@ -546,6 +556,9 @@ class ContainerService:
         the migration completes.
         """
         copy_from = copy_from or old_name
+        # compensation may only restart a container this flow stopped — a
+        # latest that was ALREADY stopped stays stopped on copy failure
+        restart_old_on_fail = old_running
         for pb in new_spec.port_bindings:
             pb.host_port = 0  # fresh host ports for the new version (reference :489-501)
         new_name = self._run_new_version(base, new_spec, start_now=False)
@@ -570,10 +583,13 @@ class ContainerService:
             log.info("rolling replace %s -> %s complete", old_name, new_name)
 
         def _compensate() -> None:
-            log.error("data migration %s -> %s dead-lettered; restarting old "
-                      "container", copy_from, new_name)
-            with contextlib.suppress(Exception):
-                self.runtime.container_start(old_name)
+            log.error("data migration %s -> %s dead-lettered%s", copy_from,
+                      new_name,
+                      "; restarting old container" if restart_old_on_fail
+                      else "")
+            if restart_old_on_fail:
+                with contextlib.suppress(Exception):
+                    self.runtime.container_start(old_name)
 
         if self.runtime.container_exists(copy_from):
             self.wq.submit(CopyTask(

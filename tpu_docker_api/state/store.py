@@ -44,8 +44,11 @@ class StateStore:
         return None if raw is None else int(raw)
 
     def history(self, resource: Resource, base: str) -> list[int]:
+        """Stored versions, oldest first — sorted numerically (KV prefix
+        scans return keys lexicographically, which puts v10 before v2)."""
         prefix = f"{keys.PREFIX}/{resource.value}/{base}/v/"
-        return [int(k.rsplit("/", 1)[1]) for k in self.kv.range_prefix(prefix)]
+        return sorted(
+            int(k.rsplit("/", 1)[1]) for k in self.kv.range_prefix(prefix))
 
     def delete_family(self, resource: Resource, name: str) -> None:
         """Drop every version + the latest pointer (delEtcdInfo semantics)."""

@@ -56,6 +56,9 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--optim", default="adamw", choices=["adamw", "adamw-int8"],
+                   help="adamw-int8 stores both Adam moments as blockwise "
+                        "int8 (halves optimizer HBM)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace (TensorBoard/Perfetto "
                         "format) covering post-compile steps")
@@ -96,11 +99,17 @@ def main(argv: list[str] | None = None) -> None:
     mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
                                sp=args.sp, pp=args.pp, ep=args.ep))
     key = jax.random.PRNGKey(0)
+    opt = None
+    if args.optim == "adamw-int8":
+        from tpu_docker_api.train.optim import adamw_int8
+
+        opt = adamw_int8()
     mgr = None
     if args.ckpt_dir:
-        state, optimizer, mgr = resume_or_init(args.ckpt_dir, cfg, mesh, key)
+        state, optimizer, mgr = resume_or_init(args.ckpt_dir, cfg, mesh, key,
+                                               optimizer=opt)
     else:
-        state, optimizer = create_train_state(cfg, mesh, key)
+        state, optimizer = create_train_state(cfg, mesh, key, optimizer=opt)
     step_fn = make_train_step(cfg, mesh, optimizer)
     start_step = int(state.step)
 

@@ -80,10 +80,14 @@ def create_train_state(
 def _opt_shardings(optimizer, abstract_params, mesh: Mesh, rules=None,
                    param_sh=None, abstract_opt=None):
     """Optimizer-state shardings: any subtree with the params' structure
-    (adam mu/nu) reuses the param shardings; everything else (step counts)
-    replicates. Walks optax's NamedTuple states recursively. Callers that
-    already traced ``param_sh``/``abstract_opt`` pass them in to skip the
-    re-trace (train/checkpoint.py restores)."""
+    (adam mu/nu) reuses the param shardings — leaf by leaf, only where the
+    leaf's shape matches the param's (int8 moments keep the param shape and
+    inherit its spec; blockwise quantization SCALES share the tree structure
+    but not the shapes, and replicate — they are ~1.6% of the moment bytes).
+    Everything else (step counts) replicates. Walks optax's NamedTuple
+    states recursively. Callers that already traced
+    ``param_sh``/``abstract_opt`` pass them in to skip the re-trace
+    (train/checkpoint.py restores)."""
     if param_sh is None:
         param_sh = param_shardings(abstract_params, mesh, rules)
     param_def = jax.tree_util.tree_structure(abstract_params)
@@ -93,7 +97,10 @@ def _opt_shardings(optimizer, abstract_params, mesh: Mesh, rules=None,
 
     def assign(node):
         if jax.tree_util.tree_structure(node) == param_def:
-            return param_sh
+            return jax.tree_util.tree_map(
+                lambda sh, pl, ol: sh if ol.shape == pl.shape else replicated,
+                param_sh, abstract_params, node,
+            )
         if isinstance(node, tuple):
             rebuilt = (assign(x) for x in node)
             return type(node)(*rebuilt) if hasattr(node, "_fields") else tuple(rebuilt)
