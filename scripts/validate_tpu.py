@@ -5,7 +5,9 @@ real Mosaic-compiled kernels, the bf16 MXU paths, and HBM-scale shapes are
 exercised here instead. Run on any machine with a TPU attached:
 
     python scripts/validate_tpu.py            # all checks
-    python scripts/validate_tpu.py --fast     # skip the long-seq sweep
+    python scripts/validate_tpu.py --fast     # skip the long-running checks
+                                              # (32k sweep, 8k chunked-CE
+                                              # train, speculative mechanism)
 
 Prints one JSON line per check; exits non-zero on any failure.
 """
@@ -97,33 +99,104 @@ def check_long_context() -> bool:
                  wall_s=round(time.perf_counter() - t0, 1))
 
 
-def check_train_step() -> bool:
+def _bench_train(name: str, cfg, batch: int, seq: int, n: int) -> bool:
+    """Shared train-step bench harness: build, 2-step compile+warmup, timed
+    loop with a host read forcing real completion, one JSON line."""
+    import math
+
     import jax
 
-    from tpu_docker_api.models.llama import llama_presets
     from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
     from tpu_docker_api.train.trainer import (
         create_train_state, make_train_step, synthetic_batch)
 
-    cfg = llama_presets()["bench-350m"]
     mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
                       devices=jax.devices()[:1])
     state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
     step = make_train_step(cfg, mesh, opt)
-    tokens = synthetic_batch(jax.random.PRNGKey(1), 8, 2048, cfg.vocab_size)
+    tokens = synthetic_batch(jax.random.PRNGKey(1), batch, seq,
+                             cfg.vocab_size)
     for _ in range(2):
         state, metrics = step(state, tokens)
     float(metrics["loss"])  # host read: force real completion
     t0 = time.perf_counter()
-    n = 4
     for _ in range(n):
         state, metrics = step(state, tokens)
     loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
-    tok_s = n * 8 * 2048 / dt
-    import math
-    return _emit("train_step_350m", math.isfinite(loss),
-                 tokens_per_sec=round(tok_s), loss=round(loss, 3))
+    return _emit(name, math.isfinite(loss),
+                 tokens_per_sec=round(n * batch * seq / dt),
+                 loss=round(loss, 3))
+
+
+def check_train_step() -> bool:
+    from tpu_docker_api.models.llama import llama_presets
+
+    return _bench_train("train_step_350m", llama_presets()["bench-350m"],
+                        batch=8, seq=2048, n=4)
+
+
+def check_long_seq_train() -> bool:
+    """seq-8192 llama3-1b training on one 16GB chip — only fits through the
+    chunked-CE loss (ops/xent.py; dense logits alone would need ~8.4GB)."""
+    import dataclasses
+
+    from tpu_docker_api.models.llama import llama_presets
+
+    return _bench_train(
+        "long_seq_train_8k_chunked_ce",
+        dataclasses.replace(llama_presets()["llama3-1b"],
+                            loss_chunk_rows=512),
+        batch=1, seq=8192, n=3)
+
+
+def check_speculative_mechanism() -> bool:
+    """Speculative decoding on hardware with the TARGET as its own draft:
+    near-total acceptance (rounds << tokens) proves the propose/verify/
+    rollback machinery end-to-end, and the latency should roughly MATCH
+    plain decode — with an equal-size draft both paths are bound by the
+    same weight reads (k drafts + 1 verify ~ k+1 single steps), so ~1.0x
+    here is correct; realized speedup needs a genuinely smaller trained
+    draft (infer/speculative.py docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
+    from tpu_docker_api.infer.speculative import (
+        SpeculativeConfig, make_speculative_generate_fn)
+    from tpu_docker_api.models.llama import llama_init, llama_presets
+
+    cfg = llama_presets()["bench-350m"]
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 64), 0,
+                                cfg.vocab_size, dtype="int32")
+    n = 128
+
+    def best(fn, *a):
+        out = fn(*a)
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*a)
+            int(jnp.sum(out["tokens"]))  # force the full program
+            ts.append(time.perf_counter() - t0)
+        return out, min(ts)
+
+    plain = make_generate_fn(
+        cfg, GenerateConfig(max_new_tokens=n, temperature=0.0, max_seq=512))
+    _, t_plain = best(plain, params, prompt, jax.random.PRNGKey(2))
+
+    spec_fn = make_speculative_generate_fn(
+        cfg, cfg, SpeculativeConfig(max_new_tokens=n, n_speculative=4,
+                                    max_seq=512))
+    res, t_spec = best(spec_fn, params, params, prompt)
+    rounds = int(res["rounds"])
+
+    return _emit("speculative_selfdraft_mechanism", rounds < n // 2,
+                 rounds=rounds, new_tokens=n,
+                 plain_ms=round(t_plain * 1e3, 1),
+                 spec_ms=round(t_spec * 1e3, 1))
 
 
 def check_inference() -> bool:
@@ -173,13 +246,17 @@ def check_inference() -> bool:
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--fast", action="store_true",
-                        help="skip the 32k long-context sweep")
+                        help="skip the long-running checks (32k "
+                             "long-context sweep, seq-8192 chunked-CE "
+                             "train, speculative mechanism)")
     args = parser.parse_args()
 
     checks = [check_device, check_flash_correctness, check_train_step,
               check_inference]
     if not args.fast:
         checks.insert(2, check_long_context)
+        checks.insert(4, check_long_seq_train)
+        checks.append(check_speculative_mechanism)
     ok = True
     for check in checks:
         try:
