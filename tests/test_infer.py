@@ -30,9 +30,28 @@ def tiny():
     return cfg, params
 
 
+# The cached path applies rope in f32 (cross-lowering bit-determinism for
+# serving) while the training forward applies it in the storage dtype (~3%
+# faster step, ops/rope.py). The cache-MECHANICS gates below therefore run
+# an f32-dtype model, where the two applications coincide and the tight
+# 2e-4 tolerance still catches off-by-one positions / stale-slot bugs; the
+# bf16 model's cached-vs-full agreement is only at bf16 noise and is
+# covered by the serving-internal exactness tests (sharded==plain
+# generate, speculative verify).
+
+
+@pytest.fixture(scope="module")
+def tiny_f32():
+    import dataclasses
+
+    cfg = dataclasses.replace(llama_presets()["tiny"], dtype=jnp.float32)
+    params = llama_init(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
 class TestCachedForward:
-    def test_prefill_matches_full_forward(self, tiny):
-        cfg, params = tiny
+    def test_prefill_matches_full_forward(self, tiny_f32):
+        cfg, params = tiny_f32
         tokens = jax.random.randint(
             jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size, dtype=jnp.int32
         )
@@ -44,10 +63,10 @@ class TestCachedForward:
         np.testing.assert_allclose(np.asarray(full), np.asarray(cached),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_incremental_decode_matches_full_forward(self, tiny):
+    def test_incremental_decode_matches_full_forward(self, tiny_f32):
         """Prefill s tokens then decode 4 one at a time; each step's logits
         must equal the full-forward logits at that position."""
-        cfg, params = tiny
+        cfg, params = tiny_f32
         total, prefill_len = 12, 8
         tokens = jax.random.randint(
             jax.random.PRNGKey(2), (2, total), 0, cfg.vocab_size,

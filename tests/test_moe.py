@@ -127,7 +127,10 @@ class TestMoEInference:
         from tpu_docker_api.infer.engine import init_kv_cache
         from tpu_docker_api.models.moe import moe_forward_cached
 
-        cfg = tiny_cfg()
+        # f32 model: the training forward applies rope in the storage dtype
+        # while the cached path applies it in f32 (ops/rope.py); with an f32
+        # model both coincide, so this stays a TIGHT cache-mechanics gate
+        cfg = tiny_cfg(dtype=jnp.float32)
         params = moe_init(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
                                     cfg.vocab_size, dtype=jnp.int32)

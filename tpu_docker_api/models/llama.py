@@ -5,8 +5,10 @@ Design choices (vs a torch-style port):
 - **Stacked layers + lax.scan**: all per-layer weights carry a leading
   ``n_layers`` dim and the forward scans over them — compile time is O(1) in
   depth and remat policy applies uniformly (MaxText-style).
-- **bf16 params/activations, f32 where it matters**: norms, softmax, rope and
-  the final logits run in f32; matmuls feed the MXU in bf16.
+- **bf16 params/activations, f32 where it matters**: norms, softmax and the
+  final logits run in f32; matmuls feed the MXU in bf16. RoPE phase tables
+  are f32 but the rotation applies in the storage dtype on the training
+  path (f32 on the KV-cached serving path — see ops/rope.py for why).
 - **Sharding by annotation**: ``parallel.sharding.LLAMA_RULES`` map param
   paths to (fsdp, tp) PartitionSpecs; activations are constrained to
   (dp+fsdp, sp) — XLA inserts the collectives.

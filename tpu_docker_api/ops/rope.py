@@ -28,15 +28,30 @@ def apply_rope(
     sin: jnp.ndarray,
     positions: jnp.ndarray | None = None,  # (batch, seq) absolute positions
 ) -> jnp.ndarray:
-    """Rotate q/k by position-dependent phases; computed in f32, cast back."""
+    """Rotate q/k by position-dependent phases.
+
+    The phase TABLES are always f32 (angles at position 32k need the
+    mantissa). The rotation itself is applied in x's own dtype on the
+    TRAINING path (``positions is None``): the inputs are already
+    bf16-rounded, so f32 application adds no information while its
+    upcast/downcast converts measured ~3% of the llama3-1b train step
+    (docs/perf-notes.md). The KV-cached SERVING path (explicit
+    ``positions``) keeps f32 application: bf16 intermediates round at
+    fusion boundaries, which differ between lowerings of the same model
+    (sharded vs single-device), and serving promises bit-identical tokens
+    across those (tests/test_infer.py TestShardedGenerate, and the
+    speculative verifier's exactness contract)."""
     _, seq, _, head_dim = x.shape
     if positions is None:
         c = cos[:seq][None, :, None, :]  # (1, seq, 1, hd/2)
         s = sin[:seq][None, :, None, :]
+        c = c.astype(x.dtype)
+        s = s.astype(x.dtype)
+        xc = x
     else:
         c = cos[positions][:, :, None, :]  # (batch, seq, 1, hd/2)
         s = sin[positions][:, :, None, :]
-    xf = x.astype(jnp.float32)
-    x1, x2 = xf[..., : head_dim // 2], xf[..., head_dim // 2:]
+        xc = x.astype(jnp.float32)
+    x1, x2 = xc[..., : head_dim // 2], xc[..., head_dim // 2:]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
