@@ -24,18 +24,30 @@ from tpu_docker_api.models.moe import (  # noqa: F401
     moe_init,
     moe_presets,
 )
+from tpu_docker_api.models.vit import (  # noqa: F401
+    ViTConfig,
+    vit_forward,
+    vit_init,
+    vit_presets,
+)
 
 
 def model_fns(cfg):
-    """(init_fn(cfg, key), loss_fn(params, tokens, cfg, mesh), rules)."""
+    """(init_fn(cfg, key), loss_fn(params, batch, cfg, mesh), rules).
+    ``batch`` is whatever the family trains on: a token array for the
+    decoder families, an (images, labels) tuple for ViT — the trainer
+    shards any batch pytree on its leading axis."""
     from tpu_docker_api.models.llama import llama_loss
     from tpu_docker_api.models.moe import MOE_RULES, moe_loss
+    from tpu_docker_api.models.vit import VIT_RULES, vit_loss
     from tpu_docker_api.parallel.sharding import LLAMA_RULES
 
     if isinstance(cfg, MoEConfig):
         return moe_init, moe_loss, MOE_RULES
     if isinstance(cfg, LlamaConfig):
         return llama_init, llama_loss, LLAMA_RULES
+    if isinstance(cfg, ViTConfig):
+        return vit_init, vit_loss, VIT_RULES
     raise TypeError(f"no model registered for config type {type(cfg)!r}")
 
 

@@ -1,0 +1,221 @@
+"""Vision Transformer (ViT) — the non-causal model family.
+
+The reference ships no models at all (SURVEY.md §0); this joins Llama and
+MoE as the third in-tree workload family and is deliberately NOT a decoder:
+it exercises the framework surfaces a causal LM cannot — non-causal
+attention (the flash kernel's ``causal=False`` path), LayerNorm
+(``ops.norms.layer_norm``), tuple batches (images, labels) through the
+generic trainer, and classification loss.
+
+TPU-first choices:
+
+- **mean-pool head, no CLS token**: token count stays ``(image/patch)²`` —
+  a multiple of 128 for the shipped presets, so sequence dims tile cleanly
+  onto the flash kernel and the MXU instead of the 197-token ragged shapes
+  a CLS token produces;
+- **patchify as reshape+matmul**: the patch embedding is a single
+  (P²·C, D) matmul on re-laid-out pixels, not a convolution — identical
+  math, and it rides the same Megatron column/row sharding rules as every
+  other projection;
+- **stacked layers + lax.scan + remat**, bf16 storage / f32 norms, exactly
+  llama's discipline (models/llama.py docstring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_docker_api.ops.attention import multihead_attention
+from tpu_docker_api.ops.norms import layer_norm
+from tpu_docker_api.ops.quant import linear
+from tpu_docker_api.parallel.sharding import constrain
+
+#: suffix rules (parallel/sharding.py): Megatron column/row over (fsdp, tp),
+#: scan axis never sharded, vectors replicated
+VIT_RULES: list[tuple[str, P]] = [
+    ("patch_embed/w",   P("fsdp", "tp")),           # (P²C, d) column
+    ("layers/attn/wq",  P(None, "fsdp", "tp")),     # (L, d, d) column
+    ("layers/attn/wk",  P(None, "fsdp", "tp")),
+    ("layers/attn/wv",  P(None, "fsdp", "tp")),
+    ("layers/attn/wo",  P(None, "tp", "fsdp")),     # row
+    ("layers/mlp/w1",   P(None, "fsdp", "tp")),     # (L, d, ff) column
+    ("layers/mlp/w2",   P(None, "tp", "fsdp")),     # (L, ff, d) row
+    ("head",            P("fsdp", None)),           # (d, classes)
+    ("pos_emb",         P()),
+    ("*",               P()),                       # biases, norms
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 256
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    n_classes: int = 1000
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_image(self) -> float:
+        """Training FLOPs per image (fwd+bwd ≈ 3× forward matmul FLOPs)."""
+        n, d = self.n_patches, self.dim
+        per_layer = (4 * 2 * d * d            # wq wk wv wo
+                     + 2 * 2 * d * self.ffn_dim)
+        attn = 2 * 2 * n * d                  # scores + values per token
+        patch = 2 * (self.patch_size ** 2 * self.channels) * d
+        head = 2 * d * self.n_classes
+        return 3.0 * (n * (self.n_layers * (per_layer + attn))
+                      + n * patch + head)
+
+
+def vit_presets() -> dict[str, ViTConfig]:
+    return {
+        # ViT-Base/16 at 256px → 256 patches (tiles on the flash kernel)
+        "vit-b16": ViTConfig(),
+        "vit-s16": ViTConfig(dim=384, n_layers=12, n_heads=6, ffn_dim=1536),
+        # CPU-fast config for tests / dryrun (64px/16 → 16 patches)
+        "tiny": ViTConfig(image_size=64, patch_size=16, dim=64, n_layers=2,
+                          n_heads=4, ffn_dim=128, n_classes=10, remat=False),
+    }
+
+
+def vit_init(cfg: ViTConfig, key: jax.Array) -> dict:
+    k_patch, k_layers, k_head, k_pos = jax.random.split(key, 4)
+    d, pd = cfg.dim, cfg.patch_size ** 2 * cfg.channels
+    L = cfg.n_layers
+
+    def init(key, shape, fan_in):
+        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+                * (fan_in**-0.5)).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 6)
+    return {
+        "patch_embed": {"w": init(k_patch, (pd, d), pd),
+                        "b": jnp.zeros((d,), cfg.dtype)},
+        "pos_emb": (jax.random.normal(k_pos, (cfg.n_patches, d), jnp.float32)
+                    * 0.02).astype(cfg.dtype),
+        "layers": {
+            "ln1_w": jnp.ones((L, d), cfg.dtype),
+            "ln1_b": jnp.zeros((L, d), cfg.dtype),
+            "ln2_w": jnp.ones((L, d), cfg.dtype),
+            "ln2_b": jnp.zeros((L, d), cfg.dtype),
+            "attn": {
+                "wq": init(ks[0], (L, d, d), d),
+                "wk": init(ks[1], (L, d, d), d),
+                "wv": init(ks[2], (L, d, d), d),
+                "wo": init(ks[3], (L, d, d), d),
+            },
+            "mlp": {
+                "w1": init(ks[4], (L, d, cfg.ffn_dim), d),
+                "b1": jnp.zeros((L, cfg.ffn_dim), cfg.dtype),
+                "w2": init(ks[5], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+                "b2": jnp.zeros((L, d), cfg.dtype),
+            },
+        },
+        "final_ln_w": jnp.ones((d,), cfg.dtype),
+        "final_ln_b": jnp.zeros((d,), cfg.dtype),
+        # near-zero head: initial logits ≈ uniform (ViT practice is exact
+        # zero, but that blocks trunk gradients at step 0)
+        "head": init(k_head, (d, cfg.n_classes), d) * jnp.asarray(
+            0.02, cfg.dtype),
+    }
+
+
+def _patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """(B, H, W, C) → (B, n_patches, P²·C)."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def _block(x, layer, cfg: ViTConfig, mesh):
+    """Pre-LN transformer encoder block (non-causal attention)."""
+    b, n, d = x.shape
+    hd = cfg.head_dim
+    y = layer_norm(x, layer["ln1_w"], layer["ln1_b"], cfg.norm_eps)
+    q = linear(y, layer["attn"]["wq"]).reshape(b, n, cfg.n_heads, hd)
+    k = linear(y, layer["attn"]["wk"]).reshape(b, n, cfg.n_heads, hd)
+    v = linear(y, layer["attn"]["wv"]).reshape(b, n, cfg.n_heads, hd)
+    attn = multihead_attention(q, k, v, causal=False)
+    x = x + linear(attn.reshape(b, n, d), layer["attn"]["wo"])
+    x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    y = layer_norm(x, layer["ln2_w"], layer["ln2_b"], cfg.norm_eps)
+    y = jax.nn.gelu(linear(y, layer["mlp"]["w1"]) + layer["mlp"]["b1"])
+    x = x + (linear(y, layer["mlp"]["w2"]) + layer["mlp"]["b2"])
+    return constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+
+
+def vit_forward(
+    params: dict,
+    images: jnp.ndarray,  # (B, H, W, C), any float dtype
+    cfg: ViTConfig,
+    mesh: Mesh | None = None,
+) -> jnp.ndarray:
+    """Class logits (B, n_classes) in f32 (mean-pooled, no CLS token)."""
+    x = _patchify(images.astype(cfg.dtype), cfg)
+    x = linear(x, params["patch_embed"]["w"]) + params["patch_embed"]["b"]
+    x = x + params["pos_emb"][None]
+    if mesh is not None:
+        x = constrain(x, mesh, P(("dp", "fsdp"), None))
+
+    block = functools.partial(_block, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        from tpu_docker_api.ops.flash_pallas import TRAIN_REMAT_POLICY
+
+        block = jax.checkpoint(block, policy=TRAIN_REMAT_POLICY)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = lax.scan(scan_body, x, params["layers"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"],
+                   cfg.norm_eps)
+    pooled = jnp.mean(x.astype(jnp.float32), axis=1)   # (B, d)
+    return linear(pooled.astype(cfg.dtype), params["head"],
+                  out_dtype=jnp.float32)
+
+
+def vit_loss(
+    params: dict,
+    batch: tuple[jnp.ndarray, jnp.ndarray],  # (images (B,H,W,C), labels (B,))
+    cfg: ViTConfig,
+    mesh: Mesh | None = None,
+) -> jnp.ndarray:
+    """Mean softmax cross-entropy over classes."""
+    images, labels = batch
+    logits = vit_forward(params, images, cfg, mesh)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    target = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - target)
+
+
+def vit_synthetic_batch(key: jax.Array, batch: int, cfg: ViTConfig):
+    """(images, labels) synthetic pair — the data layer for tests/bench."""
+    k1, k2 = jax.random.split(key)
+    images = jax.random.uniform(
+        k1, (batch, cfg.image_size, cfg.image_size, cfg.channels),
+        jnp.float32)
+    labels = jax.random.randint(k2, (batch,), 0, cfg.n_classes,
+                                dtype=jnp.int32)
+    return images, labels

@@ -6,6 +6,20 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-6) -> jnp.ndarray:
+    """LayerNorm (ViT/GPT-style: mean subtraction, scale and bias). Same
+    f32-compute discipline as rms_norm."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    normed = xc * lax.rsqrt(var + eps)
+    out = normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(orig_dtype)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
     """RMSNorm (Llama-style, no mean subtraction, no bias).
 

@@ -36,7 +36,7 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="python -m tpu_docker_api.train")
     p.add_argument("--preset", default="tiny",
                    help="model preset (llama: tiny, bench-350m, llama3-8b...; "
-                        "moe: prefix with moe:)")
+                        "moe: and vit: prefixes for the other families)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=0, help="0 = preset default")
@@ -88,13 +88,23 @@ def main(argv: list[str] | None = None) -> None:
         synthetic_batch,
     )
 
+    is_vit = args.preset.startswith("vit:")
     if args.preset.startswith("moe:"):
         cfg = moe_presets()[args.preset[4:]]
+    elif is_vit:
+        from tpu_docker_api.models.vit import vit_presets
+
+        cfg = vit_presets()[args.preset[4:]]
+        if args.data or args.seq:
+            raise SystemExit("--data/--seq do not apply to vit: presets "
+                             "(image batches are synthetic)")
+        seq = cfg.n_patches  # tokens-per-image, for the throughput metric
     else:
         cfg = llama_presets()[args.preset]
-    if args.seq:
-        cfg = dataclasses.replace(cfg, max_seq_len=args.seq)
-    seq = min(cfg.max_seq_len, 512) if not args.seq else args.seq
+    if not is_vit:
+        if args.seq:
+            cfg = dataclasses.replace(cfg, max_seq_len=args.seq)
+        seq = min(cfg.max_seq_len, 512) if not args.seq else args.seq
 
     mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp,
                                sp=args.sp, pp=args.pp, ep=args.ep))
@@ -145,6 +155,20 @@ def main(argv: list[str] | None = None) -> None:
             process_index=jax.process_index(),
             process_count=n_processes,
         )
+    elif is_vit:
+        from tpu_docker_api.data.loader import rows_for_process
+        from tpu_docker_api.models.vit import vit_synthetic_batch
+
+        rows = rows_for_process(args.batch, jax.process_index(), n_processes)
+        n_local = rows.stop - rows.start
+
+        def get_batch(i):
+            # generate only this process's rows (full images are ~786KB
+            # each — materializing the global batch everywhere is real
+            # work); fold_in keeps per-(step, process) determinism
+            key = jax.random.fold_in(jax.random.PRNGKey(i),
+                                     jax.process_index())
+            return vit_synthetic_batch(key, n_local, cfg)
     else:
         from tpu_docker_api.data.loader import rows_for_process
 

@@ -120,7 +120,12 @@ def make_train_step(
     if loss_fn is None:
         _, model_loss, _ = model_fns(cfg)
         loss_fn = lambda params, tokens: model_loss(params, tokens, cfg, mesh)
-    batch_sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+
+    def _batch_sharding(x):
+        # leading axis = batch rows (dp+fsdp), everything else replicated —
+        # per leaf, so tuple batches (ViT's (images, labels)) work too
+        return NamedSharding(
+            mesh, P(("dp", "fsdp"), *([None] * (jnp.ndim(x) - 1))))
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, tokens: jnp.ndarray):
@@ -140,10 +145,12 @@ def make_train_step(
         # must assemble the global array from per-process shards — a plain
         # device_put would reinterpret the local rows as the global batch.
         if jax.process_count() > 1:
-            tokens = jax.make_array_from_process_local_data(
-                batch_sharding, tokens)
+            tokens = jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    _batch_sharding(x), x), tokens)
         else:
-            tokens = jax.device_put(tokens, batch_sharding)
+            tokens = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, _batch_sharding(x)), tokens)
         with mesh:
             return train_step(state, tokens)
 
