@@ -52,8 +52,10 @@ def main() -> None:
     platform = devices[0].platform
     on_tpu = platform == "tpu"
     # measured-optimal single-v5e batch per TPU preset (params + adam state
-    # + activations must fit 16GB HBM; larger batches don't raise MFU)
-    tpu_preset_batch = {"llama3-1b": 2, "bench-350m": 8}
+    # + activations must fit 16GB HBM): llama3-1b fits batch 4 since the
+    # lean-remat/dense-lse memory work (13.0k tok/s vs 12.4k at batch 2;
+    # batch 5+ OOM); 350m peaks at 8 (41.2k tok/s vs 39.0k at 16)
+    tpu_preset_batch = {"llama3-1b": 4, "bench-350m": 8}
     if not on_tpu and preset in tpu_preset_batch:
         preset = "tiny"  # CPU fallback so the bench runs without hardware
 

@@ -210,12 +210,24 @@ def vit_loss(
     return jnp.mean(lse - target)
 
 
-def vit_synthetic_batch(key: jax.Array, batch: int, cfg: ViTConfig):
-    """(images, labels) synthetic pair — the data layer for tests/bench."""
-    k1, k2 = jax.random.split(key)
-    images = jax.random.uniform(
-        k1, (batch, cfg.image_size, cfg.image_size, cfg.channels),
-        jnp.float32)
-    labels = jax.random.randint(k2, (batch,), 0, cfg.n_classes,
-                                dtype=jnp.int32)
-    return images, labels
+def vit_synthetic_batch(key: jax.Array, batch: int, cfg: ViTConfig,
+                        row_offset: int = 0):
+    """(images, labels) synthetic pair — the data layer for tests/bench.
+
+    Each GLOBAL row r derives from ``fold_in(key, r)``, so a process
+    generating only its local rows (``row_offset`` = its first global row)
+    produces exactly the rows any other process layout would — the same
+    process-count-invariant resume/rescale contract as the token data
+    paths (train/__main__.py), without materializing the global image
+    batch everywhere."""
+    rows = jnp.arange(row_offset, row_offset + batch)
+    keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+
+    def one(k):
+        k1, k2 = jax.random.split(k)
+        img = jax.random.uniform(
+            k1, (cfg.image_size, cfg.image_size, cfg.channels), jnp.float32)
+        label = jax.random.randint(k2, (), 0, cfg.n_classes, dtype=jnp.int32)
+        return img, label
+
+    return jax.vmap(one)(keys)

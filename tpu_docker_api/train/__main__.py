@@ -160,15 +160,14 @@ def main(argv: list[str] | None = None) -> None:
         from tpu_docker_api.models.vit import vit_synthetic_batch
 
         rows = rows_for_process(args.batch, jax.process_index(), n_processes)
-        n_local = rows.stop - rows.start
 
         def get_batch(i):
-            # generate only this process's rows (full images are ~786KB
-            # each — materializing the global batch everywhere is real
-            # work); fold_in keeps per-(step, process) determinism
-            key = jax.random.fold_in(jax.random.PRNGKey(i),
-                                     jax.process_index())
-            return vit_synthetic_batch(key, n_local, cfg)
+            # generate only this process's rows of the GLOBAL batch (full
+            # images are ~786KB each); row-keyed generation keeps the
+            # process-count-invariant resume/rescale contract (line 141)
+            return vit_synthetic_batch(
+                jax.random.PRNGKey(i), rows.stop - rows.start, cfg,
+                row_offset=rows.start)
     else:
         from tpu_docker_api.data.loader import rows_for_process
 
