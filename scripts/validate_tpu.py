@@ -7,7 +7,8 @@ exercised here instead. Run on any machine with a TPU attached:
     python scripts/validate_tpu.py            # all checks
     python scripts/validate_tpu.py --fast     # skip the long-running checks
                                               # (32k sweep, 8k chunked-CE
-                                              # train, speculative mechanism)
+                                              # train, MoE bench train,
+                                              # speculative mechanism)
 
 Prints one JSON line per check; exits non-zero on any failure.
 """
@@ -150,6 +151,16 @@ def check_long_seq_train() -> bool:
         batch=1, seq=8192, n=3)
 
 
+def check_moe_train() -> bool:
+    """Sparse-MoE training on hardware (bench-moe, ~0.5B params, 8 experts
+    top-2): the expert-routing einsums and aux-loss path compiled by Mosaic
+    rather than the hermetic CPU tier."""
+    from tpu_docker_api.models.moe import moe_presets
+
+    return _bench_train("moe_train_bench", moe_presets()["bench-moe"],
+                        batch=8, seq=2048, n=4)
+
+
 def check_speculative_mechanism() -> bool:
     """Speculative decoding on hardware with the TARGET as its own draft:
     near-total acceptance (rounds << tokens) proves the propose/verify/
@@ -248,7 +259,8 @@ def main() -> int:
     parser.add_argument("--fast", action="store_true",
                         help="skip the long-running checks (32k "
                              "long-context sweep, seq-8192 chunked-CE "
-                             "train, speculative mechanism)")
+                             "train, MoE bench train, speculative "
+                             "mechanism)")
     args = parser.parse_args()
 
     checks = [check_device, check_flash_correctness, check_train_step,
@@ -256,6 +268,7 @@ def main() -> int:
     if not args.fast:
         checks.insert(2, check_long_context)
         checks.insert(4, check_long_seq_train)
+        checks.append(check_moe_train)
         checks.append(check_speculative_mechanism)
     ok = True
     for check in checks:

@@ -102,6 +102,13 @@ def moe_presets() -> dict[str, MoEConfig]:
             n_kv_heads=8, ffn_dim=14336, n_experts=8, top_k=2,
             max_seq_len=32768, rope_theta=1e6,
         ),
+        # single-v5e-chip bench config (~0.5B params with 8 experts;
+        # head_dim 128 tiles the flash kernel cleanly)
+        "bench-moe": MoEConfig(
+            vocab_size=32000, dim=1024, n_layers=8, n_heads=8,
+            n_kv_heads=8, ffn_dim=2048, n_experts=8, top_k=2,
+            max_seq_len=2048, rope_theta=10000.0,
+        ),
         # CPU-fast config for tests / dryrun
         "moe-tiny": MoEConfig(
             vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
