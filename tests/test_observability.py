@@ -245,3 +245,17 @@ class TestCrashRecoveryIntegration:
         watcher.poll_once()
         assert not runtime.container_inspect("roll-0").running
         assert runtime.container_inspect("roll-1").running
+
+
+def test_debug_threads_dump(api_server):
+    """GET /debug/threads: the pprof-goroutine analog (SURVEY.md §5.1) —
+    every live thread appears with a python stack."""
+    server, *_ = api_server
+    raw, _headers = _req(server.port, "GET", "/api/v1/debug/threads")
+    out = json.loads(raw)
+    assert out["code"] == 200
+    threads = out["data"]["threads"]
+    assert len(threads) >= 2  # main + http worker at minimum
+    names = {t["name"] for t in threads}
+    assert any(t["stack"] for t in threads)
+    assert any("MainThread" in n for n in names)

@@ -31,6 +31,7 @@ Route table:
     PATCH  /api/v1/volumes/{name}/rollback     roll to an older version's size
     GET    /api/v1/resources/tpus              chip scheduler view (alias: /gpus)
     GET    /api/v1/resources/ports             port scheduler view
+    GET    /api/v1/debug/threads               per-thread stack dump (pprof analog)
     GET    /healthz
 """
 
@@ -339,6 +340,29 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         # silent infinite-retry loop, workQueue.go:33-47)
         r.add("GET", "/api/v1/debug/deadletters",
               lambda body, **_: work_queue.dead_letter_view())
+
+    def debug_threads(body, **_):
+        """Per-thread stack dump — the pprof-goroutine analog SURVEY.md §5.1
+        asks for (the reference exposes nothing; a hung copy task or a
+        deadlocked family lock shows up here)."""
+        import sys
+        import threading
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append({
+                "threadId": ident,
+                "name": names.get(ident, "?"),
+                "stack": [
+                    {"file": f.filename, "line": f.lineno, "fn": f.name}
+                    for f in traceback.extract_stack(frame)
+                ],
+            })
+        return {"threads": out}
+
+    r.add("GET", "/api/v1/debug/threads", debug_threads)
 
     # pull-time utilization gauges for /metrics (SURVEY.md §5.5)
     r.metrics.gauge_fn(

@@ -181,6 +181,9 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Per-container liveness + restart bookkeeping", None),
     ("GET", "/api/v1/debug/deadletters", "getDeadLetters",
      "Async tasks that exhausted retries (never silently dropped)", None),
+    ("GET", "/api/v1/debug/threads", "getThreadDump",
+     "Per-thread stack dump (the pprof-goroutine analog): hung copies and "
+     "deadlocked family locks are visible here", None),
     ("GET", "/healthz", "healthz", "Process liveness", None),
     ("GET", "/metrics", "metrics",
      "Prometheus text format: request/latency/chip/port/queue gauges", None),
