@@ -176,6 +176,12 @@ class TestHistoryRollbackRoutes:
         out = call(server, "PATCH", "/api/v1/containers/hr/rollback", {})
         assert out["code"] != 200  # version required
 
+        # non-numeric version is a 10001 bad request, not a 500
+        # (ADVICE round 1: int() coercion must not escape as SERVER_ERROR)
+        out = call(server, "PATCH", "/api/v1/containers/hr/rollback",
+                   {"version": "abc"})
+        assert out["code"] == 10001
+
     def test_volume_history_and_rollback(self, server):
         call(server, "POST", "/api/v1/volumes",
              {"volumeName": "vh", "size": "10GB"})
@@ -184,6 +190,10 @@ class TestHistoryRollbackRoutes:
 
         out = call(server, "GET", "/api/v1/volumes/vh/history")
         assert [v["size"] for v in out["data"]["versions"]] == ["10GB", "20GB"]
+
+        out = call(server, "PATCH", "/api/v1/volumes/vh/rollback",
+                   {"version": "abc"})
+        assert out["code"] == 10001
 
         out = call(server, "PATCH", "/api/v1/volumes/vh/rollback",
                    {"version": 0})

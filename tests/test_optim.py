@@ -148,3 +148,25 @@ class TestAdamWInt8:
         tokens = synthetic_batch(jax.random.PRNGKey(1), 8, 64, cfg.vocab_size)
         state, metrics = step_fn(state, tokens)
         assert np.isfinite(float(metrics["loss"]))
+
+
+class TestResolveImpl:
+    """ADVICE round 1: "auto" must not pick the pallas kernel on a
+    multi-device mesh — pallas_call has no GSPMD partitioning rule, so XLA
+    would replicate the int8 moment buffers around the custom call."""
+
+    def test_explicit_impls_pass_through(self):
+        from tpu_docker_api.train import optim
+        for impl in ("xla", "pallas", "pallas_interpret"):
+            assert optim._resolve_impl(impl) == impl
+
+    def test_auto_pallas_only_on_single_device_tpu(self, monkeypatch):
+        from tpu_docker_api.train import optim
+        monkeypatch.setattr(optim.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(optim.jax, "device_count", lambda: 1)
+        assert optim._resolve_impl("auto") == "pallas"
+        monkeypatch.setattr(optim.jax, "device_count", lambda: 8)
+        assert optim._resolve_impl("auto") == "xla"
+        monkeypatch.setattr(optim.jax, "default_backend", lambda: "cpu")
+        monkeypatch.setattr(optim.jax, "device_count", lambda: 1)
+        assert optim._resolve_impl("auto") == "xla"

@@ -169,7 +169,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         _validate_ref_name(name)
         if "chipCount" not in body and "gpuCount" not in body:
             raise errors.BadRequest("chipCount is required")
-        want = int(body.get("chipCount", body.get("gpuCount", 0)))
+        want = errors.as_int(
+            body.get("chipCount", body.get("gpuCount", 0)), "chipCount")
         return container_svc.patch_container_chips(
             name, ContainerPatchChips(chip_count=want)
         )
@@ -204,12 +205,18 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         _validate_ref_name(name)
         return container_svc.get_container_history(name)
 
-    def c_rollback(body, name):
-        _validate_ref_name(name)
+    def _version_of(body):
         if "version" not in body:
             raise errors.BadRequest("version is required")
+        try:
+            return int(body["version"])
+        except (TypeError, ValueError):
+            raise errors.BadRequest("version must be an integer")
+
+    def c_rollback(body, name):
+        _validate_ref_name(name)
         return container_svc.rollback_container(name, ContainerRollback(
-            version=int(body["version"]),
+            version=_version_of(body),
             data_from=body.get("dataFrom", "latest"),
         ))
 
@@ -260,10 +267,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
 
     def v_rollback(body, name):
         _validate_ref_name(name)
-        if "version" not in body:
-            raise errors.BadRequest("version is required")
         return volume_svc.rollback_volume(name, VolumeRollback(
-            version=int(body["version"]),
+            version=_version_of(body),
             data_from=body.get("dataFrom", "latest"),
         ))
 

@@ -20,6 +20,18 @@ class ApiError(Exception):
         super().__init__(msg or self.__class__.__doc__ or self.__class__.__name__)
 
 
+def as_int(value, field: str) -> int:
+    """Coerce a user-supplied request field to int, mapping malformed input
+    to :class:`BadRequest` (code 10001) instead of letting ``ValueError``
+    escape the handler as a 500 SERVER_ERROR. For request DTO ``from_dict``
+    sites; internal state parsing should keep plain ``int()`` so corruption
+    surfaces as a server error."""
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise BadRequest(f"{field} must be an integer") from None
+
+
 # --- common (xerrors/common.go:7-10) ------------------------------------------
 
 class NoPatchRequired(ApiError):

@@ -217,6 +217,25 @@ def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     return x if cache is None else (x, new_cache)
 
 
+def embed_lookup(table: jnp.ndarray, tokens: jnp.ndarray,
+                 mesh: Mesh | None) -> jnp.ndarray:
+    """Token-embedding lookup that stays efficient under SPMD.
+
+    On a mesh whose ``tp`` axis shards the table's vocab dim
+    (LLAMA_RULES "embed/tokens" → P("tp", "fsdp")), a plain ``jnp.take``
+    makes the SPMD partitioner replicate the whole table and repartition
+    ("Involuntary full rematerialization" — wasted HBM + ICI every step).
+    The MXU-friendly fix (MaxText's ``use_iota_embed``): express the lookup
+    as a one-hot × table matmul, which GSPMD shards like any row-parallel
+    matmul — local partial products over each device's vocab shard, then a
+    psum over tp. Off-mesh (single chip) the gather is ideal, so keep it.
+    """
+    if mesh is not None and not mesh.empty and mesh.shape.get("tp", 1) > 1:
+        onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=table.dtype)
+        return jnp.einsum("bsv,vd->bsd", onehot, table)
+    return jnp.take(table, tokens, axis=0)
+
+
 def llama_hidden(
     params: dict,
     tokens: jnp.ndarray,  # (batch, seq) int32
@@ -227,7 +246,7 @@ def llama_hidden(
     pre-final-norm. Shared by ``llama_forward`` (dense logits tail) and the
     chunked-CE training loss (which never materializes full logits)."""
     seq = tokens.shape[1]
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    x = embed_lookup(params["embed"]["tokens"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
     rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
@@ -304,7 +323,7 @@ def decoder_forward_cached(params, tokens, cfg, k_cache, v_cache, mesh,
     ``_block`` or MoE's aux-discarding wrapper (models/moe.py) — so the
     cache-as-carry mechanics live in exactly one place."""
     max_seq = k_cache.shape[2]
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    x = embed_lookup(params["embed"]["tokens"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), None))
     rope_cos, rope_sin = rope_frequencies(cfg.head_dim, max_seq, cfg.rope_theta)

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tpu_docker_api.models.llama import _attention, cross_entropy, lm_head
+from tpu_docker_api.models.llama import (
+    _attention, cross_entropy, embed_lookup, lm_head)
 from tpu_docker_api.ops.norms import rms_norm
 from tpu_docker_api.ops.rope import rope_frequencies
 from tpu_docker_api.parallel.sharding import LLAMA_RULES, constrain
@@ -258,7 +259,7 @@ def moe_forward(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(logits (b, s, vocab) f32, mean router aux loss)."""
     seq = tokens.shape[1]
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)
+    x = embed_lookup(params["embed"]["tokens"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
     rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)

@@ -37,6 +37,7 @@ from tpu_docker_api.models.llama import (
     LlamaConfig,
     _block,
     cross_entropy,
+    embed_lookup,
     lm_head,
 )
 from tpu_docker_api.ops.rope import rope_frequencies
@@ -86,7 +87,7 @@ def pipeline_forward(
     d = cfg.dim
     rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
 
-    x = jnp.take(params["embed"]["tokens"], tokens, axis=0)  # (batch, s, d)
+    x = embed_lookup(params["embed"]["tokens"], tokens, mesh)  # (batch, s, d)
     x_mb = x.reshape(n_micro, mb, seq, d)
     x_mb = constrain(x_mb, mesh, P(None, ("dp", "fsdp"), "sp", None))
 

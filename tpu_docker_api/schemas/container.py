@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+from tpu_docker_api import errors
+
 
 @dataclasses.dataclass
 class ContainerPort:
@@ -48,15 +50,17 @@ class ContainerRun:
         return ContainerRun(
             image_name=d.get("imageName", ""),
             container_name=d.get("containerName", ""),
-            chip_count=int(d.get("chipCount", d.get("gpuCount", 0))),
+            chip_count=errors.as_int(
+                d.get("chipCount", d.get("gpuCount", 0)), "chipCount"),
             slice_shape=d.get("sliceShape", ""),
             binds=[Bind(b["src"], b["dest"]) for b in d.get("binds", [])],
             env=list(d.get("env", [])),
             cmd=list(d.get("cmd", [])),
             container_ports=[
                 ContainerPort(
-                    container_port=int(p["containerPort"]),
-                    host_port=int(p.get("hostPort", 0)),
+                    container_port=errors.as_int(p["containerPort"],
+                                                 "containerPort"),
+                    host_port=errors.as_int(p.get("hostPort", 0), "hostPort"),
                     protocol=p.get("protocol", "tcp"),
                 )
                 for p in d.get("containerPorts", [])

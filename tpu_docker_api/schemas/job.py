@@ -13,6 +13,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+from tpu_docker_api import errors
+
 
 @dataclasses.dataclass
 class JobRun:
@@ -33,12 +35,12 @@ class JobRun:
         return JobRun(
             image_name=d.get("imageName", ""),
             job_name=d.get("jobName", ""),
-            chip_count=int(d.get("chipCount", 0)),
+            chip_count=errors.as_int(d.get("chipCount", 0), "chipCount"),
             accelerator_type=d.get("acceleratorType", ""),
             binds=list(d.get("binds", [])),
             env=list(d.get("env", [])),
             cmd=list(d.get("cmd", [])),
-            num_slices=int(d.get("numSlices", 1)),
+            num_slices=errors.as_int(d.get("numSlices", 1), "numSlices"),
         )
 
 
@@ -51,7 +53,7 @@ class JobPatchChips:
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "JobPatchChips":
         return JobPatchChips(
-            chip_count=int(d.get("chipCount", 0)),
+            chip_count=errors.as_int(d.get("chipCount", 0), "chipCount"),
             accelerator_type=d.get("acceleratorType", ""),
         )
 

@@ -233,12 +233,28 @@ class ScaleByAdamInt8State(NamedTuple):
     nu_scale: optax.Updates
 
 
+def _resolve_impl(impl: str) -> str:
+    """"auto" → pallas only on a single-device TPU: pallas_call has no GSPMD
+    partitioning rule, so on a multi-device mesh XLA would replicate the int8
+    moment buffers around the custom call; the xla path shards leaf-wise for
+    free under GSPMD."""
+    if impl != "auto":
+        return impl
+    return ("pallas" if jax.default_backend() == "tpu"
+            and jax.device_count() == 1 else "xla")
+
+
 def scale_by_adam_int8(
     b1: float = 0.9, b2: float = 0.95, eps: float = 1e-8, block: int = 256,
     impl: str = "auto", fused_wd_lr: tuple[float, float] | None = None,
 ) -> optax.GradientTransformation:
-    """``impl``: "auto" (pallas on TPU, xla elsewhere), "pallas",
-    "pallas_interpret" (CPU test coverage of the kernel), or "xla".
+    """``impl``: "auto" (pallas on single-device TPU, xla elsewhere),
+    "pallas", "pallas_interpret" (CPU test coverage of the kernel), or
+    "xla". The pallas kernel carries no GSPMD partitioning rule, so under a
+    multi-device mesh "auto" selects the xla path (which GSPMD shards
+    leaf-wise for free); forcing ``impl="pallas"`` on a sharded mesh would
+    make XLA replicate the moment buffers around the custom call, negating
+    the memory win.
     ``fused_wd_lr=(weight_decay, lr)`` folds decoupled weight decay and the
     learning rate into the update (the transform then emits the FINAL
     -lr·(adam + wd·p) step and requires ``params`` at update time)."""
@@ -268,9 +284,7 @@ def scale_by_adam_int8(
         bc1 = 1.0 - b1 ** cf
         bc2 = 1.0 - b2 ** cf
         wd, lr = fused_wd_lr if fused_wd_lr is not None else (0.0, 0.0)
-        mode = impl
-        if mode == "auto":
-            mode = "pallas" if jax.default_backend() == "tpu" else "xla"
+        mode = _resolve_impl(impl)
 
         def one_xla(g, mq, ms, vq, vs, p=None):
             g = g.astype(jnp.float32)
