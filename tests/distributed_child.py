@@ -1,0 +1,89 @@
+"""One process of an N-process distributed training job — the e2e child.
+
+This is the in-container workload the control plane launches: its entire
+distributed configuration arrives via env rendered VERBATIM by
+``workload.jaxenv.render_job_specs`` (the TPU analog of the reference
+wiring ports into containers, service/container.go:489-501). The program:
+
+1. ``bootstrap_jax`` → ``jax.distributed.initialize`` from the rendered
+   JAX_* env (gloo collectives on the CPU backend);
+2. asserts the global device/process view;
+3. runs a cross-process global-sum sanity check;
+4. trains a tiny Llama for a few steps where each process feeds ONLY its
+   own rows of the global batch (``data.loader.make_batch_fn`` with
+   process_index/process_count — the row-keyed contract), exercising the
+   ``jax.process_count() > 1`` branch of ``train.trainer.make_train_step``
+   (``jax.make_array_from_process_local_data``);
+5. writes its losses to a JSON file for the parent test to compare against
+   a single-process run of the same schedule.
+
+Usage: python distributed_child.py OUT_JSON LOCAL_DEVICES STEPS GLOBAL_BATCH
+(env: rendered job env + E2E_TOKENS pointing at a loader .bin file)
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    out_path, local_devices, steps, global_batch = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+
+    from tpu_docker_api.workload.jaxenv import bootstrap_jax
+
+    bootstrap_jax(platform="cpu", virtual_devices=local_devices)
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "float32")
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pid = jax.process_index()
+    n_proc = jax.process_count()
+    assert n_proc == int(os.environ["JAX_NUM_PROCESSES"]), (
+        n_proc, os.environ["JAX_NUM_PROCESSES"])
+    assert pid == int(os.environ["JAX_PROCESS_ID"])
+    n_dev = jax.device_count()
+    assert n_dev == n_proc * local_devices
+
+    from tpu_docker_api.data.loader import make_batch_fn, open_token_files
+    from tpu_docker_api.models.llama import llama_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.trainer import create_train_state, make_train_step
+
+    mesh = build_mesh(MeshPlan(dp=n_dev // 2, fsdp=2))
+
+    # cross-process global-sum sanity: each process contributes rows filled
+    # with (pid+1); the global sum proves collectives span processes
+    rows_per = 2 * (local_devices // 2) or local_devices
+    local = np.full((rows_per, 8), float(pid + 1), np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(("dp", "fsdp"))), local)
+    with mesh:
+        total = float(jax.jit(lambda x: x.sum())(garr))
+    expected = 8.0 * rows_per * sum(range(1, n_proc + 1))
+    assert total == expected, (total, expected)
+
+    cfg = llama_presets()["tiny"]
+    seq = 32
+    src = open_token_files(os.environ["E2E_TOKENS"], window=seq + 1)
+    batch_fn = make_batch_fn(src, global_batch, seed=0,
+                             process_index=pid, process_count=n_proc)
+    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, opt)
+    losses = []
+    for s in range(steps):
+        state, metrics = step(state, batch_fn(s))
+        losses.append(float(metrics["loss"]))  # replicated scalar
+
+    with open(out_path, "w") as f:
+        json.dump({"process_id": pid, "process_count": n_proc,
+                   "device_count": n_dev, "global_sum": total,
+                   "losses": losses}, f)
+    print(f"child {pid} done: losses={losses}")
+
+
+if __name__ == "__main__":
+    main()

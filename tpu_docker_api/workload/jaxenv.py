@@ -100,6 +100,20 @@ def bootstrap_jax(platform: str = "", virtual_devices: int = 0) -> None:
         jax.config.update("jax_platforms", platform)
     n_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
     if n_processes > 1:
+        if platform == "cpu" or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            # cross-process collectives on the CPU backend go through gloo;
+            # explicit so multi-process CPU jobs (tests, the dryrun analog
+            # of a real pod) don't depend on the default — whether the
+            # platform came from the argument or from container env
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except (AttributeError, ValueError) as e:  # older/newer jax
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "could not select gloo CPU collectives (%s); "
+                    "multi-process CPU collectives depend on jax default", e)
         jax.distributed.initialize(
             coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
             num_processes=n_processes,
