@@ -1,0 +1,412 @@
+"""runtime/docker_http.py against an in-process unix-socket Engine mock.
+
+VERDICT r1 item 4: the adapter had zero coverage — a typo in any path
+string would ship silently. These tests stand up a real AF_UNIX HTTP
+server speaking the Docker Engine API's golden shapes (payloads modeled
+on the reference's captured transcripts,
+api/gpu-docker-api-sample-interface.md:51-68, and the Engine API docs)
+and assert BOTH directions of every adapter method: the exact method,
+path, query and body the adapter sends, and correct decoding of the
+responses — including the 8-byte stdcopy stream demux, 304
+already-in-state handling, and 404 → typed-error mapping.
+
+A separate integration tier runs the same smoke flow against the real
+dockerd when /var/run/docker.sock exists.
+"""
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.docker_http import (
+    DockerRuntime,
+    _demux_docker_stream,
+)
+from tpu_docker_api.runtime.spec import ContainerSpec, DeviceMount, PortBinding
+
+
+def mux_frames(*frames: tuple[int, bytes]) -> bytes:
+    """Encode (stream_id, payload) pairs in docker's stdcopy framing."""
+    return b"".join(
+        struct.pack(">BxxxL", sid, len(payload)) + payload
+        for sid, payload in frames
+    )
+
+
+class _Engine:
+    """Minimal in-memory dockerd: state + request journal."""
+
+    def __init__(self):
+        self.containers: dict[str, dict] = {}
+        self.volumes: dict[str, dict] = {}
+        self.execs: dict[str, dict] = {}
+        self.requests: list[tuple[str, str, dict, dict | None]] = []
+        self.known_images = {"jax:latest"}
+
+    def last(self):
+        return self.requests[-1]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # AF_UNIX: client_address is b'' — stub the peer-name helpers
+    def address_string(self):
+        return "unix"
+
+    def log_message(self, *args):
+        pass
+
+    @property
+    def engine(self) -> _Engine:
+        return self.server.engine
+
+    def _reply(self, status: int, payload=None, raw: bytes | None = None):
+        body = raw if raw is not None else (
+            json.dumps(payload).encode() if payload is not None else b"")
+        self.send_response(status)
+        self.send_header("Content-Type",
+                         "application/octet-stream" if raw is not None
+                         else "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _handle(self, method: str):
+        parsed = urllib.parse.urlsplit(self.path)
+        # the adapter must version-prefix every request
+        assert parsed.path.startswith("/v1.41/"), parsed.path
+        path = parsed.path[len("/v1.41"):]
+        params = dict(urllib.parse.parse_qsl(parsed.query))
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length)) if length else None
+        self.engine.requests.append((method, path, params, body))
+        route = (method, path)
+
+        if route == ("GET", "/_ping"):
+            return self._reply(200, raw=b"OK")
+
+        if route == ("POST", "/containers/create"):
+            name = params["name"]
+            if body["Image"] not in self.engine.known_images:
+                return self._reply(404, {"message": f"No such image: {body['Image']}"})
+            cid = f"id-{name}"
+            self.engine.containers[name] = {
+                "Id": cid, "Name": f"/{name}", "Config": {
+                    "Image": body["Image"], "Cmd": body.get("Cmd"),
+                    "Env": body.get("Env"), "Labels": body.get("Labels"),
+                    "OpenStdin": body.get("OpenStdin", False),
+                    "Tty": body.get("Tty", False),
+                },
+                "HostConfig": body.get("HostConfig", {}),
+                "State": {"Running": False, "Pid": 0, "ExitCode": 0},
+                "GraphDriver": {"Name": "overlay2", "Data": {
+                    "MergedDir": f"/var/lib/docker/overlay2/{cid}/merged"}},
+            }
+            return self._reply(201, {"Id": cid, "Warnings": []})
+
+        name_op = path.split("/")
+        if method == "POST" and len(name_op) == 4 and name_op[1] == "containers":
+            _, _, name, op = name_op
+            if op in ("start", "stop", "restart"):
+                c = self.engine.containers.get(name)
+                if c is None:
+                    return self._reply(404, {"message": "no such container"})
+                want = op != "stop"
+                if op != "restart" and c["State"]["Running"] == want:
+                    return self._reply(304)
+                c["State"]["Running"] = want
+                c["State"]["Pid"] = 4242 if want else 0
+                return self._reply(204)
+            if op == "exec":
+                if name not in self.engine.containers:
+                    return self._reply(404, {"message": "no such container"})
+                eid = f"exec-{len(self.engine.execs)}"
+                self.engine.execs[eid] = {"ExitCode": 3, "Cmd": body["Cmd"]}
+                return self._reply(201, {"Id": eid})
+
+        if method == "POST" and path.startswith("/exec/") and path.endswith("/start"):
+            eid = path.split("/")[2]
+            assert eid in self.engine.execs
+            return self._reply(200, raw=mux_frames(
+                (1, b"out-line-1\n"), (2, b"err-line\n"), (1, b"out-line-2\n")))
+
+        if method == "GET" and path.startswith("/exec/"):
+            eid = path.split("/")[2]
+            return self._reply(200, self.engine.execs[eid])
+
+        if route == ("POST", "/commit"):
+            cname = params["container"]
+            if cname not in self.engine.containers:
+                return self._reply(404, {"message": "no such container"})
+            return self._reply(
+                201, {"Id": f"sha256:{cname}-{params['repo']}-{params['tag']}"})
+
+        if method == "GET" and path == "/containers/json":
+            return self._reply(200, [
+                {"Id": c["Id"], "Names": [c["Name"]]}
+                for c in self.engine.containers.values()])
+
+        if method == "GET" and len(name_op) == 4 and name_op[3] == "json":
+            c = self.engine.containers.get(name_op[2])
+            if c is None:
+                return self._reply(404, {"message": "no such container"})
+            return self._reply(200, c)
+
+        if method == "DELETE" and len(name_op) == 3 and name_op[1] == "containers":
+            if self.engine.containers.pop(name_op[2], None) is None:
+                return self._reply(404, {"message": "no such container"})
+            return self._reply(204)
+
+        if route == ("POST", "/volumes/create"):
+            self.engine.volumes[body["Name"]] = {
+                "Name": body["Name"], "Driver": body["Driver"],
+                "Options": body.get("DriverOpts") or {},
+                "Mountpoint": f"/var/lib/docker/volumes/{body['Name']}/_data",
+            }
+            return self._reply(201, self.engine.volumes[body["Name"]])
+
+        if method == "GET" and len(name_op) == 3 and name_op[1] == "volumes":
+            v = self.engine.volumes.get(name_op[2])
+            if v is None:
+                return self._reply(404, {"message": "no such volume"})
+            return self._reply(200, v)
+
+        if method == "DELETE" and len(name_op) == 3 and name_op[1] == "volumes":
+            if self.engine.volumes.pop(name_op[2], None) is None:
+                return self._reply(404, {"message": "no such volume"})
+            return self._reply(204)
+
+        return self._reply(500, {"message": f"unhandled {method} {path}"})
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+
+    def __init__(self, path: str, engine: _Engine):
+        super().__init__(path, _Handler)
+        self.engine = engine
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    sock_path = str(tmp_path / "docker.sock")
+    eng = _Engine()
+    server = _UnixHTTPServer(sock_path, eng)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    eng.socket_path = sock_path
+    try:
+        yield eng
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+@pytest.fixture()
+def rt(engine):
+    return DockerRuntime(f"unix://{engine.socket_path}")
+
+
+def make_spec(name="t0") -> ContainerSpec:
+    return ContainerSpec(
+        name=name, image="jax:latest", cmd=["python", "-c", "1"],
+        env=["A=1"], binds=["v0:/data"],
+        port_bindings=[PortBinding(8080, 40001)],
+        devices=[DeviceMount("/dev/accel0", "/dev/accel0")],
+        chip_ids=[0, 1], ici_contiguous=True,
+    )
+
+
+class TestTransport:
+    def test_init_pings(self, engine):
+        DockerRuntime(f"unix://{engine.socket_path}")
+        assert engine.last() == ("GET", "/_ping", {}, None)
+
+    def test_tcp_host_rejected(self):
+        with pytest.raises(ValueError):
+            DockerRuntime("tcp://10.0.0.1:2375")
+
+
+class TestContainerFlows:
+    def test_create_sends_golden_request(self, rt, engine):
+        cid = rt.container_create(make_spec())
+        assert cid == "id-t0"
+        method, path, params, body = engine.last()
+        assert (method, path) == ("POST", "/containers/create")
+        assert params == {"name": "t0"}
+        assert body["Image"] == "jax:latest"
+        assert body["ExposedPorts"] == {"8080/tcp": {}}
+        assert body["HostConfig"]["PortBindings"] == {
+            "8080/tcp": [{"HostPort": "40001"}]}
+        assert body["HostConfig"]["Binds"] == ["v0:/data"]
+        assert body["HostConfig"]["Devices"] == [{
+            "PathOnHost": "/dev/accel0", "PathInContainer": "/dev/accel0",
+            "CgroupPermissions": "rwm"}]
+        assert body["Labels"] == {"tpu-docker-api.chips": "0,1",
+                                  "tpu-docker-api.ici": "1"}
+
+    def test_create_unknown_image_maps_404(self, rt):
+        spec = make_spec()
+        spec.image = "missing:latest"
+        with pytest.raises(errors.ApiError, match="missing:latest not found"):
+            rt.container_create(spec)
+
+    def test_start_stop_restart_and_304(self, rt, engine):
+        rt.container_create(make_spec())
+        rt.container_start("t0")
+        assert engine.last()[:2] == ("POST", "/containers/t0/start")
+        rt.container_start("t0")          # already running -> 304, no raise
+        rt.container_stop("t0", timeout_s=7)
+        assert engine.last() == ("POST", "/containers/t0/stop", {"t": "7"}, None)
+        rt.container_stop("t0")           # already stopped -> 304, no raise
+        rt.container_restart("t0")
+        assert rt.container_inspect("t0").running
+
+    def test_ops_on_missing_container_raise_typed(self, rt):
+        for op in (rt.container_start, rt.container_stop, rt.container_restart,
+                   rt.container_inspect):
+            with pytest.raises(errors.ContainerNotExist):
+                op("ghost")
+        with pytest.raises(errors.ContainerNotExist):
+            rt.container_remove("ghost")
+
+    def test_inspect_round_trips_spec(self, rt):
+        spec = make_spec()
+        rt.container_create(spec)
+        rt.container_start("t0")
+        info = rt.container_inspect("t0")
+        assert info.id == "id-t0" and info.running and info.pid == 4242
+        assert info.data_dir == "/var/lib/docker/overlay2/id-t0/merged"
+        got = info.spec
+        assert (got.name, got.image, got.cmd, got.env) == (
+            "t0", spec.image, spec.cmd, spec.env)
+        assert got.port_bindings == spec.port_bindings
+        assert got.devices == spec.devices
+        assert got.chip_ids == [0, 1] and got.ici_contiguous
+
+    def test_exists_and_list(self, rt):
+        assert not rt.container_exists("t0")
+        rt.container_create(make_spec())
+        rt.container_create(make_spec("t1"))
+        assert rt.container_exists("t0")
+        assert rt.container_list() == ["t0", "t1"]
+
+    def test_remove(self, rt, engine):
+        rt.container_create(make_spec())
+        rt.container_remove("t0", force=True)
+        assert engine.last() == ("DELETE", "/containers/t0",
+                                 {"force": "true"}, None)
+        assert not rt.container_exists("t0")
+
+    def test_exec_demux_and_exit_code(self, rt, engine):
+        rt.container_create(make_spec())
+        res = rt.container_exec("t0", ["ls", "-l"], workdir="/srv")
+        # stdout and stderr frames interleaved, in order
+        assert res.output == "out-line-1\nerr-line\nout-line-2\n"
+        assert res.exit_code == 3
+        create = next(r for r in engine.requests
+                      if r[1] == "/containers/t0/exec")
+        assert create[3] == {"AttachStdout": True, "AttachStderr": True,
+                             "Cmd": ["ls", "-l"], "WorkingDir": "/srv"}
+        start = next(r for r in engine.requests if r[1].endswith("/start")
+                     and r[1].startswith("/exec/"))
+        assert start[3] == {"Detach": False, "Tty": False}
+
+    def test_exec_missing_container(self, rt):
+        with pytest.raises(errors.ContainerNotExist):
+            rt.container_exec("ghost", ["true"])
+
+    def test_commit(self, rt, engine):
+        rt.container_create(make_spec())
+        image_id = rt.container_commit("t0", "snap:v2")
+        assert image_id == "sha256:t0-snap-v2"
+        assert engine.last() == ("POST", "/commit",
+                                 {"container": "t0", "repo": "snap",
+                                  "tag": "v2"}, None)
+        # default tag
+        assert rt.container_commit("t0", "snap") == "sha256:t0-snap-latest"
+
+
+class TestVolumeFlows:
+    def test_create_inspect_remove(self, rt, engine):
+        vol = rt.volume_create("v0", {"size": "10GB"})
+        assert engine.last() == ("POST", "/volumes/create", {}, {
+            "Name": "v0", "Driver": "local", "DriverOpts": {"size": "10GB"}})
+        assert vol.mountpoint == "/var/lib/docker/volumes/v0/_data"
+        assert vol.driver_opts == {"size": "10GB"}
+        assert rt.volume_exists("v0")
+        got = rt.volume_inspect("v0")
+        assert got == vol
+        rt.volume_remove("v0", force=True)
+        assert engine.last() == ("DELETE", "/volumes/v0",
+                                 {"force": "true"}, None)
+        assert not rt.volume_exists("v0")
+
+    def test_missing_volume_typed_errors(self, rt):
+        with pytest.raises(errors.VolumeNotExist):
+            rt.volume_inspect("ghost")
+        with pytest.raises(errors.VolumeNotExist):
+            rt.volume_remove("ghost")
+
+
+class TestDemux:
+    def test_frames(self):
+        data = mux_frames((1, b"abc"), (2, b"DEF"))
+        assert _demux_docker_stream(data) == "abcDEF"
+
+    def test_truncated_trailing_header_ignored(self):
+        data = mux_frames((1, b"abc")) + b"\x01\x00\x00"  # partial header
+        assert _demux_docker_stream(data) == "abc"
+
+    def test_tty_raw_passthrough(self):
+        assert _demux_docker_stream(b"raw tty bytes") == "raw tty bytes"
+
+    def test_empty(self):
+        assert _demux_docker_stream(b"") == ""
+
+
+DOCKER_SOCK = "/var/run/docker.sock"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.exists(DOCKER_SOCK),
+                    reason="no docker daemon on this host")
+class TestRealDockerSmoke:
+    """The cardless smoke flow (BASELINE.json config #1) on real dockerd."""
+
+    def test_cardless_lifecycle(self):
+        rt = DockerRuntime()
+        name = "tpu-docker-api-selftest"
+        if rt.container_exists(name):
+            rt.container_remove(name, force=True)
+        spec = ContainerSpec(name=name, image="busybox:latest",
+                             cmd=["sleep", "30"])
+        rt.container_create(spec)
+        try:
+            rt.container_start(name)
+            info = rt.container_inspect(name)
+            assert info.running
+            res = rt.container_exec(name, ["echo", "hi"])
+            assert res.exit_code == 0 and res.output.strip() == "hi"
+            rt.container_stop(name)
+        finally:
+            rt.container_remove(name, force=True)

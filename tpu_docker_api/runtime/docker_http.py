@@ -281,13 +281,17 @@ class _NotFound(Exception):
 
 def _demux_docker_stream(data: bytes) -> str:
     """Demultiplex docker's 8-byte-header stdout/stderr stream (the Go side
-    uses stdcopy.StdCopy, service/container.go:169-172)."""
+    uses stdcopy.StdCopy, service/container.go:169-172). A stream that does
+    not carry valid headers (stream id ∈ {0,1,2}, three zero pad bytes) is a
+    tty-mode raw stream and passes through undecoded."""
     out = []
     i = 0
     while i + 8 <= len(data):
-        _stream, _, _, size = struct.unpack(">BxxxL", data[i:i + 8])
+        stream_id, size = struct.unpack(">BxxxL", data[i:i + 8])
+        if stream_id > 2 or data[i + 1:i + 4] != b"\x00\x00\x00":
+            return data.decode(errors="replace")  # tty mode: no framing
         out.append(data[i + 8:i + 8 + size])
         i += 8 + size
-    if not out:  # tty mode: raw stream, no headers
+    if not out:  # short raw stream (< one header)
         return data.decode(errors="replace")
     return b"".join(out).decode(errors="replace")
