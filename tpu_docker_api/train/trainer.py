@@ -114,10 +114,16 @@ def make_train_step(
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     loss_fn: Callable | None = None,
+    grad_fn: Callable | None = None,
 ) -> Callable:
     """jitted (state, tokens) → (state, metrics); state buffers donated.
-    ``cfg`` may be any registered model config (Llama, MoE, ...)."""
-    if loss_fn is None:
+    ``cfg`` may be any registered model config (Llama, MoE, ...).
+    ``grad_fn(params, tokens) -> (loss, grads)`` bypasses autodiff for
+    schedules that hand-compute their backward (parallel.pipeline's 1F1B);
+    mutually exclusive with ``loss_fn``."""
+    if grad_fn is not None and loss_fn is not None:
+        raise ValueError("pass loss_fn or grad_fn, not both")
+    if grad_fn is None and loss_fn is None:
         _, model_loss, _ = model_fns(cfg)
         loss_fn = lambda params, tokens: model_loss(params, tokens, cfg, mesh)
 
@@ -129,7 +135,10 @@ def make_train_step(
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, tokens: jnp.ndarray):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        if grad_fn is not None:
+            loss, grads = grad_fn(state.params, tokens)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
