@@ -177,7 +177,8 @@ class EtcdKV(KV):
             "/v3/kv/range",
             {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
         )
-        out = {_unb64(kv["key"]): _unb64(kv["value"]) for kv in resp.get("kvs", [])}
+        out = {_unb64_key(kv["key"]): _unb64(kv["value"])
+               for kv in resp.get("kvs", [])}
         return dict(sorted(out.items()))
 
     def delete_prefix(self, prefix: str) -> None:
@@ -191,16 +192,30 @@ class EtcdKV(KV):
 
 
 def _b64(s: str) -> str:
-    return base64.b64encode(s.encode()).decode()
+    # surrogateescape: _prefix_end may produce lone surrogates for non-ascii
+    # prefix ends; they round-trip to the intended raw bytes on the wire
+    # (identical to strict encoding for any valid-unicode input)
+    return base64.b64encode(s.encode("utf-8", "surrogateescape")).decode()
+
+
+def _unb64_key(s: str) -> str:
+    """Keys decode leniently: an incremented range-end byte can make a key
+    non-UTF-8, and it must round-trip back through ``_b64``."""
+    return base64.b64decode(s).decode("utf-8", "surrogateescape")
 
 
 def _unb64(s: str) -> str:
+    """Values decode strictly: this store only ever writes UTF-8 (JSON), so
+    a non-UTF-8 value is corruption by a foreign writer and must fail loudly
+    at the read site, not surface as lone surrogates downstream."""
     return base64.b64decode(s).decode()
 
 
 def _prefix_end(prefix: str) -> str:
-    """etcd range_end for a prefix scan: prefix with last byte incremented."""
-    b = bytearray(prefix.encode())
+    """etcd range_end for a prefix scan: prefix with last byte incremented.
+    Operates on the key's raw utf-8 bytes (etcd compares bytes); raw bytes
+    that aren't valid utf-8 ride in/out as surrogateescape characters."""
+    b = bytearray(prefix.encode("utf-8", "surrogateescape"))
     for i in reversed(range(len(b))):
         if b[i] < 0xFF:
             b[i] += 1
