@@ -227,7 +227,31 @@ def main() -> None:
         result["extra"]["control_plane"] = measure_control_plane(50)
     except Exception as e:  # never let the latency rider sink the headline
         result["extra"]["control_plane"] = {"error": str(e)}
+    if on_tpu:
+        # the north-star model size (BASELINE.json 'Llama-8B tokens/sec/
+        # chip'): int8 llama3-8b serving throughput on this chip. The
+        # training state above is ~14 GB of HBM — free it first or the
+        # 8 GB weight synthesis OOMs.
+        import gc
+
+        del state, metrics, step_fn, tokens
+        gc.collect()
+        try:
+            result["extra"]["llama3_8b_int8_infer"] = measure_8b_inference()
+        except Exception as e:
+            result["extra"]["llama3_8b_int8_infer"] = {"error": str(e)[:200]}
     print(json.dumps(result))
+
+
+def measure_8b_inference() -> dict:
+    """llama3-8b int8 serving throughput at the batch-64 throughput point
+    (shared harness: infer/quantize.bench_int8_serving; validate_tpu.py's
+    check_8b_inference covers the batch-4 latency point too)."""
+    from tpu_docker_api.infer.quantize import bench_int8_serving
+
+    res = bench_int8_serving(batch=64, reps=2)
+    res.pop("ok")
+    return res
 
 
 if __name__ == "__main__":

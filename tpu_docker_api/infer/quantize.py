@@ -53,3 +53,115 @@ def quantized_bytes(params: dict) -> int:
         else:
             total += leaf.size * leaf.dtype.itemsize
     return total
+
+
+def synth_quantized_params(cfg, seed: int = 0) -> dict:
+    """Synthesize an int8-serving param tree for a config DIRECTLY on
+    device, without ever materializing bf16 weights — the path that lets
+    the north-star llama3-8b (~8 GB int8) be benchmarked on a single
+    16 GB v5e chip, where bf16 init (16 GB) plus quantization would OOM.
+
+    Weights are deterministic pseudo-random int8 from fused iota
+    arithmetic (XLA fuses iota→mod→convert into one kernel writing int8
+    only; a jax.random draw of the same shape would materialize 4x the
+    bytes in uint32 bits first). Scales are set so the dequantized std
+    is ~fan_in^-0.5, matching trained-weight magnitude — with rms norms
+    between blocks, activations stay finite through any depth, which is
+    all a throughput benchmark needs."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def qweight(shape, fan_in, salt):
+        def build():
+            i = lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+            j = lax.broadcasted_iota(jnp.int32, shape, len(shape) - 2)
+            w = ((i * 131 + j * 31 + (salt + 9 * seed) * 2017) % 255) - 127
+            return w.astype(jnp.int8)
+
+        w8 = jax.jit(build)()
+        # uniform[-127,127] has std ~73.3; scale to fan_in^-0.5 effective
+        scale = jnp.full(shape[:-2] + (shape[-1],),
+                         (fan_in ** -0.5) / 73.3, jnp.float32)
+        return QuantizedLinear(w8, scale)
+
+    def fweight(shape, fan_in, salt):
+        def build():
+            i = lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+            j = lax.broadcasted_iota(jnp.int32, shape, 0)
+            w = ((i * 131 + j * 31 + (salt + 9 * seed) * 2017) % 255) - 127
+            return (w.astype(jnp.float32) * ((fan_in ** -0.5) / 73.3)
+                    ).astype(cfg.dtype)
+
+        return jax.jit(build)()
+
+    d, hd, L = cfg.dim, cfg.head_dim, cfg.n_layers
+    return {
+        "embed": {"tokens": fweight((cfg.vocab_size, d), d, 1)},
+        "layers": {
+            "attn_norm": jnp.ones((L, d), cfg.dtype),
+            "mlp_norm": jnp.ones((L, d), cfg.dtype),
+            "attn": {
+                "wq": qweight((L, d, cfg.n_heads * hd), d, 2),
+                "wk": qweight((L, d, cfg.n_kv_heads * hd), d, 3),
+                "wv": qweight((L, d, cfg.n_kv_heads * hd), d, 4),
+                "wo": qweight((L, cfg.n_heads * hd, d), cfg.n_heads * hd, 5),
+            },
+            "mlp": {
+                "w_gate": qweight((L, d, cfg.ffn_dim), d, 6),
+                "w_up": qweight((L, d, cfg.ffn_dim), d, 7),
+                "w_down": qweight((L, cfg.ffn_dim, d), cfg.ffn_dim, 8),
+            },
+        },
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": qweight((d, cfg.vocab_size), d, 9),
+    }
+
+
+def bench_int8_serving(preset: str = "llama3-8b", batch: int = 64,
+                       new_tok: int = 64, prompt_len: int = 128,
+                       reps: int = 2, max_seq: int = 512) -> dict:
+    """Shared int8-serving throughput harness (bench.py rider and
+    validate_tpu.py check both call this — one place for the metric
+    definitions). Synthesizes the preset's weights on device, runs one
+    compile + ``reps`` timed generates, and reports:
+
+    - ``new_tok_s_incl_prefill``: generated tokens / wall time of a full
+      generate() — prefill included, as the name says;
+    - ``ms_per_new_tok_incl_prefill``: its inverse per token. NOT a pure
+      decode-step latency: at these shapes the (batch, prompt_len) prefill
+      is a comparable share of the wall time.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
+    from tpu_docker_api.models.llama import llama_presets
+
+    cfg = llama_presets()[preset]
+    params = synth_quantized_params(cfg)
+    fn = make_generate_fn(cfg, GenerateConfig(
+        max_new_tokens=new_tok, temperature=0.0, max_seq=max_seq))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    out = fn(params, prompt, jax.random.PRNGKey(2))
+    int(out["tokens"][0, 0])  # compile + force completion
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        out = fn(params, prompt, jax.random.PRNGKey(3 + i))
+        int(out["tokens"][0, 0])
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    return {
+        "ok": bool(jnp.all(out["tokens"] >= 0))
+        and out["tokens"].shape == (batch, new_tok),
+        "weights_gb": round(quantized_bytes(params) / 2**30, 2),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tok,
+        "new_tok_s_incl_prefill": round(batch * new_tok / dt, 1),
+        "ms_per_new_tok_incl_prefill": round(dt / new_tok * 1e3, 2),
+    }
