@@ -7,8 +7,8 @@ exercised here instead. Run on any machine with a TPU attached:
     python scripts/validate_tpu.py            # all checks
     python scripts/validate_tpu.py --fast     # skip the long-running checks
                                               # (32k sweep, 8k chunked-CE
-                                              # train, MoE bench train,
-                                              # speculative mechanism,
+                                              # train, MoE bench train, ViT
+                                              # train, speculative mechanism,
                                               # llama3-8b int8 serving)
 
 Prints one JSON line per check; exits non-zero on any failure.
@@ -255,6 +255,44 @@ def check_inference() -> bool:
         speedup_vs_bf16=round(dt / qdt, 2))
 
 
+def check_vit_train() -> bool:
+    """ViT-B/16 training throughput (the non-causal family). Reached MFU
+    0.404 / 574 img/s on v5e (VERDICT r1 item 7; dense short-encoder
+    attention + storage-dtype probs — docs/perf-notes.md has the
+    attribution). The gate is 0.38, not the 0.40 target: run-to-run noise
+    is ~±2% (0.395—0.404 observed) and the gate's job is to catch a
+    regression to the pre-fix 0.36, not to flake on noise."""
+    import math
+
+    import jax
+
+    from tpu_docker_api.models.vit import vit_presets, vit_synthetic_batch
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.trainer import create_train_state, make_train_step
+
+    cfg = vit_presets()["vit-b16"]
+    batch_n = 128
+    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                      devices=jax.devices()[:1])
+    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, opt)
+    batch = vit_synthetic_batch(jax.random.PRNGKey(1), batch_n, cfg)
+    for _ in range(2):
+        state, m = step(state, batch)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    n = 8
+    for _ in range(n):
+        state, m = step(state, batch)
+    loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+    ips = n * batch_n / dt
+    mfu = cfg.flops_per_image() * ips / 197e12
+    return _emit("vit_train_b16", math.isfinite(loss) and mfu > 0.38,
+                 images_per_sec=round(ips), mfu=round(mfu, 3),
+                 loss=round(loss, 3))
+
+
 def check_8b_inference() -> bool:
     """The north-star model size on one chip (BASELINE.json metric:
     'Llama-8B tokens/sec/chip'): llama3-8b int8-quantized serving — ~8 GB
@@ -298,7 +336,8 @@ def main() -> int:
                         help="skip the long-running checks (32k "
                              "long-context sweep, seq-8192 chunked-CE "
                              "train, MoE bench train, speculative "
-                             "mechanism, llama3-8b int8 serving)")
+                             "mechanism, ViT train, llama3-8b int8 "
+                             "serving)")
     args = parser.parse_args()
 
     checks = [check_device, check_flash_correctness, check_train_step,
@@ -307,6 +346,7 @@ def main() -> int:
         checks.insert(2, check_long_context)
         checks.insert(4, check_long_seq_train)
         checks.append(check_moe_train)
+        checks.append(check_vit_train)
         checks.append(check_speculative_mechanism)
         checks.append(check_8b_inference)
     ok = True

@@ -157,7 +157,13 @@ def _block(x, layer, cfg: ViTConfig, mesh):
     q = linear(y, layer["attn"]["wq"]).reshape(b, n, cfg.n_heads, hd)
     k = linear(y, layer["attn"]["wk"]).reshape(b, n, cfg.n_heads, hd)
     v = linear(y, layer["attn"]["wv"]).reshape(b, n, cfg.n_heads, hd)
-    attn = multihead_attention(q, k, v, causal=False)
+    # bf16 probs: at these shapes the f32 (b, h, n, n) probability tensor
+    # is the step's dominant HBM traffic (flash/ring round probs the same
+    # way). Half of round 2's 0.36 -> 0.404 MFU win; the other half is the
+    # dense short-encoder dispatch in ops/attention.py (attribution:
+    # docs/perf-notes.md)
+    attn = multihead_attention(q, k, v, causal=False,
+                               probs_dtype=cfg.dtype)
     x = x + linear(attn.reshape(b, n, d), layer["attn"]["wo"])
     x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
     y = layer_norm(x, layer["ln2_w"], layer["ln2_b"], cfg.norm_eps)

@@ -27,20 +27,31 @@ def dense_attention(
     v: jnp.ndarray,
     causal: bool,
     q_offset: jnp.ndarray | int | None = None,
+    probs_dtype: jnp.dtype | None = None,
 ) -> jnp.ndarray:
     """Einsum attention with GQA folding. ``q_offset`` gives query i the
     absolute position ``q_offset + i`` so KV-cached decode (queries near the
     end of a longer, partially-filled key buffer) uses the same numerics as
     the q_seq == kv_seq training path: key slot j attends iff
-    j <= q_offset + i, which also masks not-yet-written cache slots."""
+    j <= q_offset + i, which also masks not-yet-written cache slots.
+
+    ``probs_dtype``: storage dtype for the (b, h, q, k) probability tensor
+    feeding the PV matmul. The f32 default is the serving-correctness
+    choice (results independent of cache dtype). Training paths that keep
+    everything bf16 pass the storage dtype — the flash/ring kernels already
+    round probs there, and at ViT-scale shapes the f32 probs tensor is the
+    step's dominant HBM traffic (profiled 2026-07: b=256 ViT-B/16 carries
+    805 MB f32 probs through fwd+bwd; bf16 probs lifted MFU 0.386→0.404)."""
     batch, seq, num_heads, head_dim = q.shape
     kv_seq, num_kv = k.shape[1], k.shape[2]
     group = num_heads // num_kv
     # q/k stay in the storage dtype with f32 accumulation: bf16 products
     # are exact in f32, so this equals the upcast-everything numerics
-    # without writing f32 copies of the cache. probs stay f32 (a downcast
-    # would make results depend on the cache dtype) — XLA upcasts v
-    # in-register inside the fused einsum, not in HBM.
+    # without writing f32 copies of the cache. probs default to f32 (a
+    # downcast makes results depend on the cache dtype — wrong for
+    # serving); training callers opt into storage-dtype probs via
+    # ``probs_dtype`` below. XLA upcasts v in-register inside the fused
+    # einsum, not in HBM.
     qg = q.reshape(batch, seq, num_kv, group, head_dim)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32)
@@ -53,6 +64,8 @@ def dense_attention(
         mask = k_pos[None, :] <= q_pos[:, None]  # (q_seq, kv_seq)
         scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
+    if probs_dtype is not None:
+        probs = probs.astype(probs_dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v,
                      preferred_element_type=jnp.float32)
     return out.reshape(batch, seq, num_heads, head_dim).astype(q.dtype)
@@ -67,16 +80,25 @@ def multihead_attention(
     v: jnp.ndarray,
     causal: bool = True,
     impl: str = "auto",
+    probs_dtype: jnp.dtype | None = None,
 ) -> jnp.ndarray:
-    """(batch, seq, heads, head_dim) attention with GQA support."""
+    """(batch, seq, heads, head_dim) attention with GQA support.
+    ``probs_dtype`` forwards to ``dense_attention`` (the flash kernel
+    already keeps probs in the storage dtype internally)."""
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         # seq must tile by 128; head_dim 64 works too (Mosaic pads lanes),
         # and dense would materialize O(seq^2) scores — far worse than padding
         aligned = q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0
-        impl = "flash" if (on_tpu and aligned) else "dense"
+        # short NON-causal sequences run faster through XLA's fused dense
+        # einsums than through the kernel (measured on ViT-B/16 @256
+        # tokens, v5e: 541 vs 511 img/s) — the flash win comes from
+        # causal-block skipping and O(seq) memory, neither of which a
+        # 256-token encoder needs
+        short_encoder = (not causal) and q.shape[1] <= 512
+        impl = "flash" if (on_tpu and aligned and not short_encoder) else "dense"
     if impl == "dense":
-        return dense_attention(q, k, v, causal)
+        return dense_attention(q, k, v, causal, probs_dtype=probs_dtype)
     if impl in ("flash", "flash_interpret"):
         from tpu_docker_api.ops.flash_pallas import flash_attention
 
