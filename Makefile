@@ -5,7 +5,15 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast bench openapi sample-interface clean
+# build identification (reference Makefile:15 ldflags analog): export these
+# into any packaged/deployed environment so buildinfo.py reports them even
+# without a git checkout (e.g. `$(BUILDINFO_ENV) python -m tpu_docker_api`)
+BUILDINFO_ENV = \
+  TPU_DOCKER_API_VERSION=$(shell git describe --tags --always 2>/dev/null || echo dev) \
+  TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
+  TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
+
+.PHONY: all native test test-fast bench openapi sample-interface run clean
 
 all: native openapi
 
@@ -24,6 +32,9 @@ test-fast:                   ## control-plane tests only (no JAX compiles)
 
 bench:                       ## headline bench (one JSON line)
 	$(PY) bench.py
+
+run:                         ## serve with baked build identification
+	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml
 
 openapi:                     ## regenerate the OpenAPI contract
 	$(PY) -m tpu_docker_api.api.openapi > api/openapi.json.tmp

@@ -14,6 +14,14 @@ import urllib.request
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+import os
+
+# pin build identification so the generated doc is byte-reproducible
+# across machines/commits (buildinfo.py reads these before any git probe)
+os.environ["TPU_DOCKER_API_VERSION"] = "dev"
+os.environ["TPU_DOCKER_API_BRANCH"] = "main"
+os.environ["TPU_DOCKER_API_COMMIT"] = "0000000"
+
 from tpu_docker_api.config import Config
 from tpu_docker_api.daemon import Program
 

@@ -229,10 +229,13 @@ class TestGenerate:
 
 
 class TestShardedGenerate:
-    def test_generate_on_dp_tp_mesh(self, tiny):
+    def test_generate_on_dp_tp_mesh(self, tiny_f32):
         """Whole generate loop jitted over a dp=2×tp=2 mesh (8 virtual CPU
-        devices, fsdp=2 absorbing the rest): must run and match unsharded."""
-        cfg, params = tiny
+        devices, fsdp=2 absorbing the rest): must run and match unsharded.
+        f32 params: with bf16, the sharded collectives' reduction order vs
+        the unsharded matmuls rounds logits ~1e-2 apart and random-init
+        near-tie argmaxes flip — a numerics artifact, not a sharding bug."""
+        cfg, params = tiny_f32
         mesh = build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1),
                           devices=jax.devices()[:8])
         prompt = jax.random.randint(

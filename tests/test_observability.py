@@ -259,3 +259,29 @@ def test_debug_threads_dump(api_server):
     names = {t["name"] for t in threads}
     assert any(t["stack"] for t in threads)
     assert any("MainThread" in n for n in names)
+
+
+class TestBuildInfo:
+    """Reference parity: ldflags-injected BRANCH/VERSION/COMMIT
+    (cmd/gpu-docker-api/main.go:25-31) — here env-or-git resolved and
+    surfaced on /healthz."""
+
+    def test_env_override_wins(self, monkeypatch):
+        from tpu_docker_api import buildinfo
+
+        buildinfo.build_info.cache_clear()
+        monkeypatch.setenv("TPU_DOCKER_API_VERSION", "v9.9")
+        monkeypatch.setenv("TPU_DOCKER_API_BRANCH", "rel")
+        monkeypatch.setenv("TPU_DOCKER_API_COMMIT", "abc123")
+        try:
+            assert buildinfo.build_info() == {
+                "version": "v9.9", "branch": "rel", "commit": "abc123"}
+        finally:
+            buildinfo.build_info.cache_clear()
+
+    def test_fields_always_present(self):
+        from tpu_docker_api.buildinfo import build_info
+
+        info = build_info()
+        assert set(info) == {"version", "branch", "commit"}
+        assert all(isinstance(v, str) and v for v in info.values())

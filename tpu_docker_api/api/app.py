@@ -47,6 +47,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_docker_api import errors
+from tpu_docker_api.buildinfo import build_info
 from tpu_docker_api.api import codes, response
 from tpu_docker_api.schemas.container import (
     Bind,
@@ -327,7 +328,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/resources/tpus", lambda body, **_: chip_scheduler.status())
     r.add("GET", "/api/v1/resources/gpus", lambda body, **_: chip_scheduler.status())
     r.add("GET", "/api/v1/resources/ports", lambda body, **_: port_scheduler.status())
-    r.add("GET", "/healthz", lambda body, **_: {"status": "ok"})
+    r.add("GET", "/healthz",
+          lambda body, **_: {"status": "ok", **build_info()})
     if health_watcher is not None:
         # liveness transitions + auto-restart bookkeeping (SURVEY.md §5.3)
         def h_events(body, **_):

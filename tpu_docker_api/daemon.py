@@ -19,6 +19,7 @@ import signal
 import threading
 
 from tpu_docker_api import config as config_mod
+from tpu_docker_api.buildinfo import build_info
 from tpu_docker_api.api.app import ApiServer, build_router
 from tpu_docker_api.runtime import open_runtime
 from tpu_docker_api.scheduler.pod import Pod, PodHost, PodScheduler
@@ -179,9 +180,12 @@ class Program:
             health_watcher=self.health_watcher, metrics=self.metrics,
             job_svc=self.job_svc, pod_scheduler=self.pod_scheduler,
         )
+        bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
         self.api_server.start()
-        log.info("tpu-docker-api serving on %s:%d (%d chips, ports %d-%d)",
+        log.info("tpu-docker-api %s (%s@%s) serving on %s:%d "
+                 "(%d chips, ports %d-%d)",
+                 bi["version"], bi["branch"], bi["commit"],
                  self.host, self.api_server.port,
                  self.chip_scheduler.topology.n_chips,
                  self.cfg.start_port, self.cfg.end_port)
