@@ -67,6 +67,10 @@ class MoEConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     attention_impl: str = "auto"
+    # "auto" | "gather" | "einsum" — see _moe_mlp: gather/scatter dispatch
+    # on a single device, one-hot einsum dispatch (= the GSPMD all-to-all)
+    # on multi-device meshes
+    dispatch_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -155,16 +159,16 @@ def moe_init(cfg: MoEConfig, key: jax.Array) -> dict:
     }
 
 
-def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig,
-           drop_free: bool = False):
-    """Top-k routing → (dispatch (t,E,C), combine (t,E,C), aux_loss).
+def _route_topk(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig,
+                drop_free: bool = False):
+    """Top-k routing decisions: (gate_vals (t,K) f32, gate_idx (t,K),
+    pos (t,K) capacity slot, keep (t,K) mask, aux_loss, C).
 
-    Static shapes throughout: one-hot dispatch with cumsum capacity
-    assignment (GShard eq. 2), overflow tokens dropped. ``drop_free=True``
-    sets capacity = t so NO token ever drops — the decode-serving mode,
-    where capacity drops would couple co-batched requests (a token's expert
-    contribution zeroing out depending on what else is in the batch).
-    """
+    Static shapes throughout: cumsum capacity assignment (GShard eq. 2),
+    overflow tokens dropped. ``drop_free=True`` sets capacity = t so NO
+    token ever drops — the decode-serving mode, where capacity drops would
+    couple co-batched requests (a token's expert contribution zeroing out
+    depending on what else is in the batch)."""
     t = x_flat.shape[0]
     E, K = cfg.n_experts, cfg.top_k
     C = t if drop_free else cfg.capacity(t)
@@ -184,42 +188,112 @@ def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig,
     pos = jnp.sum(pos * onehots, axis=-1)                   # (t, K)
     keep = pos < C                                          # capacity mask
 
-    # dispatch: bool (t, E, C); combine: gate-weighted (t, E, C)
-    slot_onehot = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # (t, K, C)
-    disp_k = onehots.astype(jnp.float32)[..., None] * slot_onehot[:, :, None, :]
-    disp_k = disp_k * keep[:, :, None, None]
-    dispatch = jnp.sum(disp_k, axis=1)                       # (t, E, C)
-    combine = jnp.sum(disp_k * gate_vals[:, :, None, None], axis=1)
-
     # load-balance aux loss (Switch eq. 4): E * Σ_e f_e · P_e
     top1 = jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32)
     frac_tokens = jnp.mean(top1, axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac_tokens * frac_probs)
+    return gate_vals, gate_idx, pos, keep, aux, C
+
+
+def _route(x_flat: jnp.ndarray, router: jnp.ndarray, cfg: MoEConfig,
+           drop_free: bool = False):
+    """Top-k routing → (dispatch (t,E,C), combine (t,E,C), aux_loss) —
+    the einsum-dispatch form (multi-device path; see _moe_mlp)."""
+    gate_vals, gate_idx, pos, keep, aux, C = _route_topk(
+        x_flat, router, cfg, drop_free)
+    E = cfg.n_experts
+    onehots = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (t, K, E)
+
+    # dispatch: 0/1 (t, E, C); combine: gate-weighted (t, E, C). Built in
+    # the STORAGE dtype: these are the two largest tensors in the step
+    # (t·E·C — 2.7 GB each at bench shapes in f32), and f32 here made
+    # their backward cotangents f32 too (+a same-size layout copy —
+    # profiled ~20% of the MoE step). 0/1 dispatch is exact in bf16;
+    # combine carries gate weights, whose bf16 rounding is the same order
+    # as the bf16 expert outputs they multiply.
+    dt = x_flat.dtype
+    slot_onehot = jax.nn.one_hot(pos, C, dtype=dt)           # (t, K, C)
+    disp_k = onehots.astype(dt)[..., None] * slot_onehot[:, :, None, :]
+    disp_k = disp_k * keep[:, :, None, None].astype(dt)
+    dispatch = jnp.sum(disp_k, axis=1)                       # (t, E, C)
+    combine = jnp.sum(disp_k * gate_vals.astype(dt)[:, :, None, None], axis=1)
     return dispatch, combine, aux
+
+
+def _expert_swiglu(xe, layer_moe):
+    """(E, C, d) → (E, C, d) batched expert SwiGLU (shared by both
+    dispatch implementations)."""
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, layer_moe["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", xe, layer_moe["w_up"])
+    return jnp.einsum("ecf,efd->ecd", gate * up, layer_moe["w_down"])
 
 
 def _moe_mlp(x, layer_moe, cfg: MoEConfig, mesh: Mesh | None,
              drop_free: bool = False):
-    """Sparse FFN: route → all-to-all dispatch → batched expert SwiGLU →
-    all-to-all combine. Returns (out, aux_loss)."""
+    """Sparse FFN: route → dispatch → batched expert SwiGLU → combine.
+    Returns (out, aux_loss).
+
+    Two dispatch implementations, same math (the tests assert equality):
+
+    - **gather/scatter** (single-device): tokens scatter into the (E·C, d)
+      expert buffers by flat slot id and expert outputs gather back —
+      O(t·K·d) traffic. The einsum form's (t, E, C) dispatch/combine
+      tensors are the two LARGEST arrays in the whole step (2.7 GB each at
+      bench shapes) and their matmuls pure overhead; switching the bench
+      path to gather measured 2.9x tokens/s on v5e (20.1k -> 58.6k).
+    - **einsum** (multi-device): one-hot (t, E, C) contractions. Under
+      GSPMD the dispatch einsum IS the all-to-all (tokens leave their
+      data-parallel home shard for their expert's ep shard); scatter/gather
+      would make the SPMD partitioner replicate.
+    """
     b, s, d = x.shape
     x_flat = x.reshape(b * s, d)
+    impl = cfg.dispatch_impl
+    multi_device = mesh is not None and mesh.devices.size > 1
+    if impl == "auto":
+        impl = "einsum" if multi_device else "gather"
+    elif impl not in ("gather", "einsum"):
+        raise ValueError(f"unknown dispatch impl {impl!r}")
+    if impl == "gather" and multi_device:
+        # the scatter/gather path carries no sharding constraints — on a
+        # mesh GSPMD would replicate the expert buffers and compute
+        raise ValueError(
+            "dispatch_impl='gather' is single-device only; use 'auto' or "
+            "'einsum' on a multi-device mesh")
+
+    if impl == "gather":
+        gate_vals, gate_idx, pos, keep, aux, C = _route_topk(
+            x_flat, layer_moe["router"], cfg, drop_free=drop_free)
+        t = b * s
+        E, K = cfg.n_experts, cfg.top_k
+        # flat slot per (token, choice); dropped choices get DISTINCT
+        # out-of-range ids so unique_indices holds and mode="drop" elides
+        flat_slot = jnp.where(
+            keep, gate_idx * C + pos,
+            E * C + jnp.arange(t * K, dtype=jnp.int32).reshape(t, K))
+        src = jnp.broadcast_to(x_flat[:, None, :], (t, K, d))
+        xe = jnp.zeros((E * C, d), x.dtype).at[flat_slot.reshape(-1)].set(
+            src.reshape(t * K, d), mode="drop", unique_indices=True)
+        ye = _expert_swiglu(xe.reshape(E, C, d), layer_moe)
+        picked = ye.reshape(E * C, d).at[flat_slot.reshape(-1)].get(
+            mode="fill", fill_value=0).reshape(t, K, d)
+        w = (gate_vals * keep).astype(x.dtype)             # (t, K)
+        out = jnp.einsum("tk,tkd->td", w, picked)
+        return out.reshape(b, s, d), aux
+
     dispatch, combine, aux = _route(x_flat, layer_moe["router"], cfg,
                                     drop_free=drop_free)
-
     # (E, C, d) expert buffers — sharded on ep, so this einsum IS the
     # all-to-all (tokens leave their data-parallel home shard for their
     # expert's shard)
-    xe = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x_flat)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x_flat)
     if mesh is not None:
         xe = constrain(xe, mesh, P("ep", None, "fsdp"))
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, layer_moe["w_gate"]))
-    up = jnp.einsum("ecd,edf->ecf", xe, layer_moe["w_up"])
-    ye = jnp.einsum("ecf,efd->ecd", gate * up, layer_moe["w_down"])
+    ye = _expert_swiglu(xe, layer_moe)
     if mesh is not None:
         ye = constrain(ye, mesh, P("ep", None, "fsdp"))
-    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), ye)
+    out = jnp.einsum("tec,ecd->td", combine, ye)
     return out.reshape(b, s, d), aux
 
 
