@@ -190,14 +190,9 @@ def main() -> None:
     achieved_flops = tokens_per_s * flops_per_token
 
     # peak flops for the chip actually benched
-    device_kind = getattr(devices[0], "device_kind", "").lower()
-    peak = None
-    for gen_key, gen in GENERATIONS.items():
-        probe = {"v5e": ("v5 lite", "v5e"), "v5p": ("v5p",), "v4": ("v4",),
-                 "v6e": ("v6", "trillium"), "v3": ("v3",), "v2": ("v2",)}
-        if any(p in device_kind for p in probe.get(gen_key, ())):
-            peak = gen.peak_bf16_flops
-            break
+    from tpu_docker_api.scheduler.topology import peak_bf16_flops_for
+
+    peak = peak_bf16_flops_for(devices[0])
     if peak is None:
         peak = GENERATIONS["v5e"].peak_bf16_flops if on_tpu else 1e12
     mfu = achieved_flops / peak
@@ -240,7 +235,54 @@ def main() -> None:
             result["extra"]["llama3_8b_int8_infer"] = measure_8b_inference()
         except Exception as e:
             result["extra"]["llama3_8b_int8_infer"] = {"error": str(e)[:200]}
+        gc.collect()  # drop the 8 GB serving weights before the next rider
+        result["extra"]["families"] = measure_family_trains()
     print(json.dumps(result))
+
+
+def measure_family_trains() -> dict:
+    """Secondary family throughputs for the BENCH artifact: ViT-B/16
+    (non-causal, MFU vs this chip's peak) and bench-moe (sparse, gather
+    dispatch). Shared harness: train.benchlib.time_train_steps. Each
+    family measures independently — one failing must not erase the other
+    (same rule as check_8b_inference's per-batch OOM handling)."""
+    import gc
+
+    import jax
+
+    from tpu_docker_api.scheduler.topology import peak_bf16_flops_for
+    from tpu_docker_api.train.benchlib import time_train_steps
+    from tpu_docker_api.train.trainer import synthetic_batch
+
+    out = {}
+    peak = peak_bf16_flops_for(jax.devices()[0]) or 197e12
+
+    try:
+        from tpu_docker_api.models.vit import vit_presets, vit_synthetic_batch
+
+        vcfg = vit_presets()["vit-b16"]
+        r = time_train_steps(
+            vcfg, vit_synthetic_batch(jax.random.PRNGKey(1), 128, vcfg))
+        ips = r["steps_per_sec"] * 128
+        out["vit_b16"] = {"images_per_sec": round(ips),
+                          "mfu": round(vcfg.flops_per_image() * ips / peak, 3)}
+    except Exception as e:
+        out["vit_b16"] = {"error": str(e)[:160]}
+    gc.collect()
+
+    try:
+        from tpu_docker_api.models.moe import moe_presets
+
+        mcfg = moe_presets()["bench-moe"]
+        r = time_train_steps(
+            mcfg, synthetic_batch(jax.random.PRNGKey(1), 8, 2048,
+                                  mcfg.vocab_size), steps=6)
+        out["bench_moe"] = {
+            "tokens_per_sec": round(r["steps_per_sec"] * 8 * 2048)}
+    except Exception as e:
+        out["bench_moe"] = {"error": str(e)[:160]}
+    gc.collect()
+    return out
 
 
 def measure_8b_inference() -> dict:

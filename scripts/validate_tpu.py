@@ -103,33 +103,22 @@ def check_long_context() -> bool:
 
 
 def _bench_train(name: str, cfg, batch: int, seq: int, n: int) -> bool:
-    """Shared train-step bench harness: build, 2-step compile+warmup, timed
-    loop with a host read forcing real completion, one JSON line."""
+    """One JSON line of train throughput via the shared harness
+    (train.benchlib.time_train_steps — same timing discipline as the
+    bench.py riders, so the two entry points cannot drift)."""
     import math
 
     import jax
 
-    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
-    from tpu_docker_api.train.trainer import (
-        create_train_state, make_train_step, synthetic_batch)
+    from tpu_docker_api.train.benchlib import time_train_steps
+    from tpu_docker_api.train.trainer import synthetic_batch
 
-    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
-                      devices=jax.devices()[:1])
-    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, mesh, opt)
     tokens = synthetic_batch(jax.random.PRNGKey(1), batch, seq,
                              cfg.vocab_size)
-    for _ in range(2):
-        state, metrics = step(state, tokens)
-    float(metrics["loss"])  # host read: force real completion
-    t0 = time.perf_counter()
-    for _ in range(n):
-        state, metrics = step(state, tokens)
-    loss = float(metrics["loss"])
-    dt = time.perf_counter() - t0
-    return _emit(name, math.isfinite(loss),
-                 tokens_per_sec=round(n * batch * seq / dt),
-                 loss=round(loss, 3))
+    r = time_train_steps(cfg, tokens, steps=n)
+    return _emit(name, math.isfinite(r["loss"]),
+                 tokens_per_sec=round(r["steps_per_sec"] * batch * seq),
+                 loss=round(r["loss"], 3))
 
 
 def check_train_step() -> bool:
@@ -388,30 +377,19 @@ def check_vit_train() -> bool:
     import jax
 
     from tpu_docker_api.models.vit import vit_presets, vit_synthetic_batch
-    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
-    from tpu_docker_api.train.trainer import create_train_state, make_train_step
+    from tpu_docker_api.scheduler.topology import peak_bf16_flops_for
+    from tpu_docker_api.train.benchlib import time_train_steps
 
     cfg = vit_presets()["vit-b16"]
     batch_n = 128
-    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
-                      devices=jax.devices()[:1])
-    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
-    step = make_train_step(cfg, mesh, opt)
-    batch = vit_synthetic_batch(jax.random.PRNGKey(1), batch_n, cfg)
-    for _ in range(2):
-        state, m = step(state, batch)
-    float(m["loss"])
-    t0 = time.perf_counter()
-    n = 8
-    for _ in range(n):
-        state, m = step(state, batch)
-    loss = float(m["loss"])
-    dt = time.perf_counter() - t0
-    ips = n * batch_n / dt
-    mfu = cfg.flops_per_image() * ips / 197e12
-    return _emit("vit_train_b16", math.isfinite(loss) and mfu > 0.38,
+    r = time_train_steps(
+        cfg, vit_synthetic_batch(jax.random.PRNGKey(1), batch_n, cfg))
+    ips = r["steps_per_sec"] * batch_n
+    peak = peak_bf16_flops_for(jax.devices()[0]) or 197e12
+    mfu = cfg.flops_per_image() * ips / peak
+    return _emit("vit_train_b16", math.isfinite(r["loss"]) and mfu > 0.38,
                  images_per_sec=round(ips), mfu=round(mfu, 3),
-                 loss=round(loss, 3))
+                 loss=round(r["loss"], 3))
 
 
 def check_8b_inference() -> bool:

@@ -30,6 +30,21 @@ class Generation:
 
 _GB = 1024**3
 
+#: device_kind substrings → generation key, for peak-FLOPs lookup from a
+#: live jax device (bench.py and the hardware checks share this)
+_KIND_PROBE = {"v5e": ("v5 lite", "v5e"), "v5p": ("v5p",), "v4": ("v4",),
+               "v6e": ("v6", "trillium"), "v3": ("v3",), "v2": ("v2",)}
+
+
+def peak_bf16_flops_for(device) -> float | None:
+    """Per-chip peak bf16 FLOP/s for a live jax device, or None if the
+    device kind matches no known TPU generation."""
+    kind = getattr(device, "device_kind", "").lower()
+    for gen_key, gen in GENERATIONS.items():
+        if any(p in kind for p in _KIND_PROBE.get(gen_key, ())):
+            return gen.peak_bf16_flops
+    return None
+
 GENERATIONS: dict[str, Generation] = {
     "v2":  Generation("v2", 2, (2, 2, 1), 16 * _GB, 46e12, 2),
     "v3":  Generation("v3", 2, (2, 2, 1), 32 * _GB, 123e12, 2),

@@ -1,0 +1,35 @@
+"""Shared single-chip training-throughput harness.
+
+One timing discipline for every train bench (bench.py riders,
+scripts/validate_tpu.py checks): build on ONE device, warmup (first step
+compiles), then a timed loop closed by a device→host read —
+``block_until_ready`` has been seen returning early on remote-tunneled
+platforms, and a host value transfer cannot lie.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def time_train_steps(cfg, batch_data, steps: int = 8, warmup: int = 2) -> dict:
+    """{"steps_per_sec", "loss"} for ``cfg`` trained on ``batch_data``
+    (token array or tuple batch) on one device."""
+    import jax
+
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.trainer import create_train_state, make_train_step
+
+    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                      devices=jax.devices()[:1])
+    state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, mesh, opt)
+    for _ in range(max(warmup, 1)):
+        state, metrics = step(state, batch_data)
+    float(metrics["loss"])  # host read: force real completion
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return {"steps_per_sec": steps / dt, "loss": loss}
