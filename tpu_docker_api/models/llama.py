@@ -177,10 +177,13 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
                       layer["attn"]["wo"]), (k_all, v_all)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
-    if cfg.attention_impl == "ring":
+    if cfg.attention_impl in ("ring", "ring-zigzag"):
         from tpu_docker_api.parallel.ring import ring_attention
 
-        out = ring_attention(q, k, v, mesh, causal=True)
+        out = ring_attention(
+            q, k, v, mesh, causal=True,
+            placement="zigzag" if cfg.attention_impl == "ring-zigzag"
+            else "contiguous")
     elif cfg.attention_impl == "ulysses":
         from tpu_docker_api.parallel.ulysses import ulysses_attention
 

@@ -13,12 +13,13 @@ Causal efficiency: a k/v chunk that originates entirely AFTER the q chunk
 score/PV matmuls for that block never execute. Device i therefore computes
 exactly i+1 of the n blocks — Σ(i+1) = n(n+1)/2 total vs n² for the
 non-causal path, ~half the block-work at large n (verified by the
-block-count tests). The residual cost of this layout is per-step imbalance:
-the device holding the first q chunk computes 1 block while the last
-computes n (the classic ring-causal skew; zigzag/striped placement — each
-device holding a head stripe AND a tail stripe — is the standard rebalance
-and would need the whole model to run on a permuted sequence order with
-explicit per-token positions; revisit if sp-heavy meshes dominate).
+block-count tests). The contiguous layout's residual cost is
+per-step imbalance: the device holding the first q chunk computes 1 block
+while the last computes n. ``placement="zigzag"`` fixes that skew: each
+device holds a head stripe AND a tail stripe (exchanged with two
+ppermutes inside the shard_map — no model-side changes, since rope is
+applied before the ring), making per-device causal work exactly uniform
+(2n+1 half-stripe pairs each; the tests assert it).
 """
 
 from __future__ import annotations
@@ -37,6 +38,35 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def _osm_update(carry, qg, k_blk, v_blk, scale, row_base, col_base, masked):
+    """One online-softmax accumulation of q-block × kv-block — the single
+    numerics body shared by both stripe placements. Dot operands stay in
+    the storage dtype (bf16 → full-rate MXU) with f32 stats/accumulation;
+    the p·v dot downcasts p like the flash kernels do (NOT like
+    dense_attention, which keeps f32 probs for cache-dtype-independent
+    serving numerics) — in bf16 this costs up to ~1e-3 relative vs the
+    dense reference. ``row_base``/``col_base`` are absolute token offsets
+    for the causal mask; ``masked=False`` skips mask construction for
+    blocks known fully visible. Carry is (acc, m, l, n_blocks)."""
+    acc, m_prev, l_prev, nblk = carry
+    nq, nk = qg.shape[1], k_blk.shape[1]
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    if masked:
+        rows = row_base + lax.broadcasted_iota(jnp.int32, (nq, nk), 0)
+        cols = col_base + lax.broadcasted_iota(jnp.int32, (nq, nk), 1)
+        s = jnp.where((rows >= cols)[None, None, None], s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum(
+        "bhgqk,bkhd->bhgqd", p.astype(v_blk.dtype), v_blk,
+        preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new, nblk + 1
+
+
 def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     """Per-device body under shard_map. Shapes are the local chunks.
     Returns (out, blocks) where ``blocks`` is a (1,) int32 count of (q,k)
@@ -48,12 +78,7 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     h_kv = k.shape[2]
     group = h // h_kv
 
-    # dot operands stay in the storage dtype (bf16 → full-rate MXU), with
-    # f32 stats/accumulation. The p·v dot downcasts p like the flash
-    # kernels do (NOT like dense_attention, which keeps f32 probs for
-    # cache-dtype-independent serving numerics) — in bf16 this costs up to
-    # ~1e-3 relative vs the dense reference
-    qg = q.reshape(b, sq, h_kv, group, d)
+    qg = q.reshape(b, sq, h_kv, group, d)  # numerics: _osm_update
 
     acc0 = jnp.zeros((b, h_kv, group, sq, d), jnp.float32)
     m0 = jnp.full((b, h_kv, group, sq, 1), _NEG_INF, jnp.float32)
@@ -63,30 +88,14 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     def accumulate(step, carry, k_blk, v_blk):
         """Online-softmax update against the chunk currently held, which
         originated on device (my_idx - step) mod n. Fully-masked causal
-        blocks (src entirely after q) skip the matmuls via lax.cond."""
+        blocks (src entirely after q) skip the matmuls via lax.cond; only
+        the diagonal block is partially masked, but the where() is an
+        identity on fully-visible blocks so one masked body serves both."""
         src_idx = (my_idx - step) % n
 
-        def compute(carry):
-            acc, m_prev, l_prev, nblk = carry
-            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_blk,
-                           preferred_element_type=jnp.float32) * scale
-            if causal:
-                # only the diagonal block is partially masked; src < my
-                # blocks are fully visible and the where() is identity
-                rows = my_idx * sq + lax.broadcasted_iota(
-                    jnp.int32, (sq, sk), 0)
-                cols = src_idx * sk + lax.broadcasted_iota(
-                    jnp.int32, (sq, sk), 1)
-                s = jnp.where((rows >= cols)[None, None, None], s, _NEG_INF)
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m_prev, m_cur)
-            p = jnp.exp(s - m_new)
-            alpha = jnp.exp(m_prev - m_new)
-            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-            acc_new = acc * alpha + jnp.einsum(
-                "bhgqk,bkhd->bhgqd", p.astype(v_blk.dtype), v_blk,
-                preferred_element_type=jnp.float32)
-            return acc_new, m_new, l_new, nblk + 1
+        def compute(c):
+            return _osm_update(c, qg, k_blk, v_blk, scale,
+                               my_idx * sq, src_idx * sk, masked=causal)
 
         if not causal:
             return compute(carry)
@@ -112,6 +121,123 @@ def _ring_attn_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     return out, nblk.reshape(1)
 
 
+def _zigzag_exchange(x, axis_name: str, n, my_idx, inverse: bool = False):
+    """Contiguous chunks ↔ zigzag stripes, entirely inside shard_map.
+
+    Split the global sequence into 2n stripes. Contiguously-sharded device
+    a holds stripes (2a, 2a+1); zigzag device d holds (d, 2n-1-d) — a HEAD
+    stripe and a TAIL stripe, so causal work is identical on every device.
+    The exchange is two ``ppermute``s (one per local half) plus a parity
+    select; the inverse applies the inverted permutations. Works because
+    rope is applied BEFORE ring attention — stripes carry their positional
+    encoding with them, and only the mask bookkeeping needs stripe ids.
+    """
+    half = x.shape[1] // 2
+
+    def t(s: int) -> int:  # zigzag owner of global stripe s
+        return s if s < n else 2 * n - 1 - s
+
+    perm_lo = [(a, t(2 * a)) for a in range(n)]
+    perm_hi = [(a, t(2 * a + 1)) for a in range(n)]
+    even = my_idx % 2 == 0
+    if not inverse:
+        r_lo = lax.ppermute(x[:, :half], axis_name, perm_lo)
+        r_hi = lax.ppermute(x[:, half:], axis_name, perm_hi)
+        # device d's stripes (d, 2n-1-d): the even-id one arrived via the
+        # lo permutation, the odd-id one via the hi permutation
+        first = jnp.where(even, r_lo, r_hi)     # stripe d
+        second = jnp.where(even, r_hi, r_lo)    # stripe 2n-1-d
+        return jnp.concatenate([first, second], axis=1)
+    first, second = x[:, :half], x[:, half:]
+    r_lo = jnp.where(even, first, second)
+    r_hi = jnp.where(even, second, first)
+    lo = lax.ppermute(r_lo, axis_name, [(d, a) for a, d in perm_lo])
+    hi = lax.ppermute(r_hi, axis_name, [(d, a) for a, d in perm_hi])
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def _ring_attn_zigzag(q, k, v, *, axis_name: str, scale: float):
+    """Causal ring attention on zigzag stripes — per-device block-work is
+    EXACTLY uniform (2n+1 half-stripe pairs each, vs 1..n whole blocks on
+    the contiguous layout), so no device idles while the ring rotates.
+
+    Device i holds q stripes (i, 2n-1-i); the rotating kv carries stripes
+    (j, 2n-1-j) from src j. Of the four (q-stripe, kv-stripe) pairs:
+    head×head runs iff i ≥ j (diagonal masked), tail×head always runs
+    unmasked, tail×tail runs iff j ≥ i (diagonal masked), head×tail can
+    never attend and is never computed."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    if sq % 2:
+        raise ValueError(f"zigzag needs an even local seq, got {sq}")
+    if k.shape[1] != sq:
+        raise ValueError(
+            f"zigzag needs equal q/kv seq (stripe boundaries are shared), "
+            f"got q={sq} kv={k.shape[1]}; use placement='contiguous'")
+    half = sq // 2
+    h_kv = k.shape[2]
+    group = h // h_kv
+
+    q = _zigzag_exchange(q, axis_name, n, my_idx)
+    k = _zigzag_exchange(k, axis_name, n, my_idx)
+    v = _zigzag_exchange(v, axis_name, n, my_idx)
+    qg = q.reshape(b, sq, h_kv, group, d)
+    q1, q2 = qg[:, :half], qg[:, half:]          # stripes i, 2n-1-i
+
+    def fresh():
+        return (jnp.zeros((b, h_kv, group, half, d), jnp.float32),
+                jnp.full((b, h_kv, group, half, 1), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h_kv, group, half, 1), jnp.float32))
+
+    def accumulate(carry, qh, k_blk, v_blk, row_stripe, col_stripe, masked):
+        # only diagonal stripe pairs need the triangle mask
+        return _osm_update(carry, qh, k_blk, v_blk, scale,
+                           row_stripe * half, col_stripe * half, masked)
+
+    def step_compute(step, c1, c2, k_blk, v_blk):
+        src = (my_idx - step) % n
+        k1, k2 = k_blk[:, :half], k_blk[:, half:]
+        v1, v2 = v_blk[:, :half], v_blk[:, half:]
+        # head×head: stripes (i, j) — masked only on the diagonal
+        c1 = lax.cond(
+            my_idx >= src,
+            lambda c: accumulate(c, q1, k1, v1, my_idx, src, True),
+            lambda c: c, c1)
+        # tail×head: stripe 2n-1-i ≥ n > stripe j — always full
+        c2 = accumulate(c2, q2, k1, v1, 2 * n - 1 - my_idx, src, False)
+        # tail×tail: stripes (2n-1-i, 2n-1-j) — attends iff j ≥ i
+        c2 = lax.cond(
+            src >= my_idx,
+            lambda c: accumulate(c, q2, k2, v2, 2 * n - 1 - my_idx,
+                                 2 * n - 1 - src, True),
+            lambda c: c, c2)
+        return c1, c2
+
+    def body(step, carry):
+        c1, c2, k_blk, v_blk = carry
+        c1, c2 = step_compute(step, c1, c2, k_blk, v_blk)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return (c1, c2, lax.ppermute(k_blk, axis_name, perm),
+                lax.ppermute(v_blk, axis_name, perm))
+
+    nblk0 = jnp.zeros((), jnp.int32)
+    c1, c2, k_last, v_last = lax.fori_loop(
+        0, n - 1, body, ((*fresh(), nblk0), (*fresh(), nblk0), k, v))
+    c1, c2 = step_compute(n - 1, c1, c2, k_last, v_last)
+
+    def finish(c):
+        acc, m, l, nblk = c
+        out = acc / jnp.maximum(l, 1e-30)
+        return out.transpose(0, 3, 1, 2, 4).reshape(b, half, h, d), nblk
+
+    o1, n1 = finish(c1)
+    o2, n2 = finish(c2)
+    out = jnp.concatenate([o1, o2], axis=1).astype(q.dtype)
+    out = _zigzag_exchange(out, axis_name, n, my_idx, inverse=True)
+    return out, (n1 + n2).reshape(1)
+
+
 def ring_attention(
     q: jnp.ndarray,  # (batch, seq, num_heads, head_dim), seq sharded on sp
     k: jnp.ndarray,
@@ -120,6 +246,7 @@ def ring_attention(
     causal: bool = True,
     axis_name: str = "sp",
     with_block_counts: bool = False,
+    placement: str = "contiguous",
 ):
     """Exact causal attention with the sequence axis sharded over ``sp``.
 
@@ -128,16 +255,33 @@ def ring_attention(
 
     ``with_block_counts=True`` additionally returns the per-ring-position
     (q,k) block-compute counts, shape (sp,) — the causal-skip accounting
-    the efficiency tests assert on.
+    the efficiency tests assert on. (Zigzag counts are half-stripe pairs,
+    a quarter of a contiguous block each.)
+
+    ``placement="zigzag"`` (causal only): exchange to head+tail stripe
+    pairs inside the shard_map so every device computes the SAME amount of
+    causal work per ring step — removes the 1..n per-device skew of the
+    contiguous layout at the cost of two extra half-activation ppermutes
+    in and out. Prefer it when sp is large and causal.
     """
     head_dim = q.shape[-1]
     spec = P(("dp", "fsdp"), axis_name, "tp", None)
-    local = functools.partial(
-        _ring_attn_local,
-        axis_name=axis_name,
-        causal=causal,
-        scale=1.0 / (head_dim**0.5),
-    )
+    if placement not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown placement {placement!r}")
+    if placement == "zigzag":
+        if not causal:
+            raise ValueError("zigzag placement is for causal attention; "
+                             "non-causal has no skew to fix")
+        local = functools.partial(
+            _ring_attn_zigzag, axis_name=axis_name,
+            scale=1.0 / (head_dim**0.5))
+    else:
+        local = functools.partial(
+            _ring_attn_local,
+            axis_name=axis_name,
+            causal=causal,
+            scale=1.0 / (head_dim**0.5),
+        )
     kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec),
                   out_specs=(spec, P(axis_name)))
     try:  # jax >= 0.8 renamed check_rep -> check_vma
