@@ -271,6 +271,22 @@ def measure_family_trains() -> dict:
     gc.collect()
 
     try:
+        from tpu_docker_api.models.encdec import (
+            encdec_presets, encdec_synthetic_batch)
+
+        ecfg = encdec_presets()["encdec-base"]
+        r = time_train_steps(
+            ecfg, encdec_synthetic_batch(jax.random.PRNGKey(1), 32, 512,
+                                         512, ecfg), steps=6)
+        pairs = r["steps_per_sec"] * 32
+        out["encdec_base"] = {
+            "pairs_per_sec": round(pairs, 1),
+            "mfu": round(ecfg.flops_per_pair(512, 512) * pairs / peak, 3)}
+    except Exception as e:
+        out["encdec_base"] = {"error": str(e)[:160]}
+    gc.collect()
+
+    try:
         from tpu_docker_api.models.moe import moe_presets
 
         mcfg = moe_presets()["bench-moe"]

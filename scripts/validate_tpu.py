@@ -7,8 +7,8 @@ exercised here instead. Run on any machine with a TPU attached:
     python scripts/validate_tpu.py            # all checks
     python scripts/validate_tpu.py --fast     # skip the long-running checks
                                               # (32k sweep, 8k chunked-CE
-                                              # train, MoE bench train, ViT
-                                              # train, speculative mechanism
+                                              # train, MoE bench train, ViT +
+                                              # encdec train, speculative mechanism
                                               # + trained-draft speedup,
                                               # llama3-8b int8 serving)
 
@@ -392,6 +392,37 @@ def check_vit_train() -> bool:
                  loss=round(r["loss"], 3))
 
 
+def check_encdec_train() -> bool:
+    """Encoder-decoder (cross-attention) family training throughput —
+    encdec-base (T5-base-class, rope positions) at batch 32, S=T=512.
+    2026-07 v5e: 66-67 pairs/s, MFU 0.31 (per the corrected
+    flops_per_pair; an earlier double-counted formula briefly read 0.40).
+    Below the 0.40 llama/ViT bar — the short-tgt vocab head and the
+    S=T=512 attention share dominate; untuned first measurement. Gate
+    0.28: regression tripwire under ±2% run noise."""
+    import math
+
+    import jax
+
+    from tpu_docker_api.models.encdec import (
+        encdec_presets, encdec_synthetic_batch)
+    from tpu_docker_api.scheduler.topology import peak_bf16_flops_for
+    from tpu_docker_api.train.benchlib import time_train_steps
+
+    cfg = encdec_presets()["encdec-base"]
+    batch, S, T = 32, 512, 512
+    r = time_train_steps(
+        cfg, encdec_synthetic_batch(jax.random.PRNGKey(1), batch, S, T, cfg),
+        steps=6)
+    pairs = r["steps_per_sec"] * batch
+    peak = peak_bf16_flops_for(jax.devices()[0]) or 197e12
+    mfu = cfg.flops_per_pair(S, T) * pairs / peak
+    return _emit("encdec_train_base", math.isfinite(r["loss"]) and mfu > 0.28,
+                 pairs_per_sec=round(pairs, 1),
+                 tgt_tokens_per_sec=round(pairs * T), mfu=round(mfu, 3),
+                 loss=round(r["loss"], 3))
+
+
 def check_8b_inference() -> bool:
     """The north-star model size on one chip (BASELINE.json metric:
     'Llama-8B tokens/sec/chip'): llama3-8b int8-quantized serving — ~8 GB
@@ -435,8 +466,8 @@ def main() -> int:
                         help="skip the long-running checks (32k "
                              "long-context sweep, seq-8192 chunked-CE "
                              "train, MoE bench train, speculative "
-                             "mechanism + trained-draft speedup, ViT "
-                             "train, llama3-8b int8 serving)")
+                             "mechanism + trained-draft speedup, ViT + "
+                             "encdec train, llama3-8b int8 serving)")
     args = parser.parse_args()
 
     checks = [check_device, check_flash_correctness, check_train_step,
@@ -446,6 +477,7 @@ def main() -> int:
         checks.insert(4, check_long_seq_train)
         checks.append(check_moe_train)
         checks.append(check_vit_train)
+        checks.append(check_encdec_train)
         checks.append(check_speculative_mechanism)
         checks.append(check_speculative_trained)
         checks.append(check_8b_inference)

@@ -2,8 +2,9 @@
 
 The reference ships no models (SURVEY.md §0) — these are the TPU-native
 workloads: the Llama family (pretrain/inference north star), the Mixtral-style
-sparse MoE family (expert parallelism, SURVEY.md §2.3), and the MNIST MLP
-(single-chip smoke config #2). Pure-functional JAX: params are nested dicts,
+sparse MoE family (expert parallelism, SURVEY.md §2.3), the ViT family
+(non-causal encoder), the encoder-decoder family (cross-attention,
+seq2seq), and the MNIST MLP (single-chip smoke config #2). Pure-functional JAX: params are nested dicts,
 forward passes are jit/pjit-compatible functions, sharding comes from
 ``parallel.sharding`` rules rather than framework metadata.
 
@@ -30,6 +31,12 @@ from tpu_docker_api.models.vit import (  # noqa: F401
     vit_init,
     vit_presets,
 )
+from tpu_docker_api.models.encdec import (  # noqa: F401
+    EncDecConfig,
+    encdec_forward,
+    encdec_init,
+    encdec_presets,
+)
 
 
 def model_fns(cfg):
@@ -37,6 +44,7 @@ def model_fns(cfg):
     ``batch`` is whatever the family trains on: a token array for the
     decoder families, an (images, labels) tuple for ViT — the trainer
     shards any batch pytree on its leading axis."""
+    from tpu_docker_api.models.encdec import ENCDEC_RULES, encdec_loss
     from tpu_docker_api.models.llama import llama_loss
     from tpu_docker_api.models.moe import MOE_RULES, moe_loss
     from tpu_docker_api.models.vit import VIT_RULES, vit_loss
@@ -48,6 +56,8 @@ def model_fns(cfg):
         return llama_init, llama_loss, LLAMA_RULES
     if isinstance(cfg, ViTConfig):
         return vit_init, vit_loss, VIT_RULES
+    if isinstance(cfg, EncDecConfig):
+        return encdec_init, encdec_loss, ENCDEC_RULES
     raise TypeError(f"no model registered for config type {type(cfg)!r}")
 
 

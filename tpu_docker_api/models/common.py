@@ -1,0 +1,13 @@
+"""Shared model-construction helpers (one source of truth across families)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def trunc_normal_init(key, shape, fan_in, dtype):
+    """Truncated-normal fan-in initializer every family uses: N(0, 1/fan_in)
+    clipped at ±2σ, drawn in f32 and cast to the storage dtype."""
+    return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+            * (fan_in**-0.5)).astype(dtype)

@@ -1,0 +1,305 @@
+"""Encoder-decoder (T5-class) seq2seq transformer — the cross-attention
+family.
+
+The fourth in-tree workload family (reference ships none, SURVEY.md §0),
+covering the one architecture surface Llama/MoE/ViT do not: a
+bidirectional encoder feeding a causal decoder through CROSS-attention.
+What it exercises that the others cannot:
+
+- cross-attention: decoder queries against encoder keys/values — kv seq
+  length differs from q seq length, no causal mask, no rope on the cross
+  path (positions live in the self-attention paths on each side);
+- two heterogeneous layer stacks in one model (scan+remat each);
+- seq2seq batches: (src_tokens, tgt_tokens) tuples through the generic
+  trainer, like ViT's (images, labels).
+
+TPU-first choices follow the house style (models/llama.py): stacked
+layers + ``lax.scan``, bf16 storage with f32 norms/softmax/logits,
+Megatron column/row sharding rules over (fsdp, tp), rope for positions
+(no learned-position or relative-bias tables — rope is free of the
+(S, T) bias matmuls T5 pays and rides the same ops/rope.py path the
+other families use), shared src/tgt embedding, ``embed_lookup`` for the
+tp-sharded vocab gather. Sequence parallelism is not wired for this
+family (cross-attention under sp needs a gathered encoder output; use
+dp/fsdp/tp meshes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_docker_api.models.common import trunc_normal_init
+from tpu_docker_api.models.llama import cross_entropy, embed_lookup
+from tpu_docker_api.ops.attention import multihead_attention
+from tpu_docker_api.ops.norms import rms_norm
+from tpu_docker_api.ops.quant import linear
+from tpu_docker_api.ops.rope import apply_rope, rope_frequencies
+from tpu_docker_api.parallel.sharding import constrain
+
+#: suffix rules (parallel/sharding.py): both stacks' projections are
+#: Megatron column/row over (fsdp, tp); scan axis never sharded
+ENCDEC_RULES: list[tuple[str, P]] = [
+    ("embed/tokens",            P("tp", "fsdp")),
+    ("enc_layers/attn/wo",      P(None, "tp", "fsdp")),
+    ("enc_layers/attn/w*",      P(None, "fsdp", "tp")),
+    ("enc_layers/mlp/w_down",   P(None, "tp", "fsdp")),
+    ("enc_layers/mlp/w*",       P(None, "fsdp", "tp")),
+    ("dec_layers/*attn/wo",     P(None, "tp", "fsdp")),
+    ("dec_layers/*attn/w*",     P(None, "fsdp", "tp")),
+    ("dec_layers/mlp/w_down",   P(None, "tp", "fsdp")),
+    ("dec_layers/mlp/w*",       P(None, "fsdp", "tp")),
+    ("*norm*",                  P()),
+    ("lm_head",                 P("fsdp", "tp")),
+    ("*",                       P()),
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class EncDecConfig:
+    vocab_size: int = 32000
+    dim: int = 768
+    enc_layers: int = 12
+    dec_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int = 12
+    ffn_dim: int = 3072
+    max_src_len: int = 512
+    max_tgt_len: int = 512
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_pair(self, src_len: int, tgt_len: int) -> float:
+        """Training FLOPs per (src, tgt) sequence pair (fwd+bwd ≈ 3×).
+        Per-row projection costs: q and o act on the query-side rows, k and
+        v on the key-side rows — which differ on the cross path (q/o on
+        tgt, k/v on src). One MLP per layer on both sides."""
+        d, hd = self.dim, self.head_dim
+        qo = 2 * 2 * d * (self.n_heads * hd)       # q + o per row
+        kv = 2 * 2 * d * (self.n_kv_heads * hd)    # k + v per row
+        mlp = 3 * 2 * d * self.ffn_dim
+        enc = self.enc_layers * (
+            src_len * (qo + kv + mlp)
+            + 2 * 2 * src_len * src_len * (self.n_heads * hd))  # full attn
+        dec = self.dec_layers * (
+            tgt_len * (qo + kv + mlp)              # self-attention + MLP
+            + 2 * 2 * tgt_len * tgt_len * (self.n_heads * hd) / 2  # causal
+            + tgt_len * qo + src_len * kv          # cross projections
+            + 2 * 2 * tgt_len * src_len * (self.n_heads * hd))     # cross
+        head = tgt_len * 2 * d * self.vocab_size
+        return 3.0 * (enc + dec + head)
+
+
+def encdec_presets() -> dict[str, EncDecConfig]:
+    return {
+        # T5-base-class geometry (~250M params), rope positions
+        "encdec-base": EncDecConfig(),
+        # CPU-fast config for tests / dryrun
+        "tiny": EncDecConfig(
+            vocab_size=256, dim=64, enc_layers=2, dec_layers=2, n_heads=4,
+            n_kv_heads=2, ffn_dim=128, max_src_len=64, max_tgt_len=64,
+            remat=False),
+    }
+
+
+def _attn_params(key, d, cfg: EncDecConfig, L):
+    hd = cfg.head_dim
+    ks = jax.random.split(key, 4)
+
+    def init(k, shape, fan_in):
+        return trunc_normal_init(k, shape, fan_in, cfg.dtype)
+
+    return {
+        "wq": init(ks[0], (L, d, cfg.n_heads * hd), d),
+        "wk": init(ks[1], (L, d, cfg.n_kv_heads * hd), d),
+        "wv": init(ks[2], (L, d, cfg.n_kv_heads * hd), d),
+        "wo": init(ks[3], (L, cfg.n_heads * hd, d), cfg.n_heads * hd),
+    }
+
+
+def encdec_init(cfg: EncDecConfig, key: jax.Array) -> dict:
+    d = cfg.dim
+    k_embed, k_enc, k_dec_self, k_dec_cross, k_mlps, k_head = (
+        jax.random.split(key, 6))
+
+    def init(k, shape, fan_in):
+        return trunc_normal_init(k, shape, fan_in, cfg.dtype)
+
+    def mlp_params(k, L):
+        ks = jax.random.split(k, 3)
+        return {
+            "w_gate": init(ks[0], (L, d, cfg.ffn_dim), d),
+            "w_up": init(ks[1], (L, d, cfg.ffn_dim), d),
+            "w_down": init(ks[2], (L, cfg.ffn_dim, d), cfg.ffn_dim),
+        }
+
+    km_enc, km_dec = jax.random.split(k_mlps)
+    Le, Ld = cfg.enc_layers, cfg.dec_layers
+    return {
+        "embed": {"tokens": init(k_embed, (cfg.vocab_size, d), d)},
+        "enc_layers": {
+            "attn_norm": jnp.ones((Le, d), cfg.dtype),
+            "mlp_norm": jnp.ones((Le, d), cfg.dtype),
+            "attn": _attn_params(k_enc, d, cfg, Le),
+            "mlp": mlp_params(km_enc, Le),
+        },
+        "dec_layers": {
+            "self_norm": jnp.ones((Ld, d), cfg.dtype),
+            "cross_norm": jnp.ones((Ld, d), cfg.dtype),
+            "mlp_norm": jnp.ones((Ld, d), cfg.dtype),
+            "self_attn": _attn_params(k_dec_self, d, cfg, Ld),
+            "cross_attn": _attn_params(k_dec_cross, d, cfg, Ld),
+            "mlp": mlp_params(km_dec, Ld),
+        },
+        "enc_final_norm": jnp.ones((d,), cfg.dtype),
+        "final_norm": jnp.ones((d,), cfg.dtype),
+        "lm_head": init(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def _project_qkv(x, weights, cfg: EncDecConfig, kv_from=None):
+    """q from ``x``, k/v from ``kv_from`` (defaults to x — self-attention)."""
+    b, s, _ = x.shape
+    hd = cfg.head_dim
+    src = x if kv_from is None else kv_from
+    sk = src.shape[1]
+    q = linear(x, weights["wq"]).reshape(b, s, cfg.n_heads, hd)
+    k = linear(src, weights["wk"]).reshape(b, sk, cfg.n_kv_heads, hd)
+    v = linear(src, weights["wv"]).reshape(b, sk, cfg.n_kv_heads, hd)
+    return q, k, v
+
+
+def _mlp(x, mlp):
+    gate = jax.nn.silu(linear(x, mlp["w_gate"]))
+    up = linear(x, mlp["w_up"])
+    return linear(gate * up, mlp["w_down"])
+
+
+def _enc_block(x, layer, cfg: EncDecConfig, rope_cos, rope_sin, mesh):
+    """Bidirectional self-attention + SwiGLU, pre-norm residuals."""
+    b, s, d = x.shape
+    y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q, k, v = _project_qkv(y, layer["attn"], cfg)
+    q = apply_rope(q, rope_cos, rope_sin)
+    k = apply_rope(k, rope_cos, rope_sin)
+    out = multihead_attention(q, k, v, causal=False, probs_dtype=cfg.dtype)
+    x = x + linear(out.reshape(b, s, d), layer["attn"]["wo"])
+    x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["mlp"])
+    return constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+
+
+def _dec_block(x, enc_out, layer, cfg: EncDecConfig, rope_cos, rope_sin,
+               mesh):
+    """Causal self-attention → cross-attention over ``enc_out`` → SwiGLU.
+    Cross-attention applies no rope: relative order information lives in
+    each side's self-attention; the cross path is pure content lookup."""
+    b, s, d = x.shape
+    y = rms_norm(x, layer["self_norm"], cfg.norm_eps)
+    q, k, v = _project_qkv(y, layer["self_attn"], cfg)
+    q = apply_rope(q, rope_cos, rope_sin)
+    k = apply_rope(k, rope_cos, rope_sin)
+    out = multihead_attention(q, k, v, causal=True)
+    x = x + linear(out.reshape(b, s, d), layer["self_attn"]["wo"])
+
+    y = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
+    q, k, v = _project_qkv(y, layer["cross_attn"], cfg, kv_from=enc_out)
+    # dense pinned: q_seq != kv_seq on the cross path, which the flash
+    # kernel does not support (multihead_attention's auto also guards now)
+    out = multihead_attention(q, k, v, causal=False, impl="dense",
+                              probs_dtype=cfg.dtype)
+    x = x + linear(out.reshape(b, s, d), layer["cross_attn"]["wo"])
+    x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["mlp"])
+    return constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+
+
+def _maybe_remat(fn, cfg: EncDecConfig):
+    if not cfg.remat:
+        return fn
+    from tpu_docker_api.ops.flash_pallas import TRAIN_REMAT_POLICY
+
+    return jax.checkpoint(fn, policy=TRAIN_REMAT_POLICY)
+
+
+def encdec_encode(params, src, cfg: EncDecConfig, mesh=None):
+    """(b, S) source tokens → (b, S, d) encoder output (final-normed)."""
+    x = embed_lookup(params["embed"]["tokens"], src, mesh)
+    if mesh is not None:
+        x = constrain(x, mesh, P(("dp", "fsdp"), None))
+    rope_cos, rope_sin = rope_frequencies(
+        cfg.head_dim, src.shape[1], cfg.rope_theta)
+    block = _maybe_remat(functools.partial(
+        _enc_block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin,
+        mesh=mesh), cfg)
+
+    def body(x, layer):
+        return block(x, layer), None
+
+    x, _ = lax.scan(body, x, params["enc_layers"])
+    return rms_norm(x, params["enc_final_norm"], cfg.norm_eps).astype(
+        cfg.dtype)
+
+
+def encdec_forward(params, batch, cfg: EncDecConfig, mesh=None):
+    """((b, S) src, (b, T) tgt-input) → next-token logits (b, T, vocab)."""
+    src, tgt = batch
+    enc_out = encdec_encode(params, src, cfg, mesh)
+    x = embed_lookup(params["embed"]["tokens"], tgt, mesh)
+    if mesh is not None:
+        x = constrain(x, mesh, P(("dp", "fsdp"), None))
+    rope_cos, rope_sin = rope_frequencies(
+        cfg.head_dim, tgt.shape[1], cfg.rope_theta)
+    block = _maybe_remat(functools.partial(
+        _dec_block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin,
+        mesh=mesh), cfg)
+
+    def body(x, layer):
+        return block(x, enc_out, layer), None
+
+    x, _ = lax.scan(body, x, params["dec_layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = linear(x.astype(cfg.dtype), params["lm_head"],
+                    out_dtype=jnp.float32)
+    if mesh is not None:
+        logits = constrain(logits, mesh, P(("dp", "fsdp"), None, "tp"))
+    return logits
+
+
+def encdec_loss(params, batch, cfg: EncDecConfig, mesh=None):
+    """Teacher-forced seq2seq CE: batch = (src (b, S), tgt (b, T+1));
+    decoder consumes tgt[:, :-1] and predicts tgt[:, 1:]."""
+    src, tgt = batch
+    logits = encdec_forward(params, (src, tgt[:, :-1]), cfg, mesh)
+    return cross_entropy(logits, tgt[:, 1:])
+
+
+def encdec_synthetic_batch(key: jax.Array, batch: int, src_len: int,
+                           tgt_len: int, cfg: EncDecConfig,
+                           row_offset: int = 0):
+    """(src, tgt) synthetic pair with the same per-GLOBAL-row derivation
+    contract as vit_synthetic_batch (process-count-invariant rows)."""
+    rows = jnp.arange(row_offset, row_offset + batch)
+    keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(rows)
+
+    def one(k):
+        k1, k2 = jax.random.split(k)
+        src = jax.random.randint(k1, (src_len,), 0, cfg.vocab_size,
+                                 dtype=jnp.int32)
+        tgt = jax.random.randint(k2, (tgt_len + 1,), 0, cfg.vocab_size,
+                                 dtype=jnp.int32)
+        return src, tgt
+
+    return jax.vmap(one)(keys)

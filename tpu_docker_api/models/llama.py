@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_docker_api.models.common import trunc_normal_init
 from tpu_docker_api.ops.attention import dense_attention, multihead_attention
 from tpu_docker_api.ops.norms import rms_norm
 from tpu_docker_api.ops.quant import linear
@@ -110,8 +111,7 @@ def llama_init(cfg: LlamaConfig, key: jax.Array) -> dict:
     L = cfg.n_layers
 
     def init(key, shape, fan_in):
-        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
-                * (fan_in**-0.5)).astype(cfg.dtype)
+        return trunc_normal_init(key, shape, fan_in, cfg.dtype)
 
     ks = jax.random.split(k_layers, 7)
     params = {

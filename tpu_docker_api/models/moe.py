@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_docker_api.models.common import trunc_normal_init
 from tpu_docker_api.models.llama import (
     _attention, cross_entropy, embed_lookup, lm_head)
 from tpu_docker_api.ops.norms import rms_norm
@@ -129,8 +130,7 @@ def moe_init(cfg: MoEConfig, key: jax.Array) -> dict:
     d, hd, L, E = cfg.dim, cfg.head_dim, cfg.n_layers, cfg.n_experts
 
     def init(key, shape, fan_in):
-        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
-                * (fan_in**-0.5)).astype(cfg.dtype)
+        return trunc_normal_init(key, shape, fan_in, cfg.dtype)
 
     ks = jax.random.split(k_layers, 8)
     return {

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_docker_api.models.common import trunc_normal_init
 from tpu_docker_api.ops.attention import multihead_attention
 from tpu_docker_api.ops.norms import layer_norm
 from tpu_docker_api.ops.quant import linear
@@ -104,8 +105,7 @@ def vit_init(cfg: ViTConfig, key: jax.Array) -> dict:
     L = cfg.n_layers
 
     def init(key, shape, fan_in):
-        return (jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
-                * (fan_in**-0.5)).astype(cfg.dtype)
+        return trunc_normal_init(key, shape, fan_in, cfg.dtype)
 
     ks = jax.random.split(k_layers, 6)
     return {

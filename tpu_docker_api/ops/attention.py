@@ -88,8 +88,11 @@ def multihead_attention(
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         # seq must tile by 128; head_dim 64 works too (Mosaic pads lanes),
-        # and dense would materialize O(seq^2) scores — far worse than padding
-        aligned = q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0
+        # and dense would materialize O(seq^2) scores — far worse than
+        # padding. The kernel also assumes ONE shared seq — cross-attention
+        # (q_seq != kv_seq) must stay dense
+        aligned = (q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0
+                   and q.shape[1] == k.shape[1])
         # short NON-causal sequences run faster through XLA's fused dense
         # einsums than through the kernel (measured on ViT-B/16 @256
         # tokens, v5e: 541 vs 511 img/s) — the flash win comes from

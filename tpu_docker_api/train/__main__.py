@@ -89,6 +89,7 @@ def main(argv: list[str] | None = None) -> None:
     )
 
     is_vit = args.preset.startswith("vit:")
+    is_encdec = args.preset.startswith("encdec:")
     if args.preset.startswith("moe:"):
         cfg = moe_presets()[args.preset[4:]]
     elif is_vit:
@@ -99,9 +100,17 @@ def main(argv: list[str] | None = None) -> None:
             raise SystemExit("--data/--seq do not apply to vit: presets "
                              "(image batches are synthetic)")
         seq = cfg.n_patches  # tokens-per-image, for the throughput metric
+    elif is_encdec:
+        from tpu_docker_api.models.encdec import encdec_presets
+
+        cfg = encdec_presets()[args.preset[7:]]
+        if args.data:
+            raise SystemExit("--data does not apply to encdec: presets "
+                             "(seq2seq pairs are synthetic)")
+        seq = args.seq or min(cfg.max_tgt_len, 128)  # src_len == tgt_len
     else:
         cfg = llama_presets()[args.preset]
-    if not is_vit:
+    if not (is_vit or is_encdec):
         if args.seq:
             cfg = dataclasses.replace(cfg, max_seq_len=args.seq)
         seq = min(cfg.max_seq_len, 512) if not args.seq else args.seq
@@ -168,6 +177,16 @@ def main(argv: list[str] | None = None) -> None:
             return vit_synthetic_batch(
                 jax.random.PRNGKey(i), rows.stop - rows.start, cfg,
                 row_offset=rows.start)
+    elif is_encdec:
+        from tpu_docker_api.data.loader import rows_for_process
+        from tpu_docker_api.models.encdec import encdec_synthetic_batch
+
+        rows = rows_for_process(args.batch, jax.process_index(), n_processes)
+
+        def get_batch(i):
+            return encdec_synthetic_batch(
+                jax.random.PRNGKey(i), rows.stop - rows.start, seq, seq,
+                cfg, row_offset=rows.start)
     else:
         from tpu_docker_api.data.loader import rows_for_process
 
