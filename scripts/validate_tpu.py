@@ -395,11 +395,11 @@ def check_vit_train() -> bool:
 def check_encdec_train() -> bool:
     """Encoder-decoder (cross-attention) family training throughput —
     encdec-base (T5-base-class, rope positions) at batch 32, S=T=512.
-    2026-07 v5e: 66-67 pairs/s, MFU 0.31 (per the corrected
-    flops_per_pair; an earlier double-counted formula briefly read 0.40).
-    Below the 0.40 llama/ViT bar — the short-tgt vocab head and the
-    S=T=512 attention share dominate; untuned first measurement. Gate
-    0.28: regression tripwire under ±2% run noise."""
+    2026-07 v5e: 72 pairs/s, MFU 0.34 (corrected flops_per_pair — an
+    earlier double-counted formula briefly read 0.40; first tuning pass:
+    512-token encoder/cross attention back on the flash kernel, +10%).
+    Still below the 0.40 llama/ViT bar — the 32k-vocab head over a short
+    target dominates. Gate 0.28: regression tripwire under ±2% noise."""
     import math
 
     import jax

@@ -216,10 +216,9 @@ def _dec_block(x, enc_out, layer, cfg: EncDecConfig, rope_cos, rope_sin,
 
     y = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
     q, k, v = _project_qkv(y, layer["cross_attn"], cfg, kv_from=enc_out)
-    # dense pinned: q_seq != kv_seq on the cross path, which the flash
-    # kernel does not support (multihead_attention's auto also guards now)
-    out = multihead_attention(q, k, v, causal=False, impl="dense",
-                              probs_dtype=cfg.dtype)
+    # auto dispatch: its q_seq == kv_seq guard keeps differing-length
+    # cross shapes on dense; equal-length pairs may take the flash kernel
+    out = multihead_attention(q, k, v, causal=False, probs_dtype=cfg.dtype)
     x = x + linear(out.reshape(b, s, d), layer["cross_attn"]["wo"])
     x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
     x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["mlp"])
