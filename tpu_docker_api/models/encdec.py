@@ -302,3 +302,95 @@ def encdec_synthetic_batch(key: jax.Array, batch: int, src_len: int,
         return src, tgt
 
     return jax.vmap(one)(keys)
+
+
+def _cross_kv(params, enc_out, cfg: EncDecConfig):
+    """Precompute every decoder layer's cross-attention k/v from the
+    encoder output — they are fixed for the whole decode, so they are
+    computed once, OUTSIDE the token loop: (Ld, b, S, kvh, hd) each."""
+    b, S, _ = enc_out.shape
+    hd = cfg.head_dim
+
+    def per_layer(_, w):
+        k = linear(enc_out, w["wk"]).reshape(b, S, cfg.n_kv_heads, hd)
+        v = linear(enc_out, w["wv"]).reshape(b, S, cfg.n_kv_heads, hd)
+        return None, (k, v)
+
+    _, (ks, vs) = lax.scan(per_layer, None,
+                           params["dec_layers"]["cross_attn"])
+    return ks, vs
+
+
+def encdec_generate(
+    params: dict,
+    src: jnp.ndarray,        # (b, S) int32 source tokens
+    cfg: EncDecConfig,
+    max_new_tokens: int = 32,
+    bos_id: int = 0,
+) -> jnp.ndarray:
+    """Greedy seq2seq generation: encode once, then a KV-cached decoder
+    loop — self-attention against a (Ld, b, T, kvh, hd) cache written one
+    position per step, cross-attention against the precomputed encoder
+    k/v. Returns (b, max_new_tokens) int32. Jit-compatible (one compile
+    per (b, S, max_new_tokens) shape)."""
+    from tpu_docker_api.ops.attention import dense_attention
+
+    b, _ = src.shape
+    d, hd = cfg.dim, cfg.head_dim
+    Ld, n_kv = cfg.dec_layers, cfg.n_kv_heads
+    enc_out = encdec_encode(params, src, cfg)
+    cross_k, cross_v = _cross_kv(params, enc_out, cfg)
+    rope_cos, rope_sin = rope_frequencies(hd, max_new_tokens, cfg.rope_theta)
+
+    k_cache = jnp.zeros((Ld, b, max_new_tokens, n_kv, hd), cfg.dtype)
+    v_cache = jnp.zeros_like(k_cache)
+
+    def dec_step(carry, _):
+        tok, k_cache, v_cache, step = carry
+        x = embed_lookup(params["embed"]["tokens"], tok[:, None], None)
+
+        def layer_body(inner, packed):
+            x, k_cache, v_cache = inner
+            layer, layer_idx, ck, cv = packed
+            y = rms_norm(x, layer["self_norm"], cfg.norm_eps)
+            q, k, v = _project_qkv(y, layer["self_attn"], cfg)
+            pos = jnp.full((b, 1), step, jnp.int32)
+            q = apply_rope(q, rope_cos, rope_sin, pos)
+            k = apply_rope(k, rope_cos, rope_sin, pos)
+            zero = jnp.int32(0)
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype)[None],
+                (layer_idx, zero, step, zero, zero))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype)[None],
+                (layer_idx, zero, step, zero, zero))
+            kc = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, False)
+            vc = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, False)
+            out = dense_attention(q, kc, vc, causal=True, q_offset=step)
+            x = x + linear(out.reshape(b, 1, d), layer["self_attn"]["wo"])
+
+            y = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
+            # q only: the cross k/v were precomputed once by _cross_kv —
+            # projecting them again from enc_out here would cost two full
+            # (b, S, d) matmuls per layer per generated token
+            q = linear(y, layer["cross_attn"]["wq"]).reshape(
+                b, 1, cfg.n_heads, hd)
+            out = dense_attention(q, ck, cv, causal=False)
+            x = x + linear(out.reshape(b, 1, d), layer["cross_attn"]["wo"])
+            x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps),
+                         layer["mlp"])
+            return (x, k_cache, v_cache), None
+
+        (x, k_cache, v_cache), _ = lax.scan(
+            layer_body, (x, k_cache, v_cache),
+            (params["dec_layers"], jnp.arange(Ld), cross_k, cross_v))
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = linear(x.astype(cfg.dtype), params["lm_head"],
+                        out_dtype=jnp.float32)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, k_cache, v_cache, step + 1), nxt
+
+    start = jnp.full((b,), bos_id, jnp.int32)
+    _, toks = lax.scan(dec_step, (start, k_cache, v_cache, jnp.int32(0)),
+                       None, length=max_new_tokens)
+    return toks.transpose(1, 0)  # (b, max_new_tokens)
