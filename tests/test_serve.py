@@ -131,3 +131,65 @@ class TestServeQuantized:
         finally:
             p.send_signal(signal.SIGTERM)
             p.communicate(timeout=30)
+
+
+class TestFamilyPresets:
+    def _spawn(self, preset, extra=()):
+        import subprocess
+        import sys
+        import time as _time
+
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_docker_api.serve",
+             "--preset", preset, "--platform", "cpu", "--host", "127.0.0.1",
+             "--port", "0", "--virtual-devices", "1", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env={**os.environ, "PYTHONPATH": REPO},
+        )
+        port = None
+        deadline = _time.monotonic() + 120
+        lines = []
+        while _time.monotonic() < deadline:
+            if p.poll() is not None:
+                raise RuntimeError(
+                    "server died:\n" + "".join(lines) + p.stdout.read())
+            line = p.stdout.readline()
+            lines.append(line)
+            if '"event": "serving"' in line:
+                port = json.loads(line)["port"]
+                break
+        assert port, "server never became ready:\n" + "".join(lines)
+        return p, port
+
+    def test_moe_preset_serves(self):
+        p, port = self._spawn("moe:moe-tiny", ("--max-seq", "64"))
+        try:
+            out = _post(port, "/generate",
+                        {"tokens": [[1, 2, 3]], "maxNewTokens": 4,
+                         "temperature": 0.0}, timeout=180)
+            assert len(out["tokens"][0]) == 4
+        finally:
+            p.terminate()
+            p.wait(timeout=30)
+
+    def test_encdec_preset_serves_seq2seq(self):
+        p, port = self._spawn("encdec:tiny")
+        try:
+            out = _post(port, "/generate",
+                        {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
+                         "temperature": 0.0}, timeout=180)
+            assert len(out["tokens"][0]) == 4
+            assert "lengths" not in out  # seq2seq path has no eos contract
+            # sampling is rejected loudly on the greedy-only path
+            import urllib.error
+            try:
+                _post(port, "/generate",
+                      {"srcTokens": [[1, 2]], "maxNewTokens": 2,
+                       "temperature": 0.7}, timeout=60)
+                raise AssertionError("expected a 400")
+            except urllib.error.HTTPError as e:
+                err = json.loads(e.read())
+                assert "greedy-only" in err["error"]
+        finally:
+            p.terminate()
+            p.wait(timeout=30)

@@ -74,3 +74,19 @@ def cached_forward_fn(cfg):
     if isinstance(cfg, LlamaConfig):
         return llama_forward_cached
     raise TypeError(f"no cached forward for config type {type(cfg)!r}")
+
+
+def resolve_preset(spec: str):
+    """(family, cfg) from a family-prefixed preset spec — the single
+    parser behind the trainer and serve CLIs: "NAME" → llama,
+    "moe:NAME" / "vit:NAME" / "encdec:NAME" → that family."""
+    from tpu_docker_api.models.encdec import encdec_presets
+    from tpu_docker_api.models.vit import vit_presets
+
+    if spec.startswith("moe:"):
+        return "moe", moe_presets()[spec[4:]]
+    if spec.startswith("vit:"):
+        return "vit", vit_presets()[spec[4:]]
+    if spec.startswith("encdec:"):
+        return "encdec", encdec_presets()[spec[7:]]
+    return "llama", llama_presets()[spec]

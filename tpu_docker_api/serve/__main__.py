@@ -13,6 +13,12 @@ HTTP surface (stdlib server, same envelope as the control plane):
                "maxNewTokens": 64, "temperature": 0.8,
                "topK": 0, "topP": 1.0}
 
+Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
+through the same KV-cached engine and body; ``--preset encdec:NAME``
+serves seq2seq — the body uses ``srcTokens`` instead of ``tokens``,
+decoding is greedy-only (temperature 0), and responses carry no
+``lengths`` (no eos contract). ViT has no generative serving path.
+
 Design notes, TPU-first:
 
 - one compiled generate program per (batch, prompt_len, maxNewTokens,
@@ -65,11 +71,21 @@ def main(argv: list[str] | None = None) -> None:
     import jax
 
     from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
-    from tpu_docker_api.models.llama import llama_init, llama_presets
+    from tpu_docker_api.models import model_fns
     from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
     from tpu_docker_api.train.trainer import create_train_state
 
-    cfg = llama_presets()[args.preset]
+    from tpu_docker_api.models import resolve_preset
+
+    # family-prefixed presets, one parser shared with the trainer CLI:
+    # moe:NAME serves through the same KV-cached engine; encdec:NAME
+    # switches /generate to the seq2seq path (srcTokens → greedy decode)
+    family, cfg = resolve_preset(args.preset)
+    if family == "vit":
+        raise SystemExit("vit presets have no generative serving path")
+    is_encdec = family == "encdec"
+    if args.quantize and family != "llama":
+        raise SystemExit("--quantize currently supports llama presets only")
     mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=1))
     if args.ckpt_dir:
         from tpu_docker_api.train.checkpoint import resume_or_init
@@ -88,14 +104,16 @@ def main(argv: list[str] | None = None) -> None:
             params = state.params
             del state
         else:
-            params = llama_init(cfg, jax.random.PRNGKey(0))
+            init_fn, _, _ = model_fns(cfg)
+            params = init_fn(cfg, jax.random.PRNGKey(0))
         step = 0
     if args.quantize:
         from tpu_docker_api.infer.quantize import quantize_llama_params
 
         params = quantize_llama_params(params)
 
-    max_seq = args.max_seq or cfg.max_seq_len
+    max_seq = args.max_seq or (cfg.max_tgt_len if is_encdec
+                               else cfg.max_seq_len)
     # jitted generate fns keyed by sampling config. Bounded LRU: sampler
     # params are client-controlled, and each distinct tuple costs an XLA
     # compile — an unbounded dict would let traffic grow compile caches
@@ -112,12 +130,31 @@ def main(argv: list[str] | None = None) -> None:
             if key in fns:
                 fns.move_to_end(key)
                 return fns[key]
-            fn = make_generate_fn(
-                cfg,
-                GenerateConfig(max_new_tokens=key[0], temperature=key[1],
-                               top_k=key[2], top_p=key[3], max_seq=max_seq),
-                mesh,
-            )
+            if is_encdec:
+                if key[1] != 0.0 or key[2] != 0 or key[3] != 1.0:
+                    raise ValueError(
+                        "encdec serving is greedy-only (temperature 0)")
+                if key[0] > max_seq:
+                    # the llama path's capacity check lives in the engine;
+                    # this is the seq2seq analog — an unbounded client
+                    # value would trace a key[0]-step scan and allocate a
+                    # (Ld, b, key[0], kvh, hd) cache
+                    raise ValueError(
+                        f"maxNewTokens {key[0]} exceeds capacity {max_seq}")
+                from tpu_docker_api.models.encdec import encdec_generate
+
+                fn = jax.jit(lambda p, src, _rng: {
+                    "tokens": encdec_generate(p, src, cfg,
+                                              max_new_tokens=key[0]),
+                })
+            else:
+                fn = make_generate_fn(
+                    cfg,
+                    GenerateConfig(max_new_tokens=key[0], temperature=key[1],
+                                   top_k=key[2], top_p=key[3],
+                                   max_seq=max_seq),
+                    mesh,
+                )
             fns[key] = fn
             while len(fns) > _FN_CACHE_MAX:
                 fns.popitem(last=False)
@@ -161,11 +198,12 @@ def main(argv: list[str] | None = None) -> None:
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if not isinstance(req, dict):
                     raise ValueError("body must be a JSON object")
-                prompts = req.get("tokens")
+                prompts = req.get("srcTokens" if is_encdec else "tokens")
                 if not prompts or not all(
                         isinstance(r, list) and r for r in prompts):
                     raise ValueError(
-                        "tokens must be a non-empty list of non-empty "
+                        ("srcTokens" if is_encdec else "tokens")
+                        + " must be a non-empty list of non-empty "
                         "token-id rows")
                 prompt = jnp.asarray(np.array(prompts, np.int32))
                 if int(prompt.max()) >= cfg.vocab_size or int(prompt.min()) < 0:
@@ -179,10 +217,10 @@ def main(argv: list[str] | None = None) -> None:
                     key, sub = jax.random.split(rng_state["key"])
                     rng_state["key"] = key
                     out = fn(params, prompt, sub)
-                self._reply(200, {
-                    "tokens": np.asarray(out["tokens"]).tolist(),
-                    "lengths": np.asarray(out["lengths"]).tolist(),
-                })
+                payload = {"tokens": np.asarray(out["tokens"]).tolist()}
+                if "lengths" in out:
+                    payload["lengths"] = np.asarray(out["lengths"]).tolist()
+                self._reply(200, payload)
             except ValueError as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — serving must not die

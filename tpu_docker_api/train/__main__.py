@@ -88,28 +88,21 @@ def main(argv: list[str] | None = None) -> None:
         synthetic_batch,
     )
 
-    is_vit = args.preset.startswith("vit:")
-    is_encdec = args.preset.startswith("encdec:")
-    if args.preset.startswith("moe:"):
-        cfg = moe_presets()[args.preset[4:]]
-    elif is_vit:
-        from tpu_docker_api.models.vit import vit_presets
+    from tpu_docker_api.models import resolve_preset
 
-        cfg = vit_presets()[args.preset[4:]]
+    family, cfg = resolve_preset(args.preset)
+    is_vit = family == "vit"
+    is_encdec = family == "encdec"
+    if is_vit:
         if args.data or args.seq:
             raise SystemExit("--data/--seq do not apply to vit: presets "
                              "(image batches are synthetic)")
         seq = cfg.n_patches  # tokens-per-image, for the throughput metric
     elif is_encdec:
-        from tpu_docker_api.models.encdec import encdec_presets
-
-        cfg = encdec_presets()[args.preset[7:]]
         if args.data:
             raise SystemExit("--data does not apply to encdec: presets "
                              "(seq2seq pairs are synthetic)")
         seq = args.seq or min(cfg.max_tgt_len, 128)  # src_len == tgt_len
-    else:
-        cfg = llama_presets()[args.preset]
     if not (is_vit or is_encdec):
         if args.seq:
             cfg = dataclasses.replace(cfg, max_seq_len=args.seq)
