@@ -380,6 +380,13 @@ class TestDemux:
     def test_tty_raw_passthrough(self):
         assert _demux_docker_stream(b"raw tty bytes") == "raw tty bytes"
 
+    def test_midstream_corruption_keeps_parsed_frames(self):
+        # An invalid header AFTER valid frames is corruption, not tty mode:
+        # the demuxed frames must survive (not be re-emitted with their
+        # binary headers), and the unparseable tail is appended raw.
+        data = mux_frames((1, b"abc"), (2, b"DEF")) + b"\x07garbage!"
+        assert _demux_docker_stream(data) == "abcDEF\x07garbage!"
+
     def test_empty(self):
         assert _demux_docker_stream(b"") == ""
 

@@ -84,7 +84,12 @@ class TestServe:
     def test_bad_requests(self, server):
         port, _ = server
         for payload in ({}, {"tokens": []}, {"tokens": [[]]},
-                        {"tokens": [[999999]]}):
+                        {"tokens": [[999999]]},
+                        {"tokens": [[1, 2]], "maxNewTokens": 0},
+                        {"tokens": [[1, 2]], "maxNewTokens": -3},
+                        {"tokens": [[1, 2]], "maxNewTokens": 1.9},
+                        {"tokens": [[1, 2]], "maxNewTokens": True},
+                        {"tokens": [[1, 2]], "topK": "4"}):
             with pytest.raises(urllib.error.HTTPError) as e:
                 _post(port, "/generate", payload)
             assert e.value.code == 400

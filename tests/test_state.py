@@ -238,3 +238,27 @@ class TestHistoryOrdering:
             store.put_volume(VolumeState(
                 volume_name=f"d-{v}", version=v, size="1GB", driver_opts={}))
         assert store.history(Resource.VOLUMES, "d") == list(range(12))
+
+
+class TestAsInt:
+    """errors.as_int guards every user-supplied int field (ADVICE r2)."""
+
+    def test_accepts_ints_and_integral_floats(self):
+        assert errors.as_int(3, "f") == 3
+        assert errors.as_int(0, "f") == 0
+        assert errors.as_int(3.0, "f") == 3  # JSON clients sending 3.0
+
+    def test_rejects_bool(self):
+        with pytest.raises(errors.BadRequest):
+            errors.as_int(True, "chipCount")
+        with pytest.raises(errors.BadRequest):
+            errors.as_int(False, "chipCount")
+
+    def test_rejects_truncating_float(self):
+        with pytest.raises(errors.BadRequest):
+            errors.as_int(3.9, "chipCount")
+
+    def test_rejects_strings_none_nan(self):
+        for bad in ("3", "x", None, float("nan"), float("inf"), [1]):
+            with pytest.raises(errors.BadRequest):
+                errors.as_int(bad, "chipCount")

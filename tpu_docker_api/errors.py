@@ -25,11 +25,20 @@ def as_int(value, field: str) -> int:
     to :class:`BadRequest` (code 10001) instead of letting ``ValueError``
     escape the handler as a 500 SERVER_ERROR. For request DTO ``from_dict``
     sites; internal state parsing should keep plain ``int()`` so corruption
-    surfaces as a server error."""
+    surfaces as a server error.
+
+    Rejects bool (JSON ``true`` would coerce to 1), non-integral numbers
+    (``3.9`` would silently truncate to 3), and digit strings (JSON callers
+    must send numbers, not ``"3"``)."""
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise BadRequest(f"{field} must be an integer")
     try:
-        return int(value)
-    except (TypeError, ValueError):
+        coerced = int(value)
+        if coerced != value:
+            raise ValueError
+    except (TypeError, ValueError, OverflowError):  # nan/inf raise here too
         raise BadRequest(f"{field} must be an integer") from None
+    return coerced
 
 
 # --- common (xerrors/common.go:7-10) ------------------------------------------

@@ -281,15 +281,24 @@ class _NotFound(Exception):
 
 def _demux_docker_stream(data: bytes) -> str:
     """Demultiplex docker's 8-byte-header stdout/stderr stream (the Go side
-    uses stdcopy.StdCopy, service/container.go:169-172). A stream that does
-    not carry valid headers (stream id ∈ {0,1,2}, three zero pad bytes) is a
-    tty-mode raw stream and passes through undecoded."""
+    uses stdcopy.StdCopy, service/container.go:169-172). A stream whose FIRST
+    header is not valid (stream id ∈ {0,1,2}, three zero pad bytes) is a
+    tty-mode raw stream and passes through undecoded. An invalid header
+    mid-stream is corruption, not tty mode: the frames already demuxed are
+    kept and the unparseable remainder is appended raw, rather than
+    re-emitting the whole buffer (which would re-include the binary headers
+    of frames that parsed fine). A trailing fragment shorter than one header
+    is indistinguishable from a truncated valid header and is dropped as
+    framing, not payload."""
     out = []
     i = 0
     while i + 8 <= len(data):
         stream_id, size = struct.unpack(">BxxxL", data[i:i + 8])
         if stream_id > 2 or data[i + 1:i + 4] != b"\x00\x00\x00":
-            return data.decode(errors="replace")  # tty mode: no framing
+            if not out:
+                return data.decode(errors="replace")  # tty mode: no framing
+            out.append(data[i:])  # mid-stream corruption: keep parsed frames
+            break
         out.append(data[i + 8:i + 8 + size])
         i += 8 + size
     if not out:  # short raw stream (< one header)

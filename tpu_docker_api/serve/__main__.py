@@ -209,9 +209,18 @@ def main(argv: list[str] | None = None) -> None:
                 if int(prompt.max()) >= cfg.vocab_size or int(prompt.min()) < 0:
                     raise ValueError(
                         f"token ids must be in [0, {cfg.vocab_size})")
-                max_new = int(req.get("maxNewTokens", 64))
+                def req_int(name, default):
+                    v = req.get(name, default)
+                    if isinstance(v, bool) or not isinstance(v, int):
+                        raise ValueError(f"{name} must be an integer")
+                    return v
+
+                max_new = req_int("maxNewTokens", 64)
+                if max_new < 1:
+                    raise ValueError(
+                        f"maxNewTokens must be >= 1, got {max_new}")
                 fn = get_fn(max_new, float(req.get("temperature", 0.0)),
-                            int(req.get("topK", 0)),
+                            req_int("topK", 0),
                             float(req.get("topP", 1.0)))
                 with gen_lock:
                     key, sub = jax.random.split(rng_state["key"])
