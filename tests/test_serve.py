@@ -38,7 +38,8 @@ def server():
     p = subprocess.Popen(
         [sys.executable, "-m", "tpu_docker_api.serve",
          "--preset", "tiny", "--platform", "cpu", "--host", "127.0.0.1",
-         "--port", str(port), "--max-seq", "64", "--virtual-devices", "1"],
+         "--port", str(port), "--max-seq", "64", "--virtual-devices", "1",
+         "--slots", "4", "--chunk", "4"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
@@ -99,6 +100,91 @@ class TestServe:
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(port, "/nope", {})
         assert e.value.code == 404
+
+    def test_ragged_rows_on_slot_path(self, server):
+        """Rows of different lengths in one body — each row is its own
+        slot-engine request (the legacy dense path can't do this)."""
+        port, _ = server
+        out = _post(port, "/generate",
+                    {"tokens": [[1, 2, 3, 4, 5, 6], [9, 8]],
+                     "maxNewTokens": 5})
+        assert len(out["tokens"]) == 2
+        assert all(len(r) == 5 for r in out["tokens"])
+        assert out["lengths"] == [5, 5]
+
+    def test_concurrent_clients_share_the_engine(self, server):
+        """4 clients in flight at once — all complete, and healthz shows
+        the slot engine actually ran them (no gen_lock serialization)."""
+        import threading
+
+        port, _ = server
+        results = [None] * 4
+
+        def client(i):
+            results[i] = _post(port, "/generate",
+                               {"tokens": [[i + 1, i + 2, i + 3]],
+                                "maxNewTokens": 6}, timeout=120)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert all(r is not None and len(r["tokens"][0]) == 6
+                   for r in results)
+        h = _get(port, "/healthz")
+        assert h["slotEngine"]["completed"] >= 4
+        assert h["slotEngine"]["slots"] == 4
+
+    def test_topk_falls_back_to_legacy_path(self, server):
+        port, _ = server
+        out = _post(port, "/generate",
+                    {"tokens": [[5, 6, 7]], "maxNewTokens": 4, "topK": 3,
+                     "temperature": 0.9})
+        assert len(out["tokens"][0]) == 4
+        # ragged rows are a slot-path capability only
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/generate",
+                  {"tokens": [[1, 2], [3]], "maxNewTokens": 2, "topK": 2,
+                   "temperature": 0.9})
+        assert e.value.code == 400
+
+    def test_greedy_matches_slotless_server(self, server):
+        """The slot engine's output is token-exact vs a --slots 0 server
+        with identical params (same preset, same init seed)."""
+        port, _ = server
+        body = {"tokens": [[7, 3, 2, 9]], "maxNewTokens": 6,
+                "temperature": 0.0}
+        a = _post(port, "/generate", body)
+
+        port2 = 18795
+        env = {**os.environ, "PYTHONPATH": REPO}
+        p2 = subprocess.Popen(
+            [sys.executable, "-m", "tpu_docker_api.serve",
+             "--preset", "tiny", "--platform", "cpu", "--host", "127.0.0.1",
+             "--port", str(port2), "--max-seq", "64",
+             "--virtual-devices", "1", "--slots", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if p2.poll() is not None:
+                    raise RuntimeError(f"server died: {p2.stdout.read()}")
+                try:
+                    if _get(port2, "/healthz")["status"] == "ok":
+                        break
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.3)
+            else:
+                raise RuntimeError("slotless server never became healthy")
+            assert "slotEngine" not in _get(port2, "/healthz")
+            b = _post(port2, "/generate", body)
+        finally:
+            p2.send_signal(signal.SIGTERM)
+            p2.communicate(timeout=30)
+        assert a["tokens"] == b["tokens"]
 
     def test_graceful_stop_last(self, server):
         # fixture teardown asserts SIGTERM exits cleanly via communicate();

@@ -1,0 +1,421 @@
+"""Continuous-batching ("slot") serving engine.
+
+Round 2 served generations one at a time: one compiled whole-generation
+program per shape bucket, a global lock in front of the chip
+(serve/__main__.py ``gen_lock``), so two clients halved each other's
+throughput. This module multiplexes N request streams onto one chip the
+way the reference multiplexes containers onto one host
+(/root/reference/internal/service/container.go:463-535 — capability
+analog; the reference itself has no serving).
+
+TPU-first shape of the design:
+
+- **One fixed-capacity KV cache of S slots** ``(layers, S, max_seq, kv,
+  head_dim)`` allocated once; a request is admitted into a free slot and
+  the slot is recycled when the request completes. Static shapes — XLA
+  compiles exactly two kinds of program (per-bucket prefill, one decode
+  chunk) and every dispatch reuses them.
+- **Per-slot positions**: each slot sits at its own sequence length, so
+  decode runs the per-row cached forward (models/llama.py ``_attention``
+  per-row scatter write, ops/attention.py per-row causal mask). The
+  whole batch decodes in lockstep regardless of where each slot is in
+  its sequence.
+- **K-step decode chunks**: the decode loop is a ``lax.scan`` over K
+  steps per dispatch, amortizing host→device dispatch latency (tens of
+  ms through the axon tunnel) over K tokens; admission happens between
+  chunks. K trades admission latency against tail waste (a request
+  finishing mid-chunk wastes the rest of the chunk for its slot).
+- **Right-padded prefill into the slot**: a prompt is padded to a bucket
+  length and prefilled batch=1 into a fresh (layers, 1, bucket) cache,
+  then one dynamic_update_slice drops it into the big cache at the slot
+  row. Garbage k/v at padded positions sits strictly at FUTURE positions
+  of the slot, and the per-row causal mask never attends a position
+  ``> pos``; decode overwrites position p before the first query that
+  could see it. The first-token logit is read at ``actual_len - 1`` via
+  the traced ``last_only`` index.
+- **Exact sampling in one program**: greedy is ``argmax``; per-slot
+  temperature sampling is Gumbel-argmax (``argmax(logits/T + G)`` is an
+  exact categorical draw), so mixed greedy/sampled slots share one
+  compiled chunk. top-k/top-p need a sort and stay on the legacy
+  whole-generation path (serve/__main__.py routes them there).
+
+Correctness contract (tests/test_slots.py): per-stream outputs are
+token-exact vs an isolated greedy ``make_generate_fn`` decode of the
+same prompt, for any admission order and slot reuse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_docker_api.models import cached_forward_fn
+from tpu_docker_api.infer.engine import init_kv_cache
+
+
+def _default_buckets(max_seq: int) -> tuple[int, ...]:
+    """Power-of-two prefill buckets from 32 up to max_seq (inclusive)."""
+    out = []
+    b = 32
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class Handle:
+    """Per-request future. ``result()`` blocks until the request completes
+    and returns {"tokens": [...], "length": n} (tokens truncated at eos,
+    inclusive, like the legacy engine's lengths contract)."""
+
+    _done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    _result: dict | None = None
+    _error: Exception | None = None
+
+    def result(self, timeout: float | None = None) -> dict:
+        if not self._done.wait(timeout):
+            raise TimeoutError("request not complete")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _complete(self, result: dict) -> None:
+        self._result = result
+        self._done.set()
+
+    def _fail(self, err: Exception) -> None:
+        self._error = err
+        self._done.set()
+
+
+@dataclasses.dataclass
+class _Slot:
+    handle: Handle
+    tokens: list[int]          # emitted so far (starts with prefill token)
+    max_new: int
+    last_tok: int
+    pos: int                   # next cache position to write
+    temperature: float
+
+
+class SlotEngine:
+    """Slot-based continuous-batching engine for the decoder families
+    (llama + moe via ``models.cached_forward_fn``).
+
+    Single-accelerator by design: serving one chip is the unit the control
+    plane provisions (one container = one slice); meshes serve via one
+    process per chip. ``submit()`` is thread-safe; the decode loop runs on
+    the caller's thread via :meth:`step` or on a background thread via
+    :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params,
+        *,
+        slots: int = 8,
+        max_seq: int | None = None,
+        chunk: int = 8,
+        buckets: tuple[int, ...] | None = None,
+        eos_id: int | None = None,
+        pad_id: int = 0,
+        cache_dtype: Any = jnp.bfloat16,
+        seed: int = 0,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.cfg = cfg
+        self.params = params
+        self.slots = slots
+        self.max_seq = max_seq or cfg.max_seq_len
+        self.chunk = chunk
+        self.buckets = tuple(sorted(buckets or _default_buckets(self.max_seq)))
+        if self.buckets[-1] > self.max_seq:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} exceeds cache capacity "
+                f"{self.max_seq}")
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self._fwd = cached_forward_fn(cfg)
+        cache = init_kv_cache(cfg, slots, self.max_seq, mesh=None,
+                              dtype=cache_dtype)
+        self._k, self._v = cache.k, cache.v
+        self._key = jax.random.PRNGKey(seed)
+
+        self._pending: queue.SimpleQueue = queue.SimpleQueue()
+        self._table: dict[int, _Slot | None] = {i: None for i in range(slots)}
+        self._lock = threading.Lock()      # guards _table mutation vs stats
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+        self._dead: Exception | None = None
+
+        self._prefill_fns: dict[int, Any] = {}
+        self._decode_fn = None
+        # aggregate counters for /healthz-style introspection
+        self.stats = {"completed": 0, "decode_chunks": 0, "prefills": 0,
+                      "wasted_steps": 0, "emitted_tokens": 0}
+
+    # ---- compiled programs -------------------------------------------------
+
+    @staticmethod
+    def _sample(logits, temp, key):
+        """(S, vocab) f32 logits + per-slot temperature → (S,) int32.
+        Gumbel-argmax is an exact categorical draw at temperature T;
+        T == 0 rows take the plain argmax (token-exact greedy)."""
+        g = jax.random.gumbel(key, logits.shape, logits.dtype)
+        z = jnp.where(temp[:, None] > 0,
+                      logits / jnp.maximum(temp, 1e-6)[:, None] + g,
+                      logits)
+        return jnp.argmax(z, axis=-1).astype(jnp.int32)
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        cache_dtype = self._k.dtype
+
+        def prefill(params, prompt, actual_len, slot, temp, key, k_all, v_all):
+            shape = (cfg.n_layers, 1, bucket, cfg.n_kv_heads, cfg.head_dim)
+            kc = jnp.zeros(shape, cache_dtype)
+            vc = jnp.zeros(shape, cache_dtype)
+            logits, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
+                                 None, last_only=actual_len - 1)
+            tok = self._sample(logits[:, -1], temp[None], key)
+            zero = jnp.int32(0)
+            k_all = lax.dynamic_update_slice(
+                k_all, kc, (zero, slot, zero, zero, zero))
+            v_all = lax.dynamic_update_slice(
+                v_all, vc, (zero, slot, zero, zero, zero))
+            return tok[0], k_all, v_all
+
+        fn = jax.jit(prefill, donate_argnums=(6, 7))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _decode(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        cfg, fwd, K = self.cfg, self._fwd, self.chunk
+
+        def decode_chunk(params, tok, pos, temp, key, k_all, v_all):
+            def body(carry, step_key):
+                tok, pos, k_all, v_all = carry
+                logits, k_all, v_all = fwd(
+                    params, tok[:, None], cfg, k_all, v_all, pos, None)
+                nxt = self._sample(logits[:, -1], temp, step_key)
+                return (nxt, pos + 1, k_all, v_all), nxt
+
+            keys = jax.random.split(key, K)
+            (tok, pos, k_all, v_all), out = lax.scan(
+                body, (tok, pos, k_all, v_all), keys)
+            return out.T, k_all, v_all  # (S, K)
+
+        self._decode_fn = jax.jit(decode_chunk, donate_argnums=(5, 6))
+        return self._decode_fn
+
+    def warmup(self, buckets: tuple[int, ...] | None = None) -> None:
+        """Actually compile the decode chunk and the given (default: all)
+        prefill buckets by running them on dummy data — ``jax.jit`` alone
+        compiles nothing until the first call, and a mid-service compile
+        on the engine thread stalls every active slot for its duration.
+        Pass ``buckets=()`` to warm only the decode chunk (the program
+        every request shares; per-bucket prefill compiles then amortize
+        one stall per bucket size ever). Call BEFORE :meth:`start` — this
+        runs dispatches on the caller's thread and scribbles garbage into
+        the (empty) cache, which admission later overwrites."""
+        if self._thread is not None:
+            raise RuntimeError("warmup must run before start()")
+        key = jax.random.PRNGKey(0)
+        for b in (self.buckets if buckets is None else buckets):
+            _, self._k, self._v = self._prefill_fn(b)(
+                self.params, jnp.zeros((1, b), jnp.int32), jnp.int32(1),
+                jnp.int32(0), jnp.float32(0.0), key, self._k, self._v)
+        zero_i = jnp.zeros((self.slots,), jnp.int32)
+        _, self._k, self._v = self._decode()(
+            self.params, zero_i, zero_i,
+            jnp.zeros((self.slots,), jnp.float32), key, self._k, self._v)
+
+    # ---- request API -------------------------------------------------------
+
+    def submit(self, prompt: list[int], max_new: int,
+               temperature: float = 0.0) -> Handle:
+        """Queue a request; returns a Handle resolving to
+        {"tokens": [...], "length": n}. Raises ValueError for requests
+        that can never fit (capacity is checked before queueing)."""
+        handle = Handle()
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self._dead is not None:
+            raise RuntimeError(f"engine failed: {self._dead!r}")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        n = len(prompt)
+        if n < 1:
+            raise ValueError("prompt must be non-empty")
+        if n > self.buckets[-1]:
+            raise ValueError(
+                f"prompt ({n}) exceeds the largest prefill bucket "
+                f"({self.buckets[-1]})")
+        if n + max_new - 1 > self.max_seq:
+            raise ValueError(
+                f"prompt ({n}) + max_new ({max_new}) exceeds cache "
+                f"capacity {self.max_seq}")
+        self._pending.put((list(prompt), max_new, float(temperature), handle))
+        self._wake.set()
+        return handle
+
+    # ---- engine loop -------------------------------------------------------
+
+    def _admit(self) -> bool:
+        """Move pending requests into free slots (one prefill dispatch
+        each). Returns True if anything was admitted."""
+        admitted = False
+        free = [i for i, s in self._table.items() if s is None]
+        while free:
+            try:
+                prompt, max_new, temp, handle = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            slot = free.pop()
+            bucket = next(b for b in self.buckets if b >= len(prompt))
+            padded = np.full((1, bucket), self.pad_id, np.int32)
+            padded[0, :len(prompt)] = prompt
+            self._key, sub = jax.random.split(self._key)
+            tok, self._k, self._v = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(padded),
+                jnp.int32(len(prompt)), jnp.int32(slot),
+                jnp.float32(temp), sub, self._k, self._v)
+            first = int(tok)
+            self.stats["prefills"] += 1
+            st = _Slot(handle=handle, tokens=[first], max_new=max_new,
+                       last_tok=first, pos=len(prompt), temperature=temp)
+            with self._lock:
+                self._table[slot] = st
+            self._finish_if_done(slot, st)  # max_new == 1 / instant eos
+            admitted = True
+        return admitted
+
+    def _finish_if_done(self, slot: int, st: _Slot) -> bool:
+        hit_eos = self.eos_id is not None and st.tokens and (
+            st.tokens[-1] == self.eos_id)
+        if hit_eos or len(st.tokens) >= st.max_new:
+            st.handle._complete(
+                {"tokens": st.tokens, "length": len(st.tokens)})
+            with self._lock:
+                self._table[slot] = None
+                self.stats["completed"] += 1
+                self.stats["emitted_tokens"] += len(st.tokens)
+            return True
+        return False
+
+    def step(self) -> bool:
+        """One engine iteration: admit pending requests, then (if any slot
+        is active) run one K-step decode chunk and distribute its tokens.
+        Returns True if any work was done. Tests drive this directly; the
+        background thread loops it."""
+        did = self._admit()
+        active = {i: s for i, s in self._table.items() if s is not None}
+        if not active:
+            return did
+
+        tok = np.full((self.slots,), self.pad_id, np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        temp = np.zeros((self.slots,), np.float32)
+        for i, s in active.items():
+            tok[i], pos[i], temp[i] = s.last_tok, s.pos, s.temperature
+        self._key, sub = jax.random.split(self._key)
+        out, self._k, self._v = self._decode()(
+            self.params, jnp.asarray(tok), jnp.asarray(pos),
+            jnp.asarray(temp), sub, self._k, self._v)
+        out = np.asarray(out)  # (S, K)
+        self.stats["decode_chunks"] += 1
+
+        for i, s in active.items():
+            s.pos += self.chunk
+            s.last_tok = int(out[i, -1])
+            for j in range(self.chunk):
+                s.tokens.append(int(out[i, j]))
+                if self._finish_if_done(i, s):
+                    self.stats["wasted_steps"] += self.chunk - 1 - j
+                    break
+        return True
+
+    def _loop(self) -> None:
+        while not self._closed:
+            try:
+                if not self.step():
+                    self._wake.clear()
+                    self._wake.wait(timeout=0.05)
+            except Exception as e:  # noqa: BLE001 — a dead engine thread
+                # must not leave clients hanging on 10-minute timeouts:
+                # fail every in-flight and queued handle, mark the engine
+                # dead so submit() rejects fast, and surface the cause
+                self._die(e)
+                return
+
+    def _die(self, err: Exception) -> None:
+        self._dead = err
+        with self._lock:
+            for i, s in self._table.items():
+                if s is not None:
+                    s.handle._fail(RuntimeError(f"engine failed: {err!r}"))
+                    self._table[i] = None
+        while True:
+            try:
+                *_, handle = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            handle._fail(RuntimeError(f"engine failed: {err!r}"))
+
+    @property
+    def dead(self) -> str | None:
+        """repr of the error that killed the engine loop, or None."""
+        return repr(self._dead) if self._dead is not None else None
+
+    def start(self) -> "SlotEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="slot-engine")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # fail anything still queued so callers don't hang
+        while True:
+            try:
+                *_, handle = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            handle._fail(RuntimeError("engine closed"))
+        for i, s in list(self._table.items()):
+            if s is not None:
+                s.handle._fail(RuntimeError("engine closed"))
+                self._table[i] = None
+
+    def __enter__(self) -> "SlotEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

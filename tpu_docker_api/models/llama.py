@@ -155,18 +155,34 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     v = linear(x, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     if cache is not None:
         k_all, v_all, layer_idx = cache
-        positions = jnp.broadcast_to(
-            start_pos + jnp.arange(s, dtype=jnp.int32)[None, :], (b, s)
-        )
+        per_row = getattr(start_pos, "ndim", 0) == 1
+        if per_row:
+            # continuous batching (infer/slots.py): every cache row sits at
+            # its own length, so the write is a scatter at (row, pos[row])
+            # instead of one dynamic slice; mode="drop" makes a slot pushed
+            # past capacity a silent no-op rather than a clamped corruption
+            positions = (start_pos[:, None]
+                         + jnp.arange(s, dtype=jnp.int32)[None, :])
+        else:
+            positions = jnp.broadcast_to(
+                start_pos + jnp.arange(s, dtype=jnp.int32)[None, :], (b, s)
+            )
         q = apply_rope(q, rope_cos, rope_sin, positions)
         k = apply_rope(k, rope_cos, rope_sin, positions)
-        zero = jnp.int32(0)
-        k_all = lax.dynamic_update_slice(
-            k_all, k.astype(k_all.dtype)[None],
-            (layer_idx, zero, start_pos, zero, zero))
-        v_all = lax.dynamic_update_slice(
-            v_all, v.astype(v_all.dtype)[None],
-            (layer_idx, zero, start_pos, zero, zero))
+        if per_row:
+            rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+            k_all = k_all.at[layer_idx, rows, positions].set(
+                k.astype(k_all.dtype), mode="drop")
+            v_all = v_all.at[layer_idx, rows, positions].set(
+                v.astype(v_all.dtype), mode="drop")
+        else:
+            zero = jnp.int32(0)
+            k_all = lax.dynamic_update_slice(
+                k_all, k.astype(k_all.dtype)[None],
+                (layer_idx, zero, start_pos, zero, zero))
+            v_all = lax.dynamic_update_slice(
+                v_all, v.astype(v_all.dtype)[None],
+                (layer_idx, zero, start_pos, zero, zero))
         k_cache = lax.dynamic_index_in_dim(k_all, layer_idx, 0,
                                            keepdims=False)
         v_cache = lax.dynamic_index_in_dim(v_all, layer_idx, 0,
@@ -292,9 +308,11 @@ def llama_forward_cached(
     cfg: LlamaConfig,
     k_cache: jnp.ndarray,     # (n_layers, batch, max_seq, n_kv_heads, head_dim)
     v_cache: jnp.ndarray,
-    start_pos: jnp.ndarray,   # scalar int32: absolute position of tokens[:, 0]
+    start_pos: jnp.ndarray,   # int32: absolute position of tokens[:, 0] —
+    #                           scalar (whole batch) or (batch,) per-row
     mesh: Mesh | None = None,
-    last_only: bool = False,
+    last_only: bool | jnp.ndarray = False,  # True: final position; traced
+    #                           int: that position (padded-prefill logit)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """KV-cached forward: logits for the new tokens + updated caches.
 
@@ -342,8 +360,13 @@ def decoder_forward_cached(params, tokens, cfg, k_cache, v_cache, mesh,
         scan_body, (x, k_cache, v_cache),
         (params["layers"], jnp.arange(cfg.n_layers, dtype=jnp.int32)),
     )
-    if last_only:
+    if last_only is True:
         x = x[:, -1:]
+    elif last_only is not False and last_only is not None:
+        # traced index: logits for position ``last_only`` only — the padded
+        # prefill of a right-padded prompt (infer/slots.py) wants the logit
+        # at actual_len-1, which is not the bucket's final position
+        x = lax.dynamic_slice_in_dim(x, last_only, 1, axis=1)
     logits = lm_head(params, x, cfg)
     return logits, new_k, new_v
 

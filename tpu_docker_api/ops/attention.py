@@ -34,6 +34,9 @@ def dense_attention(
     end of a longer, partially-filled key buffer) uses the same numerics as
     the q_seq == kv_seq training path: key slot j attends iff
     j <= q_offset + i, which also masks not-yet-written cache slots.
+    A (batch,) ``q_offset`` gives every row its own absolute position — the
+    continuous-batching decode case (infer/slots.py) where each cache slot
+    sits at a different sequence length.
 
     ``probs_dtype``: storage dtype for the (b, h, q, k) probability tensor
     feeding the PV matmul. The f32 default is the serving-correctness
@@ -58,11 +61,16 @@ def dense_attention(
     scores = scores * (1.0 / head_dim**0.5)
     if causal:
         q_pos = jnp.arange(seq, dtype=jnp.int32)
-        if q_offset is not None:
-            q_pos = q_pos + q_offset
         k_pos = jnp.arange(kv_seq, dtype=jnp.int32)
-        mask = k_pos[None, :] <= q_pos[:, None]  # (q_seq, kv_seq)
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        if q_offset is not None and getattr(q_offset, "ndim", 0) == 1:
+            q_pos = q_pos[None, :] + q_offset[:, None]       # (batch, q_seq)
+            mask = k_pos[None, None, :] <= q_pos[:, :, None]  # (b, q, k)
+            scores = jnp.where(mask[:, None, None], scores, -1e30)
+        else:
+            if q_offset is not None:
+                q_pos = q_pos + q_offset
+            mask = k_pos[None, :] <= q_pos[:, None]  # (q_seq, kv_seq)
+            scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     if probs_dtype is not None:
         probs = probs.astype(probs_dtype)

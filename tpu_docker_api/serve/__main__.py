@@ -21,10 +21,20 @@ decoding is greedy-only (temperature 0), and responses carry no
 
 Design notes, TPU-first:
 
-- one compiled generate program per (batch, prompt_len, maxNewTokens,
-  sampler) shape bucket — jax caches compilations, so repeated traffic at
-  the same shape pays zero retrace; prompts in a batch are dense (callers
-  left-pad, engine.make_generate_fn docstring).
+- **continuous batching by default** (llama/moe, single device): requests
+  stream through a slot-based engine (infer/slots.py) — a fixed-capacity
+  KV cache of ``--slots`` slots, K-step decode chunks, admission into
+  freed slots between chunks. Concurrent clients share the chip instead
+  of serializing behind a lock; greedy and per-request temperature
+  sampling run in ONE compiled chunk program (no per-sampler retrace).
+  Prompt rows in one body may be ragged — each row is its own request.
+  top-k/top-p bodies fall back to the legacy whole-generation path below.
+- legacy path (top-k/top-p, encdec, meshes, ``--slots 0``): one compiled
+  generate program per (batch, prompt_len, maxNewTokens, sampler) shape
+  bucket — jax caches compilations, so repeated traffic at the same
+  shape pays zero retrace; prompts in a batch are dense (callers
+  left-pad, engine.make_generate_fn docstring); a global lock serializes
+  generations.
 - sharded serving: ``--dp/--fsdp/--tp`` build the same mesh/rules the
   trainer uses; params restore (orbax) directly into their shards.
 - ``--quantize`` rewrites projections to int8 at load
@@ -42,6 +52,8 @@ import signal
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpu_docker_api import errors
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -63,6 +75,11 @@ def main(argv: list[str] | None = None) -> None:
                    help="force a jax platform (tests: cpu)")
     p.add_argument("--virtual-devices", type=int, default=0,
                    help="force N virtual CPU devices (tests)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="continuous-batching slots (0 disables the slot "
+                        "engine; llama/moe single-device only)")
+    p.add_argument("--chunk", type=int, default=8,
+                   help="decode steps per slot-engine dispatch")
     args = p.parse_args(argv)
 
     from tpu_docker_api.workload.jaxenv import bootstrap_jax
@@ -114,6 +131,27 @@ def main(argv: list[str] | None = None) -> None:
 
     max_seq = args.max_seq or (cfg.max_tgt_len if is_encdec
                                else cfg.max_seq_len)
+
+    # continuous batching: the default llama/moe single-device path.
+    # Meshes keep the legacy whole-generation path (the slot engine's
+    # per-row cache scatter is single-device by design — one container
+    # serves one slice, one process per chip).
+    slot_engine = None
+    if (family in ("llama", "moe") and args.slots > 0
+            and mesh.devices.size <= 1):
+        from tpu_docker_api.infer.slots import SlotEngine
+
+        slot_engine = SlotEngine(
+            cfg, params, slots=args.slots, max_seq=max_seq,
+            chunk=args.chunk,
+            seed=int.from_bytes(os.urandom(4), "little"))
+        # compile the shared decode chunk before binding the port: a
+        # mid-service compile on the engine thread stalls every active
+        # slot, and /healthz must not report ok before the program
+        # exists. Prefill buckets compile on first use (one stall per
+        # bucket size ever).
+        slot_engine.warmup(buckets=())
+        slot_engine.start()
     # jitted generate fns keyed by sampling config. Bounded LRU: sampler
     # params are client-controlled, and each distinct tuple costs an XLA
     # compile — an unbounded dict would let traffic grow compile caches
@@ -181,11 +219,21 @@ def main(argv: list[str] | None = None) -> None:
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._reply(200, {
+                payload = {
                     "status": "ok", "model": args.preset, "step": step,
                     "quantized": args.quantize,
                     "devices": len(jax.devices()),
-                })
+                }
+                if slot_engine is not None:
+                    payload["slotEngine"] = {
+                        "slots": slot_engine.slots,
+                        "chunk": slot_engine.chunk,
+                        **slot_engine.stats,
+                    }
+                    if slot_engine.dead:
+                        payload["status"] = "degraded"
+                        payload["slotEngine"]["dead"] = slot_engine.dead
+                self._reply(200, payload)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -205,23 +253,46 @@ def main(argv: list[str] | None = None) -> None:
                         ("srcTokens" if is_encdec else "tokens")
                         + " must be a non-empty list of non-empty "
                         "token-id rows")
-                prompt = jnp.asarray(np.array(prompts, np.int32))
-                if int(prompt.max()) >= cfg.vocab_size or int(prompt.min()) < 0:
-                    raise ValueError(
-                        f"token ids must be in [0, {cfg.vocab_size})")
+                for r in prompts:
+                    if not all(isinstance(t, int) and not isinstance(t, bool)
+                               and 0 <= t < cfg.vocab_size for t in r):
+                        raise ValueError(
+                            f"token ids must be in [0, {cfg.vocab_size})")
+
                 def req_int(name, default):
-                    v = req.get(name, default)
-                    if isinstance(v, bool) or not isinstance(v, int):
-                        raise ValueError(f"{name} must be an integer")
-                    return v
+                    return errors.as_int(req.get(name, default), name)
 
                 max_new = req_int("maxNewTokens", 64)
                 if max_new < 1:
                     raise ValueError(
                         f"maxNewTokens must be >= 1, got {max_new}")
-                fn = get_fn(max_new, float(req.get("temperature", 0.0)),
-                            req_int("topK", 0),
-                            float(req.get("topP", 1.0)))
+                temperature = float(req.get("temperature", 0.0))
+                top_k, top_p = req_int("topK", 0), float(req.get("topP", 1.0))
+
+                if (slot_engine is not None and not is_encdec
+                        and top_k == 0 and top_p == 1.0):
+                    # continuous batching: each row is its own request;
+                    # rows may be ragged. Responses keep the legacy dense
+                    # contract (pad to maxNewTokens + lengths).
+                    handles = [slot_engine.submit(r, max_new, temperature)
+                               for r in prompts]
+                    outs = [h.result(timeout=600) for h in handles]
+                    self._reply(200, {
+                        "tokens": [o["tokens"]
+                                   + [0] * (max_new - o["length"])
+                                   for o in outs],
+                        "lengths": [o["length"] for o in outs],
+                    })
+                    return
+
+                lens = {len(r) for r in prompts}
+                if len(lens) > 1:
+                    raise ValueError(
+                        "the legacy path needs equal-length prompt rows "
+                        "(left-pad), or use greedy/temperature sampling "
+                        "for ragged continuous batching")
+                prompt = jnp.asarray(np.array(prompts, np.int32))
+                fn = get_fn(max_new, temperature, top_k, top_p)
                 with gen_lock:
                     key, sub = jax.random.split(rng_state["key"])
                     rng_state["key"] = key
@@ -230,7 +301,7 @@ def main(argv: list[str] | None = None) -> None:
                 if "lengths" in out:
                     payload["lengths"] = np.asarray(out["lengths"]).tolist()
                 self._reply(200, payload)
-            except ValueError as e:
+            except (ValueError, errors.BadRequest) as e:
                 self._reply(400, {"error": str(e)})
             except Exception as e:  # noqa: BLE001 — serving must not die
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
@@ -244,8 +315,12 @@ def main(argv: list[str] | None = None) -> None:
     signal.signal(signal.SIGINT, _stop)
     print(json.dumps({"event": "serving", "model": args.preset,
                       "port": httpd.server_address[1],
-                      "quantized": args.quantize}), flush=True)
+                      "quantized": args.quantize,
+                      "slots": slot_engine.slots if slot_engine else 0}),
+          flush=True)
     httpd.serve_forever()
+    if slot_engine is not None:
+        slot_engine.close()
     print(json.dumps({"event": "stopped"}), flush=True)
 
 
