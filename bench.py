@@ -236,6 +236,8 @@ def main() -> None:
         except Exception as e:
             result["extra"]["llama3_8b_int8_infer"] = {"error": str(e)[:200]}
         gc.collect()  # drop the 8 GB serving weights before the next rider
+        result["extra"]["serving"] = measure_serving()
+        gc.collect()
         result["extra"]["families"] = measure_family_trains()
     print(json.dumps(result))
 
@@ -304,12 +306,49 @@ def measure_family_trains() -> dict:
 def measure_8b_inference() -> dict:
     """llama3-8b int8 serving throughput at the batch-64 throughput point
     (shared harness: infer/quantize.bench_int8_serving; validate_tpu.py's
-    check_8b_inference covers the batch-4 latency point too)."""
+    check_8b_inference covers the batch-4 latency point too), plus the
+    decode-only roofline (VERDICT r2 item 2: decode_only_ms_per_tok and
+    % of the weight-streaming HBM roof)."""
     from tpu_docker_api.infer.quantize import bench_int8_serving
+    from tpu_docker_api.infer.servebench import bench_decode_roofline
 
     res = bench_int8_serving(batch=64, reps=2)
     res.pop("ok")
+    try:
+        roof = bench_decode_roofline(batch=64, prompt_len=128, new_tok=64,
+                                     max_seq=512, reps=2)
+        for k in ("decode_only_ms_per_tok", "decode_tok_s", "pct_hbm_roof"):
+            res[k] = roof[k]
+    except Exception as e:
+        res["roofline_error"] = str(e)[:160]
     return res
+
+
+def measure_serving() -> dict:
+    """Continuous-batching serving riders (VERDICT r2 item 1): aggregate
+    tok/s of 8 concurrent streams through the slot engine vs the same 8
+    serialized through the round-2 gen_lock path — llama3-1b bf16 and the
+    llama3-8b int8 north star. Each point independent (per-point error
+    reporting, same rule as the other riders)."""
+    import gc
+
+    from tpu_docker_api.infer.servebench import bench_concurrent_serving
+
+    out = {}
+    for name, kwargs in (
+        ("llama3_1b", dict(preset="llama3-1b", quantize=False)),
+        ("llama3_8b_int8", dict(preset="llama3-8b", quantize=True)),
+    ):
+        try:
+            r = bench_concurrent_serving(
+                streams=8, prompt_len=128, new_tok=64, max_seq=512,
+                chunk=8, **kwargs)
+            r.pop("ok")
+            out[name] = r
+        except Exception as e:
+            out[name] = {"error": str(e)[:160]}
+        gc.collect()
+    return out
 
 
 if __name__ == "__main__":

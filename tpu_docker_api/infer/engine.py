@@ -84,6 +84,13 @@ class GenerateConfig:
     eos_id: int | None = None
     pad_id: int = 0
     max_seq: int | None = None  # cache capacity; default model max_seq_len
+    #: KV-cache storage dtype. Decode is bandwidth-bound and at large
+    #: batch/long seq the cache read rivals the weights read; fp8
+    #: (jnp.float8_e4m3fn) halves it with no scale tensors — writes cast
+    #: on store, attention upcasts in-register on read (XLA fuses the
+    #: convert into the QK/PV einsums; only fp8 bytes cross HBM). A
+    #: quality trade (3 mantissa bits) — opt-in for serving.
+    cache_dtype: Any = jnp.bfloat16
 
 
 def make_generate_fn(
@@ -127,7 +134,17 @@ def make_generate_fn(
                 f"prompt ({prompt_len}) + max_new_tokens "
                 f"({gen.max_new_tokens}) exceeds cache capacity {max_seq}"
             )
-        cache = init_kv_cache(cfg, b, max_seq, mesh=None)  # inside jit: traced
+        # right-size the cache to THIS generation (prompt_len is static at
+        # trace time; the program is compiled per prompt shape anyway).
+        # Decode attention reads the full buffer every step — at batch 64
+        # a 512-capacity cache for a 192-token generation burns 4.3 GB/step
+        # of HBM reads on slots that can never be attended (measured 29.0
+        # → 21.9 ms/tok on v5e llama3-8b int8). Round to 128 so nearby
+        # shapes share a program.
+        need = prompt_len + gen.max_new_tokens - 1
+        max_seq = min(max_seq, (need + 127) // 128 * 128)
+        cache = init_kv_cache(cfg, b, max_seq, mesh=None,
+                              dtype=gen.cache_dtype)  # inside jit: traced
 
         # ---- prefill: whole prompt in one pass, logits for the LAST
         # position only (skips the (b, prompt, vocab) f32 intermediate)

@@ -20,11 +20,25 @@ TPU-first shape of the design:
   per-row scatter write, ops/attention.py per-row causal mask). The
   whole batch decodes in lockstep regardless of where each slot is in
   its sequence.
-- **K-step decode chunks**: the decode loop is a ``lax.scan`` over K
-  steps per dispatch, amortizing host→device dispatch latency (tens of
-  ms through the axon tunnel) over K tokens; admission happens between
-  chunks. K trades admission latency against tail waste (a request
-  finishing mid-chunk wastes the rest of the chunk for its slot).
+- **K-step decode chunks, chained on device**: the decode loop is a
+  ``lax.scan`` over K steps per dispatch, and the chunk's inputs
+  (current token, position, temperature per slot) live in DEVICE arrays
+  that each chunk returns for the next — so successive chunks dispatch
+  back-to-back with no host round-trip between them. The host reads
+  chunk outputs at a pipeline lag of ``pipeline`` chunks: through the
+  axon tunnel a device→host fetch costs ~100 ms of latency, and the
+  lag hides it behind the next chunks' compute (the same reason the
+  legacy engine's one-program-per-generation looked fast: one sync per
+  request).
+- **One jitted dispatch per engine action, zero eager ops**: measured on
+  the axon tunnel, every EAGER device op — a ``jax.random.split``, a
+  bare ``.at[].set`` — costs 100-200 ms of round-trip latency, while
+  host→device transfers of small arrays are ~0.2 ms and jitted
+  dispatches pipeline. So nothing here runs eagerly: RNG keys derive
+  from a host int-counter seed INSIDE the programs, admission is one
+  prefill dispatch that also updates the per-slot device state itself,
+  and the decode chunk prepends its input token to the output so the
+  prefill's first token needs no separate fetch.
 - **Right-padded prefill into the slot**: a prompt is padded to a bucket
   length and prefilled batch=1 into a fresh (layers, 1, bucket) cache,
   then one dynamic_update_slice drops it into the big cache at the slot
@@ -32,7 +46,9 @@ TPU-first shape of the design:
   of the slot, and the per-row causal mask never attends a position
   ``> pos``; decode overwrites position p before the first query that
   could see it. The first-token logit is read at ``actual_len - 1`` via
-  the traced ``last_only`` index.
+  the traced ``last_only`` index, and the sampled token stays on device
+  until the slot's first chunk is processed (its output column 0) — an
+  admission is pure dispatch, no sync.
 - **Exact sampling in one program**: greedy is ``argmax``; per-slot
   temperature sampling is Gumbel-argmax (``argmax(logits/T + G)`` is an
   exact categorical draw), so mixed greedy/sampled slots share one
@@ -41,11 +57,22 @@ TPU-first shape of the design:
 
 Correctness contract (tests/test_slots.py): per-stream outputs are
 token-exact vs an isolated greedy ``make_generate_fn`` decode of the
-same prompt, for any admission order and slot reuse.
+same prompt, for any admission order and slot reuse. (On TPU, bf16
+matmul tilings differ between batch shapes, so argmax near-ties can
+flip vs a batch-1 reference on near-uniform random-init logits — the
+f32 CPU suite is the exactness proof; hardware runs report a match
+rate.)
+
+A slot that completes mid-chunk keeps decoding garbage until the host
+processes that chunk (bounded by ``pipeline``+1 chunks); its writes land
+in its own row and are either overwritten by the next admission's
+prefill or dropped past capacity (``mode="drop"``), so stale state never
+leaks into other requests.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import queue
 import threading
@@ -103,11 +130,12 @@ class Handle:
 @dataclasses.dataclass
 class _Slot:
     handle: Handle
-    tokens: list[int]          # emitted so far (starts with prefill token)
+    tokens: list[int]          # emitted so far, host-resolved
     max_new: int
-    last_tok: int
-    pos: int                   # next cache position to write
+    pos: int                   # host mirror of the cache write position
     temperature: float
+    fresh: bool = True         # no chunk processed yet: the first chunk's
+    #                            column 0 is this slot's prefill token
 
 
 class SlotEngine:
@@ -129,6 +157,7 @@ class SlotEngine:
         slots: int = 8,
         max_seq: int | None = None,
         chunk: int = 8,
+        pipeline: int = 2,
         buckets: tuple[int, ...] | None = None,
         eos_id: int | None = None,
         pad_id: int = 0,
@@ -139,11 +168,14 @@ class SlotEngine:
             raise ValueError(f"slots must be >= 1, got {slots}")
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
+        if pipeline < 0:
+            raise ValueError(f"pipeline must be >= 0, got {pipeline}")
         self.cfg = cfg
         self.params = params
         self.slots = slots
         self.max_seq = max_seq or cfg.max_seq_len
         self.chunk = chunk
+        self.pipeline = pipeline
         self.buckets = tuple(sorted(buckets or _default_buckets(self.max_seq)))
         if self.buckets[-1] > self.max_seq:
             raise ValueError(
@@ -155,10 +187,20 @@ class SlotEngine:
         cache = init_kv_cache(cfg, slots, self.max_seq, mesh=None,
                               dtype=cache_dtype)
         self._k, self._v = cache.k, cache.v
-        self._key = jax.random.PRNGKey(seed)
+        # RNG = a host counter folded into PRNGKey INSIDE the programs:
+        # an eager jax.random.split costs a ~150 ms tunnel round-trip
+        self._seed = seed
+        self._dispatches = 0
+        # device-resident per-slot decode inputs: each chunk consumes and
+        # returns them, so chunks chain with no host round-trip
+        self._dtok = jnp.zeros((slots,), jnp.int32)
+        self._dpos = jnp.zeros((slots,), jnp.int32)
+        self._dtemp = jnp.zeros((slots,), jnp.float32)
 
         self._pending: queue.SimpleQueue = queue.SimpleQueue()
         self._table: dict[int, _Slot | None] = {i: None for i in range(slots)}
+        #: dispatched-but-unprocessed chunks: (slot snapshot, device out)
+        self._outstanding: collections.deque = collections.deque()
         self._lock = threading.Lock()      # guards _table mutation vs stats
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -191,21 +233,28 @@ class SlotEngine:
         cfg, fwd = self.cfg, self._fwd
         cache_dtype = self._k.dtype
 
-        def prefill(params, prompt, actual_len, slot, temp, key, k_all, v_all):
+        def prefill(params, prompt, actual_len, slot, temp, seed,
+                    k_all, v_all, dtok, dpos, dtemp):
             shape = (cfg.n_layers, 1, bucket, cfg.n_kv_heads, cfg.head_dim)
             kc = jnp.zeros(shape, cache_dtype)
             vc = jnp.zeros(shape, cache_dtype)
             logits, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
                                  None, last_only=actual_len - 1)
-            tok = self._sample(logits[:, -1], temp[None], key)
+            tok = self._sample(logits[:, -1], temp[None],
+                               jax.random.PRNGKey(seed))
             zero = jnp.int32(0)
             k_all = lax.dynamic_update_slice(
                 k_all, kc, (zero, slot, zero, zero, zero))
             v_all = lax.dynamic_update_slice(
                 v_all, vc, (zero, slot, zero, zero, zero))
-            return tok[0], k_all, v_all
+            # seed the device-side decode inputs for this slot in the same
+            # program — an eager .at[].set would cost a tunnel round-trip
+            dtok = dtok.at[slot].set(tok[0])
+            dpos = dpos.at[slot].set(actual_len)
+            dtemp = dtemp.at[slot].set(temp)
+            return tok[0], k_all, v_all, dtok, dpos, dtemp
 
-        fn = jax.jit(prefill, donate_argnums=(6, 7))
+        fn = jax.jit(prefill, donate_argnums=(6, 7, 8, 9, 10))
         self._prefill_fns[bucket] = fn
         return fn
 
@@ -214,20 +263,23 @@ class SlotEngine:
             return self._decode_fn
         cfg, fwd, K = self.cfg, self._fwd, self.chunk
 
-        def decode_chunk(params, tok, pos, temp, key, k_all, v_all):
+        def decode_chunk(params, seed, dtok, dpos, dtemp, k_all, v_all):
             def body(carry, step_key):
                 tok, pos, k_all, v_all = carry
                 logits, k_all, v_all = fwd(
                     params, tok[:, None], cfg, k_all, v_all, pos, None)
-                nxt = self._sample(logits[:, -1], temp, step_key)
+                nxt = self._sample(logits[:, -1], dtemp, step_key)
                 return (nxt, pos + 1, k_all, v_all), nxt
 
-            keys = jax.random.split(key, K)
+            keys = jax.random.split(jax.random.PRNGKey(seed), K)
             (tok, pos, k_all, v_all), out = lax.scan(
-                body, (tok, pos, k_all, v_all), keys)
-            return out.T, k_all, v_all  # (S, K)
+                body, (dtok, dpos, k_all, v_all), keys)
+            # column 0 = the INPUT token (a fresh slot's prefill token —
+            # saves the host a separate scalar fetch), columns 1..K = new
+            out_full = jnp.concatenate([dtok[:, None], out.T], axis=1)
+            return out_full, tok, pos, k_all, v_all  # out: (S, K+1)
 
-        self._decode_fn = jax.jit(decode_chunk, donate_argnums=(5, 6))
+        self._decode_fn = jax.jit(decode_chunk, donate_argnums=(2, 3, 5, 6))
         return self._decode_fn
 
     def warmup(self, buckets: tuple[int, ...] | None = None) -> None:
@@ -242,15 +294,15 @@ class SlotEngine:
         the (empty) cache, which admission later overwrites."""
         if self._thread is not None:
             raise RuntimeError("warmup must run before start()")
-        key = jax.random.PRNGKey(0)
         for b in (self.buckets if buckets is None else buckets):
-            _, self._k, self._v = self._prefill_fn(b)(
-                self.params, jnp.zeros((1, b), jnp.int32), jnp.int32(1),
-                jnp.int32(0), jnp.float32(0.0), key, self._k, self._v)
-        zero_i = jnp.zeros((self.slots,), jnp.int32)
-        _, self._k, self._v = self._decode()(
-            self.params, zero_i, zero_i,
-            jnp.zeros((self.slots,), jnp.float32), key, self._k, self._v)
+            (_, self._k, self._v, self._dtok, self._dpos,
+             self._dtemp) = self._prefill_fn(b)(
+                self.params, jnp.zeros((1, b), jnp.int32), np.int32(1),
+                np.int32(0), np.float32(0.0), np.uint32(0),
+                self._k, self._v, self._dtok, self._dpos, self._dtemp)
+        _, self._dtok, self._dpos, self._k, self._v = self._decode()(
+            self.params, np.uint32(0), self._dtok, self._dpos, self._dtemp,
+            self._k, self._v)
 
     # ---- request API -------------------------------------------------------
 
@@ -283,9 +335,17 @@ class SlotEngine:
 
     # ---- engine loop -------------------------------------------------------
 
+    def _next_seed(self) -> np.uint32:
+        """Per-dispatch RNG stream id: deterministic in the engine seed,
+        derived on the host (no device ops)."""
+        self._dispatches += 1
+        return np.uint32((self._seed * 1000003 + self._dispatches)
+                         % (2 ** 31))
+
     def _admit(self) -> bool:
-        """Move pending requests into free slots (one prefill dispatch
-        each). Returns True if anything was admitted."""
+        """Move pending requests into free slots — ONE prefill dispatch
+        each (it updates the per-slot device state itself), fully async
+        unless max_new == 1. Returns True if anything was admitted."""
         admitted = False
         free = [i for i, s in self._table.items() if s is None]
         while free:
@@ -297,18 +357,23 @@ class SlotEngine:
             bucket = next(b for b in self.buckets if b >= len(prompt))
             padded = np.full((1, bucket), self.pad_id, np.int32)
             padded[0, :len(prompt)] = prompt
-            self._key, sub = jax.random.split(self._key)
-            tok, self._k, self._v = self._prefill_fn(bucket)(
+            (tok, self._k, self._v, self._dtok, self._dpos,
+             self._dtemp) = self._prefill_fn(bucket)(
                 self.params, jnp.asarray(padded),
-                jnp.int32(len(prompt)), jnp.int32(slot),
-                jnp.float32(temp), sub, self._k, self._v)
-            first = int(tok)
+                np.int32(len(prompt)), np.int32(slot),
+                np.float32(temp), self._next_seed(),
+                self._k, self._v, self._dtok, self._dpos, self._dtemp)
             self.stats["prefills"] += 1
-            st = _Slot(handle=handle, tokens=[first], max_new=max_new,
-                       last_tok=first, pos=len(prompt), temperature=temp)
+            st = _Slot(handle=handle, tokens=[], max_new=max_new,
+                       pos=len(prompt), temperature=temp)
             with self._lock:
                 self._table[slot] = st
-            self._finish_if_done(slot, st)  # max_new == 1 / instant eos
+            if max_new == 1:
+                # nothing to decode: resolve the prefill token now (the
+                # one admission path that syncs) and complete
+                st.tokens.append(int(tok))
+                st.fresh = False
+                self._finish_if_done(slot, st)
             admitted = True
         return admitted
 
@@ -325,37 +390,64 @@ class SlotEngine:
             return True
         return False
 
-    def step(self) -> bool:
-        """One engine iteration: admit pending requests, then (if any slot
-        is active) run one K-step decode chunk and distribute its tokens.
-        Returns True if any work was done. Tests drive this directly; the
-        background thread loops it."""
-        did = self._admit()
-        active = {i: s for i, s in self._table.items() if s is not None}
-        if not active:
-            return did
-
-        tok = np.full((self.slots,), self.pad_id, np.int32)
-        pos = np.zeros((self.slots,), np.int32)
-        temp = np.zeros((self.slots,), np.float32)
-        for i, s in active.items():
-            tok[i], pos[i], temp[i] = s.last_tok, s.pos, s.temperature
-        self._key, sub = jax.random.split(self._key)
-        out, self._k, self._v = self._decode()(
-            self.params, jnp.asarray(tok), jnp.asarray(pos),
-            jnp.asarray(temp), sub, self._k, self._v)
-        out = np.asarray(out)  # (S, K)
+    def _dispatch_chunk(self) -> None:
+        out, self._dtok, self._dpos, self._k, self._v = self._decode()(
+            self.params, self._next_seed(), self._dtok, self._dpos,
+            self._dtemp, self._k, self._v)
+        # start the device→host copy now: by the time this chunk is
+        # processed (``pipeline`` chunks later) the tokens are already on
+        # the host, so the fetch doesn't stall the dispatch loop for a
+        # tunnel round-trip (~100 ms — 2x a whole chunk's compute)
+        out.copy_to_host_async()
+        snap = {i: s for i, s in self._table.items() if s is not None}
+        self._outstanding.append((snap, out))
         self.stats["decode_chunks"] += 1
 
-        for i, s in active.items():
-            s.pos += self.chunk
-            s.last_tok = int(out[i, -1])
-            for j in range(self.chunk):
-                s.tokens.append(int(out[i, j]))
-                if self._finish_if_done(i, s):
-                    self.stats["wasted_steps"] += self.chunk - 1 - j
+    def _process_oldest(self) -> None:
+        """Host-side half of one chunk: fetch its tokens (the only sync in
+        the steady state) and distribute them to the slots that were
+        active at its dispatch; complete/free slots that hit eos or
+        max_new. Slots freed by an EARLIER chunk are skipped by identity
+        (the snapshot holds the _Slot object, not just the index)."""
+        snap, out = self._outstanding.popleft()
+        out = np.asarray(out)  # (S, K+1); column 0 is the chunk's input
+        for i, st in snap.items():
+            if self._table.get(i) is not st:
+                continue  # completed in an earlier chunk; this is garbage
+            start = 0 if st.fresh else 1  # col 0: prefill token, once
+            st.fresh = False
+            st.pos += self.chunk
+            for j in range(start, self.chunk + 1):
+                st.tokens.append(int(out[i, j]))
+                if self._finish_if_done(i, st):
+                    self.stats["wasted_steps"] += self.chunk - j
                     break
-        return True
+
+    def step(self) -> bool:
+        """One engine iteration: admit pending requests, dispatch one
+        decode chunk if any slot is active, and process chunk outputs at
+        the pipeline lag (drain fully when idle). Returns True if any
+        work was done. Tests drive this directly; the background thread
+        loops it."""
+        did = False
+        # a waiting request with no free slot: drain outstanding chunks
+        # first — completions hide in them, and admission latency beats
+        # pipeline depth
+        if not self._pending.empty() and not any(
+                s is None for s in self._table.values()):
+            while self._outstanding:
+                self._process_oldest()
+                did = True
+        did = self._admit() or did
+        active = any(s is not None for s in self._table.values())
+        if active:
+            self._dispatch_chunk()
+            did = True
+        lag = self.pipeline if active else 0
+        while len(self._outstanding) > lag:
+            self._process_oldest()
+            did = True
+        return did
 
     def _loop(self) -> None:
         while not self._closed:
@@ -402,7 +494,7 @@ class SlotEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
-        # fail anything still queued so callers don't hang
+        # fail anything still queued or in flight so callers don't hang
         while True:
             try:
                 *_, handle = self._pending.get_nowait()
@@ -413,6 +505,7 @@ class SlotEngine:
             if s is not None:
                 s.handle._fail(RuntimeError("engine closed"))
                 self._table[i] = None
+        self._outstanding.clear()
 
     def __enter__(self) -> "SlotEngine":
         return self.start()

@@ -48,6 +48,12 @@ def dense_attention(
     batch, seq, num_heads, head_dim = q.shape
     kv_seq, num_kv = k.shape[1], k.shape[2]
     group = num_heads // num_kv
+    if k.dtype != q.dtype:
+        # narrow KV-cache dtypes (fp8 serving cache): upcast in-register —
+        # XLA fuses the convert into the einsum, so only the narrow bytes
+        # cross HBM
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     # q/k stay in the storage dtype with f32 accumulation: bf16 products
     # are exact in f32, so this equals the upcast-everything numerics
     # without writing f32 copies of the cache. probs default to f32 (a
