@@ -361,8 +361,29 @@ def check_speculative_trained() -> bool:
             draft_train_loss=round(loss_d, 3))
     # the headline claim: a genuinely smaller trained draft gives REAL
     # wall-clock speedup (2026-07 v5e: 1.22x at k=4, 1.10x at k=8)
-    return ok & _emit("speculative_trained_speedup", best_speedup > 1.05,
-                      best_speedup=round(best_speedup, 2))
+    ok &= _emit("speculative_trained_speedup", best_speedup > 1.05,
+                best_speedup=round(best_speedup, 2))
+
+    # acceptance < 1 operating point (VERDICT r2 weak #2): a PARTIALLY
+    # trained draft (a fraction of the full draft's steps — induction not
+    # yet fully formed) must still produce token-exact output through the
+    # rollback path, at measurably reduced acceptance. This is the
+    # hardware proof that rejection/rollback works, not just the
+    # acceptance≈1 happy path. 2026-07 v5e: acceptance ~0.6, exact.
+    params_dp, loss_dp = train(cfg_d, 150, 2e-3)
+    sf = make_speculative_generate_fn(cfg_t, cfg_d, SpeculativeConfig(
+        max_new_tokens=n, n_speculative=4, max_seq=512))
+    res_p = sf(params_t, params_dp, prompt)
+    int(jnp.sum(res_p["tokens"]))  # compile + force
+    t_part = grouped(lambda: sf(params_t, params_dp, prompt))
+    acc_p = int(res_p["accepted"]) / (int(res_p["rounds"]) * 4)
+    match_p = float(jnp.mean(
+        (res_p["tokens"] == results["plain"]["tokens"]).astype(jnp.float32)))
+    return ok & _emit(
+        "speculative_partial_draft", match_p == 1.0 and acc_p < 0.95,
+        k=4, acceptance=round(acc_p, 2), tokens_match=round(match_p, 2),
+        speedup_vs_plain=round(t_plain / t_part, 2),
+        draft_train_steps=150, draft_train_loss=round(loss_dp, 3))
 
 
 def check_vit_train() -> bool:
@@ -398,8 +419,16 @@ def check_encdec_train() -> bool:
     2026-07 v5e: 72 pairs/s, MFU 0.34 (corrected flops_per_pair — an
     earlier double-counted formula briefly read 0.40; first tuning pass:
     512-token encoder/cross attention back on the flash kernel, +10%).
-    Still below the 0.40 llama/ViT bar — the 32k-vocab head over a short
-    target dominates. Gate 0.28: regression tripwire under ±2% noise."""
+
+    Round-3 roofline verdict (docs/perf-notes.md "encdec roofline"): the
+    r2 head-dominates diagnosis was WRONG — chunked CE and batch 64 are
+    throughput-neutral (measured 0.331/0.325 vs 0.339). The binding
+    constraint is the dim-768 geometry itself: a pure-matmul fwd+bwd
+    chain at the model's exact shapes tops out at 0.62 MFU on v5e (the
+    same chain at llama3-1b's dim-2048 shapes: 0.87), and attention +
+    norm/rope traffic take the rest. 0.34 ≈ 55% of the achievable
+    matmul ceiling; the 0.40 absolute bar is not reachable at this
+    geometry. Gate 0.28: regression tripwire under ±2% noise."""
     import math
 
     import jax
@@ -460,6 +489,37 @@ def check_8b_inference() -> bool:
     return ok
 
 
+def check_slot_serving() -> bool:
+    """Continuous-batching slot engine (infer/slots.py) vs the round-2
+    serialized gen_lock path: 8 concurrent streams, llama3-1b bf16.
+    2026-07 v5e: 1126 aggregate tok/s vs 263 serialized = 4.28x (the
+    8b-int8 point rides in bench.py: 5.29x). Gate 2.0: the VERDICT r2
+    item-1 done-bar."""
+    from tpu_docker_api.infer.servebench import bench_concurrent_serving
+
+    r = bench_concurrent_serving(preset="llama3-1b", streams=8,
+                                 prompt_len=128, new_tok=64, max_seq=512,
+                                 chunk=8)
+    return _emit("slot_serving_concurrent",
+                 r.pop("ok") and r["speedup"] >= 2.0, **r)
+
+
+def check_decode_roofline() -> bool:
+    """llama3-8b int8 decode-only latency vs the weight-streaming HBM
+    roof (VERDICT r2 item 2). 2026-07 v5e: 20.4 ms/tok at batch 64 =
+    3132 decode tok/s = 51% of the 819 GB/s weights-only roof (84% at
+    batch 16, where the per-step cache read is small — the gap at large
+    batch IS the cache read; fp8 cache measured no-win on v5e, cache
+    right-sizing in engine.py recovered 29.0→20.4 ms). Gate 0.40 of
+    roof at batch 64."""
+    from tpu_docker_api.infer.servebench import bench_decode_roofline
+
+    r = bench_decode_roofline(preset="llama3-8b", batch=64, prompt_len=128,
+                              new_tok=64, max_seq=512, reps=2)
+    ok = r.pop("ok") and (r["pct_hbm_roof"] or 0) >= 40.0
+    return _emit("decode_roofline_8b_int8", ok, **r)
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--fast", action="store_true",
@@ -481,6 +541,8 @@ def main() -> int:
         checks.append(check_speculative_mechanism)
         checks.append(check_speculative_trained)
         checks.append(check_8b_inference)
+        checks.append(check_slot_serving)
+        checks.append(check_decode_roofline)
     ok = True
     for check in checks:
         try:

@@ -76,6 +76,12 @@ class EncDecConfig:
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # >0: encdec_loss fuses the 32k-vocab head into
+    # ops.xent.chunked_cross_entropy with this row-chunk size — the (b, T,
+    # vocab) f32 logits (2.1 GB at bench shapes) and its backward dlogits
+    # are never materialized. The round-2 encdec MFU shortfall (0.334 vs
+    # 0.40) was diagnosed as exactly this head (docs/perf-notes.md)
+    loss_chunk_rows: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -252,8 +258,10 @@ def encdec_encode(params, src, cfg: EncDecConfig, mesh=None):
         cfg.dtype)
 
 
-def encdec_forward(params, batch, cfg: EncDecConfig, mesh=None):
-    """((b, S) src, (b, T) tgt-input) → next-token logits (b, T, vocab)."""
+def encdec_hidden(params, batch, cfg: EncDecConfig, mesh=None):
+    """((b, S) src, (b, T) tgt-input) → final decoder hidden (b, T, d),
+    pre-final-norm — shared by the dense-logits tail (``encdec_forward``)
+    and the chunked-CE loss (which never materializes full logits)."""
     src, tgt = batch
     enc_out = encdec_encode(params, src, cfg, mesh)
     x = embed_lookup(params["embed"]["tokens"], tgt, mesh)
@@ -269,6 +277,12 @@ def encdec_forward(params, batch, cfg: EncDecConfig, mesh=None):
         return block(x, enc_out, layer), None
 
     x, _ = lax.scan(body, x, params["dec_layers"])
+    return x
+
+
+def encdec_forward(params, batch, cfg: EncDecConfig, mesh=None):
+    """((b, S) src, (b, T) tgt-input) → next-token logits (b, T, vocab)."""
+    x = encdec_hidden(params, batch, cfg, mesh)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = linear(x.astype(cfg.dtype), params["lm_head"],
                     out_dtype=jnp.float32)
@@ -279,8 +293,20 @@ def encdec_forward(params, batch, cfg: EncDecConfig, mesh=None):
 
 def encdec_loss(params, batch, cfg: EncDecConfig, mesh=None):
     """Teacher-forced seq2seq CE: batch = (src (b, S), tgt (b, T+1));
-    decoder consumes tgt[:, :-1] and predicts tgt[:, 1:]."""
+    decoder consumes tgt[:, :-1] and predicts tgt[:, 1:].
+
+    With ``cfg.loss_chunk_rows`` set, the head fuses into
+    ``ops.xent.chunked_cross_entropy`` exactly like ``llama_loss``."""
     src, tgt = batch
+    if cfg.loss_chunk_rows:
+        from tpu_docker_api.ops.xent import chunked_cross_entropy
+
+        x = encdec_hidden(params, (src, tgt[:, :-1]), cfg, mesh)
+        h = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cfg.dtype)
+        if mesh is not None:
+            h = constrain(h, mesh, P(("dp", "fsdp"), None, None))
+        return chunked_cross_entropy(
+            h, params["lm_head"], tgt[:, 1:], cfg.loss_chunk_rows)
     logits = encdec_forward(params, (src, tgt[:, :-1]), cfg, mesh)
     return cross_entropy(logits, tgt[:, 1:])
 
