@@ -43,6 +43,159 @@ print(f"JOB-CHILD-OK p{jax.process_index()} total={total}")
 """
 
 
+MULTISLICE_CHILD_CODE = """
+import os
+from functools import partial
+from tpu_docker_api.workload.jaxenv import bootstrap_jax
+bootstrap_jax(platform="cpu", virtual_devices=2)
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+sid = int(os.environ["MEGASCALE_SLICE_ID"])
+assert os.environ["MEGASCALE_NUM_SLICES"] == "2"
+assert jax.process_count() == 2, jax.process_count()
+# device order is process-major, and the service placed one slice per
+# process — so axis 0 of this mesh IS the slice axis
+devs = np.array(jax.devices()).reshape(2, 2)
+mesh = Mesh(devs, ("slice", "dp"))
+local = np.full((2, 4), float(sid + 1), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(("slice", "dp"))), local)
+
+@partial(shard_map, mesh=mesh, in_specs=P(("slice", "dp")),
+         out_specs=P("slice"))
+def slice_sums(x):
+    # psum over dp ONLY: slice-local reduction — the CPU/gloo analog of
+    # per-slice ICI collectives under MEGASCALE DCN stitching
+    return lax.psum(x.sum(), "dp")[None]
+
+totals = jax.jit(slice_sums)(arr)
+# the result is sharded over the slice axis: each process addresses only
+# its own slice's entry — which is exactly the slice-locality assertion
+mine = float(np.asarray(totals.addressable_shards[0].data)[0])
+assert mine == 8.0 * (sid + 1), (sid, mine)  # 2 rows x 4 x (sid+1)
+# and the cross-slice (DCN-analog) reduction still sees the whole world
+grand = float(jax.jit(lambda x: x.sum())(arr))
+assert grand == 24.0, grand
+print(f"MS-CHILD-OK p{jax.process_index()} slice={sid} mine={mine}")
+"""
+
+
+def _run_children(envs, coord_rewrites, child_code, marker):
+    """Launch one child per env dict (JAX_*/MEGASCALE_* taken verbatim,
+    addresses rewritten to loopback) and assert all exit 0 with the
+    marker in their output."""
+    procs = []
+    for env_dict in envs:
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("JAX_", "TPU_", "MEGASCALE_"))}
+        env.update({k: v for k, v in env_dict.items()
+                    if k.startswith(("JAX_", "MEGASCALE_"))})
+        for var, (old, new) in coord_rewrites.items():
+            if var in env:
+                env[var] = env[var].replace(old, new)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(REPO_ROOT), env.get("PYTHONPATH", "")]).rstrip(":")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", child_code], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(REPO_ROOT)))
+    try:
+        deadline = time.monotonic() + 300
+        pending = dict(enumerate(procs))
+        outputs = {}
+        while pending:
+            if time.monotonic() > deadline:
+                raise AssertionError(f"children {sorted(pending)} hung")
+            for pid, p in list(pending.items()):
+                if p.poll() is None:
+                    continue
+                outputs[pid] = p.stdout.read()
+                assert p.returncode == 0, (
+                    f"child {pid} rc={p.returncode}:\n{outputs[pid]}")
+                del pending[pid]
+            time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, text in outputs.items():
+        assert marker in text, text
+    return outputs
+
+
+@pytest.mark.slow
+def test_multislice_job_env_boots_real_processes_with_slice_grouping():
+    """numSlices=2 end-to-end (VERDICT r2 item 5): POST /jobs renders one
+    ICI slice per host plus MEGASCALE_* DCN stitching; the test launches
+    real processes from that env verbatim. MEGASCALE itself is libtpu-
+    only, so the executed proof is the gloo world with SLICE-LOCAL
+    grouping asserted: a shard_map psum over the dp axis alone reduces
+    within each slice, the global sum crosses them."""
+    cfg = Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        accelerator_type="v5e-4", start_port=42200, end_port=42299,
+        health_watch_interval=0,
+        pod_hosts=[
+            {"host_id": "h0", "address": "10.0.0.1",
+             "grid_coord": [0, 0, 0], "local": True},
+            {"host_id": "h1", "address": "10.0.0.2",
+             "grid_coord": [1, 0, 0], "runtime_backend": "fake"},
+        ],
+    )
+    prog = Program(cfg, host="127.0.0.1")
+    prog.init()
+    prog.start()
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}/api/v1/jobs",
+            method="POST",
+            data=json.dumps({"imageName": "workload", "jobName": "ms",
+                             "chipCount": 8, "numSlices": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert out["code"] == 200, out
+
+        envs = []
+        for host in prog.pod.hosts.values():
+            for name in host.runtime.container_list():
+                if name.startswith("ms"):
+                    spec = host.runtime.container_inspect(name).spec
+                    envs.append(dict(e.split("=", 1) for e in spec.env))
+        assert len(envs) == 2, [list(e) for e in envs]
+        envs.sort(key=lambda e: int(e["JAX_PROCESS_ID"]))
+
+        # the service-injected multislice contract, per container
+        assert [e["MEGASCALE_SLICE_ID"] for e in envs] == ["0", "1"]
+        for e in envs:
+            assert e["MEGASCALE_NUM_SLICES"] == "2"
+            assert e["JAX_NUM_PROCESSES"] == "2"  # ONE jax world
+        ms_addrs = {e["MEGASCALE_COORDINATOR_ADDRESS"] for e in envs}
+        assert len(ms_addrs) == 1  # every slice stitches to one endpoint
+        assert {e["MEGASCALE_PORT"] for e in envs} != {""}
+        # the libtpu ICI mesh must be SLICE-LOCAL: each container's peer
+        # list contains only its own host (no ICI path across slices)
+        for e in envs:
+            peers = e["TPU_PROCESS_ADDRESSES"].split(",")
+            assert len(peers) == 1, peers
+
+        coord = envs[0]["JAX_COORDINATOR_ADDRESS"]
+        _run_children(
+            envs,
+            {"JAX_COORDINATOR_ADDRESS": ("10.0.0.1", "127.0.0.1"),
+             "MEGASCALE_COORDINATOR_ADDRESS": ("10.0.0.1", "127.0.0.1")},
+            MULTISLICE_CHILD_CODE, "MS-CHILD-OK")
+        assert coord.startswith("10.0.0.1:")
+    finally:
+        prog.stop()
+
+
 @pytest.mark.slow
 def test_job_service_env_boots_real_distributed_processes(tmp_path):
     cfg = Config(
