@@ -193,19 +193,28 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
                       layer["attn"]["wo"]), (k_all, v_all)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
-    if cfg.attention_impl in ("ring", "ring-zigzag"):
+    impl = cfg.attention_impl
+    if (impl == "auto" and mesh is not None and not mesh.empty
+            and mesh.shape.get("sp", 1) > 1):
+        # a real sp axis: ring attention is the only impl that keeps the
+        # sharded seq axis device-local (dense/flash would force an
+        # all-gather of k/v). Zigzag placement by default — per-device
+        # causal block counts are uniform (2n+1 half-stripe pairs each)
+        # vs the contiguous layout's 1..n skew (parallel/ring.py; the
+        # counts are printed into the multichip dryrun artifact)
+        impl = "ring-zigzag"
+    if impl in ("ring", "ring-zigzag"):
         from tpu_docker_api.parallel.ring import ring_attention
 
         out = ring_attention(
             q, k, v, mesh, causal=True,
-            placement="zigzag" if cfg.attention_impl == "ring-zigzag"
-            else "contiguous")
-    elif cfg.attention_impl == "ulysses":
+            placement="zigzag" if impl == "ring-zigzag" else "contiguous")
+    elif impl == "ulysses":
         from tpu_docker_api.parallel.ulysses import ulysses_attention
 
         out = ulysses_attention(q, k, v, mesh, causal=True)
     else:
-        out = multihead_attention(q, k, v, causal=True, impl=cfg.attention_impl)
+        out = multihead_attention(q, k, v, causal=True, impl=impl)
     return linear(out.reshape(b, s, cfg.n_heads * hd), layer["attn"]["wo"])
 
 
