@@ -32,6 +32,7 @@ test-fast:                   ## control-plane tests only (no JAX compiles)
 	  --ignore=tests/test_vit.py --ignore=tests/test_encdec.py \
 	  --ignore=tests/test_quant.py --ignore=tests/test_optim.py \
 	  --ignore=tests/test_serve.py --ignore=tests/test_speculative.py \
+	  --ignore=tests/test_slots.py \
 	  --ignore=tests/test_distributed_e2e.py \
 	  --ignore=tests/test_job_distributed_e2e.py
 

@@ -156,6 +156,24 @@ class TestAdmissionAndLimits:
         assert _default_buckets(128) == (32, 64, 128)
         assert _default_buckets(24) == (24,)
 
+    def test_backpressure_queue_full(self, setup):
+        """max_pending bounds the admission queue: submits past it shed
+        load with QueueFull instead of growing latency unbounded."""
+        from tpu_docker_api.infer.slots import QueueFull
+
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=1, max_seq=MAX_SEQ, chunk=2,
+                         max_pending=2)
+        handles = [eng.submit([1, 2], 4) for _ in range(2)]
+        with pytest.raises(QueueFull):
+            eng.submit([1, 2], 4)
+        for _ in range(100):
+            if all(h.done() for h in handles):
+                break
+            eng.step()
+        assert all(h.done() for h in handles)
+        eng.submit([1, 2], 4)  # queue drained: admits again
+
     def test_queue_deeper_than_slots_drains(self, setup):
         cfg, params = setup
         eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)

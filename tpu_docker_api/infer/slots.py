@@ -138,6 +138,11 @@ class _Slot:
     #                            column 0 is this slot's prefill token
 
 
+class QueueFull(Exception):
+    """Admission queue at capacity — callers should shed load (HTTP 503)
+    rather than let latency grow unbounded."""
+
+
 class SlotEngine:
     """Slot-based continuous-batching engine for the decoder families
     (llama + moe via ``models.cached_forward_fn``).
@@ -163,6 +168,7 @@ class SlotEngine:
         pad_id: int = 0,
         cache_dtype: Any = jnp.bfloat16,
         seed: int = 0,
+        max_pending: int = 0,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -183,6 +189,10 @@ class SlotEngine:
                 f"{self.max_seq}")
         self.eos_id = eos_id
         self.pad_id = pad_id
+        #: admission-queue bound (0 = unbounded). Checked approximately —
+        #: SimpleQueue.qsize() races under concurrent submitters, but the
+        #: point is load shedding, not an exact ceiling.
+        self.max_pending = max_pending
         self._fwd = cached_forward_fn(cfg)
         cache = init_kv_cache(cfg, slots, self.max_seq, mesh=None,
                               dtype=cache_dtype)
@@ -329,6 +339,9 @@ class SlotEngine:
             raise ValueError(
                 f"prompt ({n}) + max_new ({max_new}) exceeds cache "
                 f"capacity {self.max_seq}")
+        if self.max_pending and self._pending.qsize() >= self.max_pending:
+            raise QueueFull(
+                f"admission queue at capacity ({self.max_pending})")
         self._pending.put((list(prompt), max_new, float(temperature), handle))
         self._wake.set()
         return handle

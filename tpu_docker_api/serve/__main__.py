@@ -144,6 +144,9 @@ def main(argv: list[str] | None = None) -> None:
         slot_engine = SlotEngine(
             cfg, params, slots=args.slots, max_seq=max_seq,
             chunk=args.chunk,
+            # shed load once the queue is 8x the slot count deep — beyond
+            # that, added requests only buy latency, not throughput
+            max_pending=args.slots * 8,
             seed=int.from_bytes(os.urandom(4), "little"))
         # compile the shared decode chunk before binding the port: a
         # mid-service compile on the engine thread stalls every active
@@ -274,8 +277,15 @@ def main(argv: list[str] | None = None) -> None:
                     # continuous batching: each row is its own request;
                     # rows may be ragged. Responses keep the legacy dense
                     # contract (pad to maxNewTokens + lengths).
-                    handles = [slot_engine.submit(r, max_new, temperature)
-                               for r in prompts]
+                    from tpu_docker_api.infer.slots import QueueFull
+
+                    try:
+                        handles = [slot_engine.submit(r, max_new,
+                                                      temperature)
+                                   for r in prompts]
+                    except QueueFull as e:
+                        self._reply(503, {"error": str(e)})
+                        return
                     outs = [h.result(timeout=600) for h in handles]
                     self._reply(200, {
                         "tokens": [o["tokens"]
