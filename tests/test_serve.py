@@ -137,6 +137,49 @@ class TestServe:
         assert h["slotEngine"]["completed"] >= 4
         assert h["slotEngine"]["slots"] == 4
 
+    def test_streaming_ndjson(self, server):
+        """stream:true — chunked ndjson, one token line at a time, then a
+        done line; tokens equal the non-streamed greedy response."""
+        port, _ = server
+        body = {"tokens": [[4, 9, 2]], "maxNewTokens": 6,
+                "temperature": 0.0}
+        plain = _post(port, "/generate", body)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({**body, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            for line in r:
+                lines.append(json.loads(line))
+        assert lines[-1]["done"] is True
+        toks = [ln["t"] for ln in lines[:-1]]
+        assert toks == plain["tokens"][0]
+        assert lines[-1]["length"] == plain["lengths"][0]
+
+    def test_streaming_rejects_multi_row_and_topk(self, server):
+        port, _ = server
+        for body in ({"tokens": [[1, 2], [3, 4]], "maxNewTokens": 2,
+                      "stream": True},
+                     {"tokens": [[1, 2]], "maxNewTokens": 2, "topK": 3,
+                      "temperature": 0.5, "stream": True}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, "/generate", body)
+            assert e.value.code == 400
+
+    def test_eos_id_truncates(self, server):
+        port, _ = server
+        base = {"tokens": [[6, 2, 8]], "maxNewTokens": 8,
+                "temperature": 0.0}
+        free = _post(port, "/generate", base)
+        eos = free["tokens"][0][2]
+        out = _post(port, "/generate", {**base, "eosId": eos})
+        n = out["lengths"][0]
+        assert n == free["tokens"][0].index(eos) + 1
+        assert out["tokens"][0][n - 1] == eos
+        assert out["tokens"][0][n:] == [0] * (8 - n)  # padded
+
     def test_topk_falls_back_to_legacy_path(self, server):
         port, _ = server
         out = _post(port, "/generate",

@@ -138,6 +138,60 @@ class TestSampling:
         assert len(set(outs)) > 1  # temperature 2 on a random-init model
 
 
+class TestStreamingAndEos:
+    def test_per_request_eos(self, setup):
+        """Two requests, same prompt, different eos — each truncates at
+        its own token (host-side check; shared compiled programs)."""
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5]
+        ref = isolated_greedy(cfg, params, prompt, 12)
+        eos_a = ref[2]
+        first_a = ref.index(eos_a) + 1
+        eos_b = next(t for t in range(cfg.vocab_size) if t not in ref)
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        ha = eng.submit(prompt, 12, eos_id=eos_a)
+        hb = eng.submit(prompt, 12, eos_id=eos_b)  # never fires
+        while not (ha.done() and hb.done()):
+            eng.step()
+        assert ha.result(0)["tokens"] == ref[:first_a]
+        assert hb.result(0)["tokens"] == ref
+
+    def test_stream_yields_tokens_incrementally_and_exactly(self, setup):
+        cfg, params = setup
+        prompt = [2, 7, 1, 8]
+        ref = isolated_greedy(cfg, params, prompt, 11)
+        with SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ,
+                        chunk=3) as eng:
+            h = eng.submit(prompt, 11, stream=True)
+            got = list(h.stream())
+        assert got == ref
+        assert h.result(0)["tokens"] == ref
+
+    def test_stream_on_nonstreaming_handle_raises(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=1, max_seq=MAX_SEQ, chunk=2)
+        h = eng.submit([1, 2], 3)
+        with pytest.raises(RuntimeError, match="not a streaming"):
+            next(h.stream())
+        while not h.done():
+            eng.step()
+
+    def test_stream_surfaces_engine_failure(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=1, max_seq=MAX_SEQ, chunk=2)
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic failure")
+
+        eng._admit = boom
+        h = Handle(_stream=__import__("queue").SimpleQueue())
+        eng._pending.put(([1, 2], 4, 0.0, None, h))
+        eng.start()
+        with pytest.raises(RuntimeError, match="engine failed"):
+            list(h.stream())
+        eng.close()
+
+
 class TestAdmissionAndLimits:
     def test_rejects_before_queueing(self, setup):
         cfg, params = setup

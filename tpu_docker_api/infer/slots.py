@@ -102,11 +102,16 @@ def _default_buckets(max_seq: int) -> tuple[int, ...]:
 class Handle:
     """Per-request future. ``result()`` blocks until the request completes
     and returns {"tokens": [...], "length": n} (tokens truncated at eos,
-    inclusive, like the legacy engine's lengths contract)."""
+    inclusive, like the legacy engine's lengths contract). Streaming
+    requests (``submit(stream=True)``) additionally expose
+    :meth:`stream` — an iterator of tokens as the engine resolves them
+    (per processed chunk, so latency ≈ chunk × step time + pipeline
+    lag); ``result()`` still returns the full payload afterwards."""
 
     _done: threading.Event = dataclasses.field(default_factory=threading.Event)
     _result: dict | None = None
     _error: Exception | None = None
+    _stream: queue.SimpleQueue | None = None
 
     def result(self, timeout: float | None = None) -> dict:
         if not self._done.wait(timeout):
@@ -118,13 +123,39 @@ class Handle:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def stream(self, timeout: float | None = None):
+        """Yield tokens as they resolve; raises the engine error (if any)
+        at the end, and TimeoutError if ``timeout`` seconds pass without
+        a new token (a wedged — not dead — engine must not block
+        consumers forever). Only valid for ``submit(stream=True)``
+        requests."""
+        if self._stream is None:
+            raise RuntimeError("not a streaming request")
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no token within {timeout}s") from None
+            if item is None:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
     def _complete(self, result: dict) -> None:
+        # _done BEFORE the stream sentinel: a consumer unblocking from
+        # stream() may immediately call result(0)
         self._result = result
         self._done.set()
+        if self._stream is not None:
+            self._stream.put(None)
 
     def _fail(self, err: Exception) -> None:
         self._error = err
         self._done.set()
+        if self._stream is not None:
+            self._stream.put(None)
 
 
 @dataclasses.dataclass
@@ -134,8 +165,15 @@ class _Slot:
     max_new: int
     pos: int                   # host mirror of the cache write position
     temperature: float
+    eos_id: int | None = None  # per-request; host-side check only, so it
+    #                            costs nothing in the compiled programs
     fresh: bool = True         # no chunk processed yet: the first chunk's
     #                            column 0 is this slot's prefill token
+
+    def emit(self, t: int) -> None:
+        self.tokens.append(t)
+        if self.handle._stream is not None:
+            self.handle._stream.put(t)
 
 
 class QueueFull(Exception):
@@ -317,11 +355,16 @@ class SlotEngine:
     # ---- request API -------------------------------------------------------
 
     def submit(self, prompt: list[int], max_new: int,
-               temperature: float = 0.0) -> Handle:
+               temperature: float = 0.0,
+               eos_id: int | None = None,
+               stream: bool = False) -> Handle:
         """Queue a request; returns a Handle resolving to
-        {"tokens": [...], "length": n}. Raises ValueError for requests
-        that can never fit (capacity is checked before queueing)."""
-        handle = Handle()
+        {"tokens": [...], "length": n} (tokens truncated at eos,
+        inclusive). ``eos_id`` overrides the engine default per request —
+        the check is host-side, so mixed-eos slots share the compiled
+        programs. Raises ValueError for requests that can never fit
+        (capacity is checked before queueing)."""
+        handle = Handle(_stream=queue.SimpleQueue() if stream else None)
         if self._closed:
             raise RuntimeError("engine is closed")
         if self._dead is not None:
@@ -342,7 +385,9 @@ class SlotEngine:
         if self.max_pending and self._pending.qsize() >= self.max_pending:
             raise QueueFull(
                 f"admission queue at capacity ({self.max_pending})")
-        self._pending.put((list(prompt), max_new, float(temperature), handle))
+        self._pending.put((list(prompt), max_new, float(temperature),
+                           self.eos_id if eos_id is None else eos_id,
+                           handle))
         self._wake.set()
         return handle
 
@@ -363,7 +408,8 @@ class SlotEngine:
         free = [i for i, s in self._table.items() if s is None]
         while free:
             try:
-                prompt, max_new, temp, handle = self._pending.get_nowait()
+                (prompt, max_new, temp, eos_id,
+                 handle) = self._pending.get_nowait()
             except queue.Empty:
                 break
             slot = free.pop()
@@ -378,21 +424,21 @@ class SlotEngine:
                 self._k, self._v, self._dtok, self._dpos, self._dtemp)
             self.stats["prefills"] += 1
             st = _Slot(handle=handle, tokens=[], max_new=max_new,
-                       pos=len(prompt), temperature=temp)
+                       pos=len(prompt), temperature=temp, eos_id=eos_id)
             with self._lock:
                 self._table[slot] = st
             if max_new == 1:
                 # nothing to decode: resolve the prefill token now (the
                 # one admission path that syncs) and complete
-                st.tokens.append(int(tok))
+                st.emit(int(tok))
                 st.fresh = False
                 self._finish_if_done(slot, st)
             admitted = True
         return admitted
 
     def _finish_if_done(self, slot: int, st: _Slot) -> bool:
-        hit_eos = self.eos_id is not None and st.tokens and (
-            st.tokens[-1] == self.eos_id)
+        hit_eos = st.eos_id is not None and st.tokens and (
+            st.tokens[-1] == st.eos_id)
         if hit_eos or len(st.tokens) >= st.max_new:
             st.handle._complete(
                 {"tokens": st.tokens, "length": len(st.tokens)})
@@ -431,7 +477,7 @@ class SlotEngine:
             st.fresh = False
             st.pos += self.chunk
             for j in range(start, self.chunk + 1):
-                st.tokens.append(int(out[i, j]))
+                st.emit(int(out[i, j]))
                 if self._finish_if_done(i, st):
                     self.stats["wasted_steps"] += self.chunk - j
                     break

@@ -11,7 +11,11 @@ HTTP surface (stdlib server, same envelope as the control plane):
     POST /generate              → {"tokens": [[...]], "lengths": [...]}
         body: {"tokens": [[...prompt ids...]] ,
                "maxNewTokens": 64, "temperature": 0.8,
-               "topK": 0, "topP": 1.0}
+               "topK": 0, "topP": 1.0, "eosId": 2,
+               "stream": false}
+        "stream": true (one prompt row, slot path only) switches the
+        response to chunked ndjson — {"t": token} per token as the
+        engine resolves it, then {"done": true, "length": n}.
 
 Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
 through the same KV-cached engine and body; ``--preset encdec:NAME``
@@ -165,8 +169,10 @@ def main(argv: list[str] | None = None) -> None:
     fn_lock = threading.Lock()
     _FN_CACHE_MAX = 16
 
-    def get_fn(max_new: int, temperature: float, top_k: int, top_p: float):
-        key = (max_new, round(temperature, 3), top_k, round(top_p, 3))
+    def get_fn(max_new: int, temperature: float, top_k: int, top_p: float,
+               eos_id: int | None = None):
+        key = (max_new, round(temperature, 3), top_k, round(top_p, 3),
+               eos_id)
         with fn_lock:
             if key in fns:
                 fns.move_to_end(key)
@@ -175,6 +181,8 @@ def main(argv: list[str] | None = None) -> None:
                 if key[1] != 0.0 or key[2] != 0 or key[3] != 1.0:
                     raise ValueError(
                         "encdec serving is greedy-only (temperature 0)")
+                if eos_id is not None:
+                    raise ValueError("encdec serving has no eos contract")
                 if key[0] > max_seq:
                     # the llama path's capacity check lives in the engine;
                     # this is the seq2seq analog — an unbounded client
@@ -193,7 +201,7 @@ def main(argv: list[str] | None = None) -> None:
                     cfg,
                     GenerateConfig(max_new_tokens=key[0], temperature=key[1],
                                    top_k=key[2], top_p=key[3],
-                                   max_seq=max_seq),
+                                   eos_id=eos_id, max_seq=max_seq),
                     mesh,
                 )
             fns[key] = fn
@@ -209,8 +217,15 @@ def main(argv: list[str] | None = None) -> None:
     gen_lock = threading.Lock()  # one TPU, one generation at a time
 
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 for chunked streaming responses; every non-streamed
+        # reply carries Content-Length so keep-alive stays correct
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, *a):  # quiet; structured line below instead
             pass
+
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
 
         def _reply(self, code: int, payload: dict) -> None:
             body = json.dumps(payload).encode()
@@ -271,20 +286,58 @@ def main(argv: list[str] | None = None) -> None:
                         f"maxNewTokens must be >= 1, got {max_new}")
                 temperature = float(req.get("temperature", 0.0))
                 top_k, top_p = req_int("topK", 0), float(req.get("topP", 1.0))
+                eos_id = (req_int("eosId", 0)
+                          if "eosId" in req else None)
+                do_stream = req.get("stream", False)
+                if not isinstance(do_stream, bool):
+                    raise ValueError("stream must be a JSON boolean")
 
-                if (slot_engine is not None and not is_encdec
-                        and top_k == 0 and top_p == 1.0):
+                slot_ok = (slot_engine is not None and not is_encdec
+                           and top_k == 0 and top_p == 1.0)
+                if do_stream and not slot_ok:
+                    raise ValueError(
+                        "stream requires the slot engine path (greedy or "
+                        "temperature sampling; no topK/topP/encdec)")
+                if do_stream and len(prompts) != 1:
+                    raise ValueError("stream serves exactly one prompt row")
+
+                if slot_ok:
                     # continuous batching: each row is its own request;
                     # rows may be ragged. Responses keep the legacy dense
                     # contract (pad to maxNewTokens + lengths).
                     from tpu_docker_api.infer.slots import QueueFull
 
                     try:
-                        handles = [slot_engine.submit(r, max_new,
-                                                      temperature)
-                                   for r in prompts]
+                        handles = [slot_engine.submit(
+                            r, max_new, temperature, eos_id=eos_id,
+                            stream=do_stream) for r in prompts]
                     except QueueFull as e:
                         self._reply(503, {"error": str(e)})
+                        return
+                    if do_stream:
+                        # chunked ndjson: one {"t": token} line per token
+                        # as the engine resolves it, then a "done" line.
+                        # Once headers are out, an error must DROP the
+                        # connection (a _reply(500) here would write a
+                        # second status line mid-chunk and poison the
+                        # keep-alive stream)
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        try:
+                            for t in handles[0].stream(timeout=600):
+                                self._chunk(json.dumps({"t": t}).encode()
+                                            + b"\n")
+                            res = handles[0].result(0)
+                            self._chunk(json.dumps(
+                                {"done": True,
+                                 "length": res["length"]}).encode()
+                                + b"\n")
+                            self.wfile.write(b"0\r\n\r\n")
+                        except Exception:  # noqa: BLE001
+                            self.close_connection = True
                         return
                     outs = [h.result(timeout=600) for h in handles]
                     self._reply(200, {
@@ -302,7 +355,7 @@ def main(argv: list[str] | None = None) -> None:
                         "(left-pad), or use greedy/temperature sampling "
                         "for ragged continuous batching")
                 prompt = jnp.asarray(np.array(prompts, np.int32))
-                fn = get_fn(max_new, temperature, top_k, top_p)
+                fn = get_fn(max_new, temperature, top_k, top_p, eos_id)
                 with gen_lock:
                     key, sub = jax.random.split(rng_state["key"])
                     rng_state["key"] = key
