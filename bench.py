@@ -364,12 +364,15 @@ def measure_serving() -> dict:
 
     out = {}
     for name, kwargs in (
-        ("llama3_1b", dict(preset="llama3-1b", quantize=False)),
-        ("llama3_8b_int8", dict(preset="llama3-8b", quantize=True)),
+        ("llama3_1b", dict(preset="llama3-1b", quantize=False, streams=8)),
+        ("llama3_1b_16streams",
+         dict(preset="llama3-1b", quantize=False, streams=16)),
+        ("llama3_8b_int8",
+         dict(preset="llama3-8b", quantize=True, streams=8)),
     ):
         try:
             r = bench_concurrent_serving(
-                streams=8, prompt_len=128, new_tok=64, max_seq=512,
+                prompt_len=128, new_tok=64, max_seq=512,
                 chunk=8, **kwargs)
             r.pop("ok")
             out[name] = r
