@@ -28,11 +28,12 @@ def setup():
     return cfg, params
 
 
-def isolated_greedy(cfg, params, prompt, max_new, eos_id=None):
+def isolated_greedy(cfg, params, prompt, max_new, eos_id=None,
+                    max_seq=MAX_SEQ):
     """Reference decode: the legacy engine, batch of one."""
     fn = make_generate_fn(
         cfg, GenerateConfig(max_new_tokens=max_new, temperature=0.0,
-                            eos_id=eos_id, max_seq=MAX_SEQ))
+                            eos_id=eos_id, max_seq=max_seq))
     out = fn(params, jnp.asarray([prompt], jnp.int32), jax.random.PRNGKey(0))
     toks = np.asarray(out["tokens"])[0]
     n = int(np.asarray(out["lengths"])[0])
@@ -309,6 +310,37 @@ class TestThreadedServing:
             h.result(1)
         with pytest.raises(RuntimeError, match="closed"):
             eng.submit([1, 2], 4)
+
+
+class TestKvBucketedDecode:
+    def test_bucketed_decode_token_exact(self, setup):
+        """A cache much larger than the active positions: decode must use
+        the bucketed (cache[:limit]) programs and stay token-exact."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=384, chunk=4)
+        assert eng._kv_buckets == (128, 256)
+        prompts = [[3, 1, 4, 1, 5], [9, 8, 7]]
+        handles = [eng.submit(p, 20) for p in prompts]
+        while not all(h.done() for h in handles):
+            eng.step()
+        assert eng.stats["bucketed_chunks"] > 0
+        assert eng.stats["bucketed_chunks"] == eng.stats["decode_chunks"]
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 20)
+
+    def test_long_slot_escalates_bucket(self, setup):
+        """One slot pushing past a bucket boundary moves the WHOLE batch
+        to the next bucket (the limit covers every active slot)."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=384, chunk=8)
+        h = eng.submit([7] * 90, 34)  # reaches position ~124+: crosses 128
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [7] * 90, 34, max_seq=384)
+        # both the 128 and 256 buckets were compiled and used
+        assert set(eng._decode_fns) >= {128, 256}
 
 
 class TestCacheIsolation:

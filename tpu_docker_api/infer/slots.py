@@ -169,6 +169,10 @@ class _Slot:
     #                            costs nothing in the compiled programs
     fresh: bool = True         # no chunk processed yet: the first chunk's
     #                            column 0 is this slot's prefill token
+    base_len: int = 0          # prompt length at admission (immutable —
+    #                            pos mutates at processing lag)
+    dispatched: int = 0        # chunks dispatched since admission; bounds
+    #                            this slot's reachable cache position
 
     def emit(self, t: int) -> None:
         self.tokens.append(t)
@@ -256,7 +260,15 @@ class SlotEngine:
         self._dead: Exception | None = None
 
         self._prefill_fns: dict[int, Any] = {}
-        self._decode_fn = None
+        #: decode programs keyed by kv read limit (None = full buffer).
+        #: Decode is bandwidth-bound and reads the whole cache prefix it
+        #: attends; when every active slot sits far below capacity, a
+        #: bucketed program reading only cache[:limit] skips the dead
+        #: bytes. Geometric buckets bound the program count.
+        self._decode_fns: dict[int | None, Any] = {}
+        self._kv_buckets = tuple(
+            b for b in (128, 256, 512, 1024, 2048, 4096, 8192)
+            if b < self.max_seq)
         # aggregate counters for /healthz-style introspection
         self.stats = {"completed": 0, "decode_chunks": 0, "prefills": 0,
                       "wasted_steps": 0, "emitted_tokens": 0}
@@ -306,16 +318,18 @@ class SlotEngine:
         self._prefill_fns[bucket] = fn
         return fn
 
-    def _decode(self):
-        if self._decode_fn is not None:
-            return self._decode_fn
+    def _decode(self, kv_limit: int | None = None):
+        fn = self._decode_fns.get(kv_limit)
+        if fn is not None:
+            return fn
         cfg, fwd, K = self.cfg, self._fwd, self.chunk
 
         def decode_chunk(params, seed, dtok, dpos, dtemp, k_all, v_all):
             def body(carry, step_key):
                 tok, pos, k_all, v_all = carry
                 logits, k_all, v_all = fwd(
-                    params, tok[:, None], cfg, k_all, v_all, pos, None)
+                    params, tok[:, None], cfg, k_all, v_all, pos, None,
+                    kv_limit=kv_limit)
                 nxt = self._sample(logits[:, -1], dtemp, step_key)
                 return (nxt, pos + 1, k_all, v_all), nxt
 
@@ -327,8 +341,23 @@ class SlotEngine:
             out_full = jnp.concatenate([dtok[:, None], out.T], axis=1)
             return out_full, tok, pos, k_all, v_all  # out: (S, K+1)
 
-        self._decode_fn = jax.jit(decode_chunk, donate_argnums=(2, 3, 5, 6))
-        return self._decode_fn
+        fn = jax.jit(decode_chunk, donate_argnums=(2, 3, 5, 6))
+        self._decode_fns[kv_limit] = fn
+        return fn
+
+    def _kv_limit_for_chunk(self, active) -> int | None:
+        """Smallest geometric bucket covering every position the NEXT
+        chunk can touch, or None (full buffer). A slot's reachable bound
+        is derived from dispatch counts, not processed state — the host
+        lags by the pipeline depth."""
+        if not self._kv_buckets:
+            return None
+        bound = max(st.base_len + (st.dispatched + 1) * self.chunk
+                    for st in active.values())
+        for b in self._kv_buckets:
+            if b >= bound:
+                return b
+        return None
 
     def warmup(self, buckets: tuple[int, ...] | None = None) -> None:
         """Actually compile the decode chunk and the given (default: all)
@@ -424,7 +453,8 @@ class SlotEngine:
                 self._k, self._v, self._dtok, self._dpos, self._dtemp)
             self.stats["prefills"] += 1
             st = _Slot(handle=handle, tokens=[], max_new=max_new,
-                       pos=len(prompt), temperature=temp, eos_id=eos_id)
+                       pos=len(prompt), temperature=temp, eos_id=eos_id,
+                       base_len=len(prompt))
             with self._lock:
                 self._table[slot] = st
             if max_new == 1:
@@ -450,17 +480,23 @@ class SlotEngine:
         return False
 
     def _dispatch_chunk(self) -> None:
-        out, self._dtok, self._dpos, self._k, self._v = self._decode()(
+        snap = {i: s for i, s in self._table.items() if s is not None}
+        limit = self._kv_limit_for_chunk(snap)
+        out, self._dtok, self._dpos, self._k, self._v = self._decode(limit)(
             self.params, self._next_seed(), self._dtok, self._dpos,
             self._dtemp, self._k, self._v)
+        for st in snap.values():
+            st.dispatched += 1
         # start the device→host copy now: by the time this chunk is
         # processed (``pipeline`` chunks later) the tokens are already on
         # the host, so the fetch doesn't stall the dispatch loop for a
         # tunnel round-trip (~100 ms — 2x a whole chunk's compute)
         out.copy_to_host_async()
-        snap = {i: s for i, s in self._table.items() if s is not None}
         self._outstanding.append((snap, out))
         self.stats["decode_chunks"] += 1
+        if limit is not None:
+            self.stats["bucketed_chunks"] = (
+                self.stats.get("bucketed_chunks", 0) + 1)
 
     def _process_oldest(self) -> None:
         """Host-side half of one chunk: fetch its tokens (the only sync in

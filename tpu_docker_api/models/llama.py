@@ -138,7 +138,7 @@ def llama_init(cfg: LlamaConfig, key: jax.Array) -> dict:
 
 
 def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
-               cache=None, start_pos=None):
+               cache=None, start_pos=None, kv_limit=None):
     """Self-attention. With ``cache=(k_all, v_all, layer_idx)`` — the FULL
     (n_layers, batch, max_seq, n_kv_heads, head_dim) cache buffers plus this
     layer's index — runs the KV-cached path: writes the new k/v into this
@@ -187,6 +187,14 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
                                            keepdims=False)
         v_cache = lax.dynamic_index_in_dim(v_all, layer_idx, 0,
                                            keepdims=False)
+        if kv_limit is not None and kv_limit < k_cache.shape[1]:
+            # static length bucket: read only the prefix every position
+            # in this dispatch can reach — decode is bandwidth-bound and
+            # the full-buffer read is pure waste when slots sit far below
+            # capacity (infer/slots.py picks the bucket per chunk). The
+            # write above still targets the full buffer.
+            k_cache = lax.slice_in_dim(k_cache, 0, kv_limit, axis=1)
+            v_cache = lax.slice_in_dim(v_cache, 0, kv_limit, axis=1)
         out = dense_attention(q, k_cache, v_cache, causal=True,
                               q_offset=start_pos)
         return linear(out.reshape(b, s, cfg.n_heads * hd),
@@ -225,7 +233,7 @@ def _mlp(x, layer):
 
 
 def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
-           cache=None, start_pos=None):
+           cache=None, start_pos=None, kv_limit=None):
     """One transformer block; the single source of truth for the residual /
     norm wiring of BOTH the training forward (cache=None) and the KV-cached
     decode path (returns (x, new_cache)). Decode's seq dim is 1 so it never
@@ -234,6 +242,7 @@ def _block(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     attn_out = _attention(
         rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg,
         rope_cos, rope_sin, mesh, cache=cache, start_pos=start_pos,
+        kv_limit=kv_limit,
     )
     new_cache = None
     if cache is not None:
@@ -322,6 +331,7 @@ def llama_forward_cached(
     mesh: Mesh | None = None,
     last_only: bool | jnp.ndarray = False,  # True: final position; traced
     #                           int: that position (padded-prefill logit)
+    kv_limit: int | None = None,  # static: attend cache[:kv_limit] only
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """KV-cached forward: logits for the new tokens + updated caches.
 
@@ -339,7 +349,7 @@ def llama_forward_cached(
     """
     def block_fn(x, layer, cache, rope_cos, rope_sin):
         return _block(x, layer, cfg, rope_cos, rope_sin, mesh,
-                      cache=cache, start_pos=start_pos)
+                      cache=cache, start_pos=start_pos, kv_limit=kv_limit)
 
     return decoder_forward_cached(
         params, tokens, cfg, k_cache, v_cache, mesh, last_only, block_fn)

@@ -298,7 +298,7 @@ def _moe_mlp(x, layer_moe, cfg: MoEConfig, mesh: Mesh | None,
 
 
 def _moe_block(x, layer, cfg: MoEConfig, rope_cos, rope_sin, mesh,
-               cache=None, start_pos=None):
+               cache=None, start_pos=None, kv_limit=None):
     """Transformer block: Llama attention (shared code) + sparse FFN.
     Returns (x, aux_loss), or (x, aux_loss, new_cache) on the KV-cached
     path (``cache=(k_all, v_all, layer_idx)`` — llama's _attention
@@ -307,6 +307,7 @@ def _moe_block(x, layer, cfg: MoEConfig, rope_cos, rope_sin, mesh,
     attn_out = _attention(
         rms_norm(x, layer["attn_norm"], cfg.norm_eps), layer, cfg,
         rope_cos, rope_sin, mesh, cache=cache, start_pos=start_pos,
+        kv_limit=kv_limit,
     )
     new_cache = None
     if cache is not None:
@@ -366,6 +367,7 @@ def moe_forward_cached(
     start_pos: jnp.ndarray,
     mesh: Mesh | None = None,
     last_only: bool = False,
+    kv_limit: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """KV-cached forward for serving — rides the shared decoder skeleton
     (models/llama.py ``decoder_forward_cached``: cache carried through the
@@ -377,7 +379,7 @@ def moe_forward_cached(
     def block_fn(x, layer, cache, rope_cos, rope_sin):
         x, _aux, new_cache = _moe_block(
             x, layer, cfg, rope_cos, rope_sin, mesh,
-            cache=cache, start_pos=start_pos,
+            cache=cache, start_pos=start_pos, kv_limit=kv_limit,
         )
         return x, new_cache
 
