@@ -369,6 +369,8 @@ def measure_serving() -> dict:
          dict(preset="llama3-1b", quantize=False, streams=16)),
         ("llama3_8b_int8",
          dict(preset="llama3-8b", quantize=True, streams=8)),
+        ("llama3_8b_int8_16streams",
+         dict(preset="llama3-8b", quantize=True, streams=16)),
     ):
         try:
             r = bench_concurrent_serving(

@@ -343,6 +343,26 @@ class TestKvBucketedDecode:
         assert set(eng._decode_fns) >= {128, 256}
 
 
+class TestMoeFamily:
+    def test_moe_slot_engine_token_exact_with_buckets(self):
+        """The MoE family through the slot engine, including the
+        bucketed kv_limit path (moe_forward_cached threads it)."""
+        from tpu_docker_api.models.moe import moe_init, moe_presets
+
+        cfg = moe_presets()["moe-tiny"]
+        params = moe_init(cfg, jax.random.PRNGKey(3))
+        eng = SlotEngine(cfg, params, slots=2, max_seq=192, chunk=4)
+        assert eng._kv_buckets == (128,)
+        prompts = [[5, 3, 1], [2, 4, 6, 8]]
+        handles = [eng.submit(p, 8) for p in prompts]
+        while not all(h.done() for h in handles):
+            eng.step()
+        assert eng.stats["bucketed_chunks"] > 0
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 8, max_seq=192)
+
+
 class TestCacheIsolation:
     def test_long_then_short_slot_reuse_no_bleed(self, setup):
         """A short prompt reusing a slot that previously held a longer
