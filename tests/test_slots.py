@@ -301,6 +301,19 @@ class TestThreadedServing:
         assert got["tokens"] == isolated_greedy(cfg, params, [1, 2, 3], 5)
         eng.close()
 
+    def test_close_with_drain_completes_in_flight(self, setup):
+        """close(drain=N): new submits reject, in-flight requests finish
+        instead of failing — the serving SIGTERM contract."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ,
+                         chunk=4).start()
+        h = eng.submit([5, 1, 2], 8)
+        eng.close(drain=60)
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [5, 1, 2], 8)
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit([1], 2)
+
     def test_close_fails_queued_requests(self, setup):
         cfg, params = setup
         eng = SlotEngine(cfg, params, slots=1, max_seq=MAX_SEQ, chunk=2)

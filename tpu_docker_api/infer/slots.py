@@ -257,6 +257,8 @@ class SlotEngine:
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         self._closed = False
+        self._draining = False
+        self._drained = threading.Event()
         self._dead: Exception | None = None
 
         self._prefill_fns: dict[int, Any] = {}
@@ -394,7 +396,7 @@ class SlotEngine:
         programs. Raises ValueError for requests that can never fit
         (capacity is checked before queueing)."""
         handle = Handle(_stream=queue.SimpleQueue() if stream else None)
-        if self._closed:
+        if self._closed or self._draining:
             raise RuntimeError("engine is closed")
         if self._dead is not None:
             raise RuntimeError(f"engine failed: {self._dead!r}")
@@ -548,6 +550,13 @@ class SlotEngine:
         while not self._closed:
             try:
                 if not self.step():
+                    if self._draining and self._pending.empty():
+                        # quiescence is decided HERE, between whole
+                        # steps — an outside poll of table/queue state
+                        # would race the admission window (popped from
+                        # pending, not yet in the table)
+                        self._drained.set()
+                        return
                     self._wake.clear()
                     self._wake.wait(timeout=0.05)
             except Exception as e:  # noqa: BLE001 — a dead engine thread
@@ -555,7 +564,9 @@ class SlotEngine:
                 # fail every in-flight and queued handle, mark the engine
                 # dead so submit() rejects fast, and surface the cause
                 self._die(e)
+                self._drained.set()
                 return
+        self._drained.set()
 
     def _die(self, err: Exception) -> None:
         self._dead = err
@@ -583,7 +594,15 @@ class SlotEngine:
             self._thread.start()
         return self
 
-    def close(self) -> None:
+    def close(self, drain: float = 0.0) -> None:
+        """Stop the engine. ``drain`` seconds > 0: reject new submits but
+        keep decoding until in-flight requests complete (or the deadline
+        passes) — the SIGTERM path for serving; 0: fail everything in
+        flight immediately."""
+        if drain > 0 and self._thread is not None and self._dead is None:
+            self._draining = True
+            self._wake.set()
+            self._drained.wait(timeout=drain)
         self._closed = True
         self._wake.set()
         if self._thread is not None:

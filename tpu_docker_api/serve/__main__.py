@@ -383,7 +383,9 @@ def main(argv: list[str] | None = None) -> None:
           flush=True)
     httpd.serve_forever()
     if slot_engine is not None:
-        slot_engine.close()
+        # drain: handler threads may still be blocked on handles after
+        # shutdown() returns — finish their requests instead of failing
+        slot_engine.close(drain=30)
     print(json.dumps({"event": "stopped"}), flush=True)
 
 
