@@ -396,10 +396,6 @@ class SlotEngine:
         programs. Raises ValueError for requests that can never fit
         (capacity is checked before queueing)."""
         handle = Handle(_stream=queue.SimpleQueue() if stream else None)
-        if self._closed or self._draining:
-            raise RuntimeError("engine is closed")
-        if self._dead is not None:
-            raise RuntimeError(f"engine failed: {self._dead!r}")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         n = len(prompt)
@@ -413,12 +409,21 @@ class SlotEngine:
             raise ValueError(
                 f"prompt ({n}) + max_new ({max_new}) exceeds cache "
                 f"capacity {self.max_seq}")
-        if self.max_pending and self._pending.qsize() >= self.max_pending:
-            raise QueueFull(
-                f"admission queue at capacity ({self.max_pending})")
-        self._pending.put((list(prompt), max_new, float(temperature),
-                           self.eos_id if eos_id is None else eos_id,
-                           handle))
+        # state check + put are ONE atomic section vs close()/_die():
+        # a check-then-put window would let a racing shutdown drain the
+        # queue between them and orphan this handle forever
+        with self._lock:
+            if self._closed or self._draining:
+                raise RuntimeError("engine is closed")
+            if self._dead is not None:
+                raise RuntimeError(f"engine failed: {self._dead!r}")
+            if (self.max_pending
+                    and self._pending.qsize() >= self.max_pending):
+                raise QueueFull(
+                    f"admission queue at capacity ({self.max_pending})")
+            self._pending.put((list(prompt), max_new, float(temperature),
+                               self.eos_id if eos_id is None else eos_id,
+                               handle))
         self._wake.set()
         return handle
 
@@ -547,30 +552,31 @@ class SlotEngine:
         return did
 
     def _loop(self) -> None:
-        while not self._closed:
-            try:
-                if not self.step():
-                    if self._draining and self._pending.empty():
-                        # quiescence is decided HERE, between whole
-                        # steps — an outside poll of table/queue state
-                        # would race the admission window (popped from
-                        # pending, not yet in the table)
-                        self._drained.set()
-                        return
-                    self._wake.clear()
-                    self._wake.wait(timeout=0.05)
-            except Exception as e:  # noqa: BLE001 — a dead engine thread
-                # must not leave clients hanging on 10-minute timeouts:
-                # fail every in-flight and queued handle, mark the engine
-                # dead so submit() rejects fast, and surface the cause
-                self._die(e)
-                self._drained.set()
-                return
-        self._drained.set()
+        try:
+            while not self._closed:
+                try:
+                    if not self.step():
+                        if self._draining and self._pending.empty():
+                            # quiescence is decided HERE, between whole
+                            # steps — an outside poll of table/queue
+                            # state would race the admission window
+                            # (popped from pending, not yet in table)
+                            return
+                        self._wake.clear()
+                        self._wake.wait(timeout=0.05)
+                except Exception as e:  # noqa: BLE001 — a dead engine
+                    # thread must not leave clients hanging on 10-minute
+                    # timeouts: fail every in-flight and queued handle,
+                    # mark the engine dead so submit() rejects fast
+                    self._die(e)
+                    return
+        finally:
+            # every exit path must release a drain waiter
+            self._drained.set()
 
     def _die(self, err: Exception) -> None:
-        self._dead = err
         with self._lock:
+            self._dead = err
             for i, s in self._table.items():
                 if s is not None:
                     s.handle._fail(RuntimeError(f"engine failed: {err!r}"))
@@ -600,10 +606,12 @@ class SlotEngine:
         passes) — the SIGTERM path for serving; 0: fail everything in
         flight immediately."""
         if drain > 0 and self._thread is not None and self._dead is None:
-            self._draining = True
+            with self._lock:
+                self._draining = True
             self._wake.set()
             self._drained.wait(timeout=drain)
-        self._closed = True
+        with self._lock:
+            self._closed = True
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=30)

@@ -384,8 +384,12 @@ def main(argv: list[str] | None = None) -> None:
     httpd.serve_forever()
     if slot_engine is not None:
         # drain: handler threads may still be blocked on handles after
-        # shutdown() returns — finish their requests instead of failing
-        slot_engine.close(drain=30)
+        # shutdown() returns — finish their requests instead of failing.
+        # 8s, NOT more: the control plane stops containers with a 10s
+        # SIGTERM→SIGKILL grace (runtime/base.py container_stop), and a
+        # drain that outlives the grace gets SIGKILLed mid-flight with
+        # no cleanup at all
+        slot_engine.close(drain=8)
     print(json.dumps({"event": "stopped"}), flush=True)
 
 
