@@ -324,6 +324,13 @@ class TestFamilyPresets:
             except urllib.error.HTTPError as e:
                 err = json.loads(e.read())
                 assert "greedy-only" in err["error"]
+            # eosId switches the seq2seq response to the lengths contract
+            eos = out["tokens"][0][1]
+            out2 = _post(port, "/generate",
+                         {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
+                          "temperature": 0.0, "eosId": eos}, timeout=60)
+            assert out2["lengths"] == [2]
+            assert out2["tokens"][0][:2] == out["tokens"][0][:2]
         finally:
             p.terminate()
             p.wait(timeout=30)

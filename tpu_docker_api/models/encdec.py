@@ -353,12 +353,16 @@ def encdec_generate(
     cfg: EncDecConfig,
     max_new_tokens: int = 32,
     bos_id: int = 0,
-) -> jnp.ndarray:
+    eos_id: int | None = None,
+    pad_id: int = 0,
+) -> jnp.ndarray | dict:
     """Greedy seq2seq generation: encode once, then a KV-cached decoder
     loop — self-attention against a (Ld, b, T, kvh, hd) cache written one
     position per step, cross-attention against the precomputed encoder
-    k/v. Returns (b, max_new_tokens) int32. Jit-compatible (one compile
-    per (b, S, max_new_tokens) shape)."""
+    k/v. Returns (b, max_new_tokens) int32; with ``eos_id`` set, returns
+    {"tokens", "lengths"} with the same truncate-at-eos-inclusive
+    contract as the llama engine (positions after eos hold ``pad_id``).
+    Jit-compatible (one compile per (b, S, max_new_tokens) shape)."""
     from tpu_docker_api.ops.attention import dense_attention
 
     b, _ = src.shape
@@ -419,4 +423,19 @@ def encdec_generate(
     start = jnp.full((b,), bos_id, jnp.int32)
     _, toks = lax.scan(dec_step, (start, k_cache, v_cache, jnp.int32(0)),
                        None, length=max_new_tokens)
-    return toks.transpose(1, 0)  # (b, max_new_tokens)
+    toks = toks.transpose(1, 0)  # (b, max_new_tokens)
+    if eos_id is None:
+        return toks
+    # eos contract (same as infer/engine.py): length = first eos + 1,
+    # else max_new; positions after eos are pad. Done rows keep decoding
+    # inside the scan (their cache writes are their own rows), so this
+    # masking is purely cosmetic/post-hoc — outputs before eos are
+    # untouched.
+    is_eos = toks == eos_id
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1)
+    lengths = jnp.where(any_eos, first_eos + 1, toks.shape[1])
+    past = jnp.arange(toks.shape[1], dtype=jnp.int32)[None, :] >= (
+        lengths[:, None])
+    return {"tokens": jnp.where(past, jnp.int32(pad_id), toks),
+            "lengths": lengths.astype(jnp.int32)}

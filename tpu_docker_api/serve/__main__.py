@@ -19,9 +19,10 @@ HTTP surface (stdlib server, same envelope as the control plane):
 
 Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
 through the same KV-cached engine and body; ``--preset encdec:NAME``
-serves seq2seq — the body uses ``srcTokens`` instead of ``tokens``,
-decoding is greedy-only (temperature 0), and responses carry no
-``lengths`` (no eos contract). ViT has no generative serving path.
+serves seq2seq — the body uses ``srcTokens`` instead of ``tokens`` and
+decoding is greedy-only (temperature 0); with ``eosId`` the response
+carries ``lengths`` (truncate-at-eos), without it no lengths are
+reported. ViT has no generative serving path.
 
 Design notes, TPU-first:
 
@@ -181,8 +182,6 @@ def main(argv: list[str] | None = None) -> None:
                 if key[1] != 0.0 or key[2] != 0 or key[3] != 1.0:
                     raise ValueError(
                         "encdec serving is greedy-only (temperature 0)")
-                if eos_id is not None:
-                    raise ValueError("encdec serving has no eos contract")
                 if key[0] > max_seq:
                     # the llama path's capacity check lives in the engine;
                     # this is the seq2seq analog — an unbounded client
@@ -192,10 +191,15 @@ def main(argv: list[str] | None = None) -> None:
                         f"maxNewTokens {key[0]} exceeds capacity {max_seq}")
                 from tpu_docker_api.models.encdec import encdec_generate
 
-                fn = jax.jit(lambda p, src, _rng: {
-                    "tokens": encdec_generate(p, src, cfg,
-                                              max_new_tokens=key[0]),
-                })
+                if eos_id is not None:
+                    fn = jax.jit(lambda p, src, _rng: encdec_generate(
+                        p, src, cfg, max_new_tokens=key[0],
+                        eos_id=eos_id))
+                else:
+                    fn = jax.jit(lambda p, src, _rng: {
+                        "tokens": encdec_generate(p, src, cfg,
+                                                  max_new_tokens=key[0]),
+                    })
             else:
                 fn = make_generate_fn(
                     cfg,
