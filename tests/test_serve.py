@@ -267,6 +267,44 @@ class TestServeQuantized:
             p.communicate(timeout=30)
 
 
+class TestMeshServing:
+    def test_serves_on_tp_mesh_legacy_path(self):
+        """A 2-device tp mesh: the slot engine steps aside (single-device
+        by design) and the legacy sharded path serves — params created
+        into their shards, generate under the mesh."""
+        port = 18796
+        env = {**os.environ, "PYTHONPATH": REPO}
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_docker_api.serve",
+             "--preset", "tiny", "--platform", "cpu", "--host", "127.0.0.1",
+             "--port", str(port), "--max-seq", "64",
+             "--virtual-devices", "2", "--tp", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if p.poll() is not None:
+                    raise RuntimeError(f"server died: {p.stdout.read()}")
+                try:
+                    h = _get(port, "/healthz")
+                    if h["status"] == "ok":
+                        break
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.3)
+            else:
+                raise RuntimeError("mesh server never became healthy")
+            assert h["devices"] == 2
+            assert "slotEngine" not in h  # mesh: legacy path only
+            out = _post(port, "/generate",
+                        {"tokens": [[1, 2, 3]], "maxNewTokens": 4,
+                         "temperature": 0.0}, timeout=120)
+            assert len(out["tokens"][0]) == 4
+        finally:
+            p.send_signal(signal.SIGTERM)
+            p.communicate(timeout=30)
+
+
 class TestFamilyPresets:
     def _spawn(self, preset, extra=()):
         import subprocess
