@@ -158,15 +158,23 @@ class TestServe:
         assert toks == plain["tokens"][0]
         assert lines[-1]["length"] == plain["lengths"][0]
 
-    def test_streaming_rejects_multi_row_and_topk(self, server):
+    def test_streaming_rejects_multi_row(self, server):
         port, _ = server
-        for body in ({"tokens": [[1, 2], [3, 4]], "maxNewTokens": 2,
-                      "stream": True},
-                     {"tokens": [[1, 2]], "maxNewTokens": 2, "topK": 3,
-                      "temperature": 0.5, "stream": True}):
-            with pytest.raises(urllib.error.HTTPError) as e:
-                _post(port, "/generate", body)
-            assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/generate", {"tokens": [[1, 2], [3, 4]],
+                                      "maxNewTokens": 2, "stream": True})
+        assert e.value.code == 400
+        # top-k streams fine now (slot path handles filtered sampling)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"tokens": [[1, 2]], "maxNewTokens": 3,
+                             "topK": 3, "temperature": 0.5,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            lines = [json.loads(line) for line in r]
+        assert lines[-1]["done"] is True
+        assert len(lines) == 4  # 3 token lines + done
 
     def test_eos_id_truncates(self, server):
         port, _ = server
@@ -180,16 +188,18 @@ class TestServe:
         assert out["tokens"][0][n - 1] == eos
         assert out["tokens"][0][n:] == [0] * (8 - n)  # padded
 
-    def test_topk_falls_back_to_legacy_path(self, server):
+    def test_topk_topp_on_slot_path(self, server):
+        """top-k/top-p serve through the slot engine too (round-3: the
+        filtered chunk variant) — ragged rows included."""
         port, _ = server
         out = _post(port, "/generate",
-                    {"tokens": [[5, 6, 7]], "maxNewTokens": 4, "topK": 3,
-                     "temperature": 0.9})
-        assert len(out["tokens"][0]) == 4
-        # ragged rows are a slot-path capability only
+                    {"tokens": [[5, 6, 7], [1, 2]], "maxNewTokens": 4,
+                     "topK": 3, "temperature": 0.9, "topP": 0.95})
+        assert [len(r) for r in out["tokens"]] == [4, 4]
+        # top_p out of range is a 400, not a 500
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(port, "/generate",
-                  {"tokens": [[1, 2], [3]], "maxNewTokens": 2, "topK": 2,
+                  {"tokens": [[1, 2]], "maxNewTokens": 2, "topP": 0.0,
                    "temperature": 0.9})
         assert e.value.code == 400
 

@@ -126,6 +126,57 @@ class TestSampling:
             cfg, params, [3, 1, 4, 1, 5], 10)
         assert len(hs.result(0)["tokens"]) == 10
 
+    def test_top_k_one_is_greedy_at_any_temperature(self, setup):
+        """top_k=1 forces the argmax token regardless of temperature —
+        an end-to-end proof of the traced filtering math."""
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5]
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        h = eng.submit(prompt, 10, temperature=1.7, top_k=1)
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 10)
+
+    def test_top_p_tiny_is_greedy(self, setup):
+        """top_p→0 keeps only the most probable token (the first sorted
+        token always survives) — again greedy-equivalent."""
+        cfg, params = setup
+        prompt = [2, 7, 1]
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        h = eng.submit(prompt, 8, temperature=1.3, top_p=1e-6)
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 8)
+
+    def test_filtered_neighbor_does_not_perturb_greedy_slot(self, setup):
+        """A top-k sampled stream co-batched with a greedy stream: the
+        greedy slot stays token-exact even though the chunk runs the
+        filtered variant."""
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5]
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        hg = eng.submit(prompt, 10)
+        hs = eng.submit(prompt, 10, temperature=1.2, top_k=5)
+        while not (hg.done() and hs.done()):
+            eng.step()
+        assert hg.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 10)
+        assert len(hs.result(0)["tokens"]) == 10
+
+    def test_filtered_variant_compiles_only_when_needed(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        h = eng.submit([1, 2, 3], 6)  # pure greedy
+        while not h.done():
+            eng.step()
+        assert all(not filt for _, filt in eng._decode_fns)
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit([1, 2], 4, top_k=-1)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1, 2], 4, top_p=0.0)
+
     def test_sampled_tokens_vary_across_requests(self, setup):
         cfg, params = setup
         eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
@@ -353,7 +404,7 @@ class TestKvBucketedDecode:
         assert h.result(0)["tokens"] == isolated_greedy(
             cfg, params, [7] * 90, 34, max_seq=384)
         # both the 128 and 256 buckets were compiled and used
-        assert set(eng._decode_fns) >= {128, 256}
+        assert {k for k, _ in eng._decode_fns} >= {128, 256}
 
 
 class TestMoeFamily:

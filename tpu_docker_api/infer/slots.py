@@ -52,8 +52,10 @@ TPU-first shape of the design:
 - **Exact sampling in one program**: greedy is ``argmax``; per-slot
   temperature sampling is Gumbel-argmax (``argmax(logits/T + G)`` is an
   exact categorical draw), so mixed greedy/sampled slots share one
-  compiled chunk. top-k/top-p need a sort and stay on the legacy
-  whole-generation path (serve/__main__.py routes them there).
+  compiled chunk. Per-slot top-k/top-p run with TRACED k and p in a
+  second chunk variant that pays a per-step (S, vocab) sort — compiled
+  and dispatched only while a filtered slot is active, so pure
+  greedy/temperature traffic never pays for it.
 - **Length-bucketed decode reads**: decode programs are compiled per
   geometric cache-prefix bucket (``kv_limit`` through the cached
   forward) and read only the positions any active slot can reach —
@@ -177,6 +179,8 @@ class _Slot:
     temperature: float
     eos_id: int | None = None  # per-request; host-side check only, so it
     #                            costs nothing in the compiled programs
+    top_k: int = 0             # per-slot traced filters; any nonzero/<1
+    top_p: float = 1.0         # active slot selects the filtered chunk
     fresh: bool = True         # no chunk processed yet: the first chunk's
     #                            column 0 is this slot's prefill token
     base_len: int = 0          # prompt length at admission (immutable —
@@ -258,6 +262,8 @@ class SlotEngine:
         self._dtok = jnp.zeros((slots,), jnp.int32)
         self._dpos = jnp.zeros((slots,), jnp.int32)
         self._dtemp = jnp.zeros((slots,), jnp.float32)
+        self._dtopk = jnp.zeros((slots,), jnp.int32)
+        self._dtopp = jnp.ones((slots,), jnp.float32)
 
         self._pending: queue.SimpleQueue = queue.SimpleQueue()
         self._table: dict[int, _Slot | None] = {i: None for i in range(slots)}
@@ -298,6 +304,35 @@ class SlotEngine:
                       logits)
         return jnp.argmax(z, axis=-1).astype(jnp.int32)
 
+    @staticmethod
+    def _sample_filtered(logits, temp, topk, topp, key):
+        """Per-slot top-k/top-p sampling with TRACED k and p — the
+        variant compiled only for chunks with a filtered slot active (it
+        pays one (S, vocab) descending sort per step). Mirrors
+        infer/sampling.py's semantics exactly: temperature scale, then
+        value-based top-k mask, then nucleus filtering of the
+        (k-masked) sorted distribution, then an exact categorical draw
+        (Gumbel-argmax). temp == 0 rows stay plain argmax."""
+        neg = jnp.float32(-1e30)
+        V = logits.shape[-1]
+        z = logits.astype(jnp.float32) / jnp.maximum(temp, 1e-6)[:, None]
+        zs = -jnp.sort(-z, axis=-1)                       # descending
+        has_k = (topk > 0)[:, None]
+        kth = jnp.take_along_axis(
+            zs, jnp.clip(topk[:, None] - 1, 0, V - 1), axis=1)
+        z1 = jnp.where(has_k & (z < kth), neg, z)
+        zs1 = jnp.where(has_k & (zs < kth), neg, zs)      # same multiset
+        probs = jax.nn.softmax(zs1, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < topp[:, None]              # first always kept
+        threshold = jnp.min(jnp.where(keep, zs1, jnp.inf), axis=-1,
+                            keepdims=True)
+        z2 = jnp.where(z1 < threshold, neg, z1)
+        g = jax.random.gumbel(key, z2.shape, z2.dtype)
+        return jnp.where(temp > 0,
+                         jnp.argmax(z2 + g, axis=-1),
+                         jnp.argmax(logits, axis=-1)).astype(jnp.int32)
+
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is not None:
@@ -305,15 +340,16 @@ class SlotEngine:
         cfg, fwd = self.cfg, self._fwd
         cache_dtype = self._k.dtype
 
-        def prefill(params, prompt, actual_len, slot, temp, seed,
-                    k_all, v_all, dtok, dpos, dtemp):
+        def prefill(params, prompt, actual_len, slot, temp, topk, topp,
+                    seed, k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp):
             shape = (cfg.n_layers, 1, bucket, cfg.n_kv_heads, cfg.head_dim)
             kc = jnp.zeros(shape, cache_dtype)
             vc = jnp.zeros(shape, cache_dtype)
             logits, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
                                  None, last_only=actual_len - 1)
-            tok = self._sample(logits[:, -1], temp[None],
-                               jax.random.PRNGKey(seed))
+            tok = self._sample_filtered(
+                logits[:, -1], temp[None], topk[None], topp[None],
+                jax.random.PRNGKey(seed))
             zero = jnp.int32(0)
             k_all = lax.dynamic_update_slice(
                 k_all, kc, (zero, slot, zero, zero, zero))
@@ -324,25 +360,33 @@ class SlotEngine:
             dtok = dtok.at[slot].set(tok[0])
             dpos = dpos.at[slot].set(actual_len)
             dtemp = dtemp.at[slot].set(temp)
-            return tok[0], k_all, v_all, dtok, dpos, dtemp
+            dtopk = dtopk.at[slot].set(topk)
+            dtopp = dtopp.at[slot].set(topp)
+            return tok[0], k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp
 
-        fn = jax.jit(prefill, donate_argnums=(6, 7, 8, 9, 10))
+        fn = jax.jit(prefill, donate_argnums=(8, 9, 10, 11, 12, 13, 14))
         self._prefill_fns[bucket] = fn
         return fn
 
-    def _decode(self, kv_limit: int | None = None):
-        fn = self._decode_fns.get(kv_limit)
+    def _decode(self, kv_limit: int | None = None, filtered: bool = False):
+        fn = self._decode_fns.get((kv_limit, filtered))
         if fn is not None:
             return fn
         cfg, fwd, K = self.cfg, self._fwd, self.chunk
 
-        def decode_chunk(params, seed, dtok, dpos, dtemp, k_all, v_all):
+        def decode_chunk(params, seed, dtok, dpos, dtemp, dtopk, dtopp,
+                         k_all, v_all):
             def body(carry, step_key):
                 tok, pos, k_all, v_all = carry
                 logits, k_all, v_all = fwd(
                     params, tok[:, None], cfg, k_all, v_all, pos, None,
                     kv_limit=kv_limit)
-                nxt = self._sample(logits[:, -1], dtemp, step_key)
+                if filtered:  # any active slot needs top-k/top-p: pay
+                    # the per-step (S, vocab) sort in this variant only
+                    nxt = self._sample_filtered(
+                        logits[:, -1], dtemp, dtopk, dtopp, step_key)
+                else:
+                    nxt = self._sample(logits[:, -1], dtemp, step_key)
                 return (nxt, pos + 1, k_all, v_all), nxt
 
             keys = jax.random.split(jax.random.PRNGKey(seed), K)
@@ -353,8 +397,8 @@ class SlotEngine:
             out_full = jnp.concatenate([dtok[:, None], out.T], axis=1)
             return out_full, tok, pos, k_all, v_all  # out: (S, K+1)
 
-        fn = jax.jit(decode_chunk, donate_argnums=(2, 3, 5, 6))
-        self._decode_fns[kv_limit] = fn
+        fn = jax.jit(decode_chunk, donate_argnums=(2, 3, 7, 8))
+        self._decode_fns[(kv_limit, filtered)] = fn
         return fn
 
     def _kv_limit_for_chunk(self, active) -> int | None:
@@ -384,30 +428,40 @@ class SlotEngine:
         if self._thread is not None:
             raise RuntimeError("warmup must run before start()")
         for b in (self.buckets if buckets is None else buckets):
-            (_, self._k, self._v, self._dtok, self._dpos,
-             self._dtemp) = self._prefill_fn(b)(
+            (_, self._k, self._v, self._dtok, self._dpos, self._dtemp,
+             self._dtopk, self._dtopp) = self._prefill_fn(b)(
                 self.params, jnp.zeros((1, b), jnp.int32), np.int32(1),
-                np.int32(0), np.float32(0.0), np.uint32(0),
-                self._k, self._v, self._dtok, self._dpos, self._dtemp)
+                np.int32(0), np.float32(0.0), np.int32(0),
+                np.float32(1.0), np.uint32(0),
+                self._k, self._v, self._dtok, self._dpos, self._dtemp,
+                self._dtopk, self._dtopp)
         _, self._dtok, self._dpos, self._k, self._v = self._decode()(
             self.params, np.uint32(0), self._dtok, self._dpos, self._dtemp,
-            self._k, self._v)
+            self._dtopk, self._dtopp, self._k, self._v)
 
     # ---- request API -------------------------------------------------------
 
     def submit(self, prompt: list[int], max_new: int,
                temperature: float = 0.0,
                eos_id: int | None = None,
-               stream: bool = False) -> Handle:
+               stream: bool = False,
+               top_k: int = 0,
+               top_p: float = 1.0) -> Handle:
         """Queue a request; returns a Handle resolving to
         {"tokens": [...], "length": n} (tokens truncated at eos,
         inclusive). ``eos_id`` overrides the engine default per request —
         the check is host-side, so mixed-eos slots share the compiled
-        programs. Raises ValueError for requests that can never fit
-        (capacity is checked before queueing)."""
+        programs. ``top_k``/``top_p`` are per-slot traced values; chunks
+        with any filtered slot active run the sort-based sampler variant.
+        Raises ValueError for requests that can never fit (capacity is
+        checked before queueing)."""
         handle = Handle(_stream=queue.SimpleQueue() if stream else None)
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         n = len(prompt)
         if n < 1:
             raise ValueError("prompt must be non-empty")
@@ -433,7 +487,7 @@ class SlotEngine:
                     f"admission queue at capacity ({self.max_pending})")
             self._pending.put((list(prompt), max_new, float(temperature),
                                self.eos_id if eos_id is None else eos_id,
-                               handle))
+                               int(top_k), float(top_p), handle))
         self._wake.set()
         return handle
 
@@ -454,7 +508,7 @@ class SlotEngine:
         free = [i for i, s in self._table.items() if s is None]
         while free:
             try:
-                (prompt, max_new, temp, eos_id,
+                (prompt, max_new, temp, eos_id, top_k, top_p,
                  handle) = self._pending.get_nowait()
             except queue.Empty:
                 break
@@ -462,16 +516,18 @@ class SlotEngine:
             bucket = next(b for b in self.buckets if b >= len(prompt))
             padded = np.full((1, bucket), self.pad_id, np.int32)
             padded[0, :len(prompt)] = prompt
-            (tok, self._k, self._v, self._dtok, self._dpos,
-             self._dtemp) = self._prefill_fn(bucket)(
+            (tok, self._k, self._v, self._dtok, self._dpos, self._dtemp,
+             self._dtopk, self._dtopp) = self._prefill_fn(bucket)(
                 self.params, jnp.asarray(padded),
                 np.int32(len(prompt)), np.int32(slot),
-                np.float32(temp), self._next_seed(),
-                self._k, self._v, self._dtok, self._dpos, self._dtemp)
+                np.float32(temp), np.int32(top_k), np.float32(top_p),
+                self._next_seed(),
+                self._k, self._v, self._dtok, self._dpos, self._dtemp,
+                self._dtopk, self._dtopp)
             self.stats["prefills"] += 1
             st = _Slot(handle=handle, tokens=[], max_new=max_new,
                        pos=len(prompt), temperature=temp, eos_id=eos_id,
-                       base_len=len(prompt))
+                       top_k=top_k, top_p=top_p, base_len=len(prompt))
             with self._lock:
                 self._table[slot] = st
             if max_new == 1:
@@ -499,9 +555,11 @@ class SlotEngine:
     def _dispatch_chunk(self) -> None:
         snap = {i: s for i, s in self._table.items() if s is not None}
         limit = self._kv_limit_for_chunk(snap)
-        out, self._dtok, self._dpos, self._k, self._v = self._decode(limit)(
+        filtered = any(s.top_k > 0 or s.top_p < 1.0 for s in snap.values())
+        out, self._dtok, self._dpos, self._k, self._v = self._decode(
+            limit, filtered)(
             self.params, self._next_seed(), self._dtok, self._dpos,
-            self._dtemp, self._k, self._v)
+            self._dtemp, self._dtopk, self._dtopp, self._k, self._v)
         for st in snap.values():
             st.dispatched += 1
         # start the device→host copy now: by the time this chunk is

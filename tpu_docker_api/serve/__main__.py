@@ -30,11 +30,11 @@ Design notes, TPU-first:
   stream through a slot-based engine (infer/slots.py) — a fixed-capacity
   KV cache of ``--slots`` slots, K-step decode chunks, admission into
   freed slots between chunks. Concurrent clients share the chip instead
-  of serializing behind a lock; greedy and per-request temperature
-  sampling run in ONE compiled chunk program (no per-sampler retrace).
+  of serializing behind a lock; greedy, per-request temperature, and
+  per-request top-k/top-p all run through it (the filtered-sampling
+  chunk variant dispatches only while a top-k/top-p slot is active).
   Prompt rows in one body may be ragged — each row is its own request.
-  top-k/top-p bodies fall back to the legacy whole-generation path below.
-- legacy path (top-k/top-p, encdec, meshes, ``--slots 0``): one compiled
+- legacy path (encdec, meshes, ``--slots 0``): one compiled
   generate program per (batch, prompt_len, maxNewTokens, sampler) shape
   bucket — jax caches compilations, so repeated traffic at the same
   shape pays zero retrace; prompts in a batch are dense (callers
@@ -296,12 +296,11 @@ def main(argv: list[str] | None = None) -> None:
                 if not isinstance(do_stream, bool):
                     raise ValueError("stream must be a JSON boolean")
 
-                slot_ok = (slot_engine is not None and not is_encdec
-                           and top_k == 0 and top_p == 1.0)
+                slot_ok = slot_engine is not None and not is_encdec
                 if do_stream and not slot_ok:
                     raise ValueError(
-                        "stream requires the slot engine path (greedy or "
-                        "temperature sampling; no topK/topP/encdec)")
+                        "stream requires the slot engine path (not "
+                        "encdec, --slots > 0, single device)")
                 if do_stream and len(prompts) != 1:
                     raise ValueError("stream serves exactly one prompt row")
 
@@ -314,7 +313,8 @@ def main(argv: list[str] | None = None) -> None:
                     try:
                         handles = [slot_engine.submit(
                             r, max_new, temperature, eos_id=eos_id,
-                            stream=do_stream) for r in prompts]
+                            stream=do_stream, top_k=top_k, top_p=top_p)
+                            for r in prompts]
                     except QueueFull as e:
                         self._reply(503, {"error": str(e)})
                         return
