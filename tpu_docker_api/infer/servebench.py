@@ -177,9 +177,11 @@ def bench_decode_roofline(
     bw = _hbm_bw()
     # weight-streaming roof: every decode step reads every weight byte
     roof_tok_s = batch * bw / weight_bytes if bw else None
-    # KV bytes actually read per step at the END of generation (worst
-    # step): batch rows * filled positions * layers * kv * hd * 2 (k+v)
-    cache_bytes = (2 * cfg.n_layers * batch * (prompt_len + new_tok)
+    # KV bytes actually read per step: decode attention reads the FULL
+    # allocated buffer (engine.py right-sizes it to prompt+new rounded
+    # up to 128), not just the filled positions
+    capacity = min(max_seq, (prompt_len + new_tok - 1 + 127) // 128 * 128)
+    cache_bytes = (2 * cfg.n_layers * batch * capacity
                    * cfg.n_kv_heads * cfg.head_dim * dtype.itemsize)
     return {
         "ok": True,

@@ -441,21 +441,11 @@ class SlotEngine:
 
     # ---- request API -------------------------------------------------------
 
-    def submit(self, prompt: list[int], max_new: int,
-               temperature: float = 0.0,
-               eos_id: int | None = None,
-               stream: bool = False,
-               top_k: int = 0,
-               top_p: float = 1.0) -> Handle:
-        """Queue a request; returns a Handle resolving to
-        {"tokens": [...], "length": n} (tokens truncated at eos,
-        inclusive). ``eos_id`` overrides the engine default per request —
-        the check is host-side, so mixed-eos slots share the compiled
-        programs. ``top_k``/``top_p`` are per-slot traced values; chunks
-        with any filtered slot active run the sort-based sampler variant.
-        Raises ValueError for requests that can never fit (capacity is
-        checked before queueing)."""
-        handle = Handle(_stream=queue.SimpleQueue() if stream else None)
+    def validate(self, prompt: list[int], max_new: int,
+                 top_k: int = 0, top_p: float = 1.0) -> None:
+        """The submit-time request checks WITHOUT queueing — callers with
+        multi-request bodies validate every request up front so a bad
+        later row can't orphan earlier rows into the engine."""
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if top_k < 0:
@@ -473,6 +463,32 @@ class SlotEngine:
             raise ValueError(
                 f"prompt ({n}) + max_new ({max_new}) exceeds cache "
                 f"capacity {self.max_seq}")
+
+    def has_room(self, n_requests: int = 1) -> bool:
+        """Approximate admission-queue room for a batch of requests —
+        checked before submitting a multi-row body so a QueueFull
+        mid-list doesn't orphan the rows already queued (approximate:
+        qsize races concurrent submitters, same caveat as max_pending)."""
+        if not self.max_pending:
+            return True
+        return self._pending.qsize() + n_requests <= self.max_pending
+
+    def submit(self, prompt: list[int], max_new: int,
+               temperature: float = 0.0,
+               eos_id: int | None = None,
+               stream: bool = False,
+               top_k: int = 0,
+               top_p: float = 1.0) -> Handle:
+        """Queue a request; returns a Handle resolving to
+        {"tokens": [...], "length": n} (tokens truncated at eos,
+        inclusive). ``eos_id`` overrides the engine default per request —
+        the check is host-side, so mixed-eos slots share the compiled
+        programs. ``top_k``/``top_p`` are per-slot traced values; chunks
+        with any filtered slot active run the sort-based sampler variant.
+        Raises ValueError for requests that can never fit (capacity is
+        checked before queueing)."""
+        handle = Handle(_stream=queue.SimpleQueue() if stream else None)
+        self.validate(prompt, max_new, top_k=top_k, top_p=top_p)
         # state check + put are ONE atomic section vs close()/_die():
         # a check-then-put window would let a racing shutdown drain the
         # queue between them and orphan this handle forever

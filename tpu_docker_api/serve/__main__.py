@@ -246,6 +246,7 @@ def main(argv: list[str] | None = None) -> None:
                     "quantized": args.quantize,
                     "devices": len(jax.devices()),
                 }
+                code = 200
                 if slot_engine is not None:
                     payload["slotEngine"] = {
                         "slots": slot_engine.slots,
@@ -253,9 +254,13 @@ def main(argv: list[str] | None = None) -> None:
                         **slot_engine.stats,
                     }
                     if slot_engine.dead:
+                        # degraded must be visible at the HTTP level —
+                        # orchestrator health checks key on the status
+                        # code, not the body
                         payload["status"] = "degraded"
                         payload["slotEngine"]["dead"] = slot_engine.dead
-                self._reply(200, payload)
+                        code = 503
+                self._reply(code, payload)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -284,19 +289,30 @@ def main(argv: list[str] | None = None) -> None:
                 def req_int(name, default):
                     return errors.as_int(req.get(name, default), name)
 
+                def req_float(name, default):
+                    v = req.get(name, default)
+                    if isinstance(v, bool) or not isinstance(v,
+                                                             (int, float)):
+                        raise ValueError(f"{name} must be a number")
+                    return float(v)
+
                 max_new = req_int("maxNewTokens", 64)
                 if max_new < 1:
                     raise ValueError(
                         f"maxNewTokens must be >= 1, got {max_new}")
-                temperature = float(req.get("temperature", 0.0))
-                top_k, top_p = req_int("topK", 0), float(req.get("topP", 1.0))
+                temperature = req_float("temperature", 0.0)
+                top_k = req_int("topK", 0)
+                top_p = req_float("topP", 1.0)
                 eos_id = (req_int("eosId", 0)
                           if "eosId" in req else None)
                 do_stream = req.get("stream", False)
                 if not isinstance(do_stream, bool):
                     raise ValueError("stream must be a JSON boolean")
 
-                slot_ok = slot_engine is not None and not is_encdec
+                # a dead engine (device error on its thread) falls back
+                # to the legacy path instead of 500ing forever
+                slot_ok = (slot_engine is not None and not is_encdec
+                           and not slot_engine.dead)
                 if do_stream and not slot_ok:
                     raise ValueError(
                         "stream requires the slot engine path (not "
@@ -310,6 +326,16 @@ def main(argv: list[str] | None = None) -> None:
                     # contract (pad to maxNewTokens + lengths).
                     from tpu_docker_api.infer.slots import QueueFull
 
+                    # validate EVERY row + queue room before submitting
+                    # any — a failure mid-list would orphan the earlier
+                    # rows into the engine (decoding for nobody)
+                    for r in prompts:
+                        slot_engine.validate(r, max_new, top_k=top_k,
+                                             top_p=top_p)
+                    if not slot_engine.has_room(len(prompts)):
+                        self._reply(503, {
+                            "error": "admission queue at capacity"})
+                        return
                     try:
                         handles = [slot_engine.submit(
                             r, max_new, temperature, eos_id=eos_id,
@@ -355,9 +381,8 @@ def main(argv: list[str] | None = None) -> None:
                 lens = {len(r) for r in prompts}
                 if len(lens) > 1:
                     raise ValueError(
-                        "the legacy path needs equal-length prompt rows "
-                        "(left-pad), or use greedy/temperature sampling "
-                        "for ragged continuous batching")
+                        "this serving path (encdec / mesh / --slots 0) "
+                        "needs equal-length prompt rows — left-pad them")
                 prompt = jnp.asarray(np.array(prompts, np.int32))
                 fn = get_fn(max_new, temperature, top_k, top_p, eos_id)
                 with gen_lock:
