@@ -280,6 +280,21 @@ class TestAdmissionAndLimits:
         assert all(h.done() for h in handles)
         eng.submit([1, 2], 4)  # queue drained: admits again
 
+    def test_admission_burst_batches_prefills(self, setup):
+        """4 same-bucket requests admitted together run ONE batched
+        prefill dispatch, not 4 — and stay token-exact."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=4, max_seq=MAX_SEQ, chunk=4)
+        prompts = [[i + 1, i + 2, i + 3] for i in range(4)]
+        handles = [eng.submit(p, 6) for p in prompts]
+        eng.step()
+        assert eng.stats["prefills"] == 1  # one (bucket, 4) program
+        while not all(h.done() for h in handles):
+            eng.step()
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 6)
+
     def test_queue_deeper_than_slots_drains(self, setup):
         cfg, params = setup
         eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)

@@ -333,39 +333,46 @@ class SlotEngine:
                          jnp.argmax(z2 + g, axis=-1),
                          jnp.argmax(logits, axis=-1)).astype(jnp.int32)
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, bucket: int, rows: int = 1):
+        """Batched prefill program: ``rows`` prompts (same bucket) in ONE
+        forward + ONE dispatch. An admission burst of N batch-1 prefills
+        pays N dispatch latencies on an under-filled MXU; grouping
+        same-bucket admissions into power-of-two row batches collapses
+        both (a group of 5 runs as 4+1 — no padding rows)."""
+        fn = self._prefill_fns.get((bucket, rows))
         if fn is not None:
             return fn
         cfg, fwd = self.cfg, self._fwd
         cache_dtype = self._k.dtype
 
-        def prefill(params, prompt, actual_len, slot, temp, topk, topp,
-                    seed, k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp):
-            shape = (cfg.n_layers, 1, bucket, cfg.n_kv_heads, cfg.head_dim)
+        def prefill(params, prompts, actual_lens, slots, temps, topks,
+                    topps, seed, k_all, v_all, dtok, dpos, dtemp, dtopk,
+                    dtopp):
+            # prompts (R, bucket); per-row vectors (R,). The per-row
+            # last_only index keeps the head at (R, 1, vocab) — the full
+            # (R, bucket, vocab) f32 logits would be GBs at 8B shapes
+            shape = (cfg.n_layers, rows, bucket, cfg.n_kv_heads,
+                     cfg.head_dim)
             kc = jnp.zeros(shape, cache_dtype)
             vc = jnp.zeros(shape, cache_dtype)
-            logits, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
-                                 None, last_only=actual_len - 1)
-            tok = self._sample_filtered(
-                logits[:, -1], temp[None], topk[None], topp[None],
+            logits, kc, vc = fwd(params, prompts, cfg, kc, vc,
+                                 jnp.int32(0), None,
+                                 last_only=actual_lens - 1)
+            toks = self._sample_filtered(
+                logits[:, 0], temps, topks, topps,
                 jax.random.PRNGKey(seed))
-            zero = jnp.int32(0)
-            k_all = lax.dynamic_update_slice(
-                k_all, kc, (zero, slot, zero, zero, zero))
-            v_all = lax.dynamic_update_slice(
-                v_all, vc, (zero, slot, zero, zero, zero))
-            # seed the device-side decode inputs for this slot in the same
-            # program — an eager .at[].set would cost a tunnel round-trip
-            dtok = dtok.at[slot].set(tok[0])
-            dpos = dpos.at[slot].set(actual_len)
-            dtemp = dtemp.at[slot].set(temp)
-            dtopk = dtopk.at[slot].set(topk)
-            dtopp = dtopp.at[slot].set(topp)
-            return tok[0], k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp
+            # drop each row's bucket-length cache into its slot row
+            k_all = k_all.at[:, slots, :bucket].set(kc)
+            v_all = v_all.at[:, slots, :bucket].set(vc)
+            dtok = dtok.at[slots].set(toks)
+            dpos = dpos.at[slots].set(actual_lens)
+            dtemp = dtemp.at[slots].set(temps)
+            dtopk = dtopk.at[slots].set(topks)
+            dtopp = dtopp.at[slots].set(topps)
+            return toks, k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp
 
         fn = jax.jit(prefill, donate_argnums=(8, 9, 10, 11, 12, 13, 14))
-        self._prefill_fns[bucket] = fn
+        self._prefill_fns[(bucket, rows)] = fn
         return fn
 
     def _decode(self, kv_limit: int | None = None, filtered: bool = False):
@@ -430,9 +437,10 @@ class SlotEngine:
         for b in (self.buckets if buckets is None else buckets):
             (_, self._k, self._v, self._dtok, self._dpos, self._dtemp,
              self._dtopk, self._dtopp) = self._prefill_fn(b)(
-                self.params, jnp.zeros((1, b), jnp.int32), np.int32(1),
-                np.int32(0), np.float32(0.0), np.int32(0),
-                np.float32(1.0), np.uint32(0),
+                self.params, jnp.zeros((1, b), jnp.int32),
+                np.ones((1,), np.int32), np.zeros((1,), np.int32),
+                np.zeros((1,), np.float32), np.zeros((1,), np.int32),
+                np.ones((1,), np.float32), np.uint32(0),
                 self._k, self._v, self._dtok, self._dpos, self._dtemp,
                 self._dtopk, self._dtopp)
         _, self._dtok, self._dpos, self._k, self._v = self._decode()(
@@ -517,42 +525,65 @@ class SlotEngine:
                          % (2 ** 31))
 
     def _admit(self) -> bool:
-        """Move pending requests into free slots — ONE prefill dispatch
-        each (it updates the per-slot device state itself), fully async
+        """Move pending requests into free slots. Same-bucket requests
+        admit as power-of-two row batches through ONE prefill dispatch
+        (which updates the per-slot device state itself) — fully async
         unless max_new == 1. Returns True if anything was admitted."""
         admitted = False
         free = [i for i, s in self._table.items() if s is None]
-        while free:
+        batch = []
+        while len(batch) < len(free):
             try:
-                (prompt, max_new, temp, eos_id, top_k, top_p,
-                 handle) = self._pending.get_nowait()
+                batch.append(self._pending.get_nowait())
             except queue.Empty:
                 break
-            slot = free.pop()
-            bucket = next(b for b in self.buckets if b >= len(prompt))
-            padded = np.full((1, bucket), self.pad_id, np.int32)
-            padded[0, :len(prompt)] = prompt
-            (tok, self._k, self._v, self._dtok, self._dpos, self._dtemp,
-             self._dtopk, self._dtopp) = self._prefill_fn(bucket)(
-                self.params, jnp.asarray(padded),
-                np.int32(len(prompt)), np.int32(slot),
-                np.float32(temp), np.int32(top_k), np.float32(top_p),
-                self._next_seed(),
-                self._k, self._v, self._dtok, self._dpos, self._dtemp,
-                self._dtopk, self._dtopp)
-            self.stats["prefills"] += 1
-            st = _Slot(handle=handle, tokens=[], max_new=max_new,
-                       pos=len(prompt), temperature=temp, eos_id=eos_id,
-                       top_k=top_k, top_p=top_p, base_len=len(prompt))
-            with self._lock:
-                self._table[slot] = st
-            if max_new == 1:
-                # nothing to decode: resolve the prefill token now (the
-                # one admission path that syncs) and complete
-                st.emit(int(tok))
-                st.fresh = False
-                self._finish_if_done(slot, st)
-            admitted = True
+        if not batch:
+            return False
+        groups: dict[int, list] = {}
+        for req in batch:
+            bucket = next(b for b in self.buckets if b >= len(req[0]))
+            groups.setdefault(bucket, []).append(req)
+        for bucket, reqs in groups.items():
+            while reqs:
+                R = 1
+                while R * 2 <= len(reqs) and R * 2 <= self.slots:
+                    R *= 2
+                group, reqs = reqs[:R], reqs[R:]
+                slots_v = [free.pop() for _ in group]
+                prompts_np = np.full((R, bucket), self.pad_id, np.int32)
+                lens = np.empty((R,), np.int32)
+                temps = np.empty((R,), np.float32)
+                topks = np.empty((R,), np.int32)
+                topps = np.empty((R,), np.float32)
+                for r, (prompt, _mn, temp, _eos, tk, tp, _h) in enumerate(
+                        group):
+                    prompts_np[r, :len(prompt)] = prompt
+                    lens[r] = len(prompt)
+                    temps[r], topks[r], topps[r] = temp, tk, tp
+                (toks, self._k, self._v, self._dtok, self._dpos,
+                 self._dtemp, self._dtopk,
+                 self._dtopp) = self._prefill_fn(bucket, R)(
+                    self.params, jnp.asarray(prompts_np), lens,
+                    np.asarray(slots_v, np.int32), temps, topks, topps,
+                    self._next_seed(),
+                    self._k, self._v, self._dtok, self._dpos,
+                    self._dtemp, self._dtopk, self._dtopp)
+                self.stats["prefills"] += 1
+                for r, (prompt, max_new, temp, eos_id, tk, tp,
+                        handle) in enumerate(group):
+                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
+                               pos=len(prompt), temperature=temp,
+                               eos_id=eos_id, top_k=tk, top_p=tp,
+                               base_len=len(prompt))
+                    with self._lock:
+                        self._table[slots_v[r]] = st
+                    if max_new == 1:
+                        # nothing to decode: resolve the prefill token
+                        # now (the one admission path that syncs)
+                        st.emit(int(toks[r]))
+                        st.fresh = False
+                        self._finish_if_done(slots_v[r], st)
+                admitted = True
         return admitted
 
     def _finish_if_done(self, slot: int, st: _Slot) -> bool:

@@ -384,8 +384,14 @@ def decoder_forward_cached(params, tokens, cfg, k_cache, v_cache, mesh,
     elif last_only is not False and last_only is not None:
         # traced index: logits for position ``last_only`` only — the padded
         # prefill of a right-padded prompt (infer/slots.py) wants the logit
-        # at actual_len-1, which is not the bucket's final position
-        x = lax.dynamic_slice_in_dim(x, last_only, 1, axis=1)
+        # at actual_len-1, which is not the bucket's final position. A
+        # (batch,) vector gives every row its own position (the batched
+        # prefill), skipping the (b, seq, vocab) f32 logits either way
+        idx = jnp.asarray(last_only)
+        if idx.ndim == 1:
+            x = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+        else:
+            x = lax.dynamic_slice_in_dim(x, last_only, 1, axis=1)
     logits = lm_head(params, x, cfg)
     return logits, new_k, new_v
 
