@@ -278,10 +278,10 @@ class TestServeQuantized:
 
 
 class TestMeshServing:
-    def test_serves_on_tp_mesh_legacy_path(self):
-        """A 2-device tp mesh: the slot engine steps aside (single-device
-        by design) and the legacy sharded path serves — params created
-        into their shards, generate under the mesh."""
+    def test_serves_on_tp_mesh_with_slot_engine(self):
+        """A 2-device tp mesh: round-3 final — the slot engine runs ON
+        the mesh (kv heads sharded over tp, slots replicated), so
+        multi-chip models get continuous batching too."""
         port = 18796
         env = {**os.environ, "PYTHONPATH": REPO}
         p = subprocess.Popen(
@@ -305,11 +305,12 @@ class TestMeshServing:
             else:
                 raise RuntimeError("mesh server never became healthy")
             assert h["devices"] == 2
-            assert "slotEngine" not in h  # mesh: legacy path only
+            assert h["slotEngine"]["slots"] > 0  # engine ON the mesh
             out = _post(port, "/generate",
                         {"tokens": [[1, 2, 3]], "maxNewTokens": 4,
                          "temperature": 0.0}, timeout=120)
             assert len(out["tokens"][0]) == 4
+            assert _get(port, "/healthz")["slotEngine"]["completed"] >= 1
         finally:
             p.send_signal(signal.SIGTERM)
             p.communicate(timeout=30)

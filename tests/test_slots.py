@@ -422,6 +422,52 @@ class TestKvBucketedDecode:
         assert {k for k, _ in eng._decode_fns} >= {128, 256}
 
 
+class TestMeshEngine:
+    """Continuous batching on a tensor-parallel mesh: the cache's kv-head
+    dim shards over tp, slots stay replicated, XLA inserts collectives.
+    f32 config so mesh-vs-unsharded is numerically tight."""
+
+    def _setup(self):
+        import dataclasses
+
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+        from tpu_docker_api.parallel.sharding import (
+            LLAMA_RULES, param_shardings)
+
+        cfg = dataclasses.replace(llama_presets()["tiny"],
+                                  dtype=jnp.float32)
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=2, sp=1),
+                          devices=jax.devices()[:2])
+        params_s = jax.device_put(
+            params, param_shardings(params, mesh, LLAMA_RULES))
+        return cfg, params, params_s, mesh
+
+    def test_tp_mesh_token_exact(self):
+        cfg, params, params_s, mesh = self._setup()
+        eng = SlotEngine(cfg, params_s, slots=3, max_seq=MAX_SEQ,
+                         chunk=4, mesh=mesh)
+        prompts = [[3, 1, 4, 1, 5], [9, 8], [2, 6, 4, 7]]
+        handles = [eng.submit(p, 9) for p in prompts]
+        for _ in range(200):
+            if all(h.done() for h in handles):
+                break
+            eng.step()
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 9)  # unsharded single-device reference
+
+    def test_dp_mesh_rejected(self):
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=1, sp=1),
+                          devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="tp/fsdp-only"):
+            SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, mesh=mesh)
+
+
 class TestMoeFamily:
     def test_moe_slot_engine_token_exact_with_buckets(self):
         """The MoE family through the slot engine, including the

@@ -94,6 +94,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_docker_api.models import cached_forward_fn
 from tpu_docker_api.infer.engine import init_kv_cache
@@ -203,11 +204,14 @@ class SlotEngine:
     """Slot-based continuous-batching engine for the decoder families
     (llama + moe via ``models.cached_forward_fn``).
 
-    Single-accelerator by design: serving one chip is the unit the control
-    plane provisions (one container = one slice); meshes serve via one
-    process per chip. ``submit()`` is thread-safe; the decode loop runs on
-    the caller's thread via :meth:`step` or on a background thread via
-    :meth:`start`.
+    Single accelerator by default; a tensor-parallel ``mesh`` (tp, and
+    optionally fsdp for weight sharding — dp/sp must be 1, since the
+    slot dim stays replicated and decode's seq is 1) serves models
+    larger than one chip with the same continuous batching: the cache's
+    kv-head dim shards over tp, every program runs under the mesh, and
+    XLA inserts the collectives. ``submit()`` is thread-safe; the decode
+    loop runs on the caller's thread via :meth:`step` or on a background
+    thread via :meth:`start`.
     """
 
     def __init__(
@@ -225,6 +229,7 @@ class SlotEngine:
         cache_dtype: Any = jnp.bfloat16,
         seed: int = 0,
         max_pending: int = 0,
+        mesh=None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -249,21 +254,49 @@ class SlotEngine:
         #: SimpleQueue.qsize() races under concurrent submitters, but the
         #: point is load shedding, not an exact ceiling.
         self.max_pending = max_pending
+        if mesh is not None and getattr(mesh, "empty", False):
+            mesh = None
+        if mesh is not None:
+            bad = {ax: n for ax, n in mesh.shape.items()
+                   if ax in ("dp", "sp") and n > 1}
+            if bad:
+                raise ValueError(
+                    f"slot engine meshes are tp/fsdp-only (slots stay "
+                    f"replicated; decode seq is 1): got {bad}")
+        self.mesh = mesh
         self._fwd = cached_forward_fn(cfg)
-        cache = init_kv_cache(cfg, slots, self.max_seq, mesh=None,
-                              dtype=cache_dtype)
-        self._k, self._v = cache.k, cache.v
+        if mesh is not None:
+            # slots stay REPLICATED (engine.CACHE_SPEC would shard them
+            # over dp/fsdp); only the kv-head dim shards, over tp
+            shape = (cfg.n_layers, slots, self.max_seq, cfg.n_kv_heads,
+                     cfg.head_dim)
+            sh = NamedSharding(mesh, P(None, None, None, "tp", None))
+            mk = jax.jit(lambda: jnp.zeros(shape, cache_dtype),
+                         out_shardings=sh)
+            with mesh:
+                self._k, self._v = mk(), mk()
+        else:
+            cache = init_kv_cache(cfg, slots, self.max_seq, mesh=None,
+                                  dtype=cache_dtype)
+            self._k, self._v = cache.k, cache.v
         # RNG = a host counter folded into PRNGKey INSIDE the programs:
         # an eager jax.random.split costs a ~150 ms tunnel round-trip
         self._seed = seed
         self._dispatches = 0
         # device-resident per-slot decode inputs: each chunk consumes and
-        # returns them, so chunks chain with no host round-trip
-        self._dtok = jnp.zeros((slots,), jnp.int32)
-        self._dpos = jnp.zeros((slots,), jnp.int32)
-        self._dtemp = jnp.zeros((slots,), jnp.float32)
-        self._dtopk = jnp.zeros((slots,), jnp.int32)
-        self._dtopp = jnp.ones((slots,), jnp.float32)
+        # returns them, so chunks chain with no host round-trip (on a
+        # mesh: replicated, so they compose with the sharded operands)
+        def vec(fill, dtype):
+            x = jnp.full((slots,), fill, dtype)
+            if mesh is not None:
+                x = jax.device_put(x, NamedSharding(mesh, P()))
+            return x
+
+        self._dtok = vec(0, jnp.int32)
+        self._dpos = vec(0, jnp.int32)
+        self._dtemp = vec(0.0, jnp.float32)
+        self._dtopk = vec(0, jnp.int32)
+        self._dtopp = vec(1.0, jnp.float32)
 
         self._pending: queue.SimpleQueue = queue.SimpleQueue()
         self._table: dict[int, _Slot | None] = {i: None for i in range(slots)}
@@ -356,7 +389,7 @@ class SlotEngine:
             kc = jnp.zeros(shape, cache_dtype)
             vc = jnp.zeros(shape, cache_dtype)
             logits, kc, vc = fwd(params, prompts, cfg, kc, vc,
-                                 jnp.int32(0), None,
+                                 jnp.int32(0), self.mesh,
                                  last_only=actual_lens - 1)
             toks = self._sample_filtered(
                 logits[:, 0], temps, topks, topps,
@@ -386,8 +419,8 @@ class SlotEngine:
             def body(carry, step_key):
                 tok, pos, k_all, v_all = carry
                 logits, k_all, v_all = fwd(
-                    params, tok[:, None], cfg, k_all, v_all, pos, None,
-                    kv_limit=kv_limit)
+                    params, tok[:, None], cfg, k_all, v_all, pos,
+                    self.mesh, kv_limit=kv_limit)
                 if filtered:  # any active slot needs top-k/top-p: pay
                     # the per-step (S, vocab) sort in this variant only
                     nxt = self._sample_filtered(
@@ -437,7 +470,7 @@ class SlotEngine:
         for b in (self.buckets if buckets is None else buckets):
             (_, self._k, self._v, self._dtok, self._dpos, self._dtemp,
              self._dtopk, self._dtopp) = self._prefill_fn(b)(
-                self.params, jnp.zeros((1, b), jnp.int32),
+                self.params, np.zeros((1, b), np.int32),
                 np.ones((1,), np.int32), np.zeros((1,), np.int32),
                 np.zeros((1,), np.float32), np.zeros((1,), np.int32),
                 np.ones((1,), np.float32), np.uint32(0),
@@ -563,7 +596,7 @@ class SlotEngine:
                 (toks, self._k, self._v, self._dtok, self._dpos,
                  self._dtemp, self._dtopk,
                  self._dtopp) = self._prefill_fn(bucket, R)(
-                    self.params, jnp.asarray(prompts_np), lens,
+                    self.params, prompts_np, lens,
                     np.asarray(slots_v, np.int32), temps, topks, topps,
                     self._next_seed(),
                     self._k, self._v, self._dtok, self._dpos,

@@ -137,18 +137,21 @@ def main(argv: list[str] | None = None) -> None:
     max_seq = args.max_seq or (cfg.max_tgt_len if is_encdec
                                else cfg.max_seq_len)
 
-    # continuous batching: the default llama/moe single-device path.
-    # Meshes keep the legacy whole-generation path (the slot engine's
-    # per-row cache scatter is single-device by design — one container
-    # serves one slice, one process per chip).
+    # continuous batching: the default llama/moe path — single device,
+    # or a tensor-parallel mesh (tp/fsdp; the cache's kv-head dim shards
+    # over tp, slots stay replicated). dp/sp meshes keep the legacy
+    # whole-generation path.
     slot_engine = None
+    multi = mesh.devices.size > 1
+    tp_only = all(mesh.shape.get(ax, 1) == 1 for ax in ("dp", "sp"))
     if (family in ("llama", "moe") and args.slots > 0
-            and mesh.devices.size <= 1):
+            and (not multi or tp_only)):
         from tpu_docker_api.infer.slots import SlotEngine
 
         slot_engine = SlotEngine(
             cfg, params, slots=args.slots, max_seq=max_seq,
             chunk=args.chunk,
+            mesh=mesh if multi else None,
             # shed load once the queue is 8x the slot count deep — beyond
             # that, added requests only buy latency, not throughput
             max_pending=args.slots * 8,
