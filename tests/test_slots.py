@@ -457,12 +457,17 @@ class TestMeshEngine:
             assert h.result(0)["tokens"] == isolated_greedy(
                 cfg, params, p, 9)  # unsharded single-device reference
 
-    def test_dp_mesh_rejected(self):
+    @pytest.mark.parametrize("plan_kw", [
+        dict(dp=2), dict(sp=2), dict(pp=2), dict(ep=2)])
+    def test_non_tp_meshes_rejected(self, plan_kw):
         from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
 
         cfg = llama_presets()["tiny"]
         params = llama_init(cfg, jax.random.PRNGKey(7))
-        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=1, sp=1),
+        mesh = build_mesh(MeshPlan(dp=plan_kw.get("dp", 1), fsdp=1, tp=1,
+                                   sp=plan_kw.get("sp", 1),
+                                   pp=plan_kw.get("pp", 1),
+                                   ep=plan_kw.get("ep", 1)),
                           devices=jax.devices()[:2])
         with pytest.raises(ValueError, match="tp/fsdp-only"):
             SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, mesh=mesh)

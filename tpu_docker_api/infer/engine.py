@@ -58,13 +58,17 @@ def init_kv_cache(
     max_seq: int | None = None,
     mesh: Mesh | None = None,
     dtype: Any = jnp.bfloat16,
+    spec: P | None = None,
 ) -> KVCache:
     """Zero-filled cache, allocated directly into its shards when a mesh is
-    given (never materialized replicated on one device)."""
+    given (never materialized replicated on one device). ``spec``
+    overrides CACHE_SPEC — the slot engine keeps its slots dim replicated
+    instead of dp/fsdp-sharded."""
     max_seq = max_seq or cfg.max_seq_len
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
     if mesh is not None and not mesh.empty:
-        sharding = NamedSharding(mesh, CACHE_SPEC)
+        sharding = NamedSharding(mesh, spec if spec is not None
+                                 else CACHE_SPEC)
         zeros = jax.jit(
             lambda: jnp.zeros(shape, dtype), out_shardings=sharding
         )

@@ -258,27 +258,19 @@ class SlotEngine:
             mesh = None
         if mesh is not None:
             bad = {ax: n for ax, n in mesh.shape.items()
-                   if ax in ("dp", "sp") and n > 1}
+                   if ax not in ("tp", "fsdp") and n > 1}
             if bad:
                 raise ValueError(
                     f"slot engine meshes are tp/fsdp-only (slots stay "
                     f"replicated; decode seq is 1): got {bad}")
         self.mesh = mesh
         self._fwd = cached_forward_fn(cfg)
-        if mesh is not None:
-            # slots stay REPLICATED (engine.CACHE_SPEC would shard them
-            # over dp/fsdp); only the kv-head dim shards, over tp
-            shape = (cfg.n_layers, slots, self.max_seq, cfg.n_kv_heads,
-                     cfg.head_dim)
-            sh = NamedSharding(mesh, P(None, None, None, "tp", None))
-            mk = jax.jit(lambda: jnp.zeros(shape, cache_dtype),
-                         out_shardings=sh)
-            with mesh:
-                self._k, self._v = mk(), mk()
-        else:
-            cache = init_kv_cache(cfg, slots, self.max_seq, mesh=None,
-                                  dtype=cache_dtype)
-            self._k, self._v = cache.k, cache.v
+        # slots stay REPLICATED (engine.CACHE_SPEC would shard them over
+        # dp/fsdp); only the kv-head dim shards, over tp
+        cache = init_kv_cache(
+            cfg, slots, self.max_seq, mesh=mesh, dtype=cache_dtype,
+            spec=P(None, None, None, "tp", None))
+        self._k, self._v = cache.k, cache.v
         # RNG = a host counter folded into PRNGKey INSIDE the programs:
         # an eager jax.random.split costs a ~150 ms tunnel round-trip
         self._seed = seed
@@ -623,12 +615,14 @@ class SlotEngine:
         hit_eos = st.eos_id is not None and st.tokens and (
             st.tokens[-1] == st.eos_id)
         if hit_eos or len(st.tokens) >= st.max_new:
-            st.handle._complete(
-                {"tokens": st.tokens, "length": len(st.tokens)})
+            # stats + table BEFORE resolving the handle: the HTTP worker
+            # it wakes may immediately read /healthz counters
             with self._lock:
                 self._table[slot] = None
                 self.stats["completed"] += 1
                 self.stats["emitted_tokens"] += len(st.tokens)
+            st.handle._complete(
+                {"tokens": st.tokens, "length": len(st.tokens)})
             return True
         return False
 
