@@ -235,8 +235,10 @@ def main() -> None:
             result["extra"]["llama3_8b_int8_infer"] = measure_8b_inference()
         except Exception as e:
             result["extra"]["llama3_8b_int8_infer"] = {"error": str(e)[:200]}
-        gc.collect()  # drop the 8 GB serving weights before the next rider
+        jax.clear_caches()  # drop the 8 GB serving weights + programs
+        gc.collect()        # before the next rider
         result["extra"]["serving"] = measure_serving()
+        jax.clear_caches()
         gc.collect()
         result["extra"]["families"] = measure_family_trains()
     print(json.dumps(result))
@@ -362,6 +364,8 @@ def measure_serving() -> dict:
 
     from tpu_docker_api.infer.servebench import bench_concurrent_serving
 
+    import jax
+
     out = {}
     for name, kwargs in (
         ("llama3_1b", dict(preset="llama3-1b", quantize=False, streams=8)),
@@ -380,6 +384,12 @@ def measure_serving() -> dict:
             out[name] = r
         except Exception as e:
             out[name] = {"error": str(e)[:160]}
+        # free the point's compiled executables + their server-side
+        # buffers before the next one: four points' accumulated caches
+        # on a 16 GB chip have been seen starving the 8B engines into
+        # allocator thrash (measured 18.8 tok/s on an otherwise-490
+        # point). Costs a recompile per point; reliability wins.
+        jax.clear_caches()
         gc.collect()
     return out
 
