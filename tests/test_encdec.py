@@ -95,6 +95,23 @@ class TestShardingRules:
         leaf = sharded["dec_layers"]["cross_attn"]["wq"]
         assert len(leaf.addressable_shards) == 8
 
+    def test_sequence_parallel_loss_matches_unsharded(self, tiny_params):
+        """sp mesh (round 3): encoder non-causal ring + decoder causal
+        zigzag ring + sp-gathered cross — loss must match the unsharded
+        forward within bf16 reduction tolerance."""
+        from tpu_docker_api.models.encdec import (
+            encdec_loss, encdec_synthetic_batch)
+
+        batch = encdec_synthetic_batch(jax.random.PRNGKey(1), 4, 32, 32,
+                                       TINY)
+        ref = float(encdec_loss(tiny_params, batch, TINY))
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=2, sp=2))
+        with mesh:
+            got = float(jax.jit(
+                lambda p, b: encdec_loss(p, b, TINY, mesh))(
+                    tiny_params, batch))
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
 
 class TestTraining:
     def test_registry_dispatch(self):

@@ -19,9 +19,18 @@ Megatron column/row sharding rules over (fsdp, tp), rope for positions
 (no learned-position or relative-bias tables — rope is free of the
 (S, T) bias matmuls T5 pays and rides the same ops/rope.py path the
 other families use), shared src/tgt embedding, ``embed_lookup`` for the
-tp-sharded vocab gather. Sequence parallelism is not wired for this
-family (cross-attention under sp needs a gathered encoder output; use
-dp/fsdp/tp meshes).
+tp-sharded vocab gather.
+
+Sequence parallelism (round 3): on meshes with a real ``sp`` axis, both
+stacks' SELF-attention rides ring attention (parallel/ring.py —
+non-causal contiguous for the bidirectional encoder, causal zigzag for
+the decoder; rope is applied globally before the ring, so no
+model-side position changes). CROSS-attention keeps the encoder output
+gathered over sp (one all-gather of the (b, S, d) activations per
+forward — decoder queries stay seq-sharded, encoder k/v are full), the
+same trade MaxText-style encoder-decoder sharding makes: the cross k/v
+are reused by every decoder layer, so gathering once beats ringing them
+per layer.
 """
 
 from __future__ import annotations
@@ -193,31 +202,54 @@ def _mlp(x, mlp):
     return linear(gate * up, mlp["w_down"])
 
 
+def _has_sp(mesh) -> bool:
+    return (mesh is not None and not mesh.empty
+            and mesh.shape.get("sp", 1) > 1)
+
+
 def _enc_block(x, layer, cfg: EncDecConfig, rope_cos, rope_sin, mesh):
-    """Bidirectional self-attention + SwiGLU, pre-norm residuals."""
+    """Bidirectional self-attention + SwiGLU, pre-norm residuals. On an
+    sp mesh the attention rides the non-causal ring (contiguous
+    placement — no causal skew to fix)."""
     b, s, d = x.shape
     y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q, k, v = _project_qkv(y, layer["attn"], cfg)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
-    out = multihead_attention(q, k, v, causal=False, probs_dtype=cfg.dtype)
+    if _has_sp(mesh):
+        from tpu_docker_api.parallel.ring import ring_attention
+
+        out = ring_attention(q, k, v, mesh, causal=False)
+    else:
+        out = multihead_attention(q, k, v, causal=False,
+                                  probs_dtype=cfg.dtype)
     x = x + linear(out.reshape(b, s, d), layer["attn"]["wo"])
-    x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    bspec = P(("dp", "fsdp"), "sp")
+    x = constrain(x, mesh, bspec) if mesh is not None else x
     x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["mlp"])
-    return constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    return constrain(x, mesh, bspec) if mesh is not None else x
 
 
 def _dec_block(x, enc_out, layer, cfg: EncDecConfig, rope_cos, rope_sin,
                mesh):
     """Causal self-attention → cross-attention over ``enc_out`` → SwiGLU.
     Cross-attention applies no rope: relative order information lives in
-    each side's self-attention; the cross path is pure content lookup."""
+    each side's self-attention; the cross path is pure content lookup.
+    On an sp mesh: self-attention rides the causal zigzag ring; the
+    cross path keeps enc_out replicated over sp (module docstring) so
+    seq-sharded queries attend full encoder k/v."""
     b, s, d = x.shape
     y = rms_norm(x, layer["self_norm"], cfg.norm_eps)
     q, k, v = _project_qkv(y, layer["self_attn"], cfg)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
-    out = multihead_attention(q, k, v, causal=True)
+    if _has_sp(mesh):
+        from tpu_docker_api.parallel.ring import ring_attention
+
+        out = ring_attention(q, k, v, mesh, causal=True,
+                             placement="zigzag")
+    else:
+        out = multihead_attention(q, k, v, causal=True)
     x = x + linear(out.reshape(b, s, d), layer["self_attn"]["wo"])
 
     y = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
@@ -226,9 +258,10 @@ def _dec_block(x, enc_out, layer, cfg: EncDecConfig, rope_cos, rope_sin,
     # cross shapes on dense; equal-length pairs may take the flash kernel
     out = multihead_attention(q, k, v, causal=False, probs_dtype=cfg.dtype)
     x = x + linear(out.reshape(b, s, d), layer["cross_attn"]["wo"])
-    x = constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    bspec = P(("dp", "fsdp"), "sp")
+    x = constrain(x, mesh, bspec) if mesh is not None else x
     x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps), layer["mlp"])
-    return constrain(x, mesh, P(("dp", "fsdp"), None)) if mesh is not None else x
+    return constrain(x, mesh, bspec) if mesh is not None else x
 
 
 def _maybe_remat(fn, cfg: EncDecConfig):
@@ -243,7 +276,7 @@ def encdec_encode(params, src, cfg: EncDecConfig, mesh=None):
     """(b, S) source tokens → (b, S, d) encoder output (final-normed)."""
     x = embed_lookup(params["embed"]["tokens"], src, mesh)
     if mesh is not None:
-        x = constrain(x, mesh, P(("dp", "fsdp"), None))
+        x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
     rope_cos, rope_sin = rope_frequencies(
         cfg.head_dim, src.shape[1], cfg.rope_theta)
     block = _maybe_remat(functools.partial(
@@ -254,8 +287,13 @@ def encdec_encode(params, src, cfg: EncDecConfig, mesh=None):
         return block(x, layer), None
 
     x, _ = lax.scan(body, x, params["enc_layers"])
-    return rms_norm(x, params["enc_final_norm"], cfg.norm_eps).astype(
+    out = rms_norm(x, params["enc_final_norm"], cfg.norm_eps).astype(
         cfg.dtype)
+    if _has_sp(mesh):
+        # gather the encoder output over sp ONCE: every decoder layer's
+        # cross-attention reuses it as full-length k/v (module docstring)
+        out = constrain(out, mesh, P(("dp", "fsdp"), None))
+    return out
 
 
 def encdec_hidden(params, batch, cfg: EncDecConfig, mesh=None):
@@ -266,7 +304,7 @@ def encdec_hidden(params, batch, cfg: EncDecConfig, mesh=None):
     enc_out = encdec_encode(params, src, cfg, mesh)
     x = embed_lookup(params["embed"]["tokens"], tgt, mesh)
     if mesh is not None:
-        x = constrain(x, mesh, P(("dp", "fsdp"), None))
+        x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
     rope_cos, rope_sin = rope_frequencies(
         cfg.head_dim, tgt.shape[1], cfg.rope_theta)
     block = _maybe_remat(functools.partial(
@@ -287,7 +325,7 @@ def encdec_forward(params, batch, cfg: EncDecConfig, mesh=None):
     logits = linear(x.astype(cfg.dtype), params["lm_head"],
                     out_dtype=jnp.float32)
     if mesh is not None:
-        logits = constrain(logits, mesh, P(("dp", "fsdp"), None, "tp"))
+        logits = constrain(logits, mesh, P(("dp", "fsdp"), "sp", "tp"))
     return logits
 
 
@@ -304,7 +342,7 @@ def encdec_loss(params, batch, cfg: EncDecConfig, mesh=None):
         x = encdec_hidden(params, (src, tgt[:, :-1]), cfg, mesh)
         h = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cfg.dtype)
         if mesh is not None:
-            h = constrain(h, mesh, P(("dp", "fsdp"), None, None))
+            h = constrain(h, mesh, P(("dp", "fsdp"), "sp", None))
         return chunked_cross_entropy(
             h, params["lm_head"], tgt[:, 1:], cfg.loss_chunk_rows)
     logits = encdec_forward(params, (src, tgt[:, :-1]), cfg, mesh)
