@@ -382,11 +382,54 @@ def check_speculative_trained() -> bool:
     acc_p = int(res_p["accepted"]) / (int(res_p["rounds"]) * 4)
     match_p = float(jnp.mean(
         (res_p["tokens"] == results["plain"]["tokens"]).astype(jnp.float32)))
-    return ok & _emit(
+    ok &= _emit(
         "speculative_partial_draft", match_p == 1.0 and acc_p < 0.95,
         k=4, acceptance=round(acc_p, 2), tokens_match=round(match_p, 2),
         speedup_vs_plain=round(t_plain / t_part, 2),
         draft_train_steps=150, draft_train_loss=round(loss_dp, 3))
+
+    # speculative × CONTINUOUS BATCHING (round 3): the trained pair
+    # through the spec slot engine vs the plain slot engine, 8 concurrent
+    # streams. At batch 8 decode is already weight-amortized, so this
+    # measures whether speculation still pays under batching (draft
+    # steps + one (8, k+1) verify vs k+1 plain chunk steps).
+    import time as _time
+
+    from tpu_docker_api.infer.slots import SlotEngine, SpeculativeSlotEngine
+
+    prompts8 = []
+    for i in range(8):
+        pat_i = jax.random.randint(jax.random.PRNGKey(800 + i),
+                                   (1, period), 0, subvocab,
+                                   dtype=jnp.int32)
+        prompts8.append(jnp.tile(pat_i, (1, 4))[0].tolist())
+    n8 = 96
+
+    def run_engine(eng):
+        eng.warmup(buckets=(64,))
+        times, outs = [], None
+        for _ in range(2):
+            t0 = _time.perf_counter()
+            hs = [eng.submit(p, n8) for p in prompts8]
+            while not all(h.done() for h in hs):
+                eng.step()
+            times.append(_time.perf_counter() - t0)
+            outs = [h.result(0)["tokens"] for h in hs]
+        return min(times), outs
+
+    t_plain8, out_plain = run_engine(SlotEngine(
+        cfg_t, params_t, slots=8, max_seq=512, chunk=8))
+    t_spec8, out_spec = run_engine(SpeculativeSlotEngine(
+        cfg_t, params_t, draft_cfg=cfg_d, draft_params=params_d,
+        n_spec=4, slots=8, max_seq=512))
+    matches = sum(a == b for a, b in zip(out_spec, out_plain))
+    return ok & _emit(
+        "speculative_slot_engine", matches >= 7,
+        streams=8, new_tokens=n8,
+        plain_slots_tok_s=round(8 * n8 / t_plain8),
+        spec_slots_tok_s=round(8 * n8 / t_spec8),
+        speedup=round(t_plain8 / t_spec8, 2),
+        match_streams=f"{matches}/8")
 
 
 def check_vit_train() -> bool:

@@ -507,3 +507,88 @@ class TestCacheIsolation:
             eng.step()
         assert h2.result(0)["tokens"] == isolated_greedy(
             cfg, params, [2, 7], 8)
+
+
+class TestSpeculativeSlots:
+    """Speculative decoding x continuous batching: greedy verification is
+    token-exact vs plain greedy REGARDLESS of draft quality."""
+
+    def _engines(self, draft_seed, n_spec=3, slots=3, draft_layers=None):
+        import dataclasses
+
+        from tpu_docker_api.infer.slots import SpeculativeSlotEngine
+
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        dcfg = cfg if draft_layers is None else dataclasses.replace(
+            cfg, n_layers=draft_layers)
+        dparams = (params if draft_seed == 7 and draft_layers is None
+                   else llama_init(dcfg, jax.random.PRNGKey(draft_seed)))
+        eng = SpeculativeSlotEngine(
+            cfg, params, draft_cfg=dcfg, draft_params=dparams,
+            n_spec=n_spec, slots=slots, max_seq=MAX_SEQ)
+        return cfg, params, eng
+
+    def test_perfect_draft_token_exact_and_fast_path(self):
+        """draft == target: every proposal accepted, slots advance
+        n_spec+1 per round, outputs exact."""
+        cfg, params, eng = self._engines(draft_seed=7)
+        prompts = [[3, 1, 4, 1, 5], [9, 8]]
+        handles = [eng.submit(p, 12) for p in prompts]
+        for _ in range(100):
+            if all(h.done() for h in handles):
+                break
+            eng.step()
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 12)
+        # acceptance ~1: far fewer rounds than tokens
+        assert eng.stats["decode_chunks"] * (eng.n_spec + 1) >= 11
+        assert eng.stats["accepted_tokens"] > 0
+
+    def test_garbage_draft_still_token_exact(self):
+        """A random different-weights draft: proposals mostly rejected —
+        the rollback path runs constantly and output stays EXACT."""
+        cfg, params, eng = self._engines(draft_seed=99, draft_layers=1)
+        prompts = [[2, 7, 1], [5, 5, 5, 5], [8]]
+        handles = [eng.submit(p, 10) for p in prompts]
+        for _ in range(300):
+            if all(h.done() for h in handles):
+                break
+            eng.step()
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 10)
+
+    def test_eos_and_slot_reuse(self):
+        cfg, params, eng = self._engines(draft_seed=7, slots=1)
+        prompt = [3, 1, 4, 1, 5]
+        ref = isolated_greedy(cfg, params, prompt, 12)
+        eos = ref[3]
+        first = ref.index(eos) + 1
+        h1 = eng.submit(prompt, 12, eos_id=eos)
+        while not h1.done():
+            eng.step()
+        assert h1.result(0)["tokens"] == ref[:first]
+        h2 = eng.submit([9, 2], 6)  # slot + both caches recycled
+        while not h2.done():
+            eng.step()
+        assert h2.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [9, 2], 6)
+
+    def test_sampling_rejected(self):
+        cfg, params, eng = self._engines(draft_seed=7)
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.submit([1, 2], 4, temperature=0.5)
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.submit([1, 2], 4, top_k=3)
+
+    def test_streaming_through_spec_slots(self):
+        cfg, params, eng = self._engines(draft_seed=7)
+        prompt = [2, 7, 1, 8]
+        ref = isolated_greedy(cfg, params, prompt, 9)
+        eng.start()
+        h = eng.submit(prompt, 9, stream=True)
+        got = list(h.stream(timeout=120))
+        eng.close()
+        assert got == ref

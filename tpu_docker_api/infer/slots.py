@@ -776,3 +776,239 @@ class SlotEngine:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class SpeculativeSlotEngine(SlotEngine):
+    """Continuous batching × speculative decoding (greedy): every engine
+    round, a small DRAFT model proposes ``n_spec`` tokens per slot
+    autoregressively, and the TARGET verifies all of them in ONE forward
+    of (slots, n_spec+1) tokens — the per-row multi-token cached forward
+    (vector ``start_pos`` with seq > 1) the slot machinery already
+    supports. Accepted prefix + the target's own correction token emit
+    per round, so a slot advances 1..n_spec+1 positions per dispatch.
+
+    Exactness: greedy speculative verification is token-exact vs plain
+    greedy decode REGARDLESS of draft quality (a bad draft only costs
+    speed) — tests/test_slots.py proves it with a garbage draft. The
+    rollback story is the same just-in-time-overwrite argument as the
+    base engine: rejected positions' k/v (in both caches) are rewritten
+    by the round that legitimately crosses them, before the causal mask
+    lets anything attend them.
+
+    Greedy-only (temperature/top-k/top-p submits are rejected) and
+    single-device for now; decode reads are unbucketed (verify reads
+    scale with n_spec, not chunk)."""
+
+    def __init__(self, cfg, params, *, draft_cfg, draft_params,
+                 n_spec: int = 4, **kwargs):
+        if kwargs.get("mesh") is not None:
+            raise ValueError("speculative slots are single-device for now")
+        if n_spec < 1:
+            raise ValueError(f"n_spec must be >= 1, got {n_spec}")
+        # chunk drives the position-bound math (a round advances at most
+        # n_spec+1) and the host emit loop's column count
+        kwargs["chunk"] = n_spec + 1
+        super().__init__(cfg, params, **kwargs)
+        self.n_spec = n_spec
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self._dfwd = cached_forward_fn(draft_cfg)
+        dcache = init_kv_cache(draft_cfg, self.slots, self.max_seq,
+                               mesh=None, dtype=self._k.dtype)
+        self._dk, self._dv = dcache.k, dcache.v
+        self._kv_buckets = ()  # verify reads stay unbucketed
+
+    def submit(self, prompt, max_new, temperature=0.0, eos_id=None,
+               stream=False, top_k=0, top_p=1.0):
+        if temperature != 0.0 or top_k != 0 or top_p != 1.0:
+            raise ValueError(
+                "speculative slots are greedy-only (temperature 0, no "
+                "top-k/top-p)")
+        return super().submit(prompt, max_new, 0.0, eos_id=eos_id,
+                              stream=stream)
+
+    # ---- compiled programs -------------------------------------------------
+
+    def _prefill_fn(self, bucket: int, rows: int = 1):
+        """Batched prefill that fills BOTH caches: the target's (and its
+        first sampled token) exactly like the base engine, plus the
+        draft's — the draft's next proposal round must attend the full
+        prompt prefix."""
+        fn = self._prefill_fns.get((bucket, rows))
+        if fn is not None:
+            return fn
+        cfg, dcfg = self.cfg, self.draft_cfg
+        fwd, dfwd = self._fwd, self._dfwd
+        cache_dtype = self._k.dtype
+
+        def prefill(params, dparams, prompts, actual_lens, slots,
+                    k_all, v_all, dk_all, dv_all, dtok, dpos):
+            shape = (cfg.n_layers, rows, bucket, cfg.n_kv_heads,
+                     cfg.head_dim)
+            kc = jnp.zeros(shape, cache_dtype)
+            vc = jnp.zeros(shape, cache_dtype)
+            logits, kc, vc = fwd(params, prompts, cfg, kc, vc,
+                                 jnp.int32(0), None,
+                                 last_only=actual_lens - 1)
+            toks = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            dshape = (dcfg.n_layers, rows, bucket, dcfg.n_kv_heads,
+                      dcfg.head_dim)
+            dkc = jnp.zeros(dshape, cache_dtype)
+            dvc = jnp.zeros(dshape, cache_dtype)
+            _, dkc, dvc = dfwd(dparams, prompts, dcfg, dkc, dvc,
+                               jnp.int32(0), None, last_only=True)
+            k_all = k_all.at[:, slots, :bucket].set(kc)
+            v_all = v_all.at[:, slots, :bucket].set(vc)
+            dk_all = dk_all.at[:, slots, :bucket].set(dkc)
+            dv_all = dv_all.at[:, slots, :bucket].set(dvc)
+            dtok = dtok.at[slots].set(toks)
+            dpos = dpos.at[slots].set(actual_lens)
+            return toks, k_all, v_all, dk_all, dv_all, dtok, dpos
+
+        fn = jax.jit(prefill, donate_argnums=(5, 6, 7, 8, 9, 10))
+        self._prefill_fns[(bucket, rows)] = fn
+        return fn
+
+    def _spec_round_fn(self):
+        fn = self._decode_fns.get("spec")
+        if fn is not None:
+            return fn
+        cfg, dcfg, K = self.cfg, self.draft_cfg, self.n_spec
+        fwd, dfwd = self._fwd, self._dfwd
+        pad = jnp.int32(self.pad_id)
+
+        def spec_round(params, dparams, dtok, dpos, k_all, v_all,
+                       dk_all, dv_all):
+            # 1. draft proposes K tokens per slot (its cache fills
+            # dpos..dpos+K-1 with [dtok, p0..p_{K-2}])
+            def dbody(carry, _):
+                tok, pos, dk, dv = carry
+                lg, dk, dv = dfwd(dparams, tok[:, None], dcfg, dk, dv,
+                                  pos, None)
+                nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, pos + 1, dk, dv), nxt
+
+            (_, _, dk_all, dv_all), props = lax.scan(
+                dbody, (dtok, dpos, dk_all, dv_all), None, length=K)
+            props = props.T  # (S, K)
+
+            # 2. target verifies all K+1 positions in ONE forward
+            seq_in = jnp.concatenate([dtok[:, None], props], axis=1)
+            logits, k_all, v_all = fwd(params, seq_in, cfg, k_all, v_all,
+                                       dpos, None)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            # 3. accepted prefix length + the target's correction token
+            match = (props == greedy[:, :K]).astype(jnp.int32)
+            n_acc = jnp.cumprod(match, axis=1).sum(axis=1)    # (S,)
+            corr = jnp.take_along_axis(greedy, n_acc[:, None],
+                                       axis=1)[:, 0]
+            idx = jnp.arange(K + 1, dtype=jnp.int32)[None, :]
+            props_ext = jnp.pad(props, ((0, 0), (0, 1)))
+            newt = jnp.where(
+                idx < n_acc[:, None], props_ext,
+                jnp.where(idx == n_acc[:, None], corr[:, None], pad))
+            out_full = jnp.concatenate([dtok[:, None], newt], axis=1)
+            counts = n_acc + 1
+            return (out_full, counts, corr, dpos + counts,
+                    k_all, v_all, dk_all, dv_all)
+
+        fn = jax.jit(spec_round, donate_argnums=(4, 5, 6, 7))
+        self._decode_fns["spec"] = fn
+        return fn
+
+    def warmup(self, buckets=None):
+        if self._thread is not None:
+            raise RuntimeError("warmup must run before start()")
+        for b in (self.buckets if buckets is None else buckets):
+            (_, self._k, self._v, self._dk, self._dv, self._dtok,
+             self._dpos) = self._prefill_fn(b)(
+                self.params, self.draft_params,
+                np.zeros((1, b), np.int32), np.ones((1,), np.int32),
+                np.zeros((1,), np.int32),
+                self._k, self._v, self._dk, self._dv,
+                self._dtok, self._dpos)
+        (_, _, self._dtok, self._dpos, self._k, self._v, self._dk,
+         self._dv) = self._spec_round_fn()(
+            self.params, self.draft_params, self._dtok, self._dpos,
+            self._k, self._v, self._dk, self._dv)
+
+    # ---- engine loop overrides ---------------------------------------------
+
+    def _admit(self) -> bool:
+        admitted = False
+        free = [i for i, s in self._table.items() if s is None]
+        batch = []
+        while len(batch) < len(free):
+            try:
+                batch.append(self._pending.get_nowait())
+            except queue.Empty:
+                break
+        if not batch:
+            return False
+        groups: dict[int, list] = {}
+        for req in batch:
+            bucket = next(b for b in self.buckets if b >= len(req[0]))
+            groups.setdefault(bucket, []).append(req)
+        for bucket, reqs in groups.items():
+            while reqs:
+                R = 1
+                while R * 2 <= len(reqs) and R * 2 <= self.slots:
+                    R *= 2
+                group, reqs = reqs[:R], reqs[R:]
+                slots_v = [free.pop() for _ in group]
+                prompts_np = np.full((R, bucket), self.pad_id, np.int32)
+                lens = np.empty((R,), np.int32)
+                for r, (prompt, *_rest) in enumerate(group):
+                    prompts_np[r, :len(prompt)] = prompt
+                    lens[r] = len(prompt)
+                (toks, self._k, self._v, self._dk, self._dv, self._dtok,
+                 self._dpos) = self._prefill_fn(bucket, R)(
+                    self.params, self.draft_params, prompts_np, lens,
+                    np.asarray(slots_v, np.int32),
+                    self._k, self._v, self._dk, self._dv,
+                    self._dtok, self._dpos)
+                self.stats["prefills"] += 1
+                for r, (prompt, max_new, _temp, eos_id, _tk, _tp,
+                        handle) in enumerate(group):
+                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
+                               pos=len(prompt), temperature=0.0,
+                               eos_id=eos_id, base_len=len(prompt))
+                    with self._lock:
+                        self._table[slots_v[r]] = st
+                    if max_new == 1:
+                        st.emit(int(toks[r]))
+                        st.fresh = False
+                        self._finish_if_done(slots_v[r], st)
+                admitted = True
+        return admitted
+
+    def _dispatch_chunk(self) -> None:
+        snap = {i: s for i, s in self._table.items() if s is not None}
+        (out, counts, self._dtok, self._dpos, self._k, self._v,
+         self._dk, self._dv) = self._spec_round_fn()(
+            self.params, self.draft_params, self._dtok, self._dpos,
+            self._k, self._v, self._dk, self._dv)
+        for st in snap.values():
+            st.dispatched += 1
+        out.copy_to_host_async()
+        counts.copy_to_host_async()
+        self._outstanding.append((snap, (out, counts)))
+        self.stats["decode_chunks"] += 1
+
+    def _process_oldest(self) -> None:
+        snap, (out, counts) = self._outstanding.popleft()
+        out = np.asarray(out)        # (S, n_spec+2); col 0 = input token
+        counts = np.asarray(counts)  # (S,) valid NEW tokens this round
+        for i, st in snap.items():
+            if self._table.get(i) is not st:
+                continue
+            start = 0 if st.fresh else 1
+            st.fresh = False
+            st.pos += int(counts[i])
+            self.stats["accepted_tokens"] = (
+                self.stats.get("accepted_tokens", 0) + int(counts[i]) - 1)
+            for j in range(start, 1 + int(counts[i])):
+                st.emit(int(out[i, j]))
+                if self._finish_if_done(i, st):
+                    break
