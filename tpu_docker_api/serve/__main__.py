@@ -85,6 +85,16 @@ def main(argv: list[str] | None = None) -> None:
                         "engine; llama/moe single-device only)")
     p.add_argument("--chunk", type=int, default=8,
                    help="decode steps per slot-engine dispatch")
+    p.add_argument("--draft-preset", default="",
+                   help="serve speculatively: a (smaller) llama preset "
+                        "as the draft model. Greedy-only; pays at small "
+                        "batch/low concurrency (perf-notes: at 8 busy "
+                        "streams plain batching wins)")
+    p.add_argument("--draft-ckpt", default="",
+                   help="orbax checkpoint for the draft ('' = random "
+                        "init — mechanism smoke only)")
+    p.add_argument("--n-spec", type=int, default=4,
+                   help="draft tokens proposed per speculative round")
     args = p.parse_args(argv)
 
     from tpu_docker_api.workload.jaxenv import bootstrap_jax
@@ -148,14 +158,42 @@ def main(argv: list[str] | None = None) -> None:
             and (not multi or tp_only)):
         from tpu_docker_api.infer.slots import SlotEngine
 
-        slot_engine = SlotEngine(
-            cfg, params, slots=args.slots, max_seq=max_seq,
-            chunk=args.chunk,
-            mesh=mesh if multi else None,
-            # shed load once the queue is 8x the slot count deep — beyond
-            # that, added requests only buy latency, not throughput
-            max_pending=args.slots * 8,
-            seed=int.from_bytes(os.urandom(4), "little"))
+        if args.draft_preset:
+            # speculative serving: greedy-only, single-device — the
+            # small-batch latency mode (measured trade in perf-notes)
+            from tpu_docker_api.infer.slots import SpeculativeSlotEngine
+
+            if family != "llama" or multi:
+                raise SystemExit(
+                    "--draft-preset requires a llama preset on a single "
+                    "device")
+            _, draft_cfg = resolve_preset(args.draft_preset)
+            if args.draft_ckpt:
+                from tpu_docker_api.train.checkpoint import resume_or_init
+
+                dstate, _, dmgr = resume_or_init(
+                    args.draft_ckpt, draft_cfg, mesh, jax.random.PRNGKey(0))
+                draft_params = dstate.params
+                dmgr.close()
+                del dstate
+            else:
+                dinit, _, _ = model_fns(draft_cfg)
+                draft_params = dinit(draft_cfg, jax.random.PRNGKey(0))
+            slot_engine = SpeculativeSlotEngine(
+                cfg, params, draft_cfg=draft_cfg,
+                draft_params=draft_params, n_spec=args.n_spec,
+                slots=args.slots, max_seq=max_seq,
+                max_pending=args.slots * 8)
+        else:
+            slot_engine = SlotEngine(
+                cfg, params, slots=args.slots, max_seq=max_seq,
+                chunk=args.chunk,
+                mesh=mesh if multi else None,
+                # shed load once the queue is 8x the slot count deep —
+                # beyond that, added requests only buy latency, not
+                # throughput
+                max_pending=args.slots * 8,
+                seed=int.from_bytes(os.urandom(4), "little"))
         # compile the shared decode chunk before binding the port: a
         # mid-service compile on the engine thread stalls every active
         # slot, and /healthz must not report ok before the program
@@ -256,6 +294,9 @@ def main(argv: list[str] | None = None) -> None:
                         "chunk": slot_engine.chunk,
                         **slot_engine.stats,
                     }
+                    if hasattr(slot_engine, "n_spec"):
+                        payload["slotEngine"]["speculative"] = True
+                        payload["slotEngine"]["nSpec"] = slot_engine.n_spec
                     if slot_engine.dead:
                         # degraded must be visible at the HTTP level —
                         # orchestrator health checks key on the status
@@ -313,9 +354,15 @@ def main(argv: list[str] | None = None) -> None:
                     raise ValueError("stream must be a JSON boolean")
 
                 # a dead engine (device error on its thread) falls back
-                # to the legacy path instead of 500ing forever
+                # to the legacy path instead of 500ing forever; a
+                # SPECULATIVE engine is greedy-only, so sampled requests
+                # fall back too rather than 400
                 slot_ok = (slot_engine is not None and not is_encdec
                            and not slot_engine.dead)
+                if (slot_ok and hasattr(slot_engine, "n_spec")
+                        and (temperature != 0.0 or top_k != 0
+                             or top_p != 1.0)):
+                    slot_ok = False
                 if do_stream and not slot_ok:
                     raise ValueError(
                         "stream requires the slot engine path (not "
