@@ -406,7 +406,7 @@ def check_speculative_trained() -> bool:
     n8 = 96
 
     def run_engine(eng):
-        eng.warmup(buckets=(64,))
+        eng.warmup(buckets=(64,), rows=(1, 8))
         times, outs = [], None
         for _ in range(2):
             t0 = _time.perf_counter()

@@ -542,8 +542,11 @@ class TestSpeculativeSlots:
         for p, h in zip(prompts, handles):
             assert h.result(0)["tokens"] == isolated_greedy(
                 cfg, params, p, 12)
-        # acceptance ~1: far fewer rounds than tokens
-        assert eng.stats["decode_chunks"] * (eng.n_spec + 1) >= 11
+        # acceptance ~1 must PERSIST across fully-accepted rounds (a
+        # draft-cache hole at the last proposal's position would collapse
+        # it): 11 new tokens at n_spec+1=4/round = 3 real rounds, plus at
+        # most pipeline+1 lag rounds at the tail
+        assert eng.stats["decode_chunks"] <= 3 + eng.pipeline + 1
         assert eng.stats["accepted_tokens"] > 0
 
     def test_garbage_draft_still_token_exact(self):

@@ -91,7 +91,7 @@ def bench_concurrent_serving(
     # decode timed together (that's what a client pool experiences)
     eng = SlotEngine(cfg, params, slots=streams, max_seq=max_seq,
                      chunk=chunk)
-    eng.warmup()
+    eng.warmup(rows=(1, streams))  # the burst admits as one R=streams group
     slot_times = []
     for _ in range(reps):
         t0 = time.perf_counter()

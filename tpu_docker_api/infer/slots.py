@@ -313,8 +313,11 @@ class SlotEngine:
             b for b in (128, 256, 512, 1024, 2048, 4096, 8192)
             if b < self.max_seq)
         # aggregate counters for /healthz-style introspection
+        # ALL keys pre-seeded: /healthz **-unpacks this dict from other
+        # threads, and inserting a key mid-iteration raises RuntimeError
         self.stats = {"completed": 0, "decode_chunks": 0, "prefills": 0,
-                      "wasted_steps": 0, "emitted_tokens": 0}
+                      "wasted_steps": 0, "emitted_tokens": 0,
+                      "bucketed_chunks": 0, "accepted_tokens": 0}
 
     # ---- compiled programs -------------------------------------------------
 
@@ -447,27 +450,32 @@ class SlotEngine:
                 return b
         return None
 
-    def warmup(self, buckets: tuple[int, ...] | None = None) -> None:
+    def warmup(self, buckets: tuple[int, ...] | None = None,
+               rows: tuple[int, ...] = (1,)) -> None:
         """Actually compile the decode chunk and the given (default: all)
         prefill buckets by running them on dummy data — ``jax.jit`` alone
         compiles nothing until the first call, and a mid-service compile
         on the engine thread stalls every active slot for its duration.
         Pass ``buckets=()`` to warm only the decode chunk (the program
-        every request shares; per-bucket prefill compiles then amortize
-        one stall per bucket size ever). Call BEFORE :meth:`start` — this
-        runs dispatches on the caller's thread and scribbles garbage into
-        the (empty) cache, which admission later overwrites."""
+        every request shares). ``rows`` warms the batched-admission
+        prefill variants too — a same-bucket burst of N requests runs a
+        power-of-two row-batched program per (bucket, R) pair, each a
+        one-time mid-service stall if cold. Call BEFORE :meth:`start` —
+        this runs dispatches on the caller's thread and scribbles
+        garbage into the (empty) cache, which admission overwrites."""
         if self._thread is not None:
             raise RuntimeError("warmup must run before start()")
         for b in (self.buckets if buckets is None else buckets):
-            (_, self._k, self._v, self._dtok, self._dpos, self._dtemp,
-             self._dtopk, self._dtopp) = self._prefill_fn(b)(
-                self.params, np.zeros((1, b), np.int32),
-                np.ones((1,), np.int32), np.zeros((1,), np.int32),
-                np.zeros((1,), np.float32), np.zeros((1,), np.int32),
-                np.ones((1,), np.float32), np.uint32(0),
-                self._k, self._v, self._dtok, self._dpos, self._dtemp,
-                self._dtopk, self._dtopp)
+            for R in sorted({min(r, self.slots) for r in rows}):
+                (_, self._k, self._v, self._dtok, self._dpos, self._dtemp,
+                 self._dtopk, self._dtopp) = self._prefill_fn(b, R)(
+                    self.params, np.zeros((R, b), np.int32),
+                    np.ones((R,), np.int32),
+                    np.arange(R, dtype=np.int32),
+                    np.zeros((R,), np.float32), np.zeros((R,), np.int32),
+                    np.ones((R,), np.float32), np.uint32(0),
+                    self._k, self._v, self._dtok, self._dpos, self._dtemp,
+                    self._dtopk, self._dtopp)
         _, self._dtok, self._dpos, self._k, self._v = self._decode()(
             self.params, np.uint32(0), self._dtok, self._dpos, self._dtemp,
             self._dtopk, self._dtopp, self._k, self._v)
@@ -549,6 +557,23 @@ class SlotEngine:
         return np.uint32((self._seed * 1000003 + self._dispatches)
                          % (2 ** 31))
 
+    def _prefill_dispatch(self, bucket, R, prompts_np, lens, slots_v,
+                          temps, topks, topps):
+        """The engine-specific half of admission: ONE prefill dispatch
+        for an R-row same-bucket group (updates the per-slot device
+        state itself). Returns the device vector of first tokens.
+        Overridden by :class:`SpeculativeSlotEngine` (which also fills
+        the draft cache); the grouping/bookkeeping loop in ``_admit``
+        is shared."""
+        (toks, self._k, self._v, self._dtok, self._dpos,
+         self._dtemp, self._dtopk,
+         self._dtopp) = self._prefill_fn(bucket, R)(
+            self.params, prompts_np, lens, slots_v, temps, topks, topps,
+            self._next_seed(),
+            self._k, self._v, self._dtok, self._dpos,
+            self._dtemp, self._dtopk, self._dtopp)
+        return toks
+
     def _admit(self) -> bool:
         """Move pending requests into free slots. Same-bucket requests
         admit as power-of-two row batches through ONE prefill dispatch
@@ -585,14 +610,9 @@ class SlotEngine:
                     prompts_np[r, :len(prompt)] = prompt
                     lens[r] = len(prompt)
                     temps[r], topks[r], topps[r] = temp, tk, tp
-                (toks, self._k, self._v, self._dtok, self._dpos,
-                 self._dtemp, self._dtopk,
-                 self._dtopp) = self._prefill_fn(bucket, R)(
-                    self.params, prompts_np, lens,
-                    np.asarray(slots_v, np.int32), temps, topks, topps,
-                    self._next_seed(),
-                    self._k, self._v, self._dtok, self._dpos,
-                    self._dtemp, self._dtopk, self._dtopp)
+                toks = self._prefill_dispatch(
+                    bucket, R, prompts_np, lens,
+                    np.asarray(slots_v, np.int32), temps, topks, topps)
                 self.stats["prefills"] += 1
                 for r, (prompt, max_new, temp, eos_id, tk, tp,
                         handle) in enumerate(group):
@@ -644,8 +664,7 @@ class SlotEngine:
         self._outstanding.append((snap, out))
         self.stats["decode_chunks"] += 1
         if limit is not None:
-            self.stats["bucketed_chunks"] = (
-                self.stats.get("bucketed_chunks", 0) + 1)
+            self.stats["bucketed_chunks"] += 1
 
     def _process_oldest(self) -> None:
         """Host-side half of one chunk: fetch its tokens (the only sync in
@@ -674,12 +693,14 @@ class SlotEngine:
         work was done. Tests drive this directly; the background thread
         loops it."""
         did = False
-        # a waiting request with no free slot: drain outstanding chunks
-        # first — completions hide in them, and admission latency beats
-        # pipeline depth
+        # a waiting request with no free slot: process ONE outstanding
+        # chunk (completions hide in them, and admission latency beats
+        # pipeline depth) — but only one per step, or sustained load
+        # would collapse the pipeline to fully-synchronous exactly when
+        # it matters most (each chunk paying the ~100 ms fetch serially)
         if not self._pending.empty() and not any(
                 s is None for s in self._table.values()):
-            while self._outstanding:
+            if self._outstanding:
                 self._process_oldest()
                 did = True
         did = self._admit() or did
@@ -888,9 +909,18 @@ class SpeculativeSlotEngine(SlotEngine):
                 nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
                 return (nxt, pos + 1, dk, dv), nxt
 
-            (_, _, dk_all, dv_all), props = lax.scan(
+            (last_prop, _, dk_all, dv_all), props = lax.scan(
                 dbody, (dtok, dpos, dk_all, dv_all), None, length=K)
             props = props.T  # (S, K)
+            # feed the FINAL proposal once more so its k/v lands in the
+            # draft cache at dpos+K: on a fully-accepted round the next
+            # round starts PAST that position and would never rewrite it,
+            # leaving a permanent garbage hole the draft attends forever
+            # (acceptance collapses even for a perfect draft). On partial
+            # acceptance this write sits at a future position and is
+            # rewritten just-in-time like everything else.
+            _, dk_all, dv_all = dfwd(dparams, last_prop[:, None], dcfg,
+                                     dk_all, dv_all, dpos + K, None)
 
             # 2. target verifies all K+1 positions in ONE forward
             seq_in = jnp.concatenate([dtok[:, None], props], axis=1)
@@ -917,17 +947,18 @@ class SpeculativeSlotEngine(SlotEngine):
         self._decode_fns["spec"] = fn
         return fn
 
-    def warmup(self, buckets=None):
+    def warmup(self, buckets=None, rows=(1,)):
         if self._thread is not None:
             raise RuntimeError("warmup must run before start()")
         for b in (self.buckets if buckets is None else buckets):
-            (_, self._k, self._v, self._dk, self._dv, self._dtok,
-             self._dpos) = self._prefill_fn(b)(
-                self.params, self.draft_params,
-                np.zeros((1, b), np.int32), np.ones((1,), np.int32),
-                np.zeros((1,), np.int32),
-                self._k, self._v, self._dk, self._dv,
-                self._dtok, self._dpos)
+            for R in sorted({min(r, self.slots) for r in rows}):
+                (_, self._k, self._v, self._dk, self._dv, self._dtok,
+                 self._dpos) = self._prefill_fn(b, R)(
+                    self.params, self.draft_params,
+                    np.zeros((R, b), np.int32), np.ones((R,), np.int32),
+                    np.arange(R, dtype=np.int32),
+                    self._k, self._v, self._dk, self._dv,
+                    self._dtok, self._dpos)
         (_, _, self._dtok, self._dpos, self._k, self._v, self._dk,
          self._dv) = self._spec_round_fn()(
             self.params, self.draft_params, self._dtok, self._dpos,
@@ -935,53 +966,17 @@ class SpeculativeSlotEngine(SlotEngine):
 
     # ---- engine loop overrides ---------------------------------------------
 
-    def _admit(self) -> bool:
-        admitted = False
-        free = [i for i, s in self._table.items() if s is None]
-        batch = []
-        while len(batch) < len(free):
-            try:
-                batch.append(self._pending.get_nowait())
-            except queue.Empty:
-                break
-        if not batch:
-            return False
-        groups: dict[int, list] = {}
-        for req in batch:
-            bucket = next(b for b in self.buckets if b >= len(req[0]))
-            groups.setdefault(bucket, []).append(req)
-        for bucket, reqs in groups.items():
-            while reqs:
-                R = 1
-                while R * 2 <= len(reqs) and R * 2 <= self.slots:
-                    R *= 2
-                group, reqs = reqs[:R], reqs[R:]
-                slots_v = [free.pop() for _ in group]
-                prompts_np = np.full((R, bucket), self.pad_id, np.int32)
-                lens = np.empty((R,), np.int32)
-                for r, (prompt, *_rest) in enumerate(group):
-                    prompts_np[r, :len(prompt)] = prompt
-                    lens[r] = len(prompt)
-                (toks, self._k, self._v, self._dk, self._dv, self._dtok,
-                 self._dpos) = self._prefill_fn(bucket, R)(
-                    self.params, self.draft_params, prompts_np, lens,
-                    np.asarray(slots_v, np.int32),
-                    self._k, self._v, self._dk, self._dv,
-                    self._dtok, self._dpos)
-                self.stats["prefills"] += 1
-                for r, (prompt, max_new, _temp, eos_id, _tk, _tp,
-                        handle) in enumerate(group):
-                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
-                               pos=len(prompt), temperature=0.0,
-                               eos_id=eos_id, base_len=len(prompt))
-                    with self._lock:
-                        self._table[slots_v[r]] = st
-                    if max_new == 1:
-                        st.emit(int(toks[r]))
-                        st.fresh = False
-                        self._finish_if_done(slots_v[r], st)
-                admitted = True
-        return admitted
+    def _prefill_dispatch(self, bucket, R, prompts_np, lens, slots_v,
+                          temps, topks, topps):
+        # speculative admission is greedy-only (submit enforces it), so
+        # temps/topks/topps are ignored; the shared _admit loop in the
+        # base class does all grouping/bookkeeping
+        (toks, self._k, self._v, self._dk, self._dv, self._dtok,
+         self._dpos) = self._prefill_fn(bucket, R)(
+            self.params, self.draft_params, prompts_np, lens, slots_v,
+            self._k, self._v, self._dk, self._dv,
+            self._dtok, self._dpos)
+        return toks
 
     def _dispatch_chunk(self) -> None:
         snap = {i: s for i, s in self._table.items() if s is not None}
@@ -1006,8 +1001,7 @@ class SpeculativeSlotEngine(SlotEngine):
             start = 0 if st.fresh else 1
             st.fresh = False
             st.pos += int(counts[i])
-            self.stats["accepted_tokens"] = (
-                self.stats.get("accepted_tokens", 0) + int(counts[i]) - 1)
+            self.stats["accepted_tokens"] += int(counts[i]) - 1
             for j in range(start, 1 + int(counts[i])):
                 st.emit(int(out[i, j]))
                 if self._finish_if_done(i, st):
