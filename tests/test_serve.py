@@ -411,16 +411,17 @@ class TestFamilyPresets:
                          "temperature": 0.0}, timeout=180)
             assert len(out["tokens"][0]) == 4
             assert "lengths" not in out  # seq2seq path has no eos contract
-            # sampling is rejected loudly on the greedy-only path
-            import urllib.error
-            try:
-                _post(port, "/generate",
-                      {"srcTokens": [[1, 2]], "maxNewTokens": 2,
-                       "temperature": 0.7}, timeout=60)
-                raise AssertionError("expected a 400")
-            except urllib.error.HTTPError as e:
-                err = json.loads(e.read())
-                assert "greedy-only" in err["error"]
+            # sampling rides the same path (round-3 closes the last
+            # greedy-only line item): top_k=1 is exact greedy, and a
+            # free temperature draw stays in-vocab
+            out_k1 = _post(port, "/generate",
+                           {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
+                            "temperature": 0.7, "topK": 1}, timeout=60)
+            assert out_k1["tokens"] == out["tokens"]
+            out_t = _post(port, "/generate",
+                          {"srcTokens": [[1, 2]], "maxNewTokens": 2,
+                           "temperature": 0.7}, timeout=60)
+            assert all(0 <= t < 256 for t in out_t["tokens"][0])
             # eosId switches the seq2seq response to the lengths contract
             eos = out["tokens"][0][1]
             out2 = _post(port, "/generate",

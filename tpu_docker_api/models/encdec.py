@@ -393,16 +393,31 @@ def encdec_generate(
     bos_id: int = 0,
     eos_id: int | None = None,
     pad_id: int = 0,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    rng: jax.Array | None = None,
 ) -> jnp.ndarray | dict:
-    """Greedy seq2seq generation: encode once, then a KV-cached decoder
+    """Seq2seq generation: encode once, then a KV-cached decoder
     loop — self-attention against a (Ld, b, T, kvh, hd) cache written one
     position per step, cross-attention against the precomputed encoder
     k/v. Returns (b, max_new_tokens) int32; with ``eos_id`` set, returns
     {"tokens", "lengths"} with the same truncate-at-eos-inclusive
     contract as the llama engine (positions after eos hold ``pad_id``).
-    Jit-compatible (one compile per (b, S, max_new_tokens) shape)."""
+
+    Sampling shares ``infer.sampling.make_sampler`` with the llama
+    engine: ``temperature == 0`` is greedy argmax (default);
+    ``temperature > 0`` draws from the temperature-scaled, optionally
+    top-k/top-p-filtered distribution, one ``rng``-derived key per step.
+    Sampler knobs are Python-level (baked into the compiled program);
+    ``rng`` is traced. Jit-compatible (one compile per
+    (b, S, max_new_tokens, sampler-config) shape)."""
+    from tpu_docker_api.infer.sampling import make_sampler
     from tpu_docker_api.ops.attention import dense_attention
 
+    sampler = make_sampler(temperature, top_k=top_k, top_p=top_p)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     b, _ = src.shape
     d, hd = cfg.dim, cfg.head_dim
     Ld, n_kv = cfg.dec_layers, cfg.n_kv_heads
@@ -413,7 +428,7 @@ def encdec_generate(
     k_cache = jnp.zeros((Ld, b, max_new_tokens, n_kv, hd), cfg.dtype)
     v_cache = jnp.zeros_like(k_cache)
 
-    def dec_step(carry, _):
+    def dec_step(carry, step_key):
         tok, k_cache, v_cache, step = carry
         x = embed_lookup(params["embed"]["tokens"], tok[:, None], None)
 
@@ -455,12 +470,13 @@ def encdec_generate(
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         logits = linear(x.astype(cfg.dtype), params["lm_head"],
                         out_dtype=jnp.float32)
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        nxt = sampler(logits[:, 0], step_key)
         return (nxt, k_cache, v_cache, step + 1), nxt
 
     start = jnp.full((b,), bos_id, jnp.int32)
+    step_keys = jax.random.split(rng, max_new_tokens)
     _, toks = lax.scan(dec_step, (start, k_cache, v_cache, jnp.int32(0)),
-                       None, length=max_new_tokens)
+                       step_keys)
     toks = toks.transpose(1, 0)  # (b, max_new_tokens)
     if eos_id is None:
         return toks

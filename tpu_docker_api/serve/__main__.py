@@ -19,10 +19,11 @@ HTTP surface (stdlib server, same envelope as the control plane):
 
 Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
 through the same KV-cached engine and body; ``--preset encdec:NAME``
-serves seq2seq — the body uses ``srcTokens`` instead of ``tokens`` and
-decoding is greedy-only (temperature 0); with ``eosId`` the response
-carries ``lengths`` (truncate-at-eos), without it no lengths are
-reported. ViT has no generative serving path.
+serves seq2seq — the body uses ``srcTokens`` instead of ``tokens``, and
+temperature/topK/topP sample through the same ``make_sampler`` semantics
+as the llama engine; with ``eosId`` the response carries ``lengths``
+(truncate-at-eos), without it no lengths are reported. ViT has no
+generative serving path.
 
 Design notes, TPU-first:
 
@@ -111,7 +112,7 @@ def main(argv: list[str] | None = None) -> None:
 
     # family-prefixed presets, one parser shared with the trainer CLI:
     # moe:NAME serves through the same KV-cached engine; encdec:NAME
-    # switches /generate to the seq2seq path (srcTokens → greedy decode)
+    # switches /generate to the seq2seq path (srcTokens → sampled decode)
     family, cfg = resolve_preset(args.preset)
     if family == "vit":
         raise SystemExit("vit presets have no generative serving path")
@@ -213,16 +214,25 @@ def main(argv: list[str] | None = None) -> None:
 
     def get_fn(max_new: int, temperature: float, top_k: int, top_p: float,
                eos_id: int | None = None):
-        key = (max_new, round(temperature, 3), top_k, round(top_p, 3),
-               eos_id)
+        # rounding 0 < top_p < 5e-4 to exactly 0.0 would turn a valid
+        # "≡ greedy" request into a make_sampler rejection — floor it at
+        # the rounding resolution instead (semantically identical: both
+        # keep only the argmax token)
+        top_p_r = round(top_p, 3)
+        if top_p > 0 and top_p_r == 0.0:
+            top_p_r = 0.001
+        temp_r = round(temperature, 3)
+        if temp_r == 0.0:
+            # greedy ignores the filters (make_sampler docstring) — don't
+            # let assorted topK/topP burn an identical compiled program
+            # + LRU slot each
+            top_k, top_p_r = 0, 1.0
+        key = (max_new, temp_r, top_k, top_p_r, eos_id)
         with fn_lock:
             if key in fns:
                 fns.move_to_end(key)
                 return fns[key]
             if is_encdec:
-                if key[1] != 0.0 or key[2] != 0 or key[3] != 1.0:
-                    raise ValueError(
-                        "encdec serving is greedy-only (temperature 0)")
                 if key[0] > max_seq:
                     # the llama path's capacity check lives in the engine;
                     # this is the seq2seq analog — an unbounded client
@@ -230,16 +240,28 @@ def main(argv: list[str] | None = None) -> None:
                     # (Ld, b, key[0], kvh, hd) cache
                     raise ValueError(
                         f"maxNewTokens {key[0]} exceeds capacity {max_seq}")
+                from tpu_docker_api.infer.sampling import make_sampler
                 from tpu_docker_api.models.encdec import encdec_generate
 
+                # temperature/top-k/top-p ride encdec_generate's sampler
+                # (shared make_sampler semantics with the llama engine);
+                # sampler knobs are static per compiled fn, rng is traced.
+                # Validate them EAGERLY (the llama branch gets this from
+                # make_generate_fn): a deferred trace-time ValueError
+                # would cache a poisoned fn in the LRU and evict a
+                # compiled program per bad request
+                make_sampler(key[1], top_k=key[2], top_p=key[3])
                 if eos_id is not None:
-                    fn = jax.jit(lambda p, src, _rng: encdec_generate(
+                    fn = jax.jit(lambda p, src, rng: encdec_generate(
                         p, src, cfg, max_new_tokens=key[0],
-                        eos_id=eos_id))
+                        eos_id=eos_id, temperature=key[1], top_k=key[2],
+                        top_p=key[3], rng=rng))
                 else:
-                    fn = jax.jit(lambda p, src, _rng: {
-                        "tokens": encdec_generate(p, src, cfg,
-                                                  max_new_tokens=key[0]),
+                    fn = jax.jit(lambda p, src, rng: {
+                        "tokens": encdec_generate(
+                            p, src, cfg, max_new_tokens=key[0],
+                            temperature=key[1], top_k=key[2],
+                            top_p=key[3], rng=rng),
                     })
             else:
                 fn = make_generate_fn(
@@ -346,6 +368,13 @@ def main(argv: list[str] | None = None) -> None:
                         f"maxNewTokens must be >= 1, got {max_new}")
                 temperature = req_float("temperature", 0.0)
                 top_k = req_int("topK", 0)
+                if top_k > cfg.vocab_size:
+                    # lax.top_k would reject this at TRACE time — after
+                    # the jitted fn is already cached (a poisoned-LRU
+                    # slot per distinct bad value)
+                    raise ValueError(
+                        f"topK must be <= vocab size {cfg.vocab_size}, "
+                        f"got {top_k}")
                 top_p = req_float("topP", 1.0)
                 eos_id = (req_int("eosId", 0)
                           if "eosId" in req else None)
