@@ -391,6 +391,20 @@ def measure_serving() -> dict:
         # point). Costs a recompile per point; reliability wins.
         jax.clear_caches()
         gc.collect()
+    # prefix caching (round 3): shared-header workload, suffix-only
+    # prefill vs full prefill through the same slot engine
+    try:
+        from tpu_docker_api.infer.servebench import bench_prefix_serving
+
+        r = bench_prefix_serving(preset="llama3-1b", requests=16,
+                                 prefix_len=960, suffix_len=16, new_tok=8,
+                                 max_seq=1024, slots=8, chunk=8, reps=2)
+        r.pop("ok")
+        out["llama3_1b_prefix_cache"] = r
+    except Exception as e:
+        out["llama3_1b_prefix_cache"] = {"error": str(e)[:160]}
+    jax.clear_caches()
+    gc.collect()
     return out
 
 

@@ -551,6 +551,22 @@ def check_slot_serving() -> bool:
                  r.pop("ok") and r["speedup"] >= 2.0, **r)
 
 
+def check_prefix_serving() -> bool:
+    """Prefix caching (round 3): a 960-token shared header with 16-token
+    suffixes and 8-token generations — the prefill-bound workload shape.
+    Captured: llama3-1b 221 → 414 aggregate tok/s (1.87×); interactive
+    8B-int8 at 448-prefix shapes measured 1.50× (202.6 → 303.7). Gate
+    1.3: well under the captured 1.87 but above tunnel variance; the
+    hermetic exactness proof is tests/test_slots.py TestPrefixCache."""
+    from tpu_docker_api.infer.servebench import bench_prefix_serving
+
+    r = bench_prefix_serving(preset="llama3-1b", requests=16,
+                             prefix_len=960, suffix_len=16, new_tok=8,
+                             max_seq=1024, slots=8, chunk=8, reps=2)
+    return _emit("prefix_cache_serving",
+                 r.pop("ok") and r["speedup"] >= 1.3, **r)
+
+
 def check_decode_roofline() -> bool:
     """llama3-8b int8 decode-only latency vs the weight-streaming HBM
     roof (VERDICT r2 item 2). 2026-07 v5e: 20.4 ms/tok at batch 64 =
@@ -589,6 +605,7 @@ def main() -> int:
         checks.append(check_speculative_trained)
         checks.append(check_8b_inference)
         checks.append(check_slot_serving)
+        checks.append(check_prefix_serving)
         checks.append(check_decode_roofline)
     ok = True
     for check in checks:

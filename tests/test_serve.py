@@ -95,6 +95,42 @@ class TestServe:
                 _post(port, "/generate", payload)
             assert e.value.code == 400
 
+    def test_prefix_register_generate_unregister(self, server):
+        port, _ = server
+        prefix = [9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4]
+        prompt = prefix + [11, 12]
+        base = _post(port, "/generate",
+                     {"tokens": [prompt], "maxNewTokens": 6})
+        reg = _post(port, "/prefixes", {"tokens": prefix})
+        assert reg["length"] == len(prefix)
+        assert _get(port, "/prefixes")["prefixes"] == [
+            {"id": reg["prefixId"], "length": len(prefix)}]
+        # suffix-only prefill must be token-exact vs the full prefill
+        hit = _post(port, "/generate",
+                    {"tokens": [prompt], "maxNewTokens": 6})
+        assert hit["tokens"] == base["tokens"]
+        assert _get(port, "/healthz")["slotEngine"]["prefix_hits"] >= 1
+        # register is idempotent; bad bodies 400
+        assert _post(port, "/prefixes",
+                     {"tokens": prefix})["prefixId"] == reg["prefixId"]
+        for bad in ({}, {"tokens": []}, {"tokens": [99999]},
+                    {"tokens": "abc"}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, "/prefixes", bad)
+            assert e.value.code == 400
+        # DELETE removes it; second delete reports removed: false
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/prefixes/{reg['prefixId']}",
+            method="DELETE")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["removed"] is True
+        with urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{port}/prefixes/{reg['prefixId']}",
+                    method="DELETE"), timeout=30) as r:
+            assert json.loads(r.read())["removed"] is False
+        assert _get(port, "/prefixes")["prefixes"] == []
+
     def test_unknown_route_404(self, server):
         port, _ = server
         with pytest.raises(urllib.error.HTTPError) as e:

@@ -509,6 +509,129 @@ class TestCacheIsolation:
             cfg, params, [2, 7], 8)
 
 
+class TestPrefixCache:
+    """Prefix caching: suffix-only prefill against a registered prefix
+    must be token-exact vs a full prefill of the same prompt (the prefix
+    k/v + traced start_pos reproduce the identical math)."""
+
+    PREFIX = [7, 3, 9, 1, 4, 4, 2, 8, 6, 5] * 4  # 40 tokens → bucket 64
+
+    def test_mixed_prefix_and_full_admissions_token_exact(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=4, max_seq=MAX_SEQ, chunk=4)
+        pid = eng.register_prefix(self.PREFIX)
+        assert eng.prefixes() == [{"id": pid, "length": 40}]
+        prompts = [self.PREFIX + [11, 12], self.PREFIX + [13],
+                   [1, 2, 3], self.PREFIX + [11, 12]]
+        handles = [eng.submit(p, 8) for p in prompts]
+        for _ in range(200):
+            if all(h.done() for h in handles):
+                break
+            eng.step()
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 8)
+        assert eng.stats["prefix_hits"] == 3  # [1,2,3] went the full path
+
+    def test_longest_match_and_dedup(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        short = eng.register_prefix(self.PREFIX[:8])
+        long = eng.register_prefix(self.PREFIX)
+        assert eng.register_prefix(self.PREFIX) == long  # dedup
+        prompt = self.PREFIX + [21]
+        assert eng._resolve_prefix(prompt).pid == long
+        h = eng.submit(prompt, 6)
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 6)
+        assert eng.unregister_prefix(long)
+        # now the SHORT prefix is the longest (still strict) match
+        assert eng._resolve_prefix(prompt).pid == short
+
+    def test_prompt_equal_to_prefix_takes_full_path(self, setup):
+        """A match must be STRICT (>= 1 suffix token): prompt == prefix
+        runs the ordinary full prefill and stays exact."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        eng.register_prefix(self.PREFIX)
+        h = eng.submit(list(self.PREFIX), 6)
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, self.PREFIX, 6)
+        assert eng.stats["prefix_hits"] == 0
+
+    def test_prompt_beyond_largest_bucket_served_via_prefix(self, setup):
+        """A prefix can cover the overflow of a prompt the bucket list
+        alone could not serve — and unregistering it mid-flight fails
+        the handle instead of the engine loop."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         buckets=(16, 32))
+        prefix = self.PREFIX[:30]
+        pid = eng.register_prefix(prefix)
+        prompt = prefix + [11, 12, 13]   # 33 > largest bucket 32
+        h = eng.submit(prompt, 6)
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 6)
+        # without the prefix the same prompt is rejected at validate
+        eng2 = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                          buckets=(16, 32))
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            eng2.submit(prompt, 6)
+        # race: queued via the prefix, prefix gone before admission
+        h2 = eng.submit(prompt, 6)
+        eng.unregister_prefix(pid)
+        eng.step()
+        with pytest.raises(ValueError, match="covering prefix is gone"):
+            h2.result(5)
+
+    def test_near_capacity_prefix_clamped_temp_cache_exact(self, setup):
+        """plen + suffix-bucket can nominally overrun max_seq (the temp
+        cache clamps and pad-tail writes drop); real positions must stay
+        exact at the capacity edge."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        prefix = [((i * 7) % 251) + 1 for i in range(90)]  # pbucket 96
+        eng.register_prefix(prefix)
+        prompt = prefix + [11, 12, 13]   # 93 + sbucket 32 > max_seq 96
+        h = eng.submit(prompt, 4)        # 93 + 4 - 1 = 96 = capacity
+        while not h.done():
+            eng.step()
+        assert eng.stats["prefix_hits"] == 1
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 4)
+
+    def test_registry_capacity_and_validation(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         max_prefixes=2)
+        eng.register_prefix([1, 2, 3])
+        eng.register_prefix([4, 5, 6])
+        with pytest.raises(ValueError, match="registry full"):
+            eng.register_prefix([7, 8, 9])
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.register_prefix([])
+        with pytest.raises(ValueError, match="no room"):
+            eng.register_prefix([1] * (MAX_SEQ - 1))
+        assert not eng.unregister_prefix("nope")
+
+    def test_speculative_engine_rejects_prefixes(self):
+        from tpu_docker_api.infer.slots import SpeculativeSlotEngine
+
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        eng = SpeculativeSlotEngine(cfg, params, draft_cfg=cfg,
+                                    draft_params=params, n_spec=2,
+                                    slots=2, max_seq=MAX_SEQ)
+        with pytest.raises(ValueError, match="not supported"):
+            eng.register_prefix([1, 2, 3])
+
+
 class TestSpeculativeSlots:
     """Speculative decoding x continuous batching: greedy verification is
     token-exact vs plain greedy REGARDLESS of draft quality."""

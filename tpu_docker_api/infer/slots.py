@@ -62,6 +62,18 @@ TPU-first shape of the design:
   writes still target the full buffer, and the host derives the bucket
   from dispatch counts so the pipeline lag never under-reads. At 16
   slots × 512 capacity this took 1,396 → 2,095 tok/s on v5e.
+- **Prefix caching**: :meth:`register_prefix` prefills a shared prompt
+  prefix (system prompt, few-shot header) ONCE into a device-resident
+  (layers, pbucket, kv, head_dim) pair; admission auto-matches the
+  longest registered strict prefix of each prompt and runs a
+  suffix-only prefill — the prefix k/v are dropped into the slot row
+  and the suffix forward starts at the traced absolute position
+  ``plen`` (the per-row rope/mask machinery is position-based, so no
+  model change). Prefill cost for an N-token prompt with a P-token
+  cached prefix is O(N−P); prompts longer than the largest prefill
+  bucket become servable when a prefix covers the overflow. Garbage at
+  prefix-pad positions sits strictly at future positions of the slot —
+  the same just-in-time-overwrite argument as bucket padding.
 - **Production edges**: bounded admission queue (``max_pending`` →
   :class:`QueueFull`, HTTP 503), per-request ``eos_id``, token
   streaming (:meth:`Handle.stream`), graceful drain
@@ -200,6 +212,20 @@ class QueueFull(Exception):
     rather than let latency grow unbounded."""
 
 
+@dataclasses.dataclass(frozen=True, eq=False)
+class _Prefix:
+    """A registered prompt prefix with its device-resident KV pair.
+    ``eq=False``: identity semantics — the jax arrays must never be
+    compared elementwise by dict/dedup machinery."""
+
+    pid: str
+    tokens: tuple[int, ...]
+    length: int                # actual token count
+    bucket: int                # padded device length (static shape)
+    k: Any                     # (layers, bucket, n_kv_heads, head_dim)
+    v: Any
+
+
 class SlotEngine:
     """Slot-based continuous-batching engine for the decoder families
     (llama + moe via ``models.cached_forward_fn``).
@@ -230,6 +256,7 @@ class SlotEngine:
         seed: int = 0,
         max_pending: int = 0,
         mesh=None,
+        max_prefixes: int = 8,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -302,6 +329,15 @@ class SlotEngine:
         self._drained = threading.Event()
         self._dead: Exception | None = None
 
+        #: prefix registry: pid → _Prefix. ``_px_lock`` serializes whole
+        #: register/unregister operations (device compute included);
+        #: ``_lock`` guards the dict itself for the engine thread's reads
+        self.max_prefixes = max_prefixes
+        self._prefixes: dict[str, _Prefix] = {}
+        self._px_lock = threading.Lock()
+        self._px_seq = 0
+        self._prefix_fns: dict[int, Any] = {}
+        self._px_prefill_fns: dict[tuple, Any] = {}
         self._prefill_fns: dict[int, Any] = {}
         #: decode programs keyed by kv read limit (None = full buffer).
         #: Decode is bandwidth-bound and reads the whole cache prefix it
@@ -317,7 +353,8 @@ class SlotEngine:
         # threads, and inserting a key mid-iteration raises RuntimeError
         self.stats = {"completed": 0, "decode_chunks": 0, "prefills": 0,
                       "wasted_steps": 0, "emitted_tokens": 0,
-                      "bucketed_chunks": 0, "accepted_tokens": 0}
+                      "bucketed_chunks": 0, "accepted_tokens": 0,
+                      "prefix_hits": 0}
 
     # ---- compiled programs -------------------------------------------------
 
@@ -403,6 +440,84 @@ class SlotEngine:
         self._prefill_fns[(bucket, rows)] = fn
         return fn
 
+    def _prefix_fn(self, bucket: int):
+        """Program that prefills ONE prefix row into a fresh bucket-length
+        cache and returns the (layers, bucket, kv, head_dim) pair — the
+        registration-time half of prefix caching."""
+        fn = self._prefix_fns.get(bucket)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        cache_dtype = self._k.dtype
+
+        def build(params, prompt):  # prompt (1, bucket)
+            shape = (cfg.n_layers, 1, bucket, cfg.n_kv_heads, cfg.head_dim)
+            kc = jnp.zeros(shape, cache_dtype)
+            vc = jnp.zeros(shape, cache_dtype)
+            _, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
+                            self.mesh, last_only=True)
+            return kc[:, 0], vc[:, 0]
+
+        fn = jax.jit(build)
+        self._prefix_fns[bucket] = fn
+        return fn
+
+    def _px_prefill_fn(self, pbucket: int, sbucket: int, rows: int = 1):
+        """Suffix-only batched prefill: the cached prefix k/v land in the
+        row cache first, then the suffix forward runs at the traced
+        absolute position ``plen`` (rope phases and the causal q_offset
+        mask are position-derived, so the math is identical to a full
+        prefill of prefix+suffix — the prefix FLOPs are just skipped).
+        Prefix-pad garbage in (plen, pbucket) is at future positions of
+        every suffix query and is overwritten just-in-time by decode."""
+        fn = self._px_prefill_fns.get((pbucket, sbucket, rows))
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        cache_dtype = self._k.dtype
+
+        tsize = min(pbucket + sbucket, self.max_seq)
+
+        def prefill(params, pk, pv, plen, prompts, actual_lens, slots,
+                    temps, topks, topps, seed, k_all, v_all, dtok, dpos,
+                    dtemp, dtopk, dtopp):
+            # prompts (R, sbucket) = SUFFIX tokens; actual_lens (R,) =
+            # suffix lengths; plen = the prefix's true token count.
+            # The temp cache is clamped to max_seq (a near-capacity
+            # prefix + a rounded-up suffix bucket can nominally overrun
+            # it); start_pos rides as a PER-ROW vector so the cache
+            # writes take the scatter path with mode="drop" — pad-tail
+            # positions past capacity drop silently instead of the
+            # scalar dynamic_update_slice CLAMPING the whole block back
+            # into bounds (which would corrupt real positions).
+            shape = (cfg.n_layers, rows, tsize,
+                     cfg.n_kv_heads, cfg.head_dim)
+            # pbucket <= tsize always: pbucket <= max_seq (registration
+            # bucket list) and pbucket <= pbucket + sbucket
+            kc = jnp.zeros(shape, cache_dtype).at[:, :, :pbucket].set(
+                pk[:, None])
+            vc = jnp.zeros(shape, cache_dtype).at[:, :, :pbucket].set(
+                pv[:, None])
+            starts = jnp.full((rows,), plen, jnp.int32)
+            logits, kc, vc = fwd(params, prompts, cfg, kc, vc, starts,
+                                 self.mesh, last_only=actual_lens - 1)
+            toks = self._sample_filtered(
+                logits[:, 0], temps, topks, topps,
+                jax.random.PRNGKey(seed))
+            k_all = k_all.at[:, slots, :tsize].set(kc)
+            v_all = v_all.at[:, slots, :tsize].set(vc)
+            dtok = dtok.at[slots].set(toks)
+            dpos = dpos.at[slots].set(plen + actual_lens)
+            dtemp = dtemp.at[slots].set(temps)
+            dtopk = dtopk.at[slots].set(topks)
+            dtopp = dtopp.at[slots].set(topps)
+            return toks, k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp
+
+        fn = jax.jit(prefill,
+                     donate_argnums=(11, 12, 13, 14, 15, 16, 17))
+        self._px_prefill_fns[(pbucket, sbucket, rows)] = fn
+        return fn
+
     def _decode(self, kv_limit: int | None = None, filtered: bool = False):
         fn = self._decode_fns.get((kv_limit, filtered))
         if fn is not None:
@@ -480,6 +595,92 @@ class SlotEngine:
             self.params, np.uint32(0), self._dtok, self._dpos, self._dtemp,
             self._dtopk, self._dtopp, self._k, self._v)
 
+    # ---- prefix cache ------------------------------------------------------
+
+    def register_prefix(self, tokens: list[int]) -> str:
+        """Prefill ``tokens`` once and register them as a shared prompt
+        prefix; returns the prefix id. Subsequent submits whose prompt
+        STRICTLY starts with these tokens (at least one suffix token)
+        prefill only the suffix. Registering an already-registered token
+        sequence returns the existing id. Costs one compile per new
+        prefix-bucket size plus one per (pbucket, sbucket, rows) combo at
+        first matched admission — register before :meth:`start` (or
+        accept the one-time mid-service stall)."""
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("prefix must be non-empty")
+        if len(tokens) + 2 > self.max_seq:
+            # a usable prefix needs >= 1 suffix token + >= 1 generated
+            raise ValueError(
+                f"prefix ({len(tokens)}) leaves no room for a suffix and "
+                f"a generated token in cache capacity {self.max_seq}")
+        bucket = next((b for b in self.buckets if b >= len(tokens)), None)
+        if bucket is None:
+            raise ValueError(
+                f"prefix ({len(tokens)}) exceeds the largest prefill "
+                f"bucket ({self.buckets[-1]})")
+        with self._px_lock:
+            key = tuple(tokens)
+            with self._lock:
+                if self._closed:
+                    raise RuntimeError("engine is closed")
+                if self._dead is not None:
+                    raise RuntimeError(f"engine failed: {self._dead!r}")
+                for ent in self._prefixes.values():
+                    if ent.tokens == key:
+                        return ent.pid
+                if len(self._prefixes) >= self.max_prefixes:
+                    raise ValueError(
+                        f"prefix registry full ({self.max_prefixes}) — "
+                        f"unregister one first")
+                self._px_seq += 1
+                pid = f"px-{self._px_seq}"
+            prompt = np.full((1, bucket), self.pad_id, np.int32)
+            prompt[0, :len(tokens)] = tokens
+            k, v = self._prefix_fn(bucket)(self.params, prompt)
+            ent = _Prefix(pid=pid, tokens=key, length=len(tokens),
+                          bucket=bucket, k=k, v=v)
+            with self._lock:
+                self._prefixes[pid] = ent
+            return pid
+
+    def unregister_prefix(self, pid: str) -> bool:
+        with self._px_lock, self._lock:
+            return self._prefixes.pop(pid, None) is not None
+
+    def prefixes(self) -> list[dict]:
+        """Snapshot of the registry for introspection (serve GET)."""
+        with self._lock:
+            return [{"id": p.pid, "length": p.length}
+                    for p in self._prefixes.values()]
+
+    def _resolve_prefix(self, prompt: list[int]) -> _Prefix | None:
+        """Longest registered STRICT prefix of ``prompt`` (identity holds
+        even if unregistered concurrently — the arrays are immutable)."""
+        best = None
+        with self._lock:
+            for ent in self._prefixes.values():
+                if (ent.length < len(prompt)
+                        and (best is None or ent.length > best.length)
+                        and tuple(prompt[:ent.length]) == ent.tokens):
+                    best = ent
+        return best
+
+    def _px_plan(self, prompt: list[int]) -> tuple[_Prefix, int] | None:
+        """(prefix, suffix_bucket) if a registered prefix applies to this
+        prompt. The temp-cache size is clamped to capacity inside the
+        program (pad-tail writes drop), so the only structural limit is
+        that the suffix fits a prefill bucket; absolute capacity
+        (prompt + max_new) is validate()'s job."""
+        ent = self._resolve_prefix(prompt)
+        if ent is None:
+            return None
+        sfx = len(prompt) - ent.length
+        sbucket = next((b for b in self.buckets if b >= sfx), None)
+        if sbucket is None:
+            return None
+        return ent, sbucket
+
     # ---- request API -------------------------------------------------------
 
     def validate(self, prompt: list[int], max_new: int,
@@ -496,10 +697,14 @@ class SlotEngine:
         n = len(prompt)
         if n < 1:
             raise ValueError("prompt must be non-empty")
-        if n > self.buckets[-1]:
+        if n > self.buckets[-1] and self._px_plan(prompt) is None:
+            # a registered prefix covering the overflow makes the prompt
+            # servable (suffix-only prefill); NB the admission-time
+            # re-resolve can still fall to a failed handle if the prefix
+            # is unregistered in between
             raise ValueError(
                 f"prompt ({n}) exceeds the largest prefill bucket "
-                f"({self.buckets[-1]})")
+                f"({self.buckets[-1]}) and no registered prefix covers it")
         if n + max_new - 1 > self.max_seq:
             raise ValueError(
                 f"prompt ({n}) + max_new ({max_new}) exceeds cache "
@@ -574,11 +779,28 @@ class SlotEngine:
             self._dtemp, self._dtopk, self._dtopp)
         return toks
 
+    def _px_prefill_dispatch(self, prefix, sbucket, R, prompts_np, lens,
+                             slots_v, temps, topks, topps):
+        """Suffix-only admission against a registered prefix: the cached
+        k/v pair rides in as a (non-donated) operand and the suffix
+        prefill starts at the prefix's true length."""
+        (toks, self._k, self._v, self._dtok, self._dpos,
+         self._dtemp, self._dtopk,
+         self._dtopp) = self._px_prefill_fn(prefix.bucket, sbucket, R)(
+            self.params, prefix.k, prefix.v,
+            np.int32(prefix.length), prompts_np, lens, slots_v,
+            temps, topks, topps, self._next_seed(),
+            self._k, self._v, self._dtok, self._dpos,
+            self._dtemp, self._dtopk, self._dtopp)
+        return toks
+
     def _admit(self) -> bool:
         """Move pending requests into free slots. Same-bucket requests
         admit as power-of-two row batches through ONE prefill dispatch
         (which updates the per-slot device state itself) — fully async
-        unless max_new == 1. Returns True if anything was admitted."""
+        unless max_new == 1. Prompts matching a registered prefix group
+        separately per (prefix, suffix-bucket) and run the suffix-only
+        prefill. Returns True if anything was admitted."""
         admitted = False
         free = [i for i, s in self._table.items() if s is None]
         batch = []
@@ -589,11 +811,29 @@ class SlotEngine:
                 break
         if not batch:
             return False
-        groups: dict[int, list] = {}
+        # group key: (prefix-or-None, bucket). For prefix groups the
+        # bucket is the SUFFIX bucket; the _Prefix object itself rides
+        # the key (identity hash) so a concurrent unregister can't drop
+        # the entry out from under the dispatch below.
+        groups: dict[tuple, list] = {}
         for req in batch:
-            bucket = next(b for b in self.buckets if b >= len(req[0]))
-            groups.setdefault(bucket, []).append(req)
-        for bucket, reqs in groups.items():
+            prompt = req[0]
+            plan = self._px_plan(prompt)
+            if plan is not None:
+                groups.setdefault(plan, []).append(req)
+                continue
+            bucket = next((b for b in self.buckets if b >= len(prompt)),
+                          None)
+            if bucket is None:
+                # admitted past validate() via a prefix unregistered in
+                # between — fail the handle, not the engine loop
+                req[-1]._fail(ValueError(
+                    f"prompt ({len(prompt)}) exceeds the largest prefill "
+                    f"bucket and its covering prefix is gone"))
+                continue
+            groups.setdefault((None, bucket), []).append(req)
+        for (prefix, bucket), reqs in groups.items():
+            plen = prefix.length if prefix is not None else 0
             while reqs:
                 R = 1
                 while R * 2 <= len(reqs) and R * 2 <= self.slots:
@@ -607,12 +847,19 @@ class SlotEngine:
                 topps = np.empty((R,), np.float32)
                 for r, (prompt, _mn, temp, _eos, tk, tp, _h) in enumerate(
                         group):
-                    prompts_np[r, :len(prompt)] = prompt
-                    lens[r] = len(prompt)
+                    sfx = prompt[plen:]
+                    prompts_np[r, :len(sfx)] = sfx
+                    lens[r] = len(sfx)
                     temps[r], topks[r], topps[r] = temp, tk, tp
-                toks = self._prefill_dispatch(
-                    bucket, R, prompts_np, lens,
-                    np.asarray(slots_v, np.int32), temps, topks, topps)
+                if prefix is not None:
+                    toks = self._px_prefill_dispatch(
+                        prefix, bucket, R, prompts_np, lens,
+                        np.asarray(slots_v, np.int32), temps, topks, topps)
+                    self.stats["prefix_hits"] += R
+                else:
+                    toks = self._prefill_dispatch(
+                        bucket, R, prompts_np, lens,
+                        np.asarray(slots_v, np.int32), temps, topks, topps)
                 self.stats["prefills"] += 1
                 for r, (prompt, max_new, temp, eos_id, tk, tp,
                         handle) in enumerate(group):
@@ -847,6 +1094,12 @@ class SpeculativeSlotEngine(SlotEngine):
                 "top-k/top-p)")
         return super().submit(prompt, max_new, 0.0, eos_id=eos_id,
                               stream=stream)
+
+    def register_prefix(self, tokens):
+        # the suffix-only prefill fills the TARGET cache only; a draft
+        # cache left unfilled would silently collapse acceptance
+        raise ValueError(
+            "prefix caching is not supported on the speculative engine")
 
     # ---- compiled programs -------------------------------------------------
 

@@ -16,6 +16,11 @@ HTTP surface (stdlib server, same envelope as the control plane):
         "stream": true (one prompt row, slot path only) switches the
         response to chunked ndjson — {"t": token} per token as the
         engine resolves it, then {"done": true, "length": n}.
+    POST /prefixes {"tokens": [...]} → {"prefixId", "length"}
+        register a shared prompt prefix (system prompt): /generate
+        prompts starting with it prefill only the suffix (slot path).
+    GET  /prefixes              → {"prefixes": [{"id", "length"}]}
+    DELETE /prefixes/{id}       → {"removed": bool}
 
 Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
 through the same KV-cached engine and body; ``--preset encdec:NAME``
@@ -283,6 +288,14 @@ def main(argv: list[str] | None = None) -> None:
                                                           "little"))}
     gen_lock = threading.Lock()  # one TPU, one generation at a time
 
+    def valid_token_row(row) -> bool:
+        """One definition of a well-formed token-id list — shared by
+        /generate rows and /prefixes bodies so the two surfaces can
+        never diverge on what a token id is."""
+        return (isinstance(row, list) and bool(row)
+                and all(isinstance(t, int) and not isinstance(t, bool)
+                        and 0 <= t < cfg.vocab_size for t in row))
+
     class Handler(BaseHTTPRequestHandler):
         # HTTP/1.1 for chunked streaming responses; every non-streamed
         # reply carries Content-Length so keep-alive stays correct
@@ -303,6 +316,13 @@ def main(argv: list[str] | None = None) -> None:
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/prefixes":
+                if slot_engine is None:
+                    self._reply(400, {"error": "prefix caching requires "
+                                               "the slot engine path"})
+                    return
+                self._reply(200, {"prefixes": slot_engine.prefixes()})
+                return
             if self.path == "/healthz":
                 payload = {
                     "status": "ok", "model": args.preset, "step": step,
@@ -330,7 +350,43 @@ def main(argv: list[str] | None = None) -> None:
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
+        def do_DELETE(self):
+            if self.path.startswith("/prefixes/"):
+                if slot_engine is None:
+                    self._reply(400, {"error": "prefix caching requires "
+                                               "the slot engine path"})
+                    return
+                pid = self.path[len("/prefixes/"):]
+                self._reply(200, {"removed":
+                                  slot_engine.unregister_prefix(pid)})
+                return
+            self._reply(404, {"error": f"no route {self.path}"})
+
         def do_POST(self):
+            if self.path == "/prefixes":
+                # register a shared prompt prefix (system prompt / few-shot
+                # header): subsequent /generate prompts starting with it
+                # prefill only the suffix (slot-engine path only)
+                try:
+                    if slot_engine is None:
+                        raise ValueError(
+                            "prefix caching requires the slot engine path "
+                            "(not encdec / dp-sp mesh / --slots 0)")
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                    toks = req.get("tokens") if isinstance(req, dict) else None
+                    if not valid_token_row(toks):
+                        raise ValueError(
+                            f"tokens must be a non-empty list of ids in "
+                            f"[0, {cfg.vocab_size})")
+                    pid = slot_engine.register_prefix(toks)
+                    self._reply(200, {"prefixId": pid,
+                                      "length": len(toks)})
+                except (ValueError, errors.BadRequest) as e:
+                    self._reply(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
             if self.path != "/generate":
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
@@ -341,16 +397,11 @@ def main(argv: list[str] | None = None) -> None:
                     raise ValueError("body must be a JSON object")
                 prompts = req.get("srcTokens" if is_encdec else "tokens")
                 if not prompts or not all(
-                        isinstance(r, list) and r for r in prompts):
+                        valid_token_row(r) for r in prompts):
                     raise ValueError(
                         ("srcTokens" if is_encdec else "tokens")
-                        + " must be a non-empty list of non-empty "
-                        "token-id rows")
-                for r in prompts:
-                    if not all(isinstance(t, int) and not isinstance(t, bool)
-                               and 0 <= t < cfg.vocab_size for t in r):
-                        raise ValueError(
-                            f"token ids must be in [0, {cfg.vocab_size})")
+                        + " must be a non-empty list of non-empty rows "
+                        f"of token ids in [0, {cfg.vocab_size})")
 
                 def req_int(name, default):
                     return errors.as_int(req.get(name, default), name)
