@@ -1,0 +1,214 @@
+"""LoRA fine-tuning (train/lora.py): frozen base + low-rank adapters —
+identity at init, adapter-only gradients, sharding, adapter-only
+checkpoints, and the CLI/serve loop."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_docker_api.models.llama import llama_loss, llama_presets
+from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+from tpu_docker_api.train.lora import (
+    create_lora_state,
+    init_base_params,
+    lora_init,
+    lora_resume_or_init,
+    lora_specs,
+    make_lora_train_step,
+    merge_lora,
+)
+from tpu_docker_api.train.trainer import synthetic_batch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TINY = llama_presets()["tiny"]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshPlan(dp=2, fsdp=2, tp=2, sp=1))
+
+
+@pytest.fixture(scope="module")
+def base(mesh):
+    return init_base_params(TINY, mesh, jax.random.PRNGKey(0))
+
+
+class TestInitAndMerge:
+    def test_structure_and_identity(self, base):
+        adapters = lora_init(base, rank=4, key=jax.random.PRNGKey(1))
+        # default targets: wq + wv, stacked over layers
+        assert set(adapters["layers"]["attn"]) == {"wq", "wv"}
+        a = adapters["layers"]["attn"]["wq"]["a"]
+        b = adapters["layers"]["attn"]["wq"]["b"]
+        assert a.shape == (TINY.n_layers, TINY.dim, 4)
+        assert b.shape == (TINY.n_layers, 4,
+                           TINY.n_heads * TINY.head_dim)
+        assert float(jnp.abs(b).max()) == 0.0  # B = 0 ⇒ merge is identity
+        merged = merge_lora(base, adapters)
+        for path in (("layers", "attn", "wq"), ("layers", "attn", "wv"),
+                     ("layers", "mlp", "w_gate"), ("lm_head",)):
+            m, o = merged, base
+            for k in path:
+                m, o = m[k], o[k]
+            np.testing.assert_array_equal(np.asarray(m), np.asarray(o))
+
+    def test_merge_changes_only_targets(self, base):
+        adapters = lora_init(base, rank=2, key=jax.random.PRNGKey(1),
+                             targets=("wq",))
+        # give B mass so the merge is non-trivial
+        adapters["layers"]["attn"]["wq"]["b"] = jnp.ones_like(
+            adapters["layers"]["attn"]["wq"]["b"])
+        merged = merge_lora(base, adapters, alpha=2.0)
+        assert not np.array_equal(
+            np.asarray(merged["layers"]["attn"]["wq"]),
+            np.asarray(base["layers"]["attn"]["wq"]))
+        np.testing.assert_array_equal(
+            np.asarray(merged["layers"]["attn"]["wv"]),
+            np.asarray(base["layers"]["attn"]["wv"]))
+        # dtype preserved (bf16 base stays bf16)
+        assert merged["layers"]["attn"]["wq"].dtype == \
+            base["layers"]["attn"]["wq"].dtype
+
+    def test_custom_targets_and_validation(self, base):
+        adapters = lora_init(base, rank=2, key=jax.random.PRNGKey(1),
+                             targets=("w_gate", "lm_head"))
+        assert "lm_head" in adapters and "mlp" in adapters["layers"]
+        assert "attn" not in adapters["layers"]
+        with pytest.raises(ValueError, match="no parameters matched"):
+            lora_init(base, rank=2, key=jax.random.PRNGKey(1),
+                      targets=("nope",))
+        with pytest.raises(ValueError, match="rank"):
+            lora_init(base, rank=0, key=jax.random.PRNGKey(1))
+
+    def test_specs_follow_base_axes(self, base):
+        adapters = lora_init(base, rank=2, key=jax.random.PRNGKey(1),
+                             targets=("wq", "wo", "lm_head"))
+        from jax.sharding import PartitionSpec as P
+
+        specs = lora_specs(adapters)
+        # wq column-parallel P(None, fsdp, tp): A keeps in-axis, B out-axis
+        assert specs["layers"]["attn"]["wq"]["a"] == P(None, "fsdp", None)
+        assert specs["layers"]["attn"]["wq"]["b"] == P(None, None, "tp")
+        # wo row-parallel P(None, tp, fsdp)
+        assert specs["layers"]["attn"]["wo"]["a"] == P(None, "tp", None)
+        assert specs["layers"]["attn"]["wo"]["b"] == P(None, None, "fsdp")
+        # lm_head 2-D P(fsdp, tp)
+        assert specs["lm_head"]["a"] == P("fsdp", None)
+        assert specs["lm_head"]["b"] == P(None, "tp")
+
+
+class TestTraining:
+    def test_loss_descends_base_frozen(self, mesh, base):
+        state, opt = create_lora_state(TINY, mesh, jax.random.PRNGKey(1),
+                                       rank=4)
+        step = make_lora_train_step(TINY, mesh, opt, base)
+        batch = synthetic_batch(jax.random.PRNGKey(2), 8, 32,
+                                TINY.vocab_size)
+        base_before = jax.tree_util.tree_map(np.asarray, base)
+        first = last = None
+        for _ in range(12):
+            state, metrics = step(state, batch)
+            last = float(metrics["loss"])
+            first = first if first is not None else last
+        assert last < first, (first, last)
+        # frozen means frozen: base arrays bit-identical after training
+        jax.tree_util.tree_map(
+            lambda before, after: np.testing.assert_array_equal(
+                before, np.asarray(after)),
+            base_before, base)
+        # adapters actually moved (B left zero-init)
+        assert float(jnp.abs(
+            state.params["layers"]["attn"]["wq"]["b"]).max()) > 0
+        # the trained merge changes the model's loss vs the raw base
+        merged_loss = float(llama_loss(
+            merge_lora(base, state.params), batch, TINY, mesh))
+        base_loss = float(llama_loss(base, batch, TINY, mesh))
+        assert merged_loss < base_loss
+
+
+class TestCheckpoint:
+    def test_adapter_roundtrip_and_resume(self, mesh, base, tmp_path):
+        state, opt, mgr = lora_resume_or_init(
+            tmp_path, TINY, mesh, jax.random.PRNGKey(1), rank=4)
+        step = make_lora_train_step(TINY, mesh, opt, base)
+        batch = synthetic_batch(jax.random.PRNGKey(2), 8, 32,
+                                TINY.vocab_size)
+        for _ in range(3):
+            state, _ = step(state, batch)
+        mgr.save(state)
+        mgr.close()
+        state2, _, mgr2 = lora_resume_or_init(
+            tmp_path, TINY, mesh, jax.random.PRNGKey(9), rank=4)
+        mgr2.close()
+        assert int(state2.step) == 3
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            state.params, state2.params)
+
+
+class TestBaseRestore:
+    def test_base_from_int8_optimizer_checkpoint(self, mesh, tmp_path):
+        """restore_base_params is metadata-driven: a base pretrained
+        with adamw-int8 (different opt_state pytree) loads params-only
+        without knowing the writing optimizer."""
+        from tpu_docker_api.train.checkpoint import resume_or_init
+        from tpu_docker_api.train.lora import restore_base_params
+        from tpu_docker_api.train.optim import adamw_int8
+        from tpu_docker_api.train.trainer import make_train_step
+
+        state, opt, mgr = resume_or_init(tmp_path, TINY, mesh,
+                                         jax.random.PRNGKey(0),
+                                         optimizer=adamw_int8())
+        step = make_train_step(TINY, mesh, opt)
+        batch = synthetic_batch(jax.random.PRNGKey(2), 8, 32,
+                                TINY.vocab_size)
+        state, _ = step(state, batch)
+        mgr.save(state)
+        mgr.close()
+        base = restore_base_params(tmp_path, TINY, mesh)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            base, state.params)
+
+    def test_missing_base_dir_is_an_error(self, mesh, tmp_path):
+        """An explicit --lora-base-ckpt with no checkpoints must error,
+        never silently fine-tune against a random base."""
+        from tpu_docker_api.train.lora import restore_base_params
+
+        with pytest.raises(FileNotFoundError):
+            restore_base_params(tmp_path / "empty", TINY, mesh)
+
+
+class TestCli:
+    def _run(self, args, timeout=300):
+        env = {**os.environ, "PYTHONPATH": REPO}
+        return subprocess.run(
+            [sys.executable, "-m", "tpu_docker_api.train",
+             "--preset", "tiny", "--batch", "8", "--seq", "32",
+             "--platform", "cpu", "--virtual-devices", "4",
+             "--fsdp", "2", "--log-every", "2", *args],
+            capture_output=True, text=True, env=env, timeout=timeout)
+
+    def test_lora_train_and_resume(self, tmp_path):
+        ckpt = tmp_path / "adapters"
+        r = self._run(["--steps", "4", "--lora-rank", "2",
+                       "--ckpt-dir", str(ckpt), "--save-every", "2"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = [json.loads(ln) for ln in r.stdout.splitlines()
+                 if ln.startswith("{")]
+        assert lines[-1] == {"event": "done", "step": 4}
+        # resume continues from the saved adapter step
+        r2 = self._run(["--steps", "6", "--lora-rank", "2",
+                        "--ckpt-dir", str(ckpt), "--save-every", "100"])
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        steps = [json.loads(ln)["step"] for ln in r2.stdout.splitlines()
+                 if ln.startswith("{") and "step" in ln]
+        assert steps[-1] == 6 and min(steps) > 4

@@ -400,6 +400,58 @@ class TestSpeculativeServing:
             p.communicate(timeout=30)
 
 
+class TestLoraServing:
+    def test_serve_merged_adapters(self, tmp_path):
+        """Train tiny adapters (CLI, sharded mesh), then serve with
+        --lora-ckpt on one device: the adapter checkpoint restores
+        across mesh shapes and merges into the base at load."""
+        env = {**os.environ, "PYTHONPATH": REPO}
+        ckpt = tmp_path / "adapters"
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_docker_api.train",
+             "--preset", "tiny", "--batch", "8", "--seq", "32",
+             "--steps", "4", "--platform", "cpu", "--virtual-devices", "4",
+             "--fsdp", "2", "--lora-rank", "2", "--ckpt-dir", str(ckpt),
+             "--save-every", "2", "--log-every", "2"],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        port = 18795
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_docker_api.serve",
+             "--preset", "tiny", "--platform", "cpu", "--host", "127.0.0.1",
+             "--port", str(port), "--max-seq", "64",
+             "--virtual-devices", "1", "--lora-ckpt", str(ckpt),
+             "--lora-rank", "2"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if p.poll() is not None:
+                    raise RuntimeError(f"server died: {p.stdout.read()}")
+                try:
+                    if _get(port, "/healthz")["status"] == "ok":
+                        break
+                except (urllib.error.URLError, OSError):
+                    time.sleep(0.3)
+            out = _post(port, "/generate",
+                        {"tokens": [[1, 2, 3]], "maxNewTokens": 4})
+            assert len(out["tokens"][0]) == 4
+        finally:
+            p.terminate()
+            p.wait(timeout=30)
+
+    def test_lora_ckpt_without_rank_exits(self):
+        env = {**os.environ, "PYTHONPATH": REPO}
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_docker_api.serve",
+             "--preset", "tiny", "--platform", "cpu",
+             "--virtual-devices", "1", "--lora-ckpt", "/nope"],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert r.returncode != 0
+        assert "--lora-rank" in r.stderr + r.stdout
+
+
 class TestFamilyPresets:
     def _spawn(self, preset, extra=()):
         import subprocess

@@ -50,6 +50,9 @@ Design notes, TPU-first:
   trainer uses; params restore (orbax) directly into their shards.
 - ``--quantize`` rewrites projections to int8 at load
   (infer/quantize.py) — decode is weight-bandwidth-bound.
+- ``--lora-ckpt`` merges trained LoRA adapters (train/lora.py) into the
+  base weights once at load (before quantization); serving then runs
+  the ordinary forward — zero per-token adapter cost.
 - the distributed bootstrap mirrors the trainer: JAX_NUM_PROCESSES > 1 ⇒
   jax.distributed.initialize from the control plane's rendered env.
 """
@@ -91,6 +94,14 @@ def main(argv: list[str] | None = None) -> None:
                         "engine; llama/moe single-device only)")
     p.add_argument("--chunk", type=int, default=8,
                    help="decode steps per slot-engine dispatch")
+    p.add_argument("--lora-ckpt", default="",
+                   help="adapter-only checkpoint dir (train --lora-rank): "
+                        "merged into the base weights at load. "
+                        "--lora-rank/--lora-alpha/--lora-targets must "
+                        "match the training run")
+    p.add_argument("--lora-rank", type=int, default=0)
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--lora-targets", default="wq,wv")
     p.add_argument("--draft-preset", default="",
                    help="serve speculatively: a (smaller) llama preset "
                         "as the draft model. Greedy-only; pays at small "
@@ -126,16 +137,12 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit("--quantize currently supports llama presets only")
     mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=1))
     if args.ckpt_dir:
-        from tpu_docker_api.train.checkpoint import resume_or_init
+        # params-only restore: the optimizer moments are never read
+        # (PLACEHOLDER) — works whatever optimizer the training run
+        # used, and at 8B the moments would not even fit one chip
+        from tpu_docker_api.train.checkpoint import restore_model_params
 
-        state, _, mgr = resume_or_init(args.ckpt_dir, cfg, mesh,
-                                       jax.random.PRNGKey(0))
-        params = state.params
-        mgr.close()
-        step = int(state.step)
-        # inference holds params only — dropping the TrainState frees the
-        # restored Adam moments (2 extra f32 copies of every weight)
-        del state
+        params, step = restore_model_params(args.ckpt_dir, cfg, mesh)
     else:
         if mesh.devices.size > 1:
             state, _ = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
@@ -145,6 +152,20 @@ def main(argv: list[str] | None = None) -> None:
             init_fn, _, _ = model_fns(cfg)
             params = init_fn(cfg, jax.random.PRNGKey(0))
         step = 0
+    if args.lora_ckpt:
+        # merge trained adapters into the base ONCE at load; serving then
+        # runs the ordinary forward on the merged weights (order matters:
+        # merge BEFORE int8 quantization, which is lossy)
+        if args.lora_rank < 1:
+            raise SystemExit("--lora-ckpt requires --lora-rank (the rank "
+                             "the adapters were trained at)")
+        from tpu_docker_api.train.lora import merge_lora, restore_adapters
+
+        targets = tuple(t for t in args.lora_targets.split(",") if t)
+        adapters = restore_adapters(args.lora_ckpt, cfg, mesh,
+                                    args.lora_rank, targets)
+        params = merge_lora(params, adapters, alpha=args.lora_alpha)
+        del adapters
     if args.quantize:
         from tpu_docker_api.infer.quantize import quantize_llama_params
 
@@ -175,13 +196,11 @@ def main(argv: list[str] | None = None) -> None:
                     "device")
             _, draft_cfg = resolve_preset(args.draft_preset)
             if args.draft_ckpt:
-                from tpu_docker_api.train.checkpoint import resume_or_init
+                from tpu_docker_api.train.checkpoint import (
+                    restore_model_params)
 
-                dstate, _, dmgr = resume_or_init(
-                    args.draft_ckpt, draft_cfg, mesh, jax.random.PRNGKey(0))
-                draft_params = dstate.params
-                dmgr.close()
-                del dstate
+                draft_params, _ = restore_model_params(
+                    args.draft_ckpt, draft_cfg, mesh)
             else:
                 dinit, _, _ = model_fns(draft_cfg)
                 draft_params = dinit(draft_cfg, jax.random.PRNGKey(0))

@@ -59,6 +59,16 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--optim", default="adamw", choices=["adamw", "adamw-int8"],
                    help="adamw-int8 stores both Adam moments as blockwise "
                         "int8 (halves optimizer HBM)")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="> 0: LoRA fine-tuning — freeze the base, train "
+                        "rank-R adapters on --lora-targets; --ckpt-dir "
+                        "then holds ADAPTER-only checkpoints")
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--lora-targets", default="wq,wv",
+                   help="comma-separated projection leaf names to adapt")
+    p.add_argument("--lora-base-ckpt", default="",
+                   help="full-train checkpoint dir to load the frozen "
+                        "base from ('' = random init, smoke/bench)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace (TensorBoard/Perfetto "
                         "format) covering post-compile steps")
@@ -116,13 +126,52 @@ def main(argv: list[str] | None = None) -> None:
         from tpu_docker_api.train.optim import adamw_int8
 
         opt = adamw_int8()
+    if args.lora_rank <= 0 and (
+            args.lora_base_ckpt or args.lora_alpha != 16.0
+            or args.lora_targets != "wq,wv"):
+        # a lora flag without --lora-rank would otherwise be silently
+        # ignored and a FULL random-init pretrain would run with exit 0
+        raise SystemExit(
+            "--lora-base-ckpt/--lora-alpha/--lora-targets require "
+            "--lora-rank > 0")
     mgr = None
-    if args.ckpt_dir:
+    if args.lora_rank > 0:
+        from tpu_docker_api.train.lora import (
+            create_lora_state,
+            init_base_params,
+            lora_resume_or_init,
+            make_lora_train_step,
+        )
+
+        targets = tuple(t for t in args.lora_targets.split(",") if t)
+        if args.lora_base_ckpt:
+            # frozen base from a full-train checkpoint: params-only,
+            # metadata-driven restore (works whatever optimizer wrote
+            # it; a missing/empty dir is an ERROR — fine-tuning against
+            # a silently random base would be garbage with exit 0)
+            from tpu_docker_api.train.lora import restore_base_params
+
+            base_params = restore_base_params(args.lora_base_ckpt, cfg,
+                                              mesh)
+        else:
+            base_params = init_base_params(cfg, mesh, key)
+        if args.ckpt_dir:
+            state, optimizer, mgr = lora_resume_or_init(
+                args.ckpt_dir, cfg, mesh, key, args.lora_rank,
+                targets=targets, optimizer=opt)
+        else:
+            state, optimizer = create_lora_state(
+                cfg, mesh, key, args.lora_rank, targets=targets,
+                optimizer=opt)
+        step_fn = make_lora_train_step(cfg, mesh, optimizer, base_params,
+                                       alpha=args.lora_alpha)
+    elif args.ckpt_dir:
         state, optimizer, mgr = resume_or_init(args.ckpt_dir, cfg, mesh, key,
                                                optimizer=opt)
+        step_fn = make_train_step(cfg, mesh, optimizer)
     else:
         state, optimizer = create_train_state(cfg, mesh, key, optimizer=opt)
-    step_fn = make_train_step(cfg, mesh, optimizer)
+        step_fn = make_train_step(cfg, mesh, optimizer)
     start_step = int(state.step)
 
     # quiesce contract: graceful stop ⇒ checkpoint ⇒ exit 0

@@ -34,6 +34,18 @@ class CheckpointManager:
 
     def __init__(self, directory: str | os.PathLike, max_to_keep: int = 3,
                  save_interval_steps: int = 1):
+        # explicit handler registry: (a) ``item_metadata`` works on a
+        # manager that has not saved/restored yet (params-only restores
+        # read the checkpoint's own structure first); (b) PyTreeRestore
+        # is admitted against the StandardSave on-disk format — it is
+        # the one restore path that honors ocp.PLACEHOLDER, which
+        # restore_params uses to SKIP reading optimizer moments
+        registry = ocp.handlers.DefaultCheckpointHandlerRegistry()
+        std = ocp.StandardCheckpointHandler()
+        registry.add("default", ocp.args.StandardSave, std)
+        registry.add("default", ocp.args.StandardRestore, std)
+        registry.add("default", ocp.args.PyTreeRestore,
+                     ocp.PyTreeCheckpointHandler())
         self._mgr = ocp.CheckpointManager(
             os.fspath(os.path.abspath(directory)),
             options=ocp.CheckpointManagerOptions(
@@ -41,6 +53,7 @@ class CheckpointManager:
                 save_interval_steps=save_interval_steps,
                 enable_async_checkpointing=True,
             ),
+            handler_registry=registry,
         )
 
     def save(self, state: TrainState, step: int | None = None) -> bool:
@@ -77,7 +90,55 @@ class CheckpointManager:
             params=as_abstract(abstract_params, p_sh),
             opt_state=as_abstract(abstract_opt, o_sh),
         )
+        return self.restore_with_target(target, step)
+
+    def restore_with_target(self, target, step: int | None = None):
+        """Restore into an arbitrary abstract pytree (ShapeDtypeStruct +
+        shardings) — the seam LoRA's adapter-only checkpoints use
+        (train/lora.py builds a target the model registry can't derive)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint steps in directory")
         return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+
+    def restore_params(self, shardings, step: int | None = None) -> dict:
+        """Restore ONLY the params tree, no matter which optimizer wrote
+        the checkpoint. The target comes from the checkpoint's OWN
+        metadata; the step and every optimizer subtree are
+        ``ocp.PLACEHOLDER`` so their bytes are never read — at 8B-adamw
+        scale the moments are 2 extra f32 copies of every weight, which
+        neither fit one serving chip nor deserve the disk reads. This is
+        the seam for frozen-base loads (LoRA ``--lora-base-ckpt``) and
+        serving, where coupling the restore to the writing run's
+        optimizer choice (adamw vs adamw-int8) would be fragile."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint steps in directory")
+        raw = self._mgr.item_metadata(step).tree  # [step, params, opt]
+
+        def sds(m, sharding):
+            return jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                        sharding=sharding)
+
+        target = [
+            ocp.PLACEHOLDER,
+            jax.tree_util.tree_map(sds, raw[1], shardings),
+            jax.tree_util.tree_map(lambda _: ocp.PLACEHOLDER, raw[2]),
+        ]
+        # explicit restore_args: without them the handler falls back to
+        # the sharding recorded in the checkpoint FILE, which references
+        # the writer's devices — a restore on a different topology (the
+        # normal serving case) then fails
+        restore_args = jax.tree_util.tree_map(
+            lambda x: x if x is ocp.PLACEHOLDER else ocp.ArrayRestoreArgs(
+                sharding=x.sharding, global_shape=x.shape, dtype=x.dtype),
+            target,
+            is_leaf=lambda x: (x is ocp.PLACEHOLDER
+                               or isinstance(x, jax.ShapeDtypeStruct)))
+        restored = self._mgr.restore(
+            step, args=ocp.args.PyTreeRestore(item=target,
+                                              restore_args=restore_args))
+        return restored[1]
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -99,6 +160,25 @@ class CheckpointManager:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def restore_model_params(directory, cfg, mesh, rules=None):
+    """(params, step) of the latest checkpoint in ``directory``,
+    params-only (optimizer state skipped via PLACEHOLDER — see
+    ``CheckpointManager.restore_params``). The one recipe behind
+    serving's ``--ckpt-dir``/``--draft-ckpt`` loads and LoRA's frozen
+    base; raises FileNotFoundError for a missing/empty directory."""
+    model_init, _, model_rules = model_fns(cfg)
+    rules = rules if rules is not None else model_rules
+    abstract = jax.eval_shape(
+        lambda k: model_init(cfg, k), jax.random.PRNGKey(0))
+    with CheckpointManager(directory) as mgr:
+        step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint steps in {os.fspath(directory)}")
+        return mgr.restore_params(
+            param_shardings(abstract, mesh, rules), step), step
 
 
 def resume_or_init(

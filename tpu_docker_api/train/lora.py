@@ -1,0 +1,286 @@
+"""LoRA fine-tuning: frozen base weights + trainable low-rank adapters.
+
+The reference has no training stack at all (SURVEY.md §0); this module
+supplies the parameter-efficient fine-tuning path a fleet of provisioned
+containers actually runs against a pretrained base. TPU-first shape:
+
+- **Merge-then-forward.** The train step computes
+  ``W' = W + (alpha/rank) * A @ B`` for every adapted projection and runs
+  the ORDINARY model forward on the merged tree. The model code stays
+  untouched (one source of truth for block math), the merge is a tiny
+  batched einsum per adapted weight, and autodiff through it yields
+  exactly the LoRA gradients (d/dA, d/dB of the low-rank delta) with the
+  base held constant — the base enters as a closed-over device constant,
+  so no gradient buffers and no optimizer moments exist for it. That is
+  the LoRA memory win: at adamw the moments are 2/3 of training HBM, and
+  here they exist only for the (rank-sized) adapters. The transient
+  merged copy XLA materializes per step is bf16 weight-sized and freed
+  after use (remat applies to it like any activation).
+- **Adapters shard like their base.** ``A (L, d_in, r)`` inherits the
+  base weight's (layer, in) axes, ``B (L, r, d_out)`` its (layer, out)
+  axis — derived mechanically from the base sharding rules, so tp/fsdp
+  meshes run unchanged and the merged tree keeps the base's layout
+  (``lora_shardings``).
+- **Adapter-only checkpoints.** The ``TrainState`` under training holds
+  ONLY the adapters; orbax saves are rank-sized (MBs, not GBs) and
+  restore onto any mesh shape like every other checkpoint in
+  train/checkpoint.py. Serving merges once at load
+  (``python -m tpu_docker_api.serve --lora-ckpt ...``).
+
+Targets match by LEAF NAME anywhere in the tree (default ``("wq", "wv")``
+— the classic LoRA attention pair), so the same code adapts any family
+whose projections are stacked 2-D/3-D arrays (llama, moe, encdec).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_docker_api.models import model_fns
+from tpu_docker_api.models.common import trunc_normal_init
+from tpu_docker_api.parallel.sharding import param_shardings, spec_for
+
+DEFAULT_TARGETS = ("wq", "wv")
+
+
+def _walk_matched(params: dict, targets, prefix: str = ""):
+    """Yield (path, leaf) for every matched projection, in traversal
+    order (deterministic — dict order is insertion order everywhere the
+    param trees are built)."""
+    for k, v in params.items():
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _walk_matched(v, targets, path)
+        elif k in targets and len(getattr(v, "shape", ())) >= 2:
+            yield path, v
+
+
+def lora_init(params: dict, rank: int, key: jax.Array,
+              targets=DEFAULT_TARGETS, dtype=jnp.float32) -> dict:
+    """Adapter pytree mirroring the matched projections of ``params``:
+    each matched ``(..., d_in, d_out)`` weight gets
+    ``{"a": (..., d_in, rank), "b": (..., rank, d_out)}`` with A
+    fan-in-scaled normal and B zero (so the merged model starts EXACTLY
+    at the base). ``params`` may be abstract (eval_shape) — only
+    shapes are read. Adapters default to f32: they are tiny, and Adam
+    updates accumulate without bf16 rounding."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    # _walk_matched is the ONE match predicate; build() keys off the
+    # resulting path→index map (index also seeds each pair's RNG fold)
+    index = {p: i for i, (p, _) in enumerate(_walk_matched(params, targets))}
+    if not index:
+        raise ValueError(f"no parameters matched targets {targets!r}")
+
+    def build(subtree: dict, prefix: str = "") -> dict:
+        out = {}
+        for k, v in subtree.items():
+            path = f"{prefix}/{k}" if prefix else k
+            if isinstance(v, dict):
+                sub = build(v, path)
+                if sub:
+                    out[k] = sub
+            elif path in index:
+                *lead, d_in, d_out = v.shape
+                out[k] = {
+                    "a": trunc_normal_init(
+                        jax.random.fold_in(key, index[path]),
+                        (*lead, d_in, rank), d_in, dtype),
+                    "b": jnp.zeros((*lead, rank, d_out), dtype),
+                }
+        return out
+
+    return build(params)
+
+
+def merge_lora(params: dict, adapters: dict, alpha: float = 16.0) -> dict:
+    """Base tree with ``W + (alpha/rank) * A @ B`` at every adapted leaf
+    (rank read off A). The delta computes in the adapter dtype (f32) and
+    casts to the base dtype at the add — bf16 bases keep their storage
+    dtype so the merged tree serves/trains exactly like the base."""
+
+    def walk(p: dict, a: dict) -> dict:
+        out = {}
+        for k, v in p.items():
+            if k in a and isinstance(a[k], dict) and "a" in a[k] \
+                    and not isinstance(v, dict):
+                pa, pb = a[k]["a"], a[k]["b"]
+                scale = alpha / pa.shape[-1]
+                delta = scale * jnp.matmul(pa, pb)
+                out[k] = (v.astype(delta.dtype) + delta).astype(v.dtype)
+            elif isinstance(v, dict):
+                out[k] = walk(v, a.get(k, {}))
+            else:
+                out[k] = v
+        return out
+
+    return walk(params, adapters)
+
+
+def lora_specs(adapters: dict, rules=None, prefix: str = ""):
+    """PartitionSpecs for an adapter tree, derived from the BASE weight's
+    rule: A keeps the base's leading+input axes (rank dim unsharded), B
+    keeps leading+output axes."""
+    out = {}
+    for k, v in adapters.items():
+        path = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict) and "a" in v and not isinstance(v["a"], dict):
+            base = spec_for(path, rules)
+            # pad the base spec to the weight's rank with leading Nones
+            # (spec_for may return a short spec for fallback rules)
+            nd = len(v["a"].shape)
+            spec = (None,) * (nd - len(base)) + tuple(base)
+            out[k] = {"a": P(*spec[:-1], None),
+                      "b": P(*spec[:-2], None, spec[-1])}
+        elif isinstance(v, dict):
+            out[k] = lora_specs(v, rules, path)
+    return out
+
+
+def lora_shardings(adapters: dict, mesh: Mesh, rules=None):
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        lora_specs(adapters, rules),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def init_base_params(cfg, mesh: Mesh, key: jax.Array, rules=None) -> dict:
+    """Base params initialized directly into their shards — the
+    params-only half of trainer.create_train_state (no optimizer state:
+    the base is frozen under LoRA)."""
+    model_init, _, model_rules = model_fns(cfg)
+    rules = rules if rules is not None else model_rules
+    abstract = jax.eval_shape(lambda k: model_init(cfg, k), key)
+    shardings = param_shardings(abstract, mesh, rules)
+    with mesh:
+        return jax.jit(lambda k: model_init(cfg, k),
+                       out_shardings=shardings)(key)
+
+
+def create_lora_state(cfg, mesh: Mesh, key: jax.Array, rank: int,
+                      targets=DEFAULT_TARGETS, optimizer=None, rules=None):
+    """(TrainState over ADAPTERS, optimizer) — the trainable half. The
+    frozen base comes separately (``init_base_params`` or a restored
+    checkpoint)."""
+    from tpu_docker_api.train.trainer import (
+        TrainState, _opt_shardings, default_optimizer)
+
+    optimizer = optimizer or default_optimizer()
+    model_init, _, model_rules = model_fns(cfg)
+    rules = rules if rules is not None else model_rules
+    abstract_base = jax.eval_shape(lambda k: model_init(cfg, k), key)
+    abstract = jax.eval_shape(
+        lambda k: lora_init(abstract_base, rank, k, targets), key)
+    a_sh = lora_shardings(abstract, mesh, rules)
+    with mesh:
+        adapters = jax.jit(
+            lambda k: lora_init(abstract_base, rank, k, targets),
+            out_shardings=a_sh)(key)
+        opt_state = jax.jit(
+            optimizer.init,
+            out_shardings=_opt_shardings(optimizer, abstract, mesh, rules,
+                                         param_sh=a_sh),
+        )(adapters)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=adapters,
+                      opt_state=opt_state), optimizer
+
+
+def make_lora_train_step(cfg, mesh: Mesh, optimizer, base_params: dict,
+                         alpha: float = 16.0):
+    """jitted (state, batch) → (state, metrics) where ``state.params``
+    are the adapters; every step merges and runs the family's ordinary
+    loss. ``base_params`` ride as closed-over device constants — never
+    donated, never differentiated."""
+    from tpu_docker_api.train.trainer import make_train_step
+
+    _, model_loss, _ = model_fns(cfg)
+
+    def loss_fn(adapters, batch):
+        merged = merge_lora(base_params, adapters, alpha)
+        return model_loss(merged, batch, cfg, mesh)
+
+    return make_train_step(cfg, mesh, optimizer, loss_fn=loss_fn)
+
+
+def lora_abstract_state(cfg, rank: int, targets, mesh: Mesh,
+                        optimizer, rules=None):
+    """Abstract TrainState (ShapeDtypeStruct + shardings) for restoring
+    adapter-only checkpoints onto ``mesh``."""
+    import numpy as np
+
+    from tpu_docker_api.train.trainer import TrainState, _opt_shardings
+
+    model_init, _, model_rules = model_fns(cfg)
+    rules = rules if rules is not None else model_rules
+    key = jax.random.PRNGKey(0)
+    abstract_base = jax.eval_shape(lambda k: model_init(cfg, k), key)
+    abstract = jax.eval_shape(
+        lambda k: lora_init(abstract_base, rank, k, targets), key)
+    a_sh = lora_shardings(abstract, mesh, rules)
+    abstract_opt = jax.eval_shape(optimizer.init, abstract)
+    o_sh = _opt_shardings(optimizer, abstract, mesh, rules, param_sh=a_sh,
+                          abstract_opt=abstract_opt)
+
+    def as_abstract(tree, shardings):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            tree, shardings)
+
+    return TrainState(
+        step=jax.ShapeDtypeStruct((), np.int32,
+                                  sharding=NamedSharding(mesh, P())),
+        params=as_abstract(abstract, a_sh),
+        opt_state=as_abstract(abstract_opt, o_sh),
+    )
+
+
+def restore_adapters(directory, cfg, mesh: Mesh, rank: int,
+                     targets=DEFAULT_TARGETS, rules=None) -> dict:
+    """Adapter params from an adapter-only checkpoint — metadata-driven
+    (works regardless of the optimizer that trained them; raises
+    FileNotFoundError for a missing/empty directory)."""
+    from tpu_docker_api.train.checkpoint import CheckpointManager
+
+    model_init, _, model_rules = model_fns(cfg)
+    rules = rules if rules is not None else model_rules
+    key = jax.random.PRNGKey(0)
+    abstract_base = jax.eval_shape(lambda k: model_init(cfg, k), key)
+    abstract = jax.eval_shape(
+        lambda k: lora_init(abstract_base, rank, k, targets), key)
+    with CheckpointManager(directory) as mgr:
+        return mgr.restore_params(lora_shardings(abstract, mesh, rules))
+
+
+def restore_base_params(directory, cfg, mesh: Mesh, rules=None) -> dict:
+    """Frozen-base params from a FULL training checkpoint — params-only
+    and optimizer-agnostic (a base pretrained with adamw-int8 loads
+    fine); raises FileNotFoundError if the directory holds no steps (an
+    explicit base flag must never silently fall back to random init)."""
+    from tpu_docker_api.train.checkpoint import restore_model_params
+
+    params, _ = restore_model_params(directory, cfg, mesh, rules)
+    return params
+
+
+def lora_resume_or_init(directory, cfg, mesh: Mesh, key: jax.Array,
+                        rank: int, targets=DEFAULT_TARGETS,
+                        optimizer=None, rules=None, max_to_keep: int = 3):
+    """Adapter-state analog of train.checkpoint.resume_or_init: restore
+    the latest adapter checkpoint if one exists, else fresh-init."""
+    from tpu_docker_api.train.checkpoint import CheckpointManager
+    from tpu_docker_api.train.trainer import default_optimizer
+
+    optimizer = optimizer or default_optimizer()
+    mgr = CheckpointManager(directory, max_to_keep=max_to_keep)
+    if mgr.latest_step() is not None:
+        target = lora_abstract_state(cfg, rank, targets, mesh, optimizer,
+                                     rules)
+        state = mgr.restore_with_target(target)
+        return state, optimizer, mgr
+    state, optimizer = create_lora_state(cfg, mesh, key, rank,
+                                         targets=targets,
+                                         optimizer=optimizer, rules=rules)
+    return state, optimizer, mgr
