@@ -119,6 +119,112 @@ class TestBatchFn:
             rows_for_process(10, 0, 3)
 
 
+class TestNativeLoader:
+    """C++ fast path (tpu_native/dataloader.cc): bit-identical to the
+    numpy path for every (seed, step, shard, dtype, file-layout) — the
+    correctness contract that lets make_batch_fn swap it in silently."""
+
+    @pytest.fixture(scope="class")
+    def lib(self):
+        import os
+        import subprocess
+
+        from tpu_docker_api.data import loader
+
+        native_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tpu_native")
+        subprocess.run(["make", "-C", native_dir, "libtpudata.so"],
+                       capture_output=True, timeout=120)
+        loader._native_cache.clear()
+        lib = loader._native_lib()
+        if lib is None:
+            pytest.skip("libtpudata.so unavailable (no toolchain)")
+        return lib
+
+    def _multi_file_source(self, tmp_path, dtype="uint16", window=9):
+        from tpu_docker_api.data.loader import (
+            open_token_files, write_token_file)
+
+        rng = np.random.default_rng(7)
+        hi = 60_000 if dtype == "uint16" else 2 ** 30
+        # sizes chosen so windows straddle file boundaries
+        for i, n in enumerate((101, 57, 260)):
+            write_token_file(rng.integers(0, hi, n), tmp_path / f"{i}.bin",
+                             bin_dtype=dtype)
+        return open_token_files(tmp_path, window=window, bin_dtype=dtype)
+
+    def _numpy_fn(self, source, *args, **kwargs):
+        import dataclasses
+
+        from tpu_docker_api.data.loader import make_batch_fn
+
+        return make_batch_fn(dataclasses.replace(source, bin_paths=None),
+                             *args, **kwargs)
+
+    @pytest.mark.parametrize("dtype", ["uint16", "int32"])
+    def test_bit_exact_vs_numpy_with_epoch_wrap(self, tmp_path, lib,
+                                                dtype):
+        from tpu_docker_api.data.loader import _NativeBatcher, make_batch_fn
+
+        src = self._multi_file_source(tmp_path, dtype=dtype)
+        for seed in (0, 3):
+            native = make_batch_fn(src, 8, seed=seed)
+            assert isinstance(native, _NativeBatcher)
+            ref = self._numpy_fn(src, 8, seed=seed)
+            # sequential steps (lookahead hits) spanning an epoch wrap
+            for step in range(0, src.n_windows // 8 + 3):
+                np.testing.assert_array_equal(native(step), ref(step))
+
+    def test_bit_exact_sharded_and_random_access(self, tmp_path, lib):
+        from tpu_docker_api.data.loader import make_batch_fn
+
+        src = self._multi_file_source(tmp_path)
+        for pi in range(4):
+            native = make_batch_fn(src, 8, seed=5, process_index=pi,
+                                   process_count=4)
+            ref = self._numpy_fn(src, 8, seed=5, process_index=pi,
+                                 process_count=4)
+            # non-sequential steps: every lookahead misses, still exact
+            for step in (11, 2, 2, 30, 0):
+                np.testing.assert_array_equal(native(step), ref(step))
+
+    def test_env_kill_switch(self, tmp_path, lib, monkeypatch):
+        from tpu_docker_api.data import loader
+
+        src = self._multi_file_source(tmp_path)
+        monkeypatch.setenv("TPU_DOCKER_API_NATIVE_DATA", "0")
+        loader._native_cache.clear()
+        try:
+            fn = loader.make_batch_fn(src, 8, seed=0)
+            assert not isinstance(fn, loader._NativeBatcher)
+        finally:
+            loader._native_cache.clear()
+
+    def test_int16_dtype_stays_on_numpy_path(self, tmp_path, lib):
+        """int16 shares uint16's itemsize — the native widen loop is
+        sign-blind, so anything but uint16/int32 must stay on numpy
+        (negative tokens would silently decode as 65535...)."""
+        from tpu_docker_api.data import loader
+
+        write_token_file(np.array([-1, -2, 5, 6, 7, 8], np.int16),
+                         tmp_path / "t.bin", bin_dtype="int16")
+        src = loader.open_token_files(tmp_path / "t.bin", window=3,
+                                      bin_dtype="int16")
+        fn = loader.make_batch_fn(src, 2, seed=0)
+        assert not isinstance(fn, loader._NativeBatcher)
+        assert -1 in fn(0)  # sign preserved by the numpy path
+
+    def test_npy_sources_stay_on_numpy_path(self, tmp_path, lib):
+        from tpu_docker_api.data import loader
+
+        np.save(tmp_path / "t.npy",
+                np.arange(500, dtype=np.int32))
+        src = loader.open_token_files(tmp_path / "t.npy", window=9)
+        assert src.bin_paths is None
+        fn = loader.make_batch_fn(src, 4, seed=0)
+        assert not isinstance(fn, loader._NativeBatcher)
+
+
 class TestTrainerIntegration:
     @pytest.mark.slow
     def test_trainer_runs_on_file_data_and_resumes(self, tmp_path):

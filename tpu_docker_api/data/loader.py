@@ -17,6 +17,15 @@ design is TPU-first in the same sense as the trainer:
   jaxenv.py renders ``JAX_PROCESS_ID``; the loader consumes it).
 - **Zero-copy reads.** Token files are memory-mapped (np.memmap); a batch
   gathers windows without materializing the corpus. Host RAM stays O(batch).
+- **Native fast path.** When every source file is a plain ``.bin`` and
+  the C++ loader (tpu_native/dataloader.cc, ``make -C tpu_native``) is
+  built, ``make_batch_fn`` transparently routes batch assembly through
+  it: mmap + tight widen loop, plus a background worker that precomputes
+  step+1 for the same row range (the trainer's sequential access hits
+  it, overlapping host data work with device compute). Bit-identical to
+  the numpy path by construction AND by test (tests/test_data.py); unset
+  builds or ``.npy`` sources silently use the numpy path, and
+  ``TPU_DOCKER_API_NATIVE_DATA=0`` disables it outright.
 
 File format: flat little-endian token ids, ``.bin`` (uint16 when
 vocab < 65536, else int32) or ``.npy``. Multiple files concatenate in sorted
@@ -26,7 +35,9 @@ windows (+1: the trainer shifts tokens/targets off one array).
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
+import os
 import pathlib
 from typing import Callable, Sequence
 
@@ -51,6 +62,11 @@ class TokenSource:
 
     arrays: tuple[np.ndarray, ...]  # memory-mapped, 1-D
     window: int                     # tokens per window (seq + 1)
+    #: set by open_token_files when EVERY file is a plain .bin — the
+    #: precondition for the native fast path (raw little-endian tokens,
+    #: no npy headers to skip)
+    bin_paths: tuple[str, ...] | None = None
+    bin_dtype: str = "uint16"
 
     def __post_init__(self):
         if self.window < 2:
@@ -102,17 +118,23 @@ def open_token_files(
         else:
             paths = [p]
     arrays = []
+    all_bin: list[str] | None = []
     for p in map(pathlib.Path, paths):
         if p.suffix == ".npy":
             arr = np.load(p, mmap_mode="r")
             if arr.ndim != 1:
                 raise ValueError(f"{p}: token arrays must be 1-D, got {arr.shape}")
+            all_bin = None
         elif p.suffix == ".bin":
             arr = np.memmap(p, dtype=np.dtype(bin_dtype), mode="r")
+            if all_bin is not None:
+                all_bin.append(str(p))
         else:
             raise ValueError(f"{p}: expected .bin or .npy")
         arrays.append(arr)
-    return TokenSource(arrays=tuple(arrays), window=window)
+    return TokenSource(arrays=tuple(arrays), window=window,
+                       bin_paths=tuple(all_bin) if all_bin else None,
+                       bin_dtype=bin_dtype)
 
 
 def rows_for_process(
@@ -125,6 +147,90 @@ def rows_for_process(
             f"{process_count}")
     per = global_batch // process_count
     return range(process_index * per, (process_index + 1) * per)
+
+
+# ---- native fast path (tpu_native/dataloader.cc) --------------------------
+
+_NATIVE_PATHS = (
+    os.path.join(os.path.dirname(__file__), "..", "..", "tpu_native",
+                 "libtpudata.so"),
+    "/usr/local/lib/libtpudata.so",
+    "libtpudata.so",
+)
+_native_cache: list = []  # [lib-or-None], memoized
+
+
+def _native_lib():
+    """The C++ loader library, or None (unbuilt / disabled). Memoized —
+    one dlopen per process."""
+    if _native_cache:
+        return _native_cache[0]
+    lib = None
+    if os.environ.get("TPU_DOCKER_API_NATIVE_DATA", "1") != "0":
+        for path in _NATIVE_PATHS:
+            try:
+                cand = ctypes.CDLL(path)
+            except OSError:
+                continue
+            cand.tpudata_abi_version.restype = ctypes.c_int32
+            if cand.tpudata_abi_version() != 1:
+                continue
+            cand.tpudata_open.restype = ctypes.c_int64
+            cand.tpudata_open.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int32]
+            cand.tpudata_n_windows.restype = ctypes.c_int64
+            cand.tpudata_n_windows.argtypes = [ctypes.c_int64]
+            cand.tpudata_n_tokens.restype = ctypes.c_int64
+            cand.tpudata_n_tokens.argtypes = [ctypes.c_int64]
+            cand.tpudata_batch.restype = ctypes.c_int32
+            cand.tpudata_batch.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32)]
+            cand.tpudata_close.argtypes = [ctypes.c_int64]
+            lib = cand
+            break
+    _native_cache.append(lib)
+    return lib
+
+
+class _NativeBatcher:
+    """Owns one native source handle; ``__call__(step)`` fills this
+    process's rows. The handle is closed on GC (the worker thread joins
+    there), so the object must outlive the returned batch fn — it IS the
+    batch fn."""
+
+    def __init__(self, lib, paths: tuple[str, ...], window: int,
+                 bin_dtype: str, global_batch: int, rows: range,
+                 seed: int):
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._handle = lib.tpudata_open(
+            arr, len(paths), window, np.dtype(bin_dtype).itemsize)
+        if self._handle < 0:
+            raise OSError(f"tpudata_open failed for {paths}")
+        self._window = window
+        self._global_batch = global_batch
+        self._rows = rows
+        self._seed = seed
+
+    def __call__(self, step: int) -> np.ndarray:
+        out = np.empty((len(self._rows), self._window), np.int32)
+        rc = self._lib.tpudata_batch(
+            self._handle, int(step), self._global_batch,
+            self._rows.start, self._rows.stop, self._seed,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise RuntimeError(f"tpudata_batch failed rc={rc}")
+        return out
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_handle", -1) >= 0:
+            lib.tpudata_close(self._handle)
+            self._handle = -1
 
 
 def make_batch_fn(
@@ -146,6 +252,20 @@ def make_batch_fn(
     n = source.n_windows
     a = _coprime_stride(n, seed)
     rows = rows_for_process(global_batch, process_index, process_count)
+
+    # the native decode loop knows exactly uint16/int32 — any other
+    # dtype (int16 shares uint16's itemsize!) must stay on numpy or a
+    # sign-blind widen would silently corrupt the stream
+    if (source.bin_paths and seed >= 0
+            and source.bin_dtype in ("uint16", "int32")):
+        lib = _native_lib()
+        if lib is not None:
+            try:
+                return _NativeBatcher(lib, source.bin_paths, source.window,
+                                      source.bin_dtype, global_batch, rows,
+                                      seed)
+            except OSError:
+                pass  # fall through to the numpy path
 
     def batch_at(step: int) -> np.ndarray:
         out = np.empty((len(rows), source.window), np.int32)

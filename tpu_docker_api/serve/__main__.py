@@ -152,6 +152,13 @@ def main(argv: list[str] | None = None) -> None:
             init_fn, _, _ = model_fns(cfg)
             params = init_fn(cfg, jax.random.PRNGKey(0))
         step = 0
+    if not args.lora_ckpt and (
+            args.lora_rank > 0 or args.lora_alpha != 16.0
+            or args.lora_targets != "wq,wv"):
+        # mirror of the trainer's guard: a lora flag without --lora-ckpt
+        # would silently serve the unmodified base with exit 0
+        raise SystemExit(
+            "--lora-rank/--lora-alpha/--lora-targets require --lora-ckpt")
     if args.lora_ckpt:
         # merge trained adapters into the base ONCE at load; serving then
         # runs the ordinary forward on the merged weights (order matters:
@@ -161,7 +168,8 @@ def main(argv: list[str] | None = None) -> None:
                              "the adapters were trained at)")
         from tpu_docker_api.train.lora import merge_lora, restore_adapters
 
-        targets = tuple(t for t in args.lora_targets.split(",") if t)
+        targets = tuple(t.strip() for t in args.lora_targets.split(",")
+                        if t.strip())
         adapters = restore_adapters(args.lora_ckpt, cfg, mesh,
                                     args.lora_rank, targets)
         params = merge_lora(params, adapters, alpha=args.lora_alpha)

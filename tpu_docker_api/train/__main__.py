@@ -143,7 +143,8 @@ def main(argv: list[str] | None = None) -> None:
             make_lora_train_step,
         )
 
-        targets = tuple(t for t in args.lora_targets.split(",") if t)
+        targets = tuple(t.strip() for t in args.lora_targets.split(",")
+                        if t.strip())
         if args.lora_base_ckpt:
             # frozen base from a full-train checkpoint: params-only,
             # metadata-driven restore (works whatever optimizer wrote
