@@ -405,6 +405,20 @@ def measure_serving() -> dict:
         out["llama3_1b_prefix_cache"] = {"error": str(e)[:160]}
     jax.clear_caches()
     gc.collect()
+    # chunked prefill (round 3): max inter-token stall a long admission
+    # inflicts on an active stream, whole vs segmented
+    try:
+        from tpu_docker_api.infer.servebench import bench_chunked_prefill
+
+        r = bench_chunked_prefill(preset="llama3-1b", prompt_len=960,
+                                  stream_new=96, chunk=8,
+                                  prefill_chunk=128, max_seq=1024)
+        r.pop("ok")
+        out["llama3_1b_chunked_prefill"] = r
+    except Exception as e:
+        out["llama3_1b_chunked_prefill"] = {"error": str(e)[:160]}
+    jax.clear_caches()
+    gc.collect()
     return out
 
 

@@ -567,6 +567,23 @@ def check_prefix_serving() -> bool:
                  r.pop("ok") and r["speedup"] >= 1.3, **r)
 
 
+def check_chunked_prefill() -> bool:
+    """Chunked prefill (round 3): a 960-token admission next to an
+    active stream — max inter-token stall must drop when the prefill
+    runs in 128-token segments. Captured: llama3-1b 75.3 → 43.6 ms
+    (1.73×); 8B-int8 960-prompt 168 → 122 ms (1.37×), while 8B at a
+    448 prompt measured 0.92× (the decode chunk IS the floor there —
+    recorded honestly in perf-notes; segmenting also costs the long
+    request itself). Gate 1.2 at the 1b point."""
+    from tpu_docker_api.infer.servebench import bench_chunked_prefill
+
+    r = bench_chunked_prefill(preset="llama3-1b", prompt_len=960,
+                              stream_new=96, chunk=8, prefill_chunk=128,
+                              max_seq=1024)
+    return _emit("chunked_prefill_stall",
+                 r.pop("ok") and r["stall_reduction"] >= 1.2, **r)
+
+
 def check_decode_roofline() -> bool:
     """llama3-8b int8 decode-only latency vs the weight-streaming HBM
     roof (VERDICT r2 item 2). 2026-07 v5e: 20.4 ms/tok at batch 64 =
@@ -606,6 +623,7 @@ def main() -> int:
         checks.append(check_8b_inference)
         checks.append(check_slot_serving)
         checks.append(check_prefix_serving)
+        checks.append(check_chunked_prefill)
         checks.append(check_decode_roofline)
     ok = True
     for check in checks:

@@ -133,8 +133,11 @@ class TestNativeLoader:
 
         native_dir = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "tpu_native")
-        subprocess.run(["make", "-C", native_dir, "libtpudata.so"],
-                       capture_output=True, timeout=120)
+        try:
+            subprocess.run(["make", "-C", native_dir, "libtpudata.so"],
+                           capture_output=True, timeout=120)
+        except FileNotFoundError:
+            pass  # no make: the lib may still be prebuilt, else skip
         loader._native_cache.clear()
         lib = loader._native_lib()
         if lib is None:

@@ -632,6 +632,99 @@ class TestPrefixCache:
             eng.register_prefix([1, 2, 3])
 
 
+class TestChunkedPrefill:
+    """Chunked prefill: long prompts admit in segments interleaved with
+    decode — token-exact for both the segmented request and every
+    concurrently decoding stream (no cache bleed from the parked row)."""
+
+    def test_segmented_long_prompt_token_exact(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         prefill_chunk=8)
+        prompt = [((i * 5) % 251) + 1 for i in range(30)]  # 4 segments
+        h = eng.submit(prompt, 8)
+        while not h.done():
+            eng.step()
+        assert eng.stats["segment_prefills"] == 4
+        assert eng.stats["prefills"] == 1
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 8)
+
+    def test_concurrent_stream_unharmed_by_segmented_admission(self, setup):
+        """An active short stream must produce EXACTLY its isolated
+        tokens while a long prompt prefills in segments next to it —
+        the parked-position write-drop in action."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=2,
+                         prefill_chunk=8)
+        short = [3, 1, 4]
+        h1 = eng.submit(short, 14)
+        eng.step()  # h1 decoding
+        long_p = [((i * 7) % 251) + 1 for i in range(40)]
+        h2 = eng.submit(long_p, 6)
+        while not (h1.done() and h2.done()):
+            eng.step()
+        assert h1.result(0)["tokens"] == isolated_greedy(
+            cfg, params, short, 14)
+        assert h2.result(0)["tokens"] == isolated_greedy(
+            cfg, params, long_p, 6)
+        assert eng.stats["segment_prefills"] == 5
+
+    def test_short_prompts_keep_whole_prompt_admission(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         prefill_chunk=16)
+        h = eng.submit([1, 2, 3], 6)
+        while not h.done():
+            eng.step()
+        assert eng.stats["segment_prefills"] == 0
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [1, 2, 3], 6)
+
+    def test_segmented_max_new_one_and_slot_reuse(self, setup):
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=1, max_seq=MAX_SEQ, chunk=4,
+                         prefill_chunk=8)
+        long_p = [((i * 3) % 251) + 1 for i in range(20)]
+        h = eng.submit(long_p, 1)
+        for _ in range(10):
+            if h.done():
+                break
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, long_p, 1)
+        # the slot recycles cleanly into an ordinary request
+        h2 = eng.submit([9, 8], 6)
+        while not h2.done():
+            eng.step()
+        assert h2.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [9, 8], 6)
+
+    def test_sampling_through_segments(self, setup):
+        """top_k=1 at temperature > 0 must equal greedy through the
+        segmented path (the final segment arms the per-slot filters)."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         prefill_chunk=8)
+        prompt = [((i * 11) % 251) + 1 for i in range(20)]
+        h = eng.submit(prompt, 6, temperature=0.9, top_k=1)
+        while not h.done():
+            eng.step()
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 6)
+
+    def test_speculative_rejects_prefill_chunk(self):
+        from tpu_docker_api.infer.slots import SpeculativeSlotEngine
+
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        with pytest.raises(ValueError, match="chunked prefill"):
+            SpeculativeSlotEngine(cfg, params, draft_cfg=cfg,
+                                  draft_params=params, n_spec=2,
+                                  slots=2, max_seq=MAX_SEQ,
+                                  prefill_chunk=8)
+
+
 class TestSpeculativeSlots:
     """Speculative decoding x continuous batching: greedy verification is
     token-exact vs plain greedy REGARDLESS of draft quality."""

@@ -170,10 +170,12 @@ def _native_lib():
         for path in _NATIVE_PATHS:
             try:
                 cand = ctypes.CDLL(path)
-            except OSError:
-                continue
-            cand.tpudata_abi_version.restype = ctypes.c_int32
-            if cand.tpudata_abi_version() != 1:
+                cand.tpudata_abi_version.restype = ctypes.c_int32
+                if cand.tpudata_abi_version() != 1:
+                    continue
+            except (OSError, AttributeError):
+                # unbuilt, unloadable, or a foreign .so without our
+                # symbols — the documented contract is numpy fallback
                 continue
             cand.tpudata_open.restype = ctypes.c_int64
             cand.tpudata_open.argtypes = [

@@ -211,6 +211,98 @@ def bench_prefix_serving(
     }
 
 
+def bench_chunked_prefill(
+    preset: str = "llama3-1b",
+    prompt_len: int = 960,
+    stream_new: int = 96,
+    chunk: int = 8,
+    prefill_chunk: int = 128,
+    max_seq: int = 1024,
+    quantize: bool = False,
+) -> dict:
+    """Inter-token stall a LONG admission inflicts on an active stream:
+    a short streaming request decodes while a ``prompt_len``-token
+    prompt is admitted; the metric is the max gap between the stream's
+    consecutive token arrivals — whole-prompt admission stalls decode
+    for the full prefill, chunked prefill bounds the stall at one
+    segment. Both runs also report the long request's completion time
+    (the latency the segmenting trades away)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.slots import SlotEngine
+    from tpu_docker_api.models.llama import llama_init, llama_presets
+
+    if stream_new < 9:
+        # the long prompt is admitted after the stream's 8th token
+        raise ValueError(f"stream_new must be >= 9, got {stream_new}")
+    cfg = llama_presets()[preset]
+    if quantize:
+        from tpu_docker_api.infer.quantize import synth_quantized_params
+
+        params = synth_quantized_params(cfg)
+    else:
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+    short = jax.random.randint(jax.random.PRNGKey(30), (16,), 0,
+                               cfg.vocab_size, dtype=jnp.int32).tolist()
+    long_p = jax.random.randint(jax.random.PRNGKey(31), (prompt_len,), 0,
+                                cfg.vocab_size, dtype=jnp.int32).tolist()
+
+    def run(pc: int) -> dict:
+        # ONE engine per mode: compiled programs live in per-engine jit
+        # closures, so warmup must run on the same instance that measures
+        eng = SlotEngine(cfg, params, slots=4, max_seq=max_seq,
+                         chunk=chunk, prefill_chunk=pc)
+        eng.start()
+        for _ in range(2):  # warm every program this scenario reaches
+            h = eng.submit(short, stream_new)
+            h2 = eng.submit(long_p, 4)
+            h.result(300)
+            h2.result(300)
+        hs = eng.submit(short, stream_new, stream=True)
+        it = hs.stream(timeout=300)
+        arrivals = [time.perf_counter()]
+        next(it)
+        arrivals[0] = time.perf_counter()
+        t_long0 = None
+        hl = None
+        for t in it:
+            arrivals.append(time.perf_counter())
+            if hl is None and len(arrivals) >= 8:
+                hl = eng.submit(long_p, 4)   # admit mid-stream
+                t_long0 = time.perf_counter()
+        hl.result(300)
+        long_dt = time.perf_counter() - t_long0
+        eng.close()
+        gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
+        # first gap that can contain the admission stall: the long
+        # prompt is submitted after arrivals[7] lands, so gap index 7
+        # (arrivals[7]→[8]) is the earliest affected one
+        tail = gaps[7:]
+        # the engine resolves tokens per processed chunk, so the gap
+        # floor is one chunk's wall time, not one decode step's
+        return {"max_gap_ms": round(max(tail) * 1e3, 1),
+                "median_gap_ms": round(sorted(tail)[len(tail) // 2] * 1e3,
+                                       1),
+                "long_request_s": round(long_dt, 3)}
+
+    whole = run(0)
+    jax.clear_caches()
+    seg = run(prefill_chunk)
+    jax.clear_caches()
+    return {
+        "ok": seg["max_gap_ms"] < whole["max_gap_ms"],
+        "preset": preset,
+        "quantized": quantize,
+        "prompt_len": prompt_len,
+        "prefill_chunk": prefill_chunk,
+        "whole": whole,
+        "chunked": seg,
+        "stall_reduction": round(
+            whole["max_gap_ms"] / max(seg["max_gap_ms"], 1e-6), 2),
+    }
+
+
 def bench_decode_roofline(
     preset: str = "llama3-8b",
     batch: int = 64,

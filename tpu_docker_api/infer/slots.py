@@ -200,6 +200,10 @@ class _Slot:
     #                            pos mutates at processing lag)
     dispatched: int = 0        # chunks dispatched since admission; bounds
     #                            this slot's reachable cache position
+    pending: list[int] | None = None  # chunked prefill: prompt tokens not
+    #                            yet prefilled; the slot joins decode only
+    #                            once this drains (None = fully prefilled)
+    prefill_pos: int = 0       # next absolute segment write offset
 
     def emit(self, t: int) -> None:
         self.tokens.append(t)
@@ -257,6 +261,7 @@ class SlotEngine:
         max_pending: int = 0,
         mesh=None,
         max_prefixes: int = 8,
+        prefill_chunk: int = 0,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -281,6 +286,15 @@ class SlotEngine:
         #: SimpleQueue.qsize() races under concurrent submitters, but the
         #: point is load shedding, not an exact ceiling.
         self.max_pending = max_pending
+        #: > 0: prompts longer than this prefill in ``prefill_chunk``-token
+        #: SEGMENTS, one per engine step, interleaved with decode chunks —
+        #: a long admission can then stall active streams by at most one
+        #: segment's compute instead of the whole prompt's. 0 = whole-
+        #: prompt admission (the batched/prefix paths).
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         if mesh is not None and getattr(mesh, "empty", False):
             mesh = None
         if mesh is not None:
@@ -354,7 +368,7 @@ class SlotEngine:
         self.stats = {"completed": 0, "decode_chunks": 0, "prefills": 0,
                       "wasted_steps": 0, "emitted_tokens": 0,
                       "bucketed_chunks": 0, "accepted_tokens": 0,
-                      "prefix_hits": 0}
+                      "prefix_hits": 0, "segment_prefills": 0}
 
     # ---- compiled programs -------------------------------------------------
 
@@ -516,6 +530,53 @@ class SlotEngine:
         fn = jax.jit(prefill,
                      donate_argnums=(11, 12, 13, 14, 15, 16, 17))
         self._px_prefill_fns[(pbucket, sbucket, rows)] = fn
+        return fn
+
+    def _seg_prefill_fn(self, bucket: int, final: bool):
+        """One chunked-prefill SEGMENT for one slot: slice the slot's
+        cache row out, run the cached forward at the segment's absolute
+        offset (per-row vector start → scatter writes, pad tail drops),
+        write the row back. Non-final segments park the slot's decode
+        position at ``max_seq`` so interleaved decode chunks' writes for
+        this row drop harmlessly; the FINAL segment samples the first
+        token and arms the real decode state — from then on the slot is
+        indistinguishable from a whole-prompt admission."""
+        key = ("seg", bucket, final)
+        fn = self._px_prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        park = jnp.int32(self.max_seq)
+
+        def seg(params, tokens, actual_len, slot, start, temp, topk,
+                topp, seed, k_all, v_all, dtok, dpos, dtemp, dtopk,
+                dtopp):
+            # tokens (1, bucket); actual_len/slot/start scalars
+            kr = lax.dynamic_slice_in_dim(k_all, slot, 1, axis=1)
+            vr = lax.dynamic_slice_in_dim(v_all, slot, 1, axis=1)
+            logits, kr, vr = fwd(params, tokens, cfg, kr, vr,
+                                 start[None], self.mesh,
+                                 last_only=actual_len[None] - 1)
+            k_all = lax.dynamic_update_slice_in_dim(k_all, kr, slot,
+                                                    axis=1)
+            v_all = lax.dynamic_update_slice_in_dim(v_all, vr, slot,
+                                                    axis=1)
+            if final:
+                toks = self._sample_filtered(
+                    logits[:, 0], temp[None], topk[None], topp[None],
+                    jax.random.PRNGKey(seed))
+                dtok = dtok.at[slot].set(toks[0])
+                dpos = dpos.at[slot].set(start + actual_len)
+                dtemp = dtemp.at[slot].set(temp)
+                dtopk = dtopk.at[slot].set(topk)
+                dtopp = dtopp.at[slot].set(topp)
+            else:
+                toks = jnp.zeros((1,), jnp.int32)
+                dpos = dpos.at[slot].set(park)
+            return toks, k_all, v_all, dtok, dpos, dtemp, dtopk, dtopp
+
+        fn = jax.jit(seg, donate_argnums=(9, 10, 11, 12, 13, 14, 15))
+        self._px_prefill_fns[key] = fn
         return fn
 
     def _decode(self, kv_limit: int | None = None, filtered: bool = False):
@@ -822,6 +883,20 @@ class SlotEngine:
             if plan is not None:
                 groups.setdefault(plan, []).append(req)
                 continue
+            if self.prefill_chunk and len(prompt) > self.prefill_chunk:
+                # chunked prefill: reserve the slot now; segments are
+                # dispatched by _dispatch_segments, interleaved with
+                # decode chunks (the slot joins decode after the final
+                # segment arms its state)
+                prompt, max_new, temp, eos_id, tk, tp, handle = req
+                st = _Slot(handle=handle, tokens=[], max_new=max_new,
+                           pos=len(prompt), temperature=temp,
+                           eos_id=eos_id, top_k=tk, top_p=tp,
+                           base_len=len(prompt), pending=list(prompt))
+                with self._lock:
+                    self._table[free.pop()] = st
+                admitted = True
+                continue
             bucket = next((b for b in self.buckets if b >= len(prompt)),
                           None)
             if bucket is None:
@@ -878,6 +953,40 @@ class SlotEngine:
                 admitted = True
         return admitted
 
+    def _dispatch_segments(self) -> bool:
+        """One prefill segment per PREFILLING slot per engine step —
+        bounded work between decode chunks, so active streams stall at
+        most one segment's compute per step during a long admission."""
+        did = False
+        for i, st in list(self._table.items()):
+            if st is None or st.pending is None:
+                continue
+            seg = st.pending[:self.prefill_chunk]
+            final = len(seg) == len(st.pending)
+            bucket = next(b for b in self.buckets if b >= len(seg))
+            tokens_np = np.full((1, bucket), self.pad_id, np.int32)
+            tokens_np[0, :len(seg)] = seg
+            (toks, self._k, self._v, self._dtok, self._dpos, self._dtemp,
+             self._dtopk, self._dtopp) = self._seg_prefill_fn(
+                bucket, final)(
+                self.params, tokens_np, np.int32(len(seg)), np.int32(i),
+                np.int32(st.prefill_pos), np.float32(st.temperature),
+                np.int32(st.top_k), np.float32(st.top_p),
+                self._next_seed(), self._k, self._v, self._dtok,
+                self._dpos, self._dtemp, self._dtopk, self._dtopp)
+            st.prefill_pos += len(seg)
+            st.pending = st.pending[len(seg):] if not final else None
+            self.stats["segment_prefills"] += 1
+            did = True
+            if final:
+                self.stats["prefills"] += 1
+                if st.max_new == 1:
+                    # nothing to decode (same sync path as _admit)
+                    st.emit(int(toks[0]))
+                    st.fresh = False
+                    self._finish_if_done(i, st)
+        return did
+
     def _finish_if_done(self, slot: int, st: _Slot) -> bool:
         hit_eos = st.eos_id is not None and st.tokens and (
             st.tokens[-1] == st.eos_id)
@@ -894,7 +1003,11 @@ class SlotEngine:
         return False
 
     def _dispatch_chunk(self) -> None:
-        snap = {i: s for i, s in self._table.items() if s is not None}
+        # prefilling slots are excluded: their decode lanes compute
+        # garbage (writes drop at the parked position) and their tokens
+        # must never be processed
+        snap = {i: s for i, s in self._table.items()
+                if s is not None and s.pending is None}
         limit = self._kv_limit_for_chunk(snap)
         filtered = any(s.top_k > 0 or s.top_p < 1.0 for s in snap.values())
         out, self._dtok, self._dpos, self._k, self._v = self._decode(
@@ -951,7 +1064,9 @@ class SlotEngine:
                 self._process_oldest()
                 did = True
         did = self._admit() or did
-        active = any(s is not None for s in self._table.values())
+        did = self._dispatch_segments() or did
+        active = any(s is not None and s.pending is None
+                     for s in self._table.values())
         if active:
             self._dispatch_chunk()
             did = True
@@ -1071,6 +1186,10 @@ class SpeculativeSlotEngine(SlotEngine):
                  n_spec: int = 4, **kwargs):
         if kwargs.get("mesh") is not None:
             raise ValueError("speculative slots are single-device for now")
+        if kwargs.get("prefill_chunk"):
+            raise ValueError(
+                "chunked prefill is not supported on the speculative "
+                "engine (segments fill the target cache only)")
         if n_spec < 1:
             raise ValueError(f"n_spec must be >= 1, got {n_spec}")
         # chunk drives the position-bound math (a round advances at most

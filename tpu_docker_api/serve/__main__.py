@@ -94,6 +94,11 @@ def main(argv: list[str] | None = None) -> None:
                         "engine; llama/moe single-device only)")
     p.add_argument("--chunk", type=int, default=8,
                    help="decode steps per slot-engine dispatch")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="> 0: prompts longer than this prefill in "
+                        "segments interleaved with decode (bounds the "
+                        "stall a long admission inflicts on active "
+                        "streams); 0 = whole-prompt admission")
     p.add_argument("--lora-ckpt", default="",
                    help="adapter-only checkpoint dir (train --lora-rank): "
                         "merged into the base weights at load. "
@@ -202,6 +207,13 @@ def main(argv: list[str] | None = None) -> None:
                 raise SystemExit(
                     "--draft-preset requires a llama preset on a single "
                     "device")
+            if args.prefill_chunk:
+                # the speculative engine would reject it; erroring here
+                # beats silently serving with whole-prompt admission
+                raise SystemExit(
+                    "--prefill-chunk is not supported with --draft-preset "
+                    "(speculative segments would fill the target cache "
+                    "only)")
             _, draft_cfg = resolve_preset(args.draft_preset)
             if args.draft_ckpt:
                 from tpu_docker_api.train.checkpoint import (
@@ -221,6 +233,7 @@ def main(argv: list[str] | None = None) -> None:
             slot_engine = SlotEngine(
                 cfg, params, slots=args.slots, max_seq=max_seq,
                 chunk=args.chunk,
+                prefill_chunk=args.prefill_chunk,
                 mesh=mesh if multi else None,
                 # shed load once the queue is 8x the slot count deep —
                 # beyond that, added requests only buy latency, not
