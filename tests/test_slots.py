@@ -670,6 +670,28 @@ class TestChunkedPrefill:
             cfg, params, long_p, 6)
         assert eng.stats["segment_prefills"] == 5
 
+    def test_concurrent_long_admissions_round_robin_exact(self, setup):
+        """Several long prompts prefilling at once: segments round-robin
+        (one per step — the stall bound is global, not per-slot) and
+        every request stays token-exact."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=3, max_seq=MAX_SEQ, chunk=2,
+                         prefill_chunk=8)
+        short = [2, 7]
+        h0 = eng.submit(short, 10)
+        eng.step()
+        longs = [[((i * k) % 251) + 1 for i in range(33)]
+                 for k in (3, 7)]
+        hs = [eng.submit(p, 5) for p in longs]
+        while not (h0.done() and all(h.done() for h in hs)):
+            eng.step()
+        assert h0.result(0)["tokens"] == isolated_greedy(
+            cfg, params, short, 10)
+        for p, h in zip(longs, hs):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 5)
+        assert eng.stats["segment_prefills"] == 10  # 2 prompts x 5 segs
+
     def test_short_prompts_keep_whole_prompt_admission(self, setup):
         cfg, params = setup
         eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
@@ -712,6 +734,46 @@ class TestChunkedPrefill:
             eng.step()
         assert h.result(0)["tokens"] == isolated_greedy(
             cfg, params, prompt, 6)
+
+    def test_long_suffix_prefers_segments_over_prefix(self, setup):
+        """A prefix hit whose SUFFIX exceeds prefill_chunk falls through
+        to segmentation: the bounded-stall contract outranks prefix
+        reuse (one long suffix dispatch would stall every stream)."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         prefill_chunk=8)
+        prefix = [5, 6, 7, 8]
+        eng.register_prefix(prefix)
+        long_sfx = prefix + [((i * 9) % 251) + 1 for i in range(24)]
+        h = eng.submit(long_sfx, 6)
+        while not h.done():
+            eng.step()
+        assert eng.stats["prefix_hits"] == 0
+        assert eng.stats["segment_prefills"] == 4  # ceil(28/8)
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, long_sfx, 6)
+        # short suffix still rides the prefix path
+        short_sfx = prefix + [11, 12]
+        h2 = eng.submit(short_sfx, 6)
+        while not h2.done():
+            eng.step()
+        assert eng.stats["prefix_hits"] == 1
+        assert h2.result(0)["tokens"] == isolated_greedy(
+            cfg, params, short_sfx, 6)
+
+    def test_prompt_beyond_largest_bucket_served_by_segments(self, setup):
+        """With chunked prefill on, segment clamping serves prompts the
+        bucket list alone could not (no prefix needed)."""
+        cfg, params = setup
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         buckets=(16, 32), prefill_chunk=40)
+        prompt = [((i * 13) % 251) + 1 for i in range(38)]  # > bucket 32
+        h = eng.submit(prompt, 5)
+        while not h.done():
+            eng.step()
+        assert eng.stats["segment_prefills"] == 2  # 32 + 6
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 5)
 
     def test_speculative_rejects_prefill_chunk(self):
         from tpu_docker_api.infer.slots import SpeculativeSlotEngine

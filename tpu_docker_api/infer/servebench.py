@@ -248,7 +248,7 @@ def bench_chunked_prefill(
     long_p = jax.random.randint(jax.random.PRNGKey(31), (prompt_len,), 0,
                                 cfg.vocab_size, dtype=jnp.int32).tolist()
 
-    def run(pc: int) -> dict:
+    def run(pc: int, reps: int = 3) -> dict:
         # ONE engine per mode: compiled programs live in per-engine jit
         # closures, so warmup must run on the same instance that measures
         eng = SlotEngine(cfg, params, slots=4, max_seq=max_seq,
@@ -259,32 +259,40 @@ def bench_chunked_prefill(
             h2 = eng.submit(long_p, 4)
             h.result(300)
             h2.result(300)
-        hs = eng.submit(short, stream_new, stream=True)
-        it = hs.stream(timeout=300)
-        arrivals = [time.perf_counter()]
-        next(it)
-        arrivals[0] = time.perf_counter()
-        t_long0 = None
-        hl = None
-        for t in it:
-            arrivals.append(time.perf_counter())
-            if hl is None and len(arrivals) >= 8:
-                hl = eng.submit(long_p, 4)   # admit mid-stream
-                t_long0 = time.perf_counter()
-        hl.result(300)
-        long_dt = time.perf_counter() - t_long0
+        max_gaps, long_dts = [], []
+        for _ in range(reps):
+            hs = eng.submit(short, stream_new, stream=True)
+            it = hs.stream(timeout=300)
+            arrivals = [time.perf_counter()]
+            next(it)
+            arrivals[0] = time.perf_counter()
+            t_long0 = None
+            hl = None
+            for t in it:
+                arrivals.append(time.perf_counter())
+                if hl is None and len(arrivals) >= 8:
+                    hl = eng.submit(long_p, 4)   # admit mid-stream
+                    t_long0 = time.perf_counter()
+            hl.result(300)
+            # the engine stamps Handle.completed_at at resolution, so
+            # the latency is exact — not quantized to this loop's
+            # token-arrival cadence or confounded by the stream's tail
+            long_dts.append(hl.completed_at - t_long0)
+            gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
+            # first gap that can contain the admission stall: the long
+            # prompt is submitted after arrivals[7] lands, so gap index
+            # 7 (arrivals[7]→[8]) is the earliest affected one. The
+            # engine resolves tokens per processed chunk, so the gap
+            # floor is one chunk's wall time, not one decode step's.
+            max_gaps.append(max(gaps[7:]))
         eng.close()
-        gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
-        # first gap that can contain the admission stall: the long
-        # prompt is submitted after arrivals[7] lands, so gap index 7
-        # (arrivals[7]→[8]) is the earliest affected one
-        tail = gaps[7:]
-        # the engine resolves tokens per processed chunk, so the gap
-        # floor is one chunk's wall time, not one decode step's
-        return {"max_gap_ms": round(max(tail) * 1e3, 1),
-                "median_gap_ms": round(sorted(tail)[len(tail) // 2] * 1e3,
-                                       1),
-                "long_request_s": round(long_dt, 3)}
+        # min over reps: scheduling/tunnel noise only INFLATES a
+        # max-gap, so the smallest observation is the best estimate of
+        # the true admission stall
+        return {"max_gap_ms": round(min(max_gaps) * 1e3, 1),
+                "rep_max_gaps_ms": [round(g * 1e3, 1) for g in max_gaps],
+                "long_request_s": round(sorted(long_dts)[len(long_dts)
+                                                         // 2], 3)}
 
     whole = run(0)
     jax.clear_caches()

@@ -100,6 +100,7 @@ import collections
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any
 
 import jax
@@ -137,6 +138,9 @@ class Handle:
     _result: dict | None = None
     _error: Exception | None = None
     _stream: queue.SimpleQueue | None = None
+    #: time.perf_counter() at completion — benchmarks read latency off
+    #: the handle instead of polling (a poll quantizes to its cadence)
+    completed_at: float | None = None
 
     def result(self, timeout: float | None = None) -> dict:
         if not self._done.wait(timeout):
@@ -172,6 +176,7 @@ class Handle:
         # _done BEFORE the stream sentinel: a consumer unblocking from
         # stream() may immediately call result(0)
         self._result = result
+        self.completed_at = time.perf_counter()
         self._done.set()
         if self._stream is not None:
             self._stream.put(None)
@@ -532,7 +537,8 @@ class SlotEngine:
         self._px_prefill_fns[(pbucket, sbucket, rows)] = fn
         return fn
 
-    def _seg_prefill_fn(self, bucket: int, final: bool):
+    def _seg_prefill_fn(self, bucket: int, final: bool,
+                        kv_limit: int | None = None):
         """One chunked-prefill SEGMENT for one slot: slice the slot's
         cache row out, run the cached forward at the segment's absolute
         offset (per-row vector start → scatter writes, pad tail drops),
@@ -540,8 +546,12 @@ class SlotEngine:
         position at ``max_seq`` so interleaved decode chunks' writes for
         this row drop harmlessly; the FINAL segment samples the first
         token and arms the real decode state — from then on the slot is
-        indistinguishable from a whole-prompt admission."""
-        key = ("seg", bucket, final)
+        indistinguishable from a whole-prompt admission. ``kv_limit``
+        (geometric bucket >= the segment's reach) keeps each segment's
+        attention from reading the slot's full max_seq row — without it
+        an N-token prompt in K-token segments pays ~(N/K)× the
+        whole-prompt admission's cache reads."""
+        key = ("seg", bucket, final, kv_limit)
         fn = self._px_prefill_fns.get(key)
         if fn is not None:
             return fn
@@ -556,7 +566,8 @@ class SlotEngine:
             vr = lax.dynamic_slice_in_dim(v_all, slot, 1, axis=1)
             logits, kr, vr = fwd(params, tokens, cfg, kr, vr,
                                  start[None], self.mesh,
-                                 last_only=actual_len[None] - 1)
+                                 last_only=actual_len[None] - 1,
+                                 kv_limit=kv_limit)
             k_all = lax.dynamic_update_slice_in_dim(k_all, kr, slot,
                                                     axis=1)
             v_all = lax.dynamic_update_slice_in_dim(v_all, vr, slot,
@@ -758,9 +769,12 @@ class SlotEngine:
         n = len(prompt)
         if n < 1:
             raise ValueError("prompt must be non-empty")
-        if n > self.buckets[-1] and self._px_plan(prompt) is None:
-            # a registered prefix covering the overflow makes the prompt
-            # servable (suffix-only prefill); NB the admission-time
+        if (n > self.buckets[-1] and not self.prefill_chunk
+                and self._px_plan(prompt) is None):
+            # two ways past the bucket ceiling: a registered prefix
+            # covering the overflow (suffix-only prefill), or chunked
+            # prefill (segments clamp to the largest bucket, so ANY
+            # length up to capacity admits). NB the admission-time px
             # re-resolve can still fall to a failed handle if the prefix
             # is unregistered in between
             raise ValueError(
@@ -880,10 +894,19 @@ class SlotEngine:
         for req in batch:
             prompt = req[0]
             plan = self._px_plan(prompt)
-            if plan is not None:
+            if plan is not None and (
+                    not self.prefill_chunk
+                    or len(prompt) - plan[0].length <= self.prefill_chunk):
+                # prefix hit with a SHORT suffix: the whole point of the
+                # registry. A long suffix would break --prefill-chunk's
+                # bounded-stall promise as one dispatch, so it falls
+                # through to segmentation instead (redundant prefix
+                # compute, bounded stalls — the flag's contract wins)
                 groups.setdefault(plan, []).append(req)
                 continue
-            if self.prefill_chunk and len(prompt) > self.prefill_chunk:
+            if self.prefill_chunk and (
+                    len(prompt) > self.prefill_chunk
+                    or len(prompt) > self.buckets[-1]):
                 # chunked prefill: reserve the slot now; segments are
                 # dispatched by _dispatch_segments, interleaved with
                 # decode chunks (the slot joins decode after the final
@@ -954,21 +977,34 @@ class SlotEngine:
         return admitted
 
     def _dispatch_segments(self) -> bool:
-        """One prefill segment per PREFILLING slot per engine step —
-        bounded work between decode chunks, so active streams stall at
-        most one segment's compute per step during a long admission."""
-        did = False
-        for i, st in list(self._table.items()):
-            if st is None or st.pending is None:
-                continue
-            seg = st.pending[:self.prefill_chunk]
+        """ONE prefill segment per engine step, round-robin across
+        prefilling slots — so the bounded-stall guarantee (active
+        streams wait at most one segment's compute per step) holds even
+        when several long admissions prefill concurrently; the
+        admissions themselves serialize against each other. Segment
+        length additionally clamps to the largest prefill bucket, so a
+        bucket always exists regardless of prefill_chunk/buckets
+        interplay."""
+        filling = [(i, st) for i, st in self._table.items()
+                   if st is not None and st.pending is not None]
+        if not filling:
+            return False
+        # rotate: pick the first prefilling slot past the last-served one
+        start = getattr(self, "_seg_rr", -1)
+        filling.sort(key=lambda p: (p[0] <= start, p[0]))
+        for i, st in filling[:1]:
+            self._seg_rr = i
+            seg = st.pending[:min(self.prefill_chunk, self.buckets[-1])]
             final = len(seg) == len(st.pending)
             bucket = next(b for b in self.buckets if b >= len(seg))
+            # read only the cache prefix this segment can attend
+            reach = st.prefill_pos + bucket
+            kvl = next((b for b in self._kv_buckets if b >= reach), None)
             tokens_np = np.full((1, bucket), self.pad_id, np.int32)
             tokens_np[0, :len(seg)] = seg
             (toks, self._k, self._v, self._dtok, self._dpos, self._dtemp,
              self._dtopk, self._dtopp) = self._seg_prefill_fn(
-                bucket, final)(
+                bucket, final, kvl)(
                 self.params, tokens_np, np.int32(len(seg)), np.int32(i),
                 np.int32(st.prefill_pos), np.float32(st.temperature),
                 np.int32(st.top_k), np.float32(st.top_p),
@@ -977,7 +1013,6 @@ class SlotEngine:
             st.prefill_pos += len(seg)
             st.pending = st.pending[len(seg):] if not final else None
             self.stats["segment_prefills"] += 1
-            did = True
             if final:
                 self.stats["prefills"] += 1
                 if st.max_new == 1:
@@ -985,7 +1020,7 @@ class SlotEngine:
                     st.emit(int(toks[0]))
                     st.fresh = False
                     self._finish_if_done(i, st)
-        return did
+        return True
 
     def _finish_if_done(self, slot: int, st: _Slot) -> bool:
         hit_eos = st.eos_id is not None and st.tokens and (
