@@ -554,8 +554,8 @@ def check_slot_serving() -> bool:
 def check_prefix_serving() -> bool:
     """Prefix caching (round 3): a 960-token shared header with 16-token
     suffixes and 8-token generations — the prefill-bound workload shape.
-    Captured (validate-run-r03-late.jsonl): llama3-1b 221 → 466
-    aggregate tok/s (2.11×; other captures 1.87–2.33); interactive
+    Captured (validate-run-r03-late.jsonl): llama3-1b 218 → 432
+    aggregate tok/s (1.98×; other captures 1.87–2.33); interactive
     8B-int8 at 448-prefix shapes measured 1.50× (202.6 → 303.7). Gate
     1.3: well under the captured band but above tunnel variance; the
     hermetic exactness proof is tests/test_slots.py TestPrefixCache."""
