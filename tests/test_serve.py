@@ -103,8 +103,9 @@ class TestServe:
                      {"tokens": [prompt], "maxNewTokens": 6})
         reg = _post(port, "/prefixes", {"tokens": prefix})
         assert reg["length"] == len(prefix)
-        assert _get(port, "/prefixes")["prefixes"] == [
-            {"id": reg["prefixId"], "length": len(prefix)}]
+        (snap,) = _get(port, "/prefixes")["prefixes"]
+        assert snap["id"] == reg["prefixId"]
+        assert snap["length"] == len(prefix) and snap["bytes"] > 0
         # suffix-only prefill must be token-exact vs the full prefill
         hit = _post(port, "/generate",
                     {"tokens": [prompt], "maxNewTokens": 6})

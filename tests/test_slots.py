@@ -520,7 +520,9 @@ class TestPrefixCache:
         cfg, params = setup
         eng = SlotEngine(cfg, params, slots=4, max_seq=MAX_SEQ, chunk=4)
         pid = eng.register_prefix(self.PREFIX)
-        assert eng.prefixes() == [{"id": pid, "length": 40}]
+        (snap,) = eng.prefixes()
+        assert snap["id"] == pid and snap["length"] == 40
+        assert snap["bytes"] == eng.stats["prefix_bytes"] > 0
         prompts = [self.PREFIX + [11, 12], self.PREFIX + [13],
                    [1, 2, 3], self.PREFIX + [11, 12]]
         handles = [eng.submit(p, 8) for p in prompts]
@@ -619,6 +621,25 @@ class TestPrefixCache:
         with pytest.raises(ValueError, match="no room"):
             eng.register_prefix([1] * (MAX_SEQ - 1))
         assert not eng.unregister_prefix("nope")
+
+    def test_registry_byte_budget(self, setup):
+        """Each prefix pins device HBM; a byte budget must reject a
+        registration that would exceed it, and unregistering must return
+        the bytes to the budget (ADVICE r3)."""
+        cfg, params = setup
+        probe = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4)
+        probe.register_prefix([1, 2, 3])
+        per = probe.stats["prefix_bytes"]  # bucket-32 prefix cost
+        eng = SlotEngine(cfg, params, slots=2, max_seq=MAX_SEQ, chunk=4,
+                         max_prefix_bytes=per)
+        pid = eng.register_prefix([1, 2, 3])
+        with pytest.raises(ValueError, match="byte budget"):
+            eng.register_prefix([4, 5, 6])
+        assert eng.register_prefix([1, 2, 3]) == pid  # dedup: no charge
+        assert eng.unregister_prefix(pid)
+        assert eng.stats["prefix_bytes"] == 0
+        eng.register_prefix([4, 5, 6])  # freed budget admits again
+        assert eng.stats["prefix_bytes"] == per
 
     def test_speculative_engine_rejects_prefixes(self):
         from tpu_docker_api.infer.slots import SpeculativeSlotEngine

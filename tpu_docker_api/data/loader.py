@@ -151,11 +151,13 @@ def rows_for_process(
 
 # ---- native fast path (tpu_native/dataloader.cc) --------------------------
 
+# absolute candidates only: a bare "libtpudata.so" would dlopen from the
+# default search path (LD_LIBRARY_PATH etc.), where a stale or planted
+# same-named library could shadow the real one (ADVICE r3)
 _NATIVE_PATHS = (
     os.path.join(os.path.dirname(__file__), "..", "..", "tpu_native",
                  "libtpudata.so"),
     "/usr/local/lib/libtpudata.so",
-    "libtpudata.so",
 )
 _native_cache: list = []  # [lib-or-None], memoized
 

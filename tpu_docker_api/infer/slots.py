@@ -233,6 +233,7 @@ class _Prefix:
     bucket: int                # padded device length (static shape)
     k: Any                     # (layers, bucket, n_kv_heads, head_dim)
     v: Any
+    nbytes: int = 0            # device bytes both arrays pin (HBM budget)
 
 
 class SlotEngine:
@@ -266,6 +267,7 @@ class SlotEngine:
         max_pending: int = 0,
         mesh=None,
         max_prefixes: int = 8,
+        max_prefix_bytes: int = 0,
         prefill_chunk: int = 0,
     ):
         if slots < 1:
@@ -352,6 +354,13 @@ class SlotEngine:
         #: register/unregister operations (device compute included);
         #: ``_lock`` guards the dict itself for the engine thread's reads
         self.max_prefixes = max_prefixes
+        #: byte ceiling for device-resident prefix K/V (0 = unbounded).
+        #: Each prefix pins 2 × layers × bucket × kv_heads × head_dim ×
+        #: itemsize of HBM that the engine's cache sizing never accounted
+        #: for — at 8B shapes a large bucket is tens of MB per prefix, so
+        #: mid-service registration could OOM an engine sized to fit
+        #: (ADVICE r3). The running total rides in stats["prefix_bytes"].
+        self.max_prefix_bytes = max_prefix_bytes
         self._prefixes: dict[str, _Prefix] = {}
         self._px_lock = threading.Lock()
         self._px_seq = 0
@@ -373,7 +382,8 @@ class SlotEngine:
         self.stats = {"completed": 0, "decode_chunks": 0, "prefills": 0,
                       "wasted_steps": 0, "emitted_tokens": 0,
                       "bucketed_chunks": 0, "accepted_tokens": 0,
-                      "prefix_hits": 0, "segment_prefills": 0}
+                      "prefix_hits": 0, "segment_prefills": 0,
+                      "prefix_bytes": 0}
 
     # ---- compiled programs -------------------------------------------------
 
@@ -705,25 +715,40 @@ class SlotEngine:
                     raise ValueError(
                         f"prefix registry full ({self.max_prefixes}) — "
                         f"unregister one first")
+                nbytes = (2 * self.cfg.n_layers * bucket
+                          * self.cfg.n_kv_heads * self.cfg.head_dim
+                          * self._k.dtype.itemsize)
+                if (self.max_prefix_bytes
+                        and self.stats["prefix_bytes"] + nbytes
+                        > self.max_prefix_bytes):
+                    raise ValueError(
+                        f"prefix K/V ({nbytes} B) would exceed the "
+                        f"registry byte budget ({self.max_prefix_bytes} B;"
+                        f" {self.stats['prefix_bytes']} B registered) — "
+                        f"unregister one first")
                 self._px_seq += 1
                 pid = f"px-{self._px_seq}"
             prompt = np.full((1, bucket), self.pad_id, np.int32)
             prompt[0, :len(tokens)] = tokens
             k, v = self._prefix_fn(bucket)(self.params, prompt)
             ent = _Prefix(pid=pid, tokens=key, length=len(tokens),
-                          bucket=bucket, k=k, v=v)
+                          bucket=bucket, k=k, v=v, nbytes=nbytes)
             with self._lock:
                 self._prefixes[pid] = ent
+                self.stats["prefix_bytes"] += nbytes
             return pid
 
     def unregister_prefix(self, pid: str) -> bool:
         with self._px_lock, self._lock:
-            return self._prefixes.pop(pid, None) is not None
+            ent = self._prefixes.pop(pid, None)
+            if ent is not None:
+                self.stats["prefix_bytes"] -= ent.nbytes
+            return ent is not None
 
     def prefixes(self) -> list[dict]:
         """Snapshot of the registry for introspection (serve GET)."""
         with self._lock:
-            return [{"id": p.pid, "length": p.length}
+            return [{"id": p.pid, "length": p.length, "bytes": p.nbytes}
                     for p in self._prefixes.values()]
 
     def _resolve_prefix(self, prompt: list[int]) -> _Prefix | None:

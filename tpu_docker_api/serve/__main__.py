@@ -19,7 +19,7 @@ HTTP surface (stdlib server, same envelope as the control plane):
     POST /prefixes {"tokens": [...]} → {"prefixId", "length"}
         register a shared prompt prefix (system prompt): /generate
         prompts starting with it prefill only the suffix (slot path).
-    GET  /prefixes              → {"prefixes": [{"id", "length"}]}
+    GET  /prefixes              → {"prefixes": [{"id", "length", "bytes"}]}
     DELETE /prefixes/{id}       → {"removed": bool}
 
 Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
@@ -99,6 +99,10 @@ def main(argv: list[str] | None = None) -> None:
                         "segments interleaved with decode (bounds the "
                         "stall a long admission inflicts on active "
                         "streams); 0 = whole-prompt admission")
+    p.add_argument("--max-prefix-bytes", type=int, default=256 * 2**20,
+                   help="HBM budget for POST /prefixes K/V pairs in "
+                        "bytes (0 = unbounded); registrations past it "
+                        "get a 400 instead of risking an engine OOM")
     p.add_argument("--lora-ckpt", default="",
                    help="adapter-only checkpoint dir (train --lora-rank): "
                         "merged into the base weights at load. "
@@ -234,6 +238,7 @@ def main(argv: list[str] | None = None) -> None:
                 cfg, params, slots=args.slots, max_seq=max_seq,
                 chunk=args.chunk,
                 prefill_chunk=args.prefill_chunk,
+                max_prefix_bytes=args.max_prefix_bytes,
                 mesh=mesh if multi else None,
                 # shed load once the queue is 8x the slot count deep —
                 # beyond that, added requests only buy latency, not

@@ -24,6 +24,9 @@
 //   tpudata_batch(h, step, global_batch, row_start, row_end, seed, out)
 //       fills out[(row_end-row_start) * window] as int32; returns 0
 //   tpudata_close(h)
+//       safe against concurrent tpudata_batch on the same handle: close
+//       unregisters the handle, then blocks until in-flight batch calls
+//       drain before freeing (in_use pin below)
 
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -75,6 +78,12 @@ struct Source {
   bool ready = false;
   std::vector<int32_t> ready_buf;
 
+  // guarded by g_mu: calls currently inside tpudata_batch on this
+  // handle; tpudata_close waits for it to reach 0 before deleting, so a
+  // concurrent close can never free a Source (or join a worker writing
+  // the caller's buffer) mid-fill
+  int64_t in_use = 0;
+
   ~Source() {
     {
       std::unique_lock<std::mutex> lk(mu);
@@ -88,6 +97,7 @@ struct Source {
 };
 
 std::mutex g_mu;
+std::condition_variable g_cv;  // signaled when a Source's in_use drops
 std::map<int64_t, Source*> g_sources;
 int64_t g_next_handle = 1;
 
@@ -236,15 +246,16 @@ int64_t tpudata_n_windows(int64_t handle) {
 int32_t tpudata_batch(int64_t handle, int64_t step, int64_t global_batch,
                       int64_t row_start, int64_t row_end, int64_t seed,
                       int32_t* out) {
+  if (row_end <= row_start || global_batch < 1 || step < 0 || seed < 0)
+    return -2;
   Source* s;
   {
     std::lock_guard<std::mutex> lk(g_mu);
     auto it = g_sources.find(handle);
     if (it == g_sources.end()) return -1;
     s = it->second;
+    s->in_use++;  // pins the Source against a concurrent tpudata_close
   }
-  if (row_end <= row_start || global_batch < 1 || step < 0 || seed < 0)
-    return -2;
   BatchKey key{step, global_batch, row_start, row_end, seed};
   int64_t rows = row_end - row_start;
   bool hit = false;
@@ -272,17 +283,23 @@ int32_t tpudata_batch(int64_t handle, int64_t step, int64_t global_batch,
     s->request_pending = true;
   }
   s->cv.notify_all();
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    s->in_use--;
+  }
+  g_cv.notify_all();
   return 0;
 }
 
 void tpudata_close(int64_t handle) {
   Source* s = nullptr;
   {
-    std::lock_guard<std::mutex> lk(g_mu);
+    std::unique_lock<std::mutex> lk(g_mu);
     auto it = g_sources.find(handle);
     if (it == g_sources.end()) return;
     s = it->second;
-    g_sources.erase(it);
+    g_sources.erase(it);  // unreachable to new tpudata_batch calls
+    g_cv.wait(lk, [s] { return s->in_use == 0; });  // drain in-flight
   }
   delete s;  // ~Source joins the worker and unmaps
 }
