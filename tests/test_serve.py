@@ -31,6 +31,31 @@ def _get(port, path, timeout=10):
         return json.loads(r.read())
 
 
+def _spawn_server(argv, timeout=180):
+    """Spawn `python -m tpu_docker_api.serve --port 0 <argv>` and wait
+    for its '"event": "serving"' ready line — THE spawn/readiness
+    protocol, in one place (a protocol change must not need N edits)."""
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpu_docker_api.serve",
+         "--platform", "cpu", "--host", "127.0.0.1", "--port", "0",
+         "--virtual-devices", "1", *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "PYTHONPATH": REPO})
+    port, lines = None, []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if p.poll() is not None:
+            raise RuntimeError(
+                "server died:\n" + "".join(lines) + p.stdout.read())
+        line = p.stdout.readline()
+        lines.append(line)
+        if '"event": "serving"' in line:
+            port = json.loads(line)["port"]
+            break
+    assert port, "server never became ready:\n" + "".join(lines)
+    return p, port
+
+
 @pytest.fixture(scope="module")
 def server():
     port = 18791
@@ -455,31 +480,7 @@ class TestLoraServing:
 
 class TestFamilyPresets:
     def _spawn(self, preset, extra=()):
-        import subprocess
-        import sys
-        import time as _time
-
-        p = subprocess.Popen(
-            [sys.executable, "-m", "tpu_docker_api.serve",
-             "--preset", preset, "--platform", "cpu", "--host", "127.0.0.1",
-             "--port", "0", "--virtual-devices", "1", *extra],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env={**os.environ, "PYTHONPATH": REPO},
-        )
-        port = None
-        deadline = _time.monotonic() + 120
-        lines = []
-        while _time.monotonic() < deadline:
-            if p.poll() is not None:
-                raise RuntimeError(
-                    "server died:\n" + "".join(lines) + p.stdout.read())
-            line = p.stdout.readline()
-            lines.append(line)
-            if '"event": "serving"' in line:
-                port = json.loads(line)["port"]
-                break
-        assert port, "server never became ready:\n" + "".join(lines)
-        return p, port
+        return _spawn_server(["--preset", preset, *extra], timeout=120)
 
     def test_moe_preset_serves(self):
         p, port = self._spawn("moe:moe-tiny", ("--max-seq", "64"))
@@ -521,3 +522,95 @@ class TestFamilyPresets:
         finally:
             p.terminate()
             p.wait(timeout=30)
+
+
+class TestHFCheckpointServing:
+    """--hf-ckpt: an HF-layout safetensors checkpoint (+ tokenizer.json)
+    serves end-to-end, token-exact vs the in-tree tree it was exported
+    from, and {"text": ...} bodies round-trip through the tokenizer."""
+
+    @pytest.fixture(scope="class")
+    def hf_dir(self, tmp_path_factory):
+        import jax as _jax
+
+        from tpu_docker_api.models.import_weights import export_hf_llama
+        from tpu_docker_api.models.llama import llama_init, llama_presets
+        from tokenizers import Tokenizer as RustTokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        cfg = llama_presets()["tiny"]
+        # PRNGKey(0) = the same tree a random-init `serve --preset tiny`
+        # builds, so greedy outputs must match the plain server's
+        params = llama_init(cfg, _jax.random.PRNGKey(0))
+        out = tmp_path_factory.mktemp("hf-tiny")
+        export_hf_llama(params, cfg, str(out))
+        vocab = {w: i for i, w in enumerate(
+            ["<unk>"] + [f"w{i}" for i in range(1, 32)])}
+        tok = RustTokenizer(WordLevel(vocab, unk_token="<unk>"))
+        tok.pre_tokenizer = Whitespace()
+        tok.save(str(out / "tokenizer.json"))
+        return str(out)
+
+    def _spawn(self, hf_dir, extra=()):
+        return _spawn_server(["--hf-ckpt", hf_dir, "--max-seq", "64",
+                              "--slots", "4", "--chunk", "4", *extra])
+
+    def test_hf_ckpt_serves_token_exact_with_text(self, hf_dir, server):
+        base_port, _ = server
+        p, port = self._spawn(hf_dir)
+        try:
+            h = _get(port, "/healthz")
+            assert h["tokenizer"] is True
+            body = {"tokens": [[1, 2, 3, 4]], "maxNewTokens": 6,
+                    "temperature": 0.0}
+            assert (_post(port, "/generate", body)["tokens"]
+                    == _post(base_port, "/generate", body)["tokens"])
+            out = _post(port, "/generate",
+                        {"text": ["w1 w3 w2"], "maxNewTokens": 4,
+                         "temperature": 0.0})
+            assert out["lengths"] == [4]
+            assert isinstance(out["texts"][0], str)
+            # text+tokens together is a 400, as is text w/o tokenizer
+            with pytest.raises(urllib.error.HTTPError):
+                _post(port, "/generate",
+                      {"text": ["w1"], "tokens": [[1]],
+                       "maxNewTokens": 2})
+            # /prefixes accepts ONE text string through the tokenizer
+            reg = _post(port, "/prefixes", {"text": "w1 w2 w3 w4"})
+            assert reg["length"] == 4
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, "/prefixes", {"text": ["w1"]})
+            assert e.value.code == 400
+            # streaming text mode: id lines + full decoded text on done
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps({"text": ["w2 w1"], "maxNewTokens": 3,
+                                 "temperature": 0.0,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                stream_lines = [json.loads(ln) for ln in
+                                r.read().decode().splitlines() if ln]
+            assert stream_lines[-1]["done"] is True
+            assert isinstance(stream_lines[-1]["text"], str)
+        finally:
+            p.terminate()
+            p.wait(timeout=30)
+
+    def test_hf_ckpt_quantized_int8_at_load(self, hf_dir):
+        p, port = self._spawn(hf_dir, ("--quantize",))
+        try:
+            assert _get(port, "/healthz")["quantized"] is True
+            out = _post(port, "/generate",
+                        {"tokens": [[1, 2, 3]], "maxNewTokens": 4})
+            assert len(out["tokens"][0]) == 4
+        finally:
+            p.terminate()
+            p.wait(timeout=30)
+
+    def test_text_without_tokenizer_400(self, server):
+        port, _ = server
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, "/generate", {"text": ["hi"], "maxNewTokens": 2})
+        assert e.value.code == 400

@@ -1,0 +1,378 @@
+"""HF-layout checkpoint import/export: safetensors ↔ the in-tree llama
+param tree.
+
+The reference's entire product is running REAL user images/workloads
+(/root/reference/README.md:64-92, api/gpu-docker-api-sample-interface.md
+:262-321); the TPU-serving analog of that duty is serving an actual
+pretrained checkpoint, not random-init geometry. This module is the
+bridge: a Hugging-Face-layout Llama checkpoint (config.json +
+model.safetensors, optionally sharded with an index) loads into the
+stacked-layer param tree of models/llama.py, composing with int8
+quantization at load so llama3-8b fits a single 16 GB v5e chip.
+
+Layout mapping (HF name → in-tree path; W is stored (out, in) by
+torch's Linear and transposed here to our (in, out)):
+
+    model.embed_tokens.weight            embed/tokens      (vocab, d) as-is
+    model.layers.{i}.self_attn.q_proj    layers/attn/wq    stack + .T
+    model.layers.{i}.self_attn.k_proj    layers/attn/wk    stack + .T
+    model.layers.{i}.self_attn.v_proj    layers/attn/wv    stack + .T
+    model.layers.{i}.self_attn.o_proj    layers/attn/wo    stack + .T
+    model.layers.{i}.mlp.gate_proj       layers/mlp/w_gate stack + .T
+    model.layers.{i}.mlp.up_proj         layers/mlp/w_up   stack + .T
+    model.layers.{i}.mlp.down_proj       layers/mlp/w_down stack + .T
+    model.layers.{i}.input_layernorm     layers/attn_norm  stack
+    model.layers.{i}.post_attention_layernorm  layers/mlp_norm  stack
+    model.norm.weight                    final_norm        as-is
+    lm_head.weight                       lm_head           .T (absent ⇒
+                                         tied: embed_tokens.T)
+
+RoPE needs NO head permutation: HF checkpoints store q/k in the
+rotate_half (split-halves) layout, which is exactly ops/rope.py's
+convention — both compute [x1·c − x2·s, x2·c + x1·s] over the
+(i, i + d/2) dim pairing. GQA likewise imports untouched: both sides
+order projection output channels head-major, with n_kv_heads·head_dim
+k/v rows.
+
+Int8-at-load streams layer by layer: each (out, in) tensor is read
+(zero-copy mmap slice via safetensors), transposed, quantized with
+EXACTLY infer/quantize.quantize_weight's math (absmax/127 per out
+channel in f32, round-half-even), and written into preallocated stacked
+int8/scale buffers — peak host memory is the int8 tree plus ONE layer's
+f32 temporaries, and no bf16 copy of the model ever materializes
+(~8 GB for llama3-8b instead of 16 GB + 16 GB).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "hf_llama_config", "import_hf_llama", "export_hf_llama",
+    "load_tokenizer", "HFCheckpoint",
+]
+
+_EPS = 1e-12  # quantize_weight's scale clamp — numerics must match
+
+
+class HFCheckpoint:
+    """Tensor resolver over an HF checkpoint directory (or a bare
+    .safetensors file): single ``model.safetensors`` or sharded
+    ``model-XXXXX-of-YYYYY.safetensors`` + ``model.safetensors.index
+    .json``. Tensors are read lazily per name — at no point is a whole
+    shard materialized — so the importer's peak memory stays at the
+    output tree, not the checkpoint."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._handles: dict[str, Any] = {}
+        if os.path.isfile(path):
+            self.directory = os.path.dirname(path) or "."
+            self._map = {name: os.path.basename(path)
+                         for name in self._open(os.path.basename(path))
+                         .keys()}
+            return
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint at {path}")
+        self.directory = path
+        index = os.path.join(path, "model.safetensors.index.json")
+        single = os.path.join(path, "model.safetensors")
+        if os.path.exists(index):
+            with open(index) as f:
+                self._map = dict(json.load(f)["weight_map"])
+        elif os.path.exists(single):
+            self._map = {name: "model.safetensors"
+                         for name in self._open("model.safetensors").keys()}
+        else:
+            cands = sorted(f for f in os.listdir(path)
+                           if f.endswith(".safetensors"))
+            if not cands:
+                raise FileNotFoundError(
+                    f"{path}: no model.safetensors, index, or "
+                    f"*.safetensors files")
+            self._map = {}
+            for fname in cands:
+                for name in self._open(fname).keys():
+                    self._map[name] = fname
+
+    def _open(self, fname: str):
+        h = self._handles.get(fname)
+        if h is None:
+            from safetensors import safe_open
+
+            h = safe_open(os.path.join(self.directory, fname),
+                          framework="numpy")
+            self._handles[fname] = h
+        return h
+
+    def names(self) -> list[str]:
+        return sorted(self._map)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._map
+
+    def tensor(self, name: str) -> np.ndarray:
+        fname = self._map.get(name)
+        if fname is None:
+            raise KeyError(
+                f"checkpoint {self.path} has no tensor {name!r}")
+        return self._open(fname).get_tensor(name)
+
+
+def hf_llama_config(path: str, **overrides):
+    """LlamaConfig from an HF ``config.json`` (a directory or the file
+    itself). Only llama-architecture checkpoints are accepted — the
+    geometry keys map 1:1 onto LlamaConfig."""
+    from tpu_docker_api.models.llama import LlamaConfig
+
+    cfg_path = (os.path.join(path, "config.json")
+                if os.path.isdir(path) else path)
+    with open(cfg_path) as f:
+        hf = json.load(f)
+    archs = hf.get("architectures") or []
+    if archs and not any("llama" in a.lower() for a in archs):
+        raise ValueError(
+            f"{cfg_path}: architectures {archs} is not a llama family "
+            f"checkpoint")
+    fields = dict(
+        vocab_size=hf["vocab_size"],
+        dim=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf["num_attention_heads"]),
+        ffn_dim=hf["intermediate_size"],
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+    )
+    head_dim = hf.get("head_dim")
+    if head_dim and head_dim * fields["n_heads"] != fields["dim"]:
+        raise ValueError(
+            f"{cfg_path}: head_dim {head_dim} × heads "
+            f"{fields['n_heads']} != hidden_size {fields['dim']} — "
+            f"non-uniform head layouts are not supported")
+    fields.update(overrides)
+    return LlamaConfig(**fields)
+
+
+def _np_dtype(dtype) -> np.dtype:
+    return np.dtype(dtype)  # jnp.bfloat16 → ml_dtypes bfloat16
+
+
+def _quantize_np(w: np.ndarray):
+    """quantize_weight's exact math on host: (in, out) f32 → int8 +
+    per-out-channel f32 scale. np.round and jnp.round both round half
+    to even, so the result is bit-identical to quantizing on device
+    (asserted by tests/test_import_weights.py)."""
+    wf = w.astype(np.float32)
+    scale = np.maximum(np.max(np.abs(wf), axis=-2), _EPS) / 127.0
+    w_int8 = np.clip(np.round(wf / scale[..., None, :]), -127, 127)
+    return w_int8.astype(np.int8), scale.astype(np.float32)
+
+
+def import_hf_llama(path: str, cfg=None, *, quantize: bool = False,
+                    to_device: bool = True):
+    """(cfg, params) from an HF-layout llama checkpoint.
+
+    ``cfg`` defaults to ``hf_llama_config(path)`` (the checkpoint's own
+    geometry); pass one explicitly to assert an expected preset — any
+    tensor-shape mismatch raises with the offending name. With
+    ``quantize`` every projection loads straight to int8
+    (``QuantizedLinear`` leaves, infer/quantize.py) without ever
+    materializing the bf16 tree. ``to_device=False`` returns host
+    (numpy) leaves — callers placing onto a mesh device_put with their
+    own shardings."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.ops.quant import QuantizedLinear
+
+    ckpt = path if isinstance(path, HFCheckpoint) else HFCheckpoint(path)
+    if cfg is None:
+        cfg = hf_llama_config(ckpt.directory)
+    dt = _np_dtype(cfg.dtype)
+    L, d, hd = cfg.n_layers, cfg.dim, cfg.head_dim
+
+    def get(name: str, shape: tuple[int, ...]) -> np.ndarray:
+        t = ckpt.tensor(name)
+        if tuple(t.shape) != shape:
+            raise ValueError(
+                f"{name}: shape {tuple(t.shape)} != expected {shape} "
+                f"for config (dim={d}, heads={cfg.n_heads}/"
+                f"{cfg.n_kv_heads}, ffn={cfg.ffn_dim}, "
+                f"vocab={cfg.vocab_size})")
+        return t
+
+    # (in-tree leaf, HF suffix, (in, out)) for the seven stacked
+    # projections; norms stack separately below
+    projs = [
+        (("attn", "wq"), "self_attn.q_proj", (d, cfg.n_heads * hd)),
+        (("attn", "wk"), "self_attn.k_proj", (d, cfg.n_kv_heads * hd)),
+        (("attn", "wv"), "self_attn.v_proj", (d, cfg.n_kv_heads * hd)),
+        (("attn", "wo"), "self_attn.o_proj", (cfg.n_heads * hd, d)),
+        (("mlp", "w_gate"), "mlp.gate_proj", (d, cfg.ffn_dim)),
+        (("mlp", "w_up"), "mlp.up_proj", (d, cfg.ffn_dim)),
+        (("mlp", "w_down"), "mlp.down_proj", (cfg.ffn_dim, d)),
+    ]
+    stacked: dict[tuple, Any] = {}
+    for key, suffix, (fin, fout) in projs:
+        if quantize:
+            w8 = np.empty((L, fin, fout), np.int8)
+            sc = np.empty((L, fout), np.float32)
+        else:
+            buf = np.empty((L, fin, fout), dt)
+        for i in range(L):
+            # torch Linear stores (out, in); transpose to our (in, out).
+            # The cast to the model dtype happens BEFORE quantization so
+            # int8-at-load equals import-bf16-then-quantize bit-exactly.
+            w = get(f"model.layers.{i}.{suffix}.weight",
+                    (fout, fin)).T.astype(dt)
+            if quantize:
+                w8[i], sc[i] = _quantize_np(w)
+            else:
+                buf[i] = w
+        stacked[key] = (QuantizedLinear(w8, sc) if quantize else buf)
+
+    attn_norm = np.empty((L, d), dt)
+    mlp_norm = np.empty((L, d), dt)
+    for i in range(L):
+        attn_norm[i] = get(f"model.layers.{i}.input_layernorm.weight",
+                           (d,)).astype(dt)
+        mlp_norm[i] = get(
+            f"model.layers.{i}.post_attention_layernorm.weight",
+            (d,)).astype(dt)
+
+    embed = get("model.embed_tokens.weight",
+                (cfg.vocab_size, d)).astype(dt)
+    if "lm_head.weight" in ckpt:
+        head = get("lm_head.weight", (cfg.vocab_size, d)).T.astype(dt)
+    else:
+        # tied embeddings (llama-3.2 1B/3B): the output projection IS
+        # the embedding table transposed
+        head = np.ascontiguousarray(embed.T)
+    params = {
+        "embed": {"tokens": embed},
+        "layers": {
+            "attn_norm": attn_norm,
+            "mlp_norm": mlp_norm,
+            "attn": {k[1]: stacked[k] for k in
+                     (("attn", "wq"), ("attn", "wk"), ("attn", "wv"),
+                      ("attn", "wo"))},
+            "mlp": {k[1]: stacked[k] for k in
+                    (("mlp", "w_gate"), ("mlp", "w_up"),
+                     ("mlp", "w_down"))},
+        },
+        "final_norm": get("model.norm.weight", (d,)).astype(dt),
+        "lm_head": (QuantizedLinear(*_quantize_np(head)) if quantize
+                    else head),
+    }
+    if to_device:
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+    return cfg, params
+
+
+def export_hf_llama(params: dict, cfg, out_dir: str,
+                    *, tie_embeddings: bool = False) -> str:
+    """Write an in-tree (float) param tree as an HF-layout checkpoint:
+    ``model.safetensors`` + ``config.json`` under ``out_dir``. The
+    inverse of :func:`import_hf_llama` — round-trip is bit-exact
+    (tests) — and the path that turns an in-tree orbax training
+    checkpoint into a portable artifact any HF-ecosystem tool can read.
+    ``tie_embeddings`` omits lm_head (readers reconstruct it from the
+    embedding, as import does)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    layers = params["layers"]
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"]["tokens"]),
+        "model.norm.weight": np.asarray(params["final_norm"]),
+    }
+    if not tie_embeddings:
+        tensors["lm_head.weight"] = np.ascontiguousarray(
+            np.asarray(params["lm_head"]).T)
+    hf_names = {
+        ("attn", "wq"): "self_attn.q_proj",
+        ("attn", "wk"): "self_attn.k_proj",
+        ("attn", "wv"): "self_attn.v_proj",
+        ("attn", "wo"): "self_attn.o_proj",
+        ("mlp", "w_gate"): "mlp.gate_proj",
+        ("mlp", "w_up"): "mlp.up_proj",
+        ("mlp", "w_down"): "mlp.down_proj",
+    }
+    for (group, leaf), suffix in hf_names.items():
+        w = np.asarray(layers[group][leaf])  # (L, in, out)
+        for i in range(cfg.n_layers):
+            tensors[f"model.layers.{i}.{suffix}.weight"] = (
+                np.ascontiguousarray(w[i].T))
+    for i in range(cfg.n_layers):
+        tensors[f"model.layers.{i}.input_layernorm.weight"] = (
+            np.asarray(layers["attn_norm"][i]))
+        tensors[f"model.layers.{i}.post_attention_layernorm.weight"] = (
+            np.asarray(layers["mlp_norm"][i]))
+    path = os.path.join(out_dir, "model.safetensors")
+    save_file(tensors, path, metadata={"format": "pt"})
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.dim,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_kv_heads,
+            "intermediate_size": cfg.ffn_dim,
+            "max_position_embeddings": cfg.max_seq_len,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.norm_eps,
+            "tie_word_embeddings": tie_embeddings,
+            "torch_dtype": "bfloat16",
+        }, f, indent=2)
+    return path
+
+
+@dataclasses.dataclass
+class Tokenizer:
+    """Thin text↔ids adapter over a local HF tokenizer — the hook that
+    lets serve accept {"text": ...} alongside raw token IDs. Loading is
+    strictly offline (``tokenizer.json`` / tokenizer files on disk; no
+    hub traffic)."""
+
+    _tok: Any
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._tok.encode(text))
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    @property
+    def eos_id(self) -> int | None:
+        return self._tok.eos_token_id
+
+    @property
+    def bos_id(self) -> int | None:
+        return self._tok.bos_token_id
+
+
+def load_tokenizer(path: str) -> Tokenizer:
+    """Tokenizer from a local checkpoint dir or tokenizer.json file.
+    Uses the fast (rust) tokenizer directly when a tokenizer.json
+    exists — that avoids transformers' config resolution entirely —
+    else falls back to AutoTokenizer with local_files_only."""
+    from transformers import AutoTokenizer, PreTrainedTokenizerFast
+
+    if os.path.isfile(path) and path.endswith(".json"):
+        return Tokenizer(PreTrainedTokenizerFast(tokenizer_file=path))
+    tok_json = os.path.join(path, "tokenizer.json")
+    if os.path.isfile(tok_json) and not os.path.exists(
+            os.path.join(path, "tokenizer_config.json")):
+        return Tokenizer(PreTrainedTokenizerFast(tokenizer_file=tok_json))
+    return Tokenizer(AutoTokenizer.from_pretrained(
+        path, local_files_only=True))

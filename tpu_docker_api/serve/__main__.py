@@ -13,12 +13,18 @@ HTTP surface (stdlib server, same envelope as the control plane):
                "maxNewTokens": 64, "temperature": 0.8,
                "topK": 0, "topP": 1.0, "eosId": 2,
                "stream": false}
+        with a tokenizer loaded (--tokenizer / --hf-ckpt), "text":
+        ["..."] replaces the token rows; the response adds decoded
+        "texts" and eosId defaults to the tokenizer's (explicit wins).
+        Streaming still emits token-id lines (BPE pieces don't decode
+        one id at a time); the final done line carries the full "text".
         "stream": true (one prompt row, slot path only) switches the
         response to chunked ndjson — {"t": token} per token as the
         engine resolves it, then {"done": true, "length": n}.
     POST /prefixes {"tokens": [...]} → {"prefixId", "length"}
         register a shared prompt prefix (system prompt): /generate
         prompts starting with it prefill only the suffix (slot path).
+        With a tokenizer loaded, {"text": "..."} (ONE string) works too.
     GET  /prefixes              → {"prefixes": [{"id", "length", "bytes"}]}
     DELETE /prefixes/{id}       → {"removed": bool}
 
@@ -76,6 +82,19 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--ckpt-dir", default="",
                    help="orbax checkpoint to restore; '' serves random init "
                         "(smoke/bench)")
+    p.add_argument("--hf-ckpt", default="",
+                   help="HF-layout llama checkpoint dir (config.json + "
+                        "safetensors): geometry comes from its "
+                        "config.json (--preset is ignored) and weights "
+                        "import via models/import_weights.py; composes "
+                        "with --quantize as int8-at-load (no bf16 tree "
+                        "ever materializes — llama3-8b on one v5e)")
+    p.add_argument("--tokenizer", default="",
+                   help="local HF tokenizer (dir or tokenizer.json): "
+                        "/generate additionally accepts {\"text\": "
+                        "[...]} and replies with decoded \"texts\". "
+                        "Defaults to --hf-ckpt's tokenizer.json when "
+                        "present")
     p.add_argument("--quantize", action="store_true",
                    help="int8 weight quantization at load")
     p.add_argument("--host", default="0.0.0.0")
@@ -138,14 +157,50 @@ def main(argv: list[str] | None = None) -> None:
     # family-prefixed presets, one parser shared with the trainer CLI:
     # moe:NAME serves through the same KV-cached engine; encdec:NAME
     # switches /generate to the seq2seq path (srcTokens → sampled decode)
-    family, cfg = resolve_preset(args.preset)
+    if args.hf_ckpt:
+        if args.ckpt_dir:
+            raise SystemExit("--hf-ckpt and --ckpt-dir are exclusive")
+        from tpu_docker_api.models.import_weights import hf_llama_config
+
+        family, cfg = "llama", hf_llama_config(args.hf_ckpt)
+        args.preset = os.path.basename(os.path.normpath(args.hf_ckpt))
+    else:
+        family, cfg = resolve_preset(args.preset)
     if family == "vit":
         raise SystemExit("vit presets have no generative serving path")
     is_encdec = family == "encdec"
     if args.quantize and family != "llama":
         raise SystemExit("--quantize currently supports llama presets only")
     mesh = build_mesh(MeshPlan(dp=args.dp, fsdp=args.fsdp, tp=args.tp, sp=1))
-    if args.ckpt_dir:
+    quantized_at_load = False
+    if args.hf_ckpt:
+        from tpu_docker_api.models.import_weights import import_hf_llama
+
+        if mesh.devices.size > 1:
+            # meshes: import bf16 to HOST, place into shards, quantize
+            # on device (the shard-local halves of the existing
+            # quantize path); single-chip 8B must take the streaming
+            # int8 branch below instead — bf16 wouldn't fit
+            from tpu_docker_api.parallel.sharding import param_shardings
+            from tpu_docker_api.models import model_fns as _mf
+
+            _, host = import_hf_llama(args.hf_ckpt, cfg, to_device=False)
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), host)
+            params = jax.device_put(
+                host, param_shardings(abstract, mesh,
+                                      _mf(cfg)[2]))
+            del host
+        else:
+            # LoRA merge must precede (lossy) quantization, so with
+            # --lora-ckpt the import stays bf16 and the shared
+            # merge-then-quantize path below runs
+            q_now = args.quantize and not args.lora_ckpt
+            _, params = import_hf_llama(args.hf_ckpt, cfg,
+                                        quantize=q_now)
+            quantized_at_load = q_now
+        step = 0
+    elif args.ckpt_dir:
         # params-only restore: the optimizer moments are never read
         # (PLACEHOLDER) — works whatever optimizer the training run
         # used, and at 8B the moments would not even fit one chip
@@ -183,10 +238,20 @@ def main(argv: list[str] | None = None) -> None:
                                     args.lora_rank, targets)
         params = merge_lora(params, adapters, alpha=args.lora_alpha)
         del adapters
-    if args.quantize:
+    if args.quantize and not quantized_at_load:
         from tpu_docker_api.infer.quantize import quantize_llama_params
 
         params = quantize_llama_params(params)
+
+    tokenizer = None
+    tok_path = args.tokenizer
+    if not tok_path and args.hf_ckpt and os.path.exists(
+            os.path.join(args.hf_ckpt, "tokenizer.json")):
+        tok_path = args.hf_ckpt
+    if tok_path:
+        from tpu_docker_api.models.import_weights import load_tokenizer
+
+        tokenizer = load_tokenizer(tok_path)
 
     max_seq = args.max_seq or (cfg.max_tgt_len if is_encdec
                                else cfg.max_seq_len)
@@ -373,6 +438,7 @@ def main(argv: list[str] | None = None) -> None:
                     "status": "ok", "model": args.preset, "step": step,
                     "quantized": args.quantize,
                     "devices": len(jax.devices()),
+                    "tokenizer": tokenizer is not None,
                 }
                 code = 200
                 if slot_engine is not None:
@@ -420,6 +486,25 @@ def main(argv: list[str] | None = None) -> None:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
                     toks = req.get("tokens") if isinstance(req, dict) else None
+                    text = (req.get("text") if isinstance(req, dict)
+                            else None)
+                    if text is not None:
+                        # same diagnostics contract as /generate: text
+                        # without a tokenizer (or of the wrong shape)
+                        # must say so, not "tokens must be ids"
+                        if tokenizer is None:
+                            raise ValueError(
+                                '"text" requires --tokenizer (or an '
+                                '--hf-ckpt shipping a tokenizer.json)')
+                        if toks is not None:
+                            raise ValueError(
+                                '"text" and "tokens" are exclusive')
+                        if not isinstance(text, str) or not text:
+                            raise ValueError(
+                                '"text" must be ONE non-empty string '
+                                'here (a prefix is a single shared '
+                                'header, not a batch)')
+                        toks = tokenizer.encode(text)
                     if not valid_token_row(toks):
                         raise ValueError(
                             f"tokens must be a non-empty list of ids in "
@@ -441,6 +526,22 @@ def main(argv: list[str] | None = None) -> None:
                 if not isinstance(req, dict):
                     raise ValueError("body must be a JSON object")
                 prompts = req.get("srcTokens" if is_encdec else "tokens")
+                texts_in = req.get("text")
+                if texts_in is not None:
+                    if tokenizer is None:
+                        raise ValueError(
+                            '"text" requires --tokenizer (or an '
+                            '--hf-ckpt shipping a tokenizer.json)')
+                    if prompts is not None:
+                        raise ValueError(
+                            '"text" and token-id rows are exclusive')
+                    if (not isinstance(texts_in, list) or not texts_in
+                            or not all(isinstance(t, str) and t
+                                       for t in texts_in)):
+                        raise ValueError(
+                            '"text" must be a non-empty list of '
+                            'non-empty strings')
+                    prompts = [tokenizer.encode(t) for t in texts_in]
                 if not prompts or not all(
                         valid_token_row(r) for r in prompts):
                     raise ValueError(
@@ -474,6 +575,12 @@ def main(argv: list[str] | None = None) -> None:
                 top_p = req_float("topP", 1.0)
                 eos_id = (req_int("eosId", 0)
                           if "eosId" in req else None)
+                if (eos_id is None and texts_in is not None
+                        and tokenizer.eos_id is not None):
+                    # text-mode requests stop at the tokenizer's eos by
+                    # default — that's what "serve a real model" means;
+                    # an explicit eosId still wins
+                    eos_id = tokenizer.eos_id
                 do_stream = req.get("stream", False)
                 if not isinstance(do_stream, bool):
                     raise ValueError("stream must be a JSON boolean")
@@ -536,21 +643,32 @@ def main(argv: list[str] | None = None) -> None:
                                 self._chunk(json.dumps({"t": t}).encode()
                                             + b"\n")
                             res = handles[0].result(0)
-                            self._chunk(json.dumps(
-                                {"done": True,
-                                 "length": res["length"]}).encode()
-                                + b"\n")
+                            done: dict = {"done": True,
+                                          "length": res["length"]}
+                            if texts_in is not None:
+                                # per-token decode is lossy for BPE
+                                # (multi-byte pieces); the full decoded
+                                # text rides the done line instead
+                                done["text"] = tokenizer.decode(
+                                    res["tokens"][:res["length"]])
+                            self._chunk(json.dumps(done).encode()
+                                        + b"\n")
                             self.wfile.write(b"0\r\n\r\n")
                         except Exception:  # noqa: BLE001
                             self.close_connection = True
                         return
                     outs = [h.result(timeout=600) for h in handles]
-                    self._reply(200, {
+                    payload = {
                         "tokens": [o["tokens"]
                                    + [0] * (max_new - o["length"])
                                    for o in outs],
                         "lengths": [o["length"] for o in outs],
-                    })
+                    }
+                    if texts_in is not None:
+                        payload["texts"] = [
+                            tokenizer.decode(o["tokens"][:o["length"]])
+                            for o in outs]
+                    self._reply(200, payload)
                     return
 
                 lens = {len(r) for r in prompts}
@@ -567,6 +685,12 @@ def main(argv: list[str] | None = None) -> None:
                 payload = {"tokens": np.asarray(out["tokens"]).tolist()}
                 if "lengths" in out:
                     payload["lengths"] = np.asarray(out["lengths"]).tolist()
+                if texts_in is not None:
+                    lens = payload.get("lengths",
+                                       [max_new] * len(payload["tokens"]))
+                    payload["texts"] = [
+                        tokenizer.decode(row[:n]) for row, n in
+                        zip(payload["tokens"], lens)]
                 self._reply(200, payload)
             except (ValueError, errors.BadRequest) as e:
                 self._reply(400, {"error": str(e)})

@@ -33,6 +33,7 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -78,11 +79,14 @@ struct Source {
   bool ready = false;
   std::vector<int32_t> ready_buf;
 
-  // guarded by g_mu: calls currently inside tpudata_batch on this
-  // handle; tpudata_close waits for it to reach 0 before deleting, so a
-  // concurrent close can never free a Source (or join a worker writing
-  // the caller's buffer) mid-fill
-  int64_t in_use = 0;
+  // calls currently inside tpudata_batch on this handle; tpudata_close
+  // waits for it to reach 0 before deleting, so a concurrent close can
+  // never free a Source (or join a worker writing the caller's buffer)
+  // mid-fill. Incremented under g_mu (so it cannot rise after close
+  // unregisters the handle), decremented under this->mu + cv notify
+  // (so close's wait is local to THIS source — a slow fill on one
+  // handle must not stall the whole registry behind g_mu).
+  std::atomic<int64_t> in_use{0};
 
   ~Source() {
     {
@@ -97,7 +101,6 @@ struct Source {
 };
 
 std::mutex g_mu;
-std::condition_variable g_cv;  // signaled when a Source's in_use drops
 std::map<int64_t, Source*> g_sources;
 int64_t g_next_handle = 1;
 
@@ -254,7 +257,7 @@ int32_t tpudata_batch(int64_t handle, int64_t step, int64_t global_batch,
     auto it = g_sources.find(handle);
     if (it == g_sources.end()) return -1;
     s = it->second;
-    s->in_use++;  // pins the Source against a concurrent tpudata_close
+    s->in_use.fetch_add(1);  // pins against a concurrent tpudata_close
   }
   BatchKey key{step, global_batch, row_start, row_end, seed};
   int64_t rows = row_end - row_start;
@@ -282,24 +285,29 @@ int32_t tpudata_batch(int64_t handle, int64_t step, int64_t global_batch,
                               seed};
     s->request_pending = true;
   }
-  s->cv.notify_all();
   {
-    std::lock_guard<std::mutex> lk(g_mu);
-    s->in_use--;
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->in_use.fetch_sub(1);
   }
-  g_cv.notify_all();
+  s->cv.notify_all();
   return 0;
 }
 
 void tpudata_close(int64_t handle) {
   Source* s = nullptr;
   {
-    std::unique_lock<std::mutex> lk(g_mu);
+    std::lock_guard<std::mutex> lk(g_mu);
     auto it = g_sources.find(handle);
     if (it == g_sources.end()) return;
     s = it->second;
     g_sources.erase(it);  // unreachable to new tpudata_batch calls
-    g_cv.wait(lk, [s] { return s->in_use == 0; });  // drain in-flight
+  }
+  {
+    // drain in-flight batch calls on THIS source only — g_mu is
+    // already released, so other handles stay fully serviceable even
+    // if a fill here takes seconds of cold page-ins
+    std::unique_lock<std::mutex> lk(s->mu);
+    s->cv.wait(lk, [s] { return s->in_use.load() == 0; });
   }
   delete s;  // ~Source joins the worker and unmaps
 }
