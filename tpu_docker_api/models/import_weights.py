@@ -376,3 +376,44 @@ def load_tokenizer(path: str) -> Tokenizer:
         return Tokenizer(PreTrainedTokenizerFast(tokenizer_file=tok_json))
     return Tokenizer(AutoTokenizer.from_pretrained(
         path, local_files_only=True))
+
+
+def _main(argv=None) -> None:
+    """CLI: turn an in-tree orbax training checkpoint into a portable
+    HF-layout artifact (the outbound half of the real-weights duty):
+
+        python -m tpu_docker_api.models.import_weights \
+            --ckpt-dir /ckpt --preset llama3-1b --out /export [--tie]
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_docker_api.models.import_weights")
+    p.add_argument("--ckpt-dir", required=True,
+                   help="orbax training checkpoint to export")
+    p.add_argument("--preset", required=True,
+                   help="llama preset the checkpoint was trained at")
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--tie", action="store_true",
+                   help="omit lm_head (tied-embedding layout)")
+    p.add_argument("--platform", default="",
+                   help="force a jax platform (tests: cpu)")
+    args = p.parse_args(argv)
+
+    from tpu_docker_api.workload.jaxenv import bootstrap_jax
+
+    bootstrap_jax(args.platform, 0)
+
+    from tpu_docker_api.models.llama import llama_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.checkpoint import restore_model_params
+
+    cfg = llama_presets()[args.preset]
+    mesh = build_mesh(MeshPlan(dp=-1, fsdp=1, tp=1, sp=1))
+    params, step = restore_model_params(args.ckpt_dir, cfg, mesh)
+    path = export_hf_llama(params, cfg, args.out, tie_embeddings=args.tie)
+    print(json.dumps({"event": "exported", "step": step, "path": path}))
+
+
+if __name__ == "__main__":
+    _main()
