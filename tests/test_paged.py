@@ -1,0 +1,270 @@
+"""Paged-KV slot engine (infer/paged.py + ops/paged.py).
+
+The dense engine's exactness contract re-proven over the page pool —
+per-stream outputs token-exact vs an isolated greedy decode for any
+admission order, slot reuse, pool exhaustion, deferred admissions, and
+page recycling — plus the capacity claims: a pool smaller than
+slots × max_seq serves traffic the dense allocation could not fit, and
+quarantined frees keep stale lanes from corrupting reissued pages.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
+from tpu_docker_api.infer.paged import PagedSlotEngine
+from tpu_docker_api.models.llama import llama_init, llama_presets
+
+MAX_SEQ = 96
+PAGE = 16
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = llama_presets()["tiny"]
+    params = llama_init(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def isolated_greedy(cfg, params, prompt, max_new, eos_id=None,
+                    max_seq=MAX_SEQ):
+    fn = make_generate_fn(
+        cfg, GenerateConfig(max_new_tokens=max_new, temperature=0.0,
+                            eos_id=eos_id, max_seq=max_seq))
+    out = fn(params, jnp.asarray([prompt], jnp.int32),
+             jax.random.PRNGKey(0))
+    toks = np.asarray(out["tokens"])[0]
+    n = int(np.asarray(out["lengths"])[0])
+    return toks[:n].tolist()
+
+
+def run_all(eng, handles, limit=500):
+    for _ in range(limit):
+        if all(h.done() for h in handles):
+            return
+        eng.step()
+    raise AssertionError("requests did not complete")
+
+
+class TestTokenExact:
+    def test_single_request_matches_isolated(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                              max_seq=MAX_SEQ, chunk=4)
+        prompt = [3, 1, 4, 1, 5]
+        h = eng.submit(prompt, max_new=12)
+        run_all(eng, [h])
+        got = h.result(0)
+        assert got["tokens"] == isolated_greedy(cfg, params, prompt, 12)
+        assert got["length"] == 12
+
+    def test_concurrent_mixed_lengths_token_exact(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                              max_seq=MAX_SEQ, chunk=4)
+        prompts = [[2, 7, 1], [9] * 20, [5, 5], [1, 2, 3, 4, 5, 6, 7],
+                   [8, 6, 4], [11, 13]]
+        max_news = [10, 6, 13, 9, 5, 16]
+        handles = [eng.submit(p, m) for p, m in zip(prompts, max_news)]
+        run_all(eng, handles)
+        for p, m, h in zip(prompts, max_news, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, m)
+
+    def test_slot_reuse_recycles_pages_exactly(self, setup):
+        """More requests than slots: completions recycle pages through
+        quarantine into later admissions — late requests stay exact."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=3)
+        prompts = [[i + 1, i + 2, i + 3] for i in range(7)]
+        handles = [eng.submit(p, 8) for p in prompts[:3]]
+        for step in range(400):
+            eng.step()
+            if step == 2:
+                handles += [eng.submit(p, 8) for p in prompts[3:]]
+            if len(handles) == 7 and all(h.done() for h in handles):
+                break
+        assert eng.stats["completed"] == 7
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 8)
+        # every page returned (possibly via quarantine still pending)
+        eng.step()
+        assert (eng.stats["pages_free"]
+                + sum(len(p) for _, p in eng._quarantine)
+                == eng.stats["pages_total"])
+
+    def test_sampling_paths_run(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4)
+        hs = [eng.submit([1, 2, 3], 6, temperature=0.8),
+              eng.submit([4, 5], 6, temperature=0.9, top_k=4,
+                         top_p=0.9)]
+        run_all(eng, hs)
+        for h in hs:
+            toks = h.result(0)["tokens"]
+            assert len(toks) == 6
+            assert all(0 <= t < cfg.vocab_size for t in toks)
+
+    def test_eos_and_max_new_1(self, setup):
+        cfg, params = setup
+        prompt = [3, 1, 4, 1, 5]
+        ref = isolated_greedy(cfg, params, prompt, 12)
+        eos = ref[3]
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4)
+        h = eng.submit(prompt, 12, eos_id=eos)
+        h1 = eng.submit([7, 7, 7], 1)
+        run_all(eng, [h, h1])
+        assert h.result(0)["tokens"] == ref[:ref.index(eos) + 1]
+        assert h1.result(0)["length"] == 1
+        assert h1.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [7, 7, 7], 1)
+
+
+class TestCapacity:
+    def test_pool_smaller_than_dense_serves_short_requests(self, setup):
+        """The capacity point: 4 slots × 96 capacity would need 24
+        dense pages/slot-row; a 12-page pool (1/8 of dense) still
+        serves 4 concurrent short requests, token-exact."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                              max_seq=MAX_SEQ, chunk=4, total_pages=12)
+        assert eng.stats["pages_total"] == 12
+        prompts = [[i + 1, i + 2] for i in range(4)]
+        handles = [eng.submit(p, 8) for p in prompts]
+        run_all(eng, handles)
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 8)
+
+    def test_exhausted_pool_defers_then_completes_fcfs(self, setup):
+        """Pool covers ~one long request: concurrent submits defer and
+        complete serially, in order, token-exact — no leapfrogging."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                              max_seq=MAX_SEQ, chunk=4, total_pages=4)
+        # each needs 3 pages (bucket 32 → 2, +tokens) → only one fits
+        prompts = [[9] * 30, [1] * 30, [5] * 30]
+        handles = [eng.submit(p, 16) for p in prompts]
+        run_all(eng, handles, limit=900)
+        assert eng.stats["deferred_admissions"] >= 1
+        done_order = sorted(range(3),
+                            key=lambda i: handles[i].completed_at)
+        assert done_order == [0, 1, 2]
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 16)
+
+    def test_request_larger_than_pool_rejected(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4, total_pages=2)
+        with pytest.raises(ValueError, match="pages"):
+            eng.submit([1] * 40, 30)
+
+    def test_stale_lanes_cannot_corrupt_reissued_pages(self, setup):
+        """The quarantine property under maximal pressure: a tiny pool
+        with immediate resubmission after every completion — stale
+        lanes still decoding at the pipeline lag must never write into
+        pages already handed to a new request."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=3,
+                              max_seq=MAX_SEQ, chunk=3, total_pages=6,
+                              pipeline=3)
+        prompts = [[i + 2, i + 5, i + 1] for i in range(9)]
+        handles = [eng.submit(p, 7) for p in prompts]
+        run_all(eng, handles, limit=900)
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 7)
+
+
+class TestEdges:
+    def test_capacity_boundary_request_admits(self, setup):
+        """prompt+max_new-1 == max_seq (validate's boundary) must fit
+        the table row exactly — the reservation can never exceed
+        max_pages_per_slot (review r4: the off-by-one killed the
+        engine thread)."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4)
+        prompt = [((i * 3) % 250) + 1 for i in range(65)]
+        h = eng.submit(prompt, 32)  # 65 + 32 - 1 == 96 == max_seq
+        run_all(eng, [h], limit=900)
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 32)
+
+    def test_non_pow2_max_seq_bucket_divisibility_rejected(self, setup):
+        """max_seq 48 yields bucket 48; page 32 divides the smallest
+        bucket but not 48 — must be rejected at construction, not
+        crash at first admission."""
+        cfg, params = setup
+        with pytest.raises(ValueError, match="every prefill bucket"):
+            PagedSlotEngine(cfg, params, page_size=32, slots=2,
+                            max_seq=48, chunk=4)
+
+    def test_deferred_handles_fail_on_close_and_die(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                              max_seq=MAX_SEQ, chunk=4, total_pages=4)
+        h1 = eng.submit([9] * 30, 16)   # takes the whole pool
+        h2 = eng.submit([1] * 30, 16)   # deferred
+        for _ in range(6):
+            eng.step()
+        assert eng._deferred and not h2.done()
+        eng.close()
+        with pytest.raises(RuntimeError, match="engine closed"):
+            h2.result(0)
+        # _die path: park a deferred handle, then kill the engine
+        eng2 = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                               max_seq=MAX_SEQ, chunk=4, total_pages=4)
+        d1 = eng2.submit([9] * 30, 16)
+        d2 = eng2.submit([1] * 30, 16)
+        for _ in range(6):
+            eng2.step()
+        assert eng2._deferred
+        eng2._die(RuntimeError("boom"))
+        with pytest.raises(RuntimeError, match="engine failed"):
+            d2.result(0)
+        del h1, d1
+
+    def test_deferred_counter_counts_once(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
+                              max_seq=MAX_SEQ, chunk=4, total_pages=4)
+        h1 = eng.submit([9] * 30, 16)
+        h2 = eng.submit([1] * 30, 16)
+        for _ in range(12):  # many re-attempts while h1 decodes
+            eng.step()
+        assert eng.stats["deferred_admissions"] == 1
+        run_all(eng, [h1, h2], limit=900)
+        assert eng.stats["deferred_admissions"] == 1
+
+
+class TestScope:
+    def test_v1_scope_rejections(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="power of two"):
+            PagedSlotEngine(cfg, params, page_size=3)
+        with pytest.raises(ValueError, match="chunked prefill"):
+            PagedSlotEngine(cfg, params, page_size=PAGE,
+                            prefill_chunk=8)
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4)
+        with pytest.raises(ValueError, match="not supported"):
+            eng.register_prefix([1, 2, 3])
+
+    def test_warmup_then_thread_loop(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4)
+        eng.warmup(buckets=(32,))
+        with eng:
+            h = eng.submit([2, 4, 6], 8)
+            assert h.result(60)["tokens"] == isolated_greedy(
+                cfg, params, [2, 4, 6], 8)

@@ -614,3 +614,24 @@ class TestHFCheckpointServing:
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(port, "/generate", {"text": ["hi"], "maxNewTokens": 2})
         assert e.value.code == 400
+
+
+class TestPagedServing:
+    def test_page_size_serves_with_page_stats(self):
+        p, port = _spawn_server(
+            ["--preset", "tiny", "--max-seq", "64", "--slots", "4",
+             "--chunk", "4", "--page-size", "16", "--total-pages", "8"])
+        try:
+            h = _get(port, "/healthz")
+            assert h["slotEngine"]["pages_total"] == 8
+            out = _post(port, "/generate",
+                        {"tokens": [[1, 2, 3, 4], [9, 8]],
+                         "maxNewTokens": 6, "temperature": 0.0})
+            assert [len(r) for r in out["tokens"]] == [6, 6]
+            # /prefixes is a clean 400 on the paged engine (v1 scope)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(port, "/prefixes", {"tokens": [1, 2, 3]})
+            assert e.value.code == 400
+        finally:
+            p.terminate()
+            p.wait(timeout=30)

@@ -1,0 +1,436 @@
+"""Paged-KV continuous batching: the slot engine over a page pool.
+
+The dense :class:`~tpu_docker_api.infer.slots.SlotEngine` preallocates
+``slots × max_seq`` cache positions. At llama3-8b shapes one position
+costs ~128 KB across layers, so 32 slots × 2048 capacity is 8 GB of
+HBM — it cannot coexist with 8 GB of int8 weights on a 16 GB v5e. This
+engine replaces the dense buffer with a POOL of fixed-size pages
+(ops/paged.py) and per-slot page lists, so HBM scales with the pool
+(sized to expected live tokens), and serving points the dense cache
+cannot reach become reachable (the verdict's bar: 32 streams × 2048 on
+one v5e).
+
+Design (everything else — chunked decode, pipeline lag, admission
+batching, sampling, drain — is inherited):
+
+- **Reservation at admission**: a request reserves
+  ``ceil(max(bucket, prompt+max_new)/page)`` pages up front; if the
+  pool can't cover it the request (and everything behind it — strict
+  FCFS, no leapfrogging starvation) waits in a deferred queue until
+  completions release pages. No mid-flight OOM, no preemption; the
+  lazy-growth/preempt-restore refinement is future work and recorded
+  here as the deliberate v1 scope.
+- **The page table is a per-dispatch host operand**, never device
+  state: repaging between dispatches is free, and the engine keeps its
+  zero-eager-ops rule (slots.py module docstring). Tables are (S, mp)
+  with mp a geometric page-count bucket — decode reads scale with live
+  pages, like the dense engine's kv_limit buckets.
+- **Quarantined frees**: a completed slot's lanes keep decoding garbage
+  until the host processes that chunk (pipeline lag), and chunks
+  already dispatched carry the OLD table — so freed pages are
+  quarantined until every chunk dispatched before the free is
+  processed, and the freed slot's table rows point at the trash page
+  from the next dispatch on. Only then can pages be reissued.
+- **Prefill is unchanged**: the bucket forward runs on a fresh dense
+  temp cache exactly as the dense engine's, and only the final
+  "drop into the big cache" becomes a page scatter.
+
+Token-exactness carries over from the dense engine because reads
+gather pages into a view element-identical to the dense cache prefix
+(ops/paged.py rationale); tests/test_paged.py re-runs the exactness
+contract under admission orders, slot reuse, pool exhaustion, and
+deferred admissions.
+
+v1 scope: llama-family, single device, whole-prompt admission (no
+``prefill_chunk``), no prefix caching, no speculative composition —
+each raises explicitly rather than degrading.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_docker_api.infer.slots import SlotEngine, _Slot
+from tpu_docker_api.models.llama import LlamaConfig, llama_forward_paged
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class PagedSlotEngine(SlotEngine):
+    """Slot engine whose KV cache is a page pool. ``total_pages`` sizes
+    the pool in usable pages (page 0 is reserved as the trash page);
+    the default equals the dense engine's capacity — pass fewer to
+    trade capacity headroom for HBM."""
+
+    def __init__(self, cfg, params, *, page_size: int = 64,
+                 total_pages: int | None = None, **kwargs):
+        if not isinstance(cfg, LlamaConfig):
+            raise ValueError(
+                "the paged engine serves llama-family configs only (v1)")
+        if kwargs.get("mesh") is not None:
+            raise ValueError("the paged engine is single-device (v1)")
+        if kwargs.get("prefill_chunk"):
+            raise ValueError(
+                "chunked prefill is not supported on the paged engine "
+                "(v1 scope: whole-prompt admission)")
+        if page_size < 1 or (page_size & (page_size - 1)):
+            raise ValueError(
+                f"page_size must be a power of two, got {page_size}")
+        self.page_size = page_size
+        self._total_pages = total_pages
+        super().__init__(cfg, params, **kwargs)
+        bad = [b for b in self.buckets if b % page_size]
+        if bad:
+            # prefill reshapes each row's bucket into bucket//page pages
+            raise ValueError(
+                f"page_size {page_size} must divide every prefill "
+                f"bucket; {bad} are not divisible")
+        # bookkeeping (engine-thread only, like the base's _table values)
+        self._slot_pages: dict[int, list[int]] = {}
+        self._deferred: list = []
+        self._quarantine: list[tuple[int, list[int]]] = []
+        self.stats["pages_total"] = self._usable_pages
+        self.stats["pages_free"] = len(self._free)
+        self.stats["deferred_admissions"] = 0
+
+    # ---- pool ---------------------------------------------------------------
+
+    @property
+    def _max_pages_per_slot(self) -> int:
+        return _ceil_div(self.max_seq, self.page_size)
+
+    def _alloc_cache(self, cache_dtype):
+        cfg = self.cfg
+        usable = (self._total_pages
+                  if self._total_pages is not None
+                  else self.slots * self._max_pages_per_slot)
+        if usable < 1:
+            raise ValueError(f"total_pages must be >= 1, got {usable}")
+        self._usable_pages = usable
+        # page 0 = trash; free list pops from the low end so tests can
+        # predict reuse order
+        self._free = list(range(usable, 0, -1))
+        shape = (cfg.n_layers, usable + 1, self.page_size,
+                 cfg.n_kv_heads, cfg.head_dim)
+        self._ptable = np.zeros(
+            (self.slots, self._max_pages_per_slot), np.int32)
+        return jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype)
+
+    def _release_quarantine(self) -> None:
+        """Return quarantined pages whose barrier has passed: every
+        chunk dispatched before the free (and therefore carrying a
+        table that still named these pages) has been processed."""
+        processed = self.stats["decode_chunks"] - len(self._outstanding)
+        keep = []
+        for barrier, pages in self._quarantine:
+            if barrier <= processed:
+                self._free.extend(pages)
+            else:
+                keep.append((barrier, pages))
+        self._quarantine = keep
+        self.stats["pages_free"] = len(self._free)
+
+    def _pages_needed(self, prompt_len: int, max_new: int,
+                      bucket: int) -> int:
+        # prefill writes [0, bucket); live decode writes up to
+        # prompt+max_new-2 (the final emitted token is only WRITTEN by
+        # a garbage continuation step, which may fall to trash) — pages
+        # cover one position beyond the live reach, and never more than
+        # validate()'s prompt+max_new-1 <= max_seq bound, so the
+        # reservation always fits the _ptable row
+        return _ceil_div(max(bucket, prompt_len + max_new - 1),
+                         self.page_size)
+
+    # ---- request API --------------------------------------------------------
+
+    def validate(self, prompt, max_new, top_k=0, top_p=1.0):
+        super().validate(prompt, max_new, top_k=top_k, top_p=top_p)
+        bucket = next(b for b in self.buckets if b >= len(prompt))
+        need = self._pages_needed(len(prompt), max_new, bucket)
+        if need > self._usable_pages:
+            raise ValueError(
+                f"request needs {need} pages "
+                f"({len(prompt)}+{max_new} tokens at page size "
+                f"{self.page_size}); the pool has {self._usable_pages}")
+
+    def register_prefix(self, tokens):
+        raise ValueError(
+            "prefix caching is not supported on the paged engine (v1 "
+            "scope — use the dense SlotEngine for prefix-heavy traffic)")
+
+    # ---- compiled programs --------------------------------------------------
+
+    def _prefill_fn(self, bucket: int, rows: int = 1):
+        """Batched prefill: identical forward on a fresh dense temp
+        cache, then a page SCATTER instead of the dense row drop.
+        ``page_ids`` (rows, bucket//page) is the host-assigned
+        destination for each row's bucket-worth of positions."""
+        fn = self._prefill_fns.get((bucket, rows))
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        cache_dtype = self._k.dtype
+        page = self.page_size
+        npg = bucket // page
+
+        def prefill(params, prompts, actual_lens, slots, page_ids,
+                    temps, topks, topps, seed, k_pool, v_pool, dtok,
+                    dpos, dtemp, dtopk, dtopp):
+            L = cfg.n_layers
+            shape = (L, rows, bucket, cfg.n_kv_heads, cfg.head_dim)
+            kc = jnp.zeros(shape, cache_dtype)
+            vc = jnp.zeros(shape, cache_dtype)
+            logits, kc, vc = fwd(params, prompts, cfg, kc, vc,
+                                 jnp.int32(0), None,
+                                 last_only=actual_lens - 1)
+            toks = self._sample_filtered(
+                logits[:, 0], temps, topks, topps,
+                jax.random.PRNGKey(seed))
+            ids = page_ids.reshape(-1)  # (rows*npg,) all distinct
+            src_k = kc.reshape(L, rows * npg, page,
+                               cfg.n_kv_heads, cfg.head_dim)
+            src_v = vc.reshape(L, rows * npg, page,
+                               cfg.n_kv_heads, cfg.head_dim)
+            k_pool = k_pool.at[:, ids].set(src_k)
+            v_pool = v_pool.at[:, ids].set(src_v)
+            dtok = dtok.at[slots].set(toks)
+            dpos = dpos.at[slots].set(actual_lens)
+            dtemp = dtemp.at[slots].set(temps)
+            dtopk = dtopk.at[slots].set(topks)
+            dtopp = dtopp.at[slots].set(topps)
+            return toks, k_pool, v_pool, dtok, dpos, dtemp, dtopk, dtopp
+
+        fn = jax.jit(prefill, donate_argnums=(9, 10, 11, 12, 13, 14, 15))
+        self._prefill_fns[(bucket, rows)] = fn
+        return fn
+
+    def _decode(self, mp: int, filtered: bool = False):
+        """K-step decode chunk over the page pool; ``table`` (S, mp)
+        rides as a host operand, constant across the chunk (the host
+        reserves pages to cover the chunk's reach before dispatch)."""
+        fn = self._decode_fns.get(("paged", mp, filtered))
+        if fn is not None:
+            return fn
+        cfg, K = self.cfg, self.chunk
+        max_pos = self.max_seq
+
+        def decode_chunk(params, seed, table, dtok, dpos, dtemp, dtopk,
+                         dtopp, k_pool, v_pool):
+            def body(carry, step_key):
+                tok, pos, kp, vp = carry
+                logits, kp, vp = llama_forward_paged(
+                    params, tok[:, None], cfg, kp, vp, table, pos,
+                    max_pos=max_pos)
+                if filtered:
+                    nxt = self._sample_filtered(
+                        logits[:, -1], dtemp, dtopk, dtopp, step_key)
+                else:
+                    nxt = self._sample(logits[:, -1], dtemp, step_key)
+                return (nxt, pos + 1, kp, vp), nxt
+
+            keys = jax.random.split(jax.random.PRNGKey(seed), K)
+            (tok, pos, k_pool, v_pool), out = lax.scan(
+                body, (dtok, dpos, k_pool, v_pool), keys)
+            out_full = jnp.concatenate([dtok[:, None], out.T], axis=1)
+            return out_full, tok, pos, k_pool, v_pool
+
+        fn = jax.jit(decode_chunk, donate_argnums=(3, 4, 8, 9))
+        self._decode_fns[("paged", mp, filtered)] = fn
+        return fn
+
+    def warmup(self, buckets=None, rows=(1,)):
+        if self._thread is not None:
+            raise RuntimeError("warmup must run before start()")
+        for b in (self.buckets if buckets is None else buckets):
+            for R in sorted({min(r, self.slots) for r in rows}):
+                ids = np.zeros((R, b // self.page_size), np.int32)
+                (_, self._k, self._v, self._dtok, self._dpos,
+                 self._dtemp, self._dtopk,
+                 self._dtopp) = self._prefill_fn(b, R)(
+                    self.params, np.zeros((R, b), np.int32),
+                    np.ones((R,), np.int32),
+                    np.arange(R, dtype=np.int32), ids,
+                    np.zeros((R,), np.float32), np.zeros((R,), np.int32),
+                    np.ones((R,), np.float32), np.uint32(0),
+                    self._k, self._v, self._dtok, self._dpos,
+                    self._dtemp, self._dtopk, self._dtopp)
+        # EVERY geometric mp bucket: warming only one would leave the
+        # rest to compile mid-service on the engine thread, the exact
+        # stall warmup exists to prevent
+        mps, mp = [], 1
+        while True:
+            mps.append(self._mp_bucket(mp))
+            if mps[-1] >= self._max_pages_per_slot:
+                break
+            mp *= 2
+        for mp in dict.fromkeys(mps):
+            (_, self._dtok, self._dpos, self._k,
+             self._v) = self._decode(mp)(
+                self.params, np.uint32(0),
+                np.zeros((self.slots, mp), np.int32), self._dtok,
+                self._dpos, self._dtemp, self._dtopk, self._dtopp,
+                self._k, self._v)
+
+    # ---- engine loop --------------------------------------------------------
+
+    def _mp_bucket(self, pages: int) -> int:
+        """Geometric (power-of-two) page-count bucket covering
+        ``pages``, capped at the per-slot maximum."""
+        cap = self._max_pages_per_slot
+        b = 1
+        while b < pages and b < cap:
+            b *= 2
+        return min(b, cap)
+
+    def _admit(self) -> bool:
+        """Admission with up-front page reservation, strict FCFS: the
+        deferred queue (requests the pool couldn't cover) is always
+        served first, and one blocked request blocks everything behind
+        it — a stream of small requests must not starve a big one."""
+        self._release_quarantine()
+        free_slots = [i for i, s in self._table.items() if s is None]
+        batch = self._deferred
+        self._deferred = []
+        n_redeferred = len(batch)  # re-attempts don't re-count in stats
+        while len(batch) < len(free_slots):
+            try:
+                batch.append(self._pending.get_nowait())
+            except queue.Empty:
+                break
+        if not batch:
+            return False
+        ok: list[tuple[Any, int, list[int]]] = []
+        blocked = False
+        for idx, req in enumerate(batch):
+            prompt, max_new = req[0], req[1]
+            bucket = next(b for b in self.buckets if b >= len(prompt))
+            need = self._pages_needed(len(prompt), max_new, bucket)
+            if (not blocked and len(ok) < len(free_slots)
+                    and need <= len(self._free)):
+                pages = [self._free.pop() for _ in range(need)]
+                ok.append((req, bucket, pages))
+            else:
+                if idx >= n_redeferred:
+                    self.stats["deferred_admissions"] += 1
+                blocked = True
+                self._deferred.append(req)
+        self.stats["pages_free"] = len(self._free)
+        if not ok:
+            return False
+        groups: dict[int, list] = {}
+        for req, bucket, pages in ok:
+            groups.setdefault(bucket, []).append((req, pages))
+        for bucket, items in groups.items():
+            npg = bucket // self.page_size
+            while items:
+                R = 1
+                while R * 2 <= len(items) and R * 2 <= self.slots:
+                    R *= 2
+                grp, items = items[:R], items[R:]
+                slots_v = [free_slots.pop() for _ in grp]
+                prompts_np = np.full((R, bucket), self.pad_id, np.int32)
+                lens = np.empty((R,), np.int32)
+                temps = np.empty((R,), np.float32)
+                topks = np.empty((R,), np.int32)
+                topps = np.empty((R,), np.float32)
+                page_ids = np.zeros((R, npg), np.int32)
+                for r, ((prompt, _mn, temp, _eos, tk, tp, _h),
+                        pages) in enumerate(grp):
+                    prompts_np[r, :len(prompt)] = prompt
+                    lens[r] = len(prompt)
+                    temps[r], topks[r], topps[r] = temp, tk, tp
+                    page_ids[r] = pages[:npg]
+                    row = self._ptable[slots_v[r]]
+                    row[:] = 0
+                    row[:len(pages)] = pages
+                (toks, self._k, self._v, self._dtok, self._dpos,
+                 self._dtemp, self._dtopk,
+                 self._dtopp) = self._prefill_fn(bucket, R)(
+                    self.params, prompts_np, lens,
+                    np.asarray(slots_v, np.int32), page_ids, temps,
+                    topks, topps, self._next_seed(),
+                    self._k, self._v, self._dtok, self._dpos,
+                    self._dtemp, self._dtopk, self._dtopp)
+                self.stats["prefills"] += 1
+                for r, ((prompt, max_new, temp, eos_id, tk, tp,
+                         handle), pages) in enumerate(grp):
+                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
+                               pos=len(prompt), temperature=temp,
+                               eos_id=eos_id, top_k=tk, top_p=tp,
+                               base_len=len(prompt))
+                    self._slot_pages[slots_v[r]] = pages
+                    with self._lock:
+                        self._table[slots_v[r]] = st
+                    if max_new == 1:
+                        st.emit(int(toks[r]))
+                        st.fresh = False
+                        self._finish_if_done(slots_v[r], st)
+        return True
+
+    def _dispatch_chunk(self) -> None:
+        snap = {i: s for i, s in self._table.items() if s is not None}
+        bound = max(st.base_len + (st.dispatched + 1) * self.chunk
+                    for st in snap.values())
+        mp = self._mp_bucket(_ceil_div(bound, self.page_size))
+        filtered = any(s.top_k > 0 or s.top_p < 1.0
+                       for s in snap.values())
+        table = np.ascontiguousarray(self._ptable[:, :mp])
+        out, self._dtok, self._dpos, self._k, self._v = self._decode(
+            mp, filtered)(
+            self.params, self._next_seed(), table, self._dtok,
+            self._dpos, self._dtemp, self._dtopk, self._dtopp,
+            self._k, self._v)
+        for st in snap.values():
+            st.dispatched += 1
+        out.copy_to_host_async()
+        self._outstanding.append((snap, out))
+        self.stats["decode_chunks"] += 1
+        if mp < self._max_pages_per_slot:
+            self.stats["bucketed_chunks"] += 1
+
+    def _finish_if_done(self, slot: int, st) -> bool:
+        done = super()._finish_if_done(slot, st)
+        if done:
+            pages = self._slot_pages.pop(slot, [])
+            self._ptable[slot, :] = 0
+            if pages:
+                # chunks dispatched up to now carry tables naming these
+                # pages; they may be reissued only after all of them
+                # are processed
+                self._quarantine.append(
+                    (self.stats["decode_chunks"], pages))
+            self._release_quarantine()
+        return done
+
+    def step(self) -> bool:
+        did = super().step()
+        # deferred requests are invisible to the base loop's pending
+        # check; retrying admission after processing may find released
+        # pages (completions hide in processed chunks)
+        if self._deferred and not self._closed:
+            did = self._admit() or did
+        return did
+
+    def _fail_deferred(self, err: Exception) -> None:
+        """Handles parked in the deferred queue are invisible to the
+        base engine's _die/close drains — they must fail with everything
+        else, never hang a client on a 10-minute timeout."""
+        deferred, self._deferred = self._deferred, []
+        for *_, handle in deferred:
+            handle._fail(err)
+
+    def _die(self, err: Exception) -> None:
+        super()._die(err)
+        self._fail_deferred(RuntimeError(f"engine failed: {err!r}"))
+
+    def close(self, drain: float = 0.0) -> None:
+        super().close(drain)
+        self._fail_deferred(RuntimeError("engine closed"))

@@ -313,12 +313,7 @@ class SlotEngine:
                     f"replicated; decode seq is 1): got {bad}")
         self.mesh = mesh
         self._fwd = cached_forward_fn(cfg)
-        # slots stay REPLICATED (engine.CACHE_SPEC would shard them over
-        # dp/fsdp); only the kv-head dim shards, over tp
-        cache = init_kv_cache(
-            cfg, slots, self.max_seq, mesh=mesh, dtype=cache_dtype,
-            spec=P(None, None, None, "tp", None))
-        self._k, self._v = cache.k, cache.v
+        self._k, self._v = self._alloc_cache(cache_dtype)
         # RNG = a host counter folded into PRNGKey INSIDE the programs:
         # an eager jax.random.split costs a ~150 ms tunnel round-trip
         self._seed = seed
@@ -384,6 +379,16 @@ class SlotEngine:
                       "bucketed_chunks": 0, "accepted_tokens": 0,
                       "prefix_hits": 0, "segment_prefills": 0,
                       "prefix_bytes": 0}
+
+    def _alloc_cache(self, cache_dtype):
+        """The big per-slot KV buffers — dense (slots, max_seq) here;
+        the paged engine (infer/paged.py) overrides with a page pool.
+        Slots stay REPLICATED (engine.CACHE_SPEC would shard them over
+        dp/fsdp); only the kv-head dim shards, over tp."""
+        cache = init_kv_cache(
+            self.cfg, self.slots, self.max_seq, mesh=self.mesh,
+            dtype=cache_dtype, spec=P(None, None, None, "tp", None))
+        return cache.k, cache.v
 
     # ---- compiled programs -------------------------------------------------
 

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_docker_api.models.common import trunc_normal_init
 from tpu_docker_api.ops.attention import dense_attention, multihead_attention
+from tpu_docker_api.ops.paged import PagedRef, gather_pages, paged_write
 from tpu_docker_api.ops.norms import rms_norm
 from tpu_docker_api.ops.quant import linear
 from tpu_docker_api.ops.rope import apply_rope, rope_frequencies
@@ -153,6 +154,26 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     q = linear(x, layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
     k = linear(x, layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
     v = linear(x, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    if isinstance(cache, PagedRef):
+        # paged decode (ops/paged.py; infer/paged.py drives it): s == 1,
+        # per-row positions; the write scatters into the slot's current
+        # page, the read gathers its pages into a contiguous view that
+        # is element-identical to the dense cache prefix — downstream
+        # attention math is shared with the dense path verbatim
+        positions = (start_pos[:, None]
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])
+        q = apply_rope(q, rope_cos, rope_sin, positions)
+        k = apply_rope(k, rope_cos, rope_sin, positions)
+        k_pool = paged_write(cache.k_pool, cache.layer_idx, cache.table,
+                             start_pos, k[:, 0])
+        v_pool = paged_write(cache.v_pool, cache.layer_idx, cache.table,
+                             start_pos, v[:, 0])
+        k_cache = gather_pages(k_pool, cache.layer_idx, cache.table)
+        v_cache = gather_pages(v_pool, cache.layer_idx, cache.table)
+        out = dense_attention(q, k_cache, v_cache, causal=True,
+                              q_offset=start_pos)
+        return linear(out.reshape(b, s, cfg.n_heads * hd),
+                      layer["attn"]["wo"]), (k_pool, v_pool)
     if cache is not None:
         k_all, v_all, layer_idx = cache
         per_row = getattr(start_pos, "ndim", 0) == 1
@@ -355,14 +376,43 @@ def llama_forward_cached(
         params, tokens, cfg, k_cache, v_cache, mesh, last_only, block_fn)
 
 
+def llama_forward_paged(
+    params: dict,
+    tokens: jnp.ndarray,      # (S, 1) int32 — one decode token per slot
+    cfg: LlamaConfig,
+    k_pool: jnp.ndarray,      # (n_layers, P, page, n_kv_heads, head_dim)
+    v_pool: jnp.ndarray,
+    table: jnp.ndarray,       # (S, mp) int32 page ids; 0 = trash
+    pos: jnp.ndarray,         # (S,) int32 per-slot positions
+    max_pos: int,             # position capacity (sizes rope tables)
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged-KV decode step: logits (S, 1, vocab) + updated pools. Block
+    math is ``_block`` via the shared skeleton — only the cache write
+    (page scatter) and read (page gather, ops/paged.py) differ from
+    ``llama_forward_cached``. Single-device (infer/paged.py's scope)."""
+    def block_fn(x, layer, cache, rope_cos, rope_sin):
+        kc, vc, layer_idx = cache
+        ref = PagedRef(k_pool=kc, v_pool=vc, layer_idx=layer_idx,
+                       table=table)
+        return _block(x, layer, cfg, rope_cos, rope_sin, None,
+                      cache=ref, start_pos=pos)
+
+    return decoder_forward_cached(
+        params, tokens, cfg, k_pool, v_pool, None, False, block_fn,
+        max_pos=max_pos)
+
+
 def decoder_forward_cached(params, tokens, cfg, k_cache, v_cache, mesh,
-                           last_only, block_fn):
+                           last_only, block_fn, max_pos=None):
     """The shared KV-cached decoder skeleton: embed → cache-carrying layer
     scan → lm_head. ``block_fn(x, layer, (kc, vc, layer_idx), rope_cos,
     rope_sin) -> (x, (kc, vc))`` supplies the block body — Llama's
-    ``_block`` or MoE's aux-discarding wrapper (models/moe.py) — so the
-    cache-as-carry mechanics live in exactly one place."""
-    max_seq = k_cache.shape[2]
+    ``_block``, MoE's aux-discarding wrapper (models/moe.py), or the
+    paged closure (``llama_forward_paged``) — so the cache-as-carry
+    mechanics live in exactly one place. ``max_pos`` sizes the rope
+    tables when the cache shape doesn't imply it (a page pool's dim 2
+    is the page size, not the position capacity)."""
+    max_seq = max_pos or k_cache.shape[2]
     x = embed_lookup(params["embed"]["tokens"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), None))

@@ -1,0 +1,88 @@
+"""Paged KV-cache primitives (the vLLM-PagedAttention capability,
+TPU-first).
+
+The dense slot cache preallocates ``slots × max_seq`` positions per
+layer whether or not any request uses them — at 8B shapes that is
+~128 KB of HBM per position, so 32 slots × 2048 capacity would pin 8 GB
+next to 8 GB of int8 weights: impossible on one v5e. Paging replaces the
+dense buffer with a POOL of fixed-size pages ``(layers, P, page, kv,
+head_dim)`` plus a per-slot page table; HBM scales with the pool (sized
+to expected LIVE tokens), not slots × capacity.
+
+TPU-first shape of the design (vs the CUDA block-table kernel):
+
+- **Static shapes everywhere**: the page table rides into each compiled
+  program as a ``(S, mp)`` int32 OPERAND (mp = a geometric page-count
+  bucket), so XLA sees fixed shapes and the host can repage freely
+  between dispatches — no device-side allocator, no eager updates (an
+  eager ``.at[].set`` costs a ~150 ms tunnel round-trip; a small host
+  operand costs ~0.2 ms, engine design rule per infer/slots.py).
+- **Reads gather pages back into a contiguous (S, mp·page, kv, hd)
+  view and run the SAME ``dense_attention`` as the dense cache.** Page
+  j of a slot covers global positions [j·page, (j+1)·page), so the
+  gathered view is element-identical to the dense cache prefix — the
+  engine's token-exactness contract (tests/test_slots.py) carries over
+  verbatim instead of resting on a new online-softmax numerics story.
+  The gather costs one extra HBM round-trip of the live bytes per
+  layer; the capacity win (serving points the dense cache cannot
+  reach) is the point, and the bucketed ``mp`` keeps the gathered view
+  at live size, not capacity.
+- **Page 0 is the trash page**: unassigned table entries point at it,
+  so writes from lanes whose request already completed (the engine
+  processes completions at a pipeline lag) land harmlessly; nothing
+  ever reads it unmasked — same just-in-time-overwrite argument as the
+  dense engine's drop-mode writes.
+
+Capability analog in the reference: none (no serving at all, SURVEY.md
+§0); this extends the round-3 slot engine the way the reference's
+versioned rolling-replacement extends plain docker run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedRef:
+    """One layer-scan step's view of the paged cache: the full pools,
+    this layer's traced index, and the dispatch's page table. Marker
+    type that routes models/llama._attention onto the paged write/read
+    path; a pytree is NOT needed — it never crosses a jit boundary as a
+    leaf (the pools do, separately, as scan carry)."""
+
+    k_pool: Any    # (layers, P, page, n_kv_heads, head_dim)
+    v_pool: Any
+    layer_idx: Any  # traced int32 scalar
+    table: Any     # (S, mp) int32 page ids; 0 = trash page
+
+
+def paged_write(pool: jnp.ndarray, layer_idx, table: jnp.ndarray,
+                pos: jnp.ndarray, new: jnp.ndarray) -> jnp.ndarray:
+    """Scatter one new position per slot into the pool:
+    ``pool[layer_idx, table[s, pos[s]//page], pos[s]%page] = new[s]``.
+    ``pos // page`` clips to the table width — a lane past its
+    reservation (completed request still decoding at the pipeline lag)
+    resolves to a zero entry, i.e. the trash page."""
+    page = pool.shape[2]
+    mp = table.shape[1]
+    slot_col = jnp.clip(pos // page, 0, mp - 1)
+    pid = jnp.take_along_axis(table, slot_col[:, None], axis=1)[:, 0]
+    return pool.at[layer_idx, pid, pos % page].set(
+        new.astype(pool.dtype))
+
+
+def gather_pages(pool: jnp.ndarray, layer_idx,
+                 table: jnp.ndarray) -> jnp.ndarray:
+    """(S, mp·page, kv, hd) contiguous view of each slot's pages for
+    this layer — element-identical to the dense cache prefix of length
+    mp·page (trash-page content appears only at positions the causal
+    q_offset mask excludes)."""
+    layer = lax.dynamic_index_in_dim(pool, layer_idx, 0, keepdims=False)
+    g = jnp.take(layer, table, axis=0)  # (S, mp, page, kv, hd)
+    s, mp, page = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(s, mp * page, *g.shape[3:])

@@ -118,6 +118,15 @@ def main(argv: list[str] | None = None) -> None:
                         "segments interleaved with decode (bounds the "
                         "stall a long admission inflicts on active "
                         "streams); 0 = whole-prompt admission")
+    p.add_argument("--page-size", type=int, default=0,
+                   help="> 0: paged KV cache (infer/paged.py) — the "
+                        "slot cache becomes a page pool and HBM scales "
+                        "with --total-pages instead of slots×max-seq. "
+                        "llama presets, single device; excludes "
+                        "--prefill-chunk, /prefixes, --draft-preset")
+    p.add_argument("--total-pages", type=int, default=0,
+                   help="pool size in pages (0 = dense-equivalent "
+                        "capacity); only with --page-size")
     p.add_argument("--max-prefix-bytes", type=int, default=256 * 2**20,
                    help="HBM budget for POST /prefixes K/V pairs in "
                         "bytes (0 = unbounded); registrations past it "
@@ -141,6 +150,16 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--n-spec", type=int, default=4,
                    help="draft tokens proposed per speculative round")
     args = p.parse_args(argv)
+    if args.total_pages and not args.page_size:
+        raise SystemExit("--total-pages requires --page-size (the "
+                         "dense engine has no page pool)")
+    if args.page_size and args.prefill_chunk:
+        # erroring beats silently serving with whole-prompt admission
+        # (same convention as --draft-preset); checked before any model
+        # loads so the misconfiguration fails in milliseconds
+        raise SystemExit(
+            "--prefill-chunk is not supported with --page-size "
+            "(paged engine v1 admits whole prompts)")
 
     from tpu_docker_api.workload.jaxenv import bootstrap_jax
 
@@ -272,6 +291,10 @@ def main(argv: list[str] | None = None) -> None:
             # small-batch latency mode (measured trade in perf-notes)
             from tpu_docker_api.infer.slots import SpeculativeSlotEngine
 
+            if args.page_size:
+                raise SystemExit(
+                    "--page-size and --draft-preset are exclusive (the "
+                    "speculative engine uses the dense cache)")
             if family != "llama" or multi:
                 raise SystemExit(
                     "--draft-preset requires a llama preset on a single "
@@ -298,6 +321,19 @@ def main(argv: list[str] | None = None) -> None:
                 draft_params=draft_params, n_spec=args.n_spec,
                 slots=args.slots, max_seq=max_seq,
                 max_pending=args.slots * 8)
+        elif args.page_size > 0:
+            from tpu_docker_api.infer.paged import PagedSlotEngine
+
+            if family != "llama" or multi:
+                raise SystemExit(
+                    "--page-size requires a llama preset on a single "
+                    "device (paged engine v1 scope)")
+            slot_engine = PagedSlotEngine(
+                cfg, params, page_size=args.page_size,
+                total_pages=args.total_pages or None,
+                slots=args.slots, max_seq=max_seq, chunk=args.chunk,
+                max_pending=args.slots * 8,
+                seed=int.from_bytes(os.urandom(4), "little"))
         else:
             slot_engine = SlotEngine(
                 cfg, params, slots=args.slots, max_seq=max_seq,
