@@ -49,7 +49,7 @@ class TestQuantizedWeight:
         x = jax.random.normal(jax.random.PRNGKey(1), (4, 64), jnp.bfloat16)
         w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
         got = np.asarray(int8_linear(x, quantize_weight(w),
-                                     out_dtype=jnp.float32))
+                                     jnp.float32))
         ref = np.asarray(x.astype(jnp.float32) @ w)
         # two int8 quantizations (weight + activation): ~1% relative error
         denom = np.abs(ref).mean()

@@ -377,8 +377,7 @@ class PagedSlotEngine(SlotEngine):
 
     def _dispatch_chunk(self) -> None:
         snap = {i: s for i, s in self._table.items() if s is not None}
-        bound = max(st.base_len + (st.dispatched + 1) * self.chunk
-                    for st in snap.values())
+        bound = self._reach_bound(snap, self.chunk)
         mp = self._mp_bucket(_ceil_div(bound, self.page_size))
         filtered = any(s.top_k > 0 or s.top_p < 1.0
                        for s in snap.values())

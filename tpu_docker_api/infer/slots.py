@@ -638,15 +638,22 @@ class SlotEngine:
         self._decode_fns[(kv_limit, filtered)] = fn
         return fn
 
+    @staticmethod
+    def _reach_bound(active, chunk: int) -> int:
+        """Highest cache position the NEXT chunk can touch across
+        ``active`` slots — derived from dispatch counts, not processed
+        state (the host lags by the pipeline depth). THE bound behind
+        both the dense engine's kv read buckets and the paged engine's
+        table width (infer/paged.py)."""
+        return max(st.base_len + (st.dispatched + 1) * chunk
+                   for st in active.values())
+
     def _kv_limit_for_chunk(self, active) -> int | None:
         """Smallest geometric bucket covering every position the NEXT
-        chunk can touch, or None (full buffer). A slot's reachable bound
-        is derived from dispatch counts, not processed state — the host
-        lags by the pipeline depth."""
+        chunk can touch, or None (full buffer)."""
         if not self._kv_buckets:
             return None
-        bound = max(st.base_len + (st.dispatched + 1) * self.chunk
-                    for st in active.values())
+        bound = self._reach_bound(active, self.chunk)
         for b in self._kv_buckets:
             if b >= bound:
                 return b

@@ -27,9 +27,12 @@ the serving stack the TPU build provides (SURVEY.md §0, §2.3).
 from __future__ import annotations
 
 import dataclasses
+import functools
+from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass
@@ -72,7 +75,29 @@ def dequantize_weight(q: QuantizedLinear, dtype=jnp.float32) -> jnp.ndarray:
 def int8_linear(x: jnp.ndarray, q: QuantizedLinear,
                 out_dtype=None) -> jnp.ndarray:
     """y = x @ dequant(q) computed as an int8×int8 MXU dot with dynamic
-    per-row activation quantization. x: (…, in); q: (in, out)."""
+    per-row activation quantization. x: (…, in); q: (in, out).
+
+    custom_vjp (straight-through): the forward's ``round`` on the
+    activations has zero gradient almost everywhere, so naive autodiff
+    through it returns zero dL/dx and silently kills backprop through
+    any layer BELOW an int8 projection — exactly the QLoRA case (frozen
+    int8 base, trainable adapters, gradients must flow through the base
+    matmuls to reach earlier layers). The STE backward is the exact
+    gradient of the DEQUANTIZED matmul: dL/dx = (g · s_w) @ W_int8ᵀ,
+    computed as a mixed f32×int8 dot (the int8→f32 convert fuses into
+    the dot — no dequantized weight copy materializes). The weights are
+    frozen by contract, so their cotangent is symbolically zero."""
+    # custom_vjp nondiff args must LEAD the signature; keep the public
+    # (x, q, out_dtype) order via this shim
+    return _int8_linear(out_dtype, x, q)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _int8_linear(out_dtype, x, q):
+    return _int8_linear_fwd_impl(x, q, out_dtype)
+
+
+def _int8_linear_fwd_impl(x, q, out_dtype):
     xf = x.astype(jnp.float32)
     x_scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
                           _EPS) / 127.0
@@ -86,14 +111,77 @@ def int8_linear(x: jnp.ndarray, q: QuantizedLinear,
     return y.astype(out_dtype or x.dtype)
 
 
+def _int8_linear_fwd(out_dtype, x, q):
+    # residuals must be jax values — a 0-sized array carries x's dtype
+    return (_int8_linear_fwd_impl(x, q, out_dtype),
+            (q, jnp.zeros((0,), x.dtype)))
+
+
+def _int8_linear_bwd(out_dtype, res, g):
+    q, x_proto = res
+    x_dtype = x_proto.dtype
+    gs = g.astype(jnp.float32) * q.scale  # fold per-channel scales in
+    gx = jax.lax.dot_general(
+        gs, q.w_int8,
+        (((g.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # frozen weights: symbolically-zero cotangents (float0 for the int8
+    # tensor — jax's tangent type for integer leaves)
+    gq = QuantizedLinear(
+        np.zeros(q.w_int8.shape, jax.dtypes.float0),
+        jnp.zeros_like(q.scale))
+    return gx.astype(x_dtype), gq
+
+
+_int8_linear.defvjp(_int8_linear_fwd, _int8_linear_bwd)
+
+
+@dataclasses.dataclass
+class LoraLinear:
+    """A frozen base projection (raw array OR QuantizedLinear) plus a
+    low-rank adapter branch, evaluated UNMERGED:
+
+        y = linear(x, base) + s·(x @ A) @ B,   s = alpha / rank
+
+    The QLoRA leaf (train/lora.py ``attach_lora``): the merged tree
+    ``W + s·A@B`` never materializes — at llama3-8b the bf16 merged
+    copy is 16 GB, over a v5e's HBM, while base-int8 + adapters is
+    ~8 GB. The adapter branch computes in the adapter dtype (f32) and
+    casts at the add, so the base path's numerics/dtype are untouched
+    and autodiff reaches A/B exactly; the base is frozen by contract
+    (int8 bases get symbolically-zero weight cotangents via
+    ``int8_linear``'s STE vjp, raw bases just discard theirs)."""
+
+    base: Any            # (…, in, out) array or QuantizedLinear
+    a: jnp.ndarray       # (…, in, rank)
+    b: jnp.ndarray       # (…, rank, out)
+    scale: float         # alpha / rank — static pytree aux
+
+    @property
+    def shape(self):
+        return self.base.shape
+
+
+jax.tree_util.register_pytree_node(
+    LoraLinear,
+    lambda l: ((l.base, l.a, l.b), l.scale),
+    lambda scale, kids: LoraLinear(*kids, scale),
+)
+
+
 def linear(x: jnp.ndarray, w, out_dtype=None) -> jnp.ndarray:
     """The one projection entry point: raw arrays take the plain matmul
     path (training — unchanged numerics), QuantizedLinear takes the int8
     path (serving). ``out_dtype`` asks for widened ACCUMULATION, not a
     cast — the raw path runs the dot with that preferred_element_type
     (the lm_head's bf16-operands/f32-out contract)."""
+    if isinstance(w, LoraLinear):
+        y = linear(x, w.base, out_dtype=out_dtype)
+        delta = (x.astype(w.a.dtype) @ w.a) @ w.b
+        return y + (w.scale * delta).astype(y.dtype)
     if isinstance(w, QuantizedLinear):
-        return int8_linear(x, w, out_dtype=out_dtype)
+        return int8_linear(x, w, out_dtype)
     if out_dtype is not None:
         return jax.lax.dot_general(
             x, w, (((x.ndim - 1,), (0,)), ((), ())),

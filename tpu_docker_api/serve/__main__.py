@@ -139,6 +139,14 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--lora-rank", type=int, default=0)
     p.add_argument("--lora-alpha", type=float, default=16.0)
     p.add_argument("--lora-targets", default="wq,wv")
+    p.add_argument("--lora-forward", default="merged",
+                   choices=["merged", "attached"],
+                   help="merged: fold adapters into the base once at "
+                        "load (zero per-token cost); attached: serve "
+                        "the unmerged Wx + s·B(Ax) forward — with "
+                        "--quantize this reproduces QLoRA training "
+                        "numerics exactly (int8 base + f32 adapters), "
+                        "and the bf16 merged tree never materializes")
     p.add_argument("--draft-preset", default="",
                    help="serve speculatively: a (smaller) llama preset "
                         "as the draft model. Greedy-only; pays at small "
@@ -211,10 +219,13 @@ def main(argv: list[str] | None = None) -> None:
                                       _mf(cfg)[2]))
             del host
         else:
-            # LoRA merge must precede (lossy) quantization, so with
-            # --lora-ckpt the import stays bf16 and the shared
-            # merge-then-quantize path below runs
-            q_now = args.quantize and not args.lora_ckpt
+            # LoRA MERGE must precede (lossy) quantization, so with a
+            # merged --lora-ckpt the import stays bf16 and the shared
+            # merge-then-quantize path below runs; the ATTACHED forward
+            # wants the opposite order (int8 base first — QLoRA's
+            # training numerics), so int8-at-load stays on
+            q_now = args.quantize and (not args.lora_ckpt
+                                       or args.lora_forward == "attached")
             _, params = import_hf_llama(args.hf_ckpt, cfg,
                                         quantize=q_now)
             quantized_at_load = q_now
@@ -237,25 +248,39 @@ def main(argv: list[str] | None = None) -> None:
         step = 0
     if not args.lora_ckpt and (
             args.lora_rank > 0 or args.lora_alpha != 16.0
-            or args.lora_targets != "wq,wv"):
+            or args.lora_targets != "wq,wv"
+            or args.lora_forward != "merged"):
         # mirror of the trainer's guard: a lora flag without --lora-ckpt
         # would silently serve the unmodified base with exit 0
         raise SystemExit(
-            "--lora-rank/--lora-alpha/--lora-targets require --lora-ckpt")
+            "--lora-rank/--lora-alpha/--lora-targets/--lora-forward "
+            "require --lora-ckpt")
     if args.lora_ckpt:
-        # merge trained adapters into the base ONCE at load; serving then
-        # runs the ordinary forward on the merged weights (order matters:
-        # merge BEFORE int8 quantization, which is lossy)
+        # merged: fold adapters into the base ONCE at load, BEFORE the
+        # lossy int8 quantization; attached: quantize FIRST (matching
+        # --qlora training numerics) and wrap projections in LoraLinear
+        # leaves — the merged tree never materializes
         if args.lora_rank < 1:
             raise SystemExit("--lora-ckpt requires --lora-rank (the rank "
                              "the adapters were trained at)")
-        from tpu_docker_api.train.lora import merge_lora, restore_adapters
+        from tpu_docker_api.train.lora import (
+            attach_lora, merge_lora, restore_adapters)
 
         targets = tuple(t.strip() for t in args.lora_targets.split(",")
                         if t.strip())
         adapters = restore_adapters(args.lora_ckpt, cfg, mesh,
                                     args.lora_rank, targets)
-        params = merge_lora(params, adapters, alpha=args.lora_alpha)
+        if args.lora_forward == "attached":
+            if args.quantize and not quantized_at_load:
+                from tpu_docker_api.infer.quantize import (
+                    quantize_llama_params)
+
+                params = quantize_llama_params(params)
+                quantized_at_load = True
+            params = attach_lora(params, adapters,
+                                 alpha=args.lora_alpha)
+        else:
+            params = merge_lora(params, adapters, alpha=args.lora_alpha)
         del adapters
     if args.quantize and not quantized_at_load:
         from tpu_docker_api.infer.quantize import quantize_llama_params
@@ -282,8 +307,14 @@ def main(argv: list[str] | None = None) -> None:
     slot_engine = None
     multi = mesh.devices.size > 1
     tp_only = all(mesh.shape.get(ax, 1) == 1 for ax in ("dp", "sp"))
-    if (family in ("llama", "moe") and args.slots > 0
-            and (not multi or tp_only)):
+    slot_ok_here = (family in ("llama", "moe") and args.slots > 0
+                    and (not multi or tp_only))
+    if args.page_size > 0 and not slot_ok_here:
+        # erroring beats silently serving on the legacy dense path
+        raise SystemExit(
+            "--page-size requires the slot-engine path (llama preset, "
+            "--slots > 0, single device)")
+    if slot_ok_here:
         from tpu_docker_api.infer.slots import SlotEngine
 
         if args.draft_preset:

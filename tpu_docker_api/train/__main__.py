@@ -69,6 +69,16 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--lora-base-ckpt", default="",
                    help="full-train checkpoint dir to load the frozen "
                         "base from ('' = random init, smoke/bench)")
+    p.add_argument("--lora-forward", default=None,
+                   choices=["merged", "attached"],
+                   help="merged: classic per-step merge (transient "
+                        "weight-sized copy); attached: unmerged "
+                        "Wx + s·B(Ax) forward — no merged tree, "
+                        "required at 8B-on-one-chip scale")
+    p.add_argument("--qlora", action="store_true",
+                   help="int8-quantize the frozen base at load and use "
+                        "the attached forward — llama3-8b fine-tuning "
+                        "on a single 16 GB chip (llama presets only)")
     p.add_argument("--profile-dir", default="",
                    help="write a jax.profiler trace (TensorBoard/Perfetto "
                         "format) covering post-compile steps")
@@ -128,12 +138,23 @@ def main(argv: list[str] | None = None) -> None:
         opt = adamw_int8()
     if args.lora_rank <= 0 and (
             args.lora_base_ckpt or args.lora_alpha != 16.0
-            or args.lora_targets != "wq,wv"):
+            or args.lora_targets != "wq,wv" or args.qlora
+            or args.lora_forward is not None):
         # a lora flag without --lora-rank would otherwise be silently
         # ignored and a FULL random-init pretrain would run with exit 0
         raise SystemExit(
-            "--lora-base-ckpt/--lora-alpha/--lora-targets require "
-            "--lora-rank > 0")
+            "--lora-base-ckpt/--lora-alpha/--lora-targets/--qlora/"
+            "--lora-forward require --lora-rank > 0")
+    if args.qlora and family != "llama":
+        raise SystemExit("--qlora supports llama presets only (the "
+                         "int8 quantizer is llama-shaped)")
+    if args.qlora and args.lora_forward == "merged":
+        # contradictory: merging onto an int8 base would quantize the
+        # delta away — reject rather than silently run attached
+        raise SystemExit("--qlora requires the attached forward; drop "
+                         "--lora-forward merged")
+    if args.lora_forward is None:
+        args.lora_forward = "attached" if args.qlora else "merged"
     mgr = None
     if args.lora_rank > 0:
         from tpu_docker_api.train.lora import (
@@ -156,6 +177,14 @@ def main(argv: list[str] | None = None) -> None:
                                               mesh)
         else:
             base_params = init_base_params(cfg, mesh, key)
+        if args.qlora:
+            # int8 base + unmerged forward: the QLoRA memory shape —
+            # exactly the serving quantizer, so adapters train against
+            # the numerics `serve --quantize --lora-forward attached`
+            # will run
+            from tpu_docker_api.train.lora import quantize_base
+
+            base_params = quantize_base(base_params)
         if args.ckpt_dir:
             state, optimizer, mgr = lora_resume_or_init(
                 args.ckpt_dir, cfg, mesh, key, args.lora_rank,
@@ -165,7 +194,8 @@ def main(argv: list[str] | None = None) -> None:
                 cfg, mesh, key, args.lora_rank, targets=targets,
                 optimizer=opt)
         step_fn = make_lora_train_step(cfg, mesh, optimizer, base_params,
-                                       alpha=args.lora_alpha)
+                                       alpha=args.lora_alpha,
+                                       forward=args.lora_forward)
     elif args.ckpt_dir:
         state, optimizer, mgr = resume_or_init(args.ckpt_dir, cfg, mesh, key,
                                                optimizer=opt)

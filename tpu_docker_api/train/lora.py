@@ -4,8 +4,8 @@ The reference has no training stack at all (SURVEY.md §0); this module
 supplies the parameter-efficient fine-tuning path a fleet of provisioned
 containers actually runs against a pretrained base. TPU-first shape:
 
-- **Merge-then-forward.** The train step computes
-  ``W' = W + (alpha/rank) * A @ B`` for every adapted projection and runs
+- **Two forwards, one model.** ``forward="merged"`` computes
+  ``W' = W + (alpha/rank) * A @ B`` per adapted projection and runs
   the ORDINARY model forward on the merged tree. The model code stays
   untouched (one source of truth for block math), the merge is a tiny
   batched einsum per adapted weight, and autodiff through it yields
@@ -16,6 +16,13 @@ containers actually runs against a pretrained base. TPU-first shape:
   here they exist only for the (rank-sized) adapters. The transient
   merged copy XLA materializes per step is bf16 weight-sized and freed
   after use (remat applies to it like any activation).
+  ``forward="attached"`` (QLoRA, round 4) skips even that transient:
+  :func:`attach_lora` wraps each adapted projection in an
+  ``ops.quant.LoraLinear`` leaf evaluating ``Wx + s·B(Ax)`` unmerged —
+  with an int8 base (``quantize_base`` + the straight-through vjp on
+  ``int8_linear``) an 8B fine-tune fits ONE 16 GB chip: ~8 GB frozen
+  int8 base + rank-sized f32 adapters and moments, vs a 16 GB bf16
+  merged copy that alone would overflow it.
 - **Adapters shard like their base.** ``A (L, d_in, r)`` inherits the
   base weight's (layer, in) axes, ``B (L, r, d_out)`` its (layer, out)
   axis — derived mechanically from the base sharding rules, so tp/fsdp
@@ -106,6 +113,11 @@ def merge_lora(params: dict, adapters: dict, alpha: float = 16.0) -> dict:
         for k, v in p.items():
             if k in a and isinstance(a[k], dict) and "a" in a[k] \
                     and not isinstance(v, dict):
+                if not hasattr(v, "astype"):
+                    raise ValueError(
+                        f"cannot merge adapters into a {type(v).__name__}"
+                        f" base at {k!r} — int8 bases need the unmerged "
+                        f"forward (attach_lora / forward='attached')")
                 pa, pb = a[k]["a"], a[k]["b"]
                 scale = alpha / pa.shape[-1]
                 delta = scale * jnp.matmul(pa, pb)
@@ -117,6 +129,45 @@ def merge_lora(params: dict, adapters: dict, alpha: float = 16.0) -> dict:
         return out
 
     return walk(params, adapters)
+
+
+def attach_lora(params: dict, adapters: dict, alpha: float = 16.0) -> dict:
+    """Base tree with :class:`~tpu_docker_api.ops.quant.LoraLinear`
+    leaves at every adapted projection — the UNMERGED (QLoRA) forward:
+    ``y = linear(x, W) + (alpha/r)·(x@A)@B`` evaluated per projection,
+    so the merged weight tree never materializes. With an int8-quantized
+    base (``quantize_base``) this is what makes llama3-8b fine-tuning a
+    one-chip reality: base ~8 GB int8 + rank-sized adapters/moments,
+    instead of a 16 GB bf16 merged copy that alone overflows a v5e.
+    Gradients flow to A/B through ``ops.quant.linear``'s dispatch
+    (int8 bases use the straight-through vjp); the base stays frozen."""
+    from tpu_docker_api.ops.quant import LoraLinear
+
+    def walk(p: dict, a: dict) -> dict:
+        out = {}
+        for k, v in p.items():
+            if k in a and isinstance(a[k], dict) and "a" in a[k] \
+                    and not isinstance(v, dict):
+                pa = a[k]["a"]
+                out[k] = LoraLinear(v, pa, a[k]["b"],
+                                    alpha / pa.shape[-1])
+            elif isinstance(v, dict):
+                out[k] = walk(v, a.get(k, {}))
+            else:
+                out[k] = v
+        return out
+
+    return walk(params, adapters)
+
+
+def quantize_base(base_params: dict) -> dict:
+    """Int8-quantize a llama-family frozen base for QLoRA training —
+    the serving quantizer reused verbatim (infer/quantize.py), so the
+    trained-adapter → ``serve --quantize --lora-forward attached``
+    round trip sees EXACTLY the base numerics it was trained against."""
+    from tpu_docker_api.infer.quantize import quantize_llama_params
+
+    return quantize_llama_params(base_params)
 
 
 def lora_specs(adapters: dict, rules=None, prefix: str = ""):
@@ -189,18 +240,26 @@ def create_lora_state(cfg, mesh: Mesh, key: jax.Array, rank: int,
 
 
 def make_lora_train_step(cfg, mesh: Mesh, optimizer, base_params: dict,
-                         alpha: float = 16.0):
+                         alpha: float = 16.0, forward: str = "merged"):
     """jitted (state, batch) → (state, metrics) where ``state.params``
-    are the adapters; every step merges and runs the family's ordinary
-    loss. ``base_params`` ride as closed-over device constants — never
+    are the adapters. ``forward="merged"`` merges per step and runs the
+    family's ordinary loss (transient weight-sized copy, exact classic
+    LoRA); ``"attached"`` runs the unmerged QLoRA forward via
+    :func:`attach_lora` — required when the merged bf16 tree wouldn't
+    fit (8B on one chip) and the only choice that is EXACT over an
+    int8 base (merging onto int8 would quantize the delta away).
+    ``base_params`` ride as closed-over device constants — never
     donated, never differentiated."""
     from tpu_docker_api.train.trainer import make_train_step
 
+    if forward not in ("merged", "attached"):
+        raise ValueError(f"forward must be merged|attached, got {forward!r}")
     _, model_loss, _ = model_fns(cfg)
+    combine = merge_lora if forward == "merged" else attach_lora
 
     def loss_fn(adapters, batch):
-        merged = merge_lora(base_params, adapters, alpha)
-        return model_loss(merged, batch, cfg, mesh)
+        return model_loss(combine(base_params, adapters, alpha), batch,
+                          cfg, mesh)
 
     return make_train_step(cfg, mesh, optimizer, loss_fn=loss_fn)
 
