@@ -1,0 +1,165 @@
+"""Encdec slot engine (infer/encdec_slots.py): continuous batching for
+seq2seq. The exactness contract: per-stream outputs token-exact vs an
+isolated greedy ``encdec_generate`` of the same source — for ragged
+sources in one engine, any admission order, and slot reuse. Closes
+VERDICT r3 missing #4 (encdec was the last single-flight family)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_docker_api.infer.encdec_slots import EncDecSlotEngine
+from tpu_docker_api.models.encdec import (
+    encdec_generate,
+    encdec_init,
+    encdec_presets,
+)
+
+TINY = encdec_presets()["tiny"]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = encdec_init(TINY, jax.random.PRNGKey(5))
+    return TINY, params
+
+
+def isolated_greedy(cfg, params, src, max_new, eos_id=None):
+    fn = jax.jit(lambda p, s: encdec_generate(
+        p, s, cfg, max_new_tokens=max_new, eos_id=eos_id,
+        temperature=0.0))
+    out = fn(params, jnp.asarray([src], jnp.int32))
+    if eos_id is None:
+        return np.asarray(out)[0].tolist()
+    toks = np.asarray(out["tokens"])[0]
+    n = int(np.asarray(out["lengths"])[0])
+    return toks[:n].tolist()
+
+
+def run_all(eng, handles, limit=500):
+    for _ in range(limit):
+        if all(h.done() for h in handles):
+            return
+        eng.step()
+    raise AssertionError("requests did not complete")
+
+
+class TestTokenExact:
+    def test_single_request_matches_isolated(self, setup):
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=4, chunk=4)
+        src = [3, 1, 4, 1, 5, 9, 2, 6]
+        h = eng.submit(src, max_new=12)
+        run_all(eng, [h])
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, src, 12)
+
+    def test_ragged_sources_concurrent_token_exact(self, setup):
+        """Mixed source lengths across buckets decode together in one
+        engine — the equal-length-rows restriction is gone."""
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=4, chunk=4)
+        srcs = [[2, 7, 1], [9] * 40, [5, 5], [1, 2, 3, 4, 5, 6, 7],
+                [8, 6, 4, 2], [11, 13]]
+        max_news = [10, 6, 13, 9, 5, 16]
+        handles = [eng.submit(s, m) for s, m in zip(srcs, max_news)]
+        run_all(eng, handles)
+        for s, m, h in zip(srcs, max_news, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, s, m)
+
+    def test_slot_reuse_and_stale_cross_kv_isolation(self, setup):
+        """More requests than slots: a reused slot's cross K/V and
+        self-cache from the previous occupant must never leak into the
+        next request's decode."""
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=2, chunk=3)
+        srcs = [[i + 1, i + 2, i + 3, i + 4] for i in range(7)]
+        handles = [eng.submit(s, 8) for s in srcs[:3]]
+        for step in range(400):
+            eng.step()
+            if step == 2:
+                handles += [eng.submit(s, 8) for s in srcs[3:]]
+            if len(handles) == 7 and all(h.done() for h in handles):
+                break
+        assert eng.stats["completed"] == 7
+        for s, h in zip(srcs, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, s, 8)
+
+    def test_long_source_short_source_mix(self, setup):
+        """A source at the largest bucket next to a tiny one — the
+        kv_len mask keeps the bucketed encode exact for both."""
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=2, chunk=4)
+        long_src = [((i * 7) % 250) + 1 for i in range(60)]
+        hs = [eng.submit(long_src, 8), eng.submit([4, 2], 8)]
+        run_all(eng, hs)
+        assert hs[0].result(0)["tokens"] == isolated_greedy(
+            cfg, params, long_src, 8)
+        assert hs[1].result(0)["tokens"] == isolated_greedy(
+            cfg, params, [4, 2], 8)
+
+    def test_eos_and_max_new_1(self, setup):
+        cfg, params = setup
+        src = [3, 1, 4, 1, 5]
+        ref = isolated_greedy(cfg, params, src, 12)
+        eos = ref[2]
+        eng = EncDecSlotEngine(cfg, params, slots=2, chunk=4)
+        h = eng.submit(src, 12, eos_id=eos)
+        h1 = eng.submit([7, 7], 1)
+        run_all(eng, [h, h1])
+        assert h.result(0)["tokens"] == ref[:ref.index(eos) + 1]
+        assert h1.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [7, 7], 1)
+
+    def test_sampling_paths_stay_in_vocab(self, setup):
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=2, chunk=4)
+        hs = [eng.submit([1, 2, 3], 6, temperature=0.8),
+              eng.submit([4, 5], 6, temperature=0.9, top_k=4,
+                         top_p=0.9)]
+        run_all(eng, hs)
+        for h in hs:
+            toks = h.result(0)["tokens"]
+            assert len(toks) == 6
+            assert all(0 <= t < cfg.vocab_size for t in toks)
+
+
+class TestScopeAndValidation:
+    def test_validation(self, setup):
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=2, chunk=4)
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.submit([], 4)
+        with pytest.raises(ValueError, match="largest source bucket"):
+            eng.submit([1] * (cfg.max_src_len + 1), 4)
+        with pytest.raises(ValueError, match="decoder cache capacity"):
+            eng.submit([1, 2], cfg.max_tgt_len + 1)
+        with pytest.raises(ValueError, match="prefix registry"):
+            eng.register_prefix([1, 2, 3])
+        with pytest.raises(ValueError, match="chunked prefill"):
+            EncDecSlotEngine(cfg, params, prefill_chunk=8)
+
+    def test_warmup_and_thread_loop(self, setup):
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=2, chunk=4)
+        eng.warmup(buckets=(32,))
+        with eng:
+            h = eng.submit([2, 4, 6], 8)
+            assert h.result(60)["tokens"] == isolated_greedy(
+                cfg, params, [2, 4, 6], 8)
+
+    def test_bos_id_respected(self, setup):
+        """A non-default BOS changes the first decode step — engine and
+        isolated reference must agree when configured alike."""
+        cfg, params = setup
+        eng = EncDecSlotEngine(cfg, params, slots=1, chunk=4, bos_id=7)
+        h = eng.submit([1, 2, 3], 6)
+        run_all(eng, [h])
+        fn = jax.jit(lambda p, s: encdec_generate(
+            p, s, cfg, max_new_tokens=6, bos_id=7, temperature=0.0))
+        ref = np.asarray(fn(params, jnp.asarray([[1, 2, 3]],
+                                                jnp.int32)))[0].tolist()
+        assert h.result(0)["tokens"] == ref

@@ -494,16 +494,23 @@ class TestFamilyPresets:
             p.wait(timeout=30)
 
     def test_encdec_preset_serves_seq2seq(self):
+        """Round 4: encdec rides its own slot engine — ragged sources,
+        lengths always reported, concurrent clients share the chip."""
         p, port = self._spawn("encdec:tiny")
         try:
             out = _post(port, "/generate",
                         {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
                          "temperature": 0.0}, timeout=180)
             assert len(out["tokens"][0]) == 4
-            assert "lengths" not in out  # seq2seq path has no eos contract
-            # sampling rides the same path (round-3 closes the last
-            # greedy-only line item): top_k=1 is exact greedy, and a
-            # free temperature draw stays in-vocab
+            assert out["lengths"] == [4]  # slot-path contract
+            # ragged rows in one body — impossible on the legacy path
+            ragged = _post(port, "/generate",
+                           {"srcTokens": [[5, 6, 7, 8], [9, 1]],
+                            "maxNewTokens": 4, "temperature": 0.0},
+                           timeout=120)
+            assert ragged["tokens"][0] == out["tokens"][0]
+            # top_k=1 is exact greedy; a free temperature draw stays
+            # in-vocab
             out_k1 = _post(port, "/generate",
                            {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
                             "temperature": 0.7, "topK": 1}, timeout=60)
@@ -512,13 +519,33 @@ class TestFamilyPresets:
                           {"srcTokens": [[1, 2]], "maxNewTokens": 2,
                            "temperature": 0.7}, timeout=60)
             assert all(0 <= t < 256 for t in out_t["tokens"][0])
-            # eosId switches the seq2seq response to the lengths contract
+            # eosId truncates (pad tail + lengths, host-side)
             eos = out["tokens"][0][1]
             out2 = _post(port, "/generate",
                          {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
                           "temperature": 0.0, "eosId": eos}, timeout=60)
             assert out2["lengths"] == [2]
             assert out2["tokens"][0][:2] == out["tokens"][0][:2]
+            h = _get(port, "/healthz")
+            assert h["slotEngine"]["completed"] >= 5
+        finally:
+            p.terminate()
+            p.wait(timeout=30)
+
+    def test_encdec_legacy_path_with_slots_0(self):
+        """--slots 0 keeps the serialized legacy contract: equal-length
+        rows, lengths only with eosId."""
+        p, port = self._spawn("encdec:tiny", ("--slots", "0"))
+        try:
+            out = _post(port, "/generate",
+                        {"srcTokens": [[5, 6, 7, 8]], "maxNewTokens": 4,
+                         "temperature": 0.0}, timeout=180)
+            assert len(out["tokens"][0]) == 4
+            assert "lengths" not in out
+            with pytest.raises(urllib.error.HTTPError):
+                _post(port, "/generate",
+                      {"srcTokens": [[1, 2], [3, 4, 5]],
+                       "maxNewTokens": 2})
         finally:
             p.terminate()
             p.wait(timeout=30)

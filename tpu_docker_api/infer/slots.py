@@ -282,11 +282,8 @@ class SlotEngine:
         self.max_seq = max_seq or cfg.max_seq_len
         self.chunk = chunk
         self.pipeline = pipeline
-        self.buckets = tuple(sorted(buckets or _default_buckets(self.max_seq)))
-        if self.buckets[-1] > self.max_seq:
-            raise ValueError(
-                f"largest bucket {self.buckets[-1]} exceeds cache capacity "
-                f"{self.max_seq}")
+        self.buckets = tuple(sorted(buckets or self._default_buckets()))
+        self._check_buckets()
         self.eos_id = eos_id
         self.pad_id = pad_id
         #: admission-queue bound (0 = unbounded). Checked approximately —
@@ -312,7 +309,7 @@ class SlotEngine:
                     f"slot engine meshes are tp/fsdp-only (slots stay "
                     f"replicated; decode seq is 1): got {bad}")
         self.mesh = mesh
-        self._fwd = cached_forward_fn(cfg)
+        self._fwd = self._cached_forward()
         self._k, self._v = self._alloc_cache(cache_dtype)
         # RNG = a host counter folded into PRNGKey INSIDE the programs:
         # an eager jax.random.split costs a ~150 ms tunnel round-trip
@@ -379,6 +376,24 @@ class SlotEngine:
                       "bucketed_chunks": 0, "accepted_tokens": 0,
                       "prefix_hits": 0, "segment_prefills": 0,
                       "prefix_bytes": 0}
+
+    def _cached_forward(self):
+        """The family's KV-cached forward (llama/moe). The encdec
+        engine overrides — its decode body lives in models/encdec.py
+        with a different signature."""
+        return cached_forward_fn(self.cfg)
+
+    def _default_buckets(self) -> tuple[int, ...]:
+        return _default_buckets(self.max_seq)
+
+    def _check_buckets(self) -> None:
+        """Prompt buckets must fit the decode cache — prompts and
+        generated tokens share positions. The encdec engine overrides:
+        its prompts are SOURCE tokens with their own capacity."""
+        if self.buckets[-1] > self.max_seq:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} exceeds cache "
+                f"capacity {self.max_seq}")
 
     def _alloc_cache(self, cache_dtype):
         """The big per-slot KV buffers — dense (slots, max_seq) here;

@@ -207,22 +207,33 @@ def _has_sp(mesh) -> bool:
             and mesh.shape.get("sp", 1) > 1)
 
 
-def _enc_block(x, layer, cfg: EncDecConfig, rope_cos, rope_sin, mesh):
+def _enc_block(x, layer, cfg: EncDecConfig, rope_cos, rope_sin, mesh,
+               kv_len=None):
     """Bidirectional self-attention + SwiGLU, pre-norm residuals. On an
     sp mesh the attention rides the non-causal ring (contiguous
-    placement — no causal skew to fix)."""
+    placement — no causal skew to fix). ``kv_len`` ((b,) int32) masks
+    right-pad positions out of the bidirectional attention — bucketed
+    slot-engine admissions must encode EXACTLY like the unpadded source
+    (pad keys would otherwise shift every real position's softmax)."""
     b, s, d = x.shape
     y = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q, k, v = _project_qkv(y, layer["attn"], cfg)
     q = apply_rope(q, rope_cos, rope_sin)
     k = apply_rope(k, rope_cos, rope_sin)
     if _has_sp(mesh):
+        if kv_len is not None:
+            # the ring kernel has no length-mask plumbing; silently
+            # dropping the mask would corrupt every real position's
+            # bidirectional softmax — the exact bug the mask prevents
+            raise NotImplementedError(
+                "kv_len masking is not supported on sp-mesh encodes "
+                "(ring attention path)")
         from tpu_docker_api.parallel.ring import ring_attention
 
         out = ring_attention(q, k, v, mesh, causal=False)
     else:
         out = multihead_attention(q, k, v, causal=False,
-                                  probs_dtype=cfg.dtype)
+                                  probs_dtype=cfg.dtype, kv_len=kv_len)
     x = x + linear(out.reshape(b, s, d), layer["attn"]["wo"])
     bspec = P(("dp", "fsdp"), "sp")
     x = constrain(x, mesh, bspec) if mesh is not None else x
@@ -272,8 +283,11 @@ def _maybe_remat(fn, cfg: EncDecConfig):
     return jax.checkpoint(fn, policy=TRAIN_REMAT_POLICY)
 
 
-def encdec_encode(params, src, cfg: EncDecConfig, mesh=None):
-    """(b, S) source tokens → (b, S, d) encoder output (final-normed)."""
+def encdec_encode(params, src, cfg: EncDecConfig, mesh=None, kv_len=None):
+    """(b, S) source tokens → (b, S, d) encoder output (final-normed).
+    ``kv_len`` ((b,) int32): treat row b's positions >= kv_len[b] as
+    right-padding — excluded from every layer's attention, so the
+    output at real positions equals encoding the unpadded source."""
     x = embed_lookup(params["embed"]["tokens"], src, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
@@ -281,7 +295,7 @@ def encdec_encode(params, src, cfg: EncDecConfig, mesh=None):
         cfg.head_dim, src.shape[1], cfg.rope_theta)
     block = _maybe_remat(functools.partial(
         _enc_block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin,
-        mesh=mesh), cfg)
+        mesh=mesh, kv_len=kv_len), cfg)
 
     def body(x, layer):
         return block(x, layer), None
@@ -383,6 +397,75 @@ def _cross_kv(params, enc_out, cfg: EncDecConfig):
     _, (ks, vs) = lax.scan(per_layer, None,
                            params["dec_layers"]["cross_attn"])
     return ks, vs
+
+
+def encdec_slot_decode_step(
+    params: dict,
+    tok: jnp.ndarray,        # (S,) int32 current token per slot
+    pos: jnp.ndarray,        # (S,) int32 per-slot decode position
+    cfg: EncDecConfig,
+    k_cache: jnp.ndarray,    # (Ld, S, max_tgt, kvh, hd) self-attn cache
+    v_cache: jnp.ndarray,
+    cross_k: jnp.ndarray,    # (Ld, S, src_cap, kvh, hd) per-slot static
+    cross_v: jnp.ndarray,
+    src_lens: jnp.ndarray,   # (S,) int32 true source length per slot
+    rope_cos, rope_sin,
+    kv_limit: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE decoder position for S independent slot rows — the decode
+    body of the encdec slot engine (infer/encdec_slots.py). Math is
+    ``encdec_generate``'s dec_step with three slot-engine twists, all
+    established by the llama engine (models/llama.py ``_attention``):
+    per-row positions (scatter cache writes, ``mode="drop"`` past
+    capacity; per-row causal ``q_offset``), a static ``kv_limit``
+    read bucket on the self-attn cache, and per-row ``src_lens``
+    masking the cross path (each slot's static cross k/v sit
+    right-padded in a shared bucket-capacity buffer). Returns
+    (logits (S, vocab) f32, k_cache, v_cache)."""
+    from tpu_docker_api.ops.attention import dense_attention
+
+    S = tok.shape[0]
+    d, hd = cfg.dim, cfg.head_dim
+    x = embed_lookup(params["embed"]["tokens"], tok[:, None], None)
+    rows = jnp.arange(S, dtype=jnp.int32)[:, None]
+    positions = pos[:, None]
+
+    def layer_body(inner, packed):
+        x, k_cache, v_cache = inner
+        layer, layer_idx, ck, cv = packed
+        y = rms_norm(x, layer["self_norm"], cfg.norm_eps)
+        q, k, v = _project_qkv(y, layer["self_attn"], cfg)
+        q = apply_rope(q, rope_cos, rope_sin, positions)
+        k = apply_rope(k, rope_cos, rope_sin, positions)
+        k_cache = k_cache.at[layer_idx, rows, positions].set(
+            k.astype(k_cache.dtype), mode="drop")
+        v_cache = v_cache.at[layer_idx, rows, positions].set(
+            v.astype(v_cache.dtype), mode="drop")
+        kc = lax.dynamic_index_in_dim(k_cache, layer_idx, 0, False)
+        vc = lax.dynamic_index_in_dim(v_cache, layer_idx, 0, False)
+        if kv_limit is not None and kv_limit < kc.shape[1]:
+            kc = lax.slice_in_dim(kc, 0, kv_limit, axis=1)
+            vc = lax.slice_in_dim(vc, 0, kv_limit, axis=1)
+        out = dense_attention(q, kc, vc, causal=True, q_offset=pos)
+        x = x + linear(out.reshape(S, 1, d), layer["self_attn"]["wo"])
+
+        y = rms_norm(x, layer["cross_norm"], cfg.norm_eps)
+        q = linear(y, layer["cross_attn"]["wq"]).reshape(
+            S, 1, cfg.n_heads, hd)
+        out = dense_attention(q, ck, cv, causal=False, kv_len=src_lens)
+        x = x + linear(out.reshape(S, 1, d), layer["cross_attn"]["wo"])
+        x = x + _mlp(rms_norm(x, layer["mlp_norm"], cfg.norm_eps),
+                     layer["mlp"])
+        return (x, k_cache, v_cache), None
+
+    (x, k_cache, v_cache), _ = lax.scan(
+        layer_body, (x, k_cache, v_cache),
+        (params["dec_layers"], jnp.arange(cfg.dec_layers), cross_k,
+         cross_v))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = linear(x.astype(cfg.dtype), params["lm_head"],
+                    out_dtype=jnp.float32)
+    return logits[:, 0], k_cache, v_cache
 
 
 def encdec_generate(

@@ -28,6 +28,7 @@ def dense_attention(
     causal: bool,
     q_offset: jnp.ndarray | int | None = None,
     probs_dtype: jnp.dtype | None = None,
+    kv_len: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Einsum attention with GQA folding. ``q_offset`` gives query i the
     absolute position ``q_offset + i`` so KV-cached decode (queries near the
@@ -37,6 +38,12 @@ def dense_attention(
     A (batch,) ``q_offset`` gives every row its own absolute position — the
     continuous-batching decode case (infer/slots.py) where each cache slot
     sits at a different sequence length.
+
+    ``kv_len`` ((batch,) int32) masks key positions ``>= kv_len[row]``
+    regardless of causality — right-padded variable-length keys (the
+    encdec slot engine's bucketed encoder inputs and per-slot cross
+    k/v). Masked columns contribute exp(-1e30 - max) == 0.0 exactly, so
+    a padded batch equals its unpadded rows bit-for-bit in f32.
 
     ``probs_dtype``: storage dtype for the (b, h, q, k) probability tensor
     feeding the PV matmul. The f32 default is the serving-correctness
@@ -65,6 +72,10 @@ def dense_attention(
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                         preferred_element_type=jnp.float32)
     scores = scores * (1.0 / head_dim**0.5)
+    if kv_len is not None:
+        k_pos = jnp.arange(kv_seq, dtype=jnp.int32)
+        lmask = k_pos[None, :] < kv_len[:, None]          # (batch, k)
+        scores = jnp.where(lmask[:, None, None, None, :], scores, -1e30)
     if causal:
         q_pos = jnp.arange(seq, dtype=jnp.int32)
         k_pos = jnp.arange(kv_seq, dtype=jnp.int32)
@@ -95,10 +106,15 @@ def multihead_attention(
     causal: bool = True,
     impl: str = "auto",
     probs_dtype: jnp.dtype | None = None,
+    kv_len: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """(batch, seq, heads, head_dim) attention with GQA support.
     ``probs_dtype`` forwards to ``dense_attention`` (the flash kernel
-    already keeps probs in the storage dtype internally)."""
+    already keeps probs in the storage dtype internally). ``kv_len``
+    forces the dense path (the kernel has no length-mask plumbing)."""
+    if kv_len is not None:
+        return dense_attention(q, k, v, causal, probs_dtype=probs_dtype,
+                               kv_len=kv_len)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         # seq must tile by 128; head_dim 64 works too (Mosaic pads lanes),

@@ -30,11 +30,15 @@ HTTP surface (stdlib server, same envelope as the control plane):
 
 Family presets mirror the trainer CLI: ``--preset moe:NAME`` serves
 through the same KV-cached engine and body; ``--preset encdec:NAME``
-serves seq2seq — the body uses ``srcTokens`` instead of ``tokens``, and
-temperature/topK/topP sample through the same ``make_sampler`` semantics
-as the llama engine; with ``eosId`` the response carries ``lengths``
-(truncate-at-eos), without it no lengths are reported. ViT has no
-generative serving path.
+serves seq2seq — the body uses ``srcTokens`` instead of ``tokens``
+(rows may be ragged on the slot path), and temperature/topK/topP sample
+through the same semantics as the llama engine. Round 4: encdec rides
+its own continuous-batching slot engine (infer/encdec_slots.py) on a
+single device — concurrent seq2seq clients share the chip, and the
+response carries ``lengths`` like every slot-path family. The legacy
+serialized path (meshes, ``--slots 0``) keeps its old contract: equal-
+length rows, ``lengths`` only with ``eosId``. ViT has no generative
+serving path.
 
 Design notes, TPU-first:
 
@@ -314,7 +318,27 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(
             "--page-size requires the slot-engine path (llama preset, "
             "--slots > 0, single device)")
-    if slot_ok_here:
+    if is_encdec and args.slots > 0 and not multi:
+        # seq2seq continuous batching (round 4): sources may be ragged,
+        # decode runs through the same slot machinery as llama/moe; the
+        # legacy serialized path remains for meshes and --slots 0
+        from tpu_docker_api.infer.encdec_slots import EncDecSlotEngine
+
+        if args.prefill_chunk:
+            raise SystemExit(
+                "--prefill-chunk does not apply to seq2seq admission "
+                "(sources are bounded by max_src_len)")
+        if args.draft_preset:
+            raise SystemExit(
+                "--draft-preset is not supported with encdec presets")
+
+        slot_engine = EncDecSlotEngine(
+            cfg, params, slots=args.slots, max_seq=max_seq,
+            chunk=args.chunk, max_pending=args.slots * 8,
+            seed=int.from_bytes(os.urandom(4), "little"))
+        slot_engine.warmup(buckets=())
+        slot_engine.start()
+    elif slot_ok_here:
         from tpu_docker_api.infer.slots import SlotEngine
 
         if args.draft_preset:
@@ -656,7 +680,7 @@ def main(argv: list[str] | None = None) -> None:
                 # to the legacy path instead of 500ing forever; a
                 # SPECULATIVE engine is greedy-only, so sampled requests
                 # fall back too rather than 400
-                slot_ok = (slot_engine is not None and not is_encdec
+                slot_ok = (slot_engine is not None
                            and not slot_engine.dead)
                 if (slot_ok and hasattr(slot_engine, "n_spec")
                         and (temperature != 0.0 or top_k != 0
@@ -664,8 +688,9 @@ def main(argv: list[str] | None = None) -> None:
                     slot_ok = False
                 if do_stream and not slot_ok:
                     raise ValueError(
-                        "stream requires the slot engine path (not "
-                        "encdec, --slots > 0, single device)")
+                        "stream requires the slot engine path "
+                        "(--slots > 0, single device; every family "
+                        "incl. encdec has one as of round 4)")
                 if do_stream and len(prompts) != 1:
                     raise ValueError("stream serves exactly one prompt row")
 
