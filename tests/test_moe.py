@@ -277,3 +277,97 @@ class TestDispatchImplValidation:
         mesh = build_mesh(MeshPlan(dp=2), devices=jax.devices()[:2])
         with pytest.raises(ValueError, match="single-device only"):
             _moe_mlp(x, layer_moe, cfg, mesh=mesh)
+
+
+class TestSortDispatch:
+    """The "sort" (dense-packed, constrained) dispatch — round 4's
+    answer to the einsum path's measured 2.6x MFU deficit: identical
+    math to gather on one device, mesh-legal via ep constraints."""
+
+    @pytest.mark.parametrize("drop_free", [False, True])
+    def test_sort_equals_gather_single_device(self, drop_free):
+        import dataclasses
+
+        from tpu_docker_api.models.moe import _moe_mlp
+
+        cfg = moe_presets()["moe-tiny"]
+        params = moe_init(dataclasses.replace(cfg, n_layers=1),
+                          jax.random.PRNGKey(0))
+        layer_moe = jax.tree_util.tree_map(lambda p: p[0],
+                                           params["layers"]["moe"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, cfg.dim),
+                              cfg.dtype)
+        out_s, aux_s = _moe_mlp(
+            x, layer_moe, dataclasses.replace(cfg, dispatch_impl="sort"),
+            mesh=None, drop_free=drop_free)
+        out_g, aux_g = _moe_mlp(
+            x, layer_moe, dataclasses.replace(cfg, dispatch_impl="gather"),
+            mesh=None, drop_free=drop_free)
+        np.testing.assert_array_equal(np.asarray(out_s),
+                                      np.asarray(out_g))
+        assert float(aux_s) == float(aux_g)
+
+    def test_sort_gradients_equal_gather(self):
+        import dataclasses
+
+        from tpu_docker_api.models.moe import _moe_mlp
+
+        cfg = dataclasses.replace(moe_presets()["moe-tiny"], n_layers=1,
+                                  dtype=jnp.float32)
+        params = moe_init(cfg, jax.random.PRNGKey(0))
+        layer_moe = jax.tree_util.tree_map(lambda p: p[0],
+                                           params["layers"]["moe"])
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, cfg.dim),
+                              jnp.float32)
+
+        def loss(impl, lm, x):
+            out, aux = _moe_mlp(
+                x, lm, dataclasses.replace(cfg, dispatch_impl=impl),
+                mesh=None)
+            return jnp.sum(out.astype(jnp.float32) ** 2) + aux
+
+        g_s = jax.grad(lambda lm, x: loss("sort", lm, x),
+                       argnums=(0, 1))(layer_moe, x)
+        g_g = jax.grad(lambda lm, x: loss("gather", lm, x),
+                       argnums=(0, 1))(layer_moe, x)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            g_s, g_g)
+
+    def test_sort_on_ep_mesh_matches_single_device(self):
+        """The mesh-legality claim: sort compiles and runs under GSPMD
+        on a dp×ep mesh and agrees with the single-device result."""
+        import dataclasses
+
+        cfg = dataclasses.replace(tiny_cfg(), dispatch_impl="sort")
+        params = moe_init(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        l_single = float(moe_loss(params, tokens, cfg))
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=1, sp=1, ep=4))
+        with mesh:
+            l_ep = float(jax.jit(
+                lambda p, t: moe_loss(p, t, cfg, mesh))(params, tokens))
+        np.testing.assert_allclose(l_ep, l_single, rtol=2e-2, atol=2e-2)
+
+    def test_sort_train_step_over_ep_mesh(self):
+        """Grad path under GSPMD: one train step on the ep mesh with
+        sort dispatch descends and stays finite."""
+        import dataclasses
+
+        from tpu_docker_api.train.trainer import (
+            create_train_state, make_train_step, synthetic_batch)
+
+        cfg = dataclasses.replace(tiny_cfg(), dispatch_impl="sort")
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=1, sp=1, ep=4))
+        state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh, opt)
+        batch = synthetic_batch(jax.random.PRNGKey(1), 4, 16,
+                                cfg.vocab_size)
+        losses = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]

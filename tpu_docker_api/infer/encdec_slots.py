@@ -41,8 +41,6 @@ speculative composition.
 
 from __future__ import annotations
 
-import queue
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -227,81 +225,35 @@ class EncDecSlotEngine(SlotEngine):
             self._dtemp, self._dtopk, self._dtopp, self._dsrc,
             self._k, self._v, self._ck, self._cv)
 
-    # ---- engine loop -------------------------------------------------------
+    # ---- engine loop (base _admit/_dispatch_chunk drive these seams) -------
 
-    def _admit(self) -> bool:
-        """Same-bucket sources admit as power-of-two row batches through
-        one masked-encode dispatch. Simpler than the base: no prefix
-        plans, no segments, no admission-time token (max_new == 1 still
-        takes one decode chunk — seq2seq has no prefill token)."""
-        admitted = False
-        free = [i for i, s in self._table.items() if s is None]
-        batch = []
-        while len(batch) < len(free):
-            try:
-                batch.append(self._pending.get_nowait())
-            except queue.Empty:
-                break
-        if not batch:
-            return False
-        groups: dict[int, list] = {}
-        for req in batch:
-            bucket = next(b for b in self.buckets if b >= len(req[0]))
-            groups.setdefault(bucket, []).append(req)
-        for bucket, reqs in groups.items():
-            while reqs:
-                R = 1
-                while R * 2 <= len(reqs) and R * 2 <= self.slots:
-                    R *= 2
-                group, reqs = reqs[:R], reqs[R:]
-                slots_v = [free.pop() for _ in group]
-                src_np = np.full((R, bucket), self.pad_id, np.int32)
-                lens = np.empty((R,), np.int32)
-                temps = np.empty((R,), np.float32)
-                topks = np.empty((R,), np.int32)
-                topps = np.empty((R,), np.float32)
-                for r, (src, _mn, temp, _eos, tk, tp, _h) in enumerate(
-                        group):
-                    src_np[r, :len(src)] = src
-                    lens[r] = len(src)
-                    temps[r], topks[r], topps[r] = temp, tk, tp
-                (self._ck, self._cv, self._dtok, self._dpos, self._dtemp,
-                 self._dtopk, self._dtopp,
-                 self._dsrc) = self._prefill_fn(bucket, R)(
-                    self.params, src_np, lens,
-                    np.asarray(slots_v, np.int32), temps, topks, topps,
-                    self._ck, self._cv, self._dtok, self._dpos,
-                    self._dtemp, self._dtopk, self._dtopp, self._dsrc)
-                self.stats["prefills"] += 1
-                for r, (src, max_new, temp, eos_id, tk, tp,
-                        handle) in enumerate(group):
-                    # base_len = 0: decode positions start at 0, so the
-                    # kv read-bucket reach bound is chunk-count-driven;
-                    # fresh = False: the chunk's column 0 is BOS, never
-                    # an emitted token
-                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
-                               pos=0, temperature=temp, eos_id=eos_id,
-                               top_k=tk, top_p=tp, base_len=0,
-                               fresh=False)
-                    with self._lock:
-                        self._table[slots_v[r]] = st
-                admitted = True
-        return admitted
+    def _prefill_dispatch(self, bucket, R, prompts_np, lens, slots_v,
+                          temps, topks, topps):
+        """The admission dispatch for an R-row same-bucket source
+        group: one masked-encode program (base's grouping loop supplies
+        the padded rows). Returns None — seq2seq admission samples no
+        token (``_finish_admission_only`` is a no-op)."""
+        (self._ck, self._cv, self._dtok, self._dpos, self._dtemp,
+         self._dtopk, self._dtopp,
+         self._dsrc) = self._prefill_fn(bucket, R)(
+            self.params, prompts_np, lens,
+            np.asarray(slots_v, np.int32), temps, topks, topps,
+            self._ck, self._cv, self._dtok, self._dpos,
+            self._dtemp, self._dtopk, self._dtopp, self._dsrc)
+        return None
 
-    def _dispatch_chunk(self) -> None:
-        snap = {i: s for i, s in self._table.items() if s is not None}
-        limit = self._kv_limit_for_chunk(snap)
-        filtered = any(s.top_k > 0 or s.top_p < 1.0
-                       for s in snap.values())
-        out, self._dtok, self._dpos, self._k, self._v = self._decode(
-            limit, filtered)(
-            self.params, self._next_seed(), self._dtok, self._dpos,
-            self._dtemp, self._dtopk, self._dtopp, self._dsrc,
-            self._k, self._v, self._ck, self._cv)
-        for st in snap.values():
-            st.dispatched += 1
-        out.copy_to_host_async()
-        self._outstanding.append((snap, out))
-        self.stats["decode_chunks"] += 1
-        if limit is not None:
-            self.stats["bucketed_chunks"] += 1
+    def _new_slot(self, prompt, max_new, temp, eos_id, tk, tp, handle):
+        # base_len = 0: decode positions start at 0, so the kv
+        # read-bucket reach bound is chunk-count-driven; fresh = False:
+        # the chunk's column 0 is BOS, never an emitted token
+        return _Slot(handle=handle, tokens=[], max_new=max_new, pos=0,
+                     temperature=temp, eos_id=eos_id, top_k=tk,
+                     top_p=tp, base_len=0, fresh=False)
+
+    def _finish_admission_only(self, slot, st, toks, r) -> None:
+        pass  # max_new == 1 still takes one decode chunk (BOS → token)
+
+    def _decode_call_args(self):
+        return (self.params, self._next_seed(), self._dtok, self._dpos,
+                self._dtemp, self._dtopk, self._dtopp, self._dsrc,
+                self._k, self._v, self._ck, self._cv)

@@ -45,11 +45,15 @@ def bench_concurrent_serving(
     chunk: int = 8,
     quantize: bool = False,
     reps: int = 2,
+    cfg=None,
+    params=None,
 ) -> dict:
     """N concurrent streams through the slot engine vs the same N
     serialized through the legacy engine at batch 1 (the round-2 serving
     shape). The VERDICT r2 item-1 target is slot/serialized >= 2.0 at
-    streams=8."""
+    streams=8. Pass ``cfg``/``params`` to measure a specific model —
+    e.g. a TRAINED target, where bf16 argmax near-ties vanish and
+    ``match_rows`` should read ~N/N on hardware (VERDICT r3 weak #2)."""
     import jax
     import jax.numpy as jnp
 
@@ -57,13 +61,15 @@ def bench_concurrent_serving(
     from tpu_docker_api.infer.slots import SlotEngine
     from tpu_docker_api.models.llama import llama_init, llama_presets
 
-    cfg = llama_presets()[preset]
-    if quantize:
-        from tpu_docker_api.infer.quantize import synth_quantized_params
+    if cfg is None:
+        cfg = llama_presets()[preset]
+    if params is None:
+        if quantize:
+            from tpu_docker_api.infer.quantize import synth_quantized_params
 
-        params = synth_quantized_params(cfg)
-    else:
-        params = llama_init(cfg, jax.random.PRNGKey(0))
+            params = synth_quantized_params(cfg)
+        else:
+            params = llama_init(cfg, jax.random.PRNGKey(0))
     prompts = [
         jax.random.randint(jax.random.PRNGKey(10 + i), (prompt_len,), 0,
                            cfg.vocab_size, dtype=jnp.int32).tolist()
@@ -461,4 +467,331 @@ def bench_moe_serving(
         "decode_tok_s": round(batch / decode_s, 1),
         "decode_only_ms_per_tok": round(decode_s * 1e3, 3),
         "tok_s_incl_prefill": round(batch * new_tok / t_full, 1),
+    }
+
+
+def _percentile(xs: list[float], p: float) -> float:
+    """Nearest-rank percentile (no interpolation — honest at small n)."""
+    s = sorted(xs)
+    return s[min(len(s) - 1, max(0, int(round(p / 100 * len(s))) - 1))]
+
+
+def bench_tail_latency(
+    preset: str = "llama3-1b",
+    streams: int = 8,
+    n_requests: int = 32,
+    arrival_s: float = 0.05,
+    prompt_lens: tuple[int, ...] = (32, 128, 384),
+    new_tok: int = 48,
+    max_seq: int = 512,
+    chunk: int = 8,
+    quantize: bool = False,
+) -> dict:
+    """Tail-latency SLOs under a mixed OPEN-LOOP load (VERDICT r3
+    stretch): ``n_requests`` streaming requests with cycled prompt
+    lengths arrive at a fixed inter-arrival time; per request the
+    consumer records TTFT (submit → first token) and inter-token gaps.
+    Reports p50/p99 for both. ITL is chunk-granular by design — the
+    engine resolves tokens per processed chunk at the pipeline lag, so
+    the chunk size is part of the operating point and is reported."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.slots import SlotEngine
+    from tpu_docker_api.models.llama import llama_init, llama_presets
+
+    cfg = llama_presets()[preset]
+    if quantize:
+        from tpu_docker_api.infer.quantize import synth_quantized_params
+
+        params = synth_quantized_params(cfg)
+    else:
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+    prompts = [
+        jax.random.randint(
+            jax.random.PRNGKey(40 + i),
+            (prompt_lens[i % len(prompt_lens)],), 0, cfg.vocab_size,
+            dtype=jnp.int32).tolist()
+        for i in range(n_requests)
+    ]
+    eng = SlotEngine(cfg, params, slots=streams, max_seq=max_seq,
+                     chunk=chunk, max_pending=n_requests)
+    eng.warmup(rows=(1,))
+    eng.start()
+    try:
+        # warm every prefill bucket this load reaches (compiles must not
+        # pollute the tails) — one real-length prompt per distinct
+        # length, NOT slices of prompts[0] (which only covers its own)
+        for i in range(len(prompt_lens)):
+            eng.submit(prompts[i], 4).result(300)
+
+        ttfts: list[float] = []
+        mean_itls: list[float] = []
+        max_itls: list[float] = []
+        lock = threading.Lock()
+
+        def consume(handle, t_submit):
+            arrivals = []
+            for _ in handle.stream(timeout=600):
+                arrivals.append(time.perf_counter())
+            with lock:
+                ttfts.append(arrivals[0] - t_submit)
+                # tokens resolve per processed chunk, so RAW gaps are
+                # bursty (many zeros + chunk-sized steps); the
+                # per-request MEAN gap is the effective token cadence a
+                # client experiences, the MAX gap its worst stall
+                gaps = [b - a for a, b in zip(arrivals, arrivals[1:])]
+                if gaps:
+                    mean_itls.append(sum(gaps) / len(gaps))
+                    max_itls.append(max(gaps))
+
+        threads = []
+        t_bench0 = time.perf_counter()
+        for i, pr in enumerate(prompts):
+            target = t_bench0 + i * arrival_s
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            h = eng.submit(pr, new_tok, stream=True)
+            th = threading.Thread(target=consume,
+                                  args=(h, time.perf_counter()))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        wall = time.perf_counter() - t_bench0
+    finally:
+        eng.close()
+    return {
+        "ok": len(ttfts) == n_requests,
+        "preset": preset,
+        "quantized": quantize,
+        "streams": streams,
+        "n_requests": n_requests,
+        "arrival_ms": round(arrival_s * 1e3, 1),
+        "new_tokens": new_tok,
+        "chunk": chunk,
+        "prompt_lens": list(prompt_lens),
+        "ttft_p50_ms": round(_percentile(ttfts, 50) * 1e3, 1),
+        "ttft_p99_ms": round(_percentile(ttfts, 99) * 1e3, 1),
+        "itl_p50_ms": round(_percentile(mean_itls, 50) * 1e3, 1),
+        "itl_p99_ms": round(_percentile(mean_itls, 99) * 1e3, 1),
+        "itl_max_p99_ms": round(_percentile(max_itls, 99) * 1e3, 1),
+        "aggregate_tok_s": round(n_requests * new_tok / wall, 1),
+    }
+
+
+def bench_paged_capacity(
+    preset: str = "llama3-8b",
+    streams: int = 32,
+    max_seq: int = 2048,
+    page_size: int = 64,
+    prompt_len: int = 128,
+    new_tok: int = 64,
+    chunk: int = 8,
+    reps: int = 2,
+) -> dict:
+    """The serving point the dense cache cannot reach (VERDICT r3 next
+    #3): ``streams`` slots at ``max_seq`` capacity on the int8
+    north-star model. The dense allocation is reported ARITHMETICALLY
+    (slots × max_seq × per-position bytes) against the chip's HBM —
+    actually attempting it would OOM-kill the tunnel client (r3 bench
+    lesson) — while the paged pool, sized to the live tokens the
+    requests actually use, runs the full load and reports throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.paged import PagedSlotEngine, _ceil_div
+    from tpu_docker_api.infer.quantize import (
+        quantized_bytes, synth_quantized_params)
+    from tpu_docker_api.models.llama import llama_presets
+
+    cfg = llama_presets()[preset]
+    params = synth_quantized_params(cfg)
+    pos_bytes = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim * 2
+    dense_gb = streams * max_seq * pos_bytes / 2**30
+    # pool: exactly the pages this load needs + one slot of headroom
+    per_req = _ceil_div(max(256, prompt_len + new_tok), page_size)
+    total_pages = streams * per_req + _ceil_div(max_seq, page_size)
+    pool_gb = (total_pages + 1) * page_size * pos_bytes / 2**30
+
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(60 + i), (prompt_len,), 0,
+                           cfg.vocab_size, dtype=jnp.int32).tolist()
+        for i in range(streams)
+    ]
+    eng = PagedSlotEngine(cfg, params, page_size=page_size,
+                          total_pages=total_pages, slots=streams,
+                          max_seq=max_seq, chunk=chunk)
+    eng.warmup(buckets=(128,), rows=(1, min(streams, 8)))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        handles = [eng.submit(pr, new_tok) for pr in prompts]
+        while not all(h.done() for h in handles):
+            eng.step()
+        times.append(time.perf_counter() - t0)
+    ok = all(h.result(0)["length"] == new_tok for h in handles)
+    dt = min(times)
+    hbm_gb = 16.0  # v5e
+    weights_gb = quantized_bytes(params) / 2**30
+    return {
+        "ok": ok and eng.stats["completed"] >= streams,
+        "preset": preset,
+        "streams": streams,
+        "capacity": max_seq,
+        "page_size": page_size,
+        "total_pages": total_pages,
+        "dense_cache_gb": round(dense_gb, 2),
+        "paged_pool_gb": round(pool_gb, 2),
+        "weights_gb": round(weights_gb, 2),
+        "dense_fits_with_weights": (dense_gb + weights_gb) < hbm_gb,
+        "aggregate_tok_s": round(streams * new_tok / dt, 1),
+        "deferred_admissions": eng.stats["deferred_admissions"],
+    }
+
+
+def bench_encdec_slot_serving(
+    preset: str = "encdec-base",
+    streams: int = 8,
+    src_len: int = 128,
+    new_tok: int = 64,
+    chunk: int = 8,
+    reps: int = 2,
+) -> dict:
+    """Seq2seq continuous batching vs the round-3 serialized path: N
+    concurrent sources through EncDecSlotEngine vs the same N one at a
+    time through batch-1 ``encdec_generate`` programs (what gen_lock
+    serving delivered). Token match reported per row (bf16 caveat as
+    bench_concurrent_serving)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.encdec_slots import EncDecSlotEngine
+    from tpu_docker_api.models.encdec import (
+        encdec_generate, encdec_init, encdec_presets)
+
+    cfg = encdec_presets()[preset]
+    params = encdec_init(cfg, jax.random.PRNGKey(0))
+    srcs = [
+        jax.random.randint(jax.random.PRNGKey(50 + i), (src_len,), 0,
+                           cfg.vocab_size, dtype=jnp.int32).tolist()
+        for i in range(streams)
+    ]
+
+    fn = jax.jit(lambda p, s: encdec_generate(
+        p, s, cfg, max_new_tokens=new_tok, temperature=0.0))
+    first = fn(params, jnp.asarray([srcs[0]], jnp.int32))
+    int(first[0, 0])  # compile + force
+    ser_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        outs = [fn(params, jnp.asarray([s], jnp.int32)) for s in srcs]
+        int(outs[-1][0, 0])
+        ser_times.append(time.perf_counter() - t0)
+    ser_dt = min(ser_times)
+    ser_tokens = [np_list(o) for o in outs]
+
+    eng = EncDecSlotEngine(cfg, params, slots=streams, chunk=chunk)
+    eng.warmup(rows=(1, streams))
+    slot_times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        handles = [eng.submit(s, new_tok) for s in srcs]
+        while not all(h.done() for h in handles):
+            eng.step()
+        slot_times.append(time.perf_counter() - t0)
+    slot_dt = min(slot_times)
+    slot_tokens = [h.result(0)["tokens"] for h in handles]
+
+    total = streams * new_tok
+    matches = sum(s == r for s, r in zip(slot_tokens, ser_tokens))
+    return {
+        "ok": all(len(t) == new_tok for t in slot_tokens),
+        "match_rows": f"{matches}/{streams}",
+        "preset": preset,
+        "streams": streams,
+        "src_len": src_len,
+        "new_tokens": new_tok,
+        "serialized_tok_s": round(total / ser_dt, 1),
+        "slot_tok_s": round(total / slot_dt, 1),
+        "speedup": round(ser_dt / slot_dt, 2),
+    }
+
+
+def np_list(out) -> list:
+    import numpy as np
+
+    return np.asarray(out)[0].tolist()
+
+
+def bench_paged_vs_dense(
+    preset: str = "llama3-1b",
+    streams: int = 8,
+    prompt_len: int = 128,
+    new_tok: int = 64,
+    max_seq: int = 512,
+    page_size: int = 64,
+    chunk: int = 8,
+    quantize: bool = False,
+    reps: int = 2,
+) -> dict:
+    """Same workload through the dense slot engine and the paged engine
+    at an operating point BOTH can run — the honest cost accounting for
+    paging (the page-gather is an extra HBM round-trip of the live
+    bytes per layer; capacity, not speed, is paging's win). Reports
+    both throughputs and the token match rate between them."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_docker_api.infer.paged import PagedSlotEngine
+    from tpu_docker_api.infer.slots import SlotEngine
+    from tpu_docker_api.models.llama import llama_init, llama_presets
+
+    cfg = llama_presets()[preset]
+    if quantize:
+        from tpu_docker_api.infer.quantize import synth_quantized_params
+
+        params = synth_quantized_params(cfg)
+    else:
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+    prompts = [
+        jax.random.randint(jax.random.PRNGKey(70 + i), (prompt_len,), 0,
+                           cfg.vocab_size, dtype=jnp.int32).tolist()
+        for i in range(streams)
+    ]
+
+    def run(eng):
+        eng.warmup(rows=(1, streams))
+        times, toks = [], None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            handles = [eng.submit(pr, new_tok) for pr in prompts]
+            while not all(h.done() for h in handles):
+                eng.step()
+            times.append(time.perf_counter() - t0)
+            toks = [h.result(0)["tokens"] for h in handles]
+        del eng
+        jax.clear_caches()
+        return min(times), toks
+
+    dense_dt, dense_toks = run(SlotEngine(
+        cfg, params, slots=streams, max_seq=max_seq, chunk=chunk))
+    paged_dt, paged_toks = run(PagedSlotEngine(
+        cfg, params, page_size=page_size, slots=streams,
+        max_seq=max_seq, chunk=chunk))
+    total = streams * new_tok
+    matches = sum(a == b for a, b in zip(paged_toks, dense_toks))
+    return {
+        "ok": all(len(t) == new_tok for t in paged_toks),
+        "match_rows": f"{matches}/{streams}",
+        "preset": preset,
+        "quantized": quantize,
+        "streams": streams,
+        "page_size": page_size,
+        "dense_tok_s": round(total / dense_dt, 1),
+        "paged_tok_s": round(total / paged_dt, 1),
+        "paged_over_dense": round(dense_dt / paged_dt, 2),
     }

@@ -1013,20 +1013,35 @@ class SlotEngine:
                 self.stats["prefills"] += 1
                 for r, (prompt, max_new, temp, eos_id, tk, tp,
                         handle) in enumerate(group):
-                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
-                               pos=len(prompt), temperature=temp,
-                               eos_id=eos_id, top_k=tk, top_p=tp,
-                               base_len=len(prompt))
+                    st = self._new_slot(prompt, max_new, temp, eos_id,
+                                        tk, tp, handle)
                     with self._lock:
                         self._table[slots_v[r]] = st
                     if max_new == 1:
-                        # nothing to decode: resolve the prefill token
-                        # now (the one admission path that syncs)
-                        st.emit(int(toks[r]))
-                        st.fresh = False
-                        self._finish_if_done(slots_v[r], st)
+                        self._finish_admission_only(slots_v[r], st,
+                                                    toks, r)
                 admitted = True
         return admitted
+
+    def _new_slot(self, prompt, max_new, temp, eos_id, tk, tp,
+                  handle) -> _Slot:
+        """Slot bookkeeping for one admitted request. Decoder-only
+        families start decode AFTER the prompt; the encdec engine
+        overrides (decode starts at BOS/position 0, and the admission
+        program samples no token)."""
+        return _Slot(handle=handle, tokens=[], max_new=max_new,
+                     pos=len(prompt), temperature=temp, eos_id=eos_id,
+                     top_k=tk, top_p=tp, base_len=len(prompt))
+
+    def _finish_admission_only(self, slot: int, st: _Slot, toks,
+                               r: int) -> None:
+        """max_new == 1 on a prefill-sampling family: the admission
+        already produced the only token — resolve now (the one
+        admission path that syncs). Families whose admission samples
+        nothing (encdec) override to a no-op and take a decode chunk."""
+        st.emit(int(toks[r]))
+        st.fresh = False
+        self._finish_if_done(slot, st)
 
     def _dispatch_segments(self) -> bool:
         """ONE prefill segment per engine step, round-robin across
@@ -1089,6 +1104,13 @@ class SlotEngine:
             return True
         return False
 
+    def _decode_call_args(self) -> tuple:
+        """Operands of one decode-chunk dispatch, in program order —
+        the seam the encdec engine widens (its chunk also consumes the
+        per-slot source lengths and the static cross-K/V pools)."""
+        return (self.params, self._next_seed(), self._dtok, self._dpos,
+                self._dtemp, self._dtopk, self._dtopp, self._k, self._v)
+
     def _dispatch_chunk(self) -> None:
         # prefilling slots are excluded: their decode lanes compute
         # garbage (writes drop at the parked position) and their tokens
@@ -1098,9 +1120,7 @@ class SlotEngine:
         limit = self._kv_limit_for_chunk(snap)
         filtered = any(s.top_k > 0 or s.top_p < 1.0 for s in snap.values())
         out, self._dtok, self._dpos, self._k, self._v = self._decode(
-            limit, filtered)(
-            self.params, self._next_seed(), self._dtok, self._dpos,
-            self._dtemp, self._dtopk, self._dtopp, self._k, self._v)
+            limit, filtered)(*self._decode_call_args())
         for st in snap.values():
             st.dispatched += 1
         # start the device→host copy now: by the time this chunk is

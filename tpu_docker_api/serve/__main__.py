@@ -121,7 +121,14 @@ def main(argv: list[str] | None = None) -> None:
                    help="> 0: prompts longer than this prefill in "
                         "segments interleaved with decode (bounds the "
                         "stall a long admission inflicts on active "
-                        "streams); 0 = whole-prompt admission")
+                        "streams); 0 = whole-prompt admission. "
+                        "Measured trade (perf-notes): a clear win on "
+                        "8B/960-token prompts (1.37x stall reduction) "
+                        "but phase-dependent on small models — "
+                        "llama3-1b spanned 0.83-1.73x across captures "
+                        "(sometimes a REGRESSION) and the segmented "
+                        "long request itself slows ~2.7x; enable for "
+                        "big-model long-prompt traffic only")
     p.add_argument("--page-size", type=int, default=0,
                    help="> 0: paged KV cache (infer/paged.py) — the "
                         "slot cache becomes a page pool and HBM scales "
