@@ -369,13 +369,29 @@ def measure_8b_inference() -> dict:
     from tpu_docker_api.infer.quantize import bench_int8_serving
     from tpu_docker_api.infer.servebench import bench_decode_roofline
 
-    res = bench_int8_serving(batch=64, reps=2)
+    res = bench_int8_serving(batch=64, reps=2, fuse=True)
     res.pop("ok")
     try:
+        # round 4: FUSED projections are the headline (bit-identical
+        # math, fewer dispatches — measured 20.9 → 15.1 ms/tok, 50 →
+        # 69% of roof on 2026-07 v5e); the unfused number rides along
+        # for the cross-round comparison
+        import gc as _gc
+
+        import jax as _jax
+
         roof = bench_decode_roofline(batch=64, prompt_len=128, new_tok=64,
-                                     max_seq=512, reps=2)
+                                     max_seq=512, reps=2, fuse=True)
         for k in ("decode_only_ms_per_tok", "decode_tok_s", "pct_hbm_roof"):
             res[k] = roof[k]
+        res["fused_projections"] = True
+        _jax.clear_caches()
+        _gc.collect()
+        unf = bench_decode_roofline(batch=64, prompt_len=128, new_tok=64,
+                                    max_seq=512, reps=2)
+        res["unfused"] = {
+            k: unf[k] for k in ("decode_only_ms_per_tok", "decode_tok_s",
+                                "pct_hbm_roof")}
     except Exception as e:
         res["roofline_error"] = str(e)[:160]
     return res

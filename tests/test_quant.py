@@ -141,3 +141,59 @@ class TestQuantizedLlama:
         a = np.asarray(fn(params, prompt, jax.random.PRNGKey(0))["tokens"])
         b = np.asarray(fn(qparams, prompt, jax.random.PRNGKey(0))["tokens"])
         assert (a == b).mean() > 0.5
+
+
+class TestFusedProjections:
+    """fuse_llama_projections: one w_qkv / w_gu dispatch must reproduce
+    the unfused tree — bit-exact on the int8 path (same activation
+    quantization, concatenated out-channels)."""
+
+    def test_int8_fused_generate_bit_exact(self):
+        from tpu_docker_api.infer.engine import (
+            GenerateConfig, make_generate_fn)
+        from tpu_docker_api.infer.quantize import (
+            fuse_llama_projections, quantize_llama_params)
+        from tpu_docker_api.models.llama import llama_init, llama_presets
+
+        cfg = llama_presets()["tiny"]
+        qparams = quantize_llama_params(
+            llama_init(cfg, jax.random.PRNGKey(0)))
+        fused = fuse_llama_projections(qparams)
+        fn = make_generate_fn(cfg, GenerateConfig(
+            max_new_tokens=8, temperature=0.0, max_seq=64))
+        prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+        a = fn(qparams, prompt, jax.random.PRNGKey(1))
+        b = fn(fused, prompt, jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(np.asarray(a["tokens"]),
+                                      np.asarray(b["tokens"]))
+
+    def test_bf16_fused_forward_exact(self):
+        from tpu_docker_api.infer.quantize import fuse_llama_projections
+        from tpu_docker_api.models.llama import (
+            llama_forward, llama_init, llama_presets)
+
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(0))
+        fused = fuse_llama_projections(params)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        np.testing.assert_allclose(
+            np.asarray(llama_forward(fused, toks, cfg)),
+            np.asarray(llama_forward(params, toks, cfg)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_fused_through_slot_engine(self):
+        from tpu_docker_api.infer.quantize import fuse_llama_projections
+        from tpu_docker_api.infer.slots import SlotEngine
+        from tpu_docker_api.models.llama import llama_init, llama_presets
+
+        cfg = llama_presets()["tiny"]
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        engines = [SlotEngine(cfg, p, slots=2, max_seq=96, chunk=4)
+                   for p in (params, fuse_llama_projections(params))]
+        handles = [e.submit([2, 7, 1], 8) for e in engines]
+        for e, h in zip(engines, handles):
+            while not h.done():
+                e.step()
+        assert (handles[0].result(0)["tokens"]
+                == handles[1].result(0)["tokens"])

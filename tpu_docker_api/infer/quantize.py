@@ -41,6 +41,60 @@ def quantize_llama_params(params: dict) -> dict:
     }
 
 
+def fuse_llama_projections(params: dict) -> dict:
+    """Serving-time projection fusion: concat wq|wk|wv into one
+    ``w_qkv`` and w_gate|w_up into one ``w_gu`` along their OUT axis
+    (models/llama.py dispatches on the fused leaf names).
+
+    Why: the 8B decode step's gap to the HBM roof is per-op dispatch
+    overhead — ~25 µs × 32 layers × ~10 fusions (docs/perf-notes.md
+    round-3 decomposition). The three QKV matmuls share the same input
+    row, as do gate/up; concatenating their out-channels turns 5
+    dispatches into 2 and (on the int8 path) runs the per-row
+    activation quantization once instead of per-matmul. Int8 results
+    are BIT-IDENTICAL to the unfused tree: per-out-channel scales
+    concatenate, the shared input quantizes to the same x_scale, and
+    each output column's int32 accumulation is unchanged (asserted
+    down to tokens in tests/test_quant.py TestFusedProjections). Works
+    on bf16 and QuantizedLinear trees.
+
+    Single-device serving only: on a tp mesh the concat axis would mix
+    q-head and kv-head shards (different per-shard widths), so the
+    engine keeps unfused weights there. LoRA: merge adapters BEFORE
+    fusing (attach_lora matches on the unfused leaf names)."""
+
+    def cat(leaves):
+        if isinstance(leaves[0], QuantizedLinear):
+            import jax.numpy as jnp
+
+            return QuantizedLinear(
+                jnp.concatenate([l.w_int8 for l in leaves], axis=-1),
+                jnp.concatenate([l.scale for l in leaves], axis=-1))
+        import jax.numpy as jnp
+
+        return jnp.concatenate(leaves, axis=-1)
+
+    layers = params["layers"]
+    attn, mlp = layers["attn"], layers["mlp"]
+    return {
+        "embed": params["embed"],
+        "layers": {
+            "attn_norm": layers["attn_norm"],
+            "mlp_norm": layers["mlp_norm"],
+            "attn": {
+                "w_qkv": cat([attn["wq"], attn["wk"], attn["wv"]]),
+                "wo": attn["wo"],
+            },
+            "mlp": {
+                "w_gu": cat([mlp["w_gate"], mlp["w_up"]]),
+                "w_down": mlp["w_down"],
+            },
+        },
+        "final_norm": params["final_norm"],
+        "lm_head": params["lm_head"],
+    }
+
+
 def quantized_bytes(params: dict) -> int:
     """Serving-weight footprint in bytes (int8 + f32 scales + float rest)."""
     import jax
@@ -120,7 +174,8 @@ def synth_quantized_params(cfg, seed: int = 0) -> dict:
 
 def bench_int8_serving(preset: str = "llama3-8b", batch: int = 64,
                        new_tok: int = 64, prompt_len: int = 128,
-                       reps: int = 2, max_seq: int = 512) -> dict:
+                       reps: int = 2, max_seq: int = 512,
+                       fuse: bool = False) -> dict:
     """Shared int8-serving throughput harness (bench.py rider and
     validate_tpu.py check both call this — one place for the metric
     definitions). Synthesizes the preset's weights on device, runs one
@@ -142,6 +197,8 @@ def bench_int8_serving(preset: str = "llama3-8b", batch: int = 64,
 
     cfg = llama_presets()[preset]
     params = synth_quantized_params(cfg)
+    if fuse:
+        params = fuse_llama_projections(params)
     fn = make_generate_fn(cfg, GenerateConfig(
         max_new_tokens=new_tok, temperature=0.0, max_seq=max_seq))
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
@@ -164,4 +221,5 @@ def bench_int8_serving(preset: str = "llama3-8b", batch: int = 64,
         "new_tokens": new_tok,
         "new_tok_s_incl_prefill": round(batch * new_tok / dt, 1),
         "ms_per_new_tok_incl_prefill": round(dt / new_tok * 1e3, 2),
+        "fused_projections": fuse,
     }

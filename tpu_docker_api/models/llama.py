@@ -151,9 +151,20 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
     (out, (k_all, v_all)) instead of out."""
     b, s, d = x.shape
     hd = cfg.head_dim
-    q = linear(x, layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
-    k = linear(x, layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
-    v = linear(x, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
+    if "w_qkv" in layer["attn"]:
+        # serving-fused projections (infer/quantize.py
+        # fuse_llama_projections): one dispatch + one activation
+        # quantization for q|k|v — per-column math identical to the
+        # three separate matmuls
+        nq, nkv = cfg.n_heads * hd, cfg.n_kv_heads * hd
+        qkv = linear(x, layer["attn"]["w_qkv"])
+        q = qkv[..., :nq].reshape(b, s, cfg.n_heads, hd)
+        k = qkv[..., nq:nq + nkv].reshape(b, s, cfg.n_kv_heads, hd)
+        v = qkv[..., nq + nkv:].reshape(b, s, cfg.n_kv_heads, hd)
+    else:
+        q = linear(x, layer["attn"]["wq"]).reshape(b, s, cfg.n_heads, hd)
+        k = linear(x, layer["attn"]["wk"]).reshape(b, s, cfg.n_kv_heads, hd)
+        v = linear(x, layer["attn"]["wv"]).reshape(b, s, cfg.n_kv_heads, hd)
     if isinstance(cache, PagedRef):
         # paged decode (ops/paged.py; infer/paged.py drives it): s == 1,
         # per-row positions; the write scatters into the slot's current
@@ -248,6 +259,10 @@ def _attention(x, layer, cfg: LlamaConfig, rope_cos, rope_sin, mesh,
 
 
 def _mlp(x, layer):
+    if "w_gu" in layer["mlp"]:
+        gu = linear(x, layer["mlp"]["w_gu"])  # serving-fused gate|up
+        gate, up = jnp.split(gu, 2, axis=-1)
+        return linear(jax.nn.silu(gate) * up, layer["mlp"]["w_down"])
     gate = jax.nn.silu(linear(x, layer["mlp"]["w_gate"]))
     up = linear(x, layer["mlp"]["w_up"])
     return linear(gate * up, layer["mlp"]["w_down"])

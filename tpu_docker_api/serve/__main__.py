@@ -101,6 +101,13 @@ def main(argv: list[str] | None = None) -> None:
                         "present")
     p.add_argument("--quantize", action="store_true",
                    help="int8 weight quantization at load")
+    p.add_argument("--no-fuse-proj", action="store_true",
+                   help="disable the default q|k|v and gate|up "
+                        "projection fusion (llama, single device, "
+                        "merged weights). Fusion is bit-identical and "
+                        "measured 20.9 → 15.1 ms/tok on 8B-int8 decode "
+                        "(50 → 69%% of the HBM roof) — this flag exists "
+                        "for A/B measurement, not production use")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--max-seq", type=int, default=0, help="0 = model default")
@@ -297,6 +304,19 @@ def main(argv: list[str] | None = None) -> None:
         from tpu_docker_api.infer.quantize import quantize_llama_params
 
         params = quantize_llama_params(params)
+
+    # projection fusion is DEFAULT-ON wherever legal: bit-identical
+    # math, measured 20.9 → 15.1 ms/tok on 8B-int8 decode (the round-4
+    # dispatch-overhead recovery). Skipped silently where the transform
+    # doesn't apply: non-llama families, meshes (the concat axis would
+    # mix q/kv-head shards under tp), attached-LoRA trees (adapters
+    # hang off the unfused leaf names).
+    if (family == "llama" and mesh.devices.size == 1
+            and not (args.lora_ckpt and args.lora_forward == "attached")
+            and not args.no_fuse_proj):
+        from tpu_docker_api.infer.quantize import fuse_llama_projections
+
+        params = fuse_llama_projections(params)
 
     tokenizer = None
     tok_path = args.tokenizer
