@@ -384,7 +384,6 @@ def measure_8b_inference() -> dict:
                                      max_seq=512, reps=2, fuse=True)
         for k in ("decode_only_ms_per_tok", "decode_tok_s", "pct_hbm_roof"):
             res[k] = roof[k]
-        res["fused_projections"] = True
         _jax.clear_caches()
         _gc.collect()
         unf = bench_decode_roofline(batch=64, prompt_len=128, new_tok=64,
@@ -469,7 +468,7 @@ def measure_serving() -> dict:
         from tpu_docker_api.infer.servebench import bench_paged_capacity
 
         r = bench_paged_capacity(preset="llama3-8b", streams=32,
-                                 max_seq=2048, page_size=64,
+                                 max_seq=3072, page_size=64,
                                  prompt_len=128, new_tok=64)
         r.pop("ok")
         out["llama3_8b_paged_capacity"] = r
@@ -496,7 +495,8 @@ def measure_serving() -> dict:
             bench_encdec_slot_serving)
 
         r = bench_encdec_slot_serving(preset="encdec-base", streams=8,
-                                      src_len=128, new_tok=64, chunk=8)
+                                      requests=16, src_len=128,
+                                      new_tok=96, chunk=24)
         r.pop("ok")
         out["encdec_slot_serving"] = r
     except Exception as e:

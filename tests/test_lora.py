@@ -338,3 +338,27 @@ class TestQLoRA:
         finally:
             p.terminate()
             p.wait(timeout=30)
+
+    def test_qlora_with_chunked_ce(self, mesh):
+        """The 8B-on-one-chip shape: int8 base + attached forward +
+        chunked cross-entropy (the int8 lm_head dequantizes once per
+        step for the chunk scan — hardware-found r4 bug)."""
+        import dataclasses
+
+        from tpu_docker_api.infer.quantize import synth_quantized_params
+        from tpu_docker_api.train.lora import make_lora_train_step
+
+        cfg = dataclasses.replace(TINY, loss_chunk_rows=32)
+        base = synth_quantized_params(cfg)
+        state, opt = create_lora_state(cfg, mesh, jax.random.PRNGKey(1),
+                                       rank=4)
+        step = make_lora_train_step(cfg, mesh, opt, base,
+                                    forward="attached")
+        batch = synthetic_batch(jax.random.PRNGKey(2), 8, 32,
+                                TINY.vocab_size)
+        losses = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]

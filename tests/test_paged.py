@@ -5,7 +5,8 @@ per-stream outputs token-exact vs an isolated greedy decode for any
 admission order, slot reuse, pool exhaustion, deferred admissions, and
 page recycling — plus the capacity claims: a pool smaller than
 slots × max_seq serves traffic the dense allocation could not fit, and
-quarantined frees keep stale lanes from corrupting reissued pages.
+immediate page reuse stays safe under stale pipeline-lag lanes (the
+donated pool buffers serialize device execution).
 """
 
 import jax
@@ -74,8 +75,8 @@ class TestTokenExact:
                 cfg, params, p, m)
 
     def test_slot_reuse_recycles_pages_exactly(self, setup):
-        """More requests than slots: completions recycle pages through
-        quarantine into later admissions — late requests stay exact."""
+        """More requests than slots: completions recycle pages into
+        later admissions — late requests stay exact."""
         cfg, params = setup
         eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
                               max_seq=MAX_SEQ, chunk=3)
@@ -91,11 +92,9 @@ class TestTokenExact:
         for p, h in zip(prompts, handles):
             assert h.result(0)["tokens"] == isolated_greedy(
                 cfg, params, p, 8)
-        # every page returned (possibly via quarantine still pending)
-        eng.step()
-        assert (eng.stats["pages_free"]
-                + sum(len(p) for _, p in eng._quarantine)
-                == eng.stats["pages_total"])
+        # every page returned immediately on completion (frees don't
+        # wait on the pipeline lag — device ordering makes reuse safe)
+        assert eng.stats["pages_free"] == eng.stats["pages_total"]
 
     def test_sampling_paths_run(self, setup):
         cfg, params = setup
@@ -168,10 +167,12 @@ class TestCapacity:
             eng.submit([1] * 40, 30)
 
     def test_stale_lanes_cannot_corrupt_reissued_pages(self, setup):
-        """The quarantine property under maximal pressure: a tiny pool
-        with immediate resubmission after every completion — stale
-        lanes still decoding at the pipeline lag must never write into
-        pages already handed to a new request."""
+        """Immediate page reuse under maximal pressure: a tiny pool
+        with deep pipeline lag and constant resubmission — stale lanes
+        still decoding must never corrupt pages already handed to a
+        new request (safe because donated pool buffers serialize
+        device execution; this test is the regression net for that
+        argument)."""
         cfg, params = setup
         eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=3,
                               max_seq=MAX_SEQ, chunk=3, total_pages=6,
@@ -211,9 +212,9 @@ class TestEdges:
     def test_deferred_handles_fail_on_close_and_die(self, setup):
         cfg, params = setup
         eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
-                              max_seq=MAX_SEQ, chunk=4, total_pages=4)
-        h1 = eng.submit([9] * 30, 16)   # takes the whole pool
-        h2 = eng.submit([1] * 30, 16)   # deferred
+                              max_seq=MAX_SEQ, chunk=4, total_pages=5)
+        h1 = eng.submit([9] * 30, 40)   # takes the whole pool, long-run
+        h2 = eng.submit([1] * 30, 40)   # deferred
         for _ in range(6):
             eng.step()
         assert eng._deferred and not h2.done()
@@ -222,9 +223,9 @@ class TestEdges:
             h2.result(0)
         # _die path: park a deferred handle, then kill the engine
         eng2 = PagedSlotEngine(cfg, params, page_size=PAGE, slots=4,
-                               max_seq=MAX_SEQ, chunk=4, total_pages=4)
-        d1 = eng2.submit([9] * 30, 16)
-        d2 = eng2.submit([1] * 30, 16)
+                               max_seq=MAX_SEQ, chunk=4, total_pages=5)
+        d1 = eng2.submit([9] * 30, 40)
+        d2 = eng2.submit([1] * 30, 40)
         for _ in range(6):
             eng2.step()
         assert eng2._deferred

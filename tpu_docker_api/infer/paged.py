@@ -25,12 +25,21 @@ batching, sampling, drain — is inherited):
   zero-eager-ops rule (slots.py module docstring). Tables are (S, mp)
   with mp a geometric page-count bucket — decode reads scale with live
   pages, like the dense engine's kv_limit buckets.
-- **Quarantined frees**: a completed slot's lanes keep decoding garbage
-  until the host processes that chunk (pipeline lag), and chunks
-  already dispatched carry the OLD table — so freed pages are
-  quarantined until every chunk dispatched before the free is
-  processed, and the freed slot's table rows point at the trash page
-  from the next dispatch on. Only then can pages be reissued.
+- **Frees are immediate — device ordering makes them safe.** A
+  completed slot's lanes keep decoding garbage until the host
+  processes that chunk (pipeline lag), and chunks already DISPATCHED
+  carry tables naming the freed pages. That is still safe to reuse
+  instantly: every dispatch consumes the DONATED pool buffers of the
+  previous one, so device execution is strictly serialized by data
+  dependency — any program that writes a reused page was enqueued
+  after the free and therefore runs after every stale chunk's garbage
+  write has landed (and been overwritten by the new admission's
+  prefill). Chunks dispatched after the free get the zeroed table row
+  (trash page) for the stale lane. Round-4 hardware lesson: the
+  earlier quarantine-until-processed design was not needed for
+  correctness and stalled back-to-back admissions behind the pipeline
+  lag (measured 11 spurious deferrals / 4x throughput loss on the
+  32-stream capacity bench).
 - **Prefill is unchanged**: the bucket forward runs on a fresh dense
   temp cache exactly as the dense engine's, and only the final
   "drop into the big cache" becomes a page scatter.
@@ -96,7 +105,6 @@ class PagedSlotEngine(SlotEngine):
         # bookkeeping (engine-thread only, like the base's _table values)
         self._slot_pages: dict[int, list[int]] = {}
         self._deferred: list = []
-        self._quarantine: list[tuple[int, list[int]]] = []
         self.stats["pages_total"] = self._usable_pages
         self.stats["pages_free"] = len(self._free)
         self.stats["deferred_admissions"] = 0
@@ -123,20 +131,6 @@ class PagedSlotEngine(SlotEngine):
         self._ptable = np.zeros(
             (self.slots, self._max_pages_per_slot), np.int32)
         return jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype)
-
-    def _release_quarantine(self) -> None:
-        """Return quarantined pages whose barrier has passed: every
-        chunk dispatched before the free (and therefore carrying a
-        table that still named these pages) has been processed."""
-        processed = self.stats["decode_chunks"] - len(self._outstanding)
-        keep = []
-        for barrier, pages in self._quarantine:
-            if barrier <= processed:
-                self._free.extend(pages)
-            else:
-                keep.append((barrier, pages))
-        self._quarantine = keep
-        self.stats["pages_free"] = len(self._free)
 
     def _pages_needed(self, prompt_len: int, max_new: int,
                       bucket: int) -> int:
@@ -295,7 +289,6 @@ class PagedSlotEngine(SlotEngine):
         deferred queue (requests the pool couldn't cover) is always
         served first, and one blocked request blocks everything behind
         it — a stream of small requests must not starve a big one."""
-        self._release_quarantine()
         free_slots = [i for i, s in self._table.items() if s is None]
         batch = self._deferred
         self._deferred = []
@@ -398,15 +391,13 @@ class PagedSlotEngine(SlotEngine):
     def _finish_if_done(self, slot: int, st) -> bool:
         done = super()._finish_if_done(slot, st)
         if done:
-            pages = self._slot_pages.pop(slot, [])
+            # immediate reuse is safe: the donated pool buffers
+            # serialize device execution, so any dispatch touching a
+            # reissued page runs after every already-dispatched stale
+            # chunk (module docstring, round-4 hardware lesson)
+            self._free.extend(self._slot_pages.pop(slot, []))
             self._ptable[slot, :] = 0
-            if pages:
-                # chunks dispatched up to now carry tables naming these
-                # pages; they may be reissued only after all of them
-                # are processed
-                self._quarantine.append(
-                    (self.stats["decode_chunks"], pages))
-            self._release_quarantine()
+            self.stats["pages_free"] = len(self._free)
         return done
 
     def step(self) -> bool:

@@ -209,6 +209,8 @@ class _Slot:
     #                            yet prefilled; the slot joins decode only
     #                            once this drains (None = fully prefilled)
     prefill_pos: int = 0       # next absolute segment write offset
+    src_len: int = 0           # encdec: true source length (drives the
+    #                            cross-K/V read bucket)
 
     def emit(self, t: int) -> None:
         self.tokens.append(t)
@@ -1111,16 +1113,24 @@ class SlotEngine:
         return (self.params, self._next_seed(), self._dtok, self._dpos,
                 self._dtemp, self._dtopk, self._dtopp, self._k, self._v)
 
+    def _select_decode(self, snap):
+        """(compiled chunk program, kv read limit) for this dispatch —
+        the seam the encdec engine widens with its cross-K/V read
+        bucket."""
+        limit = self._kv_limit_for_chunk(snap)
+        filtered = any(s.top_k > 0 or s.top_p < 1.0
+                       for s in snap.values())
+        return self._decode(limit, filtered), limit
+
     def _dispatch_chunk(self) -> None:
         # prefilling slots are excluded: their decode lanes compute
         # garbage (writes drop at the parked position) and their tokens
         # must never be processed
         snap = {i: s for i, s in self._table.items()
                 if s is not None and s.pending is None}
-        limit = self._kv_limit_for_chunk(snap)
-        filtered = any(s.top_k > 0 or s.top_p < 1.0 for s in snap.values())
-        out, self._dtok, self._dpos, self._k, self._v = self._decode(
-            limit, filtered)(*self._decode_call_args())
+        fn, limit = self._select_decode(snap)
+        out, self._dtok, self._dpos, self._k, self._v = fn(
+            *self._decode_call_args())
         for st in snap.values():
             st.dispatched += 1
         # start the device→host copy now: by the time this chunk is

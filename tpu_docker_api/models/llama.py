@@ -494,6 +494,8 @@ def llama_loss(
     ever materialized (the backward rebuilds logits per row chunk), freeing
     the HBM that otherwise caps the training batch size."""
     if cfg.loss_chunk_rows:
+        from tpu_docker_api.ops.quant import QuantizedLinear, \
+            dequantize_weight
         from tpu_docker_api.ops.xent import chunked_cross_entropy
 
         x = llama_hidden(params, tokens[:, :-1], cfg, mesh)
@@ -502,8 +504,15 @@ def llama_loss(
             # same activation sharding the dense tail's logits constraint
             # implies on its input; the chunk scan inherits it from here
             h = constrain(h, mesh, P(("dp", "fsdp"), "sp", None))
+        head = params["lm_head"]
+        if isinstance(head, QuantizedLinear):
+            # QLoRA over an int8 base (train/lora.py): the chunked-CE
+            # scan wants a plain matrix; dequantize the FROZEN head
+            # once per step (a bf16 transient — ~1 GB at 8B, freed
+            # after the scan; the base gets no gradient either way)
+            head = dequantize_weight(head, cfg.dtype)
         return chunked_cross_entropy(
-            h, params["lm_head"], tokens[:, 1:], cfg.loss_chunk_rows)
+            h, head, tokens[:, 1:], cfg.loss_chunk_rows)
     logits = llama_forward(params, tokens[:, :-1], cfg, mesh)
     return cross_entropy(logits, tokens[:, 1:])
 

@@ -36,14 +36,22 @@ _KIND_PROBE = {"v5e": ("v5 lite", "v5e"), "v5p": ("v5p",), "v4": ("v4",),
                "v6e": ("v6", "trillium"), "v3": ("v3",), "v2": ("v2",)}
 
 
-def peak_bf16_flops_for(device) -> float | None:
-    """Per-chip peak bf16 FLOP/s for a live jax device, or None if the
-    device kind matches no known TPU generation."""
+def generation_for(device) -> "Generation | None":
+    """The Generation a live jax device belongs to, or None for unknown
+    kinds — THE device-kind probe (bench riders and hardware checks
+    read per-chip HBM/peak-FLOPs off the result)."""
     kind = getattr(device, "device_kind", "").lower()
     for gen_key, gen in GENERATIONS.items():
         if any(p in kind for p in _KIND_PROBE.get(gen_key, ())):
-            return gen.peak_bf16_flops
+            return gen
     return None
+
+
+def peak_bf16_flops_for(device) -> float | None:
+    """Per-chip peak bf16 FLOP/s for a live jax device, or None if the
+    device kind matches no known TPU generation."""
+    gen = generation_for(device)
+    return gen.peak_bf16_flops if gen else None
 
 GENERATIONS: dict[str, Generation] = {
     "v2":  Generation("v2", 2, (2, 2, 1), 16 * _GB, 46e12, 2),

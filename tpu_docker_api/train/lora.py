@@ -248,8 +248,8 @@ def make_lora_train_step(cfg, mesh: Mesh, optimizer, base_params: dict,
     :func:`attach_lora` — required when the merged bf16 tree wouldn't
     fit (8B on one chip) and the only choice that is EXACT over an
     int8 base (merging onto int8 would quantize the delta away).
-    ``base_params`` ride as closed-over device constants — never
-    donated, never differentiated."""
+    ``base_params`` ride as non-donated jit operands — never
+    differentiated, never copied into the program (const_args)."""
     from tpu_docker_api.train.trainer import make_train_step
 
     if forward not in ("merged", "attached"):
@@ -257,11 +257,15 @@ def make_lora_train_step(cfg, mesh: Mesh, optimizer, base_params: dict,
     _, model_loss, _ = model_fns(cfg)
     combine = merge_lora if forward == "merged" else attach_lora
 
-    def loss_fn(adapters, batch):
-        return model_loss(combine(base_params, adapters, alpha), batch,
+    def loss_fn(adapters, batch, base):
+        # base rides as a jit OPERAND via const_args — closing over an
+        # 8B int8 tree captured 8.56 GB of constants into the lowering
+        # and stalled compilation (r4 hardware lesson)
+        return model_loss(combine(base, adapters, alpha), batch,
                           cfg, mesh)
 
-    return make_train_step(cfg, mesh, optimizer, loss_fn=loss_fn)
+    return make_train_step(cfg, mesh, optimizer, loss_fn=loss_fn,
+                           const_args=(base_params,))
 
 
 def lora_abstract_state(cfg, rank: int, targets, mesh: Mesh,

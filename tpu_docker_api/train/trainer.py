@@ -115,12 +115,18 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     loss_fn: Callable | None = None,
     grad_fn: Callable | None = None,
+    const_args: tuple = (),
 ) -> Callable:
     """jitted (state, tokens) → (state, metrics); state buffers donated.
     ``cfg`` may be any registered model config (Llama, MoE, ...).
     ``grad_fn(params, tokens) -> (loss, grads)`` bypasses autodiff for
     schedules that hand-compute their backward (parallel.pipeline's 1F1B);
-    mutually exclusive with ``loss_fn``."""
+    mutually exclusive with ``loss_fn``. ``const_args``: extra pytrees
+    appended to every loss_fn/grad_fn call AS JIT OPERANDS — large
+    frozen trees (a QLoRA base) must ride here, not as closure
+    captures, or jax lowers them as embedded constants (measured: the
+    8B int8 base captured 8.56 GB into the lowering and stalled the
+    compile; as operands the program is weight-free)."""
     if grad_fn is not None and loss_fn is not None:
         raise ValueError("pass loss_fn or grad_fn, not both")
     if grad_fn is None and loss_fn is None:
@@ -134,11 +140,12 @@ def make_train_step(
             mesh, P(("dp", "fsdp"), *([None] * (jnp.ndim(x) - 1))))
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, tokens: jnp.ndarray):
+    def train_step(state: TrainState, tokens: jnp.ndarray, *consts):
         if grad_fn is not None:
-            loss, grads = grad_fn(state.params, tokens)
+            loss, grads = grad_fn(state.params, tokens, *consts)
         else:
-            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens,
+                                                      *consts)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -161,7 +168,7 @@ def make_train_step(
             tokens = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, _batch_sharding(x)), tokens)
         with mesh:
-            return train_step(state, tokens)
+            return train_step(state, tokens, *const_args)
 
     return step
 
