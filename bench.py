@@ -421,7 +421,7 @@ def measure_serving() -> dict:
         try:
             r = bench_concurrent_serving(
                 prompt_len=128, new_tok=64, max_seq=512,
-                chunk=8, **kwargs)
+                chunk=8, fuse=True, **kwargs)
             r.pop("ok")
             out[name] = r
         except Exception as e:

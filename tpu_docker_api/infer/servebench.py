@@ -57,6 +57,7 @@ def bench_concurrent_serving(
     reps: int = 2,
     cfg=None,
     params=None,
+    fuse: bool = False,
 ) -> dict:
     """N concurrent streams through the slot engine vs the same N
     serialized through the legacy engine at batch 1 (the round-2 serving
@@ -80,6 +81,12 @@ def bench_concurrent_serving(
             params = synth_quantized_params(cfg)
         else:
             params = llama_init(cfg, jax.random.PRNGKey(0))
+    if fuse:
+        # measure what serve actually runs — projection fusion is its
+        # default (round 4); BOTH paths get the fused tree (fair ratio)
+        from tpu_docker_api.infer.quantize import fuse_llama_projections
+
+        params = fuse_llama_projections(params)
     prompts = [
         jax.random.randint(jax.random.PRNGKey(10 + i), (prompt_len,), 0,
                            cfg.vocab_size, dtype=jnp.int32).tolist()
@@ -137,6 +144,7 @@ def bench_concurrent_serving(
         "slot_tok_s": round(total / slot_dt, 1),
         "speedup": round(ser_dt / slot_dt, 2),
         "wasted_steps": eng.stats["wasted_steps"],
+        "fused_projections": fuse,
     }
 
 
