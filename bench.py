@@ -136,9 +136,10 @@ def main() -> None:
                         choices=["fake", "docker"])
     parser.add_argument("--cp-iters", type=int, default=100)
     parser.add_argument("--full", action="store_true",
-                        help="also run the long-tail riders (16-stream "
-                             "serving points, unfused roofline, prefix, "
-                             "chunked prefill, encdec, family trains)")
+                        help="also run the long-tail riders (the second "
+                             "stream-count per serving point, unfused "
+                             "roofline, prefix, chunked prefill, encdec, "
+                             "family trains)")
     parser.add_argument("--budget", type=float, default=0.0,
                         help="total seconds budget; 0 = env BENCH_BUDGET_S "
                              "or 1500")
@@ -382,8 +383,11 @@ def rider_8b_decode_fused():
     for k in ("decode_only_ms_per_tok", "decode_tok_s", "pct_hbm_roof"):
         res[k] = roof[k]
     # vs_baseline: measured % of the weight-streaming HBM roof over the
-    # 60% bar set in round 3 (fused projections cleared it in round 4)
-    vs = round((roof["pct_hbm_roof"] or 0) / 60.0, 3)
+    # 60% bar set in round 3 (fused projections cleared it in round 4);
+    # null — not 0, which would read as a total regression — when the
+    # roof is unknown for this chip generation
+    vs = (round(roof["pct_hbm_roof"] / 60.0, 3)
+          if roof["pct_hbm_roof"] is not None else None)
     return roof["decode_tok_s"], "decode tok/s", vs, res
 
 
@@ -393,7 +397,8 @@ def rider_8b_decode_unfused():
     roof = bench_decode_roofline(batch=64, prompt_len=128, new_tok=64,
                                  max_seq=512, reps=2)
     roof.pop("ok")
-    vs = round((roof["pct_hbm_roof"] or 0) / 60.0, 3)
+    vs = (round(roof["pct_hbm_roof"] / 60.0, 3)
+          if roof["pct_hbm_roof"] is not None else None)
     return roof["decode_tok_s"], "decode tok/s", vs, roof
 
 

@@ -94,6 +94,143 @@ class TestRoundTrip:
             hf_llama_config(str(tmp_path))
 
 
+_LLAMA31_SCALING = {
+    # verbatim block from a real Llama-3.1 config.json — the artifact
+    # --hf-ckpt exists for (VERDICT r4 missing #2)
+    "rope_type": "llama3",
+    "factor": 8.0,
+    "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0,
+    "original_max_position_embeddings": 8192,
+}
+
+
+class TestRopeScalingBridge:
+    """A llama-3.1-style rope_scaling block must flow config.json →
+    LlamaConfig → the rope tables the forward actually builds — or be
+    rejected, never silently ignored."""
+
+    def _config_with(self, exported, tmp_path, block):
+        _, _, out = exported
+        hf = json.load(open(os.path.join(out, "config.json")))
+        if block is None:
+            hf.pop("rope_scaling", None)
+        else:
+            hf["rope_scaling"] = block
+        (tmp_path / "config.json").write_text(json.dumps(hf))
+        st = tmp_path / "model.safetensors"
+        if not st.exists():  # weights unchanged — only the config varies
+            os.symlink(os.path.join(out, "model.safetensors"), st)
+        return str(tmp_path)
+
+    def test_llama3_block_parses(self, exported, tmp_path):
+        cfg = hf_llama_config(
+            self._config_with(exported, tmp_path, _LLAMA31_SCALING))
+        rs = cfg.rope_scaling
+        assert rs is not None and rs.factor == 8.0
+        assert rs.low_freq_factor == 1.0 and rs.high_freq_factor == 4.0
+        assert rs.original_max_position_embeddings == 8192
+
+    def test_old_style_type_key_parses(self, exported, tmp_path):
+        block = dict(_LLAMA31_SCALING)
+        block["type"] = block.pop("rope_type")
+        cfg = hf_llama_config(
+            self._config_with(exported, tmp_path, block))
+        assert cfg.rope_scaling is not None
+
+    def test_default_type_is_noop(self, exported, tmp_path):
+        cfg = hf_llama_config(self._config_with(
+            exported, tmp_path, {"rope_type": "default"}))
+        assert cfg.rope_scaling is None
+
+    def test_unknown_type_hard_rejected(self, exported, tmp_path):
+        for rtype in ("yarn", "linear", "dynamic", "longrope"):
+            with pytest.raises(ValueError, match="not.*supported"):
+                hf_llama_config(self._config_with(
+                    exported, tmp_path,
+                    {"rope_type": rtype, "factor": 2.0}))
+
+    def test_scaling_reaches_forward_tables(self, exported, tmp_path,
+                                            monkeypatch):
+        """The config's scaling object must be the one the forward's
+        table builder receives — parse-but-drop would pass every other
+        test here while still computing wrong frequencies."""
+        import tpu_docker_api.models.llama as llama_mod
+        from tpu_docker_api.ops.rope import rope_frequencies as real_rf
+
+        cfg_dir = self._config_with(exported, tmp_path, _LLAMA31_SCALING)
+        cfg, params = import_hf_llama(cfg_dir)
+        seen = []
+
+        def spy(head_dim, seq, theta=10000.0, scaling=None):
+            seen.append(scaling)
+            return real_rf(head_dim, seq, theta, scaling)
+
+        monkeypatch.setattr(llama_mod, "rope_frequencies", spy)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        llama_forward(params, toks, cfg)
+        assert seen == [cfg.rope_scaling]
+        assert seen[0].factor == 8.0
+
+    def test_forward_matches_reference_scaled_tables(self, exported,
+                                                     tmp_path):
+        """Golden: logits under the imported scaling equal logits where
+        the ONLY change is rope tables built from an independent
+        reference implementation of the llama3 formula (and differ from
+        the unscaled forward at positions where scaling bites)."""
+        import tpu_docker_api.models.llama as llama_mod
+        from unittest import mock
+
+        from tests.test_ops import _ref_llama3_inv_freq
+
+        cfg_dir = self._config_with(exported, tmp_path, _LLAMA31_SCALING)
+        cfg, params = import_hf_llama(cfg_dir)
+        # f32 end-to-end and positions deep enough (96) that the scaled
+        # low-frequency phases measurably diverge from unscaled — in
+        # bf16 at short positions the difference drowns in rounding
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32), params)
+        toks = jax.random.randint(jax.random.PRNGKey(3), (1, 96), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        got = np.asarray(llama_forward(params, toks, cfg))
+
+        def ref_tables(head_dim, seq, theta=10000.0, scaling=None):
+            if scaling is None:
+                inv = (1.0 / (theta ** (np.arange(0, head_dim, 2)
+                                        / head_dim))).astype(np.float32)
+            else:
+                inv = _ref_llama3_inv_freq(
+                    head_dim, theta, scaling.factor,
+                    scaling.low_freq_factor, scaling.high_freq_factor,
+                    scaling.original_max_position_embeddings)
+            freqs = np.outer(np.arange(seq, dtype=np.float32), inv)
+            return jnp.cos(freqs), jnp.sin(freqs)
+
+        with mock.patch.object(llama_mod, "rope_frequencies",
+                               ref_tables):
+            want = np.asarray(llama_forward(params, toks, cfg))
+            unscaled = np.asarray(llama_forward(
+                params, toks, dataclasses.replace(cfg,
+                                                  rope_scaling=None)))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        assert not np.allclose(got, unscaled, rtol=1e-4, atol=1e-4)
+
+    def test_export_round_trips_scaling_block(self, tiny, tmp_path):
+        from tpu_docker_api.ops.rope import RopeScaling
+
+        cfg, params = tiny
+        scfg = dataclasses.replace(cfg, rope_scaling=RopeScaling(
+            factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
+            original_max_position_embeddings=8192))
+        out = tmp_path / "ck"
+        export_hf_llama(params, scfg, str(out))
+        written = json.load(open(out / "config.json"))["rope_scaling"]
+        assert written == _LLAMA31_SCALING
+        cfg2, _ = import_hf_llama(str(out))
+        assert cfg2.rope_scaling == scfg.rope_scaling
+
+
 class TestLayouts:
     def test_tied_embeddings(self, tiny, tmp_path):
         """No lm_head.weight in the checkpoint ⇒ the head is the

@@ -49,6 +49,10 @@ class LlamaConfig:
     ffn_dim: int = 14336
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # llama-3.x band scaling (ops.rope.RopeScaling) or None; carried on
+    # the config so every rope table — train, serve, pipeline — builds
+    # from the same scaled frequencies the checkpoint was trained with
+    rope_scaling: Any = None
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -322,7 +326,8 @@ def llama_hidden(
     x = embed_lookup(params["embed"]["tokens"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), "sp"))
-    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta,
+                                          getattr(cfg, "rope_scaling", None))
 
     block = functools.partial(
         _block, cfg=cfg, rope_cos=rope_cos, rope_sin=rope_sin, mesh=mesh
@@ -431,7 +436,8 @@ def decoder_forward_cached(params, tokens, cfg, k_cache, v_cache, mesh,
     x = embed_lookup(params["embed"]["tokens"], tokens, mesh)
     if mesh is not None:
         x = constrain(x, mesh, P(("dp", "fsdp"), None))
-    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, max_seq, cfg.rope_theta)
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, max_seq, cfg.rope_theta,
+                                          getattr(cfg, "rope_scaling", None))
 
     def scan_body(carry, layer_and_idx):
         x, kc, vc = carry

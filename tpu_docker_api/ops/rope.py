@@ -7,16 +7,56 @@ QK projections — no gather, no dynamic shapes, MXU-friendly.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax.numpy as jnp
 
 
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.x ``"rope_type": "llama3"`` frequency band scaling — the
+    ``rope_scaling`` block every real Llama-3.1/3.2 ``config.json``
+    carries. Frozen (hashable) so it can live on the frozen LlamaConfig
+    that keys jit caches.
+
+    The scheme stretches LOW-frequency (long-wavelength) bands by
+    ``factor`` to reach the extended context, keeps HIGH-frequency
+    (short-wavelength, local-order) bands untouched, and linearly
+    interpolates between the two cutoffs. Wavelengths are measured
+    against ``original_max_position_embeddings`` (the pre-extension
+    training context)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+    def apply(self, inv_freq: jnp.ndarray) -> jnp.ndarray:
+        """Scale per-band inverse frequencies (the HF llama3 formula)."""
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wl = self.original_max_position_embeddings / self.low_freq_factor
+        high_wl = (self.original_max_position_embeddings
+                   / self.high_freq_factor)
+        smooth = ((self.original_max_position_embeddings / wavelen
+                   - self.low_freq_factor)
+                  / (self.high_freq_factor - self.low_freq_factor))
+        smoothed = ((1.0 - smooth) * inv_freq / self.factor
+                    + smooth * inv_freq)
+        out = jnp.where(wavelen > low_wl, inv_freq / self.factor, inv_freq)
+        return jnp.where((wavelen >= high_wl) & (wavelen <= low_wl),
+                         smoothed, out)
+
+
 def rope_frequencies(
-    head_dim: int, max_seq_len: int, theta: float = 10000.0
+    head_dim: int, max_seq_len: int, theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(cos, sin) tables of shape (max_seq_len, head_dim // 2), float32."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None:
+        inv_freq = scaling.apply(inv_freq)
     t = jnp.arange(max_seq_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # (seq, head_dim/2)
     return jnp.cos(freqs), jnp.sin(freqs)

@@ -85,7 +85,8 @@ def pipeline_forward(
 
     stages = _stage_layers(params, n_stages)
     d = cfg.dim
-    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta,
+                                          getattr(cfg, "rope_scaling", None))
 
     x = embed_lookup(params["embed"]["tokens"], tokens, mesh)  # (batch, s, d)
     x_mb = x.reshape(n_micro, mb, seq, d)
@@ -199,7 +200,8 @@ def pipeline_1f1b_grads(
 
     stages = _stage_layers(params, S)
     d = cfg.dim
-    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+    rope_cos, rope_sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta,
+                                          getattr(cfg, "rope_scaling", None))
     targets_mb = targets.reshape(M, mb, seq)
 
     # embed once (gather); its vjp closes over the token ids only and is
