@@ -354,6 +354,8 @@ def riders(full: bool = False):
             ("slot_serving_1b_16s", 200, rider_slot_serving_1b_16),
             ("slot_serving_8b_int8_8s", 340, rider_slot_serving_8b_8),
             ("prefix_cache_1b", 240, rider_prefix_cache),
+            ("paged_prefix_8b", 340, rider_paged_prefix),
+            ("paged_admission_8b", 340, rider_paged_admission),
             ("chunked_prefill_1b", 240, rider_chunked_prefill),
             ("tail_latency_1b_16s", 200, rider_tail_latency_16),
             ("encdec_slot_serving", 240, rider_encdec_serving),
@@ -441,8 +443,6 @@ def rider_paged_capacity():
     r = bench_paged_capacity(preset="llama3-8b", streams=32, max_seq=3072,
                              page_size=64, prompt_len=128, new_tok=64)
     r.pop("ok")
-    r["capacity_note"] = (f"{r['streams']} streams x {r['capacity']} "
-                          "addressable per slot; pool sized to live tokens")
     vs = round(r["dense_cache_gb"] / max(r["paged_pool_gb"], 1e-9), 1)
     return r["aggregate_tok_s"], "aggregate tok/s", vs, r
 
@@ -473,6 +473,32 @@ def rider_prefix_cache():
                              max_seq=1024, slots=8, chunk=8, reps=2)
     r.pop("ok")
     return r["prefix_tok_s"], "tok/s", r["speedup"], r
+
+
+def rider_paged_prefix():
+    """Shared-header workload on the paged engine at the 32×3072
+    addressable point (dense cache arithmetically impossible)."""
+    from tpu_docker_api.infer.servebench import bench_paged_prefix
+
+    r = bench_paged_prefix(preset="llama3-8b", requests=16, slots=32,
+                           prefix_len=960, suffix_len=16, new_tok=8,
+                           max_seq=3072, page_size=64)
+    r.pop("ok")
+    return r["prefix_tok_s"], "tok/s", r["speedup"], r
+
+
+def rider_paged_admission():
+    """Grow-vs-full reservation A/B on 8B-int8: admission concurrency
+    when clients over-promise max_new (the production shape)."""
+    from tpu_docker_api.infer.servebench import bench_paged_admission
+
+    r = bench_paged_admission(preset="llama3-8b", streams=32,
+                              prompt_len=128, promised_new=1024,
+                              actual_new=16, max_seq=2048,
+                              page_size=64, total_pages=104)
+    r.pop("ok")
+    return (r["admission_ratio"], "x first-wave admissions",
+            r["speedup"], r)
 
 
 def rider_chunked_prefill():

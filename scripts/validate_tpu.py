@@ -663,27 +663,154 @@ def _train_induction_target():
 
 def check_slot_serving_trained() -> bool:
     """Slot-vs-serialized token match on TRAINED weights (VERDICT r3
-    weak #2): random-init logits are near-uniform, so bf16 tiling
-    differences between batch shapes flip argmax near-ties and the
-    headline serving checks report low match_rows; a trained model's
-    peaked logits have no near-ties, so matches should be ~N/N on
-    hardware. Gate: >= 7/8 rows exact. The speedup is INFORMATIONAL
-    here — at 13M params the serialized batch-1 program is already
-    host-cheap while the slot engine pays its chunked dispatch loop,
-    so this micro-model point can read < 1 (measured 0.5 on the first
-    r4 capture); the throughput gates live in the llama3-1b/8b checks
-    where the model is serving-sized."""
+    weak #2; r4 next #4a SETTLED): the reproducible r4 7/8 was neither
+    a bug nor a coin-flip — the r5 diagnostic dumped the diverging row
+    (row 4, step 8: max logit 0.22, top-2 gap 8.4 bf16 ulps, 3
+    candidates within tiling noise) and the cause is the CHECK's
+    prompts, not the engines: random full-vocab prompts are out of
+    distribution for an induction model trained on periodic
+    subvocab-4096 patterns, so some positions are near-flat and argmax
+    is legitimately tiling-dependent there. With IN-distribution
+    periodic prompts every generated position is peaked and the gate
+    is exact: 8/8, no tolerance. diagnose_mismatch stays armed — any
+    future mismatch ships the cluster evidence in the capture. The
+    speedup is INFORMATIONAL here — at 13M params the serialized
+    batch-1 program is already host-cheap while the slot engine pays
+    its chunked dispatch loop (measured 0.5 on the first r4 capture);
+    the throughput gates live in the llama3-1b/8b checks."""
+    import jax
+    import jax.numpy as jnp
+
     from tpu_docker_api.infer.servebench import bench_concurrent_serving
 
     cfg_t, params_t = _train_induction_target()
-    r = bench_concurrent_serving(streams=8, prompt_len=64, new_tok=64,
-                                 max_seq=512, chunk=8, cfg=cfg_t,
-                                 params=params_t)
-    r["preset"] = "trained-8L-512 (induction)"
+    period, subvocab, plen = 16, 4096, 64
+    prompts = []
+    for i in range(8):
+        pat = jax.random.randint(jax.random.PRNGKey(500 + i), (period,),
+                                 0, subvocab, dtype=jnp.int32).tolist()
+        prompts.append((pat * ((plen // period) + 1))[:plen])
+    r = bench_concurrent_serving(streams=8, new_tok=64, max_seq=512,
+                                 chunk=8, cfg=cfg_t, params=params_t,
+                                 diagnose_mismatch=True,
+                                 prompts=prompts)
+    r["preset"] = "trained-8L-512 (induction, in-distribution prompts)"
     r["speedup_gated"] = False
     matches = int(r["match_rows"].split("/")[0])
     return _emit("slot_serving_trained_match",
-                 r.pop("ok") and matches >= 7, **r)
+                 r.pop("ok") and matches == 8, **r)
+
+
+def _encdec_successor_table():
+    """The fixed global successor permutation over [1, 4096) that the
+    trained encdec target memorizes — one place, so training and the
+    check's expected-output computation can never drift."""
+    import numpy as np
+
+    perm = np.random.RandomState(7).permutation(np.arange(1, 4096))
+    succ = np.zeros(4096, np.int32)
+    succ[perm] = np.roll(perm, -1)
+    return perm, succ
+
+
+def _train_encdec_target(steps: int = 1200):
+    """Seq2seq GLOBAL-SUCCESSOR-TABLE target for the encdec
+    trained-weight match check (VERDICT r4 next #4b): a fixed
+    permutation chain lives in the WEIGHTS (next token = succ[prev], a
+    4095-entry table the MLPs memorize in a few hundred steps) and the
+    single-token source seeds the chain (tgt[1] = src[0] — a
+    one-position cross-attention copy with no alignment ambiguity).
+    Measured task-design history on 2026-08 v5e, kept because the
+    failures are informative: positional COPY (tgt = BOS+src) sat at
+    loss ln(4096) — cross-attention carries no rope, so content-blind
+    positional alignment is exactly what this architecture cannot
+    shortcut; in-source successor lookup learned but slowly (0.62
+    after 3000 steps at batch 128 — associative recall through
+    cross-attention is an emergent circuit); the global table hits
+    loss 0.0000 by step ~800 at batch 128 (~60 s wall) with logits
+    peaked enough for an exact match gate. Returns
+    (cfg, params, final_loss)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_docker_api.models.encdec import encdec_presets
+    from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+    from tpu_docker_api.train.trainer import create_train_state, make_train_step
+
+    base = encdec_presets()["encdec-base"]
+    cfg_t = dataclasses.replace(base, dim=512, enc_layers=4, dec_layers=4,
+                                n_heads=8, n_kv_heads=8, ffn_dim=1408)
+    mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                      devices=jax.devices()[:1])
+    tgt_len, batch = 64, 128
+    perm, succ = _encdec_successor_table()
+    succ_j = jnp.asarray(succ)
+    perm_j = jnp.asarray(perm)
+
+    @jax.jit  # eager data ops over the tunnel cost 100-200 ms EACH
+    def data_batch(key):
+        s0 = jax.random.choice(key, perm_j, (batch,))
+
+        def chain(carry, _):
+            return succ_j[carry], carry
+
+        _, rows = jax.lax.scan(chain, s0, None, length=tgt_len)
+        tgt = jnp.concatenate(
+            [jnp.zeros((batch, 1), jnp.int32), rows.T], axis=1)
+        return s0[:, None].astype(jnp.int32), tgt
+
+    sched = optax.warmup_cosine_decay_schedule(0.0, 3e-3, 100, steps,
+                                               3e-4)
+    opt = optax.chain(optax.clip_by_global_norm(1.0),
+                      optax.adamw(sched, b1=0.9, b2=0.95,
+                                  weight_decay=0.1))
+    state, opt2 = create_train_state(cfg_t, mesh, jax.random.PRNGKey(0),
+                                     optimizer=opt)
+    step = make_train_step(cfg_t, mesh, opt2)
+    for i in range(steps):
+        state, m = step(state, data_batch(jax.random.PRNGKey(2000 + i)))
+    return cfg_t, state.params, float(m["loss"])
+
+
+def check_encdec_slot_serving_trained() -> bool:
+    """Encdec slot-vs-serialized token match on TRAINED weights — the
+    same discipline check_slot_serving_trained applies to the llama
+    engine (VERDICT r4 weak #3: the encdec hardware evidence was
+    random-weights match at 5/16 with noise-bound throughput). Each
+    stream's single-token source seeds a different section of the
+    memorized successor chain, so outputs are diverse across slots
+    (a row-crossing cache bug would show) yet every position is an
+    ultra-peaked table lookup. Triple gate: 16/16 rows match the
+    serialized path, the rows equal the TABLE's ground truth (not
+    just each other), and the train loss converged."""
+    from tpu_docker_api.infer.servebench import bench_encdec_slot_serving
+
+    cfg_t, params_t, loss = _train_encdec_target()
+    perm, succ = _encdec_successor_table()
+    srcs = [[int(perm[37 * i])] for i in range(16)]  # 16 distinct seeds
+    r = bench_encdec_slot_serving(streams=8, requests=16,
+                                  new_tok=48, chunk=24, cfg=cfg_t,
+                                  params=params_t, srcs=srcs,
+                                  return_tokens=True)
+    r["preset"] = "trained-4L-512 (global successor table)"
+    r["train_loss"] = round(loss, 4)
+    r["speedup_gated"] = False
+    matches = int(r["match_rows"].split("/")[0])
+    # ground truth: the chain itself — s0, succ[s0], succ[succ[s0]], ...
+    truth_ok = True
+    for s, toks in zip(srcs, r.pop("slot_tokens")):
+        want, cur = [], s[0]
+        for _ in range(len(toks)):
+            want.append(int(cur))
+            cur = succ[cur]
+        truth_ok &= toks == want
+    return _emit("encdec_slot_serving_trained_match",
+                 (r.pop("ok") and matches == 16 and loss < 0.05
+                  and truth_ok),
+                 ground_truth_rows=truth_ok, **r)
 
 
 def check_paged_serving() -> bool:
@@ -720,6 +847,42 @@ def check_paged_serving() -> bool:
     return ok
 
 
+def check_paged_admission() -> bool:
+    """Grow-vs-full reservation on 8B-int8 (round 5 — VERDICT r4 next
+    #6): 32 requests promising 1024 tokens but stopping at ~16 share a
+    104-page pool. Worst-case reservation (18 pages/request) admits ≤5
+    at a time; grow-mode admits all 32 on prefill pages and claims only
+    the ~3 pages each decode actually reaches. Gate: ≥2× first-wave
+    admission at token-identical outputs."""
+    from tpu_docker_api.infer.servebench import bench_paged_admission
+
+    r = bench_paged_admission(preset="llama3-8b", streams=32,
+                              prompt_len=128, promised_new=1024,
+                              actual_new=16, max_seq=2048,
+                              page_size=64, total_pages=104)
+    return _emit("paged_admission_grow_8b",
+                 r.pop("ok") and r["admission_ratio"] >= 2.0, **r)
+
+
+def check_paged_prefix() -> bool:
+    """Paged × prefix caching (round 5 — VERDICT r4 next #3): the
+    960-token shared-header workload on llama3-8b int8 at a 32×3072
+    addressable capacity whose dense cache is arithmetically impossible
+    next to the weights. Gate: the shared-page run beats per-request
+    full prefill by ≥1.3× (the suffix prefill is an 8× smaller bucket;
+    tunnel noise caps the observable ratio well below that), every
+    request hits the prefix, and the dense impossibility holds."""
+    from tpu_docker_api.infer.servebench import bench_paged_prefix
+
+    r = bench_paged_prefix(preset="llama3-8b", requests=16, slots=32,
+                           prefix_len=960, suffix_len=16, new_tok=8,
+                           max_seq=3072, page_size=64)
+    return _emit("paged_prefix_8b",
+                 (r.pop("ok") and r["speedup"] >= 1.3
+                  and not r["dense_fits_with_weights"]),
+                 **r)
+
+
 def check_encdec_slot_serving() -> bool:
     """Seq2seq continuous batching (round 4) — INFORMATIONAL, not
     gated (the chunked_prefill precedent): r4 captures at identical
@@ -749,8 +912,13 @@ def check_encdec_slot_serving() -> bool:
 def check_tail_latency() -> bool:
     """Serving SLO percentiles (VERDICT r3 stretch): p50/p99 TTFT and
     inter-token latency under a mixed open-loop load at the 8- and
-    16-stream operating points. Informational (the numbers ARE the
-    artifact; regressions show as percentile jumps across rounds)."""
+    16-stream operating points. Round 5: the ENGINE-side percentiles
+    (what /metrics exports) ride along and must agree with the
+    client-side measurement on TTFT p50 within 50% or 25 ms — the two
+    clocks bracket the same event (engine records at host chunk
+    processing, the client thread after queue wakeup), so gross
+    disagreement means the export is lying. Percentile VALUES stay
+    informational (tunnel variance)."""
     from tpu_docker_api.infer.servebench import bench_tail_latency
 
     ok = True
@@ -758,8 +926,14 @@ def check_tail_latency() -> bool:
         r = bench_tail_latency(preset="llama3-1b", streams=streams,
                                n_requests=4 * streams, arrival_s=0.04,
                                new_tok=48, max_seq=512, chunk=8)
-        r["gated"] = False
-        ok &= _emit(f"tail_latency_{streams}streams", r.pop("ok"), **r)
+        r["gated"] = "engine_latency cross-check only"
+        el = r.get("engine_latency") or {}
+        ep50, cp50 = el.get("ttft_p50_ms"), r["ttft_p50_ms"]
+        agree = (ep50 is not None
+                 and abs(ep50 - cp50) <= max(25.0, 0.5 * cp50))
+        r["engine_client_ttft_agree"] = agree
+        ok &= _emit(f"tail_latency_{streams}streams",
+                    r.pop("ok") and agree, **r)
     return ok
 
 
@@ -846,7 +1020,10 @@ def main() -> int:
         checks.append(check_decode_roofline)
         checks.append(check_slot_serving_trained)
         checks.append(check_paged_serving)
+        checks.append(check_paged_prefix)
+        checks.append(check_paged_admission)
         checks.append(check_encdec_slot_serving)
+        checks.append(check_encdec_slot_serving_trained)
         checks.append(check_tail_latency)
         checks.append(check_qlora_8b)
     ok = True

@@ -13,13 +13,19 @@ one v5e).
 Design (everything else — chunked decode, pipeline lag, admission
 batching, sampling, drain — is inherited):
 
-- **Reservation at admission**: a request reserves
-  ``ceil(max(bucket, prompt+max_new)/page)`` pages up front; if the
-  pool can't cover it the request (and everything behind it — strict
-  FCFS, no leapfrogging starvation) waits in a deferred queue until
-  completions release pages. No mid-flight OOM, no preemption; the
-  lazy-growth/preempt-restore refinement is future work and recorded
-  here as the deliberate v1 scope.
+- **Grow-as-you-decode reservation (r5 — VERDICT r4 next #6)**: an
+  admission holds only its prefill-scatter pages; each chunk dispatch
+  claims the pages its write reach needs (the per-slot form of
+  ``_reach_bound``), so a request promising max_new=2048 but emitting
+  10 tokens never pins 2048 tokens of pool. When the pool runs dry at
+  a growth edge, the LOWEST-PROGRESS slot is preempted with exact
+  restore: its host-resolved tokens requeue at the deferred queue's
+  front as ``prompt + carry`` and re-prefill — greedy continuations
+  are token-identical, clients never see the swap, and growth for
+  existing slots outranks new admissions. ``reservation="full"``
+  keeps the r4 worst-case up-front policy (escape hatch / A/B
+  baseline). Admission stays strict FCFS either way: the deferred
+  queue is always served first, no leapfrogging starvation.
 - **The page table is a per-dispatch host operand**, never device
   state: repaging between dispatches is free, and the engine keeps its
   zero-eager-ops rule (slots.py module docstring). Tables are (S, mp)
@@ -50,9 +56,29 @@ gather pages into a view element-identical to the dense cache prefix
 contract under admission orders, slot reuse, pool exhaustion, and
 deferred admissions.
 
-v1 scope: llama-family, single device, whole-prompt admission (no
-``prefill_chunk``), no prefix caching, no speculative composition —
-each raises explicitly rather than degrading.
+Prefix caching (round 5 — VERDICT r4 next #3) composes via REFCOUNTED
+SHARED PAGES, and the page-alignment choice is what keeps it simple:
+
+- ``register_prefix`` prefills the prefix ONCE and scatters only its
+  first ``floor(P/page)·page`` positions into pool pages. Those pages
+  are **never written again** — admissions whose prompt strictly
+  extends the prefix get the shared page ids PREPENDED to their table
+  and re-prefill just the unaligned tail (< page tokens) plus their
+  suffix. Decode only appends at positions > the shared region, so
+  read-only sharing needs no copy-on-write, ever; the cost is at most
+  page_size−1 redundantly-prefilled tokens per admission.
+- Registration and its pool scatter run ON THE ENGINE THREAD (a small
+  command queue drained by :meth:`step`): every pool program consumes
+  the donated buffers of the previous dispatch, so a caller-thread
+  scatter would race the donation chain that serializes the device.
+- ``unregister_prefix`` removes the entry from the registry (no new
+  admissions can attach) but the pages return to the pool only when
+  the last live reader completes — a zombie list the engine loop
+  reclaims, mirroring how slot completions release private pages.
+
+v1 scope remaining: llama-family, single device, whole-prompt
+admission (no ``prefill_chunk``), no speculative composition — each
+raises explicitly rather than degrading.
 """
 
 from __future__ import annotations
@@ -73,6 +99,28 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
 
 
+class _PagedPrefix:
+    """A registered prefix whose aligned K/V lives in shared pool pages.
+    Mutable on purpose: ``refs`` counts live reader slots (engine-thread
+    only) and ``dead`` marks an unregistered entry awaiting reclamation.
+    Attribute-compatible with the base ``_Prefix`` where the base class
+    reads entries (``prefixes()``, ``_resolve_prefix``)."""
+
+    __slots__ = ("pid", "tokens", "length", "shared_len", "page_ids",
+                 "refs", "dead", "nbytes")
+
+    def __init__(self, pid, tokens, length, shared_len, page_ids,
+                 nbytes):
+        self.pid = pid
+        self.tokens = tokens          # tuple[int, ...]
+        self.length = length          # true token count
+        self.shared_len = shared_len  # floor(length/page)*page
+        self.page_ids = page_ids      # tuple[int, ...] shared pool pages
+        self.nbytes = nbytes          # pool bytes the shared pages pin
+        self.refs = 0
+        self.dead = False
+
+
 class PagedSlotEngine(SlotEngine):
     """Slot engine whose KV cache is a page pool. ``total_pages`` sizes
     the pool in usable pages (page 0 is reserved as the trash page);
@@ -80,7 +128,8 @@ class PagedSlotEngine(SlotEngine):
     trade capacity headroom for HBM."""
 
     def __init__(self, cfg, params, *, page_size: int = 64,
-                 total_pages: int | None = None, **kwargs):
+                 total_pages: int | None = None,
+                 reservation: str = "grow", **kwargs):
         if not isinstance(cfg, LlamaConfig):
             raise ValueError(
                 "the paged engine serves llama-family configs only (v1)")
@@ -93,7 +142,17 @@ class PagedSlotEngine(SlotEngine):
         if page_size < 1 or (page_size & (page_size - 1)):
             raise ValueError(
                 f"page_size must be a power of two, got {page_size}")
+        if reservation not in ("grow", "full"):
+            raise ValueError(
+                f"reservation must be 'grow' or 'full', got "
+                f"{reservation!r}")
         self.page_size = page_size
+        #: "grow" (r5 default): admission reserves only the prefill
+        #: scatter pages; decode pages are claimed per-chunk at the
+        #: reservation edge, with preempt-lowest-progress as the
+        #: pressure valve. "full": the r4 worst-case up-front
+        #: reservation (escape hatch + the A/B baseline).
+        self.reservation = reservation
         self._total_pages = total_pages
         super().__init__(cfg, params, **kwargs)
         bad = [b for b in self.buckets if b % page_size]
@@ -105,9 +164,23 @@ class PagedSlotEngine(SlotEngine):
         # bookkeeping (engine-thread only, like the base's _table values)
         self._slot_pages: dict[int, list[int]] = {}
         self._deferred: list = []
+        #: which registered prefix (if any) each active slot reads —
+        #: completions decrement its refcount (engine-thread only)
+        self._slot_prefix: dict[int, _PagedPrefix] = {}
+        #: registration requests routed to the engine thread (the pool
+        #: scatter must join the donation chain); (tokens, reply_queue)
+        self._px_cmds: queue.SimpleQueue = queue.SimpleQueue()
+        #: unregistered prefixes with live readers — pages reclaim when
+        #: refs hits 0 (engine thread)
+        self._px_zombies: list[_PagedPrefix] = []
+        #: original prompt per active slot — a preemption must rebuild
+        #: the exact re-prefill context (engine-thread only)
+        self._slot_prompt: dict[int, list[int]] = {}
         self.stats["pages_total"] = self._usable_pages
         self.stats["pages_free"] = len(self._free)
         self.stats["deferred_admissions"] = 0
+        self.stats["grown_pages"] = 0
+        self.stats["preemptions"] = 0
 
     # ---- pool ---------------------------------------------------------------
 
@@ -147,18 +220,305 @@ class PagedSlotEngine(SlotEngine):
 
     def validate(self, prompt, max_new, top_k=0, top_p=1.0):
         super().validate(prompt, max_new, top_k=top_k, top_p=top_p)
-        bucket = next(b for b in self.buckets if b >= len(prompt))
-        need = self._pages_needed(len(prompt), max_new, bucket)
-        if need > self._usable_pages:
+        plan = self._px_plan(list(prompt))
+        # pages PERMANENTLY pinned by registered prefixes never return
+        # to the free list while registered — a request whose need
+        # exceeds usable-minus-pinned can never admit, and (strict
+        # FCFS) would hang every request behind it; submit() promises
+        # to raise for can-never-fit instead
+        with self._lock:
+            pinned = sum(len(e.page_ids)
+                         for e in self._prefixes.values())
+        if plan is not None:
+            ent, sbucket = plan
+            need = self._px_pages_needed(len(prompt), max_new, ent,
+                                         sbucket)
+        else:
+            bucket = next((b for b in self.buckets
+                           if b >= len(prompt)), None)
+            if bucket is None:
+                # base validate admitted this length via a prefix that
+                # no longer resolves (concurrent unregister) — the
+                # admission-time re-resolve fails the handle; here the
+                # request can still never fit a prefill bucket
+                raise ValueError(
+                    f"prompt ({len(prompt)}) exceeds the largest "
+                    f"prefill bucket ({self.buckets[-1]}) and no "
+                    f"registered prefix covers it")
+            need = self._pages_needed(len(prompt), max_new, bucket)
+        if need > self._usable_pages - pinned:
             raise ValueError(
                 f"request needs {need} pages "
                 f"({len(prompt)}+{max_new} tokens at page size "
-                f"{self.page_size}); the pool has {self._usable_pages}")
+                f"{self.page_size}); the pool has {self._usable_pages}"
+                f" with {pinned} pinned by registered prefixes")
 
-    def register_prefix(self, tokens):
-        raise ValueError(
-            "prefix caching is not supported on the paged engine (v1 "
-            "scope — use the dense SlotEngine for prefix-heavy traffic)")
+    # ---- prefix cache (shared pages) ----------------------------------------
+
+    def register_prefix(self, tokens) -> str:
+        """Prefill ``tokens`` once into SHARED pool pages; admissions
+        whose prompt strictly extends them prepend those pages to their
+        table and prefill only the unaligned tail + suffix. Runs on the
+        engine thread when the engine is live (the pool scatter must
+        join the donation chain that serializes the device); direct when
+        it is not (pre-start registration, test-driven stepping)."""
+        tokens = list(tokens)
+        if self._thread is None:
+            return self._do_register_prefix(tokens)
+        reply: queue.SimpleQueue = queue.SimpleQueue()
+        with self._lock:
+            if self._closed or self._draining:
+                raise RuntimeError("engine is closed")
+            if self._dead is not None:
+                raise RuntimeError(f"engine failed: {self._dead!r}")
+            self._px_cmds.put((tokens, reply))
+        self._wake.set()
+        ok, val = reply.get(timeout=600)
+        if not ok:
+            raise val
+        return val
+
+    def _do_register_prefix(self, tokens: list[int]) -> str:
+        """Engine-thread half of registration: registry checks, page
+        allocation, one prefill + aligned-page scatter. ``_px_lock``
+        serializes whole registrations (base-class rule) — the direct
+        pre-start path may see concurrent caller threads."""
+        with self._px_lock:
+            return self._do_register_prefix_locked(tokens)
+
+    def _do_register_prefix_locked(self, tokens: list[int]) -> str:
+        page = self.page_size
+        if not tokens:
+            raise ValueError("prefix must be non-empty")
+        if len(tokens) < page:
+            raise ValueError(
+                f"prefix ({len(tokens)} tokens) is shorter than one page "
+                f"({page}) — nothing can be shared read-only; lower "
+                f"page_size or use the dense SlotEngine")
+        if len(tokens) + 2 > self.max_seq:
+            raise ValueError(
+                f"prefix ({len(tokens)}) leaves no room for a suffix and "
+                f"a generated token in cache capacity {self.max_seq}")
+        bucket = next((b for b in self.buckets if b >= len(tokens)), None)
+        if bucket is None:
+            raise ValueError(
+                f"prefix ({len(tokens)}) exceeds the largest prefill "
+                f"bucket ({self.buckets[-1]})")
+        npx = len(tokens) // page
+        key = tuple(tokens)
+        with self._lock:
+            for ent in self._prefixes.values():
+                if ent.tokens == key:
+                    return ent.pid
+            if len(self._prefixes) >= self.max_prefixes:
+                raise ValueError(
+                    f"prefix registry full ({self.max_prefixes}) — "
+                    f"unregister one first")
+            nbytes = (2 * self.cfg.n_layers * npx * page
+                      * self.cfg.n_kv_heads * self.cfg.head_dim
+                      * self._k.dtype.itemsize)
+            if (self.max_prefix_bytes
+                    and self.stats["prefix_bytes"] + nbytes
+                    > self.max_prefix_bytes):
+                raise ValueError(
+                    f"prefix pages ({nbytes} B) would exceed the "
+                    f"registry byte budget ({self.max_prefix_bytes} B; "
+                    f"{self.stats['prefix_bytes']} B registered) — "
+                    f"unregister one first")
+            self._px_seq += 1
+            pid = f"px-{self._px_seq}"
+        if npx > len(self._free):
+            raise ValueError(
+                f"prefix needs {npx} pages; only {len(self._free)} free "
+                f"in the pool")
+        pages = [self._free.pop() for _ in range(npx)]
+        prompt = np.full((1, bucket), self.pad_id, np.int32)
+        prompt[0, :len(tokens)] = tokens
+        self._k, self._v = self._px_build_fn(bucket, npx)(
+            self.params, prompt, np.asarray(pages, np.int32),
+            self._k, self._v)
+        ent = _PagedPrefix(pid=pid, tokens=key, length=len(tokens),
+                           shared_len=npx * page,
+                           page_ids=tuple(pages), nbytes=nbytes)
+        with self._lock:
+            self._prefixes[pid] = ent
+            self.stats["prefix_bytes"] += nbytes
+        self.stats["pages_free"] = len(self._free)
+        return pid
+
+    def unregister_prefix(self, pid: str) -> bool:
+        """Remove from the registry (no new admissions attach); shared
+        pages return to the pool only once the last live reader slot
+        completes (the engine loop reclaims)."""
+        with self._px_lock, self._lock:
+            ent = self._prefixes.pop(pid, None)
+            if ent is None:
+                return False
+            ent.dead = True
+            self.stats["prefix_bytes"] -= ent.nbytes
+            self._px_zombies.append(ent)
+        if self._thread is None:
+            self._reclaim_zombies()
+        return True
+
+    def _reclaim_zombies(self) -> None:
+        """Free dead prefixes' pages once refs == 0 (engine thread)."""
+        live = []
+        for ent in self._px_zombies:
+            if ent.refs == 0:
+                self._free.extend(ent.page_ids)
+                self.stats["pages_free"] = len(self._free)
+            else:
+                live.append(ent)
+        self._px_zombies = live
+
+    def _drain_px_cmds(self, err: Exception | None = None) -> None:
+        """Execute (or fail, if ``err``) queued registrations."""
+        while True:
+            try:
+                tokens, reply = self._px_cmds.get_nowait()
+            except queue.Empty:
+                return
+            if err is not None:
+                reply.put((False, RuntimeError(f"engine failed: {err!r}")
+                           if not isinstance(err, RuntimeError) else err))
+                continue
+            try:
+                reply.put((True, self._do_register_prefix(tokens)))
+            except Exception as e:  # registry/pool errors → the caller
+                reply.put((False, e))
+
+    def _px_plan(self, prompt: list[int]):
+        """(prefix, suffix_bucket) when a registered prefix applies.
+        The suffix starts at the ALIGNED shared length — the unaligned
+        tail re-prefills with the suffix (read-only sharing's price)."""
+        ent = self._resolve_prefix(prompt)
+        if ent is None:
+            return None
+        sfx = len(prompt) - ent.shared_len
+        sbucket = next((b for b in self.buckets if b >= sfx), None)
+        if sbucket is None:
+            return None
+        return ent, sbucket
+
+    def _sfx_pages(self, npx: int, sbucket: int) -> int:
+        """Scatter pages for a suffix prefill: the bucket's pages,
+        truncated to the table row — the truncated region is pad
+        garbage past capacity (validate bounds real positions)."""
+        return min(sbucket // self.page_size,
+                   self._max_pages_per_slot - npx)
+
+    def _px_pages_needed(self, prompt_len: int, max_new: int,
+                         ent: _PagedPrefix, sbucket: int) -> int:
+        """PRIVATE pages an admission against ``ent`` must reserve:
+        cover the suffix scatter and the decode reach beyond the shared
+        region (same one-past-live rule as _pages_needed)."""
+        npx = len(ent.page_ids)
+        reach_pages = _ceil_div(prompt_len + max_new - 1, self.page_size)
+        return max(self._sfx_pages(npx, sbucket), reach_pages - npx)
+
+    def _admit_need(self, prompt_len: int, max_new: int, bucket: int,
+                    ent: _PagedPrefix | None) -> int:
+        """Pages an admission must hold BEFORE its prefill dispatches.
+        Full mode: the r4 worst-case reservation. Grow mode (r5,
+        VERDICT r4 next #6): only the prefill scatter destinations —
+        decode pages are claimed per-chunk in _ensure_coverage, so a
+        request that asks for max_new=2048 but emits 10 tokens never
+        pins pages it won't use, and admission concurrency scales with
+        LIVE tokens instead of promises."""
+        if ent is not None:
+            if self.reservation == "full":
+                return self._px_pages_needed(prompt_len, max_new, ent,
+                                             bucket)
+            return self._sfx_pages(len(ent.page_ids), bucket)
+        if self.reservation == "full":
+            return self._pages_needed(prompt_len, max_new, bucket)
+        return bucket // self.page_size
+
+    # ---- growth + preemption (r5) -------------------------------------------
+
+    def _ensure_coverage(self, snap: dict) -> None:
+        """Grow-mode: before a chunk dispatches, every active slot's
+        pages must cover the chunk's write reach (per-slot form of the
+        _reach_bound math, capped at the request's own remaining need).
+        Pages come from the pool; when it runs dry the LOWEST-PROGRESS
+        slot is preempted — host-known tokens are the exact restore
+        context, so nothing a client saw is ever lost. Runs both in
+        step() (growth outranks new admissions for a tight pool) and in
+        _dispatch_chunk (fresh admits claim their first chunk).
+        Preempted entries in ``snap`` become None in place."""
+        if self.reservation != "grow":
+            return
+        page = self.page_size
+        for i in sorted(snap):
+            st = snap.get(i)
+            if st is None or self._table.get(i) is not st:
+                continue  # preempted by an earlier slot's growth
+            shared = (len(self._slot_prefix[i].page_ids)
+                      if i in self._slot_prefix else 0)
+            target = min(
+                st.base_len + (st.dispatched + 1) * self.chunk,
+                st.base_len + (st.max_new - st.preseed) - 1)
+            need = (_ceil_div(target, page) - shared
+                    - len(self._slot_pages[i]))
+            while need > len(self._free):
+                victim = self._pick_victim(snap)
+                self._preempt(victim, snap[victim])
+                snap[victim] = None
+                if victim == i:
+                    break
+            if snap.get(i) is None or need <= 0:
+                continue
+            pages = [self._free.pop() for _ in range(need)]
+            row = self._ptable[i]
+            start = shared + len(self._slot_pages[i])
+            row[start:start + need] = pages
+            self._slot_pages[i].extend(pages)
+            self.stats["grown_pages"] += need
+            self.stats["pages_free"] = len(self._free)
+
+    def _pick_victim(self, snap: dict) -> int:
+        """Lowest host-known progress (cheapest restore), preferring
+        slots whose restored prompt still fits a prefill bucket. A
+        non-restorable victim (prompt+progress past the largest bucket
+        — only reachable with a truncated explicit bucket list) is the
+        last resort: its re-admission fails that handle loudly, which
+        beats deadlocking every stream on an overcommitted pool."""
+        live = [j for j, s in snap.items()
+                if s is not None and self._table.get(j) is s]
+        big = self.buckets[-1]
+
+        def restorable(j):
+            return (len(self._slot_prompt[j]) + len(snap[j].tokens)
+                    <= big)
+
+        fits = [j for j in live if restorable(j)]
+        pool = fits or live
+        return min(pool, key=lambda j: (len(snap[j].tokens), -j))
+
+    def _preempt(self, slot: int, st) -> None:
+        """Exact-restore preemption: free the slot's private pages and
+        requeue the request at the FRONT of the deferred queue with its
+        host-resolved tokens carried. Re-prefill context =
+        prompt + carry, so a greedy continuation is token-identical and
+        a sampled one re-draws from the engine stream; the client's
+        handle (and anything it already streamed) is untouched.
+        Outstanding chunks still carrying this slot are skipped by the
+        processing loop's identity check, exactly like completions."""
+        with self._lock:
+            self._table[slot] = None
+        self._free.extend(self._slot_pages.pop(slot, []))
+        self._ptable[slot, :] = 0
+        ent = self._slot_prefix.pop(slot, None)
+        if ent is not None:
+            ent.refs -= 1
+        orig = self._slot_prompt.pop(slot)
+        carry = list(st.tokens)
+        self._deferred.insert(
+            0, (orig + carry, st.max_new, st.temperature, st.eos_id,
+                st.top_k, st.top_p, st.handle, carry))
+        self.stats["preemptions"] += 1
+        self.stats["pages_free"] = len(self._free)
 
     # ---- compiled programs --------------------------------------------------
 
@@ -204,6 +564,95 @@ class PagedSlotEngine(SlotEngine):
 
         fn = jax.jit(prefill, donate_argnums=(9, 10, 11, 12, 13, 14, 15))
         self._prefill_fns[(bucket, rows)] = fn
+        return fn
+
+    def _px_build_fn(self, bucket: int, npx: int):
+        """Registration program: one-row prefill on a dense temp cache,
+        then scatter the first ``npx`` ALIGNED pages into the pool.
+        Positions past npx·page (the unaligned tail + bucket pad) are
+        deliberately not stored — admissions re-prefill them."""
+        key = ("pxbuild", bucket, npx)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        cache_dtype = self._k.dtype
+        page = self.page_size
+
+        def build(params, prompt, page_ids, k_pool, v_pool):
+            L = cfg.n_layers
+            shape = (L, 1, bucket, cfg.n_kv_heads, cfg.head_dim)
+            kc = jnp.zeros(shape, cache_dtype)
+            vc = jnp.zeros(shape, cache_dtype)
+            _, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
+                            None, last_only=True)
+            src_k = kc[:, 0, :npx * page].reshape(
+                L, npx, page, cfg.n_kv_heads, cfg.head_dim)
+            src_v = vc[:, 0, :npx * page].reshape(
+                L, npx, page, cfg.n_kv_heads, cfg.head_dim)
+            return (k_pool.at[:, page_ids].set(src_k),
+                    v_pool.at[:, page_ids].set(src_v))
+
+        fn = jax.jit(build, donate_argnums=(3, 4))
+        self._prefill_fns[key] = fn
+        return fn
+
+    def _px_prefill_paged_fn(self, npx: int, sbucket: int, rows: int):
+        """Suffix-only batched prefill against shared pages: gather the
+        prefix's aligned K/V out of the pool into the temp cache, run
+        the suffix forward at absolute position npx·page (rope phases
+        and the causal q_offset mask are position-derived, so the math
+        is identical to a full prefill — the shared FLOPs are just
+        skipped), then scatter ONLY the suffix's pages into the
+        admission's private pages. Shared pages are never written."""
+        key = ("pxpaged", npx, sbucket, rows)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        cache_dtype = self._k.dtype
+        page = self.page_size
+        P_ = npx * page
+        nsp = self._sfx_pages(npx, sbucket)
+        tsize = P_ + sbucket
+
+        def prefill(params, px_ids, prompts, actual_lens, slots,
+                    page_ids, temps, topks, topps, seed, k_pool, v_pool,
+                    dtok, dpos, dtemp, dtopk, dtopp):
+            L = cfg.n_layers
+            kvh, hd = cfg.n_kv_heads, cfg.head_dim
+            shape = (L, rows, tsize, kvh, hd)
+            pk = jnp.take(k_pool, px_ids, axis=1).reshape(L, P_, kvh, hd)
+            pv = jnp.take(v_pool, px_ids, axis=1).reshape(L, P_, kvh, hd)
+            kc = jnp.zeros(shape, cache_dtype).at[:, :, :P_].set(
+                pk[:, None])
+            vc = jnp.zeros(shape, cache_dtype).at[:, :, :P_].set(
+                pv[:, None])
+            # per-row start vector → scatter writes (mode="drop"), same
+            # rationale as the dense engine's _px_prefill_fn
+            starts = jnp.full((rows,), P_, jnp.int32)
+            logits, kc, vc = fwd(params, prompts, cfg, kc, vc, starts,
+                                 None, last_only=actual_lens - 1)
+            toks = self._sample_filtered(
+                logits[:, 0], temps, topks, topps,
+                jax.random.PRNGKey(seed))
+            ids = page_ids.reshape(-1)  # (rows*nsp,) all distinct
+            src_k = kc[:, :, P_:P_ + nsp * page].reshape(
+                L, rows * nsp, page, kvh, hd)
+            src_v = vc[:, :, P_:P_ + nsp * page].reshape(
+                L, rows * nsp, page, kvh, hd)
+            k_pool = k_pool.at[:, ids].set(src_k)
+            v_pool = v_pool.at[:, ids].set(src_v)
+            dtok = dtok.at[slots].set(toks)
+            dpos = dpos.at[slots].set(P_ + actual_lens)
+            dtemp = dtemp.at[slots].set(temps)
+            dtopk = dtopk.at[slots].set(topks)
+            dtopp = dtopp.at[slots].set(topps)
+            return toks, k_pool, v_pool, dtok, dpos, dtemp, dtopk, dtopp
+
+        fn = jax.jit(prefill,
+                     donate_argnums=(10, 11, 12, 13, 14, 15, 16))
+        self._prefill_fns[key] = fn
         return fn
 
     def _decode(self, mp: int, filtered: bool = False):
@@ -288,7 +737,11 @@ class PagedSlotEngine(SlotEngine):
         """Admission with up-front page reservation, strict FCFS: the
         deferred queue (requests the pool couldn't cover) is always
         served first, and one blocked request blocks everything behind
-        it — a stream of small requests must not starve a big one."""
+        it — a stream of small requests must not starve a big one.
+        Prompts extending a registered prefix reserve only PRIVATE
+        pages and group per (prefix, suffix-bucket) for the shared-page
+        prefill. No unregister can race the refcount here: reclamation
+        runs on this same thread, after _admit returns."""
         free_slots = [i for i, s in self._table.items() if s is None]
         batch = self._deferred
         self._deferred = []
@@ -300,16 +753,35 @@ class PagedSlotEngine(SlotEngine):
                 break
         if not batch:
             return False
-        ok: list[tuple[Any, int, list[int]]] = []
+        # normalize to 8-tuples: preemption restores carry an emitted-
+        # token prefix; fresh submits carry none
+        batch = [r if len(r) == 8 else (*r, []) for r in batch]
+        ok: list[tuple[Any, Any, int, list[int]]] = []
         blocked = False
         for idx, req in enumerate(batch):
             prompt, max_new = req[0], req[1]
-            bucket = next(b for b in self.buckets if b >= len(prompt))
-            need = self._pages_needed(len(prompt), max_new, bucket)
+            plan = self._px_plan(prompt)
+            if plan is not None:
+                ent, bucket = plan
+            else:
+                ent = None
+                bucket = next((b for b in self.buckets
+                               if b >= len(prompt)), None)
+                if bucket is None:
+                    # admitted past validate() via a prefix
+                    # unregistered in between — or a preemption restore
+                    # whose prompt+progress outgrew a truncated bucket
+                    # list — fail the handle, not the engine loop
+                    req[6]._fail(ValueError(
+                        f"prompt ({len(prompt)}) exceeds the largest "
+                        f"prefill bucket and no registered prefix "
+                        f"covers it"))
+                    continue
+            need = self._admit_need(len(prompt), max_new, bucket, ent)
             if (not blocked and len(ok) < len(free_slots)
                     and need <= len(self._free)):
                 pages = [self._free.pop() for _ in range(need)]
-                ok.append((req, bucket, pages))
+                ok.append((req, ent, bucket, pages))
             else:
                 if idx >= n_redeferred:
                     self.stats["deferred_admissions"] += 1
@@ -318,11 +790,16 @@ class PagedSlotEngine(SlotEngine):
         self.stats["pages_free"] = len(self._free)
         if not ok:
             return False
-        groups: dict[int, list] = {}
-        for req, bucket, pages in ok:
-            groups.setdefault(bucket, []).append((req, pages))
-        for bucket, items in groups.items():
-            npg = bucket // self.page_size
+        groups: dict[tuple, list] = {}
+        for req, ent, bucket, pages in ok:
+            # the entry object itself rides the key (identity hash) so
+            # same-bucket hits on different prefixes never merge
+            groups.setdefault((ent, bucket), []).append((req, pages))
+        for (ent, bucket), items in groups.items():
+            shared = len(ent.page_ids) if ent is not None else 0
+            plen = ent.shared_len if ent is not None else 0
+            npg = (self._sfx_pages(shared, bucket) if ent is not None
+                   else bucket // self.page_size)
             while items:
                 R = 1
                 while R * 2 <= len(items) and R * 2 <= self.slots:
@@ -335,34 +812,61 @@ class PagedSlotEngine(SlotEngine):
                 topks = np.empty((R,), np.int32)
                 topps = np.empty((R,), np.float32)
                 page_ids = np.zeros((R, npg), np.int32)
-                for r, ((prompt, _mn, temp, _eos, tk, tp, _h),
+                for r, ((prompt, _mn, temp, _eos, tk, tp, _h, _c),
                         pages) in enumerate(grp):
-                    prompts_np[r, :len(prompt)] = prompt
-                    lens[r] = len(prompt)
+                    sfx = prompt[plen:]
+                    prompts_np[r, :len(sfx)] = sfx
+                    lens[r] = len(sfx)
                     temps[r], topks[r], topps[r] = temp, tk, tp
                     page_ids[r] = pages[:npg]
                     row = self._ptable[slots_v[r]]
                     row[:] = 0
-                    row[:len(pages)] = pages
-                (toks, self._k, self._v, self._dtok, self._dpos,
-                 self._dtemp, self._dtopk,
-                 self._dtopp) = self._prefill_fn(bucket, R)(
-                    self.params, prompts_np, lens,
-                    np.asarray(slots_v, np.int32), page_ids, temps,
-                    topks, topps, self._next_seed(),
-                    self._k, self._v, self._dtok, self._dpos,
-                    self._dtemp, self._dtopk, self._dtopp)
+                    if ent is not None:
+                        row[:shared] = ent.page_ids
+                    row[shared:shared + len(pages)] = pages
+                if ent is None:
+                    (toks, self._k, self._v, self._dtok, self._dpos,
+                     self._dtemp, self._dtopk,
+                     self._dtopp) = self._prefill_fn(bucket, R)(
+                        self.params, prompts_np, lens,
+                        np.asarray(slots_v, np.int32), page_ids, temps,
+                        topks, topps, self._next_seed(),
+                        self._k, self._v, self._dtok, self._dpos,
+                        self._dtemp, self._dtopk, self._dtopp)
+                else:
+                    (toks, self._k, self._v, self._dtok, self._dpos,
+                     self._dtemp, self._dtopk,
+                     self._dtopp) = self._px_prefill_paged_fn(
+                        shared, bucket, R)(
+                        self.params,
+                        np.asarray(ent.page_ids, np.int32),
+                        prompts_np, lens,
+                        np.asarray(slots_v, np.int32), page_ids, temps,
+                        topks, topps, self._next_seed(),
+                        self._k, self._v, self._dtok, self._dpos,
+                        self._dtemp, self._dtopk, self._dtopp)
+                    self.stats["prefix_hits"] += R
                 self.stats["prefills"] += 1
                 for r, ((prompt, max_new, temp, eos_id, tk, tp,
-                         handle), pages) in enumerate(grp):
-                    st = _Slot(handle=handle, tokens=[], max_new=max_new,
+                         handle, carry), pages) in enumerate(grp):
+                    # a preemption restore re-seeds its already-emitted
+                    # tokens directly (NOT via emit — clients streamed
+                    # them already); finish/reach math subtracts preseed
+                    st = _Slot(handle=handle, tokens=list(carry),
+                               max_new=max_new,
                                pos=len(prompt), temperature=temp,
                                eos_id=eos_id, top_k=tk, top_p=tp,
-                               base_len=len(prompt))
+                               base_len=len(prompt), preseed=len(carry))
                     self._slot_pages[slots_v[r]] = pages
+                    self._slot_prompt[slots_v[r]] = (
+                        prompt[:len(prompt) - len(carry)] if carry
+                        else prompt)
+                    if ent is not None:
+                        ent.refs += 1
+                        self._slot_prefix[slots_v[r]] = ent
                     with self._lock:
                         self._table[slots_v[r]] = st
-                    if max_new == 1:
+                    if max_new - len(carry) <= 1:
                         st.emit(int(toks[r]))
                         st.fresh = False
                         self._finish_if_done(slots_v[r], st)
@@ -370,6 +874,13 @@ class PagedSlotEngine(SlotEngine):
 
     def _dispatch_chunk(self) -> None:
         snap = {i: s for i, s in self._table.items() if s is not None}
+        # grow-mode: claim this chunk's pages (fresh admits included);
+        # may preempt — drop preempted entries before dispatching
+        self._ensure_coverage(snap)
+        snap = {i: s for i, s in snap.items()
+                if s is not None and self._table.get(i) is s}
+        if not snap:
+            return
         bound = self._reach_bound(snap, self.chunk)
         mp = self._mp_bucket(_ceil_div(bound, self.page_size))
         filtered = any(s.top_k > 0 or s.top_p < 1.0
@@ -397,11 +908,26 @@ class PagedSlotEngine(SlotEngine):
             # chunk (module docstring, round-4 hardware lesson)
             self._free.extend(self._slot_pages.pop(slot, []))
             self._ptable[slot, :] = 0
+            self._slot_prompt.pop(slot, None)
+            ent = self._slot_prefix.pop(slot, None)
+            if ent is not None:
+                ent.refs -= 1  # dead-entry pages reclaim in step()
             self.stats["pages_free"] = len(self._free)
         return done
 
     def step(self) -> bool:
+        # registrations routed from caller threads run here, joining
+        # the donation chain that serializes pool programs
+        self._drain_px_cmds()
+        # grow-mode: existing slots' next-chunk pages outrank the new
+        # admissions super().step() is about to make on a tight pool
+        if self.reservation == "grow":
+            self._ensure_coverage(
+                {i: s for i, s in self._table.items() if s is not None})
         did = super().step()
+        # unregistered prefixes whose last reader just completed
+        if self._px_zombies:
+            self._reclaim_zombies()
         # deferred requests are invisible to the base loop's pending
         # check; retrying admission after processing may find released
         # pages (completions hide in processed chunks)
@@ -414,13 +940,15 @@ class PagedSlotEngine(SlotEngine):
         base engine's _die/close drains — they must fail with everything
         else, never hang a client on a 10-minute timeout."""
         deferred, self._deferred = self._deferred, []
-        for *_, handle in deferred:
-            handle._fail(err)
+        for req in deferred:
+            req[6]._fail(err)  # handle is index 6 in 7- and 8-tuples
 
     def _die(self, err: Exception) -> None:
         super()._die(err)
         self._fail_deferred(RuntimeError(f"engine failed: {err!r}"))
+        self._drain_px_cmds(err)
 
     def close(self, drain: float = 0.0) -> None:
         super().close(drain)
         self._fail_deferred(RuntimeError("engine closed"))
+        self._drain_px_cmds(RuntimeError("engine closed"))

@@ -38,6 +38,7 @@ class MetricsRegistry:
         self._hist_buckets: dict[str, tuple[float, ...]] = {}
         self._help: dict[str, tuple[str, str]] = {}  # name -> (type, help)
         self._gauge_fns: dict[str, Callable[[], float]] = {}
+        self._counter_fns: dict[str, Callable[[], float]] = {}
         self._label_names: dict[str, tuple[str, ...]] = {}
 
     def _series_key(self, name: str, labels: dict | None) -> tuple:
@@ -66,6 +67,17 @@ class MetricsRegistry:
             self._help.setdefault(name, ("gauge", help))
             self._gauge_fns[name] = fn
 
+    def counter_fn(self, name: str, fn: Callable[[], float],
+                   help: str = "") -> None:
+        """Register a pull-time COUNTER (a monotonically increasing
+        value owned elsewhere, e.g. an engine's completed-request
+        count). Rendered with TYPE counter so Prometheus consumers can
+        apply rate()/increase() with reset handling — exporting a
+        monotonic series as a gauge breaks exactly that."""
+        with self._lock:
+            self._help.setdefault(name, ("counter", help))
+            self._counter_fns[name] = fn
+
     def observe(self, name: str, value: float, labels: dict | None = None,
                 buckets: Iterable[float] = _DEFAULT_BUCKETS,
                 help: str = "") -> None:
@@ -92,6 +104,12 @@ class MetricsRegistry:
                     out.append(f"# HELP {name} {hlp}")
                 out.append(f"# TYPE {name} {typ}")
                 if typ == "counter":
+                    if name in self._counter_fns:
+                        try:
+                            v = float(self._counter_fns[name]())
+                        except Exception:  # pragma: no cover — never break /metrics
+                            continue
+                        out.append(f"{name} {v:g}")
                     for key, v in sorted(self._counters.get(name, {}).items()):
                         out.append(f"{name}{_fmt_labels(dict(key))} {v:g}")
                 elif typ == "gauge":
