@@ -56,6 +56,12 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--ep", type=int, default=1)
+    p.add_argument("--moe-dispatch", default="auto",
+                   choices=["auto", "gather", "einsum", "sort"],
+                   help="moe: presets only. auto (default) = gather on "
+                        "one device, sort on meshes (r5); einsum = the "
+                        "GSPMD all-to-all form, the escape hatch if "
+                        "multi-chip profiling favors it")
     p.add_argument("--optim", default="adamw", choices=["adamw", "adamw-int8"],
                    help="adamw-int8 stores both Adam moments as blockwise "
                         "int8 (halves optimizer HBM)")
@@ -113,6 +119,10 @@ def main(argv: list[str] | None = None) -> None:
     family, cfg = resolve_preset(args.preset)
     is_vit = family == "vit"
     is_encdec = family == "encdec"
+    if args.moe_dispatch != "auto":
+        if family != "moe":
+            raise SystemExit("--moe-dispatch applies to moe: presets only")
+        cfg = dataclasses.replace(cfg, dispatch_impl=args.moe_dispatch)
     if is_vit:
         if args.data or args.seq:
             raise SystemExit("--data/--seq do not apply to vit: presets "
