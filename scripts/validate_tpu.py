@@ -937,6 +937,196 @@ def check_tail_latency() -> bool:
     return ok
 
 
+def check_real_artifact_pipeline() -> bool:
+    """End-to-end product rehearsal (VERDICT r4 next #8): import →
+    quantize → fuse → serve exercised as ONE pipeline on real trained
+    weights, plus the orbax→export-CLI seam through the real
+    subprocess entrypoints — the closest this zero-egress environment
+    gets to the reference's run-real-workloads story.
+
+    Two legs, split by a measured platform reality: bulk device→host
+    over the axon tunnel moves ~22 MB/s (0.70 GiB in 32 s, measured
+    2026-08-01), so the 15 GB orbax train-state save of llama3-1b+Adam
+    is a ~12-minute operation — the PRODUCT-SCALE leg therefore trains
+    llama3-1b in-process and exports its params directly (3 GB
+    artifact, one d2h pass), while the trainer-CLI→orbax→export-CLI
+    chain runs as real subprocesses at a tunnel-feasible scale (tiny
+    preset). Every seam runs on hardware; only the redundant giant
+    save is avoided."""
+    import os
+    import shutil
+    import subprocess
+    import sys as _sys
+    import urllib.request
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    # PREPEND to PYTHONPATH — this environment registers its jax
+    # backend plugin via a sitecustomize dir already on the path, and
+    # overwriting would strand the subprocess without a backend
+    env = {**os.environ, "PYTHONPATH": os.pathsep.join(
+        p for p in (repo, os.environ.get("PYTHONPATH", "")) if p)}
+    ck, hf = "/tmp/ra_ck", "/tmp/ra_hf"
+    shutil.rmtree(ck, ignore_errors=True)
+    shutil.rmtree(hf, ignore_errors=True)
+    stages = {}
+    t0 = time.time()
+    try:
+        # leg A1: orbax → export CLI through the real entrypoints
+        r = subprocess.run(
+            [_sys.executable, "-m", "tpu_docker_api.train", "--preset",
+             "tiny", "--steps", "4", "--batch", "4", "--seq", "64",
+             "--ckpt-dir", ck, "--save-every", "4"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=600)
+        if r.returncode != 0:
+            return _emit("real_artifact_pipeline", False,
+                         stage="train-cli", error=r.stderr[-300:])
+        r = subprocess.run(
+            [_sys.executable, "-m",
+             "tpu_docker_api.models.import_weights", "--ckpt-dir", ck,
+             "--preset", "tiny", "--out", ck + "_hf", "--platform",
+             "cpu"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=600)
+        if r.returncode != 0:
+            return _emit("real_artifact_pipeline", False,
+                         stage="export-cli", error=r.stderr[-300:])
+        stages["cli_chain_s"] = round(time.time() - t0, 1)
+
+        # leg A2: product scale — train llama3-1b briefly in-process,
+        # export its params as the real 3 GB HF artifact
+        import gc
+
+        import jax
+
+        from tpu_docker_api.models.import_weights import export_hf_llama
+        from tpu_docker_api.models.llama import llama_presets
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+        from tpu_docker_api.train.trainer import (
+            create_train_state, make_train_step, synthetic_batch)
+
+        t1 = time.time()
+        cfg = llama_presets()["llama3-1b"]
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=1, sp=1),
+                          devices=jax.devices()[:1])
+        state, opt = create_train_state(cfg, mesh, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, mesh, opt)
+        toks = synthetic_batch(jax.random.PRNGKey(1), 2, 512,
+                               cfg.vocab_size)
+        for _ in range(8):
+            state, m = step(state, toks)
+        stages["train_loss"] = round(float(m["loss"]), 3)
+        stages["train_s"] = round(time.time() - t1, 1)
+        t2 = time.time()
+        export_hf_llama(state.params, cfg, hf)
+        stages["export_s"] = round(time.time() - t2, 1)
+        stages["artifact_gb"] = round(os.path.getsize(
+            os.path.join(hf, "model.safetensors")) / 2**30, 2)
+        # free the 15 GB train state before the serve subprocess loads
+        del state, step, opt, toks, m
+        gc.collect()
+        jax.clear_caches()
+        gc.collect()
+
+        # a real (tiny) tokenizer rides with the artifact
+        from tokenizers import Tokenizer as RustTokenizer
+        from tokenizers.models import WordLevel
+        from tokenizers.pre_tokenizers import Whitespace
+
+        words = ["<unk>", "the", "tpu", "serves", "real", "artifacts",
+                 "now", "fast"]
+        tok = RustTokenizer(WordLevel({w: i for i, w in
+                                       enumerate(words)},
+                                      unk_token="<unk>"))
+        tok.pre_tokenizer = Whitespace()
+        tok.save(os.path.join(hf, "tokenizer.json"))
+
+        # leg B: serve the artifact — --hf-ckpt + int8-at-load + text
+        t3 = time.time()
+        proc = subprocess.Popen(
+            [_sys.executable, "-u", "-m", "tpu_docker_api.serve",
+             "--hf-ckpt", hf, "--quantize", "--host", "127.0.0.1",
+             "--port", "0", "--slots", "8", "--chunk", "8",
+             "--max-seq", "512"],
+            cwd=repo, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        port = None
+        try:
+            import select
+
+            deadline = time.time() + 900
+            lines = []
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    # drain the pipe first — the traceback TAIL is the
+                    # useful part of a startup crash
+                    rest = proc.stdout.read() or ""
+                    return _emit(
+                        "real_artifact_pipeline", False, stage="serve",
+                        error=("".join(lines) + rest)[-300:])
+                # select-bounded read: a silently-hung serve must trip
+                # the deadline, not block readline() forever
+                ready, _, _ = select.select([proc.stdout], [], [], 5.0)
+                if not ready:
+                    continue
+                line = proc.stdout.readline()
+                if line == "":  # EOF with a live process: don't spin
+                    time.sleep(1.0)
+                    continue
+                lines.append(line)
+                if '"event": "serving"' in line:
+                    port = json.loads(line)["port"]
+                    break
+            if port is None:
+                return _emit("real_artifact_pipeline", False,
+                             stage="serve", error="never ready")
+            stages["serve_ready_s"] = round(time.time() - t3, 1)
+            body = json.dumps({
+                "text": ["the tpu serves real artifacts"] * 8,
+                "maxNewTokens": 32, "temperature": 0.0}).encode()
+
+            def burst():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=600) as resp:
+                    return json.loads(resp.read())
+
+            # burst 1 compiles the R=8 prefill variant (serve only
+            # pre-warms the decode chunk — measured 59 s of XLA compile
+            # landing in the first burst's TTFT on the first capture);
+            # burst 2 is the steady-state number
+            burst()
+            t4 = time.time()
+            out = burst()
+            dt = time.time() - t4
+            n_tok = sum(out["lengths"])
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=30) as resp:
+                h = json.loads(resp.read())
+            ok = (n_tok == 8 * 32 and len(out.get("texts", [])) == 8
+                  and h["quantized"] and h["tokenizer"]
+                  and h["slotEngine"]["completed"] >= 16)
+            return _emit(
+                "real_artifact_pipeline", ok, **stages,
+                streams=8, new_tokens=32,
+                serving_tok_s=round(n_tok / dt, 1),
+                texts_decoded=len(out.get("texts", [])),
+                ttft_p50_ms=h["slotEngine"]["latency"]["ttft_p50_ms"],
+                total_s=round(time.time() - t0, 1))
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    finally:
+        shutil.rmtree(ck, ignore_errors=True)
+        shutil.rmtree(ck + "_hf", ignore_errors=True)
+        shutil.rmtree(hf, ignore_errors=True)
+
+
 def check_qlora_8b() -> bool:
     """QLoRA at the north-star size (round 4): llama3-8b with an int8
     frozen base and rank-16 adapters trains on ONE chip — the unmerged
@@ -1026,6 +1216,7 @@ def main() -> int:
         checks.append(check_encdec_slot_serving_trained)
         checks.append(check_tail_latency)
         checks.append(check_qlora_8b)
+        checks.append(check_real_artifact_pipeline)
     ok = True
     for check in checks:
         try:
