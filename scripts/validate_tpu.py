@@ -1078,8 +1078,9 @@ def check_real_artifact_pipeline() -> bool:
                     port = json.loads(line)["port"]
                     break
             if port is None:
-                return _emit("real_artifact_pipeline", False,
-                             stage="serve", error="never ready")
+                return _emit(
+                    "real_artifact_pipeline", False, stage="serve",
+                    error="never ready: " + "".join(lines)[-280:])
             stages["serve_ready_s"] = round(time.time() - t3, 1)
             body = json.dumps({
                 "text": ["the tpu serves real artifacts"] * 8,

@@ -588,3 +588,66 @@ class TestPinnedPageValidation:
             eng.step()
             # with the pins released the same request now validates
             eng.validate([1] * 30, 16)
+
+
+class TestPagedTensorParallel:
+    """Paged engine on a tp mesh (r5 — VERDICT r4 next #3 secondary):
+    the pool's kv-head dim shards over tp like the dense cache; the
+    page table stays a replicated host operand. f32 config so
+    mesh-vs-unsharded is numerically tight (the TestMeshEngine rule:
+    in bf16 the tp collectives' reduction order rounds logits ~1e-2
+    apart and random-init near-tie argmaxes flip — a numerics
+    artifact, not a sharding bug; observed here at token 22 of a
+    30-token decode before switching to f32). Prefix sharing and
+    growth ride along."""
+
+    def _setup_f32(self):
+        import dataclasses
+
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+        from tpu_docker_api.parallel.sharding import (
+            LLAMA_RULES, param_shardings)
+
+        cfg = llama_presets()["tiny"]
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+        params = llama_init(cfg, jax.random.PRNGKey(7))
+        mesh = build_mesh(MeshPlan(dp=1, fsdp=1, tp=2, sp=1),
+                          devices=jax.devices()[:2])
+        params_s = jax.device_put(
+            params, param_shardings(params, mesh, LLAMA_RULES))
+        return cfg, params, params_s, mesh
+
+    def test_tp_mesh_token_exact(self):
+        cfg, params, params_s, mesh = self._setup_f32()
+        eng = PagedSlotEngine(cfg, params_s, mesh=mesh, page_size=PAGE,
+                              slots=4, max_seq=MAX_SEQ, chunk=4)
+        prompts = [[2, 7, 1], [9] * 20, [5, 5], [1, 2, 3, 4, 5]]
+        handles = [eng.submit(p, 10) for p in prompts]
+        run_all(eng, handles)
+        for p, h in zip(prompts, handles):
+            assert h.result(0)["tokens"] == isolated_greedy(
+                cfg, params, p, 10)  # unsharded single-device reference
+
+    def test_tp_mesh_prefix_and_growth(self):
+        cfg, params, params_s, mesh = self._setup_f32()
+        eng = PagedSlotEngine(cfg, params_s, mesh=mesh, page_size=PAGE,
+                              slots=2, max_seq=MAX_SEQ, chunk=4,
+                              total_pages=6)
+        px = list(range(7, 7 + 20))
+        eng.register_prefix(px)
+        h = eng.submit(px + [42], 30)  # decode crosses page boundaries
+        run_all(eng, [h])
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["grown_pages"] >= 1
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, px + [42], 30)
+        assert eng.stats["pages_free"] == eng.stats["pages_total"] - 1
+
+    def test_dp_mesh_still_rejected(self, setup):
+        cfg, params = setup
+        from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
+
+        mesh = build_mesh(MeshPlan(dp=2, fsdp=1, tp=1, sp=1),
+                          devices=jax.devices()[:2])
+        with pytest.raises(ValueError, match="tp/fsdp"):
+            PagedSlotEngine(cfg, params, page_size=PAGE, mesh=mesh)

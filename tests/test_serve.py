@@ -731,3 +731,32 @@ class TestServingSLOMetrics:
         finally:
             p.terminate()
             p.wait(timeout=30)
+
+
+class TestPagedTensorParallelServing:
+    def test_page_size_on_tp_mesh(self):
+        """r5: --page-size composes with --tp — the pool's kv-head dim
+        shards over the mesh and /prefixes still works."""
+        p, port = _spawn_server(
+            ["--preset", "tiny", "--max-seq", "64", "--slots", "4",
+             "--chunk", "4", "--page-size", "16", "--total-pages", "12",
+             "--virtual-devices", "2", "--tp", "2"])
+        try:
+            h = _get(port, "/healthz")
+            assert h["devices"] == 2
+            assert h["slotEngine"]["pages_total"] == 12
+            out = _post(port, "/generate",
+                        {"tokens": [[1, 2, 3, 4], [9, 8]],
+                         "maxNewTokens": 6, "temperature": 0.0})
+            assert [len(r) for r in out["tokens"]] == [6, 6]
+            px = list(range(2, 20))
+            _post(port, "/prefixes", {"tokens": px})
+            out = _post(port, "/generate",
+                        {"tokens": [px + [21]], "maxNewTokens": 4,
+                         "temperature": 0.0})
+            assert len(out["tokens"][0]) == 4
+            assert (_get(port, "/healthz")["slotEngine"]["prefix_hits"]
+                    >= 1)
+        finally:
+            p.terminate()
+            p.wait(timeout=30)

@@ -76,9 +76,14 @@ SHARED PAGES, and the page-alignment choice is what keeps it simple:
   the last live reader completes — a zombie list the engine loop
   reclaims, mirroring how slot completions release private pages.
 
-v1 scope remaining: llama-family, single device, whole-prompt
-admission (no ``prefill_chunk``), no speculative composition — each
-raises explicitly rather than degrading.
+Tensor-parallel meshes compose (r5): the pool's kv-head dim shards
+over tp exactly like the dense cache, page scatter/gather stay local
+to each shard (they are elementwise in the sharded dim), and the page
+table remains a replicated host operand.
+
+v1 scope remaining: llama-family, whole-prompt admission (no
+``prefill_chunk``), no speculative composition — each raises
+explicitly rather than degrading.
 """
 
 from __future__ import annotations
@@ -133,8 +138,9 @@ class PagedSlotEngine(SlotEngine):
         if not isinstance(cfg, LlamaConfig):
             raise ValueError(
                 "the paged engine serves llama-family configs only (v1)")
-        if kwargs.get("mesh") is not None:
-            raise ValueError("the paged engine is single-device (v1)")
+        # r5: tensor-parallel meshes compose — the pool's kv-head dim
+        # shards over tp exactly like the dense cache (base __init__
+        # validates tp/fsdp-only); dp/sp stay rejected there
         if kwargs.get("prefill_chunk"):
             raise ValueError(
                 "chunked prefill is not supported on the paged engine "
@@ -199,11 +205,21 @@ class PagedSlotEngine(SlotEngine):
         # page 0 = trash; free list pops from the low end so tests can
         # predict reuse order
         self._free = list(range(usable, 0, -1))
-        shape = (cfg.n_layers, usable + 1, self.page_size,
-                 cfg.n_kv_heads, cfg.head_dim)
         self._ptable = np.zeros(
             (self.slots, self._max_pages_per_slot), np.int32)
-        return jnp.zeros(shape, cache_dtype), jnp.zeros(shape, cache_dtype)
+        # pool rows are PAGES (usable + trash page 0), page_size is the
+        # position dim; kv-heads shard over tp exactly like the dense
+        # cache (same init_kv_cache seam + spec as the dense override —
+        # the table stays a replicated host operand, so page ids mean
+        # the same thing on every shard)
+        from jax.sharding import PartitionSpec
+        from tpu_docker_api.infer.engine import init_kv_cache
+
+        cache = init_kv_cache(
+            self.cfg, usable + 1, self.page_size, mesh=self.mesh,
+            dtype=cache_dtype,
+            spec=PartitionSpec(None, None, None, "tp", None))
+        return cache.k, cache.v
 
     def _pages_needed(self, prompt_len: int, max_new: int,
                       bucket: int) -> int:
@@ -543,7 +559,7 @@ class PagedSlotEngine(SlotEngine):
             kc = jnp.zeros(shape, cache_dtype)
             vc = jnp.zeros(shape, cache_dtype)
             logits, kc, vc = fwd(params, prompts, cfg, kc, vc,
-                                 jnp.int32(0), None,
+                                 jnp.int32(0), self.mesh,
                                  last_only=actual_lens - 1)
             toks = self._sample_filtered(
                 logits[:, 0], temps, topks, topps,
@@ -585,7 +601,7 @@ class PagedSlotEngine(SlotEngine):
             kc = jnp.zeros(shape, cache_dtype)
             vc = jnp.zeros(shape, cache_dtype)
             _, kc, vc = fwd(params, prompt, cfg, kc, vc, jnp.int32(0),
-                            None, last_only=True)
+                            self.mesh, last_only=True)
             src_k = kc[:, 0, :npx * page].reshape(
                 L, npx, page, cfg.n_kv_heads, cfg.head_dim)
             src_v = vc[:, 0, :npx * page].reshape(
@@ -632,7 +648,7 @@ class PagedSlotEngine(SlotEngine):
             # rationale as the dense engine's _px_prefill_fn
             starts = jnp.full((rows,), P_, jnp.int32)
             logits, kc, vc = fwd(params, prompts, cfg, kc, vc, starts,
-                                 None, last_only=actual_lens - 1)
+                                 self.mesh, last_only=actual_lens - 1)
             toks = self._sample_filtered(
                 logits[:, 0], temps, topks, topps,
                 jax.random.PRNGKey(seed))
@@ -671,7 +687,7 @@ class PagedSlotEngine(SlotEngine):
                 tok, pos, kp, vp = carry
                 logits, kp, vp = llama_forward_paged(
                     params, tok[:, None], cfg, kp, vp, table, pos,
-                    max_pos=max_pos)
+                    max_pos=max_pos, mesh=self.mesh)
                 if filtered:
                     nxt = self._sample_filtered(
                         logits[:, -1], dtemp, dtopk, dtopp, step_key)

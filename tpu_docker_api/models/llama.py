@@ -405,20 +405,24 @@ def llama_forward_paged(
     table: jnp.ndarray,       # (S, mp) int32 page ids; 0 = trash
     pos: jnp.ndarray,         # (S,) int32 per-slot positions
     max_pos: int,             # position capacity (sizes rope tables)
+    mesh: Mesh | None = None,  # tp mesh: pool kv-head dim sharded
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Paged-KV decode step: logits (S, 1, vocab) + updated pools. Block
     math is ``_block`` via the shared skeleton — only the cache write
     (page scatter) and read (page gather, ops/paged.py) differ from
-    ``llama_forward_cached``. Single-device (infer/paged.py's scope)."""
+    ``llama_forward_cached``. On a tp ``mesh`` the pools arrive with
+    their kv-head dim sharded (infer/paged.py _alloc_cache) and the
+    page scatter/gather are per-head-elementwise in that dim, so GSPMD
+    keeps them local to each shard — same rule as the dense cache."""
     def block_fn(x, layer, cache, rope_cos, rope_sin):
         kc, vc, layer_idx = cache
         ref = PagedRef(k_pool=kc, v_pool=vc, layer_idx=layer_idx,
                        table=table)
-        return _block(x, layer, cfg, rope_cos, rope_sin, None,
+        return _block(x, layer, cfg, rope_cos, rope_sin, mesh,
                       cache=ref, start_pos=pos)
 
     return decoder_forward_cached(
-        params, tokens, cfg, k_pool, v_pool, None, False, block_fn,
+        params, tokens, cfg, k_pool, v_pool, mesh, False, block_fn,
         max_pos=max_pos)
 
 

@@ -145,7 +145,8 @@ def main(argv: list[str] | None = None) -> None:
                    help="> 0: paged KV cache (infer/paged.py) — the "
                         "slot cache becomes a page pool and HBM scales "
                         "with --total-pages instead of slots×max-seq. "
-                        "llama presets, single device; /prefixes "
+                        "llama presets, single device or tp-only mesh "
+                        "(r5: kv-heads shard over tp); /prefixes "
                         "compose via refcounted shared pages (r5); "
                         "excludes --prefill-chunk, --draft-preset")
     p.add_argument("--total-pages", type=int, default=0,
@@ -350,7 +351,7 @@ def main(argv: list[str] | None = None) -> None:
         # erroring beats silently serving on the legacy dense path
         raise SystemExit(
             "--page-size requires the slot-engine path (llama preset, "
-            "--slots > 0, single device)")
+            "--slots > 0, single device or tp-only mesh)")
     if is_encdec and args.slots > 0 and not multi:
         # seq2seq continuous batching (round 4): sources may be ragged,
         # decode runs through the same slot machinery as llama/moe; the
@@ -412,15 +413,18 @@ def main(argv: list[str] | None = None) -> None:
         elif args.page_size > 0:
             from tpu_docker_api.infer.paged import PagedSlotEngine
 
-            if family != "llama" or multi:
+            if family != "llama":
                 raise SystemExit(
-                    "--page-size requires a llama preset on a single "
-                    "device (paged engine v1 scope)")
+                    "--page-size requires a llama preset "
+                    "(paged engine v1 scope)")
+            # r5: tp-only meshes compose — the pool's kv-head dim
+            # shards over tp, the page table stays a host operand
             slot_engine = PagedSlotEngine(
                 cfg, params, page_size=args.page_size,
                 total_pages=args.total_pages or None,
                 slots=args.slots, max_seq=max_seq, chunk=args.chunk,
                 max_pending=args.slots * 8,
+                mesh=mesh if multi else None,
                 seed=int.from_bytes(os.urandom(4), "little"))
         else:
             slot_engine = SlotEngine(
