@@ -372,3 +372,33 @@ class TestTokenizer:
                               ["<unk>", "a", "b"])
         tok = load_tokenizer(str(tmp_path))
         assert tok.encode("b a") == [2, 1]
+
+
+def test_llama31_preset_matches_real_checkpoint_import():
+    """The llama31-8b preset must equal, field for field, what
+    importing a verbatim Meta-Llama-3.1-8B config.json produces — the
+    preset exists to assert against --hf-ckpt imports, so ANY drift
+    (the r5 review caught max_seq_len at 8192 vs the real 131072) must
+    fail here."""
+    import tempfile
+
+    from tpu_docker_api.models.llama import llama_presets
+
+    cfg_json = {
+        "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+        "vocab_size": 128256, "hidden_size": 4096,
+        "num_hidden_layers": 32, "num_attention_heads": 32,
+        "num_key_value_heads": 8, "intermediate_size": 14336,
+        "max_position_embeddings": 131072, "rope_theta": 500000.0,
+        "rms_norm_eps": 1e-05,
+        "rope_scaling": dict(_LLAMA31_SCALING),
+    }
+    with tempfile.TemporaryDirectory() as d:
+        (pathlib := __import__("pathlib")).Path(
+            d, "config.json").write_text(json.dumps(cfg_json))
+        parsed = hf_llama_config(d)
+    preset = llama_presets()["llama31-8b"]
+    for f in ("vocab_size", "dim", "n_layers", "n_heads", "n_kv_heads",
+              "ffn_dim", "max_seq_len", "rope_theta", "norm_eps",
+              "rope_scaling"):
+        assert getattr(parsed, f) == getattr(preset, f), f

@@ -35,7 +35,8 @@ from tpu_docker_api.ops.attention import dense_attention, multihead_attention
 from tpu_docker_api.ops.paged import PagedRef, gather_pages, paged_write
 from tpu_docker_api.ops.norms import rms_norm
 from tpu_docker_api.ops.quant import linear
-from tpu_docker_api.ops.rope import apply_rope, rope_frequencies
+from tpu_docker_api.ops.rope import (RopeScaling, apply_rope,
+                                     rope_frequencies)
 from tpu_docker_api.parallel.sharding import constrain
 
 
@@ -93,6 +94,21 @@ def llama_presets() -> dict[str, LlamaConfig]:
         "llama3-1b": LlamaConfig(
             vocab_size=128256, dim=2048, n_layers=16, n_heads=32,
             n_kv_heads=8, ffn_dim=8192, max_seq_len=8192,
+        ),
+        # llama-3.1 8B: the geometry of llama3-8b plus the llama3
+        # rope_scaling block and the 128k context every real 3.1
+        # checkpoint carries (r5, ops/rope.py) — the preset to assert
+        # against --hf-ckpt imports of Meta-Llama-3.1-8B config.json
+        # files, so every field must match what importing one
+        # produces. Serving/training pick their own working --max-seq;
+        # this field is the model's ADDRESSABLE context, not a cache
+        # size.
+        "llama31-8b": LlamaConfig(
+            vocab_size=128256, dim=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, ffn_dim=14336, max_seq_len=131072,
+            rope_scaling=RopeScaling(
+                factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
+                original_max_position_embeddings=8192),
         ),
         # single-v5e-chip bench config (fits 16GB HBM with optimizer state;
         # head_dim 128 so the Pallas flash path tiles cleanly on the MXU)
