@@ -258,9 +258,9 @@ class TestScope:
         cfg, params = setup
         with pytest.raises(ValueError, match="power of two"):
             PagedSlotEngine(cfg, params, page_size=3)
-        with pytest.raises(ValueError, match="chunked prefill"):
-            PagedSlotEngine(cfg, params, page_size=PAGE,
-                            prefill_chunk=8)
+        # r5: chunked prefill composes (TestPagedChunkedPrefill) — the
+        # construction that used to reject must now build cleanly
+        PagedSlotEngine(cfg, params, page_size=PAGE, prefill_chunk=8)
         eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
                               max_seq=MAX_SEQ, chunk=4)
         # r5: prefix caching is supported — but a sub-page prefix shares
@@ -651,3 +651,177 @@ class TestPagedTensorParallel:
                           devices=jax.devices()[:2])
         with pytest.raises(ValueError, match="tp/fsdp"):
             PagedSlotEngine(cfg, params, page_size=PAGE, mesh=mesh)
+
+
+class TestPagedChunkedPrefill:
+    """Chunked prefill × paged (r5 — the third of the v1 exclusions to
+    fall): segments gather the slot's pages into a dense temp row,
+    prefill at the offset, and scatter every covered page back; parked
+    lanes route to the trash page via paged_write's beyond-view bound
+    (position maxp·page — max_seq itself is unsafe when not
+    page-aligned)."""
+
+    def test_long_prompt_segments_token_exact(self, setup):
+        """A prompt past the largest prefill bucket serves via
+        segmentation, token-exact, while a short stream decodes
+        through the interleaved chunks."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=3,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,))
+        long_p = list(range(3, 43))   # 40 > bucket 32
+        short_p = [5, 6, 7]
+        h1 = eng.submit(long_p, 12)
+        h2 = eng.submit(short_p, 12)
+        run_all(eng, [h1, h2])
+        assert h1.result(0)["tokens"] == isolated_greedy(
+            cfg, params, long_p, 12)
+        assert h2.result(0)["tokens"] == isolated_greedy(
+            cfg, params, short_p, 12)
+        assert eng.stats["segment_prefills"] >= 5
+        assert eng.stats["pages_free"] == eng.stats["pages_total"]
+
+    def test_short_prompts_skip_segmentation(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=16)
+        h = eng.submit([1, 2, 3], 8)  # 3 <= prefill_chunk: one dispatch
+        run_all(eng, [h])
+        assert eng.stats["segment_prefills"] == 0
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [1, 2, 3], 8)
+
+    def test_segments_grow_pages_and_release(self, setup):
+        """Grow-mode: a chunked admission reserves ZERO pages; every
+        page arrives with its segment and all return at completion."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,),
+                              total_pages=5)
+        h = eng.submit(list(range(2, 42)), 8)  # 40 tokens → 3 pages
+        eng.step()  # admission reserves NOTHING; the same step's first
+        #             segment claims exactly its one page — not the 3
+        #             a full reservation would pin
+        assert eng.stats["pages_free"] == 4
+        run_all(eng, [h])
+        assert eng.stats["grown_pages"] >= 3
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, list(range(2, 42)), 8)
+        assert eng.stats["pages_free"] == 5
+
+    def test_full_reservation_mode_chunks_too(self, setup):
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,),
+                              reservation="full")
+        p = list(range(2, 40))
+        h = eng.submit(p, 10)
+        eng.step()
+        # full mode pins the whole need up front
+        held = eng.stats["pages_total"] - eng.stats["pages_free"]
+        assert held >= 3
+        run_all(eng, [h])
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, p, 10)
+
+    def test_segment_pressure_preempts_decoder(self, setup):
+        """Pool pressure between a senior decoder and a junior
+        segmenter resolves by seniority-scoped preemption (the senior's
+        growth takes the junior's pages, never the reverse); both
+        requests still finish token-exact with all pages returned."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,),
+                              total_pages=4)
+        ha = eng.submit([9] * 30, 20)            # decoder: 2-3 pages
+        for _ in range(3):
+            eng.step()
+        hb = eng.submit(list(range(2, 42)), 8)   # segmenter needs 3
+        run_all(eng, [ha, hb], limit=900)
+        assert eng.stats["preemptions"] >= 1
+        assert ha.result(0)["tokens"] == isolated_greedy(
+            cfg, params, [9] * 30, 20)
+        assert hb.result(0)["tokens"] == isolated_greedy(
+            cfg, params, list(range(2, 42)), 8)
+        assert eng.stats["pages_free"] == eng.stats["pages_total"]
+
+    def test_preempted_long_restore_rechunks(self, setup):
+        """A preempted decode slot whose prompt+progress exceeds the
+        largest bucket restores THROUGH segmentation — with
+        prefill_chunk on, no restore is ever non-admissible."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,),
+                              total_pages=6)
+        pa = list(range(3, 31))                   # 28 tokens
+        ha = eng.submit(pa, 30)                   # will reach 58 > 32
+        for _ in range(6):
+            eng.step()
+        hb = eng.submit(list(range(2, 34)), 20)   # pressure
+        run_all(eng, [ha, hb], limit=1200)
+        assert ha.result(0)["tokens"] == isolated_greedy(
+            cfg, params, pa, 30)
+        assert hb.result(0)["tokens"] == isolated_greedy(
+            cfg, params, list(range(2, 34)), 20)
+
+
+class TestChunkedPagedReviewRegressions:
+    """Pins for the r5 review findings on the chunked×paged seams."""
+
+    def test_two_segmenters_tight_pool_both_complete(self, setup):
+        """Rotation must advance past a page-stalled junior (review: a
+        stalled junior re-picked forever starves the page-holding
+        senior — both hang)."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,),
+                              total_pages=3)
+        pa, pb = list(range(3, 43)), list(range(50, 90))
+        ha = eng.submit(pa, 6)
+        hb = eng.submit(pb, 6)
+        run_all(eng, [ha, hb], limit=1500)
+        assert ha.result(0)["tokens"] == isolated_greedy(
+            cfg, params, pa, 6)
+        assert hb.result(0)["tokens"] == isolated_greedy(
+            cfg, params, pb, 6)
+        assert eng.stats["pages_free"] == eng.stats["pages_total"]
+
+    def test_validate_uses_chunked_need(self, setup):
+        """A chunk-routed request is feasibility-checked with the
+        segment path's need, not the bucket-rounded one (review:
+        bucket rounding rejected servable requests)."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(64,),
+                              total_pages=3)
+        # bucket-rounded need = ceil(64/16) = 4 > 3 would reject;
+        # chunked need = ceil((40+8-1)/16) = 3 fits — and serves
+        h = eng.submit(list(range(2, 42)), 8)
+        run_all(eng, [h], limit=900)
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, list(range(2, 42)), 8)
+
+    def test_long_suffix_prefix_hit_segments(self, setup):
+        """A registered-prefix hit whose suffix exceeds prefill_chunk
+        falls through to segmentation (bounded-stall contract), still
+        token-exact."""
+        cfg, params = setup
+        eng = PagedSlotEngine(cfg, params, page_size=PAGE, slots=2,
+                              max_seq=MAX_SEQ, chunk=4,
+                              prefill_chunk=8, buckets=(32,))
+        px = list(range(7, 7 + 16))
+        eng.register_prefix(px)
+        prompt = px + list(range(40, 64))  # suffix 24 > prefill_chunk 8
+        h = eng.submit(prompt, 8)
+        run_all(eng, [h])
+        assert eng.stats["segment_prefills"] >= 2  # segmented, not px
+        assert eng.stats["prefix_hits"] == 0
+        assert h.result(0)["tokens"] == isolated_greedy(
+            cfg, params, prompt, 8)

@@ -760,3 +760,26 @@ class TestPagedTensorParallelServing:
         finally:
             p.terminate()
             p.wait(timeout=30)
+
+
+class TestPagedChunkedPrefillServing:
+    def test_page_size_with_prefill_chunk(self):
+        """r5: --page-size composes with --prefill-chunk — a prompt
+        past the largest prefill bucket serves through page-aware
+        segments."""
+        p, port = _spawn_server(
+            ["--preset", "tiny", "--max-seq", "96", "--slots", "4",
+             "--chunk", "4", "--page-size", "16", "--total-pages", "16",
+             "--prefill-chunk", "8"])
+        try:
+            long_prompt = list(range(3, 43))  # 40 tokens
+            out = _post(port, "/generate",
+                        {"tokens": [long_prompt], "maxNewTokens": 6,
+                         "temperature": 0.0})
+            assert len(out["tokens"][0]) == 6
+            h = _get(port, "/healthz")["slotEngine"]
+            assert h["segment_prefills"] >= 1
+            assert h["pages_free"] == h["pages_total"]
+        finally:
+            p.terminate()
+            p.wait(timeout=30)

@@ -18,11 +18,16 @@ batching, sampling, drain — is inherited):
   claims the pages its write reach needs (the per-slot form of
   ``_reach_bound``), so a request promising max_new=2048 but emitting
   10 tokens never pins 2048 tokens of pool. When the pool runs dry at
-  a growth edge, the LOWEST-PROGRESS slot is preempted with exact
-  restore: its host-resolved tokens requeue at the deferred queue's
-  front as ``prompt + carry`` and re-prefill — greedy continuations
-  are token-identical, clients never see the swap, and growth for
-  existing slots outranks new admissions. ``reservation="full"``
+  a growth edge, the lowest-progress slot JUNIOR to the requester (by
+  submit time) is preempted with exact restore: its host-resolved
+  tokens requeue at the deferred queue's front as ``prompt + carry``
+  and re-prefill — greedy continuations are token-identical, clients
+  never see the swap, and growth for existing slots outranks new
+  admissions. Seniority-scoping is what makes preemption TERMINATE:
+  juniors can never take a senior's pages, so the oldest request
+  strictly progresses and the system drains FCFS under pressure (the
+  unscoped lowest-progress rule livelocked two requests preempting
+  each other, observed and fixed in r5). ``reservation="full"``
   keeps the r4 worst-case up-front policy (escape hatch / A/B
   baseline). Admission stays strict FCFS either way: the deferred
   queue is always served first, no leapfrogging starvation.
@@ -81,9 +86,14 @@ over tp exactly like the dense cache, page scatter/gather stay local
 to each shard (they are elementwise in the sharded dim), and the page
 table remains a replicated host operand.
 
-v1 scope remaining: llama-family, whole-prompt admission (no
-``prefill_chunk``), no speculative composition — each raises
-explicitly rather than degrading.
+Chunked prefill composes too (r5): segments gather the slot's pages
+into a dense temp row, prefill at the absolute offset, and scatter
+every covered page back; parked lanes route to the trash page via
+``paged_write``'s beyond-view bound, and segment page-claims follow
+the same seniority-scoped pressure rules as decode growth.
+
+v1 scope remaining: llama-family, no speculative composition — each
+raises explicitly rather than degrading.
 """
 
 from __future__ import annotations
@@ -141,10 +151,10 @@ class PagedSlotEngine(SlotEngine):
         # r5: tensor-parallel meshes compose — the pool's kv-head dim
         # shards over tp exactly like the dense cache (base __init__
         # validates tp/fsdp-only); dp/sp stay rejected there
-        if kwargs.get("prefill_chunk"):
-            raise ValueError(
-                "chunked prefill is not supported on the paged engine "
-                "(v1 scope: whole-prompt admission)")
+        # r5: chunked prefill composes — segments gather the slot's
+        # pages into a dense temp row, prefill at the offset, and
+        # scatter every covered page back; parked lanes route to the
+        # trash page via paged_write's beyond-view bound
         if page_size < 1 or (page_size & (page_size - 1)):
             raise ValueError(
                 f"page_size must be a power of two, got {page_size}")
@@ -245,7 +255,16 @@ class PagedSlotEngine(SlotEngine):
         with self._lock:
             pinned = sum(len(e.page_ids)
                          for e in self._prefixes.values())
-        if plan is not None:
+        sfx_len = (len(prompt) - plan[0].shared_len
+                   if plan is not None else len(prompt))
+        chunked_route = self.prefill_chunk and (
+            sfx_len > self.prefill_chunk
+            or len(prompt) > self.buckets[-1])
+        if chunked_route:
+            # _admit will serve this through page-aware segments —
+            # whose worst-case need has no bucket-rounding term
+            need = _ceil_div(len(prompt) + max_new - 1, self.page_size)
+        elif plan is not None:
             ent, sbucket = plan
             need = self._px_pages_needed(len(prompt), max_new, ent,
                                          sbucket)
@@ -253,15 +272,21 @@ class PagedSlotEngine(SlotEngine):
             bucket = next((b for b in self.buckets
                            if b >= len(prompt)), None)
             if bucket is None:
-                # base validate admitted this length via a prefix that
-                # no longer resolves (concurrent unregister) — the
-                # admission-time re-resolve fails the handle; here the
-                # request can still never fit a prefill bucket
-                raise ValueError(
-                    f"prompt ({len(prompt)}) exceeds the largest "
-                    f"prefill bucket ({self.buckets[-1]}) and no "
-                    f"registered prefix covers it")
-            need = self._pages_needed(len(prompt), max_new, bucket)
+                if not self.prefill_chunk:
+                    # base validate admitted this length via a prefix
+                    # that no longer resolves (concurrent unregister) —
+                    # the admission-time re-resolve fails the handle;
+                    # here the request can still never fit a bucket
+                    raise ValueError(
+                        f"prompt ({len(prompt)}) exceeds the largest "
+                        f"prefill bucket ({self.buckets[-1]}) and no "
+                        f"registered prefix covers it")
+                # chunked admission: segments cover the prompt, so the
+                # full need has no bucket-rounding term
+                need = _ceil_div(len(prompt) + max_new - 1,
+                                 self.page_size)
+            else:
+                need = self._pages_needed(len(prompt), max_new, bucket)
         if need > self._usable_pages - pinned:
             raise ValueError(
                 f"request needs {need} pages "
@@ -478,11 +503,23 @@ class PagedSlotEngine(SlotEngine):
             need = (_ceil_div(target, page) - shared
                     - len(self._slot_pages[i]))
             while need > len(self._free):
-                victim = self._pick_victim(snap)
+                victim = self._pick_victim(snap, st)  # junior decoders
+                if victim is None:
+                    victim = self._junior_prefiller(st)
+                    if victim is not None:
+                        self._preempt(victim, self._table[victim])
+                        continue
+                    # no junior anywhere holds pages: this slot is the
+                    # junior-most — self-preempt (an ungrowable slot
+                    # must not dispatch: its beyond-allocation writes
+                    # would silently land in the trash page and
+                    # corrupt ITS OWN stream) and wait at the deferred
+                    # front for a senior to finish
+                    self._preempt(i, st)
+                    snap[i] = None
+                    break
                 self._preempt(victim, snap[victim])
                 snap[victim] = None
-                if victim == i:
-                    break
             if snap.get(i) is None or need <= 0:
                 continue
             pages = [self._free.pop() for _ in range(need)]
@@ -493,19 +530,48 @@ class PagedSlotEngine(SlotEngine):
             self.stats["grown_pages"] += need
             self.stats["pages_free"] = len(self._free)
 
-    def _pick_victim(self, snap: dict) -> int:
-        """Lowest host-known progress (cheapest restore), preferring
-        slots whose restored prompt still fits a prefill bucket. A
-        non-restorable victim (prompt+progress past the largest bucket
-        — only reachable with a truncated explicit bucket list) is the
-        last resort: its re-admission fails that handle loudly, which
-        beats deadlocking every stream on an overcommitted pool."""
+    def _junior_prefiller(self, st) -> int | None:
+        """The most junior PREFILLING slot strictly younger than
+        ``st``'s request, or None. Mid-prefill preemption is safe —
+        nothing has been emitted, so the restore is exactly the
+        admission request (minus the lost prefill work)."""
+        mine = st.handle.submitted_at or 0.0
+        cands = {j: s for j, s in self._table.items()
+                 if s is not None and s.pending is not None
+                 and s is not st
+                 and (s.handle.submitted_at or 0.0) > mine
+                 # a zero-page victim frees nothing — preempting it
+                 # would only wipe its prefill progress
+                 and self._slot_pages.get(j)}
+        if not cands:
+            return None
+        return max(cands,
+                   key=lambda j: cands[j].handle.submitted_at or 0.0)
+
+    def _pick_victim(self, snap: dict, requester=None) -> int | None:
+        """Preemption victim under pool pressure: among slots JUNIOR
+        to the requester (by submit time — seniority is what makes
+        preemption terminate: juniors can never take a senior's pages,
+        so the oldest request strictly progresses and the system
+        drains FCFS), the LOWEST host-known progress (cheapest
+        restore, the VERDICT's valve), preferring slots whose restored
+        prompt still fits a prefill bucket (a non-restorable victim's
+        re-admission fails that handle loudly unless chunked prefill
+        is on, which re-admits any length). None when no junior
+        exists — the requester must then self-preempt or wait."""
+        mine = (0.0 if requester is None
+                else requester.handle.submitted_at or 0.0)
         live = [j for j, s in snap.items()
-                if s is not None and self._table.get(j) is s]
+                if s is not None and self._table.get(j) is s
+                and (requester is None
+                     or (s.handle.submitted_at or 0.0) > mine)]
+        if not live:
+            return None
         big = self.buckets[-1]
 
         def restorable(j):
-            return (len(self._slot_prompt[j]) + len(snap[j].tokens)
+            return (self.prefill_chunk
+                    or len(self._slot_prompt[j]) + len(snap[j].tokens)
                     <= big)
 
         fits = [j for j in live if restorable(j)]
@@ -705,6 +771,132 @@ class PagedSlotEngine(SlotEngine):
         self._decode_fns[("paged", mp, filtered)] = fn
         return fn
 
+    def _seg_prefill_paged_fn(self, bucket: int, final: bool, mp: int):
+        """One chunked-prefill SEGMENT for one slot over the page pool:
+        gather the slot's ``mp`` pages into a dense temp row, run the
+        cached forward at the segment's absolute offset (vector start →
+        scatter writes, pad tail drops), scatter every covered page
+        back. Non-final segments park the decode position at
+        ``maxp·page`` — STRICTLY past any dispatch view, so interleaved
+        decode chunks' writes for this lane route to the trash page
+        (paged_write's beyond-view bound; max_seq itself is not safe
+        when it is not page-aligned). The FINAL segment samples the
+        first token and arms the real decode state."""
+        key = ("segpaged", bucket, final, mp)
+        fn = self._prefill_fns.get(key)
+        if fn is not None:
+            return fn
+        cfg, fwd = self.cfg, self._fwd
+        page = self.page_size
+        park = jnp.int32(self._max_pages_per_slot * page)
+
+        def seg(params, tokens, actual_len, slot, start, temp, topk,
+                topp, seed, row, k_pool, v_pool, dtok, dpos, dtemp,
+                dtopk, dtopp):
+            # tokens (1, bucket); actual_len/slot/start scalars;
+            # row (mp,) page ids covering positions [0, mp·page)
+            L = cfg.n_layers
+            kvh, hd = cfg.n_kv_heads, cfg.head_dim
+            kr = jnp.take(k_pool, row, axis=1).reshape(
+                L, 1, mp * page, kvh, hd)
+            vr = jnp.take(v_pool, row, axis=1).reshape(
+                L, 1, mp * page, kvh, hd)
+            logits, kr, vr = fwd(params, tokens, cfg, kr, vr,
+                                 start[None], self.mesh,
+                                 last_only=actual_len[None] - 1)
+            k_pool = k_pool.at[:, row].set(
+                kr.reshape(L, mp, page, kvh, hd))
+            v_pool = v_pool.at[:, row].set(
+                vr.reshape(L, mp, page, kvh, hd))
+            if final:
+                toks = self._sample_filtered(
+                    logits[:, 0], temp[None], topk[None], topp[None],
+                    jax.random.PRNGKey(seed))
+                dtok = dtok.at[slot].set(toks[0])
+                dpos = dpos.at[slot].set(start + actual_len)
+                dtemp = dtemp.at[slot].set(temp)
+                dtopk = dtopk.at[slot].set(topk)
+                dtopp = dtopp.at[slot].set(topp)
+            else:
+                toks = jnp.zeros((1,), jnp.int32)
+                dpos = dpos.at[slot].set(park)
+            return toks, k_pool, v_pool, dtok, dpos, dtemp, dtopk, dtopp
+
+        fn = jax.jit(seg, donate_argnums=(10, 11, 12, 13, 14, 15, 16))
+        self._prefill_fns[key] = fn
+        return fn
+
+    def _dispatch_segments(self) -> bool:
+        """Paged chunked prefill (r5): the base engine's one-segment-
+        per-step rotation, with page coverage claimed before each
+        segment dispatches. A dry pool preempts the lowest-progress
+        DECODING slot (mid-prefill slots are never victims — their
+        restore context is incomplete); if nothing is preemptable the
+        segment waits for completions, stalling only its own stream."""
+        filling = [(i, st) for i, st in self._table.items()
+                   if st is not None and st.pending is not None]
+        if not filling:
+            return False
+        start_rr = getattr(self, "_seg_rr", -1)
+        filling.sort(key=lambda p: (p[0] <= start_rr, p[0]))
+        page = self.page_size
+        for i, st in filling[:1]:
+            # advance the rotation FIRST (the base engine's rule): a
+            # slot that stalls on pages below must not be re-picked
+            # every step while the slot holding those pages starves
+            self._seg_rr = i
+            seg = st.pending[:min(self.prefill_chunk, self.buckets[-1])]
+            final = len(seg) == len(st.pending)
+            bucket = next(b for b in self.buckets if b >= len(seg))
+            p_need = _ceil_div(st.prefill_pos + len(seg), page)
+            missing = p_need - len(self._slot_pages[i])
+            while missing > len(self._free):
+                decoding = {j: s for j, s in self._table.items()
+                            if s is not None and s.pending is None
+                            and self._table.get(j) is s}
+                victim = self._pick_victim(decoding, st)
+                if victim is not None:  # a decoder JUNIOR to me
+                    self._preempt(victim, decoding[victim])
+                    continue
+                # no junior decoder: maybe a junior prefiller (safe —
+                # nothing emitted, restore is the admission request)
+                victim = self._junior_prefiller(st)
+                if victim is None:
+                    return True  # seniors hold the pool — wait my turn
+                self._preempt(victim, self._table[victim])
+            if missing > 0:
+                pages = [self._free.pop() for _ in range(missing)]
+                row = self._ptable[i]
+                start = len(self._slot_pages[i])
+                row[start:start + missing] = pages
+                self._slot_pages[i].extend(pages)
+                self.stats["grown_pages"] += missing
+                self.stats["pages_free"] = len(self._free)
+            mp = self._mp_bucket(p_need)
+            row_view = np.ascontiguousarray(self._ptable[i, :mp])
+            tokens_np = np.full((1, bucket), self.pad_id, np.int32)
+            tokens_np[0, :len(seg)] = seg
+            (toks, self._k, self._v, self._dtok, self._dpos,
+             self._dtemp, self._dtopk,
+             self._dtopp) = self._seg_prefill_paged_fn(
+                bucket, final, mp)(
+                self.params, tokens_np, np.int32(len(seg)),
+                np.int32(i), np.int32(st.prefill_pos),
+                np.float32(st.temperature), np.int32(st.top_k),
+                np.float32(st.top_p), self._next_seed(),
+                row_view, self._k, self._v, self._dtok, self._dpos,
+                self._dtemp, self._dtopk, self._dtopp)
+            st.prefill_pos += len(seg)
+            st.pending = st.pending[len(seg):] if not final else None
+            self.stats["segment_prefills"] += 1
+            if final:
+                self.stats["prefills"] += 1
+                if st.max_new - st.preseed <= 1:
+                    st.emit(int(toks[0]))
+                    st.fresh = False
+                    self._finish_if_done(i, st)
+        return True
+
     def warmup(self, buckets=None, rows=(1,)):
         if self._thread is not None:
             raise RuntimeError("warmup must run before start()")
@@ -774,9 +966,53 @@ class PagedSlotEngine(SlotEngine):
         batch = [r if len(r) == 8 else (*r, []) for r in batch]
         ok: list[tuple[Any, Any, int, list[int]]] = []
         blocked = False
+        chunked_admitted = False
         for idx, req in enumerate(batch):
             prompt, max_new = req[0], req[1]
             plan = self._px_plan(prompt)
+            if plan is not None and self.prefill_chunk and (
+                    len(prompt) - plan[0].shared_len
+                    > self.prefill_chunk):
+                # prefix hit with a LONG suffix: one monolithic suffix
+                # prefill would break --prefill-chunk's bounded-stall
+                # promise — fall through to segmentation instead
+                # (redundant prefix compute; the flag's contract wins —
+                # the base engine's rule, slots.py _admit)
+                plan = None
+            if plan is None and self.prefill_chunk and (
+                    len(prompt) > self.prefill_chunk
+                    or len(prompt) > self.buckets[-1]):
+                # chunked prefill (r5): reserve the slot now; segments
+                # claim pages as they dispatch (_dispatch_segments),
+                # except full-reservation mode which pins the whole
+                # need up front like every other admission
+                need = (0 if self.reservation == "grow" else _ceil_div(
+                    len(prompt) + max_new - 1, self.page_size))
+                if blocked or not free_slots or need > len(self._free):
+                    if idx >= n_redeferred:
+                        self.stats["deferred_admissions"] += 1
+                    blocked = True
+                    self._deferred.append(req)
+                    continue
+                pages = [self._free.pop() for _ in range(need)]
+                (prompt, max_new, temp, eos_id, tk, tp, handle,
+                 carry) = req
+                slot = free_slots.pop()
+                st = _Slot(handle=handle, tokens=list(carry),
+                           max_new=max_new, pos=len(prompt),
+                           temperature=temp, eos_id=eos_id, top_k=tk,
+                           top_p=tp, base_len=len(prompt),
+                           preseed=len(carry), pending=list(prompt))
+                self._slot_pages[slot] = pages
+                self._ptable[slot, :len(pages)] = pages
+                self._slot_prompt[slot] = (
+                    prompt[:len(prompt) - len(carry)] if carry
+                    else prompt)
+                self.stats["pages_free"] = len(self._free)
+                with self._lock:
+                    self._table[slot] = st
+                chunked_admitted = True
+                continue
             if plan is not None:
                 ent, bucket = plan
             else:
@@ -805,7 +1041,7 @@ class PagedSlotEngine(SlotEngine):
                 self._deferred.append(req)
         self.stats["pages_free"] = len(self._free)
         if not ok:
-            return False
+            return chunked_admitted
         groups: dict[tuple, list] = {}
         for req, ent, bucket, pages in ok:
             # the entry object itself rides the key (identity hash) so
@@ -889,7 +1125,12 @@ class PagedSlotEngine(SlotEngine):
         return True
 
     def _dispatch_chunk(self) -> None:
-        snap = {i: s for i, s in self._table.items() if s is not None}
+        # prefilling (pending) slots are excluded like the base engine:
+        # their decode lanes compute garbage (writes route to trash via
+        # paged_write's beyond-view bound) and their tokens must never
+        # be processed
+        snap = {i: s for i, s in self._table.items()
+                if s is not None and s.pending is None}
         # grow-mode: claim this chunk's pages (fresh admits included);
         # may preempt — drop preempted entries before dispatching
         self._ensure_coverage(snap)
@@ -939,7 +1180,8 @@ class PagedSlotEngine(SlotEngine):
         # admissions super().step() is about to make on a tight pool
         if self.reservation == "grow":
             self._ensure_coverage(
-                {i: s for i, s in self._table.items() if s is not None})
+                {i: s for i, s in self._table.items()
+                 if s is not None and s.pending is None})
         did = super().step()
         # unregistered prefixes whose last reader just completed
         if self._px_zombies:

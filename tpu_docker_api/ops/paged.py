@@ -65,13 +65,18 @@ def paged_write(pool: jnp.ndarray, layer_idx, table: jnp.ndarray,
                 pos: jnp.ndarray, new: jnp.ndarray) -> jnp.ndarray:
     """Scatter one new position per slot into the pool:
     ``pool[layer_idx, table[s, pos[s]//page], pos[s]%page] = new[s]``.
-    ``pos // page`` clips to the table width — a lane past its
-    reservation (completed request still decoding at the pipeline lag)
-    resolves to a zero entry, i.e. the trash page."""
+    A position BEYOND the table view (``pos // page >= mp``) routes to
+    the trash page unconditionally — the paged analog of the dense
+    cache's mode="drop" writes: completed lanes decoding at the
+    pipeline lag land there via their zeroed rows, and PARKED
+    chunked-prefill lanes (decode position pinned at max_seq, r5) land
+    there via this bound even though their rows hold live pages."""
     page = pool.shape[2]
     mp = table.shape[1]
-    slot_col = jnp.clip(pos // page, 0, mp - 1)
-    pid = jnp.take_along_axis(table, slot_col[:, None], axis=1)[:, 0]
+    col = pos // page
+    pid = jnp.take_along_axis(
+        table, jnp.clip(col, 0, mp - 1)[:, None], axis=1)[:, 0]
+    pid = jnp.where(col < mp, pid, 0)
     return pool.at[layer_idx, pid, pos % page].set(
         new.astype(pool.dtype))
 

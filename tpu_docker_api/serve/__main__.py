@@ -147,8 +147,9 @@ def main(argv: list[str] | None = None) -> None:
                         "with --total-pages instead of slots×max-seq. "
                         "llama presets, single device or tp-only mesh "
                         "(r5: kv-heads shard over tp); /prefixes "
-                        "compose via refcounted shared pages (r5); "
-                        "excludes --prefill-chunk, --draft-preset")
+                        "(refcounted shared pages) and --prefill-chunk "
+                        "(page-aware segments) compose (r5); excludes "
+                        "--draft-preset")
     p.add_argument("--total-pages", type=int, default=0,
                    help="pool size in pages (0 = dense-equivalent "
                         "capacity); only with --page-size")
@@ -186,13 +187,8 @@ def main(argv: list[str] | None = None) -> None:
     if args.total_pages and not args.page_size:
         raise SystemExit("--total-pages requires --page-size (the "
                          "dense engine has no page pool)")
-    if args.page_size and args.prefill_chunk:
-        # erroring beats silently serving with whole-prompt admission
-        # (same convention as --draft-preset); checked before any model
-        # loads so the misconfiguration fails in milliseconds
-        raise SystemExit(
-            "--prefill-chunk is not supported with --page-size "
-            "(paged engine v1 admits whole prompts)")
+    # r5: --page-size composes with --prefill-chunk (page-aware
+    # segments, infer/paged.py) — the v1 rejection is gone
 
     from tpu_docker_api.workload.jaxenv import bootstrap_jax
 
@@ -423,6 +419,7 @@ def main(argv: list[str] | None = None) -> None:
                 cfg, params, page_size=args.page_size,
                 total_pages=args.total_pages or None,
                 slots=args.slots, max_seq=max_seq, chunk=args.chunk,
+                prefill_chunk=args.prefill_chunk,
                 max_pending=args.slots * 8,
                 mesh=mesh if multi else None,
                 seed=int.from_bytes(os.urandom(4), "little"))
