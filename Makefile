@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast bench openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench openapi sample-interface run clean
 
 all: native openapi
 
@@ -35,6 +35,9 @@ test-fast:                   ## control-plane tests only (no JAX compiles)
 	  --ignore=tests/test_slots.py \
 	  --ignore=tests/test_distributed_e2e.py \
 	  --ignore=tests/test_job_distributed_e2e.py
+
+chaos:                       ## crash-consistency + fault-injection suite (docs/robustness.md)
+	$(PY) -m pytest tests/ -q -m chaos
 
 bench:                       ## headline bench (one JSON line)
 	$(PY) bench.py

@@ -22,6 +22,9 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running end-to-end flows (subprocess trainers)")
+    config.addinivalue_line(
+        "markers", "chaos: crash/fault-injection suite (`make chaos`); "
+        "hermetic and fast — also runs in the default tier")
 # the CPU backend's default matmul precision is low; exactness tests
 # (flash vs dense, ring vs dense) need deterministic f32 accumulation
 jax.config.update("jax_default_matmul_precision", "float32")

@@ -39,9 +39,15 @@ def server(tmp_path):
         VersionMap(kv, keys.VERSIONS_CONTAINER_KEY), wq,
     )
     v_svc = VolumeService(runtime, store, VersionMap(kv, keys.VERSIONS_VOLUME_KEY), wq)
-    srv = ApiServer(build_router(c_svc, v_svc, chips, ports), port=0)
+    from tpu_docker_api.service.reconcile import Reconciler
+
+    reconciler = Reconciler(runtime, store, chips, ports, c_svc.versions,
+                            container_svc=c_svc)
+    srv = ApiServer(build_router(c_svc, v_svc, chips, ports, work_queue=wq,
+                                 reconciler=reconciler), port=0)
     srv.start()
-    srv.wq = wq  # test hook for draining
+    srv.wq = wq        # test hooks
+    srv.runtime = runtime
     yield srv
     srv.close()
     wq.close()
@@ -244,3 +250,46 @@ class TestResourceRoutes:
 
     def test_healthz(self, server):
         assert call(server, "GET", "/healthz")["data"]["status"] == "ok"
+
+
+class TestRobustnessRoutes:
+    def test_reconcile_dry_run_then_apply(self, server):
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "t", "chipCount": 2,
+        })
+        server.runtime.crash_container("t-0")
+
+        out = call(server, "GET", "/api/v1/reconcile?dryRun=true")
+        assert out["code"] == 200
+        assert out["data"]["dryRun"] is True
+        assert [a["action"] for a in out["data"]["actions"]] == ["restart-dead"]
+        # dry run did not touch the runtime
+        assert not server.runtime.container_inspect("t-0").running
+
+        out = call(server, "GET", "/api/v1/reconcile")
+        assert out["data"]["dryRun"] is False
+        assert server.runtime.container_inspect("t-0").running
+
+        out = call(server, "GET", "/api/v1/reconcile/events")
+        assert out["data"][-1]["action"] == "restart-dead"
+
+    def test_dead_letter_retry_roundtrip(self, server):
+        from tpu_docker_api.state.workqueue import FnTask
+
+        server.wq._max_retries = 1
+        server.wq._backoff_base_s = 0.001
+        healthy = []
+
+        def flaky():
+            if not healthy:
+                raise OSError("disk full")
+
+        server.wq.submit(FnTask(fn=flaky, description="flaky"))
+        server.wq.drain()
+        assert len(call(server, "GET", "/api/v1/debug/deadletters")["data"]) == 1
+
+        healthy.append(True)
+        out = call(server, "POST", "/api/v1/dead-letters/retry")
+        assert out["data"] == {"requeued": 1}
+        server.wq.drain()
+        assert call(server, "GET", "/api/v1/debug/deadletters")["data"] == []

@@ -1,0 +1,229 @@
+"""Crash-consistency chaos suite (docs/robustness.md).
+
+Each case arms one labeled crash point (service/crashpoints.py), drives a
+rolling-replacement flow into it — the ``SimulatedCrash`` is a
+BaseException, so none of the in-process rollback handlers run, exactly
+like ``kill -9`` — then boots a FRESH ``Program`` over the same KV store
+and runtime and lets the startup reconciler repair the wreckage. The
+oracle is ``check_invariants``: exactly one live version per family, zero
+leaked chips/ports, scheduler ownership equal to the latest spec.
+
+The first Program's work queue is never started, so tasks the dying flow
+enqueued (data copy, deferred start) are lost with the process — the
+strictest possible crash model.
+"""
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.faulty import FaultyRuntime, FaultPlan, fail_nth
+from tpu_docker_api.schemas.container import (
+    Bind,
+    ContainerPatchChips,
+    ContainerPatchVolume,
+    ContainerPort,
+    ContainerRun,
+)
+from tpu_docker_api.service.crashpoints import (
+    KNOWN_CRASH_POINTS,
+    SimulatedCrash,
+    armed,
+)
+from tpu_docker_api.service.invariants import check_invariants
+from tpu_docker_api.state.kv import MemoryKV
+
+pytestmark = pytest.mark.chaos
+
+
+def boot(kv, runtime) -> Program:
+    """A Program over injected state — init only, no HTTP server, and the
+    work queue deliberately NOT started (see module docstring)."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+    )
+    prg = Program(cfg, kv=kv, runtime=runtime)
+    prg.init()
+    return prg
+
+
+def setup_family(prg, tmp_path):
+    """train-0: 2 chips, 1 scheduled port, one bind, with checkpoint data."""
+    (tmp_path / "v1").mkdir(exist_ok=True)
+    (tmp_path / "v2").mkdir(exist_ok=True)
+    prg.container_svc.run_container(ContainerRun(
+        image_name="jax", container_name="train", chip_count=2,
+        container_ports=[ContainerPort(8080)],
+        binds=[Bind(str(tmp_path / "v1"), "/data")],
+    ))
+    data_dir = prg.runtime.container_data_dir("train-0")
+    with open(f"{data_dir}/ckpt.txt", "w") as f:
+        f.write("step=100")
+
+
+def _grow(svc):
+    svc.patch_container_chips("train", ContainerPatchChips(chip_count=4))
+
+
+def _shrink(svc):
+    svc.patch_container_chips("train", ContainerPatchChips(chip_count=1))
+
+
+def _volume(svc, tmp_path):
+    svc.patch_container_volume("train", ContainerPatchVolume(
+        old_bind=Bind(str(tmp_path / "v1"), "/data"),
+        new_bind=Bind(str(tmp_path / "v2"), "/data"),
+    ))
+
+
+_REPLACE_POINTS = ("replace.after_version_bump", "replace.after_create_new",
+                   "replace.after_quiesce_old")
+_PATCH_POINTS = ("patch.after_alloc", "patch.after_replace")
+
+#: every (flow, crash point) pair that the flow actually traverses
+CASES = (
+    [("grow", p) for p in _REPLACE_POINTS + _PATCH_POINTS]
+    + [("shrink", p) for p in _REPLACE_POINTS + _PATCH_POINTS]
+    + [("volume", p) for p in _REPLACE_POINTS]
+)
+
+
+def test_case_matrix_covers_every_crash_point():
+    assert {p for _, p in CASES} == set(KNOWN_CRASH_POINTS)
+
+
+def _mutations(runtime: FakeRuntime) -> list:
+    return [c for c in runtime.calls
+            if c[0] in ("create", "start", "stop", "restart", "remove", "crash")]
+
+
+@pytest.mark.parametrize("flow,point", CASES,
+                         ids=[f"{f}@{p}" for f, p in CASES])
+def test_crash_restart_reconcile_converges(tmp_path, flow, point):
+    kv = MemoryKV()
+    runtime = FakeRuntime(root=str(tmp_path / "rt"))
+    prg = boot(kv, runtime)
+    setup_family(prg, tmp_path)
+
+    mutate = {"grow": _grow, "shrink": _shrink,
+              "volume": lambda svc: _volume(svc, tmp_path)}[flow]
+    with armed(point):
+        with pytest.raises(SimulatedCrash):
+            mutate(prg.container_svc)
+
+    # the daemon is dead; a fresh control plane boots over the same state
+    prg2 = boot(kv, runtime)
+
+    # a shrink that dies right after _adjust_chip_allocation allocated
+    # nothing and freed nothing — the one case with genuinely zero drift
+    benign = (flow, point) == ("shrink", "patch.after_alloc")
+
+    # dry-run first: it must report the drift without mutating anything
+    kv_before = dict(kv.range_prefix("/"))
+    mutations_before = _mutations(runtime)
+    dry = prg2.reconciler.reconcile(dry_run=True)
+    assert dry["dryRun"]
+    if not benign:
+        assert dry["actions"], f"no drift reported at {point}"
+    assert dict(kv.range_prefix("/")) == kv_before
+    assert _mutations(runtime) == mutations_before
+
+    report = prg2.reconciler.reconcile()
+    if not benign:
+        assert report["actions"], f"nothing repaired at {point}"
+
+    problems = check_invariants(
+        runtime, prg2.store, prg2.container_versions,
+        prg2.chip_scheduler, prg2.port_scheduler)
+    assert problems == [], f"{flow}@{point}: {problems}"
+
+    # exactly one live version, and it is the latest pointer
+    latest = prg2.container_versions.get("train")
+    running = [n for n in runtime.container_list()
+               if runtime.container_inspect(n).running]
+    assert running == [f"train-{latest}"]
+
+    # the surviving version still has the checkpoint (an interrupted
+    # migration must never strand the data on a retired container)
+    with open(f"{runtime.container_data_dir(running[0])}/ckpt.txt") as f:
+        assert f.read() == "step=100"
+
+    # a second sweep finds nothing: the repair is a fixpoint
+    assert prg2.reconciler.reconcile()["actions"] == []
+
+
+def test_crashed_flow_without_reconcile_violates_invariants(tmp_path):
+    """Sanity check on the oracle itself: the crash DOES corrupt state (the
+    suite would be vacuous if the invariants held without repair)."""
+    kv = MemoryKV()
+    runtime = FakeRuntime(root=str(tmp_path / "rt"))
+    prg = boot(kv, runtime)
+    setup_family(prg, tmp_path)
+    with armed("replace.after_quiesce_old"):
+        with pytest.raises(SimulatedCrash):
+            _grow(prg.container_svc)
+    prg2 = boot(kv, runtime)
+    assert check_invariants(
+        runtime, prg2.store, prg2.container_versions,
+        prg2.chip_scheduler, prg2.port_scheduler) != []
+
+
+class TestAmbiguousEngineFailures:
+    """FaultyRuntime chaos: the engine commits the operation, then errors.
+    The service compensations (hardened this PR) plus the reconciler must
+    converge exactly as for process crashes."""
+
+    def _boot(self, tmp_path, rules):
+        kv = MemoryKV()
+        runtime = FaultyRuntime(FakeRuntime(root=str(tmp_path / "rt")),
+                                FaultPlan(rules=rules))
+        return boot(kv, runtime), kv, runtime
+
+    def test_ambiguous_create_leaves_no_orphan_and_retry_works(self, tmp_path):
+        prg, kv, runtime = self._boot(
+            tmp_path, [fail_nth("container_create", 1, mode="ambiguous")])
+        with pytest.raises(Exception, match="injected fault"):
+            prg.container_svc.run_container(ContainerRun(
+                image_name="jax", container_name="train", chip_count=2))
+        # the committed-then-errored create was compensated away
+        assert runtime.container_list() == []
+        assert prg.container_versions.get("train") is None
+        assert len(prg.chip_scheduler.free_chips) == 8
+        # the family name is reusable immediately
+        out = prg.container_svc.run_container(ContainerRun(
+            image_name="jax", container_name="train", chip_count=2))
+        assert out["name"] == "train-0"
+
+    def test_failed_quiesce_stop_aborts_replacement_atomically(self, tmp_path):
+        prg, kv, runtime = self._boot(tmp_path, [])
+        setup_family(prg, tmp_path)
+        runtime.add_rules([fail_nth("container_stop", 1)])
+        with pytest.raises(Exception, match="injected fault"):
+            _grow(prg.container_svc)
+        # old version untouched and still latest; the half-made replacement
+        # (container, ports, spec, version bump) was fully unwound
+        assert prg.container_versions.get("train") == 0
+        assert runtime.container_inspect("train-0").running
+        assert not runtime.container_exists("train-1")
+        assert check_invariants(
+            runtime, prg.store, prg.container_versions,
+            prg.chip_scheduler, prg.port_scheduler) == []
+
+    def test_ambiguous_quiesce_stop_converges_after_reconcile(self, tmp_path):
+        """stop lands AND errors: compensation unwinds the replacement but
+        cannot restart what it believes it never stopped — the reconciler
+        closes that last gap."""
+        prg, kv, runtime = self._boot(tmp_path, [])
+        setup_family(prg, tmp_path)
+        runtime.add_rules([fail_nth("container_stop", 1, mode="ambiguous")])
+        with pytest.raises(Exception, match="injected fault"):
+            _grow(prg.container_svc)
+        assert prg.container_versions.get("train") == 0
+        assert not runtime.container_inspect("train-0").running  # effect landed
+        prg.reconciler.reconcile()
+        assert runtime.container_inspect("train-0").running
+        assert check_invariants(
+            runtime, prg.store, prg.container_versions,
+            prg.chip_scheduler, prg.port_scheduler) == []

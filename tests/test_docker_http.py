@@ -391,6 +391,85 @@ class TestDemux:
         assert _demux_docker_stream(b"") == ""
 
 
+class TestStopTimeout:
+    """The HTTP timeout must scale with the engine-side stop grace: dockerd
+    holds the POST open for up to ``t`` seconds before SIGKILL, so a flat
+    60 s transport timeout made any stop with t > 60 raise on a healthy
+    daemon."""
+
+    def _capture(self, rt):
+        seen = {}
+
+        def fake_request(method, path, params=None, body=None,
+                         timeout=60.0, retry=None):
+            seen.update(method=method, path=path, params=params,
+                        timeout=timeout)
+            return 204, b""
+
+        rt._request = fake_request
+        return seen
+
+    def test_long_grace_extends_http_timeout(self, rt):
+        seen = self._capture(rt)
+        rt.container_stop("t0", timeout_s=120)
+        assert seen["params"] == {"t": 120}
+        assert seen["timeout"] >= 150  # grace + margin
+
+    def test_default_grace_keeps_default_timeout(self, rt):
+        seen = self._capture(rt)
+        rt.container_stop("t0")  # timeout_s=10
+        assert seen["timeout"] == 60.0
+
+
+class TestTransientRetry:
+    """Connection-level failures (dockerd restarting) are retried with
+    backoff on idempotent GETs only; non-idempotent POSTs stay one-shot —
+    a blindly repeated create/stop could double-apply."""
+
+    def _flaky_connect(self, rt, exc, fail_times):
+        real_open = type(rt)._open_connection
+        counter = {"n": 0}
+
+        def flaky(timeout):
+            counter["n"] += 1
+            if counter["n"] <= fail_times:
+                raise exc
+            return real_open(rt, timeout)
+
+        rt._open_connection = flaky
+        rt.RETRY_BACKOFF_S = 0.001
+        return counter
+
+    def test_get_retries_connection_refused(self, rt, engine):
+        rt.container_create(make_spec())
+        counter = self._flaky_connect(rt, ConnectionRefusedError(), 2)
+        info = rt.container_inspect("t0")  # succeeds on 3rd attempt
+        assert info.name == "t0"
+        assert counter["n"] == 3
+
+    def test_get_exhausted_retries_raise(self, rt):
+        self._flaky_connect(rt, ConnectionResetError(), 99)
+        with pytest.raises(ConnectionResetError):
+            rt.container_inspect("t0")
+
+    def test_post_is_one_shot(self, rt, engine):
+        rt.container_create(make_spec())
+        counter = self._flaky_connect(rt, ConnectionResetError(), 1)
+        with pytest.raises(ConnectionResetError):
+            rt.container_start("t0")
+        assert counter["n"] == 1
+
+
+class TestInspectStatus:
+    def test_status_round_trips(self, rt, engine):
+        rt.container_create(make_spec())
+        engine.containers["t0"]["State"]["Status"] = "created"
+        assert rt.container_inspect("t0").status == "created"
+        rt.container_start("t0")
+        engine.containers["t0"]["State"]["Status"] = "running"
+        assert rt.container_inspect("t0").status == "running"
+
+
 DOCKER_SOCK = "/var/run/docker.sock"
 
 

@@ -1,0 +1,122 @@
+"""FaultyRuntime plan mechanics: scripted Nth-call faults, ambiguous
+failures, latency, seeded probabilistic rules, and the pass-through seam."""
+
+import pytest
+
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.faulty import (
+    FaultPlan,
+    FaultRule,
+    FaultyRuntime,
+    InjectedFault,
+    fail_nth,
+)
+from tpu_docker_api.runtime.spec import ContainerSpec
+
+
+@pytest.fixture
+def rt(tmp_path):
+    inner = FakeRuntime(root=str(tmp_path))
+    faulty = FaultyRuntime(inner, FaultPlan())
+    yield faulty
+    faulty.close()
+
+
+def make(rt, name="t0"):
+    rt.container_create(ContainerSpec(name=name, image="jax"))
+
+
+class TestScriptedFaults:
+    def test_fail_nth_fires_on_exactly_that_call(self, rt):
+        make(rt)
+        rt.add_rules([fail_nth("container_start", 2)])
+        rt.container_start("t0")              # call 1: ok
+        with pytest.raises(InjectedFault):
+            rt.container_start("t0")          # call 2: injected
+        rt.container_start("t0")              # call 3: rule burned out
+        assert [c[2] for c in rt.calls if c[0] == "container_start"] == [
+            "ok", "fail", "ok"]
+
+    def test_fail_mode_has_no_effect(self, rt):
+        make(rt)
+        rt.add_rules([fail_nth("container_start", 1)])
+        with pytest.raises(InjectedFault):
+            rt.container_start("t0")
+        assert not rt.inner.container_inspect("t0").running
+
+    def test_ambiguous_mode_applies_effect_then_raises(self, rt):
+        make(rt)
+        rt.add_rules([fail_nth("container_start", 1, mode="ambiguous")])
+        with pytest.raises(InjectedFault):
+            rt.container_start("t0")
+        assert rt.inner.container_inspect("t0").running  # effect landed
+
+    def test_latency_mode_delays_but_succeeds(self, rt):
+        make(rt)
+        rt.add_rules([FaultRule(op="container_start", on_calls={1},
+                                mode="latency", latency_s=0.01)])
+        rt.container_start("t0")
+        assert rt.container_inspect("t0").running
+        assert ("container_start", "t0", "latency") in rt.calls
+
+    def test_rule_times_forever(self, rt):
+        rt.add_rules([FaultRule(op="container_list", times=-1)])
+        for _ in range(3):
+            with pytest.raises(InjectedFault):
+                rt.container_list()
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError):
+            FaultRule(op="container_list", mode="explode")
+
+
+class TestDeterminism:
+    def _run_plan(self, tmp_path, seed):
+        inner = FakeRuntime(root=str(tmp_path / f"s{seed}"))
+        rt = FaultyRuntime(inner, FaultPlan(
+            rules=[FaultRule(op="container_list", probability=0.5, times=-1)],
+            seed=seed))
+        pattern = []
+        for _ in range(20):
+            try:
+                rt.container_list()
+                pattern.append("ok")
+            except InjectedFault:
+                pattern.append("fault")
+        rt.close()
+        return pattern
+
+    def test_same_seed_same_firing_pattern(self, tmp_path):
+        a = self._run_plan(tmp_path / "a", seed=7)
+        b = self._run_plan(tmp_path / "b", seed=7)
+        assert a == b
+        assert "fault" in a and "ok" in a  # p=0.5 over 20 draws
+
+    def test_different_seed_different_pattern(self, tmp_path):
+        assert (self._run_plan(tmp_path / "a", seed=1)
+                != self._run_plan(tmp_path / "b", seed=2))
+
+
+class TestSeam:
+    def test_op_counts_and_journal(self, rt):
+        make(rt)
+        rt.container_start("t0")
+        rt.container_inspect("t0")
+        assert rt.op_count("container_create") == 1
+        assert rt.op_count("container_start") == 1
+        assert rt.op_count("container_stop") == 0
+        assert rt.calls[0] == ("container_create", "t0", "ok")
+
+    def test_backend_helpers_pass_through(self, rt):
+        make(rt)
+        rt.container_start("t0")
+        rt.crash_container("t0")  # FakeRuntime-only helper
+        info = rt.container_inspect("t0")
+        assert not info.running and info.exit_code == 137
+
+    def test_clear_rules(self, rt):
+        rt.add_rules([FaultRule(op="container_list", times=-1)])
+        with pytest.raises(InjectedFault):
+            rt.container_list()
+        rt.clear_rules()
+        assert rt.container_list() == []

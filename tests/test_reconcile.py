@@ -1,0 +1,291 @@
+"""Reconciler unit tier: each drift class in isolation on the fake runtime
+(the chaos suite in test_chaos.py covers the crash-produced combinations)."""
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.scheduler.topology import HostTopology
+from tpu_docker_api.schemas.container import (
+    ContainerPatchChips,
+    ContainerPort,
+    ContainerRun,
+)
+from tpu_docker_api.service.container import ContainerService
+from tpu_docker_api.service.invariants import check_invariants
+from tpu_docker_api.service.reconcile import Reconciler
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.state.workqueue import WorkQueue
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+
+class Env:
+    def __init__(self, tmp_path):
+        self.kv = MemoryKV()
+        self.store = StateStore(self.kv)
+        self.runtime = FakeRuntime(root=str(tmp_path))
+        self.chips = ChipScheduler(HostTopology.build("v5e-8"), self.kv)
+        self.ports = PortScheduler(self.kv, 40000, 40099)
+        self.versions = VersionMap(self.kv, keys.VERSIONS_CONTAINER_KEY)
+        self.wq = WorkQueue(self.kv)
+        self.wq.start()
+        self.svc = ContainerService(
+            self.runtime, self.store, self.chips, self.ports,
+            self.versions, self.wq,
+        )
+        self.registry = MetricsRegistry()
+        self.rec = Reconciler(
+            self.runtime, self.store, self.chips, self.ports, self.versions,
+            container_svc=self.svc, registry=self.registry,
+        )
+
+    def run(self, name, chips=0, **kw):
+        out = self.svc.run_container(ContainerRun(
+            image_name="jax", container_name=name, chip_count=chips, **kw
+        ))
+        self.wq.drain()
+        return out
+
+    def check(self):
+        return check_invariants(self.runtime, self.store, self.versions,
+                                self.chips, self.ports)
+
+    def close(self):
+        self.wq.close()
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = Env(tmp_path)
+    yield e
+    e.close()
+
+
+def action_kinds(report):
+    return [a["action"] for a in report["actions"]]
+
+
+class TestHealthySteadyState:
+    def test_empty_plane_is_clean(self, env):
+        assert env.rec.reconcile()["actions"] == []
+
+    def test_running_family_untouched(self, env):
+        env.run("t", chips=2, container_ports=[ContainerPort(80)])
+        assert env.rec.reconcile()["actions"] == []
+        assert env.check() == []
+
+    def test_deliberately_stopped_family_untouched(self, env):
+        env.run("t", chips=2)
+        env.svc.stop_container("t-0")
+        assert env.rec.reconcile()["actions"] == []
+        assert not env.runtime.container_inspect("t-0").running
+
+    def test_retired_versions_untouched(self, env):
+        env.run("t", chips=2)
+        env.svc.patch_container_chips("t", ContainerPatchChips(chip_count=3))
+        env.wq.drain()
+        assert env.rec.reconcile()["actions"] == []
+        assert env.check() == []
+
+
+class TestDriftRepair:
+    def test_out_of_band_removal_frees_resources(self, env):
+        env.run("t", chips=4, container_ports=[ContainerPort(80)])
+        env.runtime.container_remove("t-0", force=True)
+        report = env.rec.reconcile()
+        assert "mark-family-lost" in action_kinds(report)
+        assert len(env.chips.free_chips) == 8
+        assert env.ports.n_free == 100
+        assert env.check() == []
+        # repair is stable: the family stays lost, no flapping
+        assert env.rec.reconcile()["actions"] == []
+
+    def test_crashed_container_restarted(self, env):
+        env.run("t", chips=2)
+        env.runtime.crash_container("t-0")
+        report = env.rec.reconcile()
+        assert action_kinds(report) == ["restart-dead"]
+        assert env.runtime.container_inspect("t-0").running
+        assert env.check() == []
+
+    def test_two_running_versions_retires_stale(self, env):
+        env.run("t", chips=2)
+        env.svc.patch_container_chips("t", ContainerPatchChips(chip_count=3))
+        env.wq.drain()
+        env.runtime.container_start("t-0")  # out-of-band resurrection
+        report = env.rec.reconcile()
+        assert action_kinds(report) == ["retire-stale-version"]
+        assert not env.runtime.container_inspect("t-0").running
+        assert env.runtime.container_inspect("t-1").running
+        assert env.check() == []
+
+    def test_orphan_with_state_adopted(self, env):
+        env.run("t", chips=2, container_ports=[ContainerPort(80)])
+        env.versions.remove("t")  # lost pointer (simulated corruption)
+        report = env.rec.reconcile()
+        assert "adopt-orphan" in action_kinds(report)
+        assert env.versions.get("t") == 0
+        assert env.check() == []
+
+    def test_orphan_without_state_removed(self, env):
+        spec = ContainerSpec(name="ghost-0", image="jax")
+        env.runtime.container_create(spec)
+        report = env.rec.reconcile()
+        assert action_kinds(report) == ["remove-orphan"]
+        assert not env.runtime.container_exists("ghost-0")
+
+    def test_unversioned_container_names_ignored(self, env):
+        # a container not matching base-N is not ours — never touched
+        env.runtime.container_create(ContainerSpec(name="foreign", image="x"))
+        assert env.rec.reconcile()["actions"] == []
+        assert env.runtime.container_exists("foreign")
+
+    def test_leaked_chips_of_unknown_owner_swept(self, env):
+        env.chips.apply_chips(2, owner="ghost")
+        env.ports.apply_ports(1, owner="ghost")
+        report = env.rec.reconcile()
+        assert sorted(action_kinds(report)) == [
+            "free-leaked-chips", "free-leaked-ports"]
+        assert len(env.chips.free_chips) == 8
+        assert env.ports.n_free == 100
+
+    def test_shared_owner_maps_protect_job_claims(self, env):
+        job_versions = VersionMap(env.kv, keys.VERSIONS_JOB_KEY)
+        job_versions.set("trainjob", 0)
+        env.chips.apply_chips(2, owner="trainjob")
+        env.rec._shared_maps = [job_versions]
+        assert env.rec.reconcile()["actions"] == []
+        assert env.chips.owned_chips("trainjob") == [0, 1]
+
+    def test_failing_repair_does_not_abort_the_sweep(self, env, monkeypatch):
+        """One family's broken repair must not leave the next family's
+        drift unrepaired (code review: per-action error isolation)."""
+        env.run("a", chips=1)
+        env.run("b", chips=1)
+        env.runtime.crash_container("a-0")
+        env.runtime.crash_container("b-0")
+
+        real_restart = env.runtime.container_restart
+
+        def flaky_restart(name):
+            if name == "a-0":
+                raise RuntimeError("image gone")
+            real_restart(name)
+
+        monkeypatch.setattr(env.runtime, "container_restart", flaky_restart)
+        report = env.rec.reconcile()
+        by_target = {a["target"]: a for a in report["actions"]}
+        assert by_target["a-0"]["error"].startswith("RuntimeError")
+        assert "error" not in by_target["b-0"]
+        assert env.runtime.container_inspect("b-0").running
+        assert 'reconcile_action_failures_total{action="restart-dead"}' \
+            in env.registry.render()
+
+    def test_orphan_sweep_rechecks_version_pointer(self, env):
+        """A family that gained its version pointer after the sweep's
+        snapshot (concurrent create) must not be treated as an orphan
+        (code review: mid-create force-remove race)."""
+        env.run("t", chips=1)
+        # simulate the stale snapshot: call the orphan path directly even
+        # though the family is fully registered
+        env.rec._reconcile_orphan("t", [], dry_run=False)
+        assert env.runtime.container_exists("t-0")
+        assert env.versions.get("t") == 0
+
+    def test_foreign_owner_sweep_rechecks_before_freeing(self, env):
+        """The leak sweep re-checks ownership under the family lock before
+        freeing — a claim whose family registered after the sweep's
+        snapshot (in-flight create: chips claimed before the version
+        pointer exists) must survive (code review)."""
+        env.chips.apply_chips(2, owner="mid")   # snapshot would see "unknown"
+        env.versions.set("mid", 0)              # ...then the create registers
+        env.rec._free_foreign(
+            lambda items, owner=None: pytest.fail(
+                "freed an in-flight create's chips"),
+            "mid", [0, 1])
+        assert env.chips.owned_chips("mid") == [0, 1]
+
+    def test_explicit_out_of_range_host_port_is_not_drift(self, env):
+        """User-specified host ports outside the scheduler pool were never
+        pool-allocated; they must not produce phantom conflicts or block
+        crash restarts (code review)."""
+        env.run("t", chips=1,
+                container_ports=[ContainerPort(80, host_port=39000)])
+        assert env.rec.reconcile()["actions"] == []
+        assert env.check() == []
+        env.runtime.crash_container("t-0")
+        report = env.rec.reconcile()
+        assert action_kinds(report) == ["restart-dead"]
+        assert env.runtime.container_inspect("t-0").running
+
+    def test_version_pointer_without_spec_rolled_back(self, env):
+        env.run("t", chips=1)
+        env.versions.set("t", 5)  # pointer advanced, spec never persisted
+        report = env.rec.reconcile()
+        assert "rollback-version-pointer" in action_kinds(report)
+        assert env.versions.get("t") == 0
+        assert env.check() == []
+
+
+class TestDryRunAndObservability:
+    def test_dry_run_reports_without_mutating(self, env):
+        env.run("t", chips=2)
+        env.runtime.crash_container("t-0")
+        before = dict(env.kv.range_prefix("/"))
+        report = env.rec.reconcile(dry_run=True)
+        assert report["dryRun"] and action_kinds(report) == ["restart-dead"]
+        assert dict(env.kv.range_prefix("/")) == before
+        assert not env.runtime.container_inspect("t-0").running
+
+    def test_actions_recorded_as_events_and_metrics(self, env):
+        env.run("t", chips=1)
+        env.runtime.crash_container("t-0")
+        env.rec.reconcile()
+        events = env.rec.events_view()
+        assert events and events[-1]["action"] == "restart-dead"
+        rendered = env.registry.render()
+        assert 'reconcile_actions_total{action="restart-dead"' in rendered
+        assert "reconcile_runs_total" in rendered
+
+    def test_last_report_kept(self, env):
+        assert env.rec.last_report() is None
+        env.rec.reconcile()
+        assert env.rec.last_report()["actions"] == []
+
+    def test_periodic_mode_runs_and_closes(self, env):
+        env.run("t", chips=1)
+        env.runtime.crash_container("t-0")
+        env.rec.start_periodic(0.01)
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if env.runtime.container_inspect("t-0").running:
+                break
+            time.sleep(0.01)
+        env.rec.close()
+        assert env.runtime.container_inspect("t-0").running
+
+
+class TestSchedulerClaims:
+    def test_try_claim_chips_all_or_nothing(self, env):
+        env.chips.apply_chips(2, owner="a")  # chips 0,1
+        assert env.chips.try_claim_chips([1, 2], owner="b") == [1]
+        assert env.chips.owned_chips("b") == []  # nothing claimed
+        assert env.chips.try_claim_chips([2, 3], owner="b") == []
+        assert env.chips.owned_chips("b") == [2, 3]
+        # idempotent re-claim of own chips
+        assert env.chips.try_claim_chips([2, 3], owner="b") == []
+
+    def test_try_claim_ports_all_or_nothing(self, env):
+        env.ports.apply_ports(1, owner="a")  # 40000
+        assert env.ports.try_claim_ports([40000, 40001], owner="b") == [40000]
+        assert env.ports.try_claim_ports([40001], owner="b") == []
+        assert env.ports.status()["owners"][40001] == "b"
+        # out-of-range ports are conflicts, not silent claims
+        assert env.ports.try_claim_ports([99999], owner="b") == [99999]

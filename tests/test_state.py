@@ -215,6 +215,64 @@ class TestWorkQueue:
         wq.close()  # no explicit drain: close itself must finish the backlog
         assert len(kv.range_prefix("/drain/")) == 50
 
+    def test_close_terminates_with_failing_queued_task(self, kv):
+        """close() while a poison task sits in the queue: the bounded retry
+        must dead-letter it and close must return — not spin forever on the
+        failing task (the reference's infinite re-enqueue would hang here),
+        and tasks queued behind the poison one must still run."""
+        attempts = []
+
+        def boom():
+            attempts.append(1)
+            raise RuntimeError("poison")
+
+        wq = WorkQueue(kv, max_retries=3, backoff_base_s=0.001)
+        wq.start()
+        wq.submit(FnTask(fn=boom, description="poison"))
+        wq.submit(PutKVTask("/after/poison", "survived"))
+        wq.close()  # must terminate
+        assert len(attempts) == 3  # bounded, not spinning
+        assert len(wq.dead_letters) == 1
+        assert kv.get("/after/poison") == "survived"
+
+    def test_retry_dead_letters_reruns_with_fresh_budget(self, kv):
+        """A dead-lettered task succeeds on operator retry once the
+        underlying fault is gone (POST /api/v1/dead-letters/retry)."""
+        healthy = []
+
+        def flaky():
+            if not healthy:
+                raise OSError("disk full")
+
+        wq = WorkQueue(kv, max_retries=2, backoff_base_s=0.001)
+        wq.start()
+        wq.submit(FnTask(fn=flaky, description="flaky"))
+        wq.drain()
+        assert len(wq.dead_letters) == 1
+        # retried while the fault persists: dead-letters again, no spin
+        assert wq.retry_dead_letters() == 1
+        wq.drain()
+        assert len(wq.dead_letters) == 1
+
+        healthy.append(True)  # "the disk was cleaned up"
+        assert wq.retry_dead_letters() == 1
+        wq.drain()
+        wq.close()
+        assert wq.dead_letters == []
+
+    def test_retry_dead_letters_after_close_is_a_safe_noop(self, kv):
+        """A retry racing shutdown must not strand tasks in a consumerless
+        queue (and must keep them observable in the dead-letter view)."""
+        wq = WorkQueue(kv, max_retries=1, backoff_base_s=0.001)
+        wq.start()
+        wq.submit(FnTask(fn=lambda: (_ for _ in ()).throw(OSError("x")),
+                         description="doomed"))
+        wq.drain()
+        wq.close()
+        assert len(wq.dead_letters) == 1
+        assert wq.retry_dead_letters() == 0
+        assert len(wq.dead_letters) == 1  # still observable
+
 
 class TestEtcdKVHelpers:
     def test_prefix_end(self):

@@ -129,7 +129,7 @@ class Router:
 def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  chip_scheduler, port_scheduler, work_queue=None,
                  health_watcher=None, metrics=None,
-                 job_svc=None, pod_scheduler=None) -> Router:
+                 job_svc=None, pod_scheduler=None, reconciler=None) -> Router:
     r = Router(metrics=metrics)
 
     # -- containers (reference api/container.go:19-38) ---------------------------
@@ -347,6 +347,22 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         # silent infinite-retry loop, workQueue.go:33-47)
         r.add("GET", "/api/v1/debug/deadletters",
               lambda body, **_: work_queue.dead_letter_view())
+        # ... and recoverable: re-enqueue after the operator fixed the cause
+        r.add("POST", "/api/v1/dead-letters/retry",
+              lambda body, **_: {"requeued": work_queue.retry_dead_letters()})
+    if reconciler is not None:
+        # KV-vs-runtime drift sweep (service/reconcile.py); ?dryRun=true
+        # reports the planned repairs without mutating anything
+        def reconcile_view(body, **_):
+            dry = str(body.get("dryRun", "false")).lower() in ("1", "true", "yes")
+            return reconciler.reconcile(dry_run=dry)
+
+        r.add("GET", "/api/v1/reconcile", reconcile_view)
+        # canonical mutating trigger (GET kept for the reference-style
+        # always-200 tooling; prefer POST from anything GET-assuming)
+        r.add("POST", "/api/v1/reconcile", reconcile_view)
+        r.add("GET", "/api/v1/reconcile/events",
+              lambda body, **_: reconciler.events_view())
 
     def debug_threads(body, **_):
         """Per-thread stack dump — the pprof-goroutine analog SURVEY.md §5.1

@@ -181,6 +181,16 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Per-container liveness + restart bookkeeping", None),
     ("GET", "/api/v1/debug/deadletters", "getDeadLetters",
      "Async tasks that exhausted retries (never silently dropped)", None),
+    ("POST", "/api/v1/dead-letters/retry", "retryDeadLetters",
+     "Re-enqueue every dead-lettered task with a fresh retry budget", None),
+    ("GET", "/api/v1/reconcile", "reconcile",
+     "Sweep KV desired state vs runtime actual state and repair drift "
+     "(orphans, half-completed replaces, leaked chips/ports); "
+     "?dryRun=true reports without mutating", None),
+    ("POST", "/api/v1/reconcile", "reconcilePost",
+     "Canonical mutating reconcile trigger (same semantics as GET)", None),
+    ("GET", "/api/v1/reconcile/events", "getReconcileEvents",
+     "Recent drift-repair actions (ring buffer, newest last)", None),
     ("GET", "/api/v1/debug/threads", "getThreadDump",
      "Per-thread stack dump (the pprof-goroutine analog): hung copies and "
      "deadlocked family locks are visible here", None),

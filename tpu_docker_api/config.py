@@ -10,7 +10,11 @@ state store grows a backend selector so tests run hermetically without etcd.
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # pragma: no cover — 3.10 containers
+    import tomli as tomllib
 
 
 @dataclasses.dataclass
@@ -41,6 +45,12 @@ class Config:
     libtpu_path: str = ""
     # health watcher (service/watch.py): poll interval; 0 disables the watcher
     health_watch_interval: float = 5.0
+    # startup reconcile (service/reconcile.py): sweep KV desired state vs
+    # runtime actual state before serving — repairs drift left by a crash
+    reconcile_on_start: bool = True
+    # periodic reconcile interval; 0 disables the background sweep (the
+    # startup pass still runs when reconcile_on_start is true)
+    reconcile_interval: float = 0.0
     # "none" (observe only) | "on-failure" (bounded auto-restart)
     restart_policy: str = "none"
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,

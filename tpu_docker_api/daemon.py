@@ -39,18 +39,25 @@ log = logging.getLogger(__name__)
 
 
 class Program:
-    def __init__(self, cfg: config_mod.Config, host: str = "0.0.0.0") -> None:
+    def __init__(self, cfg: config_mod.Config, host: str = "0.0.0.0",
+                 kv=None, runtime=None) -> None:
         self.cfg = cfg
         self.host = host
         self.api_server: ApiServer | None = None
+        # injection seam for the crash-consistency harness: a "restarted"
+        # Program must boot over the SAME KV + runtime the dead one used
+        # (with the default memory backend, open_store would hand each
+        # Program a fresh empty store and hide every crash bug)
+        self._injected_kv = kv
+        self._injected_runtime = runtime
 
     def init(self) -> None:
         cfg = self.cfg
-        self.kv = open_store(
+        self.kv = self._injected_kv or open_store(
             cfg.store_backend, etcd_addr=cfg.etcd_addr, sqlite_path=cfg.sqlite_path
         )
         self.store = StateStore(self.kv)
-        self.runtime = (
+        self.runtime = self._injected_runtime or (
             open_runtime("docker", docker_host=cfg.docker_host)
             if cfg.runtime_backend == "docker"
             else open_runtime("fake", allow_exec=True)
@@ -76,6 +83,19 @@ class Program:
         self.job_svc = JobService(
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path,
+        )
+        from tpu_docker_api.service.reconcile import Reconciler
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry()
+        # job families allocate from the same local chip/port pools, so
+        # their claims must be off-limits to the reconciler's leak sweep
+        self.reconciler = Reconciler(
+            self.runtime, self.store, self.chip_scheduler,
+            self.port_scheduler, self.container_versions,
+            container_svc=self.container_svc,
+            shared_version_maps=[self.job_versions],
+            registry=self.metrics,
         )
 
     def _build_pod(self, local_topology: HostTopology) -> Pod:
@@ -158,10 +178,24 @@ class Program:
         return HostTopology.build(cfg.accelerator_type)
 
     def start(self) -> None:
-        from tpu_docker_api.telemetry.metrics import MetricsRegistry
-
         self.wq.start()
-        self.metrics = MetricsRegistry()
+        if self.cfg.reconcile_on_start:
+            # repair whatever a previous incarnation left half-done BEFORE
+            # serving traffic (an interrupted rolling replace must not be
+            # visible as two live versions). A failed sweep must not block
+            # boot — a recovery feature that crash-loops the daemon is worse
+            # than the drift it would repair
+            try:
+                report = self.reconciler.reconcile()
+                if report["actions"]:
+                    log.warning("startup reconcile repaired %d drift(s): %s",
+                                report["driftCount"],
+                                [a["action"] for a in report["actions"]])
+            except Exception:  # noqa: BLE001
+                log.exception("startup reconcile failed; serving anyway "
+                              "(rerun via /api/v1/reconcile)")
+        if self.cfg.reconcile_interval > 0:
+            self.reconciler.start_periodic(self.cfg.reconcile_interval)
         self.health_watcher = None
         if self.cfg.health_watch_interval > 0:
             from tpu_docker_api.service.watch import HealthWatcher
@@ -179,6 +213,7 @@ class Program:
             self.chip_scheduler, self.port_scheduler, work_queue=self.wq,
             health_watcher=self.health_watcher, metrics=self.metrics,
             job_svc=self.job_svc, pod_scheduler=self.pod_scheduler,
+            reconciler=self.reconciler,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -195,6 +230,8 @@ class Program:
             self.api_server.close()
         if getattr(self, "health_watcher", None) is not None:
             self.health_watcher.close()
+        if getattr(self, "reconciler", None) is not None:
+            self.reconciler.close()
         self.wq.close()
         for host in self.pod.hosts.values():
             if host.runtime is not self.runtime:

@@ -16,6 +16,7 @@ from tpu_docker_api.runtime.base import (  # noqa: F401
     VolumeInfo,
 )
 from tpu_docker_api.runtime.fake import FakeRuntime  # noqa: F401
+from tpu_docker_api.runtime.faulty import FaultPlan, FaultRule, FaultyRuntime, fail_nth  # noqa: F401
 from tpu_docker_api.runtime.spec import ContainerSpec, PortBinding, render_tpu_attachment  # noqa: F401
 
 

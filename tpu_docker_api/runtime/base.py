@@ -25,6 +25,10 @@ class ContainerInfo:
     data_dir: str = ""     # overlay MergedDir analog (copy source/target)
     pid: int = 0
     exit_code: int = 0
+    # docker State.Status ("created" | "running" | "exited" | ...). The
+    # reconciler uses "created" to tell a never-started replacement (roll it
+    # back) from a crashed container (restart it). "" = backend unknown.
+    status: str = ""
 
 
 @dataclasses.dataclass

@@ -13,6 +13,7 @@ import http.client
 import json
 import socket
 import struct
+import time
 import urllib.parse
 
 from tpu_docker_api import errors
@@ -48,6 +49,18 @@ class DockerRuntime(ContainerRuntime):
 
     # -- transport ---------------------------------------------------------------
 
+    #: transient-connection retry for idempotent requests (a dockerd restart
+    #: mid-poll refuses/resets connections for a moment; GETs can just try
+    #: again, non-idempotent POSTs stay one-shot — a second "create" or
+    #: "stop" could double-apply)
+    RETRY_ATTEMPTS = 3
+    RETRY_BACKOFF_S = 0.05
+    _RETRYABLE = (ConnectionRefusedError, ConnectionResetError,
+                  BrokenPipeError, FileNotFoundError)
+
+    def _open_connection(self, timeout: float) -> _UnixHTTPConnection:
+        return _UnixHTTPConnection(self._socket_path, timeout=timeout)
+
     def _request(
         self,
         method: str,
@@ -55,19 +68,31 @@ class DockerRuntime(ContainerRuntime):
         params: dict | None = None,
         body: dict | None = None,
         timeout: float = 60.0,
+        retry: bool | None = None,
     ) -> tuple[int, bytes]:
+        if retry is None:
+            retry = method == "GET"
+        attempts = self.RETRY_ATTEMPTS if retry else 1
         qs = ("?" + urllib.parse.urlencode(params)) if params else ""
-        conn = _UnixHTTPConnection(self._socket_path, timeout=timeout)
-        try:
-            payload = json.dumps(body).encode() if body is not None else None
-            headers = {"Content-Type": "application/json"} if payload else {}
-            conn.request(method, f"/{API_VERSION}{path}{qs}", body=payload,
-                         headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-            return resp.status, data
-        finally:
-            conn.close()
+        for attempt in range(attempts):
+            try:
+                conn = self._open_connection(timeout)
+                try:
+                    payload = (json.dumps(body).encode()
+                               if body is not None else None)
+                    headers = {"Content-Type": "application/json"} if payload else {}
+                    conn.request(method, f"/{API_VERSION}{path}{qs}",
+                                 body=payload, headers=headers)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    return resp.status, data
+                finally:
+                    conn.close()
+            except self._RETRYABLE:
+                if attempt == attempts - 1:
+                    raise
+                time.sleep(self.RETRY_BACKOFF_S * (2 ** attempt))
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def _json(self, method: str, path: str, params: dict | None = None,
               body: dict | None = None, ok: tuple[int, ...] = (200, 201, 204)):
@@ -129,15 +154,21 @@ class DockerRuntime(ContainerRuntime):
         self._container_op(name, "start")
 
     def container_stop(self, name: str, timeout_s: int = 10) -> None:
-        self._container_op(name, "stop", params={"t": timeout_s})
+        # dockerd holds the POST open for up to timeout_s before SIGKILL, so
+        # the HTTP timeout must exceed it — with the flat 60 s transport
+        # default, any stop grace > 60 s raised on a perfectly healthy daemon
+        self._container_op(name, "stop", params={"t": timeout_s},
+                           timeout=max(60.0, timeout_s + 30.0))
 
     def container_restart(self, name: str) -> None:
         self._container_op(name, "restart")
 
-    def _container_op(self, name: str, op: str, params: dict | None = None) -> None:
+    def _container_op(self, name: str, op: str, params: dict | None = None,
+                      timeout: float = 60.0) -> None:
         try:
             # 304 = already in desired state
-            status, data = self._request("POST", f"/containers/{name}/{op}", params)
+            status, data = self._request("POST", f"/containers/{name}/{op}",
+                                         params, timeout=timeout)
             if status == 404:
                 raise errors.ContainerNotExist(name)
             if status not in (204, 304):
@@ -198,6 +229,7 @@ class DockerRuntime(ContainerRuntime):
             data_dir=merged,
             pid=int(state.get("Pid") or 0),
             exit_code=int(state.get("ExitCode") or 0),
+            status=str(state.get("Status") or ""),
         )
 
     def container_exists(self, name: str) -> bool:

@@ -49,7 +49,8 @@ class FakeRuntime(ContainerRuntime):
             os.makedirs(data_dir, exist_ok=True)
             cid = uuid.uuid4().hex[:12]
             self._containers[spec.name] = ContainerInfo(
-                name=spec.name, id=cid, running=False, spec=spec, data_dir=data_dir
+                name=spec.name, id=cid, running=False, spec=spec,
+                data_dir=data_dir, status="created",
             )
             self.calls.append(("create", spec.name))
             return cid
@@ -65,6 +66,7 @@ class FakeRuntime(ContainerRuntime):
             info = self._get(name)
             info.running = True
             info.pid = os.getpid()
+            info.status = "running"
             self.calls.append(("start", name))
 
     def container_stop(self, name: str, timeout_s: int = 10) -> None:
@@ -72,6 +74,8 @@ class FakeRuntime(ContainerRuntime):
             info = self._get(name)
             info.running = False
             info.pid = 0
+            if info.status != "created":  # stopping a created container is a no-op
+                info.status = "exited"
             self.calls.append(("stop", name))
 
     def container_restart(self, name: str) -> None:
@@ -79,6 +83,7 @@ class FakeRuntime(ContainerRuntime):
             info = self._get(name)
             info.running = True
             info.exit_code = 0
+            info.status = "running"
             self.calls.append(("restart", name))
 
     def crash_container(self, name: str, exit_code: int = 137) -> None:
@@ -89,6 +94,7 @@ class FakeRuntime(ContainerRuntime):
             info.running = False
             info.pid = 0
             info.exit_code = exit_code
+            info.status = "exited"
             self.calls.append(("crash", name))
 
     def container_remove(self, name: str, force: bool = False) -> None:

@@ -83,6 +83,23 @@ class PortScheduler:
             self._persist_locked()
             return out
 
+    def try_claim_ports(self, ports: list[int], owner: str) -> list[int]:
+        """Claim SPECIFIC ports for ``owner`` (reconciler adoption/re-claim,
+        mirroring ChipScheduler.try_claim_chips). All-or-nothing: returns
+        conflicts and claims nothing unless empty."""
+        with self._mu:
+            conflicts = sorted(
+                p for p in ports
+                if not self.start_port <= p <= self.end_port
+                or self._used.get(p, owner) != owner
+            )
+            if conflicts:
+                return conflicts
+            for p in ports:
+                self._used[p] = owner
+            self._persist_locked()
+            return []
+
     def restore_ports(self, ports: list[int], owner: str | None = None) -> None:
         """Return ports to the pool (reference RestorePorts, scheduler.go:114-125).
         With ``owner`` set, only ports still held by that owner are freed

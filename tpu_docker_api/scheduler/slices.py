@@ -163,6 +163,26 @@ class ChipScheduler:
             self._claim_locked(picked, owner)
             return picked, False
 
+    def try_claim_chips(self, chip_ids: list[int], owner: str) -> list[int]:
+        """Claim SPECIFIC chips for ``owner`` — the reconciler's adoption
+        path (re-own a container found in the runtime but absent from the
+        allocation map). All-or-nothing: returns the conflicting chip ids
+        (held by a different owner or outside the topology) and claims
+        nothing unless the list is empty. Chips already owned by ``owner``
+        are fine (idempotent re-adoption)."""
+        with self._mu:
+            conflicts = sorted(
+                c for c in chip_ids
+                if c not in self.topology.coords
+                or self._used.get(c, owner) != owner
+            )
+            if conflicts:
+                return conflicts
+            for c in chip_ids:
+                self._used[c] = owner
+            self._persist_locked()
+            return []
+
     def restore_chips(self, chip_ids: list[int], owner: str | None = None) -> None:
         """Return chips to the pool (reference RestoreGpus, scheduler.go:93-104).
 

@@ -48,6 +48,7 @@ from tpu_docker_api.schemas.container import (
     ContainerStop,
 )
 from tpu_docker_api.schemas.state import ContainerState
+from tpu_docker_api.service.crashpoints import crash_point
 from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
@@ -108,6 +109,12 @@ class ContainerService:
 
     def _resolve_latest(self, name: str) -> tuple[str, int, str]:
         return resolve_latest(self.versions, name)
+
+    def family_lock(self, base: str):
+        """Context manager serializing against this family's user flows —
+        the reconciler holds it while repairing, so repair cannot race a
+        concurrent patch/stop/delete."""
+        return self._locks.hold(base)
 
     def _adjust_chip_allocation(
         self, base: str, cur_spec: ContainerSpec, want: int,
@@ -190,6 +197,7 @@ class ContainerService:
         version = self.versions.next_version(base)
         name = versioned_name(base, version)
         spec.name = name
+        crash_point("replace.after_version_bump")
 
         fresh_ports: list[int] = []
         need = [pb for pb in spec.port_bindings if pb.host_port == 0]
@@ -197,7 +205,16 @@ class ContainerService:
             fresh_ports = self.ports.apply_ports(len(need), owner=base)
             for pb, hp in zip(need, fresh_ports):
                 pb.host_port = hp
-            self.runtime.container_create(spec)
+            try:
+                self.runtime.container_create(spec)
+            except Exception:
+                # ambiguous-failure hardening (chaos suite): the engine may
+                # have committed the create before erroring — a leftover
+                # container would block every retry with ContainerExisted
+                with contextlib.suppress(Exception):
+                    if self.runtime.container_exists(name):
+                        self.runtime.container_remove(name, force=True)
+                raise
             try:
                 self.store.put_container(
                     ContainerState(container_name=name, version=version,
@@ -273,6 +290,7 @@ class ContainerService:
             # replace leaves the old container's chips untouched
             new_chips, extra, to_release, contiguous = (
                 self._adjust_chip_allocation(base, spec, want))
+            crash_point("patch.after_alloc")
             try:
                 render_tpu_attachment(
                     spec, new_chips, self.chips.topology,
@@ -282,6 +300,7 @@ class ContainerService:
             except Exception:
                 self.chips.restore_chips(extra, owner=base)
                 raise
+            crash_point("patch.after_replace")
             self.chips.restore_chips(to_release, owner=base)
             log.info("patched %s chips %d -> %d as %s", latest_name,
                      len(current), want, new_name)
@@ -562,6 +581,7 @@ class ContainerService:
         for pb in new_spec.port_bindings:
             pb.host_port = 0  # fresh host ports for the new version (reference :489-501)
         new_name = self._run_new_version(base, new_spec, start_now=False)
+        crash_point("replace.after_create_new")
 
         if old_running:
             # quiesce: stop old, keep its chips (the new version inherits
@@ -574,6 +594,14 @@ class ContainerService:
                 )
             except errors.ContainerNotExist:
                 old_running = False
+            except Exception:
+                # quiesce failed on a live engine error (chaos suite): undo
+                # the replacement so the flow stays atomic — otherwise the
+                # family is left with a version pointer at a container that
+                # will never start
+                self._undo_new_version(base, old_name, new_name)
+                raise
+        crash_point("replace.after_quiesce_old")
 
         def _resolve(n: str) -> str:
             return self.runtime.container_data_dir(n)
@@ -603,3 +631,20 @@ class ContainerService:
         else:
             self.wq.submit(FnTask(fn=_start_new, description=f"start {new_name}"))
         return new_name
+
+    def _undo_new_version(self, base: str, old_name: str, new_name: str) -> None:
+        """Best-effort compensation: retire a freshly created replacement
+        (container, ports, stored spec, version pointer) when the rest of
+        the flow cannot proceed. Every step is idempotent — the reconciler
+        applies the same recipe after a crash."""
+        with contextlib.suppress(Exception):
+            state = self.store.get_container(new_name)
+            spec = ContainerSpec.from_dict(state.spec)
+            self.ports.restore_ports(
+                [pb.host_port for pb in spec.port_bindings], owner=base)
+        with contextlib.suppress(Exception):
+            if self.runtime.container_exists(new_name):
+                self.runtime.container_remove(new_name, force=True)
+        self.store.delete_version(Resource.CONTAINERS, new_name)
+        _, old_version = split_versioned_name(old_name)
+        self.versions.rollback(base, old_version)

@@ -1,0 +1,67 @@
+"""Labeled crash points for the chaos harness (docs/robustness.md).
+
+Service mutations call :func:`crash_point` at the places where a daemon
+kill would leave the KV store and the runtime disagreeing. In production
+the calls are no-ops (one global ``is None`` check). The crash-consistency
+tests arm a label and the call raises :class:`SimulatedCrash`, which
+deliberately derives from ``BaseException`` so the services' ``except
+Exception`` rollback paths do NOT run — exactly like ``kill -9``, the
+in-process compensation never gets a chance. The test then boots a fresh
+``Program`` over the same KV + runtime and lets the reconciler
+(service/reconcile.py) repair the drift.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+#: every label compiled into the services, so tests can iterate "all crash
+#: points" without grepping (each insertion site registers itself here)
+KNOWN_CRASH_POINTS = (
+    # _run_new_version: version pointer bumped + persisted, no container yet
+    "replace.after_version_bump",
+    # _rolling_replace: new container created + spec persisted, old untouched
+    "replace.after_create_new",
+    # _rolling_replace: old container stopped, its ports freed, copy not queued
+    "replace.after_quiesce_old",
+    # patch_container_chips: extra chips claimed, no new version yet
+    "patch.after_alloc",
+    # patch_container_chips: replacement rolled, shrink chips not yet released
+    "patch.after_replace",
+)
+
+
+class SimulatedCrash(BaseException):
+    """The daemon 'died' at a labeled crash point (BaseException on purpose —
+    must not be swallowed by service-level ``except Exception`` rollbacks)."""
+
+    def __init__(self, label: str):
+        super().__init__(f"simulated crash at {label}")
+        self.label = label
+
+
+_armed: set[str] | None = None
+_mu = threading.Lock()
+
+
+def crash_point(label: str) -> None:
+    """No-op unless ``label`` is armed; then raises SimulatedCrash."""
+    if _armed is not None and label in _armed:
+        raise SimulatedCrash(label)
+
+
+@contextlib.contextmanager
+def armed(*labels: str):
+    """Arm crash points for the duration of a test block."""
+    global _armed
+    unknown = set(labels) - set(KNOWN_CRASH_POINTS)
+    if unknown:
+        raise ValueError(f"unknown crash points: {sorted(unknown)}")
+    with _mu:
+        _armed = set(labels)
+    try:
+        yield
+    finally:
+        with _mu:
+            _armed = None

@@ -1,0 +1,516 @@
+"""Crash-consistent startup/periodic reconciler (docs/robustness.md).
+
+The control plane persists desired state in the KV store and mutates the
+runtime through multi-step flows (version bump → create → quiesce → copy →
+start). A daemon death between any two steps — or an out-of-band ``docker
+rm`` — leaves the two sources of truth disagreeing: two live versions of a
+family, a version pointer with no container, chips and ports owned by
+nothing. The reference has no recovery story at all (its ``Init`` rebuilds
+schedulers from etcd and trusts them blindly, main.go:50-86).
+
+``Reconciler.reconcile()`` sweeps KV desired state against
+``runtime.container_list()``/``inspect`` actual state and repairs drift:
+
+- **half-completed rolling replacements** — a latest version that exists
+  but never started (docker status "created") while an older version is
+  still around is rolled BACK through the same compensation recipe the
+  in-process failure path uses (``ContainerService._undo_new_version``):
+  the old container keeps the data, the incomplete replacement is retired
+  and its resources freed. A latest that *has* run (status "exited")
+  crashed — it is restarted and stale older versions are retired;
+- **version pointers without specs / without containers** — rolled back to
+  the newest version that actually exists;
+- **orphaned containers** — runtime containers with stored state but no
+  version pointer are adopted (pointer + scheduler claims restored);
+  containers with no KV trace at all are removed;
+- **out-of-band removals** — a family gone from the runtime has its chips
+  and ports freed (double-free-guarded by scheduler ownership) and is
+  marked no-longer-desired so the repair is stable;
+- **leaked / missing resources** — per family, scheduler ownership is
+  reconciled to exactly the latest spec's claim (free the extras, re-claim
+  the missing), and owners that map to no known family are swept.
+
+Every action is recorded as a HealthWatcher-style event, counted in
+``MetricsRegistry`` (``reconcile_actions_total{action=...}``), and returned
+in the report served at ``GET /api/v1/reconcile``. ``dry_run=True`` reports
+the planned repairs without mutating anything.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+
+from tpu_docker_api import errors
+from tpu_docker_api.runtime.base import ContainerRuntime
+from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.scheduler.ports import PortScheduler
+from tpu_docker_api.scheduler.slices import ChipScheduler
+from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
+
+log = logging.getLogger(__name__)
+
+#: structural repairs per family per pass — each iteration re-evaluates the
+#: family after a pointer rollback; anything deeper than a few is a bug
+_MAX_FAMILY_PASSES = 5
+
+
+class Reconciler:
+    def __init__(
+        self,
+        runtime: ContainerRuntime,
+        store: StateStore,
+        chips: ChipScheduler,
+        ports: PortScheduler,
+        versions: VersionMap,
+        container_svc=None,
+        shared_version_maps: list[VersionMap] | None = None,
+        registry: MetricsRegistry | None = None,
+        max_events: int = 512,
+    ) -> None:
+        self.runtime = runtime
+        self.store = store
+        self.chips = chips
+        self.ports = ports
+        self.versions = versions
+        self._svc = container_svc
+        #: other owners of the SAME schedulers (the job service shares the
+        #: local chip/port pools) — their claims are off-limits to the sweep
+        self._shared_maps = shared_version_maps or []
+        self._registry = registry if registry is not None else REGISTRY
+        self._mu = threading.Lock()
+        self._events: collections.deque = collections.deque(maxlen=max_events)
+        self._last_report: dict | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle (periodic mode) ------------------------------------------------
+
+    def start_periodic(self, interval_s: float) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, args=(interval_s,), name="reconcile", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.reconcile()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("periodic reconcile failed")
+
+    # -- the sweep ----------------------------------------------------------------
+
+    def reconcile(self, dry_run: bool = False) -> dict:
+        t0 = time.perf_counter()
+        actions: list[dict] = []
+        families = self.versions.snapshot()
+        members = self._runtime_members()
+
+        for base in sorted(families):
+            if self._svc is not None and not dry_run:
+                with self._svc.family_lock(base):
+                    # under the lock, list fresh — the pre-lock snapshot
+                    # may predate a concurrent mutation
+                    self._reconcile_family(base, actions, dry_run)
+            else:
+                self._reconcile_family(base, actions, dry_run,
+                                       members=members.get(base, {}))
+        for base in sorted(set(members) - set(families)):
+            self._reconcile_orphan(base, actions, dry_run)
+        self._sweep_foreign_owners(actions, dry_run)
+
+        report = {
+            "dryRun": dry_run,
+            "actions": actions,
+            "driftCount": len(actions),
+            "durationMs": round((time.perf_counter() - t0) * 1e3, 2),
+        }
+        self._registry.counter_inc(
+            "reconcile_runs_total", {"dryRun": str(dry_run).lower()},
+            help="Reconcile sweeps executed")
+        if not dry_run:
+            with self._mu:
+                self._last_report = report
+        if actions:
+            log.info("reconcile%s: %d repairs: %s",
+                     " (dry-run)" if dry_run else "", len(actions),
+                     [a["action"] for a in actions])
+        return report
+
+    def events_view(self, limit: int = 100) -> list[dict]:
+        with self._mu:
+            return list(self._events)[-limit:]
+
+    def last_report(self) -> dict | None:
+        with self._mu:
+            return self._last_report
+
+    # -- helpers ------------------------------------------------------------------
+
+    def _runtime_members(self) -> dict[str, dict[int, str]]:
+        out: dict[str, dict[int, str]] = {}
+        for name in self.runtime.container_list():
+            base, version = split_versioned_name(name)
+            if version is not None:
+                out.setdefault(base, {})[version] = name
+        return out
+
+    def _act(self, actions: list[dict], dry_run: bool, action: str,
+             target: str, fn=None, **detail) -> None:
+        entry = {"action": action, "target": target, **detail}
+        actions.append(entry)
+        self._registry.counter_inc("reconcile_actions_total",
+                                   {"action": action, "dryRun": str(dry_run).lower()},
+                                   help="Drift repairs by kind")
+        log.info("reconcile%s: %s %s %s", " (dry-run)" if dry_run else "",
+                 action, target, detail or "")
+        if fn is not None and not dry_run:
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — one failing repair must
+                # not abort the sweep; the remaining families still get fixed
+                # and the failure is visible in the report/events/metrics
+                entry["error"] = f"{type(e).__name__}: {e}"
+                self._registry.counter_inc(
+                    "reconcile_action_failures_total", {"action": action},
+                    help="Drift repairs that raised")
+                log.warning("reconcile: %s %s failed: %s", action, target,
+                            entry["error"])
+        with self._mu:
+            self._events.append({"ts": time.time(), "dryRun": dry_run, **entry})
+
+    def _family_members(self, base: str) -> dict[int, str]:
+        return self._runtime_members().get(base, {})
+
+    # -- per-family repair --------------------------------------------------------
+
+    def _reconcile_family(self, base: str, actions: list[dict],
+                          dry_run: bool, members: dict[int, str] | None = None,
+                          ) -> None:
+        for _ in range(_MAX_FAMILY_PASSES):
+            if members is None:
+                # locked path: list fresh under the family lock; refreshed
+                # only after a structural repair — the only time it can
+                # change. Unlocked/dry-run callers pass the sweep's snapshot
+                members = self._family_members(base)
+            structural = self._family_pass(base, members, actions, dry_run)
+            if not structural or dry_run:
+                # dry-run stops at the first structural repair: the cascade
+                # cannot be predicted without applying it
+                return
+            members = None
+        log.warning("reconcile: family %s did not settle in %d passes",
+                    base, _MAX_FAMILY_PASSES)
+
+    def _family_pass(self, base: str, members: dict[int, str],
+                     actions: list[dict], dry_run: bool) -> bool:
+        """One structural evaluation. Returns True when it made (or, in
+        dry-run, planned) a structural change that warrants re-evaluation."""
+        latest = self.versions.get(base)
+        if latest is None:
+            return False
+        latest_name = versioned_name(base, latest)
+
+        try:
+            state = self.store.get_container(latest_name)
+        except errors.NotExistInStore:
+            # crash between version bump and spec persist: pointer with no
+            # spec — roll back to the newest version that is stored
+            stored = self.store.history(Resource.CONTAINERS, base)
+            prev = max((v for v in stored if v < latest), default=None)
+            if prev is None:
+                self._act(actions, dry_run, "drop-empty-family", base,
+                          fn=lambda: self.versions.remove(base))
+                self._release_all(base, actions, dry_run)
+                return False
+            self._act(actions, dry_run, "rollback-version-pointer", latest_name,
+                      to=prev, fn=lambda: self.versions.rollback(base, prev))
+            return True
+
+        spec = ContainerSpec.from_dict(state.spec)
+        try:
+            info = self.runtime.container_inspect(latest_name)
+        except errors.ContainerNotExist:
+            info = None
+
+        if info is None:
+            present = sorted(v for v in members if v != latest)
+            if present:
+                # latest is gone but an older version survives — adopt it
+                target = max(present)
+                self._act(actions, dry_run, "rollback-latest-missing",
+                          latest_name, to=target,
+                          fn=lambda: self.versions.rollback(base, target))
+                return True
+            # whole family removed out-of-band: free its resources and
+            # record that it is no longer desired (stable repair)
+            if state.desired_running:
+                def _mark_lost():
+                    state.desired_running = False
+                    self.store.put_container(state)
+                self._act(actions, dry_run, "mark-family-lost", latest_name,
+                          fn=_mark_lost)
+            self._reconcile_resources(base, spec, desired=False,
+                                      actions=actions, dry_run=dry_run)
+            return False
+
+        older_running = sorted(
+            n for v, n in members.items()
+            if v != latest and self._running(n))
+
+        if not info.running and state.desired_running:
+            if info.status == "created" and members.keys() - {latest}:
+                # half-completed rolling replacement: the new version never
+                # started and the old one (with the data) is still around —
+                # roll back through the service's own compensation recipe
+                old_name = versioned_name(
+                    base, max(v for v in members if v != latest))
+                self._act(actions, dry_run, "rollback-half-replacement",
+                          latest_name, keep=old_name,
+                          fn=lambda: self._undo_replacement(
+                              base, old_name, latest_name))
+                return True
+            if info.status == "created":
+                # created-not-started with no predecessor (crash between
+                # create and first start): finish forward, nothing to migrate
+                self._act(actions, dry_run, "start-created", latest_name,
+                          fn=lambda: self.runtime.container_start(latest_name))
+            else:
+                self._restart_dead(base, latest_name, spec, actions, dry_run)
+        elif info.running and not state.desired_running:
+            # user asked for stop but the runtime disagrees (ambiguous stop)
+            self._act(actions, dry_run, "stop-undesired", latest_name,
+                      fn=lambda: self.runtime.container_stop(latest_name))
+
+        for name in older_running:
+            # two live versions of one family: the latest is authoritative —
+            # retire the stale one (kept stopped, as after a normal replace)
+            self._act(actions, dry_run, "retire-stale-version", name,
+                      fn=lambda n=name: self.runtime.container_stop(n))
+
+        self._reconcile_resources(base, spec, desired=state.desired_running,
+                                  actions=actions, dry_run=dry_run)
+        return False
+
+    def _running(self, name: str) -> bool:
+        try:
+            return self.runtime.container_inspect(name).running
+        except errors.ContainerNotExist:
+            return False
+
+    def _undo_replacement(self, base: str, old_name: str, new_name: str) -> None:
+        if self._svc is not None:
+            self._svc._undo_new_version(base, old_name, new_name)
+            return
+        # standalone fallback: same recipe, inline
+        try:
+            spec = ContainerSpec.from_dict(self.store.get_container(new_name).spec)
+            self.ports.restore_ports(
+                [pb.host_port for pb in spec.port_bindings], owner=base)
+        except errors.NotExistInStore:
+            pass
+        if self.runtime.container_exists(new_name):
+            self.runtime.container_remove(new_name, force=True)
+        self.store.delete_version(Resource.CONTAINERS, new_name)
+        _, old_version = split_versioned_name(old_name)
+        self.versions.rollback(base, old_version)
+
+    def _restart_dead(self, base: str, latest_name: str, spec: ContainerSpec,
+                      actions: list[dict], dry_run: bool) -> None:
+        """desired_running=true but the container is dead. A crash never
+        releases chips/ports, but a crash *mid-replace* may have (the
+        quiesce step frees the old ports) — re-claim before restarting so
+        scheduler accounting matches the running container again."""
+        port_conflicts, err_p = self._guarded_claim(
+            self.ports.try_claim_ports, self._scheduled_ports(spec), base,
+            dry_run)
+        chip_conflicts, err_c = self._guarded_claim(
+            self.chips.try_claim_chips, spec.chip_ids, base, dry_run)
+        conflicts = port_conflicts + chip_conflicts
+        if conflicts or err_p or err_c:
+            # someone else holds the resources (or the claim itself failed):
+            # restarting would double-bind — report and leave for next sweep
+            self._act(actions, dry_run, "restart-blocked", latest_name,
+                      conflicts=conflicts,
+                      **({"error": err_p or err_c} if err_p or err_c else {}))
+            return
+        self._act(actions, dry_run, "restart-dead", latest_name,
+                  fn=lambda: self.runtime.container_restart(latest_name))
+
+    # -- orphans ------------------------------------------------------------------
+
+    def _reconcile_orphan(self, base: str, actions: list[dict],
+                          dry_run: bool) -> None:
+        """Runtime containers whose family has no version pointer."""
+        if self._svc is not None and not dry_run:
+            with self._svc.family_lock(base):
+                self._orphan_pass(base, actions, dry_run)
+        else:
+            self._orphan_pass(base, actions, dry_run)
+
+    def _orphan_pass(self, base: str, actions: list[dict],
+                     dry_run: bool) -> None:
+        # re-check under the family lock: the pre-sweep snapshot may predate
+        # a concurrent create (version bumped, container just created) —
+        # force-removing that "orphan" would delete a container mid-build
+        if self.versions.get(base) is not None:
+            return
+        members = self._family_members(base)
+        if not members:
+            return
+        stored = set(self.store.history(Resource.CONTAINERS, base))
+        adoptable = sorted(v for v in members if v in stored)
+        if adoptable:
+            target = adoptable[-1]
+            self._act(actions, dry_run, "adopt-orphan",
+                      versioned_name(base, target), version=target,
+                      fn=lambda: self.versions.set(base, target))
+            if not dry_run:
+                self._reconcile_family(base, actions, dry_run)
+            return
+        for v in sorted(members):
+            name = members[v]
+            self._act(actions, dry_run, "remove-orphan", name,
+                      fn=lambda n=name: self.runtime.container_remove(
+                          n, force=True))
+
+    # -- resource accounting ------------------------------------------------------
+
+    def _guarded_claim(self, claim, items: list[int], owner: str,
+                       dry_run: bool) -> tuple[list[int], str]:
+        """Run a try_claim_* with the same error isolation _act gives fn
+        callbacks: a KV hiccup mid-claim must not abort the sweep."""
+        if dry_run:
+            return [], ""
+        try:
+            return claim(items, owner=owner), ""
+        except Exception as e:  # noqa: BLE001
+            err = f"{type(e).__name__}: {e}"
+            self._registry.counter_inc(
+                "reconcile_action_failures_total", {"action": "reclaim"},
+                help="Drift repairs that raised")
+            log.warning("reconcile: reclaim for %s failed: %s", owner, err)
+            return [], err
+
+    def _scheduled_ports(self, spec: ContainerSpec) -> list[int]:
+        """Host ports the scheduler has jurisdiction over. Explicit
+        user-specified ports outside [start_port, end_port] were never
+        pool-allocated — treating them as expected claims would report
+        phantom conflicts on every sweep."""
+        return [pb.host_port for pb in spec.port_bindings
+                if pb.host_port
+                and self.ports.start_port <= pb.host_port <= self.ports.end_port]
+
+    def _reconcile_resources(self, base: str, spec: ContainerSpec,
+                             desired: bool, actions: list[dict],
+                             dry_run: bool) -> None:
+        """Converge scheduler ownership to exactly the latest spec's claim:
+        a family that wants to run owns its spec's chips/ports, a stopped or
+        lost family owns nothing. Frees are owner-guarded (``restore_*``
+        skips resources re-allocated to someone else — no double-free)."""
+        expected_chips = set(spec.chip_ids) if desired else set()
+        owned_chips = set(self.chips.owned_chips(base))
+        extra = sorted(owned_chips - expected_chips)
+        if extra:
+            self._act(actions, dry_run, "free-leaked-chips", base, chips=extra,
+                      fn=lambda: self.chips.restore_chips(extra, owner=base))
+        missing = sorted(expected_chips - owned_chips)
+        if missing:
+            conflicts, err = self._guarded_claim(
+                self.chips.try_claim_chips, missing, base, dry_run)
+            self._act(actions, dry_run,
+                      "chips-conflict" if conflicts else "reclaim-chips",
+                      base, chips=missing,
+                      **({"conflicts": conflicts} if conflicts else {}),
+                      **({"error": err} if err else {}))
+
+        expected_ports = set(self._scheduled_ports(spec)) if desired else set()
+        owned_ports = {p for p, o in self.ports.status()["owners"].items()
+                       if o == base}
+        extra_p = sorted(owned_ports - expected_ports)
+        if extra_p:
+            self._act(actions, dry_run, "free-leaked-ports", base, ports=extra_p,
+                      fn=lambda: self.ports.restore_ports(extra_p, owner=base))
+        missing_p = sorted(expected_ports - owned_ports)
+        if missing_p:
+            conflicts, err = self._guarded_claim(
+                self.ports.try_claim_ports, missing_p, base, dry_run)
+            self._act(actions, dry_run,
+                      "ports-conflict" if conflicts else "reclaim-ports",
+                      base, ports=missing_p,
+                      **({"conflicts": conflicts} if conflicts else {}),
+                      **({"error": err} if err else {}))
+
+    def _release_all(self, base: str, actions: list[dict],
+                     dry_run: bool) -> None:
+        chips = self.chips.owned_chips(base)
+        if chips:
+            self._act(actions, dry_run, "free-leaked-chips", base, chips=chips,
+                      fn=lambda: self.chips.restore_chips(chips, owner=base))
+        ports = sorted(p for p, o in self.ports.status()["owners"].items()
+                       if o == base)
+        if ports:
+            self._act(actions, dry_run, "free-leaked-ports", base, ports=ports,
+                      fn=lambda: self.ports.restore_ports(ports, owner=base))
+
+    def _sweep_foreign_owners(self, actions: list[dict], dry_run: bool) -> None:
+        """Chips/ports whose owner is no known family — freed. Owners from
+        shared version maps (the job service allocates from the same pools)
+        are left alone."""
+        known: set[str] = set(self.versions.snapshot())
+        known |= set(self._runtime_members())
+        for vm in self._shared_maps:
+            known |= set(vm.snapshot())
+        known.add("")  # anonymous allocations are not ours to judge
+
+        chip_owners: dict[str, list[int]] = {}
+        for c in self.chips.status()["chips"]:
+            if c["used"]:
+                chip_owners.setdefault(c["owner"], []).append(c["chipId"])
+        for owner, ids in sorted(chip_owners.items()):
+            if owner not in known:
+                self._act(actions, dry_run, "free-leaked-chips", owner,
+                          chips=ids,
+                          fn=lambda o=owner, i=ids: self._free_foreign(
+                              self.chips.restore_chips, o, i))
+
+        port_owners: dict[str, list[int]] = {}
+        for p, o in self.ports.status()["owners"].items():
+            port_owners.setdefault(o, []).append(p)
+        for owner, ps in sorted(port_owners.items()):
+            if owner not in known:
+                self._act(actions, dry_run, "free-leaked-ports", owner,
+                          ports=sorted(ps),
+                          fn=lambda o=owner, i=ps: self._free_foreign(
+                              self.ports.restore_ports, o, i))
+
+    def _free_foreign(self, restore, owner: str, items: list[int]) -> None:
+        """Free an unknown owner's claim — re-checked under the owner's
+        family lock: run_container claims chips BEFORE its version pointer
+        or container exists, so the sweep's pre-claim snapshot could
+        misread an in-flight create as a leak and free chips out from
+        under it. Under the lock the create has either finished (owner
+        known now → skip) or rolled back (restore is an owner-guarded
+        no-op)."""
+        lock = (self._svc.family_lock(owner) if self._svc is not None
+                else contextlib.nullcontext())
+        with lock:
+            if self.versions.get(owner) is not None:
+                return
+            if any(vm.get(owner) is not None for vm in self._shared_maps):
+                return
+            if owner in self._runtime_members():
+                return
+            restore(items, owner=owner)

@@ -92,6 +92,8 @@ class WorkQueue:
         self._backoff_base_s = backoff_base_s
         self._thread: threading.Thread | None = None
         self.dead_letters: list[tuple[Task, str]] = []
+        self._dl_mu = threading.Lock()
+        self._lifecycle_mu = threading.Lock()
 
     # -- producer side -----------------------------------------------------------
 
@@ -112,11 +114,15 @@ class WorkQueue:
         """Drain queued tasks, then stop the loop (reference drains only
         in-flight tasks and drops queued ones, workQueue.go:20-22 — we do
         better and finish everything already submitted)."""
-        if self._thread is None:
-            return
-        self._q.put(None)  # sentinel
-        self._thread.join()
-        self._thread = None
+        # _lifecycle_mu orders close vs retry_dead_letters: a retry that
+        # wins the lock enqueues before the sentinel (and is drained); one
+        # that loses sees _thread None and no-ops
+        with self._lifecycle_mu:
+            if self._thread is None:
+                return
+            self._q.put(None)  # sentinel
+            self._thread.join()
+            self._thread = None
 
     def drain(self) -> None:
         """Block until everything submitted so far is processed (test hook)."""
@@ -147,7 +153,8 @@ class WorkQueue:
                             task, attempt + 1, self._max_retries, last_err)
                 time.sleep(self._backoff_base_s * (2**attempt))
         log.error("workqueue task %r dead-lettered: %s", task, last_err)
-        self.dead_letters.append((task, last_err))
+        with self._dl_mu:
+            self.dead_letters.append((task, last_err))
         if isinstance(task, CopyTask) and task.on_fail is not None:
             try:
                 task.on_fail()
@@ -157,7 +164,27 @@ class WorkQueue:
     def dead_letter_view(self) -> list[dict]:
         """Snapshot for the debug endpoint — dead letters must be observable,
         not an in-memory secret."""
-        return [{"task": repr(t), "error": e} for t, e in self.dead_letters]
+        with self._dl_mu:
+            return [{"task": repr(t), "error": e} for t, e in self.dead_letters]
+
+    def retry_dead_letters(self) -> int:
+        """Re-enqueue every dead-lettered task (POST /api/v1/dead-letters/
+        retry) — the operator fixed the underlying fault (disk full, engine
+        down) and wants the lost work to run, not a process restart. Each
+        task gets a fresh retry budget; tasks that fail again dead-letter
+        again. Returns how many were re-enqueued."""
+        with self._lifecycle_mu:
+            if self._thread is None:
+                # queue closed: keep the letters observable in
+                # dead_letter_view rather than stranding them behind the
+                # shutdown sentinel in a consumerless queue
+                return 0
+            with self._dl_mu:
+                tasks = [t for t, _ in self.dead_letters]
+                self.dead_letters.clear()
+            for task in tasks:
+                self._q.put(task)
+            return len(tasks)
 
     def _execute(self, task: Task) -> None:
         if isinstance(task, PutKVTask):
