@@ -18,7 +18,12 @@ import pytest
 from tpu_docker_api import config as config_mod
 from tpu_docker_api.daemon import Program
 from tpu_docker_api.runtime.fake import FakeRuntime
-from tpu_docker_api.runtime.faulty import FaultyRuntime, FaultPlan, fail_nth
+from tpu_docker_api.runtime.faulty import (
+    FaultPlan,
+    FaultRule,
+    FaultyRuntime,
+    fail_nth,
+)
 from tpu_docker_api.schemas.container import (
     Bind,
     ContainerPatchChips,
@@ -26,12 +31,18 @@ from tpu_docker_api.schemas.container import (
     ContainerPort,
     ContainerRun,
 )
+from tpu_docker_api.schemas.job import JobPatchChips, JobRun
 from tpu_docker_api.service.crashpoints import (
+    CONTAINER_CRASH_POINTS,
+    JOB_CRASH_POINTS,
     KNOWN_CRASH_POINTS,
     SimulatedCrash,
     armed,
 )
-from tpu_docker_api.service.invariants import check_invariants
+from tpu_docker_api.service.invariants import (
+    check_invariants,
+    check_job_invariants,
+)
 from tpu_docker_api.state.kv import MemoryKV
 
 pytestmark = pytest.mark.chaos
@@ -91,7 +102,10 @@ CASES = (
 
 
 def test_case_matrix_covers_every_crash_point():
-    assert {p for _, p in CASES} == set(KNOWN_CRASH_POINTS)
+    assert {p for _, p in CASES} == set(CONTAINER_CRASH_POINTS)
+    assert {p for _, p in JOB_CASES} == set(JOB_CRASH_POINTS)
+    assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
+            == set(KNOWN_CRASH_POINTS))
 
 
 def _mutations(runtime: FakeRuntime) -> list:
@@ -168,6 +182,246 @@ def test_crashed_flow_without_reconcile_violates_invariants(tmp_path):
     assert check_invariants(
         runtime, prg2.store, prg2.container_versions,
         prg2.chip_scheduler, prg2.port_scheduler) != []
+
+
+def boot_pod(kv, local_rt, remote_rt) -> Program:
+    """A 2-host v5e pod (8 chips each): h0 is the daemon-local host sharing
+    the injected runtime/schedulers, h1 a 'remote' fake engine injected via
+    ``pod_runtimes`` so a restarted daemon drives the SAME engines."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        pod_hosts=[
+            {"host_id": "h0", "address": "10.0.0.1", "grid_coord": [0, 0, 0],
+             "local": True},
+            {"host_id": "h1", "address": "10.0.0.2", "grid_coord": [1, 0, 0],
+             "runtime_backend": "fake"},
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=local_rt,
+                  pod_runtimes={"h1": remote_rt})
+    prg.init()
+    return prg
+
+
+#: job flows × the crash points each actually traverses. "run" dies inside
+#: run_job; "rescale" covers the _run_version points again on the NEW
+#: version plus the patch swap points; "gang" dies inside the supervisor's
+#: whole-gang restart
+_JOB_RUN_POINTS = ("job.run.after_version_bump", "job.run.after_create")
+_JOB_PATCH_POINTS = ("job.patch.after_quiesce_old", "job.patch.after_start_new")
+_JOB_GANG_POINTS = ("job.gang.after_mark_restarting", "job.gang.after_stop_all")
+
+JOB_CASES = (
+    [("run", p) for p in _JOB_RUN_POINTS]
+    + [("rescale", p) for p in _JOB_RUN_POINTS + _JOB_PATCH_POINTS]
+    + [("gang", p) for p in _JOB_GANG_POINTS]
+)
+
+
+def _job_oracle(prg) -> list[str]:
+    problems = check_job_invariants(
+        prg.pod, prg.pod_scheduler, prg.store, prg.job_versions)
+    # the shared local schedulers must also be clean from the container
+    # layer's point of view (job owners are not leaks)
+    problems += check_invariants(
+        prg.runtime, prg.store, prg.container_versions,
+        prg.chip_scheduler, prg.port_scheduler,
+        job_versions=prg.job_versions)
+    return problems
+
+
+@pytest.mark.parametrize("flow,point", JOB_CASES,
+                         ids=[f"{f}@{p}" for f, p in JOB_CASES])
+def test_job_crash_restart_reconcile_converges(flow, point):
+    kv = MemoryKV()
+    rt0, rt1 = FakeRuntime(), FakeRuntime()
+    prg = boot_pod(kv, rt0, rt1)
+
+    if flow == "rescale":
+        # sub-host job on h0; the rescale to 8 chips (one whole host) takes
+        # the fast path onto the fully-free h1
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=4))
+    elif flow == "gang":
+        # 16 chips = both hosts: a real 2-member gang, coordinator on h0
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))
+
+    with armed(point):
+        with pytest.raises(SimulatedCrash):
+            if flow == "run":
+                prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                           chip_count=16))
+            elif flow == "rescale":
+                prg.job_svc.patch_job_chips(
+                    "train", JobPatchChips(chip_count=8))
+            else:
+                rt1.crash_container("train-0-p1")
+                prg.job_supervisor.poll_once()
+
+    # the daemon is dead; a fresh control plane boots over the same engines
+    prg2 = boot_pod(kv, rt0, rt1)
+
+    # dry-run reports the drift without mutating anything
+    kv_before = dict(kv.range_prefix("/"))
+    muts_before = (_mutations(rt0), _mutations(rt1))
+    dry = prg2.reconciler.reconcile(dry_run=True)
+    assert dry["actions"], f"no job drift reported at {flow}@{point}"
+    assert dict(kv.range_prefix("/")) == kv_before
+    assert (_mutations(rt0), _mutations(rt1)) == muts_before
+
+    report = prg2.reconciler.reconcile()
+    assert report["actions"], f"nothing repaired at {flow}@{point}"
+
+    problems = _job_oracle(prg2)
+    assert problems == [], f"{flow}@{point}: {problems}"
+
+    latest = prg2.job_versions.get("train")
+    if flow == "run":
+        # the half-created job was scrubbed: family gone, capacity free
+        assert latest is None
+        assert all(len(h.chips.free_chips) == 8
+                   for h in prg2.pod.hosts.values())
+    else:
+        st = prg2.store.get_job(f"train-{latest}")
+        assert st.phase == "running", f"{flow}@{point}: {st.phase}"
+        # one consistent gang: every member of the latest version runs
+        for host_id, cname, *_ in st.placements:
+            info = prg2.pod.hosts[host_id].runtime.container_inspect(cname)
+            assert info.running, f"{cname} dead after reconcile"
+
+    # a second sweep finds nothing: the repair is a fixpoint
+    assert prg2.reconciler.reconcile()["actions"] == []
+
+
+def test_job_crash_without_reconcile_violates_invariants():
+    """Oracle sanity: a mid-rescale crash DOES corrupt state (the job matrix
+    would be vacuous if the invariants held without repair)."""
+    kv = MemoryKV()
+    rt0, rt1 = FakeRuntime(), FakeRuntime()
+    prg = boot_pod(kv, rt0, rt1)
+    prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                               chip_count=4))
+    with armed("job.patch.after_quiesce_old"):
+        with pytest.raises(SimulatedCrash):
+            prg.job_svc.patch_job_chips("train", JobPatchChips(chip_count=8))
+    prg2 = boot_pod(kv, rt0, rt1)
+    assert _job_oracle(prg2) != []
+
+
+class TestJobCrashLoop:
+    """Seeded FaultyRuntime crash loop: the gang burns its restart budget
+    through strictly-increasing backoff and converges to terminal `failed`
+    with every slice and port reusable."""
+
+    def test_backoff_then_failed_then_capacity_reusable(self):
+        from tpu_docker_api.service.job_supervisor import JobSupervisor
+
+        kv = MemoryKV()
+        rt0 = FakeRuntime()
+        rt1 = FaultyRuntime(FakeRuntime(), FaultPlan(rules=[], seed=7))
+        prg = boot_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))
+
+        clock = {"now": 0.0}
+        sup = JobSupervisor(
+            prg.pod, prg.job_svc, prg.store, prg.job_versions,
+            max_restarts=3, backoff_base_s=1.0, backoff_max_s=4.0,
+            backoff_jitter=0.0, seed=7, clock=lambda: clock["now"],
+        )
+
+        # from now on every start of the h1 member fails: each gang restart
+        # stops the survivors, restarts the coordinator, then dies on p1
+        rt1.add_rules([FaultRule(op="container_start", times=-1, mode="fail")])
+        rt1.crash_container("train-0-p1")
+
+        delays = []
+        for _ in range(10):
+            sup.poll_once()
+            st = prg.store.get_job("train-0")
+            if st.phase == "failed":
+                break
+            clock["now"] += 100.0  # jump past any backoff deadline
+        delays = [e["backoff_s"] for e in sup.events_view(limit=500)
+                  if e["event"] == "gang-restarting"]
+
+        st = prg.store.get_job("train-0")
+        assert st.phase == "failed"
+        assert "crash loop" in st.failure_reason
+        assert st.restarts == 3
+        # exponential, strictly increasing up to the cap
+        assert delays == [1.0, 2.0, 4.0]
+        assert delays == sorted(delays) and max(delays) <= 4.0
+
+        # terminal: owns zero slices and zero ports
+        assert _job_oracle(prg) == []
+        assert prg.pod_scheduler.get_grant("train-0") is None
+
+        # ... and the freed capacity is immediately reusable
+        rt1.clear_rules()
+        out = prg.job_svc.run_job(JobRun(image_name="jax", job_name="train2",
+                                         chip_count=16))
+        assert out["phase"] == "running"
+        assert len(out["processes"]) == 2
+
+        # the failed job survives as a readable post-mortem
+        info = prg.job_svc.get_job_info("train-0")
+        assert info["phase"] == "failed"
+        assert "crash loop" in info["failureReason"]
+
+    def test_reconciler_respects_exhausted_budget(self):
+        """A daemon reboot must not hand a crash-looping gang a fresh life:
+        with the persisted budget already burned, the startup reconciler
+        converges the job to failed instead of restarting it again."""
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))
+        # burn the whole budget (default job_max_restarts=3), then die again
+        for _ in range(3):
+            rt1.crash_container("train-0-p1")
+            prg.job_svc.restart_gang("train", reason="test")
+        rt1.crash_container("train-0-p1")
+
+        prg2 = boot_pod(kv, rt0, rt1)
+        report = prg2.reconciler.reconcile()
+        assert "fail-job-crash-loop" in [a["action"] for a in report["actions"]]
+        st = prg2.store.get_job("train-0")
+        assert st.phase == "failed" and st.restarts == 3
+        assert _job_oracle(prg2) == []
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    def test_deferred_restart_respects_backoff_window(self):
+        from tpu_docker_api.service.job_supervisor import JobSupervisor
+
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))
+        clock = {"now": 0.0}
+        sup = JobSupervisor(
+            prg.pod, prg.job_svc, prg.store, prg.job_versions,
+            max_restarts=5, backoff_base_s=10.0, backoff_max_s=60.0,
+            backoff_jitter=0.0, clock=lambda: clock["now"],
+        )
+        rt1.crash_container("train-0-p1")
+        sup.poll_once()  # restart #1, arms a 10 s deadline
+        assert prg.store.get_job("train-0").restarts == 1
+        rt1.crash_container("train-0-p1")
+        clock["now"] = 5.0  # inside the window: deferred, no restart
+        sup.poll_once()
+        assert prg.store.get_job("train-0").restarts == 1
+        assert not rt1.container_inspect("train-0-p1").running
+        events = [e["event"] for e in sup.events_view()]
+        assert "gang-restart-deferred" in events
+        clock["now"] = 11.0  # window passed
+        sup.poll_once()
+        assert prg.store.get_job("train-0").restarts == 2
+        assert rt1.container_inspect("train-0-p1").running
 
 
 class TestAmbiguousEngineFailures:

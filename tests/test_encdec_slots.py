@@ -9,6 +9,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+#: JAX-compile heavy: excluded from the `-m 'not slow'` quick tier so it
+#: fits its time budget; still runs in `make test` (the full suite)
+pytestmark = pytest.mark.slow
+
+
 from tpu_docker_api.infer.encdec_slots import EncDecSlotEngine
 from tpu_docker_api.models.encdec import (
     encdec_generate,

@@ -12,6 +12,11 @@ import urllib.request
 
 import pytest
 
+#: JAX-compile heavy: excluded from the `-m 'not slow'` quick tier so it
+#: fits its time budget; still runs in `make test` (the full suite)
+pytestmark = pytest.mark.slow
+
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -14,6 +14,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+#: JAX-compile heavy: excluded from the `-m 'not slow'` quick tier so it
+#: fits its time budget; still runs in `make test` (the full suite)
+pytestmark = pytest.mark.slow
+
+
 from tpu_docker_api.infer.engine import GenerateConfig, make_generate_fn
 from tpu_docker_api.infer.slots import Handle, SlotEngine, _default_buckets
 from tpu_docker_api.models.llama import LlamaConfig, llama_init, llama_presets

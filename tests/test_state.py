@@ -197,6 +197,27 @@ class TestWorkQueue:
         assert len(attempts) == 3
         assert len(wq.dead_letters) == 1
 
+    def test_retry_backoff_capped_and_jittered(self, kv):
+        """The retry sleep is min(cap, base·2^attempt) ± jitter — unbounded
+        2^attempt would stall the single sync thread for minutes, and
+        jitterless sleeps synchronize every daemon hit by the same outage."""
+        wq = WorkQueue(kv, backoff_base_s=0.5, backoff_max_s=2.0,
+                       backoff_jitter=0.25, seed=42)
+        delays = [wq.retry_delay_s(a) for a in range(8)]
+        # clamped: even attempt 7 (raw 64 s) stays within cap + jitter
+        assert all(d <= 2.0 * 1.25 for d in delays)
+        # jittered around the schedule, not exactly on it
+        assert delays[0] != 0.5 and abs(delays[0] - 0.5) <= 0.125
+        assert abs(delays[1] - 1.0) <= 0.25
+        # deterministic under a seed (replayable chaos runs)
+        wq2 = WorkQueue(kv, backoff_base_s=0.5, backoff_max_s=2.0,
+                        backoff_jitter=0.25, seed=42)
+        assert [wq2.retry_delay_s(a) for a in range(8)] == delays
+        # jitter can be disabled for exact-schedule tests
+        wq3 = WorkQueue(kv, backoff_base_s=0.5, backoff_max_s=2.0,
+                        backoff_jitter=0.0)
+        assert [wq3.retry_delay_s(a) for a in range(4)] == [0.5, 1.0, 2.0, 2.0]
+
     def test_tasks_execute_in_order(self, kv):
         order = []
         wq = WorkQueue(kv)

@@ -8,6 +8,11 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+#: JAX-compile heavy: excluded from the `-m 'not slow'` quick tier so it
+#: fits its time budget; still runs in `make test` (the full suite)
+pytestmark = pytest.mark.slow
+
+
 from tpu_docker_api.models.llama import llama_presets
 from tpu_docker_api.parallel.mesh import MeshPlan, build_mesh
 from tpu_docker_api.train.trainer import (

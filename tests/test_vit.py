@@ -2,6 +2,13 @@
 training behavior, permutation equivariance sanity, and the generic trainer
 with a tuple batch on a sharded mesh."""
 
+import pytest
+
+#: JAX-compile heavy: excluded from the `-m 'not slow'` quick tier so it
+#: fits its time budget; still runs in `make test` (the full suite)
+pytestmark = pytest.mark.slow
+
+
 import dataclasses
 
 import jax

@@ -129,7 +129,8 @@ class Router:
 def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  chip_scheduler, port_scheduler, work_queue=None,
                  health_watcher=None, metrics=None,
-                 job_svc=None, pod_scheduler=None, reconciler=None) -> Router:
+                 job_svc=None, pod_scheduler=None, reconciler=None,
+                 job_supervisor=None) -> Router:
     r = Router(metrics=metrics)
 
     # -- containers (reference api/container.go:19-38) ---------------------------
@@ -330,18 +331,31 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/resources/ports", lambda body, **_: port_scheduler.status())
     r.add("GET", "/healthz",
           lambda body, **_: {"status": "ok", **build_info()})
-    if health_watcher is not None:
-        # liveness transitions + auto-restart bookkeeping (SURVEY.md §5.3)
+    if health_watcher is not None or job_supervisor is not None:
+        # one events ring for the operator: container liveness transitions
+        # (health watcher) merged with gang lifecycle events (job
+        # supervisor), ordered by timestamp (SURVEY.md §5.3)
         def h_events(body, **_):
             try:
                 limit = int(body.get("limit", 100))
             except (TypeError, ValueError):
                 raise errors.BadRequest("limit must be an integer") from None
-            return health_watcher.events_view(limit=limit)
+            events = []
+            if health_watcher is not None:
+                events.extend(health_watcher.events_view(limit=limit))
+            if job_supervisor is not None:
+                events.extend(job_supervisor.events_view(limit=limit))
+            events.sort(key=lambda e: e.get("ts", 0))
+            return events[-limit:] if limit > 0 else []
 
         r.add("GET", "/api/v1/events", h_events)
+    if health_watcher is not None:
         r.add("GET", "/api/v1/health/containers",
               lambda body, **_: health_watcher.status_view())
+    if job_supervisor is not None:
+        # per-gang phase / restart budget / backoff state
+        r.add("GET", "/api/v1/health/jobs",
+              lambda body, **_: job_supervisor.status_view())
     if work_queue is not None:
         # failed async tasks must be observable (fix for the reference's
         # silent infinite-retry loop, workQueue.go:33-47)

@@ -157,7 +157,8 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Place a distributed JAX job: one process container per host over an "
      "ICI-contiguous slice, coordinator + TPU_PROCESS_* env rendered", "JobRun"),
     ("GET", "/api/v1/jobs/{name}", "getJobInfo",
-     "Job spec + per-process live state; historical versions readable", None),
+     "Job spec + per-process live state + gang phase/restarts/failureReason; "
+     "historical versions readable", None),
     ("DELETE", "/api/v1/jobs/{name}", "deleteJob",
      "Remove all job versions, free slices and ports", "JobDelete"),
     ("PATCH", "/api/v1/jobs/{name}/tpu", "patchJobChips",
@@ -166,7 +167,8 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("POST", "/api/v1/jobs/{name}/stop", "stopJob",
      "Quiesce every process container (checkpoint flush)", None),
     ("PATCH", "/api/v1/jobs/{name}/restart", "restartJob",
-     "Restart every process container of the latest version", None),
+     "Whole-gang restart: stop every member (coordinator last), start in "
+     "process order (coordinator first); resets the restart budget", None),
     ("GET", "/api/v1/resources/tpus", "getTpus",
      "Chip map: coords, owner, fragmentation (largest free block)", None),
     ("GET", "/api/v1/resources/gpus", "getTpusCompat",
@@ -176,9 +178,13 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("GET", "/api/v1/resources/slices", "getSlices",
      "Pod view: host grid, per-host free chips, active slice grants", None),
     ("GET", "/api/v1/events", "getHealthEvents",
-     "Container liveness transitions seen by the health watcher", None),
+     "Container liveness transitions (health watcher) merged with gang "
+     "lifecycle events (job supervisor), ordered by timestamp", None),
     ("GET", "/api/v1/health/containers", "getHealthStatus",
      "Per-container liveness + restart bookkeeping", None),
+    ("GET", "/api/v1/health/jobs", "getJobHealth",
+     "Per-job gang status: phase (running/restarting/failed/stopped), "
+     "restart budget, dead/missing members, backoff remaining", None),
     ("GET", "/api/v1/debug/deadletters", "getDeadLetters",
      "Async tasks that exhausted retries (never silently dropped)", None),
     ("POST", "/api/v1/dead-letters/retry", "retryDeadLetters",

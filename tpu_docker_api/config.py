@@ -53,6 +53,21 @@ class Config:
     reconcile_interval: float = 0.0
     # "none" (observe only) | "on-failure" (bounded auto-restart)
     restart_policy: str = "none"
+    # per-container restart backoff (service/watch.py): base seconds between
+    # automatic restarts, doubled per attempt up to the max; 0 = immediate
+    # restarts (the pre-backoff behavior)
+    restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 30.0
+    # gang supervisor (service/job_supervisor.py): member-liveness poll
+    # interval over all pod hosts; 0 disables supervision
+    job_supervise_interval: float = 5.0
+    # whole-gang restarts before a crash-looping job goes terminal "failed"
+    job_max_restarts: int = 3
+    # exponential backoff between gang restarts: base·2^n seconds, clamped
+    # to the max, ±jitter fraction so gangs don't restart in lockstep
+    job_backoff_base_s: float = 1.0
+    job_backoff_max_s: float = 60.0
+    job_backoff_jitter: float = 0.1
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container

@@ -40,16 +40,20 @@ log = logging.getLogger(__name__)
 
 class Program:
     def __init__(self, cfg: config_mod.Config, host: str = "0.0.0.0",
-                 kv=None, runtime=None) -> None:
+                 kv=None, runtime=None, pod_runtimes=None) -> None:
         self.cfg = cfg
         self.host = host
         self.api_server: ApiServer | None = None
         # injection seam for the crash-consistency harness: a "restarted"
         # Program must boot over the SAME KV + runtime the dead one used
         # (with the default memory backend, open_store would hand each
-        # Program a fresh empty store and hide every crash bug)
+        # Program a fresh empty store and hide every crash bug).
+        # ``pod_runtimes`` extends the seam to multi-host pods: host_id →
+        # runtime for non-local [[pod_hosts]] entries, so a "restarted"
+        # daemon sees the same remote engines the dead one drove
         self._injected_kv = kv
         self._injected_runtime = runtime
+        self._injected_pod_runtimes = pod_runtimes or {}
 
     def init(self) -> None:
         cfg = self.cfg
@@ -84,10 +88,23 @@ class Program:
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path,
         )
+        from tpu_docker_api.service.job_supervisor import JobSupervisor
         from tpu_docker_api.service.reconcile import Reconciler
         from tpu_docker_api.telemetry.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+        # gang supervision (whole-gang restart with backoff, crash-loop →
+        # terminal failed): built in init so the startup reconcile and the
+        # watcher's delegation hook can use it before start()
+        self.job_supervisor = JobSupervisor(
+            self.pod, self.job_svc, self.store, self.job_versions,
+            interval_s=cfg.job_supervise_interval,
+            max_restarts=cfg.job_max_restarts,
+            backoff_base_s=cfg.job_backoff_base_s,
+            backoff_max_s=cfg.job_backoff_max_s,
+            backoff_jitter=cfg.job_backoff_jitter,
+            registry=self.metrics,
+        )
         # job families allocate from the same local chip/port pools, so
         # their claims must be off-limits to the reconciler's leak sweep
         self.reconciler = Reconciler(
@@ -95,6 +112,8 @@ class Program:
             self.port_scheduler, self.container_versions,
             container_svc=self.container_svc,
             shared_version_maps=[self.job_versions],
+            job_svc=self.job_svc, job_versions=self.job_versions,
+            job_max_restarts=cfg.job_max_restarts,
             registry=self.metrics,
         )
 
@@ -127,7 +146,7 @@ class Program:
                     ports=self.port_scheduler,
                 ))
                 continue
-            runtime = (
+            runtime = self._injected_pod_runtimes.get(host_id) or (
                 open_runtime("docker", docker_host=entry.get(
                     "docker_host", cfg.docker_host))
                 if entry.get("runtime_backend", cfg.runtime_backend) == "docker"
@@ -196,6 +215,8 @@ class Program:
                               "(rerun via /api/v1/reconcile)")
         if self.cfg.reconcile_interval > 0:
             self.reconciler.start_periodic(self.cfg.reconcile_interval)
+        if self.cfg.job_supervise_interval > 0:
+            self.job_supervisor.start()
         self.health_watcher = None
         if self.cfg.health_watch_interval > 0:
             from tpu_docker_api.service.watch import HealthWatcher
@@ -205,6 +226,16 @@ class Program:
                 interval_s=self.cfg.health_watch_interval,
                 restart_policy=self.cfg.restart_policy,
                 crash_handler=self.container_svc.handle_crash,
+                # gang members are the supervisor's: the container path
+                # declines them (never restart one member in isolation).
+                # Only wired when the supervisor loop actually runs —
+                # delegating to a stopped supervisor would strand crashed
+                # members with no recovery path at all
+                job_crash_handler=(
+                    self.job_supervisor.handle_member_death
+                    if self.cfg.job_supervise_interval > 0 else None),
+                restart_backoff_s=self.cfg.restart_backoff_s,
+                restart_backoff_max_s=self.cfg.restart_backoff_max_s,
                 registry=self.metrics,
             )
             self.health_watcher.start()
@@ -213,7 +244,7 @@ class Program:
             self.chip_scheduler, self.port_scheduler, work_queue=self.wq,
             health_watcher=self.health_watcher, metrics=self.metrics,
             job_svc=self.job_svc, pod_scheduler=self.pod_scheduler,
-            reconciler=self.reconciler,
+            reconciler=self.reconciler, job_supervisor=self.job_supervisor,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -230,6 +261,8 @@ class Program:
             self.api_server.close()
         if getattr(self, "health_watcher", None) is not None:
             self.health_watcher.close()
+        if getattr(self, "job_supervisor", None) is not None:
+            self.job_supervisor.close()
         if getattr(self, "reconciler", None) is not None:
             self.reconciler.close()
         self.wq.close()

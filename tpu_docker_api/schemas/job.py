@@ -73,6 +73,14 @@ class JobDelete:
         )
 
 
+#: gang lifecycle phases (service/job_supervisor.py): a job is ``running``
+#: until a member dies; the supervisor moves it through ``restarting``
+#: (whole-gang stop→start in flight) back to ``running``, or — once the
+#: restart budget is burned — to terminal ``failed`` (slices/ports freed).
+#: ``stopped`` is the user-requested quiesce (resources retained for resume).
+JOB_PHASES = ("running", "restarting", "failed", "stopped")
+
+
 @dataclasses.dataclass
 class JobState:
     """Persisted per job version — everything needed to rebuild or rescale."""
@@ -92,6 +100,13 @@ class JobState:
     num_slices: int = 1
     # megascale DCN port (multislice only), allocated on process 0's host
     megascale_port: int = 0
+    # gang lifecycle (JOB_PHASES); persisted so a daemon crash mid-recovery
+    # is recognizable (phase == "restarting") and terminal failure survives
+    phase: str = "running"
+    # whole-gang restarts consumed against the supervisor's budget
+    restarts: int = 0
+    # why the job went terminal (phase == "failed"), surfaced in the API
+    failure_reason: str = ""
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -111,4 +126,7 @@ class JobState:
             desired_running=bool(d.get("desired_running", True)),
             num_slices=int(d.get("num_slices", 1)),
             megascale_port=int(d.get("megascale_port", 0)),
+            phase=d.get("phase", "running"),
+            restarts=int(d.get("restarts", 0)),
+            failure_reason=d.get("failure_reason", ""),
         )

@@ -18,7 +18,7 @@ import threading
 
 #: every label compiled into the services, so tests can iterate "all crash
 #: points" without grepping (each insertion site registers itself here)
-KNOWN_CRASH_POINTS = (
+CONTAINER_CRASH_POINTS = (
     # _run_new_version: version pointer bumped + persisted, no container yet
     "replace.after_version_bump",
     # _rolling_replace: new container created + spec persisted, old untouched
@@ -30,6 +30,26 @@ KNOWN_CRASH_POINTS = (
     # patch_container_chips: replacement rolled, shrink chips not yet released
     "patch.after_replace",
 )
+
+#: gang-level crash points (service/job.py + service/job_supervisor.py)
+JOB_CRASH_POINTS = (
+    # _run_version: job version pointer bumped, no slices/containers yet
+    "job.run.after_version_bump",
+    # _run_version: slices claimed + all member containers created (and, on
+    # the run path, started), JobState NOT yet persisted
+    "job.run.after_create",
+    # patch_job_chips fast path: new gang created (not started), old gang
+    # quiesced and marked stopped, new members not started
+    "job.patch.after_quiesce_old",
+    # patch_job_chips fast path: new gang started, old slice/ports not freed
+    "job.patch.after_start_new",
+    # restart_gang: phase=restarting persisted, members not yet stopped
+    "job.gang.after_mark_restarting",
+    # restart_gang: every member stopped, none started again
+    "job.gang.after_stop_all",
+)
+
+KNOWN_CRASH_POINTS = CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
 
 
 class SimulatedCrash(BaseException):

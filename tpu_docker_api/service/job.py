@@ -19,6 +19,11 @@ immutable-versioned rolling-replacement semantics:
   containers stay (stopped) for rollback until delete, like retired
   container versions.
 - ``delete_job`` / ``stop_job`` / ``restart_job`` / ``get_job_info``.
+- ``restart_gang`` / ``fail_job`` — gang recovery (service/job_supervisor.py):
+  whole-gang stop (coordinator last) → start (coordinator first), and the
+  terminal ``failed`` transition that frees every slice and port. ``JobState``
+  carries the lifecycle ``phase`` (running/restarting/failed/stopped) and the
+  persisted restart budget.
 
 Checkpoint continuity across rescales rides a shared bind (e.g. NFS, the
 cross-container channel the reference also leans on, README.md:41): every
@@ -29,12 +34,14 @@ from the step ``job-n`` checkpointed at quiesce.
 from __future__ import annotations
 
 import logging
+import re
 
 from tpu_docker_api import errors
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.pod import Pod, PodScheduler, SliceAllocation
 from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun, JobState
 from tpu_docker_api.service.container import _FamilyLocks, resolve_latest
+from tpu_docker_api.service.crashpoints import crash_point
 from tpu_docker_api.state.keys import (
     BASE_NAME_RE,
     Resource,
@@ -54,6 +61,10 @@ log = logging.getLogger(__name__)
 #: default libtpu inter-process mesh port (container side)
 _TPU_PORT = 8476
 
+#: member container names are "<versioned-job>-p<process_id>"
+#: (workload/jaxenv.py render_job_specs)
+_MEMBER_RE = re.compile(r"^(?P<job>.+)-p(?P<pid>\d+)$")
+
 
 class JobService:
     def __init__(
@@ -70,11 +81,44 @@ class JobService:
         self.versions = versions
         self.libtpu_path = libtpu_path
         self._locks = _FamilyLocks()
+        #: optional event hook (set by JobSupervisor): called with
+        #: (kind, job_name, **detail) for gang lifecycle transitions
+        self.event_sink = None
 
     # -- helpers -----------------------------------------------------------------
 
     def _resolve_latest(self, name: str) -> tuple[str, int, str]:
         return resolve_latest(self.versions, name)
+
+    def family_lock(self, base: str):
+        """Serialize against this family's user flows (mirrors
+        ContainerService.family_lock; used by supervisor + reconciler)."""
+        return self._locks.hold(base)
+
+    def _emit(self, kind: str, job_name: str, **detail) -> None:
+        if self.event_sink is not None:
+            try:
+                self.event_sink(kind, job_name, **detail)
+            except Exception:  # noqa: BLE001 — events must never break flows
+                log.exception("job event sink failed for %s %s", kind, job_name)
+
+    def owns_member(self, cname: str) -> str | None:
+        """Map a container name to its job family base, or None when the
+        container is not a member of any known job version. The per-container
+        crash path (HealthWatcher) uses this to DECLINE job members — a gang
+        member must never be restarted in isolation."""
+        m = _MEMBER_RE.match(cname)
+        if m is None:
+            return None
+        vname = m.group("job")
+        base, version = split_versioned_name(vname)
+        if version is None or self.versions.get(base) is None:
+            return None
+        try:
+            st = self.store.get_job(vname)
+        except errors.NotExistInStore:
+            return None
+        return base if any(c == cname for _, c, *_ in st.placements) else None
 
     def _slice_owner(self, vname: str, k: int, num_slices: int) -> str:
         # single-slice owners stay the bare versioned name (back-compat with
@@ -223,6 +267,7 @@ class JobService:
         prev = self.versions.get(base)
         version = self.versions.next_version(base)
         job_versioned = versioned_name(base, version)
+        crash_point("job.run.after_version_bump")
         try:
             grants = self._apply_slices(
                 n_chips, num_slices, accelerator_type, job_versioned)
@@ -244,6 +289,7 @@ class JobService:
         except Exception:
             self.versions.rollback(base, prev)
             raise
+        crash_point("job.run.after_create")
         host_order = self._host_order(grants)
         st = JobState(
             job_name=job_versioned,
@@ -327,9 +373,12 @@ class JobService:
                     f"want {want} chips, pod has {self.pod.n_chips}")
 
             def _quiesce_old() -> None:
-                self._stop_members(old)
+                # gang ordering here too: workers flush their checkpoint
+                # shards first, the coordinator (the rendezvous point) last
+                self._stop_members(old, reverse=True)
                 self.store.put_job(JobState.from_dict(
-                    {**old.to_dict(), "desired_running": False}
+                    {**old.to_dict(), "desired_running": False,
+                     "phase": "stopped"}
                 ))
 
             def _free_old() -> None:
@@ -352,6 +401,7 @@ class JobService:
                 )
                 try:
                     _quiesce_old()
+                    crash_point("job.patch.after_quiesce_old")
                     self._start_members(st)
                 except Exception:
                     # the old containers are intact: tear the new version
@@ -361,6 +411,7 @@ class JobService:
                     self._teardown_version(st, old.version)
                     _resume_old()
                     raise
+                crash_point("job.patch.after_start_new")
                 _free_old()
             except errors.ChipNotEnough:
                 # rescale-in-place: the freed old slice is the capacity
@@ -387,24 +438,189 @@ class JobService:
         base, _, latest_name = self._resolve_latest(name)
         with self._locks.hold(base):
             st = self.store.get_job(latest_name)
-            self._stop_members(st)
+            # gang quiesce: workers drain first, the coordinator last, so
+            # collective peers never outlive their rendezvous point
+            self._stop_members(st, reverse=True)
             self.store.put_job(JobState.from_dict(
-                {**st.to_dict(), "desired_running": False}
+                {**st.to_dict(), "desired_running": False, "phase": "stopped"}
             ))
+            self._emit("job-stopped", st.job_name)
 
     def restart_job(self, name: str) -> dict:
+        """User-requested whole-gang restart. Gang ordering, not N isolated
+        ``container_restart`` calls: stop every member (coordinator last),
+        then start the full gang in process order via the same path
+        ``_create_and_start`` uses — the coordinator comes up first so peers
+        find it. Resets the supervisor's restart budget (a manual restart is
+        a fresh start, not a crash)."""
         base, _, latest_name = self._resolve_latest(name)
         with self._locks.hold(base):
             st = self.store.get_job(latest_name)
+            if st.phase == "failed":
+                raise errors.BadRequest(
+                    f"job {base} is failed ({st.failure_reason or 'crash loop'});"
+                    " its slices and ports were freed — delete and re-run it")
+            # validate every placement host BEFORE stopping anything: a
+            # stale placement must not take a healthy gang down halfway
             for host_id, cname, *_ in st.placements:
-                host = self.pod.hosts.get(host_id)
-                if host is None:
+                if self.pod.hosts.get(host_id) is None:
                     raise errors.ContainerNotExist(
                         f"{cname}: host {host_id} is no longer in the pod")
-                host.runtime.container_restart(cname)
-            st = JobState.from_dict({**st.to_dict(), "desired_running": True})
+            self._stop_members(st, reverse=True)
+            st = JobState.from_dict({**st.to_dict(), "desired_running": True,
+                                     "phase": "running", "restarts": 0,
+                                     "failure_reason": ""})
+            # store record first: if a member start fails below, the family
+            # still wants to run and the supervisor/reconciler finish the gang
             self.store.put_job(st)
+            self._start_members(st)
+            self._emit("job-restarted", st.job_name, manual=True)
             return self._info_dict(st)
+
+    def restart_gang(self, name: str, reason: str = "",
+                     count_restart: bool = True) -> JobState:
+        """Whole-gang crash recovery (docs/robustness.md): one dead member
+        wedges every surviving peer of the ``jax.distributed`` collective, so
+        the only sound repair is stop-everything → start-everything, resuming
+        from the shared checkpoint binds. Never restarts a member in
+        isolation. ``count_restart=False`` is the adoption path (reconciler
+        finishing a restart that a daemon death interrupted) — the attempt
+        was already counted when the dying daemon marked the job
+        ``restarting``."""
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            st = self.store.get_job(latest_name)
+            if st.phase == "failed":
+                raise errors.BadRequest(
+                    f"job {base} is failed: {st.failure_reason}")
+            if not st.desired_running:
+                # callers decide to recover on a pre-lock snapshot; a user
+                # stop that raced in wins — crash recovery must not revive
+                # a deliberately stopped gang
+                raise errors.BadRequest(f"job {base} is stopped")
+            if not self._any_member_down(st):
+                # stale snapshot the other way: someone else (manual
+                # restart_job, an overlapping reconcile sweep) already
+                # recovered the gang — bouncing a healthy gang would kill
+                # training progress and burn a budget unit for nothing
+                if st.phase == "restarting":
+                    st = JobState.from_dict(
+                        {**st.to_dict(), "phase": "running"})
+                    self.store.put_job(st)
+                self._emit("gang-restart-skipped", st.job_name,
+                           reason="all members already running")
+                return st
+            # persist intent FIRST: a daemon death anywhere below leaves
+            # phase == "restarting", which the reconciler adopts by finishing
+            # the restart (without re-counting it against the budget)
+            st = JobState.from_dict({**st.to_dict(), "phase": "restarting",
+                                     "desired_running": True,
+                                     "restarts": st.restarts
+                                     + (1 if count_restart else 0)})
+            self.store.put_job(st)
+            crash_point("job.gang.after_mark_restarting")
+            # stop survivors in reverse process order (coordinator last)
+            self._stop_members(st, reverse=True)
+            crash_point("job.gang.after_stop_all")
+            # start the FULL gang in process order — coordinator first, the
+            # ordering _create_and_start/_host_order established
+            self._start_members(st)
+            st = JobState.from_dict({**st.to_dict(), "phase": "running"})
+            self.store.put_job(st)
+            self._emit("gang-restarted", st.job_name, reason=reason,
+                       attempt=st.restarts)
+            log.info("gang restart of %s (attempt %d): %s", st.job_name,
+                     st.restarts, reason or "requested")
+            return st
+
+    def fail_job(self, name: str, reason: str,
+                 only_if_restarts_ge: int | None = None) -> JobState:
+        """Terminal transition: the gang crash-looped through its restart
+        budget (or lost a member container entirely). Stops any survivors and
+        frees every slice and port the family holds — a ``failed`` job owns
+        zero resources (invariants.py), so its capacity is immediately
+        reusable by the next ``run_job``. Containers are kept (stopped) for
+        post-mortem until ``delete_job``.
+
+        ``only_if_restarts_ge`` re-validates the crash-loop verdict under
+        the family lock: a manual ``restart_job`` that raced in reset the
+        persisted budget, and the fresh gang must not be condemned on the
+        caller's stale snapshot."""
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            st = self.store.get_job(latest_name)
+            if (only_if_restarts_ge is not None
+                    and st.restarts < only_if_restarts_ge):
+                return st
+            if not st.desired_running or st.phase == "failed":
+                # a user stop / delete(keep-spec) that raced in wins: the
+                # caller's lock-free verdict is stale, and a deliberately
+                # stopped job must not be condemned as failed
+                return st
+            self._stop_members(st, reverse=True)
+            self._release_job_resources(base)
+            st = JobState.from_dict({**st.to_dict(), "phase": "failed",
+                                     "desired_running": False,
+                                     "failure_reason": reason})
+            self.store.put_job(st)
+            self._emit("job-failed", st.job_name, reason=reason)
+            log.warning("job %s failed: %s", st.job_name, reason)
+            return st
+
+    def mark_gang_completed(self, name: str) -> JobState:
+        """Every member exited cleanly (code 0): the job RAN TO COMPLETION —
+        that is success, not a crash, and must never burn restart budget or
+        end in ``failed``. Recorded as ``stopped`` (the terminal-success
+        phase): resources are retained like a user stop, freed by
+        ``delete_job``."""
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            st = self.store.get_job(latest_name)
+            if st.phase == "failed" or not st.desired_running:
+                return st
+            st = JobState.from_dict({**st.to_dict(), "phase": "stopped",
+                                     "desired_running": False})
+            self.store.put_job(st)
+            self._emit("job-completed", st.job_name)
+            log.info("job %s ran to completion (all members exited 0)",
+                     st.job_name)
+            return st
+
+    def mark_gang_running(self, name: str) -> None:
+        """Settle a job stuck in phase ``restarting`` whose members all run
+        (daemon died between the last member start and the phase flip)."""
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            st = self.store.get_job(latest_name)
+            if st.phase == "restarting":
+                self.store.put_job(JobState.from_dict(
+                    {**st.to_dict(), "phase": "running"}))
+
+    def _any_member_down(self, st: JobState) -> bool:
+        """True when any member is dead, missing, or on a missing host —
+        i.e. the gang genuinely needs recovery."""
+        for host_id, cname, *_ in st.placements:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                return True
+            try:
+                if not host.runtime.container_inspect(cname).running:
+                    return True
+            except errors.ContainerNotExist:
+                return True
+        return False
+
+    def _release_job_resources(self, base: str) -> None:
+        """Free slices + ports of EVERY stored version of the family
+        (owner-guarded restores — double frees are no-ops)."""
+        for version in self.store.history(Resource.JOBS, base):
+            vname = versioned_name(base, version)
+            try:
+                vst = self.store.get_job(vname)
+            except errors.NotExistInStore:
+                continue
+            self._restore_slices(vname, vst.num_slices)
+            self._free_state_ports(vst)
 
     def delete_job(self, name: str, req: JobDelete) -> None:
         base, _, latest_name = self._resolve_latest(name)
@@ -430,8 +646,16 @@ class JobService:
                 self.store.delete_family(Resource.JOBS, base)
                 self.versions.remove(base)
             else:
-                # keep specs for re-run; drop only the runtime artifacts
-                pass
+                # keep specs for re-run; drop only the runtime artifacts —
+                # but record the quiesce, or the supervisor/reconciler would
+                # read the kept spec as a running job with missing members
+                try:
+                    st = self.store.get_job(latest_name)
+                    self.store.put_job(JobState.from_dict(
+                        {**st.to_dict(), "desired_running": False,
+                         "phase": "stopped"}))
+                except errors.NotExistInStore:
+                    pass
             log.info("deleted job %s (%d versions)", base, len(history))
 
     def get_job_info(self, name: str) -> dict:
@@ -476,8 +700,12 @@ class JobService:
         self.store.delete_version(Resource.JOBS, st.job_name)
         self.versions.rollback(base, rollback_to)
 
-    def _stop_members(self, st: JobState) -> None:
-        for host_id, cname, *_ in st.placements:
+    def _stop_members(self, st: JobState, reverse: bool = False) -> None:
+        """``reverse=True`` is gang ordering: stop workers first, the
+        coordinator (process 0) last, so peers never lose their rendezvous
+        point while still draining."""
+        placements = list(reversed(st.placements)) if reverse else st.placements
+        for host_id, cname, *_ in placements:
             host = self.pod.hosts.get(host_id)
             if host is None:
                 continue
@@ -507,6 +735,8 @@ class JobService:
             "chipCount": st.chip_count,
             "coordinatorPort": st.coordinator_port,
             "desiredRunning": st.desired_running,
+            "phase": st.phase,
+            "restarts": st.restarts,
             "numSlices": st.num_slices,
             "processes": [
                 {
@@ -520,6 +750,8 @@ class JobService:
                 for host_id, cname, pid, chips, tpu_port in st.placements
             ],
         }
+        if st.failure_reason:
+            out["failureReason"] = st.failure_reason
         if st.megascale_port:
             out["megascalePort"] = st.megascale_port
         if live:

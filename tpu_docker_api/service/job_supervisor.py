@@ -1,0 +1,350 @@
+"""Gang-aware job supervision (docs/robustness.md).
+
+The per-container ``HealthWatcher`` closes the single-container failure gap;
+this supervisor closes the distributed one. A multi-host job is ONE
+``jax.distributed`` collective: when a member dies, every surviving member is
+wedged at the next collective op — restarting the dead member alone rejoins a
+barrier nobody else will reach. The standard training-stack answer is gang
+semantics:
+
+- **whole-gang restart** — on any member death, stop all survivors (workers
+  first, coordinator last) and restart the full gang in process order
+  (coordinator first), resuming from the shared checkpoint binds;
+- **exponential backoff with jitter** between gang restarts, so a pod-wide
+  fault does not synchronize a thundering herd of restarts;
+- **bounded restart budget** — a crash-looping job converges to the terminal
+  ``failed`` phase, its slices and ports are freed for the next job, and the
+  reason is surfaced via ``GET /api/v1/jobs/{name}`` and the events ring.
+
+The supervisor polls member liveness across *all* pod hosts (the container
+watcher only sees the local runtime). The watcher delegates job members to
+:meth:`handle_member_death` and never restarts them itself.
+
+Restart *counts* live on the persisted ``JobState`` so the budget survives a
+daemon death; backoff *deadlines* are in-memory (monotonic clock) and reset
+on restart — a fresh daemon retries once immediately, which is the safe
+direction after an operator intervention.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import random
+import threading
+import time
+
+from tpu_docker_api import errors
+from tpu_docker_api.state.keys import split_versioned_name, versioned_name
+from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
+from tpu_docker_api.utils.backoff import backoff_delay_s
+
+log = logging.getLogger(__name__)
+
+
+class JobSupervisor:
+    """Polls gang liveness; executes whole-gang recovery with backoff.
+
+    ``clock`` and ``seed`` are injection seams for deterministic tests: the
+    clock gates backoff deadlines (no sleeping inside ``poll_once``), the
+    seed fixes the jitter draw.
+    """
+
+    def __init__(
+        self,
+        pod,
+        job_svc,
+        store,
+        versions,
+        interval_s: float = 5.0,
+        max_restarts: int = 3,
+        backoff_base_s: float = 1.0,
+        backoff_max_s: float = 60.0,
+        backoff_jitter: float = 0.1,
+        seed: int | None = None,
+        clock=time.monotonic,
+        registry: MetricsRegistry | None = None,
+        max_events: int = 512,
+    ) -> None:
+        self.pod = pod
+        self._svc = job_svc
+        self._store = store
+        self._versions = versions
+        self._interval = interval_s
+        self._max_restarts = max_restarts
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._backoff_jitter = backoff_jitter
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self._registry = registry if registry is not None else REGISTRY
+        self._mu = threading.Lock()
+        #: base → earliest monotonic time the next gang restart may run
+        self._deadline: dict[str, float] = {}
+        #: families THIS supervisor instance already attempted to restart —
+        #: distinguishes "phase == restarting because a previous daemon died
+        #: mid-restart" (adoption: finish without re-counting) from "our own
+        #: last attempt failed" (the next attempt must consume budget)
+        self._attempted: set[str] = set()
+        #: base → last poll's {deadMembers, missingMembers} — status_view
+        #: serves this instead of re-inspecting every member per request
+        self._last_obs: dict[str, dict] = {}
+        self._events: collections.deque = collections.deque(maxlen=max_events)
+        self._stop = threading.Event()
+        #: set by handle_member_death to cut the poll interval short — the
+        #: watcher thread must never run gang recovery inline (it would
+        #: block behind the family lock and stall liveness polling)
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        # gang lifecycle transitions the service performs (manual restarts,
+        # fail/stop) land in the same ring the supervisor's own actions use
+        job_svc.event_sink = self._service_event
+
+    # -- lifecycle ---------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="job-supervise", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=self._interval + 5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(self._interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the supervisor must survive
+                log.exception("job supervision poll failed")
+
+    # -- the watch loop ----------------------------------------------------------
+
+    def poll_once(self) -> None:
+        """One liveness scan over every job family; separated from the loop
+        for tests."""
+        families = sorted(self._versions.snapshot())
+        for base in families:
+            try:
+                self._check_family(base)
+            except Exception:  # noqa: BLE001 — one family (one flaky remote
+                # engine) must not starve every other gang of supervision;
+                # SimulatedCrash (BaseException) still propagates — that is
+                # the chaos harness's kill
+                log.exception("gang check of %s failed", base)
+        with self._mu:
+            for gone in set(self._last_obs) - set(families):
+                self._last_obs.pop(gone, None)
+
+    def handle_member_death(self, cname: str) -> bool:
+        """Watcher delegation entry: returns True iff ``cname`` is a member
+        of a known job — the caller must then NOT touch it. Recovery is NOT
+        run inline (the watcher thread must not block behind a family lock
+        mid-rescale); the supervisor's own loop is woken to handle it
+        immediately instead of waiting out the poll interval."""
+        base = self._svc.owns_member(cname)
+        if base is None:
+            return False
+        self._record("member-died-delegated", base, member=cname)
+        self._wake.set()
+        return True
+
+    # -- decision logic ----------------------------------------------------------
+
+    def _check_family(self, base: str) -> None:
+        latest = self._versions.get(base)
+        if latest is None:
+            return
+        latest_name = versioned_name(base, latest)
+        # NO family lock here: liveness polling fans out container_inspect
+        # calls to every pod host, and a slow remote engine must not hold
+        # this job's API flows (or the rest of the poll) hostage. Every
+        # repair below re-validates state under the lock before mutating
+        # (restart_gang rejects stopped/failed jobs, fail_job re-checks the
+        # budget via only_if_restarts_ge, mark_gang_* re-read the phase).
+        try:
+            st = self._store.get_job(latest_name)
+        except errors.NotExistInStore:
+            return  # half-created version; the reconciler's jurisdiction
+        if not st.desired_running or st.phase in ("failed", "stopped"):
+            self._note_obs(base, [], [])
+            return
+        dead, missing, crashed = self._member_liveness(st)
+        self._note_obs(base, dead, missing)
+        if missing:
+            self._record("job-member-missing", base, members=missing)
+            self._try_repair(base, lambda: self._svc.fail_job(
+                base, f"member container(s) {missing} no longer exist"))
+            return
+        if not dead:
+            if st.phase == "restarting":
+                # adopted mid-restart and every member runs: settle
+                self._svc.mark_gang_running(base)
+                self._record("gang-settled", base)
+            return
+        if st.phase != "restarting" and not crashed:
+            # every dead member exited 0 — completion, not a crash. The
+            # whole gang down = the job finished; a partial clean exit is
+            # an early finisher whose peers are still wrapping up — never
+            # a reason to bounce the gang or burn budget
+            if len(dead) == len(st.placements):
+                self._try_repair(
+                    base, lambda: self._svc.mark_gang_completed(base))
+            return
+        finishing = (st.phase == "restarting"
+                     and base not in self._attempted)
+        if st.restarts >= self._max_restarts and not finishing:
+            self._record("job-crash-loop", base, restarts=st.restarts,
+                         members=dead)
+            self._try_repair(base, lambda: self._svc.fail_job(
+                base, f"crash loop: {st.restarts} gang restarts "
+                f"exhausted (dead members: {dead})",
+                only_if_restarts_ge=self._max_restarts))
+            return
+        now = self._clock()
+        with self._mu:
+            deadline = self._deadline.get(base, 0.0)
+        if now < deadline:
+            self._record("gang-restart-deferred", base, members=dead,
+                         wait_s=round(deadline - now, 3))
+            return
+        # schedule the NEXT attempt before acting: if the restart kills
+        # the daemon, the replacement still observes a backoff gap
+        delay = self._next_delay(st.restarts)
+        with self._mu:
+            self._deadline[base] = now + delay
+        self._record("gang-restarting", base, members=dead,
+                     attempt=st.restarts + (0 if finishing else 1),
+                     backoff_s=round(delay, 3))
+        self._attempted.add(base)
+        try:
+            self._svc.restart_gang(
+                base, reason=f"member(s) died: {dead}",
+                count_restart=not finishing)
+            self._counter("gang_restarts_total")
+        except errors.ApiError as e:
+            # attempt burned (restart_gang counts BEFORE acting), backoff
+            # armed; retried next poll until the budget converges the
+            # job to failed. Also the stale-snapshot path: a user stop
+            # that raced in makes restart_gang decline loudly
+            self._record("gang-restart-failed", base, error=str(e))
+
+    def _try_repair(self, base: str, fn) -> None:
+        try:
+            fn()
+        except errors.ApiError as e:
+            self._record("gang-repair-failed", base, error=str(e))
+
+    def _member_liveness(self, st) -> tuple[list[str], list[str], bool]:
+        """(dead, missing, crashed) over the latest version's members.
+        ``crashed`` is True when any dead member actually failed — nonzero
+        exit code, or created-but-never-started (an interrupted launch) —
+        as opposed to a clean exit-0 completion."""
+        dead: list[str] = []
+        missing: list[str] = []
+        crashed = False
+        for host_id, cname, *_ in st.placements:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                missing.append(cname)
+                continue
+            try:
+                info = host.runtime.container_inspect(cname)
+            except errors.ContainerNotExist:
+                missing.append(cname)
+                continue
+            if not info.running:
+                dead.append(cname)
+                if info.exit_code != 0 or info.status == "created":
+                    crashed = True
+        return dead, missing, crashed
+
+    def _note_obs(self, base: str, dead: list[str],
+                  missing: list[str]) -> None:
+        with self._mu:
+            self._last_obs[base] = {"deadMembers": dead,
+                                    "missingMembers": missing}
+
+    def _next_delay(self, restarts: int) -> float:
+        """min(cap, base·2^n), then ±jitter so a pod-wide fault does not
+        restart every gang in lockstep."""
+        return backoff_delay_s(restarts, self._backoff_base_s,
+                               self._backoff_max_s, self._backoff_jitter,
+                               self._rng)
+
+    def _forget(self, base: str) -> None:
+        with self._mu:
+            self._deadline.pop(base, None)
+        self._attempted.discard(base)
+
+    # -- events / views ----------------------------------------------------------
+
+    def _counter(self, name: str) -> None:
+        self._registry.counter_inc(
+            name, help={"gang_restarts_total":
+                        "Whole-gang restarts executed by the job supervisor",
+                        "jobs_failed_total":
+                        "Jobs driven to the terminal failed phase"}[name])
+
+    def _service_event(self, kind: str, job_name: str, **detail) -> None:
+        if kind in ("job-restarted", "job-stopped", "job-failed",
+                    "job-completed"):
+            # manual restart = fresh start (restart_job reset the persisted
+            # budget — the in-memory backoff deadline must reset with it);
+            # stop/fail make any armed deadline meaningless
+            base, _ = split_versioned_name(job_name)
+            self._forget(base)
+        if kind == "job-failed":
+            # EVERY terminal transition counts — the supervisor's own
+            # crash-loop verdicts, the reconciler's boot-time ones, and
+            # manual fail_job calls all flow through this sink
+            self._counter("jobs_failed_total")
+        self._record(kind, job_name, **detail)
+
+    def _record(self, kind: str, job: str, **extra) -> None:
+        evt = {"ts": time.time(), "job": job, "event": kind, **extra}
+        with self._mu:
+            self._events.append(evt)
+        log.info("job event: %s %s %s", job, kind, extra or "")
+
+    def events_view(self, limit: int = 100) -> list[dict]:
+        if limit <= 0:
+            return []
+        with self._mu:
+            return list(self._events)[-limit:]
+
+    def status_view(self) -> dict:
+        """GET /api/v1/health/jobs — per-family gang status. Liveness comes
+        from the LAST poll's observation (O(1) I/O per request): a hung
+        remote engine must not wedge an operator dashboard refresh."""
+        now = self._clock()
+        out: dict[str, dict] = {}
+        for base, latest in sorted(self._versions.snapshot().items()):
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                continue
+            with self._mu:
+                deadline = self._deadline.get(base, 0.0)
+                obs = dict(self._last_obs.get(
+                    base, {"deadMembers": [], "missingMembers": []}))
+            out[base] = {
+                "version": latest,
+                "phase": st.phase,
+                "desiredRunning": st.desired_running,
+                "restarts": st.restarts,
+                "maxRestarts": self._max_restarts,
+                **obs,
+                "backoffRemainingS": round(max(0.0, deadline - now), 3),
+                **({"failureReason": st.failure_reason}
+                   if st.failure_reason else {}),
+            }
+        return {"jobs": out, "backoffBaseS": self._backoff_base_s,
+                "backoffMaxS": self._backoff_max_s}

@@ -49,7 +49,12 @@ from tpu_docker_api.runtime.base import ContainerRuntime
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.ports import PortScheduler
 from tpu_docker_api.scheduler.slices import ChipScheduler
-from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
+from tpu_docker_api.state.keys import (
+    Resource,
+    job_owner_base,
+    split_versioned_name,
+    versioned_name,
+)
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
@@ -71,6 +76,9 @@ class Reconciler:
         versions: VersionMap,
         container_svc=None,
         shared_version_maps: list[VersionMap] | None = None,
+        job_svc=None,
+        job_versions: VersionMap | None = None,
+        job_max_restarts: int = 3,
         registry: MetricsRegistry | None = None,
         max_events: int = 512,
     ) -> None:
@@ -83,6 +91,17 @@ class Reconciler:
         #: other owners of the SAME schedulers (the job service shares the
         #: local chip/port pools) — their claims are off-limits to the sweep
         self._shared_maps = shared_version_maps or []
+        #: distributed-job repair (gang adoption) when wired by the daemon
+        self._job_svc = job_svc
+        self._job_versions = job_versions
+        self._job_max_restarts = job_max_restarts
+        #: gangs this reconciler already adopted (mirror of the supervisor's
+        #: _attempted set): a first sight of phase == "restarting" is a
+        #: daemon-death adoption and does not consume budget; if the family
+        #: is STILL restarting on a later sweep, our own adoption failed and
+        #: further attempts must count — else a persistently failing start
+        #: would be retried forever past job_max_restarts
+        self._job_adopted: set[str] = set()
         self._registry = registry if registry is not None else REGISTRY
         self._mu = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=max_events)
@@ -129,6 +148,14 @@ class Reconciler:
                                        members=members.get(base, {}))
         for base in sorted(set(members) - set(families)):
             self._reconcile_orphan(base, actions, dry_run)
+        if self._job_svc is not None and self._job_versions is not None:
+            for base in sorted(self._job_versions.snapshot()):
+                try:
+                    self._reconcile_job_family(base, actions, dry_run)
+                except Exception:  # noqa: BLE001 — one family must not
+                    # abort the sweep (SimulatedCrash, a BaseException,
+                    # still propagates — that is the chaos harness's kill)
+                    log.exception("job reconcile of %s failed", base)
         self._sweep_foreign_owners(actions, dry_run)
 
         report = {
@@ -386,6 +413,206 @@ class Reconciler:
                       fn=lambda n=name: self.runtime.container_remove(
                           n, force=True))
 
+    # -- distributed jobs (gang adoption) -----------------------------------------
+
+    def _reconcile_job_family(self, base: str, actions: list[dict],
+                              dry_run: bool) -> None:
+        """Repair one job family after a daemon death mid-flow:
+
+        - a version pointer with no stored ``JobState`` (crash between bump
+          and persist) has its half-made artifacts scrubbed — member
+          containers removed, slices and ports freed — and the pointer rolls
+          back (or the family drops);
+        - a gang with dead-but-present members, or one stuck in phase
+          ``restarting`` (daemon died mid gang-restart), is adopted: the
+          whole gang restarts through the same coordinator-first path the
+          supervisor uses, without re-counting the attempt;
+        - members gone entirely ⇒ the job converges to terminal ``failed``
+          (zero slices, zero ports);
+        - stale older versions (interrupted rescale) are quiesced and their
+          resources freed — the latest version is authoritative.
+        """
+        lock = (self._job_svc.family_lock(base) if not dry_run
+                else contextlib.nullcontext())
+        with lock:
+            latest = self._job_versions.get(base)
+            if latest is None:
+                return
+            latest_name = versioned_name(base, latest)
+            try:
+                st = self.store.get_job(latest_name)
+            except errors.NotExistInStore:
+                self._act(actions, dry_run, "scrub-half-created-job",
+                          latest_name,
+                          fn=lambda: self._scrub_job_version(latest_name))
+                stored = self.store.history(Resource.JOBS, base)
+                prev = max((v for v in stored if v < latest), default=None)
+                if prev is None:
+                    self._act(actions, dry_run, "drop-empty-job-family", base,
+                              fn=lambda: self._job_versions.remove(base))
+                else:
+                    self._act(actions, dry_run, "rollback-job-pointer",
+                              latest_name, to=prev,
+                              fn=lambda: self._job_versions.rollback(base, prev))
+                return
+
+            members = []  # (host, cname, info | None)
+            for host_id, cname, *_ in st.placements:
+                host = self._job_svc.pod.hosts.get(host_id)
+                info = None
+                if host is not None:
+                    try:
+                        info = host.runtime.container_inspect(cname)
+                    except errors.ContainerNotExist:
+                        info = None
+                members.append((host, cname, info))
+
+            if st.desired_running and st.phase not in ("failed", "stopped"):
+                missing = [c for _, c, i in members if i is None]
+                dead = [c for _, c, i in members if i is not None and not i.running]
+                # a dead member CRASHED if it exited nonzero or never got
+                # past "created" (interrupted launch); mid-restart gangs
+                # (phase == "restarting") are always adoptable — their
+                # members were stopped by the restart itself
+                crashed = (st.phase == "restarting" or any(
+                    i is not None and not i.running
+                    and (i.exit_code != 0 or i.status == "created")
+                    for _, _, i in members))
+                finishing = (st.phase == "restarting"
+                             and base not in self._job_adopted)
+                if missing:
+                    self._act(actions, dry_run, "fail-job-missing-members",
+                              latest_name, members=missing,
+                              fn=lambda: self._job_svc.fail_job(
+                                  base, f"member container(s) {missing} "
+                                  "lost while the daemon was down"))
+                elif dead and not crashed:
+                    # every dead member exited 0: completion, not a crash —
+                    # settle the whole-gang exit; a partial clean exit is an
+                    # early finisher, left alone
+                    if len(dead) == len(members):
+                        self._act(actions, dry_run, "settle-completed-job",
+                                  latest_name,
+                                  fn=lambda: self._job_svc.
+                                  mark_gang_completed(base))
+                elif dead:
+                    if (st.restarts >= self._job_max_restarts
+                            and not finishing):
+                        # budget already exhausted: a daemon reboot must not
+                        # hand a crash-looping gang a fresh life — converge
+                        # to terminal failed, same as the supervisor would
+                        self._act(actions, dry_run, "fail-job-crash-loop",
+                                  latest_name, restarts=st.restarts,
+                                  fn=lambda: self._job_svc.fail_job(
+                                      base, f"crash loop: {st.restarts} gang "
+                                      f"restarts exhausted (dead members: "
+                                      f"{dead})"))
+                    else:
+                        # half-restarted gang (phase == "restarting") or
+                        # members that died with the daemon: finish/redo the
+                        # whole-gang restart; a restart the dying daemon
+                        # already counted is not counted again
+                        if not dry_run:
+                            self._job_adopted.add(base)
+                        self._act(actions, dry_run, "restart-gang",
+                                  latest_name, members=dead,
+                                  fn=lambda: self._job_svc.restart_gang(
+                                      base, reason="reconcile adoption",
+                                      count_restart=not finishing))
+                elif st.phase == "restarting":
+                    # daemon died between the last member start and the
+                    # phase flip — every member runs; settle the record
+                    self._act(actions, dry_run, "settle-restarting-job",
+                              latest_name,
+                              fn=lambda: self._job_svc.mark_gang_running(base))
+            else:
+                running = [c for _, c, i in members if i is not None and i.running]
+                if running:
+                    self._act(actions, dry_run, "stop-undesired-job-members",
+                              latest_name, members=running,
+                              fn=lambda: self._job_svc._stop_members(
+                                  st, reverse=True))
+                if st.phase == "failed":
+                    self._job_resource_release(base, actions, dry_run)
+
+            # stale older versions: a completed (or crashed-after-start)
+            # rescale leaves the old gang quiesced — it must hold nothing
+            for version in self.store.history(Resource.JOBS, base):
+                if version == latest:
+                    continue
+                vname = versioned_name(base, version)
+                try:
+                    vst = self.store.get_job(vname)
+                except errors.NotExistInStore:
+                    continue
+                stale_running = []
+                for host_id, cname, *_ in vst.placements:
+                    host = self._job_svc.pod.hosts.get(host_id)
+                    if host is None:
+                        continue
+                    try:
+                        if host.runtime.container_inspect(cname).running:
+                            stale_running.append(cname)
+                    except errors.ContainerNotExist:
+                        pass
+                if stale_running:
+                    self._act(actions, dry_run, "retire-stale-job-version",
+                              vname, members=stale_running,
+                              fn=lambda v=vst: self._job_svc._stop_members(
+                                  v, reverse=True))
+                holds_slices = (
+                    self._job_svc.slices.get_grant(vname) is not None
+                    or any(self._job_svc.slices.get_grant(f"{vname}#s{k}")
+                           is not None for k in range(vst.num_slices)))
+                holds_ports = any(
+                    o == vname
+                    for host in self._job_svc.pod.hosts.values()
+                    for o in host.ports.status()["owners"].values())
+                if holds_slices or holds_ports:
+                    self._act(actions, dry_run, "free-stale-job-resources",
+                              vname,
+                              fn=lambda v=vst, n=vname: (
+                                  self._job_svc._restore_slices(
+                                      n, v.num_slices),
+                                  self._job_svc._free_state_ports(v)))
+
+    def _scrub_job_version(self, vname: str) -> None:
+        """Remove every artifact a half-created job version left: member
+        containers (named ``<vname>-p<i>``) on any pod host, slice grants
+        (``<vname>`` or ``<vname>#s<k>``), and host ports owned by it."""
+        svc = self._job_svc
+        prefix = f"{vname}-p"
+        for host in svc.pod.hosts.values():
+            for cname in list(host.runtime.container_list()):
+                if cname.startswith(prefix) and cname[len(prefix):].isdigit():
+                    try:
+                        host.runtime.container_remove(cname, force=True)
+                    except errors.ContainerNotExist:
+                        pass
+            owned = [p for p, o in host.ports.status()["owners"].items()
+                     if o == vname]
+            if owned:
+                host.ports.restore_ports(owned, owner=vname)
+        for owner in list(svc.slices.status()["slices"]):
+            if owner == vname or owner.startswith(f"{vname}#s"):
+                svc.slices.restore_slice(owner)
+
+    def _job_resource_release(self, base: str, actions: list[dict],
+                              dry_run: bool) -> None:
+        """A terminal ``failed`` job owns nothing — free whatever any of its
+        versions still holds (owner-guarded; no-op when already clean)."""
+        svc = self._job_svc
+        held = [o for o in svc.slices.status()["slices"]
+                if job_owner_base(o) == base]
+        held_ports = any(
+            job_owner_base(o) == base
+            for host in svc.pod.hosts.values()
+            for o in host.ports.status()["owners"].values())
+        if held or held_ports:
+            self._act(actions, dry_run, "release-failed-job-resources", base,
+                      slices=held,
+                      fn=lambda: svc._release_job_resources(base))
+
     # -- resource accounting ------------------------------------------------------
 
     def _guarded_claim(self, claim, items: list[int], owner: str,
@@ -475,12 +702,18 @@ class Reconciler:
             known |= set(vm.snapshot())
         known.add("")  # anonymous allocations are not ours to judge
 
+        def _is_known(owner: str) -> bool:
+            # job claims are keyed by VERSIONED owner ("train-1",
+            # "train-1#s0") while version maps key by base — map back
+            # before judging, or every live job's chips/ports read as leaks
+            return owner in known or job_owner_base(owner) in known
+
         chip_owners: dict[str, list[int]] = {}
         for c in self.chips.status()["chips"]:
             if c["used"]:
                 chip_owners.setdefault(c["owner"], []).append(c["chipId"])
         for owner, ids in sorted(chip_owners.items()):
-            if owner not in known:
+            if not _is_known(owner):
                 self._act(actions, dry_run, "free-leaked-chips", owner,
                           chips=ids,
                           fn=lambda o=owner, i=ids: self._free_foreign(
@@ -490,7 +723,7 @@ class Reconciler:
         for p, o in self.ports.status()["owners"].items():
             port_owners.setdefault(o, []).append(p)
         for owner, ps in sorted(port_owners.items()):
-            if owner not in known:
+            if not _is_known(owner):
                 self._act(actions, dry_run, "free-leaked-ports", owner,
                           ports=sorted(ps),
                           fn=lambda o=owner, i=ps: self._free_foreign(
@@ -509,7 +742,9 @@ class Reconciler:
         with lock:
             if self.versions.get(owner) is not None:
                 return
-            if any(vm.get(owner) is not None for vm in self._shared_maps):
+            base = job_owner_base(owner)
+            if any(vm.get(owner) is not None or vm.get(base) is not None
+                   for vm in self._shared_maps):
                 return
             if owner in self._runtime_members():
                 return

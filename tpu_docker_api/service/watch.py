@@ -38,6 +38,18 @@ class HealthWatcher:
     daemon) is the accounting-aware recovery path: it holds the family lock,
     checks declarative liveness, and refuses retired versions. The direct
     runtime restart is only a fallback for standalone use of the watcher.
+
+    ``job_crash_handler`` (JobSupervisor.handle_member_death when wired) is
+    consulted FIRST on every death: a container that belongs to a
+    distributed job must never be restarted in isolation — one member
+    rejoining a wedged ``jax.distributed`` collective helps nobody — so the
+    watcher delegates it to the gang supervisor and stays hands-off.
+
+    ``restart_backoff_s`` > 0 spaces restart attempts exponentially
+    (``base·2^n``, clamped to ``restart_backoff_max_s``): without it a tight
+    crash loop burns the whole ``max_restarts`` budget in a few poll ticks.
+    A deferred restart is retried on later polls once the deadline passes
+    and does not consume budget.
     """
 
     def __init__(
@@ -48,6 +60,10 @@ class HealthWatcher:
         max_restarts: int = 3,
         max_events: int = 512,
         crash_handler=None,
+        job_crash_handler=None,
+        restart_backoff_s: float = 0.0,
+        restart_backoff_max_s: float = 30.0,
+        clock=time.monotonic,
         registry: MetricsRegistry | None = None,
     ) -> None:
         if restart_policy not in ("none", "on-failure"):
@@ -57,10 +73,17 @@ class HealthWatcher:
         self._policy = restart_policy
         self._max_restarts = max_restarts
         self._crash_handler = crash_handler
+        self._job_crash_handler = job_crash_handler
+        self._backoff_s = restart_backoff_s
+        self._backoff_max_s = restart_backoff_max_s
+        self._clock = clock
         self._registry = registry if registry is not None else REGISTRY
         self._mu = threading.Lock()
         self._last_running: dict[str, bool] = {}
         self._restarts: dict[str, int] = {}
+        #: containers that died a crash-death and still await a restart
+        #: (deferred by backoff); name → earliest monotonic retry time
+        self._pending_restart: dict[str, float] = {}
         self._events: collections.deque = collections.deque(maxlen=max_events)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -99,6 +122,7 @@ class HealthWatcher:
             with self._mu:
                 self._last_running.pop(name, None)
                 self._restarts.pop(name, None)
+                self._pending_restart.pop(name, None)
 
         for name in names:
             try:
@@ -114,25 +138,65 @@ class HealthWatcher:
                 self._registry.counter_inc(
                     "containers_died_total",
                     help="Containers observed transitioning running→dead")
-                if self._policy == "on-failure" and info.exit_code != 0:
+                if (self._job_crash_handler is not None
+                        and self._job_crash_handler(name)):
+                    # job member: gang supervision owns recovery — the
+                    # container path must never restart it in isolation
+                    self._record(name, "delegated-to-job-supervisor", False)
+                    with self._mu:
+                        self._pending_restart.pop(name, None)
+                elif self._policy == "on-failure" and info.exit_code != 0:
                     now = self._try_restart(name)
+                else:
+                    # deliberately not restarted (clean exit / observe-only
+                    # policy): a stale backoff deadline from an earlier
+                    # crash must not resurrect this container later via the
+                    # deferred-retry branch
+                    with self._mu:
+                        self._pending_restart.pop(name, None)
             elif not was and now:
                 self._record(name, "started", now)
+                with self._mu:
+                    self._pending_restart.pop(name, None)
+            elif not was and not now and name in self._pending_restart:
+                # died earlier, restart deferred by backoff — retry once the
+                # deadline passes (no running→dead edge fires again)
+                now = self._try_restart(name)
             with self._mu:
                 self._last_running[name] = now
 
     def _try_restart(self, name: str) -> bool:
         """Returns the container's liveness after the attempt."""
+        ts = self._clock()
         with self._mu:
-            n = self._restarts.get(name, 0)
-            if n >= self._max_restarts:
-                give_up = True
+            deadline = self._pending_restart.get(name, 0.0)
+            if ts < deadline:
+                defer = deadline - ts
             else:
-                give_up = False
-                self._restarts[name] = n + 1
+                defer = 0.0
+            n = self._restarts.get(name, 0)
+            if defer == 0.0:
+                if n >= self._max_restarts:
+                    give_up = True
+                else:
+                    give_up = False
+                    self._restarts[name] = n + 1
+        if defer > 0.0:
+            self._record(name, "restart-deferred", False,
+                         wait_s=round(defer, 3))
+            return False
         if give_up:
+            with self._mu:
+                self._pending_restart.pop(name, None)
             self._record(name, "restart-budget-exhausted", False)
             return False
+        if self._backoff_s > 0:
+            # arm the NEXT attempt's deadline before acting
+            from tpu_docker_api.utils.backoff import backoff_delay_s
+
+            with self._mu:
+                self._pending_restart[name] = ts + backoff_delay_s(
+                    n, self._backoff_s, self._backoff_max_s)
         try:
             if self._crash_handler is not None:
                 if not self._crash_handler(name):
@@ -140,6 +204,7 @@ class HealthWatcher:
                     # family gone — don't count against the budget either
                     with self._mu:
                         self._restarts[name] = n
+                        self._pending_restart.pop(name, None)
                     self._record(name, "restart-declined", False)
                     return False
             else:

@@ -53,6 +53,16 @@ def versioned_name(base: str, version: int) -> str:
     return f"{base}-{version}"
 
 
+def job_owner_base(owner: str) -> str:
+    """Map a job scheduler-owner back to its family base. Job claims are
+    keyed by VERSIONED name, optionally with a multislice suffix
+    ("train-1", "train-1#s0") — version maps key by base, so ownership
+    checks must strip both before judging. Non-job owners pass through."""
+    stem = owner.split("#", 1)[0]
+    base, version = split_versioned_name(stem)
+    return base if version is not None else owner
+
+
 def family_prefix(resource: Resource, base: str) -> str:
     return f"{PREFIX}/{resource.value}/{base}/"
 

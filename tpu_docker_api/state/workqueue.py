@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import queue
+import random
 import threading
 import time
 from typing import Any, Callable
@@ -30,6 +31,12 @@ log = logging.getLogger(__name__)
 DEFAULT_CAPACITY = 110
 DEFAULT_MAX_RETRIES = 5
 BACKOFF_BASE_S = 0.05
+#: retry sleeps clamp here — an unbounded 2^attempt would stall the single
+#: sync thread for minutes on a flaky engine
+BACKOFF_MAX_S = 2.0
+#: ±fraction of jitter on every retry sleep, so N daemons hammered by the
+#: same engine outage don't retry in lockstep
+BACKOFF_JITTER = 0.25
 
 
 @dataclasses.dataclass
@@ -82,6 +89,9 @@ class WorkQueue:
         capacity: int = DEFAULT_CAPACITY,
         max_retries: int = DEFAULT_MAX_RETRIES,
         backoff_base_s: float = BACKOFF_BASE_S,
+        backoff_max_s: float = BACKOFF_MAX_S,
+        backoff_jitter: float = BACKOFF_JITTER,
+        seed: int | None = None,
     ) -> None:
         from tpu_docker_api.utils.files import copy_dir_contents
 
@@ -90,6 +100,9 @@ class WorkQueue:
         self._q: queue.Queue[Task | None] = queue.Queue(maxsize=capacity)
         self._max_retries = max_retries
         self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._backoff_jitter = backoff_jitter
+        self._rng = random.Random(seed)
         self._thread: threading.Thread | None = None
         self.dead_letters: list[tuple[Task, str]] = []
         self._dl_mu = threading.Lock()
@@ -151,7 +164,7 @@ class WorkQueue:
                 last_err = f"{type(e).__name__}: {e}"
                 log.warning("workqueue task %r failed (attempt %d/%d): %s",
                             task, attempt + 1, self._max_retries, last_err)
-                time.sleep(self._backoff_base_s * (2**attempt))
+                time.sleep(self.retry_delay_s(attempt))
         log.error("workqueue task %r dead-lettered: %s", task, last_err)
         with self._dl_mu:
             self.dead_letters.append((task, last_err))
@@ -160,6 +173,15 @@ class WorkQueue:
                 task.on_fail()
             except Exception:  # noqa: BLE001
                 log.exception("copy-task compensation for %s failed", task.new_name)
+
+    def retry_delay_s(self, attempt: int) -> float:
+        """Capped, jittered exponential backoff: min(cap, base·2^attempt)
+        with ±``backoff_jitter`` spread (seedable for deterministic tests)."""
+        from tpu_docker_api.utils.backoff import backoff_delay_s
+
+        return backoff_delay_s(attempt, self._backoff_base_s,
+                               self._backoff_max_s, self._backoff_jitter,
+                               self._rng)
 
     def dead_letter_view(self) -> list[dict]:
         """Snapshot for the debug endpoint — dead letters must be observable,
