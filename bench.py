@@ -122,7 +122,9 @@ def measure_control_plane(iters: int = 100, runtime: str = "fake") -> dict:
     }
 
 
-def main() -> None:
+def main() -> int | None:
+    """Returns a nonzero exit code on backend-init failure (consumed by
+    the ``sys.exit(main())`` entry); None = success."""
     parser = argparse.ArgumentParser()
     parser.add_argument("--preset", default="llama3-1b")
     parser.add_argument("--batch", type=int, default=0, help="0 = auto")
@@ -164,10 +166,28 @@ def main() -> None:
         })
         return
 
-    import jax
+    # first line of every run: a schema-valid diagnostic emitted BEFORE any
+    # backend-dependent work, so the artifact is never empty — a dead TPU
+    # driver used to hang silently inside the first compile and the
+    # driver's kill erased everything (the BENCH_r04/MULTICHIP_r05 class).
+    # Backend init failure is itself a structured line + fast nonzero exit.
+    try:
+        import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+        boot_devices = jax.devices()
+    except Exception as e:
+        emit({"metric": "bench_boot", "value": None, "unit": "devices",
+              "vs_baseline": None, "rc": 1,
+              "error": f"backend-init: {type(e).__name__}: {str(e)[:200]}"})
+        return 1
+    emit({"metric": "bench_boot", "value": len(boot_devices),
+          "unit": "devices", "vs_baseline": 1.0, "rc": 0,
+          "extra": {"platform": boot_devices[0].platform,
+                    "device_count": len(boot_devices),
+                    "device_kind": getattr(boot_devices[0], "device_kind",
+                                           "")}})
 
     import dataclasses
 

@@ -105,9 +105,11 @@ def test_default_plan_covers_verdict_done_set():
 
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
-    """Full subprocess run on CPU: line 1 is the headline, every line
-    parses, and the last line repeats the headline with a compact rider
-    digest (so a last-line tail parse also lands on the headline)."""
+    """Full subprocess run on CPU: line 1 is the backend-boot diagnostic
+    (emitted BEFORE any backend-dependent work, so the artifact is never
+    empty even when the backend wedges), line 2 is the headline, every
+    line parses, and the last line repeats the headline with a compact
+    rider digest (so a last-line tail parse also lands on the headline)."""
     import subprocess
 
     proc = subprocess.run(
@@ -118,12 +120,38 @@ def test_headline_prints_first_end_to_end():
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-2000:]
     lines = [json.loads(ln) for ln in proc.stdout.splitlines() if ln]
-    assert len(lines) >= 2
-    assert lines[0]["metric"] == "llama_train_tokens_per_sec_per_chip"
-    assert SCHEMA_KEYS <= set(lines[0])
+    assert len(lines) >= 3
+    boot = lines[0]
+    assert boot["metric"] == "bench_boot"
+    assert SCHEMA_KEYS <= set(boot)
+    assert boot["rc"] == 0 and boot["value"] >= 1
+    assert boot["extra"]["platform"] == "cpu"
+    assert lines[1]["metric"] == "llama_train_tokens_per_sec_per_chip"
+    assert SCHEMA_KEYS <= set(lines[1])
     last = lines[-1]
     assert last["metric"] == "llama_train_tokens_per_sec_per_chip"
     assert "riders" in last["extra"]
     # the tail-parse anchor stays compact (r3's parsed:null was a
     # multi-KB line overflowing the driver's bounded tail read)
     assert len(json.dumps(last)) < 1024
+
+
+def test_bench_boot_line_fails_fast_on_backend_init_error():
+    """A dead backend must produce a STRUCTURED first line and a nonzero
+    exit, never a silent hang into the driver's kill (the class that
+    emptied BENCH_r04.json / MULTICHIP_r05.json)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--preset", "tiny", "--platform",
+         "definitely_not_a_platform", "--steps", "2", "--warmup", "1"],
+        cwd=Path(__file__).resolve().parent.parent,
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode != 0
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines() if ln]
+    assert len(lines) == 1
+    assert lines[0]["metric"] == "bench_boot"
+    assert lines[0]["rc"] == 1
+    assert "backend-init" in lines[0]["error"]
+    assert SCHEMA_KEYS <= set(lines[0])

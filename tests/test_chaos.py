@@ -103,7 +103,8 @@ CASES = (
 
 def test_case_matrix_covers_every_crash_point():
     assert {p for _, p in CASES} == set(CONTAINER_CRASH_POINTS)
-    assert {p for _, p in JOB_CASES} == set(JOB_CRASH_POINTS)
+    assert ({p for _, p in JOB_CASES} | {p for p in MIGRATE_POINTS}
+            | {INFEASIBLE_MIGRATE_POINT} == set(JOB_CRASH_POINTS))
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
@@ -422,6 +423,271 @@ class TestJobCrashLoop:
         sup.poll_once()
         assert prg.store.get_job("train-0").restarts == 2
         assert rt1.container_inspect("train-0-p1").running
+
+
+def boot_pod4(kv, rts) -> Program:
+    """4-host v5e pod in a 4x1 row (h0 local): enough healthy spare
+    capacity that a 2-host gang on h0+h1 can migrate onto h2+h3."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        host_probe_interval_s=0.01,  # breaker cooldown rides this: tests
+        pod_hosts=(                  # must not wait 5 s for a half-open probe
+            [{"host_id": "h0", "address": "10.0.0.1",
+              "grid_coord": [0, 0, 0], "local": True}]
+            + [{"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+                "grid_coord": [i, 0, 0], "runtime_backend": "fake"}
+               for i in range(1, 4)]
+        ),
+    )
+    prg = Program(cfg, kv=kv, runtime=rts[0],
+                  pod_runtimes={f"h{i}": rts[i] for i in range(1, 4)})
+    prg.init()
+    return prg
+
+
+#: migrate_gang crash points that the FEASIBLE flow (healthy spare hosts,
+#: allocate-first path) traverses; the release-first point needs a pool
+#: too small for old+new and gets its own scenario below
+MIGRATE_POINTS = ("job.migrate.after_mark", "job.migrate.after_create_new",
+                  "job.migrate.after_quiesce_old",
+                  "job.migrate.after_start_new")
+INFEASIBLE_MIGRATE_POINT = "job.migrate.after_release"
+
+
+class TestHostFailureChaos:
+    """Host failure domains (docs/robustness.md): blip vs dead, gang
+    migration budget separation, crash-mid-migration adoption, and drain
+    against a full pool."""
+
+    def _pod4(self):
+        kv = MemoryKV()
+        inner = [FakeRuntime() for _ in range(4)]
+        rts = [inner[0]] + [FaultyRuntime(r, FaultPlan()) for r in inner[1:]]
+        prg = boot_pod4(kv, rts)
+        return prg, kv, rts, inner
+
+    def _supervision(self, prg, grace=15.0):
+        from tpu_docker_api.service.host_health import HostMonitor
+        from tpu_docker_api.service.job_supervisor import JobSupervisor
+
+        clock = {"now": 0.0}
+        mon = HostMonitor(prg.pod, prg.pod_scheduler,
+                          down_grace_s=grace, clock=lambda: clock["now"])
+        sup = JobSupervisor(
+            prg.pod, prg.job_svc, prg.store, prg.job_versions,
+            max_restarts=3, max_migrations=3, backoff_jitter=0.0,
+            clock=lambda: clock["now"], host_monitor=mon)
+        return mon, sup, clock
+
+    def test_blip_then_dead_host_migrates_without_restart_budget(self):
+        """THE acceptance scenario: a sub-grace blip causes zero
+        restarts; a confirmed-down host trips the breaker and the gang
+        migrates onto healthy hosts charged to the migration budget —
+        the crash-restart budget stays untouched — and the down host
+        receives no new placements until it is back and uncordoned."""
+        import time as _time
+
+        prg, kv, rts, inner = self._pod4()
+        mon, sup, clock = self._supervision(prg, grace=15.0)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))  # gang on h0+h1
+        st = prg.store.get_job("train-0")
+        assert sorted({h for h, *_ in st.placements}) == ["h0", "h1"]
+
+        # ---- blip: shorter than the grace window ⇒ ZERO restarts ----
+        rts[1].set_unreachable(True)
+        mon.probe_once()                       # t=0 → suspect
+        sup.poll_once()
+        clock["now"] = 5.0                     # inside the grace window
+        mon.probe_once()
+        sup.poll_once()
+        st = prg.store.get_job("train-0")
+        assert st.phase == "running" and st.restarts == 0
+        assert st.migrations == 0
+        events = [e["event"] for e in sup.events_view(limit=100)]
+        assert "host-blip" in events
+        assert "gang-restarting" not in events
+        assert "gang-migrating" not in events
+        # every member still untouched (no stop was ever issued)
+        assert inner[1].container_inspect("train-0-p1").running
+        rts[1].set_unreachable(False)
+        _time.sleep(0.03)                      # past the breaker cooldown
+        clock["now"] = 6.0
+        mon.probe_once()
+        assert mon.host_state("h1") == "healthy"
+
+        # ---- dead: grace elapses ⇒ breaker open, gang migrates ----
+        rts[1].set_unreachable(True)
+        clock["now"] = 10.0
+        mon.probe_once()                       # suspect again
+        clock["now"] = 25.0
+        mon.probe_once()                       # grace elapsed → down
+        mon.probe_once()                       # third consecutive failure
+        assert mon.is_down("h1")
+        assert prg.pod.hosts["h1"].runtime.view()["state"] == "open"
+        sup.poll_once()
+        st = prg.store.get_job(f"train-{prg.job_versions.get('train')}")
+        assert st.phase == "running"
+        assert st.migrations == 1 and st.restarts == 0  # separate budgets
+        hosts_now = sorted({h for h, *_ in st.placements})
+        assert hosts_now == ["h2", "h3"]
+        for host_id, cname, *_ in st.placements:
+            assert prg.pod.hosts[host_id].runtime.container_inspect(
+                cname).running
+        assert _job_oracle(prg) == []
+
+        # ---- the down host takes no placements; cordon outlives the
+        #      outage; uncordon restores it ----
+        assert prg.pod_scheduler.down_hosts() == {"h1"}
+        mon.cordon("h1")
+        rts[1].set_unreachable(False)
+        _time.sleep(0.03)
+        clock["now"] = 30.0
+        mon.probe_once()                       # recovered → down cleared
+        assert prg.pod_scheduler.down_hosts() == set()
+        g = prg.pod_scheduler.apply_slice(n_chips=8, owner="x")
+        assert [h for h, _ in g.hosts] == ["h0"]   # h1 still cordoned
+        with pytest.raises(Exception, match="cordoned"):
+            prg.pod_scheduler.apply_slice(n_chips=8, owner="y")
+        mon.uncordon("h1")
+        g2 = prg.pod_scheduler.apply_slice(n_chips=8, owner="y")
+        assert [h for h, _ in g2.hosts] == ["h1"]
+
+    @pytest.mark.parametrize("point", MIGRATE_POINTS)
+    def test_crash_mid_migration_reconcile_converges(self, point):
+        """Daemon dies inside migrate_gang: a fresh daemon over the same
+        engines (the bad host still unreachable) adopts the half-done
+        migration and converges to one healthy gang off the dead host."""
+        prg, kv, rts, inner = self._pod4()
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))  # h0+h1
+        rts[1].set_unreachable(True)
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.migrate_gang("train", {"h1"},
+                                         reason="host down")
+
+        prg2 = boot_pod4(kv, rts)
+        kv_before = dict(kv.range_prefix("/"))
+        muts_before = [_mutations(r) for r in inner]
+        dry = prg2.reconciler.reconcile(dry_run=True)
+        assert dry["actions"], f"no drift reported at {point}"
+        assert dict(kv.range_prefix("/")) == kv_before
+        assert [_mutations(r) for r in inner] == muts_before
+
+        report = prg2.reconciler.reconcile()
+        assert report["actions"], f"nothing repaired at {point}"
+        problems = _job_oracle(prg2)
+        assert problems == [], f"{point}: {problems}"
+        latest = prg2.job_versions.get("train")
+        st = prg2.store.get_job(f"train-{latest}")
+        assert st.phase == "running", f"{point}: {st.phase}"
+        assert "h1" not in {h for h, *_ in st.placements}
+        for host_id, cname, *_ in st.placements:
+            assert prg2.pod.hosts[host_id].runtime.container_inspect(
+                cname).running
+        # host faults never touch the crash-restart budget... except the
+        # one unavoidable adoption corner (create_new/quiesce_old land
+        # the new version as created-never-started, which the reconciler
+        # finishes through restart-gang) — even there it costs at most 1
+        assert st.restarts <= 1
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    def test_supervisor_adoption_excludes_observed_unreachable(self):
+        """Down verdicts are in-memory and die with the daemon: a fresh
+        supervisor adopting an interrupted migration inside the new grace
+        window (host not yet re-confirmed down) must still exclude the
+        OBSERVED-unreachable host — re-placing onto it would burn the
+        migration budget on placements that cannot start."""
+        prg, kv, rts, inner = self._pod4()
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))  # h0+h1
+        rts[1].set_unreachable(True)
+        with armed("job.migrate.after_mark"):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.migrate_gang("train", {"h1"},
+                                         reason="host down")
+        prg2 = boot_pod4(kv, rts)
+        mon, sup, clock = self._supervision(prg2)
+        sup.poll_once()  # monitor has NOT confirmed h1 down yet
+        st = prg2.store.get_job(f"train-{prg2.job_versions.get('train')}")
+        assert st.phase == "running"
+        assert "h1" not in {h for h, *_ in st.placements}
+        assert st.migrations == 1  # adoption never re-counts
+
+    def test_crash_mid_release_first_migration_converges_to_failed(self):
+        """The release-first arm with NO healthy spare capacity (2-host
+        pod, whole-pod gang): the interrupted migration can never be
+        satisfied, so repeated adoption burns the migration budget and
+        the job converges to terminal failed with every slice and port
+        free — never a live-lock, never a leak."""
+        kv = MemoryKV()
+        rt0 = FakeRuntime()
+        inner1 = FakeRuntime()
+        rt1 = FaultyRuntime(inner1, FaultPlan())
+        prg = boot_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))
+        rt1.set_unreachable(True)
+        with armed(INFEASIBLE_MIGRATE_POINT):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.migrate_gang("train", {"h1"},
+                                         reason="host down")
+
+        prg2 = boot_pod(kv, rt0, rt1)
+        for _ in range(8):
+            prg2.reconciler.reconcile()
+            if prg2.store.get_job("train-0").phase == "failed":
+                break
+        st = prg2.store.get_job("train-0")
+        assert st.phase == "failed"
+        assert "migrations exhausted" in st.failure_reason
+        problems = _job_oracle(prg2)
+        assert problems == [], problems
+        # terminal failed owns NOTHING: all chips on every host are free
+        for host in prg2.pod.hosts.values():
+            assert len(host.chips.free_chips) == 8
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    def test_drain_without_spare_capacity_fails_loudly_frees_nothing(self):
+        """Operator drain of a LIVE host when the pool cannot hold both
+        gangs: the migration raises, the running gang is untouched, its
+        slice stays held, and the failure dead-letters observably."""
+        from tpu_docker_api.service.host_health import HostMonitor
+
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))  # the whole pod
+        mon = HostMonitor(prg.pod, prg.pod_scheduler,
+                          job_svc=prg.job_svc,
+                          job_versions=prg.job_versions,
+                          work_queue=prg.wq)
+        out = mon.drain("h1")
+        assert out["drainingJobs"] == ["train"]
+        assert prg.pod_scheduler.cordoned_hosts() == {"h1"}
+        # the queued migration fails LOUDLY (retries, then dead-letters)
+        prg.wq.start()
+        prg.wq.drain()
+        prg.wq.close()
+        letters = prg.wq.dead_letter_view()
+        assert len(letters) == 1
+        assert "ChipNotEnough" in letters[0]["error"]
+        kinds = [e["event"] for e in mon.events_view()]
+        assert "host-drain-failed" in kinds
+        # ... and freed NOTHING: the gang still runs where it was, the
+        # slice grant still stands, capacity still fully held
+        st = prg.store.get_job("train-0")
+        assert st.phase == "running" and st.desired_running
+        for host_id, cname, *_ in st.placements:
+            assert prg.pod.hosts[host_id].runtime.container_inspect(
+                cname).running
+        assert prg.pod_scheduler.get_grant("train-0") is not None
+        assert all(len(h.chips.free_chips) == 0
+                   for h in prg.pod.hosts.values())
+        assert _job_oracle(prg) == []
 
 
 class TestAmbiguousEngineFailures:

@@ -1,0 +1,316 @@
+"""Host failure domains (docs/robustness.md "Host failure domains"):
+circuit breaker transitions, the healthy→suspect→down state machine and
+its grace window, cordon persistence across daemon restarts, and the
+scheduler's refusal to place on cordoned/down hosts."""
+
+import pytest
+
+pytestmark = pytest.mark.chaos  # rides `make chaos` with the fault tier
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.faulty import FaultPlan, FaultyRuntime
+from tpu_docker_api.schemas.job import JobRun
+from tpu_docker_api.service.host_health import BreakerRuntime, HostMonitor
+from tpu_docker_api.state.kv import MemoryKV
+
+
+def boot_pod(kv, local_rt, remote_rt) -> Program:
+    """2-host v5e pod; h1 remote (breaker-wrapped by the daemon)."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        pod_hosts=[
+            {"host_id": "h0", "address": "10.0.0.1", "grid_coord": [0, 0, 0],
+             "local": True},
+            {"host_id": "h1", "address": "10.0.0.2", "grid_coord": [1, 0, 0],
+             "runtime_backend": "fake"},
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=local_rt,
+                  pod_runtimes={"h1": remote_rt})
+    prg.init()
+    return prg
+
+
+class TestBreaker:
+    def _mk(self, threshold=3, cooldown=5.0):
+        clock = {"now": 0.0}
+        inner = FaultyRuntime(FakeRuntime(), FaultPlan())
+        br = BreakerRuntime(inner, host_id="h1", threshold=threshold,
+                            cooldown_s=cooldown, clock=lambda: clock["now"])
+        return br, inner, clock
+
+    def test_opens_after_threshold_and_fast_fails(self):
+        br, inner, clock = self._mk(threshold=3)
+        inner.set_unreachable(True)
+        for _ in range(3):
+            with pytest.raises(errors.HostUnreachable):
+                br.container_list()
+        assert br.view()["state"] == "open"
+        # open: fast-fail WITHOUT touching the inner engine
+        inner_calls = len(inner.calls)
+        with pytest.raises(errors.HostUnreachable, match="circuit open"):
+            br.container_list()
+        assert len(inner.calls) == inner_calls
+
+    def test_half_open_probe_closes_on_success(self):
+        br, inner, clock = self._mk(threshold=2, cooldown=5.0)
+        inner.set_unreachable(True)
+        for _ in range(2):
+            with pytest.raises(errors.HostUnreachable):
+                br.container_list()
+        assert br.view()["state"] == "open"
+        inner.set_unreachable(False)
+        # inside the cooldown: still fast-failing (engine never touched)
+        clock["now"] = 4.0
+        with pytest.raises(errors.HostUnreachable, match="circuit open"):
+            br.container_list()
+        # past the cooldown: the next call IS the half-open probe
+        clock["now"] = 6.0
+        assert br.container_list() == []
+        assert br.view()["state"] == "closed"
+        assert br.view()["consecutiveFailures"] == 0
+
+    def test_half_open_probe_failure_reopens(self):
+        br, inner, clock = self._mk(threshold=2, cooldown=5.0)
+        inner.set_unreachable(True)
+        for _ in range(2):
+            with pytest.raises(errors.HostUnreachable):
+                br.container_list()
+        clock["now"] = 6.0
+        with pytest.raises(errors.HostUnreachable):
+            br.container_list()  # probe goes through, fails
+        assert br.view()["state"] == "open"
+        # re-armed for another full cooldown
+        clock["now"] = 7.0
+        with pytest.raises(errors.HostUnreachable, match="circuit open"):
+            br.container_list()
+
+    def test_application_errors_prove_the_host_alive(self):
+        br, inner, clock = self._mk(threshold=2)
+        inner.set_unreachable(True)
+        with pytest.raises(errors.HostUnreachable):
+            br.container_list()
+        inner.set_unreachable(False)
+        # an app error (container missing) resets the failure streak
+        with pytest.raises(errors.ContainerNotExist):
+            br.container_inspect("nope")
+        assert br.view()["consecutiveFailures"] == 0
+        inner.set_unreachable(True)
+        with pytest.raises(errors.HostUnreachable):
+            br.container_list()
+        assert br.view()["state"] == "closed"  # streak restarted at 1/2
+
+    def test_normalizes_connection_errors(self):
+        class Sock(FakeRuntime):
+            def container_list(self):
+                raise ConnectionRefusedError("boom")
+
+        br = BreakerRuntime(Sock(), host_id="h9", threshold=3)
+        with pytest.raises(errors.HostUnreachable, match="h9"):
+            br.container_list()
+
+
+class TestHostStateMachine:
+    def _mk(self, grace=15.0):
+        kv = MemoryKV()
+        rt1 = FaultyRuntime(FakeRuntime(), FaultPlan())
+        prg = boot_pod(kv, FakeRuntime(), rt1)
+        clock = {"now": 0.0}
+        mon = HostMonitor(prg.pod, prg.pod_scheduler, down_grace_s=grace,
+                          clock=lambda: clock["now"])
+        return prg, rt1, mon, clock
+
+    def test_blip_inside_grace_never_goes_down(self):
+        prg, rt1, mon, clock = self._mk(grace=15.0)
+        mon.probe_once()
+        assert mon.host_state("h1") == "healthy"
+        rt1.set_unreachable(True)
+        mon.probe_once()
+        assert mon.host_state("h1") == "suspect"
+        clock["now"] = 10.0  # inside the grace window
+        mon.probe_once()
+        assert mon.host_state("h1") == "suspect"
+        assert not mon.is_down("h1")
+        assert prg.pod_scheduler.down_hosts() == set()
+        rt1.set_unreachable(False)
+        clock["now"] = 12.0
+        mon.probe_once()
+        assert mon.host_state("h1") == "healthy"
+        events = [e["event"] for e in mon.events_view()]
+        assert "host-suspect" in events and "host-blip-over" in events
+        assert "host-down" not in events
+
+    def test_grace_elapsed_marks_down_and_unschedulable(self):
+        prg, rt1, mon, clock = self._mk(grace=15.0)
+        rt1.set_unreachable(True)
+        down_hook = []
+        mon._on_down = down_hook.append
+        mon.probe_once()                  # → suspect at t=0
+        clock["now"] = 15.0
+        mon.probe_once()                  # grace elapsed → down
+        assert mon.is_down("h1")
+        assert prg.pod_scheduler.down_hosts() == {"h1"}
+        assert not prg.pod_scheduler.host_schedulable("h1")
+        assert down_hook == ["h1"]
+        # recovery: probe succeeds → healthy again, schedulable again
+        # (only two probes failed, so h1's breaker never opened and the
+        # recovery probe passes straight through; with an open breaker the
+        # post-cooldown probe doubles as the half-open trial)
+        rt1.set_unreachable(False)
+        clock["now"] = 30.0
+        mon.probe_once()
+        assert mon.host_state("h1") == "healthy"
+        assert prg.pod_scheduler.down_hosts() == set()
+        events = [e["event"] for e in mon.events_view()]
+        assert "host-down" in events and "host-recovered" in events
+
+    def test_status_view_reports_breaker_and_schedulability(self):
+        kv = MemoryKV()
+        rt1 = FaultyRuntime(FakeRuntime(), FaultPlan())
+        prg = boot_pod(kv, FakeRuntime(), rt1)
+        mon = HostMonitor(prg.pod, prg.pod_scheduler)
+        view = mon.status_view()
+        assert set(view["hosts"]) == {"h0", "h1"}
+        assert view["hosts"]["h1"]["state"] == "healthy"
+        assert view["hosts"]["h1"]["schedulable"]
+        # h1 is breaker-wrapped by the daemon (breaker_threshold default)
+        assert view["hosts"]["h1"]["breaker"]["state"] == "closed"
+        rt1.set_unreachable(True)
+        for _ in range(4):
+            mon.probe_once()
+        view = mon.status_view()
+        assert view["hosts"]["h1"]["state"] == "suspect"
+        assert view["hosts"]["h1"]["breaker"]["state"] == "open"
+
+
+class TestCordon:
+    def test_cordoned_host_receives_no_placements_until_uncordon(self):
+        kv = MemoryKV()
+        prg = boot_pod(kv, FakeRuntime(), FakeRuntime())
+        prg.pod_scheduler.cordon_host("h1")
+        # whole-host ask lands on the only schedulable host
+        g1 = prg.pod_scheduler.apply_slice(n_chips=8, owner="a")
+        assert [h for h, _ in g1.hosts] == ["h0"]
+        with pytest.raises(errors.ChipNotEnough, match="cordoned"):
+            prg.pod_scheduler.apply_slice(n_chips=8, owner="b")
+        # sub-host asks skip it too
+        with pytest.raises(errors.ChipNotEnough):
+            prg.pod_scheduler.apply_slice(n_chips=4, owner="c")
+        view = prg.pod_scheduler.host_view("h1")
+        assert view["cordoned"] and not view["schedulable"]
+        prg.pod_scheduler.uncordon_host("h1")
+        g2 = prg.pod_scheduler.apply_slice(n_chips=8, owner="b")
+        assert [h for h, _ in g2.hosts] == ["h1"]
+
+    def test_cordon_survives_daemon_restart(self):
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_pod(kv, rt0, rt1)
+        prg.pod_scheduler.cordon_host("h1")
+        # the daemon dies; a fresh control plane boots over the same KV
+        prg2 = boot_pod(kv, rt0, rt1)
+        assert prg2.pod_scheduler.cordoned_hosts() == {"h1"}
+        assert not prg2.pod_scheduler.host_schedulable("h1")
+        with pytest.raises(errors.ChipNotEnough):
+            prg2.pod_scheduler.apply_slice(n_chips=16, owner="big")
+        prg2.pod_scheduler.uncordon_host("h1")
+        # ... and the uncordon persists as well
+        prg3 = boot_pod(kv, rt0, rt1)
+        assert prg3.pod_scheduler.cordoned_hosts() == set()
+
+    def test_cordon_unknown_host_rejected(self):
+        prg = boot_pod(MemoryKV(), FakeRuntime(), FakeRuntime())
+        with pytest.raises(errors.ContainerNotExist):
+            prg.pod_scheduler.cordon_host("nope")
+
+    def test_capacity_accounting_excludes_unschedulable(self):
+        prg = boot_pod(MemoryKV(), FakeRuntime(), FakeRuntime())
+        st = prg.pod_scheduler.status()
+        assert st["freeHosts"] == 2
+        assert st["schedulableChips"] == 16
+        prg.pod_scheduler.cordon_host("h1")
+        st = prg.pod_scheduler.status()
+        assert st["freeHosts"] == 1
+        assert st["schedulableChips"] == 8
+        assert st["freeSchedulableChips"] == 8
+        assert st["cordonedHosts"] == ["h1"]
+        prg.pod_scheduler.set_host_down("h0", True)
+        st = prg.pod_scheduler.status()
+        assert st["freeHosts"] == 0
+        assert st["downHosts"] == ["h0"]
+
+    def test_exclude_hosts_param_bans_for_one_grant(self):
+        prg = boot_pod(MemoryKV(), FakeRuntime(), FakeRuntime())
+        g = prg.pod_scheduler.apply_slice(n_chips=8, owner="a",
+                                          exclude_hosts={"h0"})
+        assert [h for h, _ in g.hosts] == ["h1"]
+        # the exclusion was per-grant, not sticky
+        g2 = prg.pod_scheduler.apply_slice(n_chips=8, owner="b")
+        assert [h for h, _ in g2.hosts] == ["h0"]
+
+
+class TestOperatorSurface:
+    def test_cordon_drain_health_routes(self):
+        """The HTTP surface: cordon/uncordon flip schedulability, drain
+        queues migrations, /health/hosts serves the monitor view."""
+        import json
+        import urllib.request
+
+        kv = MemoryKV()
+        prg = boot_pod(kv, FakeRuntime(), FakeRuntime())
+        prg.cfg.port = 0                    # ephemeral bind
+        prg.cfg.reconcile_on_start = False
+        prg.cfg.job_supervise_interval = 0
+        prg.host_monitor._interval = 3600   # no surprise probes mid-test
+        try:
+            prg.start()
+
+            def call(method, path):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{prg.api_server.port}{path}",
+                    method=method, data=b"{}" if method == "POST" else None,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req) as resp:
+                    return json.loads(resp.read())
+
+            out = call("POST", "/api/v1/hosts/h1/cordon")
+            assert out["code"] == 200 and out["data"]["cordoned"]
+            health = call("GET", "/api/v1/health/hosts")
+            assert health["data"]["hosts"]["h1"]["cordoned"]
+            assert not health["data"]["hosts"]["h1"]["schedulable"]
+            out = call("POST", "/api/v1/hosts/h1/uncordon")
+            assert not out["data"]["cordoned"]
+            # drain with no jobs: cordons, queues nothing
+            out = call("POST", "/api/v1/hosts/h1/drain")
+            assert out["data"]["cordoned"]
+            assert out["data"]["drainingJobs"] == []
+            # host events reach the merged operator ring
+            events = call("GET", "/api/v1/events")["data"]
+            kinds = [e.get("event") for e in events]
+            assert "host-cordoned" in kinds
+            assert "host-drain-queued" in kinds
+        finally:
+            prg.stop()
+
+    def test_drain_queues_migration_for_placed_jobs(self):
+        kv = MemoryKV()
+        prg = boot_pod(kv, FakeRuntime(), FakeRuntime())
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=4))  # sub-host → h0
+        mon = prg.host_monitor
+        out = mon.drain("h0")
+        assert out["drainingJobs"] == ["train"]
+        assert prg.pod_scheduler.cordoned_hosts() == {"h0"}
+        # run the queued migration synchronously: the gang moves to h1
+        prg.wq.start()
+        prg.wq.drain()
+        prg.wq.close()
+        st = prg.store.get_job("train-1")
+        assert st.phase == "running"
+        assert all(h == "h1" for h, *_ in st.placements)
+        # drain is operator-driven: the fault-migration budget is untouched
+        assert st.migrations == 0

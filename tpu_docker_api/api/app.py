@@ -31,6 +31,10 @@ Route table:
     PATCH  /api/v1/volumes/{name}/rollback     roll to an older version's size
     GET    /api/v1/resources/tpus              chip scheduler view (alias: /gpus)
     GET    /api/v1/resources/ports             port scheduler view
+    POST   /api/v1/hosts/{name}/cordon         no new placements on the host
+    POST   /api/v1/hosts/{name}/uncordon       lift the cordon
+    POST   /api/v1/hosts/{name}/drain          cordon + migrate gangs off (async)
+    GET    /api/v1/health/hosts                per-host probe + breaker state
     GET    /api/v1/debug/threads               per-thread stack dump (pprof analog)
     GET    /healthz
 """
@@ -130,7 +134,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  chip_scheduler, port_scheduler, work_queue=None,
                  health_watcher=None, metrics=None,
                  job_svc=None, pod_scheduler=None, reconciler=None,
-                 job_supervisor=None) -> Router:
+                 job_supervisor=None, host_monitor=None) -> Router:
     r = Router(metrics=metrics)
 
     # -- containers (reference api/container.go:19-38) ---------------------------
@@ -324,6 +328,29 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         r.add("GET", "/api/v1/resources/slices",
               lambda body, **_: pod_scheduler.status())
 
+        # -- host failure domains (docs/robustness.md): cordon/uncordon are
+        #    pure scheduler state (persisted in KV); drain + health need
+        #    the monitor --------------------------------------------------------
+        def h_cordon(body, name):
+            if host_monitor is not None:
+                return host_monitor.cordon(name)
+            return pod_scheduler.cordon_host(name)
+
+        def h_uncordon(body, name):
+            if host_monitor is not None:
+                return host_monitor.uncordon(name)
+            return pod_scheduler.uncordon_host(name)
+
+        r.add("POST", "/api/v1/hosts/{name}/cordon", h_cordon)
+        r.add("POST", "/api/v1/hosts/{name}/uncordon", h_uncordon)
+    if host_monitor is not None:
+        # async drain: cordon now, gang migrations ride the work queue
+        r.add("POST", "/api/v1/hosts/{name}/drain",
+              lambda body, name: host_monitor.drain(name))
+        # per-host probe state + breaker + schedulability
+        r.add("GET", "/api/v1/health/hosts",
+              lambda body, **_: host_monitor.status_view())
+
     # -- resource views (reference api/resource.go:12-29) ------------------------
 
     r.add("GET", "/api/v1/resources/tpus", lambda body, **_: chip_scheduler.status())
@@ -331,10 +358,12 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/resources/ports", lambda body, **_: port_scheduler.status())
     r.add("GET", "/healthz",
           lambda body, **_: {"status": "ok", **build_info()})
-    if health_watcher is not None or job_supervisor is not None:
+    if (health_watcher is not None or job_supervisor is not None
+            or host_monitor is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
-        # supervisor), ordered by timestamp (SURVEY.md §5.3)
+        # supervisor) and host health transitions (host monitor), ordered
+        # by timestamp (SURVEY.md §5.3)
         def h_events(body, **_):
             try:
                 limit = int(body.get("limit", 100))
@@ -345,6 +374,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                 events.extend(health_watcher.events_view(limit=limit))
             if job_supervisor is not None:
                 events.extend(job_supervisor.events_view(limit=limit))
+            if host_monitor is not None:
+                events.extend(host_monitor.events_view(limit=limit))
             events.sort(key=lambda e: e.get("ts", 0))
             return events[-limit:] if limit > 0 else []
 

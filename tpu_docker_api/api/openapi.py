@@ -176,15 +176,30 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("GET", "/api/v1/resources/ports", "getUsedPorts",
      "Host-port scheduler state", None),
     ("GET", "/api/v1/resources/slices", "getSlices",
-     "Pod view: host grid, per-host free chips, active slice grants", None),
+     "Pod view: host grid, per-host free chips + schedulability "
+     "(cordon/down), active slice grants", None),
+    ("POST", "/api/v1/hosts/{name}/cordon", "cordonHost",
+     "No new placements on the host (persisted; survives daemon restarts); "
+     "existing workloads untouched", None),
+    ("POST", "/api/v1/hosts/{name}/uncordon", "uncordonHost",
+     "Lift a cordon: the host is schedulable again", None),
+    ("POST", "/api/v1/hosts/{name}/drain", "drainHost",
+     "Cordon the host and migrate every gang off it (async via the work "
+     "queue); no healthy capacity ⇒ the migration fails loudly and frees "
+     "nothing", None),
+    ("GET", "/api/v1/health/hosts", "getHostHealth",
+     "Per-host probe state (healthy/suspect/down), circuit-breaker state, "
+     "cordon/schedulability", None),
     ("GET", "/api/v1/events", "getHealthEvents",
      "Container liveness transitions (health watcher) merged with gang "
-     "lifecycle events (job supervisor), ordered by timestamp", None),
+     "lifecycle events (job supervisor) and host health transitions "
+     "(host monitor), ordered by timestamp", None),
     ("GET", "/api/v1/health/containers", "getHealthStatus",
      "Per-container liveness + restart bookkeeping", None),
     ("GET", "/api/v1/health/jobs", "getJobHealth",
-     "Per-job gang status: phase (running/restarting/failed/stopped), "
-     "restart budget, dead/missing members, backoff remaining", None),
+     "Per-job gang status: phase (running/restarting/migrating/failed/"
+     "stopped), restart + migration budgets, dead/missing members, "
+     "unreachable hosts, backoff remaining", None),
     ("GET", "/api/v1/debug/deadletters", "getDeadLetters",
      "Async tasks that exhausted retries (never silently dropped)", None),
     ("POST", "/api/v1/dead-letters/retry", "retryDeadLetters",

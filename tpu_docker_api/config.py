@@ -68,6 +68,24 @@ class Config:
     job_backoff_base_s: float = 1.0
     job_backoff_max_s: float = 60.0
     job_backoff_jitter: float = 0.1
+    # host failure domains (service/host_health.py): engine-probe interval
+    # over every pod host; 0 disables the monitor — and with it automatic
+    # host-down detection / gang migration, the drain route, and
+    # GET /api/v1/health/hosts; cordon/uncordon (pure scheduler state)
+    # keep working
+    host_probe_interval_s: float = 5.0
+    # continuous probe failure longer than this confirms a host "down"
+    # (scheduler stops placing, gangs migrate off); anything shorter is a
+    # blip and causes ZERO restarts
+    host_down_grace_s: float = 15.0
+    # circuit breaker around each non-local host engine: consecutive
+    # connection failures before it opens (open ⇒ calls fast-fail instead
+    # of hanging on a dead socket); 0 disables the breakers
+    breaker_threshold: int = 3
+    # host-fault migrations before a job goes terminal "failed" — a budget
+    # SEPARATE from job_max_restarts, so dead hosts never eat the
+    # crash-restart budget (and crash loops never eat this one)
+    job_max_migrations: int = 3
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container

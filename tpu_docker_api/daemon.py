@@ -88,22 +88,43 @@ class Program:
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path,
         )
+        from tpu_docker_api.service.host_health import HostMonitor
         from tpu_docker_api.service.job_supervisor import JobSupervisor
         from tpu_docker_api.service.reconcile import Reconciler
         from tpu_docker_api.telemetry.metrics import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+        # host failure domains: engine probing + healthy→suspect→down per
+        # host; built before the supervisor so its down-verdicts gate the
+        # supervisor's migrate-vs-hands-off decision from the first poll
+        self.host_monitor = None
+        if cfg.host_probe_interval_s > 0:
+            self.host_monitor = HostMonitor(
+                self.pod, self.pod_scheduler,
+                interval_s=cfg.host_probe_interval_s,
+                down_grace_s=cfg.host_down_grace_s,
+                job_svc=self.job_svc, job_versions=self.job_versions,
+                work_queue=self.wq,
+                registry=self.metrics,
+                # late-bound: the supervisor is constructed just below —
+                # a confirmed-down host must wake it immediately, not
+                # wait out the poll interval
+                on_down=lambda hid: self.job_supervisor.wake(hid),
+            )
         # gang supervision (whole-gang restart with backoff, crash-loop →
-        # terminal failed): built in init so the startup reconcile and the
-        # watcher's delegation hook can use it before start()
+        # terminal failed; host-down → migration): built in init so the
+        # startup reconcile and the watcher's delegation hook can use it
+        # before start()
         self.job_supervisor = JobSupervisor(
             self.pod, self.job_svc, self.store, self.job_versions,
             interval_s=cfg.job_supervise_interval,
             max_restarts=cfg.job_max_restarts,
+            max_migrations=cfg.job_max_migrations,
             backoff_base_s=cfg.job_backoff_base_s,
             backoff_max_s=cfg.job_backoff_max_s,
             backoff_jitter=cfg.job_backoff_jitter,
             registry=self.metrics,
+            host_monitor=self.host_monitor,
         )
         # job families allocate from the same local chip/port pools, so
         # their claims must be off-limits to the reconciler's leak sweep
@@ -114,6 +135,7 @@ class Program:
             shared_version_maps=[self.job_versions],
             job_svc=self.job_svc, job_versions=self.job_versions,
             job_max_restarts=cfg.job_max_restarts,
+            job_max_migrations=cfg.job_max_migrations,
             registry=self.metrics,
         )
 
@@ -152,6 +174,21 @@ class Program:
                 if entry.get("runtime_backend", cfg.runtime_backend) == "docker"
                 else open_runtime("fake", allow_exec=True)
             )
+            if cfg.breaker_threshold > 0:
+                # circuit breaker per REMOTE engine: a dead socket must
+                # cost one timeout, not one per caller per poll. The local
+                # host's runtime stays unwrapped — it is shared with the
+                # container service, and a local dockerd outage takes the
+                # daemon with it anyway
+                from tpu_docker_api.service.host_health import BreakerRuntime
+
+                runtime = BreakerRuntime(
+                    runtime, host_id=host_id,
+                    threshold=cfg.breaker_threshold,
+                    # cooldown tied to the probe interval so every monitor
+                    # tick past it doubles as the half-open recovery probe
+                    cooldown_s=cfg.host_probe_interval_s or 5.0,
+                )
             topo = HostTopology.build(
                 entry.get("accelerator_type", cfg.accelerator_type))
             hosts.append(PodHost(
@@ -217,6 +254,8 @@ class Program:
             self.reconciler.start_periodic(self.cfg.reconcile_interval)
         if self.cfg.job_supervise_interval > 0:
             self.job_supervisor.start()
+        if self.host_monitor is not None:
+            self.host_monitor.start()
         self.health_watcher = None
         if self.cfg.health_watch_interval > 0:
             from tpu_docker_api.service.watch import HealthWatcher
@@ -245,6 +284,7 @@ class Program:
             health_watcher=self.health_watcher, metrics=self.metrics,
             job_svc=self.job_svc, pod_scheduler=self.pod_scheduler,
             reconciler=self.reconciler, job_supervisor=self.job_supervisor,
+            host_monitor=self.host_monitor,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -261,6 +301,8 @@ class Program:
             self.api_server.close()
         if getattr(self, "health_watcher", None) is not None:
             self.health_watcher.close()
+        if getattr(self, "host_monitor", None) is not None:
+            self.host_monitor.close()
         if getattr(self, "job_supervisor", None) is not None:
             self.job_supervisor.close()
         if getattr(self, "reconciler", None) is not None:

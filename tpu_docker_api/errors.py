@@ -109,3 +109,25 @@ class PortNotEnough(ApiError):
 class TopologyUnknown(ApiError):
     """The requested slice shape/type is not a known TPU topology."""
     code = 10603
+
+
+# --- host failure domains (service/host_health.py) ----------------------------
+
+class HostUnreachable(ApiError):
+    """A pod host's container engine cannot be reached — connection refused,
+    socket timeout, or the host's circuit breaker is open and fast-failing.
+    Distinct from ContainerNotExist: the CONTAINER's state is unknown, only
+    the path to the engine failed."""
+    code = 10701
+
+
+#: everything that means "the path to a host's engine is broken": the
+#: normalized HostUnreachable a circuit breaker raises, plus the raw
+#: socket errors (ConnectionRefused/Reset, timeouts — OSError subclasses)
+#: that docker_http surfaces when a runtime is NOT breaker-wrapped (the
+#: local pod host always; every host when breaker_threshold = 0). Every
+#: scanner that classifies member state (supervisor, reconciler,
+#: invariants, job service) must catch THIS tuple, not HostUnreachable
+#: alone, or an unwrapped engine's outage reads as a scan crash instead
+#: of an unreachable host.
+HOST_PATH_ERRORS = (HostUnreachable, OSError)

@@ -98,6 +98,7 @@ raises explicitly rather than degrading.
 
 from __future__ import annotations
 
+import bisect
 import queue
 from typing import Any
 
@@ -246,53 +247,66 @@ class PagedSlotEngine(SlotEngine):
 
     def validate(self, prompt, max_new, top_k=0, top_p=1.0):
         super().validate(prompt, max_new, top_k=top_k, top_p=top_p)
-        plan = self._px_plan(list(prompt))
+        prompt = list(prompt)
         # pages PERMANENTLY pinned by registered prefixes never return
         # to the free list while registered — a request whose need
         # exceeds usable-minus-pinned can never admit, and (strict
         # FCFS) would hang every request behind it; submit() promises
         # to raise for can-never-fit instead
-        with self._lock:
-            pinned = sum(len(e.page_ids)
-                         for e in self._prefixes.values())
-        sfx_len = (len(prompt) - plan[0].shared_len
-                   if plan is not None else len(prompt))
-        chunked_route = self.prefill_chunk and (
-            sfx_len > self.prefill_chunk
-            or len(prompt) > self.buckets[-1])
-        if chunked_route:
-            # _admit will serve this through page-aware segments —
-            # whose worst-case need has no bucket-rounding term
-            need = _ceil_div(len(prompt) + max_new - 1, self.page_size)
-        elif plan is not None:
-            ent, sbucket = plan
-            need = self._px_pages_needed(len(prompt), max_new, ent,
-                                         sbucket)
-        else:
-            bucket = next((b for b in self.buckets
-                           if b >= len(prompt)), None)
-            if bucket is None:
-                if not self.prefill_chunk:
-                    # base validate admitted this length via a prefix
-                    # that no longer resolves (concurrent unregister) —
-                    # the admission-time re-resolve fails the handle;
-                    # here the request can still never fit a bucket
-                    raise ValueError(
-                        f"prompt ({len(prompt)}) exceeds the largest "
-                        f"prefill bucket ({self.buckets[-1]}) and no "
-                        f"registered prefix covers it")
-                # chunked admission: segments cover the prompt, so the
-                # full need has no bucket-rounding term
-                need = _ceil_div(len(prompt) + max_new - 1,
-                                 self.page_size)
-            else:
-                need = self._pages_needed(len(prompt), max_new, bucket)
+        pinned = self._pinned_pages()
+        plan = self._px_plan(prompt)
+        if (plan is None and self._prompt_bucket(prompt) is None
+                and not self.prefill_chunk):
+            # base validate admitted this length via a prefix that no
+            # longer resolves (concurrent unregister) — the
+            # admission-time re-resolve fails the handle; here the
+            # request can still never fit a bucket
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds the largest "
+                f"prefill bucket ({self.buckets[-1]}) and no "
+                f"registered prefix covers it")
+        need = self._worst_case_need(prompt, max_new, plan=plan)
         if need > self._usable_pages - pinned:
             raise ValueError(
                 f"request needs {need} pages "
                 f"({len(prompt)}+{max_new} tokens at page size "
                 f"{self.page_size}); the pool has {self._usable_pages}"
                 f" with {pinned} pinned by registered prefixes")
+
+    def _prompt_bucket(self, prompt: list[int]) -> int | None:
+        return next((b for b in self.buckets if b >= len(prompt)), None)
+
+    _PLAN_UNSET = object()
+
+    def _worst_case_need(self, prompt: list[int], max_new: int,
+                         plan=_PLAN_UNSET) -> int:
+        """Total pool pages the request needs at its worst moment — the
+        can-never-fit criterion validate() applies at submit time, reused
+        by the post-pin re-validation (register_prefix) and the
+        admission-time re-check (_admit): the criterion must be ONE
+        computation or the three gates drift. ``plan`` lets a caller that
+        already resolved the prefix plan skip the second registry scan
+        (None is a meaningful value: no prefix applies)."""
+        if plan is PagedSlotEngine._PLAN_UNSET:
+            plan = self._px_plan(prompt)
+        sfx_len = (len(prompt) - plan[0].shared_len
+                   if plan is not None else len(prompt))
+        chunked_route = self.prefill_chunk and (
+            sfx_len > self.prefill_chunk
+            or len(prompt) > self.buckets[-1])
+        if chunked_route:
+            # served through page-aware segments — the worst-case need
+            # has no bucket-rounding term
+            return _ceil_div(len(prompt) + max_new - 1, self.page_size)
+        if plan is not None:
+            ent, sbucket = plan
+            return self._px_pages_needed(len(prompt), max_new, ent,
+                                         sbucket)
+        bucket = self._prompt_bucket(prompt)
+        if bucket is None:
+            # chunked admission: segments cover the prompt
+            return _ceil_div(len(prompt) + max_new - 1, self.page_size)
+        return self._pages_needed(len(prompt), max_new, bucket)
 
     # ---- prefix cache (shared pages) ----------------------------------------
 
@@ -385,7 +399,71 @@ class PagedSlotEngine(SlotEngine):
             self._prefixes[pid] = ent
             self.stats["prefix_bytes"] += nbytes
         self.stats["pages_free"] = len(self._free)
+        # pinning shrank the pool FOR AS LONG AS the prefix is registered:
+        # an already-admitted or deferred request whose worst-case
+        # remaining need no longer fits usable-minus-pinned can NEVER
+        # complete — in grow mode it would hit the reservation edge, find
+        # no junior to preempt, self-preempt, re-admit, and livelock (and
+        # strict FCFS would wedge everything behind it). Re-validate every
+        # live request against the post-pin capacity and fail the
+        # now-unfittable ones loudly, exactly as submit() would have.
+        self._fail_unfittable_after_pin()
         return pid
+
+    def _pin_err(self, need: int, capacity: int, pinned: int) -> ValueError:
+        return ValueError(
+            f"registered prefixes pinned pool pages: this request "
+            f"needs {need} pages but at most {capacity} can ever be "
+            f"free ({pinned} pinned by registered prefixes) — it could "
+            f"never be scheduled again")
+
+    def _pinned_pages(self) -> int:
+        with self._lock:
+            return sum(len(e.page_ids) for e in self._prefixes.values())
+
+    def _release_slot(self, slot: int) -> list[int]:
+        """Tear one active slot down (table clear, private pages back to
+        the pool, prefix ref drop) and return the slot's ORIGINAL prompt —
+        the shared teardown under both preemption and pin-eviction; the
+        caller decides the request's fate (requeue vs fail)."""
+        with self._lock:
+            self._table[slot] = None
+        self._free.extend(self._slot_pages.pop(slot, []))
+        self._ptable[slot, :] = 0
+        ent = self._slot_prefix.pop(slot, None)
+        if ent is not None:
+            ent.refs -= 1
+        self.stats["pages_free"] = len(self._free)
+        return self._slot_prompt.pop(slot, [])
+
+    def _fail_unfittable_after_pin(self) -> None:
+        page = self.page_size
+        pinned = self._pinned_pages()
+        capacity = self._usable_pages - pinned
+        for i in sorted(list(self._table)):
+            st = self._table.get(i)
+            if st is None:
+                continue
+            shared = (len(self._slot_prefix[i].page_ids)
+                      if i in self._slot_prefix else 0)
+            # the slot's decode peak (the _ensure_coverage cap): one page
+            # past the last live position, minus read-only shared pages
+            peak = st.base_len + (st.max_new - st.preseed) - 1
+            need = _ceil_div(max(peak, 1), page) - shared
+            if need > capacity:
+                self._release_slot(i)
+                st.handle._fail(self._pin_err(need, capacity, pinned))
+        kept = []
+        for req in self._deferred:
+            prompt, max_new = req[0], req[1]
+            carry = req[7] if len(req) == 8 else []
+            need = self._worst_case_need(list(prompt),
+                                         max_new - len(carry))
+            if need > capacity:
+                req[6]._fail(self._pin_err(need, capacity, pinned))
+            else:
+                kept.append(req)
+        self._deferred = kept
 
     def unregister_prefix(self, pid: str) -> bool:
         """Remove from the registry (no new admissions attach); shared
@@ -580,27 +658,28 @@ class PagedSlotEngine(SlotEngine):
 
     def _preempt(self, slot: int, st) -> None:
         """Exact-restore preemption: free the slot's private pages and
-        requeue the request at the FRONT of the deferred queue with its
-        host-resolved tokens carried. Re-prefill context =
+        requeue the request into the deferred queue IN SUBMIT ORDER with
+        its host-resolved tokens carried. Re-prefill context =
         prompt + carry, so a greedy continuation is token-identical and
         a sampled one re-draws from the engine stream; the client's
         handle (and anything it already streamed) is untouched.
         Outstanding chunks still carrying this slot are skipped by the
-        processing loop's identity check, exactly like completions."""
-        with self._lock:
-            self._table[slot] = None
-        self._free.extend(self._slot_pages.pop(slot, []))
-        self._ptable[slot, :] = 0
-        ent = self._slot_prefix.pop(slot, None)
-        if ent is not None:
-            ent.refs -= 1
-        orig = self._slot_prompt.pop(slot)
+        processing loop's identity check, exactly like completions.
+
+        Insertion is ordered by ``submitted_at`` (bisect), not pushed to
+        index 0: the deferred queue's documented contract is FCFS drain,
+        and front-insertion inverted it — two preemptions in one pressure
+        round landed newest-first, letting a junior restore leapfrog a
+        senior and starve it under sustained pressure."""
+        orig = self._release_slot(slot)
         carry = list(st.tokens)
+        key = st.handle.submitted_at or 0.0
+        idx = bisect.bisect_left(
+            [r[6].submitted_at or 0.0 for r in self._deferred], key)
         self._deferred.insert(
-            0, (orig + carry, st.max_new, st.temperature, st.eos_id,
-                st.top_k, st.top_p, st.handle, carry))
+            idx, (orig + carry, st.max_new, st.temperature, st.eos_id,
+                  st.top_k, st.top_p, st.handle, carry))
         self.stats["preemptions"] += 1
-        self.stats["pages_free"] = len(self._free)
 
     # ---- compiled programs --------------------------------------------------
 
@@ -967,9 +1046,21 @@ class PagedSlotEngine(SlotEngine):
         ok: list[tuple[Any, Any, int, list[int]]] = []
         blocked = False
         chunked_admitted = False
+        pinned = self._pinned_pages()
+        capacity = self._usable_pages - pinned
         for idx, req in enumerate(batch):
             prompt, max_new = req[0], req[1]
             plan = self._px_plan(prompt)
+            # can-never-fit re-check: a prefix registered AFTER this
+            # request passed submit-time validate may have pinned its
+            # headroom away; admitting it anyway would self-preempt
+            # livelock in grow mode (and wedge the strict-FCFS queue in
+            # full mode). Fail the handle loudly instead.
+            need = self._worst_case_need(list(prompt),
+                                         max_new - len(req[7]), plan=plan)
+            if need > capacity:
+                req[6]._fail(self._pin_err(need, capacity, pinned))
+                continue
             if plan is not None and self.prefill_chunk and (
                     len(prompt) - plan[0].shared_len
                     > self.prefill_chunk):

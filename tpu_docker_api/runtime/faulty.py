@@ -6,11 +6,19 @@ schedule, so every failure a test provokes is reproducible. A
 :class:`FaultPlan` is a list of rules; each rule targets one runtime op and
 fires on chosen call numbers with one of three modes:
 
-- ``fail``:      raise before the op runs (connection refused / engine down);
-- ``ambiguous``: run the op, THEN raise — the classic distributed-systems
+- ``fail``:        raise before the op runs (one flaky call);
+- ``ambiguous``:   run the op, THEN raise — the classic distributed-systems
   failure where the effect landed but the caller sees an error (timeout
   after the engine committed);
-- ``latency``:   sleep, then run the op normally (slow engine).
+- ``latency``:     sleep, then run the op normally (slow engine);
+- ``unreachable``: raise :class:`~tpu_docker_api.errors.HostUnreachable`
+  before the op runs (the connection-class failure host circuit breakers
+  classify — a dockerd hang / NIC death as one scripted call).
+
+For a host that goes down *as a whole* (every op failing until an operator
+or a reboot brings it back), :meth:`FaultyRuntime.set_unreachable` flips a
+persistent flag — the host-failure chaos tier's blip/dead switch — instead
+of scripting every op.
 
 Probabilistic rules draw from ``random.Random(seed)`` so a plan replays
 identically; scripted rules (``on_calls``) need no randomness at all.
@@ -48,7 +56,7 @@ class FaultRule:
     ``on_calls``  — 1-based call numbers of that op which fire the rule
                     (e.g. {2} = the second stop). Empty ⇒ every call is a
                     candidate, gated by ``probability``.
-    ``mode``      — "fail" | "ambiguous" | "latency".
+    ``mode``      — "fail" | "ambiguous" | "latency" | "unreachable".
     ``latency_s`` — sleep for latency mode.
     ``times``     — total firings before the rule burns out (-1 = forever).
     ``probability`` — chance a candidate call fires (seeded; 1.0 = always).
@@ -64,7 +72,7 @@ class FaultRule:
         f"injected fault on {op}")
 
     def __post_init__(self) -> None:
-        if self.mode not in ("fail", "ambiguous", "latency"):
+        if self.mode not in ("fail", "ambiguous", "latency", "unreachable"):
             raise ValueError(f"unknown fault mode {self.mode!r}")
         self.on_calls = frozenset(self.on_calls)
 
@@ -111,8 +119,22 @@ class FaultyRuntime(ContainerRuntime):
         self.plan = plan or FaultPlan()
         self.calls: list[tuple[str, str, str]] = []
         self._counts: dict[str, int] = {}
+        #: host-down switch (set_unreachable): every op fails with
+        #: HostUnreachable while set — dockerd hang / host reboot / NIC
+        #: death, as opposed to a per-call rule
+        self._unreachable = False
+
+    def set_unreachable(self, down: bool = True) -> None:
+        """Make the whole engine unreachable (or reachable again). Models a
+        host-level fault: every op — including the host monitor's probes —
+        raises ``HostUnreachable`` until the flag is cleared."""
+        self._unreachable = down
 
     def _invoke(self, op: str, target: str, fn: Callable):
+        if self._unreachable:
+            self.calls.append((op, target, "unreachable"))
+            raise errors.HostUnreachable(
+                f"engine unreachable: connection refused on {op}")
         self._counts[op] = self._counts.get(op, 0) + 1
         rule = self.plan.decide(op, self._counts[op])
         if rule is None:
@@ -121,6 +143,10 @@ class FaultyRuntime(ContainerRuntime):
         if rule.mode == "fail":
             self.calls.append((op, target, "fail"))
             raise rule.error(op)
+        if rule.mode == "unreachable":
+            self.calls.append((op, target, "unreachable"))
+            raise errors.HostUnreachable(
+                f"engine unreachable: connection refused on {op}")
         if rule.mode == "latency":
             self.calls.append((op, target, "latency"))
             time.sleep(rule.latency_s)

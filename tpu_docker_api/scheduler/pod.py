@@ -175,10 +175,12 @@ class PodScheduler:
     level slice registry for introspection/restore)."""
 
     def __init__(self, pod: Pod, kv: KV,
-                 store_key: str = keys.SCHEDULER_SLICES_KEY) -> None:
+                 store_key: str = keys.SCHEDULER_SLICES_KEY,
+                 cordon_key: str = keys.HOSTS_CORDONED_KEY) -> None:
         self.pod = pod
         self._kv = kv
         self._key = store_key
+        self._cordon_key = cordon_key
         self._mu = threading.Lock()
         self._grants: dict[str, SliceAllocation] = {}
         raw = kv.get_or(store_key)
@@ -186,6 +188,16 @@ class PodScheduler:
             self._grants = {
                 o: SliceAllocation.from_dict(d) for o, d in json.loads(raw).items()
             }
+        #: operator cordons — persisted in KV (an operator decision must
+        #: survive a daemon restart; uncordon is the only way out). Cordon
+        #: of a host no longer in the pod config is kept (harmless) so a
+        #: host can be cordoned, removed, re-added without losing the mark
+        raw = kv.get_or(cordon_key)
+        self._cordoned: set[str] = set(json.loads(raw)) if raw else set()
+        #: hosts the monitor confirmed down — in-memory on purpose: a
+        #: fresh daemon re-observes reachability rather than trusting a
+        #:  possibly-stale verdict from before its own death
+        self._down: set[str] = set()
 
     # -- persistence -------------------------------------------------------------
 
@@ -194,25 +206,103 @@ class PodScheduler:
             {o: g.to_dict() for o, g in sorted(self._grants.items())}
         ))
 
+    # -- host schedulability (cordon / down) --------------------------------------
+
+    def cordon_host(self, host_id: str) -> dict:
+        """Persisted operator cordon: no NEW placements land on the host;
+        existing grants are untouched (drain is the eviction story)."""
+        if host_id not in self.pod.hosts:
+            raise errors.ContainerNotExist(f"host {host_id} is not in the pod")
+        with self._mu:
+            self._cordoned.add(host_id)
+            self._kv.put(self._cordon_key, json.dumps(sorted(self._cordoned)))
+        return self.host_view(host_id)
+
+    def uncordon_host(self, host_id: str) -> dict:
+        if host_id not in self.pod.hosts:
+            raise errors.ContainerNotExist(f"host {host_id} is not in the pod")
+        with self._mu:
+            self._cordoned.discard(host_id)
+            self._kv.put(self._cordon_key, json.dumps(sorted(self._cordoned)))
+        return self.host_view(host_id)
+
+    def set_host_down(self, host_id: str, down: bool) -> None:
+        """Health-driven schedulability (HostMonitor): a confirmed-down
+        host takes no placements until a probe proves it back."""
+        with self._mu:
+            if down:
+                self._down.add(host_id)
+            else:
+                self._down.discard(host_id)
+
+    def cordoned_hosts(self) -> set[str]:
+        with self._mu:
+            return set(self._cordoned)
+
+    def down_hosts(self) -> set[str]:
+        with self._mu:
+            return set(self._down)
+
+    def host_schedulable(self, host_id: str) -> bool:
+        with self._mu:
+            return (host_id in self.pod.hosts
+                    and host_id not in self._cordoned
+                    and host_id not in self._down)
+
+    def _unschedulable_locked(self, exclude: set[str] | None) -> set[str]:
+        out = self._cordoned | self._down
+        if exclude:
+            out |= set(exclude)
+        return out
+
+    def host_view(self, host_id: str) -> dict:
+        h = self.pod.hosts[host_id]
+        with self._mu:
+            cordoned = host_id in self._cordoned
+            down = host_id in self._down
+        return {
+            "hostId": host_id,
+            "address": h.address,
+            "gridCoord": list(h.grid_coord),
+            "totalChips": h.topology.n_chips,
+            "freeChips": len(h.chips.free_chips),
+            "cordoned": cordoned,
+            "down": down,
+            "schedulable": not cordoned and not down,
+        }
+
     # -- queries -----------------------------------------------------------------
 
     def status(self) -> dict:
-        """Resource view for GET /resources/slices."""
+        """Resource view for GET /resources/slices. Capacity aggregates
+        (``freeHosts``, ``schedulableChips``, ``freeSchedulableChips``)
+        exclude cordoned and down hosts — an operator sizing a job must
+        see the capacity the scheduler will actually place on."""
         with self._mu:
             grants = {o: g.to_dict() for o, g in self._grants.items()}
+            unschedulable = self._cordoned | self._down
+            cordoned, down = set(self._cordoned), set(self._down)
         hosts = []
         free_hosts = 0
+        sched_chips = free_sched_chips = 0
         for hid in sorted(self.pod.hosts):
             h = self.pod.hosts[hid]
             free = len(h.chips.free_chips)
-            if free == h.topology.n_chips:
-                free_hosts += 1
+            schedulable = hid not in unschedulable
+            if schedulable:
+                sched_chips += h.topology.n_chips
+                free_sched_chips += free
+                if free == h.topology.n_chips:
+                    free_hosts += 1
             hosts.append({
                 "hostId": hid,
                 "address": h.address,
                 "gridCoord": list(h.grid_coord),
                 "totalChips": h.topology.n_chips,
                 "freeChips": free,
+                "cordoned": hid in cordoned,
+                "down": hid in down,
+                "schedulable": schedulable,
             })
         return {
             "generation": self.pod.generation.name,
@@ -221,6 +311,10 @@ class PodScheduler:
             "totalChips": self.pod.n_chips,
             "chipsPerHost": self.pod.chips_per_host,
             "freeHosts": free_hosts,
+            "schedulableChips": sched_chips,
+            "freeSchedulableChips": free_sched_chips,
+            "cordonedHosts": sorted(cordoned),
+            "downHosts": sorted(down),
             "hosts": hosts,
             "slices": grants,
         }
@@ -232,10 +326,16 @@ class PodScheduler:
     # -- allocation --------------------------------------------------------------
 
     def apply_slice(self, n_chips: int = 0, accelerator_type: str = "",
-                    owner: str = "") -> SliceAllocation:
+                    owner: str = "",
+                    exclude_hosts: set[str] | None = None) -> SliceAllocation:
         """Allocate ``n_chips`` (or the chip count implied by an accelerator
         type like "v5p-64"). Sub-host counts delegate to one host's chip
         scheduler; whole-host multiples allocate an ICI-contiguous host block.
+
+        Cordoned and confirmed-down hosts never receive placements;
+        ``exclude_hosts`` additionally bans specific hosts for this one
+        grant (gang migration: the new placement must avoid the dead host
+        even before the monitor has marked it).
         """
         if accelerator_type:
             gen, n_chips = parse_accelerator_type(accelerator_type)
@@ -249,10 +349,11 @@ class PodScheduler:
             raise errors.BadRequest("slice allocation requires an owner")
         per_host = self.pod.chips_per_host
         with self._mu:
+            banned = self._unschedulable_locked(exclude_hosts)
             if owner in self._grants:
                 raise errors.ContainerExisted(f"slice owner {owner} already holds a grant")
             if n_chips < per_host or len(self.pod.hosts) == 1:
-                grant = self._apply_sub_host_locked(n_chips, owner)
+                grant = self._apply_sub_host_locked(n_chips, owner, banned)
             else:
                 # deterministic infeasibilities are BadRequest, not
                 # ChipNotEnough: callers treat ChipNotEnough as a capacity
@@ -262,16 +363,18 @@ class PodScheduler:
                         f"multi-host slices are host-granular: {n_chips} chips "
                         f"is not a multiple of {per_host} chips/host"
                     )
-                grant = self._apply_hosts_locked(n_chips // per_host, owner)
+                grant = self._apply_hosts_locked(n_chips // per_host, owner,
+                                                 banned)
             self._grants[owner] = grant
             self._persist_locked()
             return grant
 
-    def _apply_sub_host_locked(self, n: int, owner: str) -> SliceAllocation:
+    def _apply_sub_host_locked(self, n: int, owner: str,
+                               banned: set[str]) -> SliceAllocation:
         """Tightest-fit host first (least free chips that still satisfy), then
         host id for determinism."""
         ranked = sorted(
-            self.pod.hosts.values(),
+            (h for h in self.pod.hosts.values() if h.host_id not in banned),
             key=lambda h: (len(h.chips.free_chips), h.host_id),
         )
         for host in ranked:
@@ -283,13 +386,16 @@ class PodScheduler:
                 continue
             return SliceAllocation(owner, [(host.host_id, chips)], (1, 1, 1),
                                    contiguous)
-        total_free = sum(len(h.chips.free_chips) for h in self.pod.hosts.values())
+        total_free = sum(len(h.chips.free_chips) for h in ranked)
         raise errors.ChipNotEnough(
-            f"want {n} chips on one host, no host can satisfy "
-            f"(pod free={total_free}/{self.pod.n_chips})"
+            f"want {n} chips on one host, no schedulable host can satisfy "
+            f"(schedulable free={total_free}/{self.pod.n_chips}"
+            + (f"; {len(banned)} host(s) cordoned/down/excluded"
+               if banned else "") + ")"
         )
 
-    def _apply_hosts_locked(self, n_hosts: int, owner: str) -> SliceAllocation:
+    def _apply_hosts_locked(self, n_hosts: int, owner: str,
+                            banned: set[str]) -> SliceAllocation:
         # deterministic infeasibility (no axis-aligned tiling exists) is
         # BadRequest, not ChipNotEnough: callers treat ChipNotEnough as a
         # capacity problem that freeing other slices could solve
@@ -302,10 +408,13 @@ class PodScheduler:
         free_coords = {
             h.grid_coord for h in self.pod.hosts.values()
             if len(h.chips.free_chips) == h.topology.n_chips
+            and h.host_id not in banned
         }
         if n_hosts > len(free_coords):
             raise errors.ChipNotEnough(
-                f"want {n_hosts} whole hosts, only {len(free_coords)} fully free"
+                f"want {n_hosts} whole hosts, only {len(free_coords)} fully "
+                f"free and schedulable"
+                + (f" ({len(banned)} cordoned/down/excluded)" if banned else "")
             )
         block = None
         shape: Shape = (n_hosts, 1, 1)

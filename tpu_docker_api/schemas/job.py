@@ -77,8 +77,12 @@ class JobDelete:
 #: until a member dies; the supervisor moves it through ``restarting``
 #: (whole-gang stop→start in flight) back to ``running``, or — once the
 #: restart budget is burned — to terminal ``failed`` (slices/ports freed).
-#: ``stopped`` is the user-requested quiesce (resources retained for resume).
-JOB_PHASES = ("running", "restarting", "failed", "stopped")
+#: ``migrating`` is the host-fault analog of ``restarting``: the gang is
+#: being re-placed onto healthy hosts (whole-gang stop → release slice →
+#: re-apply excluding unhealthy hosts → start), charged to its own
+#: ``job_max_migrations`` budget. ``stopped`` is the user-requested
+#: quiesce (resources retained for resume).
+JOB_PHASES = ("running", "restarting", "migrating", "failed", "stopped")
 
 
 @dataclasses.dataclass
@@ -105,6 +109,11 @@ class JobState:
     phase: str = "running"
     # whole-gang restarts consumed against the supervisor's budget
     restarts: int = 0
+    # host-fault migrations consumed against job_max_migrations — a
+    # SEPARATE budget on purpose: a dead host must not eat the
+    # crash-restart budget (no restart can fix it), and a crash-looping
+    # workload must not eat the migration budget
+    migrations: int = 0
     # why the job went terminal (phase == "failed"), surfaced in the API
     failure_reason: str = ""
 
@@ -128,5 +137,6 @@ class JobState:
             megascale_port=int(d.get("megascale_port", 0)),
             phase=d.get("phase", "running"),
             restarts=int(d.get("restarts", 0)),
+            migrations=int(d.get("migrations", 0)),
             failure_reason=d.get("failure_reason", ""),
         )

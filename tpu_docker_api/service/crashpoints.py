@@ -47,6 +47,18 @@ JOB_CRASH_POINTS = (
     "job.gang.after_mark_restarting",
     # restart_gang: every member stopped, none started again
     "job.gang.after_stop_all",
+    # migrate_gang: phase=migrating persisted, nothing else touched
+    "job.migrate.after_mark",
+    # migrate_gang fast path: new gang created (not started) on healthy
+    # hosts, old gang still holds its slice
+    "job.migrate.after_create_new",
+    # migrate_gang release-first path: old gang stopped and its slices and
+    # ports freed, new version not yet allocated
+    "job.migrate.after_release",
+    # migrate_gang: old gang quiesced and marked stopped, new not started
+    "job.migrate.after_quiesce_old",
+    # migrate_gang: new gang started, old slice/ports not yet freed
+    "job.migrate.after_start_new",
 )
 
 KNOWN_CRASH_POINTS = CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS

@@ -174,6 +174,15 @@ def check_job_invariants(
                 member_running[cname] = False
                 if live:
                     problems.append(f"job {base}: member {cname} missing")
+            except errors.HOST_PATH_ERRORS:
+                # state unknown, not provably dead — but a live gang with a
+                # member behind a dead engine is not converged either: it
+                # awaits migration (host down) or recovery (blip)
+                member_running[cname] = False
+                if live:
+                    problems.append(
+                        f"job {base}: member {cname} on unreachable "
+                        f"host {host_id}")
 
         if live and st.phase == "running":
             dead = sorted(c for c, r in member_running.items() if not r)
@@ -204,7 +213,10 @@ def check_job_invariants(
                         problems.append(
                             f"job {base}: retired version member {cname} "
                             f"is running alongside latest v{latest}")
-                except errors.ContainerNotExist:
+                except (errors.ContainerNotExist, *errors.HOST_PATH_ERRORS):
+                    # unreachable: unverifiable — a retired member stranded
+                    # behind a dead engine is quiesced when the host
+                    # returns, never a live-gang violation from here
                     pass
 
         # resource accounting: failed owns nothing; live owns exactly the

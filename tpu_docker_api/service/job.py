@@ -22,8 +22,11 @@ immutable-versioned rolling-replacement semantics:
 - ``restart_gang`` / ``fail_job`` — gang recovery (service/job_supervisor.py):
   whole-gang stop (coordinator last) → start (coordinator first), and the
   terminal ``failed`` transition that frees every slice and port. ``JobState``
-  carries the lifecycle ``phase`` (running/restarting/failed/stopped) and the
-  persisted restart budget.
+  carries the lifecycle ``phase`` (running/restarting/migrating/failed/
+  stopped) and the persisted restart + migration budgets.
+- ``migrate_gang`` — host-fault recovery (docs/robustness.md "Host failure
+  domains"): re-place the whole gang EXCLUDING unhealthy hosts, charged to
+  ``job_max_migrations`` instead of the crash-restart budget.
 
 Checkpoint continuity across rescales rides a shared bind (e.g. NFS, the
 cross-container channel the reference also leans on, README.md:41): every
@@ -126,7 +129,8 @@ class JobService:
         return vname if num_slices == 1 else f"{vname}#s{k}"
 
     def _apply_slices(self, n_chips: int, num_slices: int,
-                      accelerator_type: str, vname: str
+                      accelerator_type: str, vname: str,
+                      exclude_hosts: set[str] | None = None,
                       ) -> list[SliceAllocation]:
         """One ICI-slice grant per slice, all-or-nothing."""
         if num_slices > 1 and accelerator_type:
@@ -146,6 +150,7 @@ class JobService:
                     n_chips=n_chips // num_slices,
                     accelerator_type=accelerator_type,
                     owner=self._slice_owner(vname, k, num_slices),
+                    exclude_hosts=exclude_hosts,
                 ))
         except Exception:
             for k in range(len(grants)):
@@ -261,16 +266,21 @@ class JobService:
     def _run_version(self, base: str, image: str, cmd: list[str], env: list[str],
                      binds: list[str], n_chips: int,
                      accelerator_type: str = "", start_now: bool = True,
-                     num_slices: int = 1) -> JobState:
+                     num_slices: int = 1,
+                     exclude_hosts: set[str] | None = None,
+                     carry: dict | None = None) -> JobState:
         """Slice alloc → version bump → ports → render → create[+start] →
-        persist, with full rollback (the job-level _run_new_version)."""
+        persist, with full rollback (the job-level _run_new_version).
+        ``carry`` merges extra JobState fields into the persisted record
+        (migration carries the budget counters onto the new version)."""
         prev = self.versions.get(base)
         version = self.versions.next_version(base)
         job_versioned = versioned_name(base, version)
         crash_point("job.run.after_version_bump")
         try:
             grants = self._apply_slices(
-                n_chips, num_slices, accelerator_type, job_versioned)
+                n_chips, num_slices, accelerator_type, job_versioned,
+                exclude_hosts=exclude_hosts)
             try:
                 placements, coordinator_port, megascale_port, claimed = (
                     self._build_placements(grants, job_versioned))
@@ -304,6 +314,8 @@ class JobService:
             num_slices=num_slices,
             megascale_port=megascale_port,
         )
+        if carry:
+            st = JobState.from_dict({**st.to_dict(), **carry})
         self.store.put_job(st)
         return st
 
@@ -469,7 +481,7 @@ class JobService:
             self._stop_members(st, reverse=True)
             st = JobState.from_dict({**st.to_dict(), "desired_running": True,
                                      "phase": "running", "restarts": 0,
-                                     "failure_reason": ""})
+                                     "migrations": 0, "failure_reason": ""})
             # store record first: if a member start fails below, the family
             # still wants to run and the supervisor/reconciler finish the gang
             self.store.put_job(st)
@@ -493,6 +505,12 @@ class JobService:
             if st.phase == "failed":
                 raise errors.BadRequest(
                     f"job {base} is failed: {st.failure_reason}")
+            if st.phase == "migrating":
+                # a migration is in flight (or awaiting adoption): crash
+                # recovery must finish THAT, not restart onto a placement
+                # that still names the dead host
+                raise errors.BadRequest(
+                    f"job {base} is migrating off unhealthy hosts")
             if not st.desired_running:
                 # callers decide to recover on a pre-lock snapshot; a user
                 # stop that raced in wins — crash recovery must not revive
@@ -533,8 +551,127 @@ class JobService:
                      st.restarts, reason or "requested")
             return st
 
+    def migrate_gang(self, name: str, exclude_hosts: set[str],
+                     reason: str = "", count_migration: bool = True,
+                     release_first_ok: bool = True) -> JobState:
+        """Move a whole gang off unhealthy (or draining) hosts: quiesce
+        survivors gang-ordered, release the slice, re-apply EXCLUDING
+        ``exclude_hosts``, and start the gang on the new placement — the
+        repair for faults no restart can fix (a gang restart would re-place
+        members onto the same dead host via the still-held grant). Charged
+        to the separate ``job_max_migrations`` budget (``count_migration``;
+        the supervisor enforces the cap) so host faults never consume the
+        crash-restart budget.
+
+        Sequencing mirrors ``patch_job_chips``: the fast path allocates the
+        new slice and CREATES its containers while the old gang still holds
+        its grant, so a capacity failure leaves the old gang untouched;
+        only when the pool cannot hold both does it release first
+        (``release_first_ok`` — sound for a host-down migration, where the
+        old placement is already broken, but forbidden for a drain of a
+        LIVE host, which must fail loudly and free nothing).
+
+        For fault migrations (``release_first_ok=True``) ``phase =
+        "migrating"`` is persisted FIRST, so a daemon death anywhere in
+        the flow is adoptable: the reconciler re-runs the migration
+        (``count_migration=False``) against the hosts it observes
+        unreachable at adoption time. An operator DRAIN deliberately
+        persists no such intent: adoption always finishes release-first,
+        which would let a daemon death mid-drain stop a healthy gang and
+        free its slice — the exact outcome drain promises never to
+        produce. An interrupted drain converges structurally (the same
+        version-shape repairs an interrupted rescale uses) and the
+        operator simply re-drains.
+        """
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            old = self.store.get_job(latest_name)
+            if old.phase == "failed":
+                raise errors.BadRequest(
+                    f"job {base} is failed: {old.failure_reason}")
+            if not old.desired_running:
+                raise errors.BadRequest(f"job {base} is stopped")
+            finishing = old.phase == "migrating"
+            if finishing and not release_first_ok:
+                raise errors.BadRequest(
+                    f"job {base} already has a fault migration in flight")
+            on_excluded = sorted(
+                c for h, c, *_ in old.placements if h in exclude_hosts)
+            if not on_excluded and not finishing:
+                # stale snapshot: nothing placed on an excluded host (an
+                # earlier migration or rescale already moved the gang)
+                raise errors.NoPatchRequired(
+                    f"job {base} has no member on {sorted(exclude_hosts)}")
+            if release_first_ok:
+                old = JobState.from_dict({
+                    **old.to_dict(), "phase": "migrating",
+                    "migrations": old.migrations
+                    + (1 if count_migration else 0),
+                })
+                self.store.put_job(old)
+            crash_point("job.migrate.after_mark")
+            carry = {"restarts": old.restarts, "migrations": old.migrations}
+            released = False
+            try:
+                # fast path: new slice + created-not-started containers
+                # while the old grant still stands — capacity failure here
+                # touches nothing
+                st = self._run_version(
+                    base, old.image, old.cmd, old.env, old.binds,
+                    old.chip_count, start_now=False,
+                    num_slices=old.num_slices,
+                    exclude_hosts=exclude_hosts, carry=carry)
+                crash_point("job.migrate.after_create_new")
+            except errors.ChipNotEnough:
+                if not release_first_ok:
+                    # drain of a live host: fail LOUDLY, free nothing —
+                    # the gang keeps running where it is (no phase was
+                    # ever persisted, so there is nothing to restore)
+                    self._emit("gang-migrate-failed", old.job_name,
+                               reason=reason, error="no healthy capacity")
+                    raise
+                # host-down path: the old placement is already broken —
+                # releasing it cannot lose anything that isn't lost, and
+                # the freed survivors' chips are the capacity the new
+                # placement needs. Quiesce is gang-ordered (workers first,
+                # coordinator last); stops on unreachable hosts are
+                # best-effort — the members there are beyond reach
+                self._stop_members(old, reverse=True)
+                self._restore_slices(old.job_name, old.num_slices)
+                self._free_state_ports(old)
+                released = True
+                crash_point("job.migrate.after_release")
+                st = self._run_version(
+                    base, old.image, old.cmd, old.env, old.binds,
+                    old.chip_count, start_now=False,
+                    num_slices=old.num_slices,
+                    exclude_hosts=exclude_hosts, carry=carry)
+            if not released:
+                # fast path: the old gang still runs — quiesce it now
+                # (same gang ordering / best-effort rules as above)
+                self._stop_members(old, reverse=True)
+            # record the retirement so supervisors and invariants read the
+            # old version as settled
+            self.store.put_job(JobState.from_dict(
+                {**old.to_dict(), "desired_running": False,
+                 "phase": "stopped"}))
+            crash_point("job.migrate.after_quiesce_old")
+            self._start_members(st)
+            crash_point("job.migrate.after_start_new")
+            if not released:
+                self._restore_slices(old.job_name, old.num_slices)
+                self._free_state_ports(old)
+            self._emit("gang-migrated", st.job_name, reason=reason,
+                       from_hosts=sorted(exclude_hosts),
+                       migration=st.migrations)
+            log.info("migrated job %s off %s → %s (migration %d): %s",
+                     base, sorted(exclude_hosts), st.job_name,
+                     st.migrations, reason or "requested")
+            return st
+
     def fail_job(self, name: str, reason: str,
-                 only_if_restarts_ge: int | None = None) -> JobState:
+                 only_if_restarts_ge: int | None = None,
+                 only_if_migrations_ge: int | None = None) -> JobState:
         """Terminal transition: the gang crash-looped through its restart
         budget (or lost a member container entirely). Stops any survivors and
         frees every slice and port the family holds — a ``failed`` job owns
@@ -551,6 +688,9 @@ class JobService:
             st = self.store.get_job(latest_name)
             if (only_if_restarts_ge is not None
                     and st.restarts < only_if_restarts_ge):
+                return st
+            if (only_if_migrations_ge is not None
+                    and st.migrations < only_if_migrations_ge):
                 return st
             if not st.desired_running or st.phase == "failed":
                 # a user stop / delete(keep-spec) that raced in wins: the
@@ -598,7 +738,10 @@ class JobService:
 
     def _any_member_down(self, st: JobState) -> bool:
         """True when any member is dead, missing, or on a missing host —
-        i.e. the gang genuinely needs recovery."""
+        i.e. the gang genuinely needs recovery. An unreachable host counts
+        as down (conservative: a member whose state cannot be read cannot
+        be proven healthy, and the stale-snapshot protection this check
+        exists for only matters when every member is PROVABLY running)."""
         for host_id, cname, *_ in st.placements:
             host = self.pod.hosts.get(host_id)
             if host is None:
@@ -606,7 +749,7 @@ class JobService:
             try:
                 if not host.runtime.container_inspect(cname).running:
                     return True
-            except errors.ContainerNotExist:
+            except (errors.ContainerNotExist, *errors.HOST_PATH_ERRORS):
                 return True
         return False
 
@@ -640,6 +783,11 @@ class JobService:
                         host.runtime.container_remove(cname, force=req.force)
                     except errors.ContainerNotExist:
                         pass
+                    except errors.HOST_PATH_ERRORS as e:
+                        # the member is beyond a dead engine; removing the
+                        # KV record must still work (the container is lost
+                        # either way — logged for the post-reboot janitor)
+                        log.warning("remove of %s skipped: %s", cname, e)
                 self._restore_slices(vname, st.num_slices)
                 self._free_state_ports(st)
             if req.del_state_and_version_record:
@@ -693,7 +841,7 @@ class JobService:
                 continue
             try:
                 host.runtime.container_remove(cname, force=True)
-            except errors.ContainerNotExist:
+            except (errors.ContainerNotExist, *errors.HOST_PATH_ERRORS):
                 pass
         self._restore_slices(st.job_name, st.num_slices)
         self._free_state_ports(st)
@@ -703,7 +851,9 @@ class JobService:
     def _stop_members(self, st: JobState, reverse: bool = False) -> None:
         """``reverse=True`` is gang ordering: stop workers first, the
         coordinator (process 0) last, so peers never lose their rendezvous
-        point while still draining."""
+        point while still draining. Stops are best-effort on unreachable
+        hosts — a member beyond a dead engine cannot be drained, and every
+        caller (quiesce, fail, migrate) must still make progress."""
         placements = list(reversed(st.placements)) if reverse else st.placements
         for host_id, cname, *_ in placements:
             host = self.pod.hosts.get(host_id)
@@ -713,6 +863,8 @@ class JobService:
                 host.runtime.container_stop(cname)
             except errors.ContainerNotExist:
                 pass
+            except errors.HOST_PATH_ERRORS as e:
+                log.warning("stop of %s skipped: %s", cname, e)
 
     def _free_state_ports(self, st: JobState) -> None:
         for host_id, _, pid, _, tpu_port in st.placements:
@@ -754,6 +906,8 @@ class JobService:
             out["failureReason"] = st.failure_reason
         if st.megascale_port:
             out["megascalePort"] = st.megascale_port
+        if st.migrations:
+            out["migrations"] = st.migrations
         if live:
             for proc in out["processes"]:
                 host = self.pod.hosts.get(proc["hostId"])
@@ -765,4 +919,10 @@ class JobService:
                         proc["container"]).running
                 except errors.ContainerNotExist:
                     proc["running"] = False
+                except errors.HOST_PATH_ERRORS:
+                    # unknown, not dead: the PATH failed, the member may
+                    # well be running — surfaced distinctly so operators
+                    # don't misread a network fault as a crash
+                    proc["running"] = None
+                    proc["hostUnreachable"] = True
         return out

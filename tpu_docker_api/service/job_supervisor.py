@@ -24,6 +24,17 @@ Restart *counts* live on the persisted ``JobState`` so the budget survives a
 daemon death; backoff *deadlines* are in-memory (monotonic clock) and reset
 on restart — a fresh daemon retries once immediately, which is the safe
 direction after an operator intervention.
+
+Host failure domains (docs/robustness.md "Host failure domains") add the
+disambiguation layer a restart-only supervisor lacks: a member whose host
+engine is UNREACHABLE is neither dead nor missing — its state is unknown.
+The supervisor consults the :class:`~tpu_docker_api.service.host_health.
+HostMonitor`: while the host is merely *suspect* (inside the grace window)
+the gang is left completely alone — a sub-grace blip causes ZERO restarts;
+once the host is confirmed *down*, the gang MIGRATES onto healthy hosts
+(``JobService.migrate_gang``), charged to the separate
+``job_max_migrations`` budget — a dead host must never eat the
+crash-restart budget, because no restart can fix it.
 """
 
 from __future__ import annotations
@@ -58,6 +69,7 @@ class JobSupervisor:
         versions,
         interval_s: float = 5.0,
         max_restarts: int = 3,
+        max_migrations: int = 3,
         backoff_base_s: float = 1.0,
         backoff_max_s: float = 60.0,
         backoff_jitter: float = 0.1,
@@ -65,6 +77,7 @@ class JobSupervisor:
         clock=time.monotonic,
         registry: MetricsRegistry | None = None,
         max_events: int = 512,
+        host_monitor=None,
     ) -> None:
         self.pod = pod
         self._svc = job_svc
@@ -72,6 +85,10 @@ class JobSupervisor:
         self._versions = versions
         self._interval = interval_s
         self._max_restarts = max_restarts
+        self._max_migrations = max_migrations
+        #: HostMonitor (service/host_health.py) when host probing runs —
+        #: the down/suspect verdicts that gate migration vs hands-off
+        self.host_monitor = host_monitor
         self._backoff_base_s = backoff_base_s
         self._backoff_max_s = backoff_max_s
         self._backoff_jitter = backoff_jitter
@@ -86,6 +103,13 @@ class JobSupervisor:
         #: mid-restart" (adoption: finish without re-counting) from "our own
         #: last attempt failed" (the next attempt must consume budget)
         self._attempted: set[str] = set()
+        #: same adoption bookkeeping for migrations (phase == "migrating")
+        self._mig_attempted: set[str] = set()
+        #: families currently observed behind an unreachable-but-not-down
+        #: host — the host-blip event is recorded on ENTRY only, not every
+        #: poll tick (a persistent blip must not evict the whole bounded
+        #: event ring)
+        self._blipped: set[str] = set()
         #: base → last poll's {deadMembers, missingMembers} — status_view
         #: serves this instead of re-inspecting every member per request
         self._last_obs: dict[str, dict] = {}
@@ -156,6 +180,11 @@ class JobSupervisor:
         self._wake.set()
         return True
 
+    def wake(self, *_args) -> None:
+        """Cut the poll interval short (the HostMonitor's on_down hook:
+        a confirmed-down host should start gang migration NOW)."""
+        self._wake.set()
+
     # -- decision logic ----------------------------------------------------------
 
     def _check_family(self, base: str) -> None:
@@ -176,8 +205,32 @@ class JobSupervisor:
         if not st.desired_running or st.phase in ("failed", "stopped"):
             self._note_obs(base, [], [])
             return
-        dead, missing, crashed = self._member_liveness(st)
-        self._note_obs(base, dead, missing)
+        dead, missing, crashed, unreachable = self._member_liveness(st)
+        self._note_obs(base, dead, missing, unreachable)
+        down = sorted(h for h in unreachable if self._host_down(h))
+        if st.phase == "migrating" or down:
+            # host-down (or an interrupted migration to adopt): the repair
+            # is migration, never a restart — a gang restart would re-place
+            # members onto the same dead host via the still-held grant.
+            # Exclude every OBSERVED-unreachable host too, not just the
+            # monitor-confirmed ones: down verdicts are in-memory and reset
+            # with the daemon, so an adoption in the fresh grace window
+            # would otherwise re-place onto the still-dead host and burn
+            # the budget on placements that cannot start (the reconciler's
+            # adoption path applies the same rule)
+            self._migrate_family(base, st, down, sorted(unreachable))
+            return
+        if unreachable:
+            # sub-grace blip (or no monitor to confirm down-ness): hands
+            # off ENTIRELY — zero restarts. Recovery would fail against the
+            # unreachable engine anyway, and the members there may be fine
+            if base not in self._blipped:
+                self._blipped.add(base)
+                self._record("host-blip", base, hosts=unreachable)
+            return
+        if base in self._blipped:
+            self._blipped.discard(base)
+            self._record("host-blip-over", base)
         if missing:
             self._record("job-member-missing", base, members=missing)
             self._try_repair(base, lambda: self._svc.fail_job(
@@ -236,19 +289,64 @@ class JobSupervisor:
             # that raced in makes restart_gang decline loudly
             self._record("gang-restart-failed", base, error=str(e))
 
+    def _migrate_family(self, base: str, st, down: list[str],
+                        unreachable: list[str]) -> None:
+        """Host-fault repair: move the gang off ``down`` (and currently
+        unreachable) hosts, bounded by the migration budget (separate from
+        crash restarts — a dead host is not the workload's fault). Both
+        lists may be empty when adopting an interrupted migration whose
+        bad host has since recovered."""
+        finishing = (st.phase == "migrating"
+                     and base not in self._mig_attempted)
+        if st.migrations >= self._max_migrations and not finishing:
+            self._record("job-migration-loop", base,
+                         migrations=st.migrations, hosts=down)
+            self._try_repair(base, lambda: self._svc.fail_job(
+                base, f"host(s) {down} down: {st.migrations} migrations "
+                "exhausted",
+                only_if_migrations_ge=self._max_migrations))
+            return
+        self._record("gang-migrating", base, hosts=down,
+                     attempt=st.migrations + (0 if finishing else 1))
+        self._mig_attempted.add(base)
+        try:
+            self._svc.migrate_gang(
+                base, exclude_hosts=set(down) | set(unreachable),
+                reason=f"host(s) down: {down}" if down
+                else "finishing interrupted migration",
+                count_migration=not finishing)
+            self._counter("gang_migrations_total")
+        except errors.ApiError as e:
+            # attempt burned (migrate_gang counts BEFORE acting); retried
+            # next poll until capacity appears or the budget converges the
+            # job to failed
+            self._record("gang-migrate-failed", base, error=str(e))
+
+    def _host_down(self, host_id: str) -> bool:
+        """Confirmed down = the monitor's verdict (grace window elapsed).
+        Without a monitor, unreachability alone NEVER condemns a host —
+        hands-off is the safe default for an unprovable fault."""
+        return (self.host_monitor is not None
+                and self.host_monitor.is_down(host_id))
+
     def _try_repair(self, base: str, fn) -> None:
         try:
             fn()
         except errors.ApiError as e:
             self._record("gang-repair-failed", base, error=str(e))
 
-    def _member_liveness(self, st) -> tuple[list[str], list[str], bool]:
-        """(dead, missing, crashed) over the latest version's members.
-        ``crashed`` is True when any dead member actually failed — nonzero
-        exit code, or created-but-never-started (an interrupted launch) —
-        as opposed to a clean exit-0 completion."""
+    def _member_liveness(
+            self, st) -> tuple[list[str], list[str], bool, list[str]]:
+        """(dead, missing, crashed, unreachable_hosts) over the latest
+        version's members. ``crashed`` is True when any dead member
+        actually failed — nonzero exit code, or created-but-never-started
+        (an interrupted launch) — as opposed to a clean exit-0 completion.
+        Members behind an unreachable engine are in NO other bucket: their
+        state is unknown, and treating them as dead or missing is exactly
+        the misclassification that burned restart budget on host faults."""
         dead: list[str] = []
         missing: list[str] = []
+        unreachable: list[str] = []
         crashed = False
         for host_id, cname, *_ in st.placements:
             host = self.pod.hosts.get(host_id)
@@ -260,17 +358,22 @@ class JobSupervisor:
             except errors.ContainerNotExist:
                 missing.append(cname)
                 continue
+            except errors.HOST_PATH_ERRORS:
+                if host_id not in unreachable:
+                    unreachable.append(host_id)
+                continue
             if not info.running:
                 dead.append(cname)
                 if info.exit_code != 0 or info.status == "created":
                     crashed = True
-        return dead, missing, crashed
+        return dead, missing, crashed, unreachable
 
-    def _note_obs(self, base: str, dead: list[str],
-                  missing: list[str]) -> None:
+    def _note_obs(self, base: str, dead: list[str], missing: list[str],
+                  unreachable: list[str] | None = None) -> None:
         with self._mu:
             self._last_obs[base] = {"deadMembers": dead,
-                                    "missingMembers": missing}
+                                    "missingMembers": missing,
+                                    "unreachableHosts": unreachable or []}
 
     def _next_delay(self, restarts: int) -> float:
         """min(cap, base·2^n), then ±jitter so a pod-wide fault does not
@@ -283,6 +386,8 @@ class JobSupervisor:
         with self._mu:
             self._deadline.pop(base, None)
         self._attempted.discard(base)
+        self._mig_attempted.discard(base)
+        self._blipped.discard(base)
 
     # -- events / views ----------------------------------------------------------
 
@@ -290,6 +395,8 @@ class JobSupervisor:
         self._registry.counter_inc(
             name, help={"gang_restarts_total":
                         "Whole-gang restarts executed by the job supervisor",
+                        "gang_migrations_total":
+                        "Whole-gang migrations off unhealthy hosts",
                         "jobs_failed_total":
                         "Jobs driven to the terminal failed phase"}[name])
 
@@ -334,13 +441,16 @@ class JobSupervisor:
             with self._mu:
                 deadline = self._deadline.get(base, 0.0)
                 obs = dict(self._last_obs.get(
-                    base, {"deadMembers": [], "missingMembers": []}))
+                    base, {"deadMembers": [], "missingMembers": [],
+                           "unreachableHosts": []}))
             out[base] = {
                 "version": latest,
                 "phase": st.phase,
                 "desiredRunning": st.desired_running,
                 "restarts": st.restarts,
                 "maxRestarts": self._max_restarts,
+                "migrations": st.migrations,
+                "maxMigrations": self._max_migrations,
                 **obs,
                 "backoffRemainingS": round(max(0.0, deadline - now), 3),
                 **({"failureReason": st.failure_reason}

@@ -79,6 +79,7 @@ class Reconciler:
         job_svc=None,
         job_versions: VersionMap | None = None,
         job_max_restarts: int = 3,
+        job_max_migrations: int = 3,
         registry: MetricsRegistry | None = None,
         max_events: int = 512,
     ) -> None:
@@ -95,6 +96,7 @@ class Reconciler:
         self._job_svc = job_svc
         self._job_versions = job_versions
         self._job_max_restarts = job_max_restarts
+        self._job_max_migrations = job_max_migrations
         #: gangs this reconciler already adopted (mirror of the supervisor's
         #: _attempted set): a first sight of phase == "restarting" is a
         #: daemon-death adoption and does not consume budget; if the family
@@ -102,6 +104,10 @@ class Reconciler:
         #: further attempts must count — else a persistently failing start
         #: would be retried forever past job_max_restarts
         self._job_adopted: set[str] = set()
+        #: same adoption bookkeeping for interrupted migrations (phase ==
+        #: "migrating"): first sight finishes without counting, repeats
+        #: count so a never-satisfiable migration converges to failed
+        self._mig_adopted: set[str] = set()
         self._registry = registry if registry is not None else REGISTRY
         self._mu = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=max_events)
@@ -427,6 +433,13 @@ class Reconciler:
           ``restarting`` (daemon died mid gang-restart), is adopted: the
           whole gang restarts through the same coordinator-first path the
           supervisor uses, without re-counting the attempt;
+        - a gang stuck in phase ``migrating`` (daemon died mid host-fault
+          migration) is adopted the same way: the migration re-runs
+          excluding whatever hosts are unreachable NOW, without
+          re-counting; once ``job_max_migrations`` is exhausted it
+          converges to terminal ``failed``. Members behind an unreachable
+          engine are otherwise LEFT ALONE (state unknown — down-vs-blip
+          is the host monitor's verdict, migration the supervisor's job);
         - members gone entirely ⇒ the job converges to terminal ``failed``
           (zero slices, zero ports);
         - stale older versions (interrupted rescale) are quiesced and their
@@ -457,6 +470,7 @@ class Reconciler:
                 return
 
             members = []  # (host, cname, info | None)
+            unreachable: list[str] = []  # host ids whose engine is down
             for host_id, cname, *_ in st.placements:
                 host = self._job_svc.pod.hosts.get(host_id)
                 info = None
@@ -465,17 +479,76 @@ class Reconciler:
                         info = host.runtime.container_inspect(cname)
                     except errors.ContainerNotExist:
                         info = None
+                    except errors.HOST_PATH_ERRORS:
+                        # the member's state is UNKNOWN, not missing — a
+                        # connectivity fault must never read as a lost
+                        # container (fail-job-missing-members would
+                        # condemn the job on a network blip)
+                        if host_id not in unreachable:
+                            unreachable.append(host_id)
+                        members.append((host, cname, "unreachable"))
+                        continue
                 members.append((host, cname, info))
+
+            if st.desired_running and st.phase == "migrating":
+                # daemon died mid-migration: finish it, excluding whatever
+                # is unreachable NOW (the original bad host, if still
+                # down; nothing, if it recovered — re-placing is safe
+                # either way). First sight does not re-count the
+                # migration; a repeat means OUR adoption failed and must
+                # count, so a never-satisfiable migration converges to
+                # failed via the budget
+                finishing = base not in self._mig_adopted
+                if (st.migrations >= self._job_max_migrations
+                        and not finishing):
+                    self._act(actions, dry_run, "fail-job-migration-loop",
+                              latest_name, migrations=st.migrations,
+                              fn=lambda: self._job_svc.fail_job(
+                                  base, f"host fault: {st.migrations} "
+                                  "migrations exhausted",
+                                  only_if_migrations_ge=(
+                                      self._job_max_migrations)))
+                    return
+                if not dry_run:
+                    self._mig_adopted.add(base)
+                self._act(actions, dry_run, "finish-migration", latest_name,
+                          excluding=sorted(unreachable),
+                          fn=lambda: self._job_svc.migrate_gang(
+                              base, exclude_hosts=set(unreachable),
+                              reason="reconcile adoption",
+                              count_migration=not finishing))
+                return
+            if unreachable and st.desired_running and st.phase not in (
+                    "failed", "stopped"):
+                # members behind a dead engine: their liveness is
+                # unknowable from here. Down-vs-blip is the monitor's
+                # verdict and migration is the supervisor's repair — the
+                # reconciler must not guess (restarting or failing a gang
+                # on a blip is the exact misclassification this layer
+                # exists to prevent). Deliberately NOT an action: waiting
+                # is not drift, and the fixpoint contract ("a clean sweep
+                # reports zero actions") must hold while a host blips
+                log.info("reconcile: job %s has members on unreachable "
+                         "host(s) %s; leaving to the host monitor/"
+                         "supervisor", latest_name, sorted(unreachable))
+                with self._mu:
+                    self._events.append({
+                        "ts": time.time(), "dryRun": dry_run,
+                        "action": "skip-unreachable-job",
+                        "target": latest_name,
+                        "hosts": sorted(unreachable)})
+                return
 
             if st.desired_running and st.phase not in ("failed", "stopped"):
                 missing = [c for _, c, i in members if i is None]
-                dead = [c for _, c, i in members if i is not None and not i.running]
+                dead = [c for _, c, i in members if i is not None
+                        and i != "unreachable" and not i.running]
                 # a dead member CRASHED if it exited nonzero or never got
                 # past "created" (interrupted launch); mid-restart gangs
                 # (phase == "restarting") are always adoptable — their
                 # members were stopped by the restart itself
                 crashed = (st.phase == "restarting" or any(
-                    i is not None and not i.running
+                    i is not None and i != "unreachable" and not i.running
                     and (i.exit_code != 0 or i.status == "created")
                     for _, _, i in members))
                 finishing = (st.phase == "restarting"
@@ -526,7 +599,9 @@ class Reconciler:
                               latest_name,
                               fn=lambda: self._job_svc.mark_gang_running(base))
             else:
-                running = [c for _, c, i in members if i is not None and i.running]
+                running = [c for _, c, i in members
+                           if i is not None and i != "unreachable"
+                           and i.running]
                 if running:
                     self._act(actions, dry_run, "stop-undesired-job-members",
                               latest_name, members=running,
@@ -553,7 +628,12 @@ class Reconciler:
                     try:
                         if host.runtime.container_inspect(cname).running:
                             stale_running.append(cname)
-                    except errors.ContainerNotExist:
+                    except (errors.ContainerNotExist,
+                            *errors.HOST_PATH_ERRORS):
+                        # unreachable: unverifiable, and unquiesceable —
+                        # but the KV-side resource frees below must still
+                        # run (a migrated-away gang's old slice is pure
+                        # control-plane state)
                         pass
                 if stale_running:
                     self._act(actions, dry_run, "retire-stale-job-version",
@@ -583,11 +663,19 @@ class Reconciler:
         svc = self._job_svc
         prefix = f"{vname}-p"
         for host in svc.pod.hosts.values():
-            for cname in list(host.runtime.container_list()):
+            try:
+                names = list(host.runtime.container_list())
+            except errors.HOST_PATH_ERRORS:
+                # can't enumerate a dead engine; the KV-side frees below
+                # still run, and any member it holds is swept when (if)
+                # the host returns
+                continue
+            for cname in names:
                 if cname.startswith(prefix) and cname[len(prefix):].isdigit():
                     try:
                         host.runtime.container_remove(cname, force=True)
-                    except errors.ContainerNotExist:
+                    except (errors.ContainerNotExist,
+                            *errors.HOST_PATH_ERRORS):
                         pass
             owned = [p for p, o in host.ports.status()["owners"].items()
                      if o == vname]

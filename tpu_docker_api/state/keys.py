@@ -90,6 +90,12 @@ VERSIONS_VOLUME_KEY = f"{PREFIX}/versions/volumes"
 VERSIONS_JOB_KEY = f"{PREFIX}/versions/jobs"
 
 
+#: operator cordon set (service/host_health.py + scheduler/pod.py): JSON
+#: list of host ids that must receive no new placements; persisted so a
+#: cordon survives daemon restarts (uncordon is the only way out)
+HOSTS_CORDONED_KEY = f"{PREFIX}/scheduler/hosts/cordoned"
+
+
 def host_chips_key(host_id: str) -> str:
     """Per-host chip-scheduler state for multi-host pods (each host's
     ChipScheduler persists independently)."""
