@@ -293,3 +293,73 @@ class TestRobustnessRoutes:
         assert out["data"] == {"requeued": 1}
         server.wq.drain()
         assert call(server, "GET", "/api/v1/debug/deadletters")["data"] == []
+
+
+class TestDurableQueueRoutes:
+    def test_queue_stats_and_durable_dead_letters(self, server):
+        server.wq._max_retries = 1
+        server.wq._backoff_base_s = 0.001
+
+        def boom(rec):
+            raise OSError("disk full")
+
+        server.wq.register("always_fail", boom)
+        server.wq.submit_record("always_fail", {"x": 1})
+        server.wq.drain()
+
+        st = call(server, "GET", "/api/v1/queue")["data"]
+        assert st["capacity"] == 110 and st["closed"] is False
+        assert st["journal"]["dead"] == 1 and st["journal"]["inflight"] == 0
+
+        dl = call(server, "GET", "/api/v1/dead-letters")["data"]
+        assert len(dl) == 1
+        assert dl[0]["kind"] == "always_fail" and dl[0]["durable"] is True
+        assert "disk full" in dl[0]["error"]
+
+        # operator fixed the fault → HTTP retry drains the DURABLE set
+        server.wq.register("always_fail", lambda rec: None)
+        assert call(server, "POST", "/api/v1/dead-letters/retry")["data"] == {
+            "requeued": 1}
+        server.wq.drain()
+        assert call(server, "GET", "/api/v1/dead-letters")["data"] == []
+        assert call(server, "GET", "/api/v1/queue")["data"]["journal"]["entries"] == 0
+
+    def test_queue_saturation_surfaces_http_429(self, server):
+        import threading
+        import urllib.error
+
+        from tpu_docker_api import errors
+        from tpu_docker_api.state.workqueue import FnTask
+
+        call(server, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "sat", "chipCount": 0,
+        })
+        gate = threading.Event()
+        server.wq.submit(FnTask(fn=gate.wait, description="wedge the loop"))
+        server.wq._submit_timeout_s = 0.05
+        try:
+            for _ in range(200):  # fill every slot behind the wedged task
+                try:
+                    server.wq.submit(FnTask(fn=lambda: None))
+                except errors.QueueSaturated:
+                    break
+            else:
+                pytest.fail("queue never saturated")
+
+            # the purge submit inside DELETE hits the full queue → a real
+            # HTTP 429 (the one deviation from the always-200 envelope)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/api/v1/containers/sat-0",
+                method="DELETE",
+                data=json.dumps({"force": True,
+                                 "delEtcdInfoAndVersionRecord": True}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 429
+            body = json.loads(ei.value.read())
+            assert body["code"] == 10801
+            assert "retry later" in body["msg"]
+        finally:
+            gate.set()  # unwedge so fixture teardown's close() drains fast

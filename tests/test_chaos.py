@@ -36,6 +36,7 @@ from tpu_docker_api.service.crashpoints import (
     CONTAINER_CRASH_POINTS,
     JOB_CRASH_POINTS,
     KNOWN_CRASH_POINTS,
+    QUEUE_CRASH_POINTS,
     SimulatedCrash,
     armed,
 )
@@ -105,8 +106,11 @@ def test_case_matrix_covers_every_crash_point():
     assert {p for _, p in CASES} == set(CONTAINER_CRASH_POINTS)
     assert ({p for _, p in JOB_CASES} | {p for p in MIGRATE_POINTS}
             | {INFEASIBLE_MIGRATE_POINT} == set(JOB_CRASH_POINTS))
+    # the durable-queue matrix drives BOTH flows (data copy + drain)
+    # through every queue lifecycle point
+    assert set(QUEUE_CRASH_POINTS) == set(QUEUE_POINTS)
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
-            == set(KNOWN_CRASH_POINTS))
+            | set(QUEUE_CRASH_POINTS) == set(KNOWN_CRASH_POINTS))
 
 
 def _mutations(runtime: FakeRuntime) -> list:
@@ -688,6 +692,192 @@ class TestHostFailureChaos:
         assert all(len(h.chips.free_chips) == 0
                    for h in prg.pod.hosts.values())
         assert _job_oracle(prg) == []
+
+
+QUEUE_POINTS = ("queue.claim", "queue.exec", "queue.ack")
+
+
+class TestDurableQueueChaos:
+    """Durable work queue (docs/robustness.md "Durable work queue"): the
+    daemon dies at every queue lifecycle boundary while a journaled record
+    is being processed — during a volume-resize data copy, a container
+    rolling-replace copy, and a host drain. A fresh ``Program`` over the
+    same KV adopts the journal through the startup reconciler and replay
+    converges: one live version, zero leaks, and the copy applied
+    effectively ONCE (marker-verified — a post-crash tamper of the source
+    proves a replay never re-copies)."""
+
+    def _volume_env(self, tmp_path):
+        from tpu_docker_api.schemas.volume import VolumeCreate, VolumeSize
+
+        kv = MemoryKV()
+        runtime = FakeRuntime(root=str(tmp_path / "rt"))
+        prg = boot(kv, runtime)
+        prg.volume_svc.create_volume(VolumeCreate(volume_name="data",
+                                                  size="1GB"))
+        src = runtime.volume_data_dir("data-0")
+        with open(f"{src}/ckpt.txt", "w") as f:
+            f.write("step=100")
+        # resize journals the copy record; the sync loop never ran, so the
+        # record is pure durable intent at this point
+        prg.volume_svc.patch_volume_size("data", VolumeSize(size="2GB"))
+        return prg, kv, runtime
+
+    @pytest.mark.parametrize("point", QUEUE_POINTS)
+    def test_volume_resize_copy_crash_converges(self, tmp_path, point):
+        prg, kv, runtime = self._volume_env(tmp_path)
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                # drive the queue's own lifecycle inline (the sync loop's
+                # code path) into the armed crash point
+                prg.wq.replay_journal(include_local=True)
+
+        copied_already = point in ("queue.exec", "queue.ack")
+        if copied_already:
+            # the side effects landed before the crash; a REPLAYED copy
+            # would re-clobber the new volume with this tampered content
+            src = runtime.volume_data_dir("data-0")
+            with open(f"{src}/ckpt.txt", "w") as f:
+                f.write("tampered-after-crash")
+
+        prg2 = boot(kv, runtime)
+        report = prg2.reconciler.reconcile()
+        if point != "queue.ack":  # ack crashed AFTER the journal was clean
+            assert "replay-task" in [a["action"] for a in report["actions"]]
+
+        # converged: the resize completed exactly once — the new volume
+        # holds the ORIGINAL data (marker-verified: no double-apply)
+        assert prg2.volume_versions.get("data") == 1
+        dst = runtime.volume_data_dir("data-1")
+        with open(f"{dst}/ckpt.txt") as f:
+            assert f.read() == "step=100"
+        # journal drained: nothing pending/in-flight/dead survives
+        stats = prg2.wq.stats()
+        assert stats["journal"]["pending"] == 0
+        assert stats["journal"]["inflight"] == 0
+        assert stats["journal"]["dead"] == 0
+        # fixpoint
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    @pytest.mark.parametrize("point", QUEUE_POINTS)
+    def test_container_replace_copy_crash_converges(self, tmp_path, point):
+        """The strictest no-double-apply case: at queue.exec the NEW
+        container is already started when the daemon dies — a replayed
+        copy would clobber live writes. The marker proves done-ness."""
+        kv = MemoryKV()
+        runtime = FakeRuntime(root=str(tmp_path / "rt"))
+        prg = boot(kv, runtime)
+        setup_family(prg, tmp_path)
+        _grow(prg.container_svc)  # journals the copy+start record
+
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                prg.wq.replay_journal(include_local=True)
+
+        if point in ("queue.exec", "queue.ack"):
+            # copy landed and train-1 is RUNNING; tamper the retired
+            # source — replay must not drag this into the live container
+            with open(f"{runtime.container_data_dir('train-0')}/ckpt.txt",
+                      "w") as f:
+                f.write("stale-overwrite")
+
+        prg2 = boot(kv, runtime)
+        prg2.reconciler.reconcile()
+
+        problems = check_invariants(
+            runtime, prg2.store, prg2.container_versions,
+            prg2.chip_scheduler, prg2.port_scheduler)
+        assert problems == [], f"{point}: {problems}"
+        latest = prg2.container_versions.get("train")
+        running = [n for n in runtime.container_list()
+                   if runtime.container_inspect(n).running]
+        assert running == [f"train-{latest}"]
+        with open(f"{runtime.container_data_dir(running[0])}/ckpt.txt") as f:
+            assert f.read() == "step=100"
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    @pytest.mark.parametrize("point", QUEUE_POINTS)
+    def test_drain_crash_converges(self, point):
+        """Daemon dies mid-drain at each queue point: the journaled
+        drain_gang record replays under the fresh daemon and the gang ends
+        on healthy hosts exactly once — a drain that already migrated is
+        recognized (NoPatchRequired → drained), never migrated twice."""
+        kv = MemoryKV()
+        inner = [FakeRuntime() for _ in range(4)]
+        rts = [inner[0]] + [FaultyRuntime(r, FaultPlan()) for r in inner[1:]]
+        prg = boot_pod4(kv, rts)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))  # gang on h0+h1
+        out = prg.host_monitor.drain("h1")
+        assert out["drainingJobs"] == ["train"]
+
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                prg.wq.replay_journal(include_local=True)
+
+        prg2 = boot_pod4(kv, rts)
+        report = prg2.reconciler.reconcile()
+        if point != "queue.ack":
+            assert "replay-task" in [a["action"] for a in report["actions"]]
+
+        problems = _job_oracle(prg2)
+        assert problems == [], f"{point}: {problems}"
+        latest = prg2.job_versions.get("train")
+        st = prg2.store.get_job(f"train-{latest}")
+        assert st.phase == "running"
+        hosts_now = sorted({h for h, *_ in st.placements})
+        assert "h1" not in hosts_now
+        for host_id, cname, *_ in st.placements:
+            assert prg2.pod.hosts[host_id].runtime.container_inspect(
+                cname).running
+        # migrated exactly once: the drain is operator-driven (budget
+        # untouched) and version bumped a single time
+        assert st.migrations == 0
+        assert latest == 1
+        # cordon persisted through the crash; journal drained; fixpoint
+        assert prg2.pod_scheduler.cordoned_hosts() == {"h1"}
+        stats = prg2.wq.stats()
+        assert stats["journal"]["pending"] == 0
+        assert stats["journal"]["inflight"] == 0
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    def test_dead_letters_survive_restart_and_retry_drains(self):
+        """A drain with no healthy spare capacity dead-letters DURABLY: a
+        fresh daemon over the same KV still serves the letter, replay does
+        NOT resurrect it, and the operator retry path re-enqueues it."""
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16))  # the whole pod
+        prg.host_monitor.drain("h1")
+        prg.wq.start()
+        prg.wq.drain()
+        prg.wq.close()
+        assert len(prg.wq.dead_letter_view()) == 1
+
+        # the daemon dies; the dead letter survives in the journal
+        prg2 = boot_pod(kv, rt0, rt1)
+        letters = prg2.wq.dead_letter_view()
+        assert len(letters) == 1
+        assert letters[0]["durable"]
+        assert "ChipNotEnough" in letters[0]["error"]
+        # reconcile replays pending/in-flight only — dead stays dead
+        prg2.reconciler.reconcile()
+        assert len(prg2.wq.dead_letter_view()) == 1
+
+        # the operator rescales the gang down — the cordon (persisted)
+        # already steers the new version off h1 — then retries the letter:
+        # the drain record now finds the host clear and settles as drained
+        prg2.job_svc.patch_job_chips("train", JobPatchChips(chip_count=8))
+        prg2.wq.start()
+        assert prg2.wq.retry_dead_letters() == 1
+        prg2.wq.drain()
+        prg2.wq.close()
+        assert prg2.wq.dead_letter_view() == []
+        latest = prg2.job_versions.get("train")
+        st = prg2.store.get_job(f"train-{latest}")
+        assert sorted({h for h, *_ in st.placements}) == ["h0"]
 
 
 class TestAmbiguousEngineFailures:

@@ -34,6 +34,18 @@ class _FakeGateway(BaseHTTPRequestHandler):
         return self.server.store
 
     def do_POST(self):
+        # connection-fault injection: abort the next N requests at the
+        # socket level (no HTTP response at all) — what a dying etcd or a
+        # mid-restart gateway looks like to the client
+        if getattr(self.server, "fail_next", 0) > 0:
+            self.server.fail_next -= 1
+            self.server.fail_seen += 1
+            self.close_connection = True
+            self.connection.close()
+            return
+        self._do_POST()
+
+    def _do_POST(self):
         length = int(self.headers.get("Content-Length") or 0)
         body = json.loads(self.rfile.read(length))
         key = base64.b64decode(body["key"])
@@ -83,6 +95,8 @@ class _FakeGateway(BaseHTTPRequestHandler):
 def gateway():
     server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGateway)
     server.store = {}
+    server.fail_next = 0
+    server.fail_seen = 0
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     try:
@@ -186,9 +200,57 @@ class TestEtcdKVContract:
 
 
 class TestDialBehavior:
-    def test_unreachable_fails_fast(self):
-        with pytest.raises(Exception):
+    def test_unreachable_fails_fast_and_typed(self):
+        with pytest.raises(errors.StoreUnavailable):
             EtcdKV("http://127.0.0.1:9")  # discard port: connection refused
+
+
+class TestStoreOutageNormalization:
+    """Store-outage tolerance (docs/robustness.md): connection-class
+    failures normalize to the typed StoreUnavailable, idempotent reads get
+    a bounded retry+backoff, writes fail on the first fault."""
+
+    def _kv(self, gateway, attempts=3):
+        return EtcdKV(f"http://127.0.0.1:{gateway.server_address[1]}",
+                      retry_attempts=attempts, retry_base_s=0.001,
+                      retry_max_s=0.01)
+
+    def test_read_retries_through_transient_outage(self, gateway):
+        kv = self._kv(gateway, attempts=3)
+        kv.put("/k", "v")
+        gateway.fail_next = 2  # two aborted requests, then healthy
+        assert kv.get("/k") == "v"
+        assert gateway.fail_seen == 2
+
+    def test_read_exhausts_retries_to_typed_error(self, gateway):
+        kv = self._kv(gateway, attempts=2)
+        kv.put("/k", "v")
+        gateway.fail_next = 10  # longer than the budget
+        with pytest.raises(errors.StoreUnavailable):
+            kv.get("/k")
+        assert gateway.fail_seen == 2  # bounded: exactly the budget
+
+    def test_range_prefix_retries(self, gateway):
+        kv = self._kv(gateway, attempts=3)
+        kv.put("/p/a", "1")
+        gateway.fail_next = 1
+        assert kv.range_prefix("/p/") == {"/p/a": "1"}
+
+    def test_write_is_normalized_but_never_retried(self, gateway):
+        kv = self._kv(gateway, attempts=3)
+        gateway.fail_next = 1
+        with pytest.raises(errors.StoreUnavailable):
+            kv.put("/w", "1")
+        # ONE attempt consumed the fault; a blind write retry would have
+        # burned through it and hidden the outage
+        assert gateway.fail_seen == 1
+        assert gateway.fail_next == 0
+        assert kv.get_or("/w") is None
+
+    def test_missing_key_is_not_an_outage(self, gateway):
+        kv = self._kv(gateway)
+        with pytest.raises(errors.NotExistInStore):
+            kv.get("/absent")
 
 
 ETCD_ADDR = os.environ.get("ETCD_ADDR", "")

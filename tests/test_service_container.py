@@ -476,3 +476,62 @@ class TestHistoryRollback:
         stop_idx = max(i for i, c in enumerate(calls)
                        if c == ("stop", "train-1"))
         assert last_start < stop_idx
+
+
+class TestQueueBackpressureCompensation:
+    """A rejected submit (QueueSaturated/QueueClosed) must leave NOTHING
+    half-applied (docs/robustness.md "Backpressure and shutdown"): the
+    rejected record cannot replay, so the flow must unwind inline."""
+
+    def test_saturated_replace_unquiesces_old_and_retires_new(
+            self, env, tmp_path, monkeypatch):
+        (tmp_path / "v1").mkdir()
+        (tmp_path / "v2").mkdir()
+        env.svc.run_container(ContainerRun(
+            image_name="jax", container_name="web", chip_count=2,
+            container_ports=[ContainerPort(80)],
+            binds=[Bind(str(tmp_path / "v1"), "/data")],
+        ))
+        env.wq.drain()
+        used_before = env.ports.status()["usedCount"]
+
+        def saturated(*a, **k):
+            raise errors.QueueSaturated("full")
+
+        monkeypatch.setattr(env.wq, "submit_record", saturated)
+        with pytest.raises(errors.QueueSaturated):
+            env.svc.patch_container_volume("web", ContainerPatchVolume(
+                old_bind=Bind(str(tmp_path / "v1"), "/data"),
+                new_bind=Bind(str(tmp_path / "v2"), "/data"),
+            ))
+        # old container back up with its ports re-claimed; replacement gone
+        assert env.runtime.container_inspect("web-0").running
+        assert env.versions.get("web") == 0
+        assert not env.runtime.container_exists("web-1")
+        assert env.ports.status()["usedCount"] == used_before
+        with pytest.raises(errors.NotExistInStore):
+            env.store.get_container("web-1")
+
+    def test_saturated_purge_keeps_version_pointer_for_retry(
+            self, env, monkeypatch):
+        run_default(env, chips=2)
+        real_submit = env.wq.submit_record
+
+        def saturated(*a, **k):
+            raise errors.QueueSaturated("full")
+
+        monkeypatch.setattr(env.wq, "submit_record", saturated)
+        with pytest.raises(errors.QueueSaturated):
+            env.svc.delete_container("train-0", ContainerDelete(
+                force=True, del_etcd_info_and_version_record=True))
+        # the pointer survives the rejected purge — a retried delete must
+        # still resolve the family and reach the purge path (remove-first
+        # would 404 forever and leak the state family)
+        assert env.versions.get("train") == 0
+        monkeypatch.setattr(env.wq, "submit_record", real_submit)
+        env.svc.delete_container("train-0", ContainerDelete(
+            force=True, del_etcd_info_and_version_record=True))
+        env.wq.drain()
+        assert env.versions.get("train") is None
+        with pytest.raises(errors.NotExistInStore):
+            env.store.get_container("train-0")

@@ -59,6 +59,17 @@ class TestKV:
         assert s2.get("/k") == "v"
         s2.close()
 
+    def test_sqlite_busy_timeout_configured(self, tmp_path):
+        """A foreign lock holder makes ops wait (bounded), not raise
+        'database is locked' instantly — the PRAGMA must be live on the
+        connection."""
+        s = SqliteKV(str(tmp_path / "b.db"), busy_timeout_s=2.5)
+        assert s._conn.execute("PRAGMA busy_timeout").fetchone()[0] == 2500
+        s.close()
+        d = SqliteKV(str(tmp_path / "d.db"))  # default is nonzero too
+        assert d._conn.execute("PRAGMA busy_timeout").fetchone()[0] > 0
+        d.close()
+
 
 class TestKeys:
     def test_split_versioned_name(self):
@@ -293,6 +304,35 @@ class TestWorkQueue:
         assert len(wq.dead_letters) == 1
         assert wq.retry_dead_letters() == 0
         assert len(wq.dead_letters) == 1  # still observable
+
+
+class TestTaskRecords:
+    """Declarative record shape (the durable-queue contract; full lifecycle
+    coverage in test_workqueue_durable.py)."""
+
+    def test_json_roundtrip(self):
+        from tpu_docker_api.state.workqueue import TaskRecord
+
+        rec = TaskRecord(task_id="abc", kind="copy_volume_data",
+                         params={"copyFrom": "d-0", "newName": "d-1"},
+                         seq=7, state="inflight", attempts=2,
+                         error="OSError: x", idempotency_key="copy:d-0->d-1")
+        back = TaskRecord.from_json(rec.to_json())
+        assert back == rec
+
+    def test_journal_key_order_matches_seq_order(self):
+        assert keys.queue_task_key(2) < keys.queue_task_key(10)
+        assert keys.queue_task_key(0).startswith(keys.QUEUE_TASKS_PREFIX)
+
+    def test_legacy_tasks_are_ephemeral(self, kv):
+        """Closure tasks never touch the journal — only records do."""
+        wq = WorkQueue(kv)
+        wq.start()
+        wq.submit(FnTask(fn=lambda: None))
+        wq.submit(PutKVTask("/e/k", "v"))
+        wq.drain()
+        wq.close()
+        assert kv.range_prefix(keys.QUEUE_TASKS_PREFIX) == {}
 
 
 class TestEtcdKVHelpers:

@@ -1,0 +1,483 @@
+"""Durable work queue unit tests (docs/robustness.md "Durable work queue"):
+journal lifecycle, replay-on-restart, durable dead letters, bounded submit /
+typed-close semantics, and store-outage degradation. The chaos-level
+end-to-end matrix (crash at every ``queue.*`` point during a data copy and
+a drain) lives in tests/test_chaos.py::TestDurableQueueChaos."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import KV, MemoryKV
+from tpu_docker_api.state.workqueue import (
+    FnTask,
+    TaskRecord,
+    WorkQueue,
+    submit_state_put,
+)
+
+
+def _records(kv) -> list[TaskRecord]:
+    return [TaskRecord.from_json(v)
+            for _, v in sorted(kv.range_prefix(keys.QUEUE_TASKS_PREFIX).items())]
+
+
+class TestJournalLifecycle:
+    def test_submit_journals_pending_record(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        wq.register("probe", lambda rec: None)
+        tid = wq.submit_record("probe", {"x": 1}, idempotency_key="p:1")
+        recs = _records(kv)
+        assert len(recs) == 1
+        assert recs[0].task_id == tid
+        assert recs[0].state == "pending"
+        assert recs[0].kind == "probe"
+        assert recs[0].params == {"x": 1}
+        assert recs[0].idempotency_key == "p:1"
+
+    def test_ack_deletes_journal_entry_and_marker(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        seen = []
+
+        def _exec(rec):
+            wq.mark_done(rec.task_id)
+            seen.append(rec.task_id)
+
+        wq.register("probe", _exec)
+        wq.start()
+        tid = wq.submit_record("probe", {})
+        wq.drain()
+        wq.close()
+        assert seen == [tid]
+        assert _records(kv) == []
+        assert kv.range_prefix(keys.QUEUE_MARKERS_PREFIX) == {}
+
+    def test_built_in_kinds_execute(self, tmp_path):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        wq.start()
+        submit_state_put(wq, "/t/a", {"v": 1})
+        wq.submit_record("del_key", {"key": "/t/a"})
+        wq.submit_record("put_kv", {"key": "/t/b", "value": "2"})
+        wq.drain()
+        wq.close()
+        assert kv.get_or("/t/a") is None
+        assert kv.get("/t/b") == "2"
+        assert _records(kv) == []
+
+    def test_submit_order_is_journal_order(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        wq.register("probe", lambda rec: None)
+        for i in range(12):
+            wq.submit_record("probe", {"i": i})
+        recs = _records(kv)
+        assert [r.params["i"] for r in recs] == list(range(12))
+        assert [r.seq for r in recs] == sorted(r.seq for r in recs)
+
+    def test_idempotency_key_dedupes_active_submit(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        wq.register("probe", lambda rec: None)
+        t1 = wq.submit_record("probe", {}, idempotency_key="k")
+        t2 = wq.submit_record("probe", {}, idempotency_key="k")
+        assert t1 == t2
+        assert len(_records(kv)) == 1
+
+    def test_unknown_kind_dead_letters_without_retrying(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv, max_retries=5, backoff_base_s=10.0)  # a retry would hang
+        wq.start()
+        wq.submit_record("no_such_kind", {})
+        wq.drain()
+        wq.close()
+        letters = wq.dead_letter_view()
+        assert len(letters) == 1
+        assert "no handler registered" in letters[0]["error"]
+        # deterministic failure: dead-lettered on first sight, no backoff
+        assert letters[0]["attempts"] == 1
+
+    def test_idempotency_dedup_survives_restart(self):
+        kv = MemoryKV()
+        dead = WorkQueue(kv)
+        dead.register("probe", lambda rec: None)
+        t1 = dead.submit_record("probe", {}, idempotency_key="k")
+        # the daemon dies; the next one must dedup against the journaled
+        # record, not only its own in-memory submissions
+        wq2 = WorkQueue(kv)
+        wq2.register("probe", lambda rec: None)
+        assert wq2.submit_record("probe", {}, idempotency_key="k") == t1
+        assert len(_records(kv)) == 1
+
+
+class TestReplayOnRestart:
+    """The journal is the contract between a dead daemon and its successor:
+    a fresh WorkQueue over the same KV replays pending/in-flight records in
+    submit order, and resumes the sequence counter without collisions."""
+
+    def _restarted(self, kv) -> WorkQueue:
+        wq = WorkQueue(kv)
+        return wq
+
+    def test_pending_records_replay_in_order(self):
+        kv = MemoryKV()
+        dead = WorkQueue(kv)
+        dead.register("probe", lambda rec: None)
+        for i in range(5):
+            dead.submit_record("probe", {"i": i})
+        # the daemon dies: its loop never ran, the records are pure intent
+
+        ran = []
+        wq2 = self._restarted(kv)
+        wq2.register("probe", lambda rec: ran.append(rec.params["i"]))
+        outcomes = wq2.replay_journal()
+        assert ran == list(range(5))
+        assert [o["state"] for o in outcomes] == ["done"] * 5
+        assert _records(kv) == []
+
+    def test_inflight_record_replays(self):
+        kv = MemoryKV()
+        dead = WorkQueue(kv)
+        dead.register("probe", lambda rec: None)
+        dead.submit_record("probe", {})
+        rec = _records(kv)[0]
+        rec.state = "inflight"  # the dead daemon claimed it, then died
+        kv.put(keys.queue_task_key(rec.seq), rec.to_json())
+
+        ran = []
+        wq2 = self._restarted(kv)
+        wq2.register("probe", lambda rec: ran.append(rec.task_id))
+        wq2.replay_journal()
+        assert ran == [rec.task_id]
+        assert _records(kv) == []
+
+    def test_replay_skips_records_owned_by_this_process(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        wq.register("probe", lambda rec: None)
+        wq.submit_record("probe", {})  # queued in THIS process, loop not run
+        assert wq.journal_replayable() == []
+        assert wq.replay_journal() == []
+        # ... but an adopting (restarted) queue sees it
+        wq2 = self._restarted(kv)
+        assert len(wq2.journal_replayable()) == 1
+
+    def test_marker_makes_replay_skip_completed_side_effect(self):
+        kv = MemoryKV()
+        dead = WorkQueue(kv)
+        applied = []
+
+        def _exec_once(rec):
+            if not dead.marker_done(rec.task_id):
+                applied.append("dead")
+                dead.mark_done(rec.task_id)
+            # simulated crash AFTER the side effect, BEFORE the ack
+
+        dead.register("probe", _exec_once)
+        dead.submit_record("probe", {})
+        rec = _records(kv)[0]
+        _exec_once(rec)  # side effect lands; journal still pending
+
+        wq2 = self._restarted(kv)
+
+        def _exec_replay(rec):
+            if not wq2.marker_done(rec.task_id):
+                applied.append("replay")
+                wq2.mark_done(rec.task_id)
+
+        wq2.register("probe", _exec_replay)
+        wq2.replay_journal()
+        assert applied == ["dead"]  # effectively once
+        assert _records(kv) == []
+
+    def test_concurrent_replays_run_each_record_once(self):
+        kv = MemoryKV()
+        dead = WorkQueue(kv)
+        dead.register("probe", lambda rec: None)
+        dead.submit_record("probe", {})
+
+        ran = []
+        wq2 = WorkQueue(kv)
+        wq2.register("probe", lambda rec: (time.sleep(0.05),
+                                           ran.append(rec.task_id)))
+        # periodic reconcile and the HTTP route racing: the second replayer
+        # must re-read the journal AFTER the first finishes, not adopt the
+        # same record twice
+        threads = [threading.Thread(target=wq2.replay_journal)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(ran) == 1
+        assert _records(kv) == []
+
+    def test_seq_resumes_past_surviving_entries(self):
+        kv = MemoryKV()
+        dead = WorkQueue(kv)
+        dead.register("probe", lambda rec: None)
+        for i in range(3):
+            dead.submit_record("probe", {"i": i})
+        top = max(r.seq for r in _records(kv))
+
+        wq2 = self._restarted(kv)
+        wq2.register("probe", lambda rec: None)
+        wq2.submit_record("probe", {"i": 99})
+        assert max(r.seq for r in _records(kv)) == top + 1  # no collision
+
+
+class TestDurableDeadLetters:
+    def test_dead_letters_survive_restart(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv, max_retries=2, backoff_base_s=0.001)
+        wq.register("boom", lambda rec: (_ for _ in ()).throw(OSError("disk")))
+        wq.start()
+        wq.submit_record("boom", {})
+        wq.drain()
+        wq.close()
+        assert len(wq.dead_letter_view()) == 1
+
+        wq2 = WorkQueue(kv)  # the next daemon
+        letters = wq2.dead_letter_view()
+        assert len(letters) == 1
+        assert letters[0]["durable"]
+        assert letters[0]["kind"] == "boom"
+        assert letters[0]["error"].startswith("OSError")
+        # dead records are NOT replayed — only an operator retry revives them
+        assert wq2.journal_replayable() == []
+
+    def test_retry_drains_durable_set_with_fresh_budget(self):
+        kv = MemoryKV()
+        healthy = []
+        wq = WorkQueue(kv, max_retries=2, backoff_base_s=0.001)
+
+        def _flaky(rec):
+            if not healthy:
+                raise OSError("disk full")
+
+        wq.register("flaky", _flaky)
+        wq.start()
+        wq.submit_record("flaky", {})
+        wq.drain()
+        assert len(wq.dead_letter_view()) == 1
+        # retried while the fault persists: dead-letters again, no spin
+        assert wq.retry_dead_letters() == 1
+        wq.drain()
+        assert len(wq.dead_letter_view()) == 1
+
+        healthy.append(True)
+        assert wq.retry_dead_letters() == 1
+        wq.drain()
+        wq.close()
+        assert wq.dead_letter_view() == []
+        assert _records(kv) == []
+
+    def test_compensation_fires_on_durable_dead_letter(self):
+        kv = MemoryKV()
+        compensated = []
+        wq = WorkQueue(kv, max_retries=1, backoff_base_s=0.001)
+        wq.register("boom", lambda rec: (_ for _ in ()).throw(OSError("x")),
+                    on_fail=lambda rec: compensated.append(rec.params["who"]))
+        wq.start()
+        wq.submit_record("boom", {"who": "t-1"})
+        wq.drain()
+        wq.close()
+        assert compensated == ["t-1"]
+
+
+class TestBoundedSubmitAndClose:
+    def test_full_queue_raises_queue_saturated(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv, capacity=1, submit_timeout_s=0.05)
+        wq.register("probe", lambda rec: None)
+        # no consumer: the first submit fills the queue
+        wq.submit_record("probe", {})
+        with pytest.raises(errors.QueueSaturated):
+            wq.submit_record("probe", {})
+        with pytest.raises(errors.QueueSaturated):
+            wq.submit(FnTask(fn=lambda: None))
+        # the rejected record must NOT linger in the journal (it would
+        # execute later behind the caller's back)
+        assert len(_records(kv)) == 1
+
+    def test_queue_saturated_maps_to_http_429(self):
+        assert errors.QueueSaturated.http_status == 429
+        assert errors.ApiError.http_status == 200  # everything else: envelope
+
+    def test_submit_after_close_raises_queue_closed(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv)
+        wq.register("probe", lambda rec: None)
+        wq.start()
+        wq.close()
+        with pytest.raises(errors.QueueClosed):
+            wq.submit_record("probe", {})
+        with pytest.raises(errors.QueueClosed):
+            wq.submit(FnTask(fn=lambda: None))
+
+    def test_close_deadline_bounds_hung_engine(self):
+        kv = MemoryKV()
+        release = threading.Event()
+        wq = WorkQueue(kv, close_deadline_s=0.2)
+        wq.register("hang", lambda rec: release.wait(30))
+        wq.start()
+        wq.submit_record("hang", {})
+        t0 = time.monotonic()
+        wq.close()  # must return within ~the deadline, not after 30 s
+        assert time.monotonic() - t0 < 5.0
+        stats = wq.stats()
+        assert any(e["event"] == "queue-close-abandoned"
+                   for e in stats["events"])
+        release.set()
+        # the abandoned record is still journaled for the next daemon
+        assert len(_records(kv)) == 1
+
+
+class _OutageKV(KV):
+    """Wrapper that fails every op touching the queue journal while
+    ``broken`` is set — the store-outage the queue must degrade through."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.broken = False
+
+    def _gate(self, key: str):
+        if self.broken and key.startswith(keys.QUEUE_PREFIX):
+            raise errors.StoreUnavailable("injected outage")
+
+    def put(self, key, value):
+        self._gate(key)
+        self.inner.put(key, value)
+
+    def get(self, key):
+        self._gate(key)
+        return self.inner.get(key)
+
+    def delete(self, key):
+        self._gate(key)
+        self.inner.delete(key)
+
+    def range_prefix(self, prefix):
+        self._gate(prefix)
+        return self.inner.range_prefix(prefix)
+
+
+class TestStoreOutageDegradation:
+    def test_submit_degrades_loudly_and_still_executes(self):
+        kv = _OutageKV(MemoryKV())
+        ran = []
+        wq = WorkQueue(kv)
+        wq.register("probe", lambda rec: ran.append(rec.params["i"]))
+        wq.start()
+        kv.broken = True
+        wq.submit_record("probe", {"i": 1})  # journal write fails — LOUDLY
+        wq.drain()
+        kv.broken = False
+        wq.submit_record("probe", {"i": 2})  # back to durable
+        wq.drain()
+        wq.close()
+        assert ran == [1, 2]
+        stats = wq.stats()
+        assert stats["journalWriteFailures"] >= 1
+        assert any(e["event"] == "journal-write-failed"
+                   for e in stats["events"])
+        assert _records(kv.inner) == []  # the durable one was acked
+
+    def test_degraded_submit_dead_letter_stays_observable(self):
+        kv = _OutageKV(MemoryKV())
+        wq = WorkQueue(kv, max_retries=1, backoff_base_s=0.001)
+        wq.register("boom", lambda rec: (_ for _ in ()).throw(OSError("x")))
+        wq.start()
+        kv.broken = True  # journal write fails: the record is in-memory only
+        wq.submit_record("boom", {"who": "t"})
+        wq.drain()
+        kv.broken = False
+        # exhausted: with no journal entry to hold state="dead", the record
+        # must land with the ephemeral letters, never vanish silently
+        letters = wq.dead_letter_view()
+        assert len(letters) == 1
+        assert letters[0]["kind"] == "boom" and letters[0]["durable"] is False
+        # ... and stays retryable
+        wq.register("boom", lambda rec: None)
+        assert wq.retry_dead_letters() == 1
+        wq.drain()
+        wq.close()
+        assert wq.dead_letter_view() == []
+
+    def test_retry_with_full_queue_keeps_ephemeral_letters(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv, capacity=1, submit_timeout_s=0.05,
+                       max_retries=1, backoff_base_s=0.001)
+        wq.register("boom", lambda rec: (_ for _ in ()).throw(OSError("x")))
+        wq.start()
+        wq.submit(FnTask(fn=lambda: (_ for _ in ()).throw(OSError("y")),
+                         description="eph"))
+        wq.drain()
+        assert len(wq.dead_letter_view()) == 1
+        # wedge the consumer and fill the queue so the re-enqueue cannot fit
+        gate = threading.Event()
+        wq.submit(FnTask(fn=gate.wait, description="wedge"))
+        wq.submit(FnTask(fn=lambda: None, description="filler"))
+        # bounded: returns (no deadlock holding the lifecycle lock), and the
+        # un-enqueued letter is restored rather than dropped
+        assert wq.retry_dead_letters() == 0
+        assert len(wq.dead_letter_view()) == 1
+        gate.set()
+        wq.drain()
+        wq.close()
+
+    def test_stats_survive_journal_outage(self):
+        kv = _OutageKV(MemoryKV())
+        wq = WorkQueue(kv)
+        kv.broken = True
+        out = wq.stats()
+        assert "error" in out["journal"]
+
+    def test_ack_outage_leaves_entry_for_replay(self):
+        kv = _OutageKV(MemoryKV())
+        wq = WorkQueue(kv)
+        ran = []
+        wq.register("probe", lambda rec: ran.append(1))
+        wq.start()
+        tid = wq.submit_record("probe", {})
+        kv.broken = True  # the ack delete will fail
+        wq.drain()
+        wq.close()
+        kv.broken = False
+        assert ran == [1]
+        recs = _records(kv.inner)
+        # the claim write failed too, so the entry survives as pending
+        # (or inflight, had the outage begun later) — either replays
+        assert len(recs) == 1 and recs[0].state in ("pending", "inflight")
+        # the next daemon adopts and re-acks it (idempotent handler)
+        wq2 = WorkQueue(kv)
+        wq2.register("probe", lambda rec: ran.append(2))
+        wq2.replay_journal()
+        assert _records(kv.inner) == []
+
+
+class TestQueueStatsView:
+    def test_stats_counts_lifecycle_states(self):
+        kv = MemoryKV()
+        wq = WorkQueue(kv, max_retries=1, backoff_base_s=0.001)
+        wq.register("ok", lambda rec: None)
+        wq.register("boom", lambda rec: (_ for _ in ()).throw(OSError("x")))
+        wq.submit_record("ok", {})
+        out = wq.stats()
+        assert out["depth"] == 1
+        assert out["journal"]["pending"] == 1
+        assert out["capacity"] == 110
+        assert out["closed"] is False
+        wq.start()
+        wq.submit_record("boom", {})
+        wq.drain()
+        wq.close()
+        out = wq.stats()
+        assert out["journal"]["dead"] == 1
+        assert out["journal"]["pending"] == 0
+        assert out["closed"] is True

@@ -35,6 +35,9 @@ Route table:
     POST   /api/v1/hosts/{name}/uncordon       lift the cordon
     POST   /api/v1/hosts/{name}/drain          cordon + migrate gangs off (async)
     GET    /api/v1/health/hosts                per-host probe + breaker state
+    GET    /api/v1/queue                       durable work-queue stats
+    GET    /api/v1/dead-letters                durable dead-letter set
+    POST   /api/v1/dead-letters/retry          re-enqueue the dead letters
     GET    /api/v1/debug/threads               per-thread stack dump (pprof analog)
     GET    /healthz
 """
@@ -389,12 +392,17 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
               lambda body, **_: job_supervisor.status_view())
     if work_queue is not None:
         # failed async tasks must be observable (fix for the reference's
-        # silent infinite-retry loop, workQueue.go:33-47)
-        r.add("GET", "/api/v1/debug/deadletters",
+        # silent infinite-retry loop, workQueue.go:33-47) — and, since the
+        # durable journal, they survive daemon restarts
+        r.add("GET", "/api/v1/dead-letters",
+              lambda body, **_: work_queue.dead_letter_view())
+        r.add("GET", "/api/v1/debug/deadletters",  # legacy alias
               lambda body, **_: work_queue.dead_letter_view())
         # ... and recoverable: re-enqueue after the operator fixed the cause
         r.add("POST", "/api/v1/dead-letters/retry",
               lambda body, **_: {"requeued": work_queue.retry_dead_letters()})
+        # queue depth / journal lifecycle / degradation counters
+        r.add("GET", "/api/v1/queue", lambda body, **_: work_queue.stats())
     if reconciler is not None:
         # KV-vs-runtime drift sweep (service/reconcile.py); ?dryRun=true
         # reports the planned repairs without mutating anything
@@ -482,6 +490,7 @@ def build_handler(router: Router):
             route = found[2] if found else "unmatched"
             t0 = time.perf_counter()
             app_code = codes.SUCCESS
+            http_status = 200
             try:
                 if found is None:
                     raise errors.BadRequest(f"no route for {method} {path}")
@@ -499,6 +508,10 @@ def build_handler(router: Router):
                 payload = response.success(data)
             except errors.ApiError as e:
                 app_code = e.code
+                # the one deviation from always-200: backpressure errors
+                # (QueueSaturated) carry a real 429 so clients and proxies
+                # treat them as retryable, never as success
+                http_status = e.http_status or 200
                 payload = response.error(e.code, str(e))
             except json.JSONDecodeError as e:
                 app_code = codes.BAD_REQUEST
@@ -517,8 +530,9 @@ def build_handler(router: Router):
                              help="API request latency")
             log.info("%s %s code=%d dur=%.1fms id=%s",
                      method, path, app_code, dur * 1e3, req_id)
-            # reference: always HTTP 200, app code in envelope (response.go:15-29)
-            self.send_response(200)
+            # reference: always HTTP 200, app code in envelope
+            # (response.go:15-29) — except typed backpressure (429 above)
+            self.send_response(http_status)
             self.send_header("Content-Type", "application/json")
             self.send_header("X-Request-Id", req_id)
             self.send_header("Content-Length", str(len(payload)))

@@ -200,10 +200,17 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Per-job gang status: phase (running/restarting/migrating/failed/"
      "stopped), restart + migration budgets, dead/missing members, "
      "unreachable hosts, backoff remaining", None),
-    ("GET", "/api/v1/debug/deadletters", "getDeadLetters",
-     "Async tasks that exhausted retries (never silently dropped)", None),
+    ("GET", "/api/v1/queue", "getQueueStats",
+     "Durable work-queue view: in-memory depth, journal lifecycle counts "
+     "(pending/inflight/dead), degradation events and counters", None),
+    ("GET", "/api/v1/dead-letters", "getDeadLetters",
+     "Async tasks that exhausted retries — journaled in the KV store, so "
+     "they survive daemon restarts (never silently dropped)", None),
+    ("GET", "/api/v1/debug/deadletters", "getDeadLettersDebug",
+     "Legacy alias of GET /api/v1/dead-letters", None),
     ("POST", "/api/v1/dead-letters/retry", "retryDeadLetters",
-     "Re-enqueue every dead-lettered task with a fresh retry budget", None),
+     "Re-enqueue every dead-lettered task (durable + ephemeral) with a "
+     "fresh retry budget", None),
     ("GET", "/api/v1/reconcile", "reconcile",
      "Sweep KV desired state vs runtime actual state and repair drift "
      "(orphans, half-completed replaces, leaked chips/ports); "

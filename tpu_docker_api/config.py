@@ -86,6 +86,21 @@ class Config:
     # SEPARATE from job_max_restarts, so dead hosts never eat the
     # crash-restart budget (and crash loops never eat this one)
     job_max_migrations: int = 3
+    # durable work queue (state/workqueue.py): how long a producer (API
+    # thread) may block on a full queue before the typed QueueSaturated
+    # error (HTTP 429) — never forever
+    queue_submit_timeout_s: float = 5.0
+    # shutdown drain deadline: close() waits this long for the sync loop to
+    # finish the backlog, then abandons it — journaled records replay under
+    # the next daemon, so a hung engine can't block shutdown indefinitely
+    queue_close_deadline_s: float = 10.0
+    # store-outage tolerance (EtcdKV): idempotent READS retry up to
+    # store_retry_attempts times with capped exponential backoff
+    # (base·2^n clamped to max) before raising the typed StoreUnavailable;
+    # writes are normalized but never blind-retried
+    store_retry_attempts: int = 3
+    store_retry_base_s: float = 0.05
+    store_retry_max_s: float = 1.0
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container

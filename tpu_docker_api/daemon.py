@@ -57,8 +57,17 @@ class Program:
 
     def init(self) -> None:
         cfg = self.cfg
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        # metrics first: the work queue's degradation counters need a home
+        # before any durable submit can happen
+        self.metrics = MetricsRegistry()
         self.kv = self._injected_kv or open_store(
-            cfg.store_backend, etcd_addr=cfg.etcd_addr, sqlite_path=cfg.sqlite_path
+            cfg.store_backend, etcd_addr=cfg.etcd_addr,
+            sqlite_path=cfg.sqlite_path,
+            retry_attempts=cfg.store_retry_attempts,
+            retry_base_s=cfg.store_retry_base_s,
+            retry_max_s=cfg.store_retry_max_s,
         )
         self.store = StateStore(self.kv)
         self.runtime = self._injected_runtime or (
@@ -66,7 +75,12 @@ class Program:
             if cfg.runtime_backend == "docker"
             else open_runtime("fake", allow_exec=True)
         )
-        self.wq = WorkQueue(self.kv)
+        self.wq = WorkQueue(
+            self.kv,
+            submit_timeout_s=cfg.queue_submit_timeout_s,
+            close_deadline_s=cfg.queue_close_deadline_s,
+            metrics=self.metrics,
+        )
         topology = self._discover_topology()
         self.chip_scheduler = ChipScheduler(topology, self.kv)
         self.port_scheduler = PortScheduler(
@@ -91,9 +105,7 @@ class Program:
         from tpu_docker_api.service.host_health import HostMonitor
         from tpu_docker_api.service.job_supervisor import JobSupervisor
         from tpu_docker_api.service.reconcile import Reconciler
-        from tpu_docker_api.telemetry.metrics import MetricsRegistry
 
-        self.metrics = MetricsRegistry()
         # host failure domains: engine probing + healthy→suspect→down per
         # host; built before the supervisor so its down-verdicts gate the
         # supervisor's migrate-vs-hands-off decision from the first poll
@@ -137,6 +149,10 @@ class Program:
             job_max_restarts=cfg.job_max_restarts,
             job_max_migrations=cfg.job_max_migrations,
             registry=self.metrics,
+            # durable-queue adoption: the startup sweep replays the journal
+            # a dead daemon left (pending/in-flight records) before judging
+            # family state
+            work_queue=self.wq,
         )
 
     def _build_pod(self, local_topology: HostTopology) -> Pod:

@@ -15,6 +15,11 @@ class ApiError(Exception):
 
     #: numeric code from tpu_docker_api.api.codes (filled per subclass)
     code: int = 500
+    #: HTTP status the envelope rides on. The reference answers everything
+    #: with 200 + app code; backpressure errors are the one exception —
+    #: intermediaries and clients must see queue saturation as a retryable
+    #: transport-level condition (429), not a success
+    http_status: int = 200
 
     def __init__(self, msg: str = ""):
         super().__init__(msg or self.__class__.__doc__ or self.__class__.__name__)
@@ -94,6 +99,16 @@ class NotExistInStore(ApiError):
     code = 10501
 
 
+class StoreUnavailable(ApiError):
+    """The state-store backend cannot be reached (connection refused/reset,
+    timeout). Distinct from NotExistInStore: the KEY's presence is unknown,
+    only the path to the store failed — the KV analog of HostUnreachable.
+    EtcdKV normalizes every connection-class failure to this type (bounded
+    retry+backoff on idempotent reads first); the work queue's journal
+    writes catch it and degrade loudly instead of wedging the sync loop."""
+    code = 10502
+
+
 # --- schedulers (xerrors/scheduler.go:8-10) -----------------------------------
 
 class ChipNotEnough(ApiError):
@@ -109,6 +124,27 @@ class PortNotEnough(ApiError):
 class TopologyUnknown(ApiError):
     """The requested slice shape/type is not a known TPU topology."""
     code = 10603
+
+
+# --- work queue (state/workqueue.py) ------------------------------------------
+
+class QueueSaturated(ApiError):
+    """The work queue is full and the bounded submit timed out — the daemon
+    is falling behind its async backlog. Surfaced as HTTP 429 so callers
+    (and proxies) treat it as retryable backpressure, never as success."""
+    code = 10801
+    http_status = 429
+
+
+class QueueClosed(ApiError):
+    """Submit raced shutdown: the sync loop is gone, so enqueueing would
+    silently strand the task in a consumerless queue. Callers see a typed
+    error instead; journaled records are replayed by the next daemon.
+    HTTP 503 for the same reason QueueSaturated is 429: the identical
+    request succeeds against the next daemon, so retry-aware clients and
+    proxies must see transient backpressure, not a final app error."""
+    code = 10802
+    http_status = 503
 
 
 # --- host failure domains (service/host_health.py) ----------------------------

@@ -52,7 +52,7 @@ from tpu_docker_api.service.crashpoints import crash_point
 from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
-from tpu_docker_api.state.workqueue import CopyTask, FnTask, WorkQueue
+from tpu_docker_api.state.workqueue import TaskRecord, WorkQueue
 
 log = logging.getLogger(__name__)
 
@@ -104,6 +104,12 @@ class ContainerService:
         self.wq = work_queue
         self.libtpu_path = libtpu_path
         self._locks = _FamilyLocks()
+        # durable-queue registry: bind this service's context to the task
+        # kinds it submits, so records journaled by a dead daemon replay
+        # under any daemon that can construct the service
+        work_queue.register("copy_container_data", self._task_copy_data,
+                            on_fail=self._task_copy_failed)
+        work_queue.register("start_version", self._task_start_version)
 
     # -- helpers -----------------------------------------------------------------
 
@@ -251,11 +257,16 @@ class ContainerService:
                 except errors.ContainerNotExist:
                     continue
             if req.del_etcd_info_and_version_record:
+                # submit BEFORE dropping the version pointer: a saturated
+                # queue (429) there would otherwise leak the state family
+                # forever — the retried delete 404s on the missing pointer
+                # and can never reach this purge again
+                self.wq.submit_record(
+                    "delete_state_family",
+                    {"resource": Resource.CONTAINERS.value, "base": base},
+                    idempotency_key=f"purge:containers:{base}",
+                )
                 self.versions.remove(base)
-                self.wq.submit(FnTask(
-                    fn=lambda: self.store.delete_family(Resource.CONTAINERS, base),
-                    description=f"delete state family {base}",
-                ))
             log.info("deleted container family %s (purge_state=%s)",
                      base, req.del_etcd_info_and_version_record)
 
@@ -583,6 +594,7 @@ class ContainerService:
         new_name = self._run_new_version(base, new_spec, start_now=False)
         crash_point("replace.after_create_new")
 
+        quiesced_ports: list[int] | None = None
         if old_running:
             # quiesce: stop old, keep its chips (the new version inherits
             # them), release its old ports (reference stop opts :263-266)
@@ -592,6 +604,8 @@ class ContainerService:
                 self.ports.restore_ports(
                     [pb.host_port for pb in old_info.spec.port_bindings], owner=base
                 )
+                quiesced_ports = [pb.host_port
+                                  for pb in old_info.spec.port_bindings]
             except errors.ContainerNotExist:
                 old_running = False
             except Exception:
@@ -603,34 +617,104 @@ class ContainerService:
                 raise
         crash_point("replace.after_quiesce_old")
 
-        def _resolve(n: str) -> str:
-            return self.runtime.container_data_dir(n)
-
-        def _start_new() -> None:
-            self.runtime.container_start(new_name)
-            log.info("rolling replace %s -> %s complete", old_name, new_name)
-
-        def _compensate() -> None:
-            log.error("data migration %s -> %s dead-lettered%s", copy_from,
-                      new_name,
-                      "; restarting old container" if restart_old_on_fail
-                      else "")
-            if restart_old_on_fail:
+        # declarative records (not closures): the durable journal makes the
+        # migrate-then-start intent survive a daemon crash — the reconciler
+        # replays it under the next daemon (docs/robustness.md)
+        try:
+            if self.runtime.container_exists(copy_from):
+                self.wq.submit_record(
+                    "copy_container_data",
+                    {"base": base, "copyFrom": copy_from, "newName": new_name,
+                     "oldName": old_name, "startNew": True,
+                     "restartOldOnFail": restart_old_on_fail},
+                    idempotency_key=f"copy:containers:{copy_from}->{new_name}",
+                )
+            else:
+                self.wq.submit_record(
+                    "start_version", {"base": base, "name": new_name},
+                    idempotency_key=f"start:containers:{new_name}",
+                )
+        except (errors.QueueSaturated, errors.QueueClosed):
+            # a rejected submit must leave NOTHING half-applied (the same
+            # contract as volume resize) — without the record neither the
+            # copy nor the start can ever replay, so the family would be
+            # stranded: latest an unstarted data-less container, old one
+            # stopped. Un-quiesce the old container, then retire the
+            # replacement, before surfacing the backpressure
+            if quiesced_ports is not None:
+                conflicts = self.ports.try_claim_ports(quiesced_ports,
+                                                       owner=base)
+                if conflicts:
+                    # another family grabbed the ports inside the submit
+                    # window; the engine arbitrates the actual bind
+                    log.error("un-quiesce of %s: ports %s already claimed",
+                              old_name, conflicts)
                 with contextlib.suppress(Exception):
                     self.runtime.container_start(old_name)
-
-        if self.runtime.container_exists(copy_from):
-            self.wq.submit(CopyTask(
-                resource="containers",
-                old_name=copy_from,
-                new_name=new_name,
-                resolve=_resolve,
-                on_done=_start_new,
-                on_fail=_compensate,
-            ))
-        else:
-            self.wq.submit(FnTask(fn=_start_new, description=f"start {new_name}"))
+            self._undo_new_version(base, old_name, new_name)
+            raise
         return new_name
+
+    # -- durable task handlers (registry kinds this service executes) -------------
+
+    def _latest_of(self, base: str) -> str | None:
+        latest = self.versions.get(base)
+        return None if latest is None else versioned_name(base, latest)
+
+    def _task_copy_data(self, rec: TaskRecord) -> None:
+        """Execute a ``copy_container_data`` record: migrate data old→new,
+        then start the replacement. Safe to replay: the copy-complete
+        MARKER is written before the start, so a re-run after a crash at
+        any point skips the copy once the new container may be running —
+        a replayed copy never re-clobbers a started container."""
+        p = rec.params
+        with self._locks.hold(p["base"]):
+            new_name = p["newName"]
+            if (self._latest_of(p["base"]) != new_name
+                    or not self.runtime.container_exists(new_name)):
+                # the family moved on (reconciler rolled the replacement
+                # back, a newer replace superseded it, or it was deleted):
+                # this record is obsolete — starting a retired version
+                # would resurrect a second live version
+                log.info("copy task for %s is obsolete; skipping", new_name)
+                return
+            if not self.wq.marker_done(rec.task_id):
+                if self.runtime.container_exists(p["copyFrom"]):
+                    self.wq.copy_dirs(
+                        self.runtime.container_data_dir(p["copyFrom"]),
+                        self.runtime.container_data_dir(new_name))
+                # marker BEFORE start: the non-idempotent step is proven
+                # done before anything may write into the new container
+                self.wq.mark_done(rec.task_id)
+            if p.get("startNew", True):
+                self.runtime.container_start(new_name)
+                log.info("rolling replace %s -> %s complete",
+                         p["oldName"], new_name)
+
+    def _task_copy_failed(self, rec: TaskRecord) -> None:
+        """Dead-letter compensation: the migration is lost, so restart the
+        old container (if this flow stopped it) — the workload must not
+        stay stranded on a replacement that never got its data."""
+        p = rec.params
+        log.error("data migration %s -> %s dead-lettered%s", p["copyFrom"],
+                  p["newName"],
+                  "; restarting old container" if p.get("restartOldOnFail")
+                  else "")
+        if p.get("restartOldOnFail"):
+            with contextlib.suppress(Exception):
+                self.runtime.container_start(p["oldName"])
+
+    def _task_start_version(self, rec: TaskRecord) -> None:
+        """Execute a ``start_version`` record (no-copy replacement path).
+        Idempotent: starting a running container is a no-op, and an
+        obsolete record (family moved on) is skipped."""
+        p = rec.params
+        with self._locks.hold(p["base"]):
+            if (self._latest_of(p["base"]) != p["name"]
+                    or not self.runtime.container_exists(p["name"])):
+                log.info("start task for %s is obsolete; skipping", p["name"])
+                return
+            self.runtime.container_start(p["name"])
 
     def _undo_new_version(self, base: str, old_name: str, new_name: str) -> None:
         """Best-effort compensation: retire a freshly created replacement

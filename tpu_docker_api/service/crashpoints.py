@@ -61,7 +61,21 @@ JOB_CRASH_POINTS = (
     "job.migrate.after_start_new",
 )
 
-KNOWN_CRASH_POINTS = CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
+#: durable work-queue lifecycle (state/workqueue.py _run_record): the
+#: journal closes the last volatile control-plane state, and these three
+#: points prove replay converges from every lifecycle boundary
+QUEUE_CRASH_POINTS = (
+    # record marked inflight in the journal, side effects not yet run
+    "queue.claim",
+    # side effects ran (copy-complete marker written, follow-up done),
+    # the ack (journal delete) not yet persisted
+    "queue.exec",
+    # ack persisted — nothing durable left, only loop bookkeeping
+    "queue.ack",
+)
+
+KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
+                      + QUEUE_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

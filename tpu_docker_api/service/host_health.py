@@ -49,7 +49,6 @@ from tpu_docker_api.runtime.base import (
     VolumeInfo,
 )
 from tpu_docker_api.runtime.spec import ContainerSpec
-from tpu_docker_api.state.workqueue import FnTask
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -294,6 +293,10 @@ class HostMonitor:
         self._events: collections.deque = collections.deque(maxlen=max_events)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        if work_queue is not None:
+            # durable-queue registry: a drain journaled by a dead daemon is
+            # finished by the next one through the same migrate path
+            work_queue.register("drain_gang", self._task_drain)
 
     # -- lifecycle ---------------------------------------------------------------
 
@@ -429,50 +432,55 @@ class HostMonitor:
                     and any(h == hid for h, *_ in st.placements)):
                 families.append(base)
         for base in families:
-            self._wq.submit(FnTask(
-                fn=self._drain_family_fn(base, hid),
-                description=f"drain {hid}: migrate job {base}"))
+            # declarative record, not a closure: the drain intent survives
+            # a daemon crash and replays under the next daemon
+            self._wq.submit_record(
+                "drain_gang", {"base": base, "host": hid},
+                idempotency_key=f"drain:{hid}:{base}")
         self._record("host-drain-queued", hid, jobs=families)
         out["drainingJobs"] = families
         return out
 
-    def _drain_family_fn(self, base: str, hid: str):
-        def _migrate() -> None:
+    def _task_drain(self, rec) -> None:
+        """Execute (or replay) a ``drain_gang`` record. Naturally
+        idempotent: a migration that already ran surfaces as
+        ``NoPatchRequired`` (no member left on the host) and settles as
+        drained instead of moving the gang twice."""
+        base, hid = rec.params["base"], rec.params["host"]
+        try:
+            # allocate-first only: a drain targets a LIVE host, so a
+            # capacity failure must leave the gang running and free
+            # nothing. Operator-driven, so it never burns the
+            # fault-migration budget.
+            self._job_svc.migrate_gang(
+                base, exclude_hosts={hid},
+                reason=f"drain of host {hid}",
+                count_migration=False, release_first_ok=False)
+            self._record("job-drained", hid, job=base)
+        except errors.NoPatchRequired:
+            # the latest version has no member on the host — but a
+            # PREVIOUS drain attempt may have died between creating
+            # the new gang and starting it, so "off the host" is not
+            # the same as "healthy". Report honestly; the supervisor
+            # finishes a half-started gang through its normal path.
+            latest = self._job_versions.get(base)
             try:
-                # allocate-first only: a drain targets a LIVE host, so a
-                # capacity failure must leave the gang running and free
-                # nothing. Operator-driven, so it never burns the
-                # fault-migration budget.
-                self._job_svc.migrate_gang(
-                    base, exclude_hosts={hid},
-                    reason=f"drain of host {hid}",
-                    count_migration=False, release_first_ok=False)
-                self._record("job-drained", hid, job=base)
-            except errors.NoPatchRequired:
-                # the latest version has no member on the host — but a
-                # PREVIOUS drain attempt may have died between creating
-                # the new gang and starting it, so "off the host" is not
-                # the same as "healthy". Report honestly; the supervisor
-                # finishes a half-started gang through its normal path.
-                latest = self._job_versions.get(base)
-                try:
-                    st = (self._job_svc.store.get_job(f"{base}-{latest}")
-                          if latest is not None else None)
-                except errors.NotExistInStore:
-                    st = None
-                if (st is not None and st.desired_running
-                        and self._job_svc._any_member_down(st)):
-                    self._record("host-drain-incomplete", hid, job=base,
-                                 note="gang re-placed off the host but not "
-                                 "fully running; supervisor will finish")
-                else:
-                    self._record("job-drained", hid, job=base,
-                                 note="already off the host")
-            except errors.ApiError as e:
-                self._record("host-drain-failed", hid, job=base,
-                             error=str(e))
-                raise  # work-queue retries, then dead-letters — loud
-        return _migrate
+                st = (self._job_svc.store.get_job(f"{base}-{latest}")
+                      if latest is not None else None)
+            except errors.NotExistInStore:
+                st = None
+            if (st is not None and st.desired_running
+                    and self._job_svc._any_member_down(st)):
+                self._record("host-drain-incomplete", hid, job=base,
+                             note="gang re-placed off the host but not "
+                             "fully running; supervisor will finish")
+            else:
+                self._record("job-drained", hid, job=base,
+                             note="already off the host")
+        except errors.ApiError as e:
+            self._record("host-drain-failed", hid, job=base,
+                         error=str(e))
+            raise  # work-queue retries, then dead-letters — loud
 
     # -- views -------------------------------------------------------------------
 

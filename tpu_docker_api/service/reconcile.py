@@ -82,6 +82,7 @@ class Reconciler:
         job_max_migrations: int = 3,
         registry: MetricsRegistry | None = None,
         max_events: int = 512,
+        work_queue=None,
     ) -> None:
         self.runtime = runtime
         self.store = store
@@ -97,6 +98,11 @@ class Reconciler:
         self._job_versions = job_versions
         self._job_max_restarts = job_max_restarts
         self._job_max_migrations = job_max_migrations
+        #: durable work queue: the startup sweep adopts its journal and
+        #: replays pending/in-flight records BEFORE the family passes, so
+        #: an interrupted copy/drain finishes forward instead of being
+        #: misread as structural drift
+        self._wq = work_queue
         #: gangs this reconciler already adopted (mirror of the supervisor's
         #: _attempted set): a first sight of phase == "restarting" is a
         #: daemon-death adoption and does not consume budget; if the family
@@ -140,6 +146,7 @@ class Reconciler:
     def reconcile(self, dry_run: bool = False) -> dict:
         t0 = time.perf_counter()
         actions: list[dict] = []
+        self._replay_queue_journal(actions, dry_run)
         families = self.versions.snapshot()
         members = self._runtime_members()
 
@@ -181,6 +188,39 @@ class Reconciler:
                      " (dry-run)" if dry_run else "", len(actions),
                      [a["action"] for a in actions])
         return report
+
+    def _replay_queue_journal(self, actions: list[dict],
+                              dry_run: bool) -> None:
+        """Adopt the durable work queue's journal: replay every pending /
+        in-flight record a dead daemon left behind, exactly once, in
+        submit order (state/workqueue.py). Runs FIRST so the family passes
+        judge the post-replay world — an interrupted rolling-replace copy
+        finishes forward rather than being rolled back as drift. Dry-run
+        reports the replayable records without executing them."""
+        if self._wq is None:
+            return
+        if dry_run:
+            try:
+                pending = self._wq.journal_replayable()
+            except Exception as e:  # noqa: BLE001 — a store outage must
+                # not abort the sweep; the journal is re-read next pass
+                log.warning("reconcile: journal scan failed: %s", e)
+                return
+            for rec in pending:
+                self._act(actions, True, "replay-task", rec.label(),
+                          kind=rec.kind)
+            return
+        # SimulatedCrash (BaseException) propagates — that is the chaos
+        # harness's kill; real task failures dead-letter inside the queue,
+        # and a store outage on the journal scan skips to the next pass
+        try:
+            outcomes = self._wq.replay_journal()
+        except Exception as e:  # noqa: BLE001
+            log.warning("reconcile: journal replay failed: %s", e)
+            return
+        for outcome in outcomes:
+            self._act(actions, False, "replay-task", outcome["target"],
+                      kind=outcome["kind"], result=outcome["state"])
 
     def events_view(self, limit: int = 100) -> list[dict]:
         with self._mu:

@@ -26,7 +26,7 @@ from tpu_docker_api.schemas.volume import (
 from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
-from tpu_docker_api.state.workqueue import CopyTask, FnTask, WorkQueue
+from tpu_docker_api.state.workqueue import TaskRecord, WorkQueue
 from tpu_docker_api.utils.files import dir_size
 
 log = logging.getLogger(__name__)
@@ -46,6 +46,9 @@ class VolumeService:
         self.wq = work_queue
         self._locks: dict[str, threading.RLock] = {}
         self._locks_mu = threading.Lock()
+        # durable-queue registry: volume data copies are declarative records
+        # (kind + params), replayable by any daemon over the same KV
+        work_queue.register("copy_volume_data", self._task_copy_data)
 
     @contextlib.contextmanager
     def _hold(self, base: str):
@@ -104,11 +107,16 @@ class VolumeService:
                 with contextlib.suppress(errors.VolumeNotExist):
                     self.runtime.volume_remove(versioned_name(base, v), force=True)
             if req.del_etcd_info_and_version_record:
+                # submit BEFORE dropping the version pointer: a saturated
+                # queue (429) there would otherwise leak the state family
+                # forever — the retried delete 404s on the missing pointer
+                # and can never reach this purge again
+                self.wq.submit_record(
+                    "delete_state_family",
+                    {"resource": Resource.VOLUMES.value, "base": base},
+                    idempotency_key=f"purge:volumes:{base}",
+                )
                 self.versions.remove(base)
-                self.wq.submit(FnTask(
-                    fn=lambda: self.store.delete_family(Resource.VOLUMES, base),
-                    description=f"delete volume state {base}",
-                ))
             log.info("deleted volume family %s", base)
 
     # -- resize (PATCH /volumes/{name}/size; reference PatchVolumeSize :122-187) --
@@ -134,17 +142,19 @@ class VolumeService:
                 f"{latest_name}: {used} bytes in use > target {req.size}"
             )
 
-        new_name = self._create_version(base, req.size)
-
-        def _resolve(n: str) -> str:
-            return self.runtime.volume_data_dir(n)
-
-        self.wq.submit(CopyTask(
-            resource="volumes",
-            old_name=latest_name,
-            new_name=new_name,
-            resolve=_resolve,
-        ))
+        # submit BEFORE creating the version: a saturated queue (429) must
+        # leave NOTHING half-applied. Sound because the copy handler takes
+        # the family lock we hold (it cannot run before the volume exists)
+        # and skips obsolete records (a crash before the create leaves a
+        # record the replay recognizes as moot and drops)
+        new_name = versioned_name(base, version + 1)
+        self.wq.submit_record(
+            "copy_volume_data",
+            {"base": base, "copyFrom": latest_name, "newName": new_name},
+            idempotency_key=f"copy:volumes:{latest_name}->{new_name}",
+        )
+        created = self._create_version(base, req.size)
+        assert created == new_name, f"{created} != planned {new_name}"
         log.info("resized volume %s -> %s (%s)", latest_name, new_name, req.size)
         return {"name": new_name, "size": req.size}
 
@@ -208,21 +218,45 @@ class VolumeService:
                         f"{src_name}: {used} bytes in use > rollback target "
                         f"size {t_state.size}")
 
-            new_name = self._create_version(base, t_state.size)
-
-            def _resolve(n: str) -> str:
-                return self.runtime.volume_data_dir(n)
-
-            self.wq.submit(CopyTask(
-                resource="volumes",
-                old_name=src_name,
-                new_name=new_name,
-                resolve=_resolve,
-            ))
+            # submit-then-create, like the resize path: saturation (429)
+            # must not leave a data-less version behind
+            new_name = versioned_name(base, version + 1)
+            self.wq.submit_record(
+                "copy_volume_data",
+                {"base": base, "copyFrom": src_name, "newName": new_name},
+                idempotency_key=f"copy:volumes:{src_name}->{new_name}",
+            )
+            created = self._create_version(base, t_state.size)
+            assert created == new_name, f"{created} != planned {new_name}"
             log.info("rolled back volume %s to v%d as %s (data from %s)",
                      latest_name, target, new_name, src_name)
             return {"name": new_name, "fromVersion": target,
                     "size": t_state.size}
+
+    # -- durable task handlers (registry kinds this service executes) -------------
+
+    def _task_copy_data(self, rec: TaskRecord) -> None:
+        """Execute a ``copy_volume_data`` record. Replay-safe: the
+        copy-complete marker proves a crash-interrupted run already moved
+        the data, so adoption never re-clobbers a volume a workload may
+        have started writing to."""
+        p = rec.params
+        with self._hold(p["base"]):
+            if self.wq.marker_done(rec.task_id):
+                return
+            try:
+                src = self.runtime.volume_data_dir(p["copyFrom"])
+                dst = self.runtime.volume_data_dir(p["newName"])
+            except errors.VolumeNotExist:
+                # source or replacement gone (family deleted, rollback):
+                # the record is obsolete
+                log.info("volume copy %s -> %s is obsolete; skipping",
+                         p["copyFrom"], p["newName"])
+                return
+            log.info("copying volume data %s -> %s (%s -> %s)",
+                     p["copyFrom"], p["newName"], src, dst)
+            self.wq.copy_dirs(src, dst)
+            self.wq.mark_done(rec.task_id)
 
     # -- info (GET /volumes/{name}; reference GetVolumeInfo :189-199) -------------
 

@@ -10,5 +10,6 @@ from tpu_docker_api.state.workqueue import (  # noqa: F401
     DelKeyTask,
     FnTask,
     PutKVTask,
+    TaskRecord,
     WorkQueue,
 )

@@ -96,6 +96,27 @@ VERSIONS_JOB_KEY = f"{PREFIX}/versions/jobs"
 HOSTS_CORDONED_KEY = f"{PREFIX}/scheduler/hosts/cordoned"
 
 
+# -- durable work-queue journal (state/workqueue.py) ---------------------------
+#: every async task is journaled here as a declarative record (kind + JSON
+#: params) keyed by a zero-padded submit sequence, so replay after a crash
+#: preserves submit order. Lifecycle rides the record's ``state`` field
+#: (pending → inflight → dead); successful tasks delete their key (done).
+QUEUE_PREFIX = f"{PREFIX}/queue"
+QUEUE_TASKS_PREFIX = f"{PREFIX}/queue/tasks/"
+#: per-task side-effect markers (e.g. copy-complete): written BEFORE the
+#: follow-up action so a replayed task can prove its non-idempotent step
+#: already ran and must not re-apply; deleted together with the record
+QUEUE_MARKERS_PREFIX = f"{PREFIX}/queue/markers/"
+
+
+def queue_task_key(seq: int) -> str:
+    return f"{QUEUE_TASKS_PREFIX}{seq:012d}"
+
+
+def queue_marker_key(task_id: str) -> str:
+    return f"{QUEUE_MARKERS_PREFIX}{task_id}"
+
+
 def host_chips_key(host_id: str) -> str:
     """Per-host chip-scheduler state for multi-host pods (each host's
     ChipScheduler persists independently)."""

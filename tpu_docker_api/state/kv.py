@@ -18,6 +18,7 @@ import abc
 import base64
 import sqlite3
 import threading
+import time
 
 from tpu_docker_api import errors
 
@@ -85,14 +86,22 @@ class SqliteKV(KV):
 
     Unlike the reference — which flushes scheduler/version state only on
     graceful Stop (SURVEY.md §3.1) — every ``put`` here commits, so a hard
-    crash loses nothing.
+    crash loses nothing. A busy timeout bounds lock waits: a foreign
+    process holding the database (backup tooling, a second daemon by
+    mistake) makes ops block up to ``busy_timeout_s`` and then fail,
+    instead of raising ``database is locked`` instantly or hanging.
     """
 
-    def __init__(self, path: str) -> None:
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+    BUSY_TIMEOUT_S = 5.0
+
+    def __init__(self, path: str, busy_timeout_s: float = BUSY_TIMEOUT_S) -> None:
+        self._conn = sqlite3.connect(
+            path, timeout=busy_timeout_s, check_same_thread=False
+        )
         self._mu = threading.Lock()
         with self._mu:
             self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_s * 1000)}")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT NOT NULL)"
             )
@@ -138,32 +147,70 @@ class EtcdKV(KV):
     The reference dials etcd gRPC with a 2 s blocking connect and 1 s per-op
     timeout (etcd/client.go:14-23, common.go:31); we keep the same budgets on
     HTTP. Keys/values are base64 on the wire per the gateway contract.
+
+    Store-outage tolerance (docs/robustness.md "Durable work queue"): every
+    connection-class failure (refused/reset/timeout) is normalized to
+    :class:`errors.StoreUnavailable` — the KV analog of the host layer's
+    ``HostUnreachable`` — so callers classify store-path failures with one
+    except clause instead of matching ``requests`` internals. Idempotent
+    READS (``get``/``range_prefix``) additionally retry up to
+    ``retry_attempts`` times with capped exponential backoff before giving
+    up; writes are normalized but never retried here (the work queue owns
+    write retry policy, and a blind double-put hides real outages).
     """
 
     DIAL_TIMEOUT_S = 2.0
     OP_TIMEOUT_S = 1.0
+    RETRY_ATTEMPTS = 3
+    RETRY_BASE_S = 0.05
+    RETRY_MAX_S = 1.0
 
-    def __init__(self, addr: str) -> None:
+    def __init__(self, addr: str, retry_attempts: int = RETRY_ATTEMPTS,
+                 retry_base_s: float = RETRY_BASE_S,
+                 retry_max_s: float = RETRY_MAX_S) -> None:
         import requests  # lazy: hermetic paths never import it
 
+        self._requests = requests
         self._addr = addr.rstrip("/")
         self._session = requests.Session()
+        self._retry_attempts = max(1, retry_attempts)
+        self._retry_base_s = retry_base_s
+        self._retry_max_s = retry_max_s
         # fail fast if unreachable, like the reference's blocking dial
+        # (no retry: a daemon pointed at a dead store must error at boot,
+        # not spin through a backoff schedule before reporting it)
         self._post("/v3/kv/range", {"key": _b64("probe"), "limit": 1},
                    timeout=self.DIAL_TIMEOUT_S)
 
-    def _post(self, path: str, body: dict, timeout: float | None = None) -> dict:
-        r = self._session.post(
-            self._addr + path, json=body, timeout=timeout or self.OP_TIMEOUT_S
-        )
-        r.raise_for_status()
-        return r.json()
+    def _post(self, path: str, body: dict, timeout: float | None = None,
+              idempotent: bool = False) -> dict:
+        from tpu_docker_api.utils.backoff import backoff_delay_s
+
+        attempts = self._retry_attempts if idempotent else 1
+        last: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                r = self._session.post(
+                    self._addr + path, json=body,
+                    timeout=timeout or self.OP_TIMEOUT_S,
+                )
+                r.raise_for_status()
+                return r.json()
+            except (self._requests.ConnectionError,
+                    self._requests.Timeout) as e:
+                last = e
+                if attempt + 1 < attempts:
+                    time.sleep(backoff_delay_s(
+                        attempt, self._retry_base_s, self._retry_max_s))
+        raise errors.StoreUnavailable(
+            f"etcd {self._addr}{path}: {type(last).__name__}: {last}"
+        ) from last
 
     def put(self, key: str, value: str) -> None:
         self._post("/v3/kv/put", {"key": _b64(key), "value": _b64(value)})
 
     def get(self, key: str) -> str:
-        resp = self._post("/v3/kv/range", {"key": _b64(key)})
+        resp = self._post("/v3/kv/range", {"key": _b64(key)}, idempotent=True)
         kvs = resp.get("kvs", [])
         if not kvs:
             raise errors.NotExistInStore(key)
@@ -176,6 +223,7 @@ class EtcdKV(KV):
         resp = self._post(
             "/v3/kv/range",
             {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
+            idempotent=True,
         )
         out = {_unb64_key(kv["key"]): _unb64(kv["value"])
                for kv in resp.get("kvs", [])}
@@ -224,12 +272,17 @@ def _prefix_end(prefix: str) -> str:
     return "\0"  # prefix was all 0xff: scan everything
 
 
-def open_store(backend: str, *, etcd_addr: str = "", sqlite_path: str = "") -> KV:
-    """Open a KV backend by name (config.store_backend)."""
+def open_store(backend: str, *, etcd_addr: str = "", sqlite_path: str = "",
+               retry_attempts: int = EtcdKV.RETRY_ATTEMPTS,
+               retry_base_s: float = EtcdKV.RETRY_BASE_S,
+               retry_max_s: float = EtcdKV.RETRY_MAX_S) -> KV:
+    """Open a KV backend by name (config.store_backend); ``retry_*`` maps
+    from the ``store_retry_*`` config keys (etcd idempotent-read retry)."""
     if backend == "memory":
         return MemoryKV()
     if backend == "sqlite":
         return SqliteKV(sqlite_path)
     if backend == "etcd":
-        return EtcdKV(etcd_addr)
+        return EtcdKV(etcd_addr, retry_attempts=retry_attempts,
+                      retry_base_s=retry_base_s, retry_max_s=retry_max_s)
     raise ValueError(f"unknown store backend {backend!r}")
