@@ -122,6 +122,180 @@ def measure_control_plane(iters: int = 100, runtime: str = "fake") -> dict:
     }
 
 
+def measure_control_plane_churn(n_containers: int = 1000,
+                                n_gangs: int = 100) -> dict:
+    """Control-plane churn family (``--control-plane --cp-family churn``):
+    create→ready→replace→delete for ``n_containers`` containers and
+    ``n_gangs`` 4-host gangs through the full HTTP stack on the fake
+    runtime, with the daemon's store wrapped in a ``CountingKV`` so every
+    flow reports **store round trips** next to its latency quantiles.
+
+    The audit phase then re-drives one instrumented iteration of each flow
+    (work queue drained between snapshots, via the UNCOUNTED inner KV so
+    the polling never pollutes the deltas) and self-gates the tentpole
+    invariants: container create stays ≤ 3 atomic ``apply`` batches, and a
+    gang's apply count is O(1) in its member count (a 4-host gang costs
+    exactly what a 2-host gang costs). A violated gate flips
+    ``gates.ok`` — main() turns that into a nonzero exit, so "batched"
+    stays a measured invariant, not an adjective."""
+    import statistics
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.state import keys
+    from tpu_docker_api.state.kv import CountingKV, MemoryKV
+    from tpu_docker_api.state.workqueue import queue_depth
+
+    if min(n_containers, n_gangs) < 2:
+        raise ValueError("churn needs >= 2 iterations per flow for quantiles")
+    counting = CountingKV(MemoryKV())
+    prog = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=42000, end_port=43999, health_watch_interval=0,
+        pod_hosts=(
+            [{"host_id": "h0", "address": "10.0.0.1",
+              "grid_coord": [0, 0, 0], "local": True}]
+            + [{"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+                "grid_coord": [i, 0, 0], "runtime_backend": "fake"}
+               for i in range(1, 4)]
+        ),
+    ), host="127.0.0.1", kv=counting)
+    prog.init()
+    prog.start()
+    chips_per_host = prog.pod.chips_per_host
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def drain(timeout_s: float = 10.0):
+        """Wait for the async tail (copy/purge records) of the previous
+        flow: queue empty AND journal empty. Polls the inner KV directly —
+        the drain reads must never show up in a flow's counted delta."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if (queue_depth(prog.wq) == 0
+                    and not counting.inner.range_prefix(
+                        keys.QUEUE_TASKS_PREFIX)):
+                return
+            time.sleep(0.002)
+        raise RuntimeError("work queue failed to drain within budget")
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return (time.perf_counter() - t0) * 1e3
+
+    def container_cycle(name: str) -> tuple[float, float, float]:
+        t_create = timed(lambda: call("POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": name, "chipCount": 4,
+            "containerPorts": [{"containerPort": 8080}]}))
+        info = call("GET", f"/api/v1/containers/{name}-0")
+        if not (info["data"]["runtime"] or {}).get("running"):
+            raise RuntimeError(f"{name}-0 not running after create")
+        t_replace = timed(lambda: call(
+            "PATCH", f"/api/v1/containers/{name}-0/tpu", {"chipCount": 2}))
+        t_delete = timed(lambda: call("DELETE", f"/api/v1/containers/{name}", {
+            "force": True, "delEtcdInfoAndVersionRecord": True}))
+        return t_create, t_replace, t_delete
+
+    def gang_cycle(name: str, hosts: int) -> tuple[float, float]:
+        t_create = timed(lambda: call("POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": name,
+            "chipCount": chips_per_host * hosts}))
+        info = call("GET", f"/api/v1/jobs/{name}")
+        if info["data"].get("phase") not in ("running",):
+            raise RuntimeError(f"gang {name} not running: {info['data']}")
+        t_delete = timed(lambda: call("DELETE", f"/api/v1/jobs/{name}", {
+            "force": True, "delStateAndVersionRecord": True}))
+        return t_create, t_delete
+
+    def quantiles(ms: list[float]) -> dict:
+        # exclusive-method quantiles extrapolate past the sample extremes at
+        # small n; clamp so p95 ≤ max always holds in the artifact
+        qs = statistics.quantiles(ms, n=20)
+        return {"p50": round(statistics.median(ms), 3),
+                "p95": round(min(qs[18], max(ms)), 3),
+                "max": round(max(ms), 3)}
+
+    def audit(fn) -> dict:
+        drain()
+        before = counting.snapshot()
+        fn()
+        drain()
+        return CountingKV.delta(before, counting.snapshot())
+
+    c_lat: dict[str, list[float]] = {"create": [], "replace": [], "delete": []}
+    g_lat: dict[str, list[float]] = {"create": [], "delete": []}
+    try:
+        for i in range(n_containers):
+            cr, rp, dl = container_cycle(f"churn{i}")
+            c_lat["create"].append(cr)
+            c_lat["replace"].append(rp)
+            c_lat["delete"].append(dl)
+        for i in range(n_gangs):
+            cr, dl = gang_cycle(f"gang{i}", hosts=4)
+            g_lat["create"].append(cr)
+            g_lat["delete"].append(dl)
+
+        # round-trip audit: one quiesced iteration per flow
+        rt: dict[str, dict] = {}
+        rt["container_create"] = audit(lambda: call(
+            "POST", "/api/v1/containers",
+            {"imageName": "jax", "containerName": "audit", "chipCount": 4,
+             "containerPorts": [{"containerPort": 8080}]}))
+        rt["container_replace"] = audit(lambda: call(
+            "PATCH", "/api/v1/containers/audit-0/tpu", {"chipCount": 2}))
+        rt["container_delete"] = audit(lambda: call(
+            "DELETE", "/api/v1/containers/audit",
+            {"force": True, "delEtcdInfoAndVersionRecord": True}))
+        for hosts in (2, 4):
+            rt[f"gang_create_{hosts}host"] = audit(lambda: call(
+                "POST", "/api/v1/jobs",
+                {"imageName": "jax", "jobName": f"audit{hosts}",
+                 "chipCount": chips_per_host * hosts}))
+            rt[f"gang_delete_{hosts}host"] = audit(lambda: call(
+                "DELETE", f"/api/v1/jobs/audit{hosts}",
+                {"force": True, "delStateAndVersionRecord": True}))
+    finally:
+        prog.stop()
+
+    create_applies = rt["container_create"].get("apply", 0)
+    gang_applies = rt["gang_create_4host"].get("apply", 0)
+    # >= 1 keeps the gate honest: a write path that stopped routing
+    # through the counted apply at all must FAIL, not pass vacuously
+    gang_o1 = (gang_applies >= 1
+               and rt["gang_create_2host"].get("apply", 0) == gang_applies)
+    return {
+        "family": "churn",
+        "iters": {"containers": n_containers, "gangs": n_gangs},
+        "create_ready_ms_p50": quantiles(c_lat["create"])["p50"],
+        "containers": {f"{flow}_ms_{q}": v
+                       for flow, ms in c_lat.items()
+                       for q, v in quantiles(ms).items()},
+        "gangs": dict(
+            {f"{flow}_ms_{q}": v
+             for flow, ms in g_lat.items()
+             for q, v in quantiles(ms).items()},
+            members=4),
+        "round_trips": rt,
+        "gates": {
+            "container_create_applies": create_applies,
+            "container_create_applies_max": 3,
+            "gang_apply_o1_in_members": gang_o1,
+            "ok": bool(1 <= create_applies <= 3 and gang_o1),
+        },
+    }
+
+
 def main() -> int | None:
     """Returns a nonzero exit code on backend-init failure (consumed by
     the ``sys.exit(main())`` entry); None = success."""
@@ -133,10 +307,20 @@ def main() -> int | None:
     parser.add_argument("--warmup", type=int, default=2)
     parser.add_argument("--platform", default="", help="force jax platform")
     parser.add_argument("--control-plane", action="store_true",
-                        help="bench create→ready latency only")
+                        help="bench the control plane only (no JAX)")
     parser.add_argument("--cp-runtime", default="fake",
                         choices=["fake", "docker"])
-    parser.add_argument("--cp-iters", type=int, default=100)
+    parser.add_argument("--cp-family", default="create",
+                        choices=["create", "churn"],
+                        help="create = create→ready latency; churn = "
+                             "create→ready→replace→delete for containers "
+                             "AND gangs with store round-trips per flow")
+    parser.add_argument("--cp-iters", type=int, default=100,
+                        help="iterations (create family) / container "
+                             "cycles (churn family)")
+    parser.add_argument("--churn-gangs", type=int, default=0,
+                        help="gang cycles for the churn family; 0 = "
+                             "cp-iters // 10 (min 2)")
     parser.add_argument("--full", action="store_true",
                         help="also run the long-tail riders (the second "
                              "stream-count per serving point, unfused "
@@ -154,9 +338,26 @@ def main() -> int | None:
     deadline = time.monotonic() + budget_s
 
     if args.control_plane:
-        cp = measure_control_plane(args.cp_iters, args.cp_runtime)
+        # loud-failure contract (same as bench_boot): a dead control-plane
+        # probe must exit nonzero with a structured line, never silently
+        # produce an empty artifact the driver reads as "pass"
+        try:
+            if args.cp_family == "churn":
+                cp = measure_control_plane_churn(
+                    args.cp_iters,
+                    args.churn_gangs or max(args.cp_iters // 10, 2))
+            else:
+                cp = measure_control_plane(args.cp_iters, args.cp_runtime)
+        except Exception as e:
+            emit({"metric": f"control_plane_{args.cp_family}", "value": None,
+                  "unit": "ms", "vs_baseline": None, "rc": 1,
+                  "error": {"error": f"{type(e).__name__}: {str(e)[:300]}",
+                            "family": args.cp_family}})
+            return 1
         emit({
-            "metric": "container_create_ready_ms_p50",
+            "metric": ("control_plane_churn_create_ready_ms_p50"
+                       if args.cp_family == "churn"
+                       else "container_create_ready_ms_p50"),
             "value": cp["create_ready_ms_p50"],
             "unit": "ms",
             # the reference publishes no latency numbers (BASELINE.md) —
@@ -164,6 +365,13 @@ def main() -> int | None:
             "vs_baseline": 1.0,
             "extra": cp,
         })
+        if not cp.get("gates", {"ok": True})["ok"]:
+            emit({"metric": "control_plane_churn_gate", "value": 0,
+                  "unit": "bool", "vs_baseline": 0.0, "rc": 1,
+                  "error": {"error": f"regression gate failed: "
+                                     f"{cp['gates']}",
+                            "family": args.cp_family}})
+            return 1
         return
 
     # first line of every run: a schema-valid diagnostic emitted BEFORE any
@@ -278,8 +486,15 @@ def main() -> int | None:
     # so the driver's BENCH artifact always records it
     try:
         result["extra"]["control_plane"] = measure_control_plane(50)
-    except Exception as e:  # never let the latency rider sink the headline
-        result["extra"]["control_plane"] = {"error": str(e)}
+    except Exception as e:  # never let the latency rider sink the headline,
+        # but never let its death pass silently either: structured error in
+        # extra AND a dedicated nonzero-signal line (the bench_boot
+        # loud-failure contract) so the driver sees the dead probe
+        cp_err = {"error": f"{type(e).__name__}: {str(e)[:300]}",
+                  "family": "create"}
+        result["extra"]["control_plane"] = cp_err
+        emit({"metric": "control_plane_create", "value": None, "unit": "ms",
+              "vs_baseline": None, "rc": 1, "error": cp_err})
     # headline FIRST — durable before any rider runs (VERDICT r4 item 1)
     emit(result)
 

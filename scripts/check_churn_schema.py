@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Validate the churn-family BENCH artifact (``make bench-churn``).
+
+Reads JSON lines from stdin (or a file argument) and asserts the schema the
+driver-side BENCH pipeline consumes: every line carries the
+{metric, value, unit, vs_baseline} envelope, and the churn headline carries
+latency quantiles, per-flow store round trips, and a passing regression
+gate. Exit 0 = consumable artifact, nonzero = a structural problem printed
+one-per-line (the same loud-failure contract as bench_boot).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+ENVELOPE = ("metric", "value", "unit", "vs_baseline")
+CONTAINER_FLOWS = ("create", "replace", "delete")
+GANG_FLOWS = ("create", "delete")
+QUANTS = ("p50", "p95", "max")
+ROUND_TRIP_FLOWS = ("container_create", "container_replace",
+                    "container_delete", "gang_create_2host",
+                    "gang_create_4host", "gang_delete_2host",
+                    "gang_delete_4host")
+
+
+def validate_lines(lines: list[dict]) -> list[str]:
+    """Return every schema violation found (empty = consumable)."""
+    problems: list[str] = []
+    if not lines:
+        return ["no JSON lines emitted (empty artifact)"]
+    for i, line in enumerate(lines):
+        missing = [k for k in ENVELOPE if k not in line]
+        if missing:
+            problems.append(f"line {i}: missing envelope keys {missing}")
+    churn = [ln for ln in lines
+             if (ln.get("extra") or {}).get("family") == "churn"]
+    if not churn:
+        return problems + ["no churn headline line (extra.family == churn)"]
+    extra = churn[0]["extra"]
+
+    def num(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    if not num(extra.get("create_ready_ms_p50")):
+        problems.append("churn: create_ready_ms_p50 is not a number")
+    for group, flows in (("containers", CONTAINER_FLOWS), ("gangs", GANG_FLOWS)):
+        stats = extra.get(group) or {}
+        for flow in flows:
+            for q in QUANTS:
+                if not num(stats.get(f"{flow}_ms_{q}")):
+                    problems.append(f"churn: {group}.{flow}_ms_{q} missing")
+    rt = extra.get("round_trips") or {}
+    for flow in ROUND_TRIP_FLOWS:
+        counts = rt.get(flow)
+        if not isinstance(counts, dict) or not counts:
+            problems.append(f"churn: round_trips.{flow} missing or empty")
+        elif not all(isinstance(v, int) and v > 0 for v in counts.values()):
+            problems.append(f"churn: round_trips.{flow} has non-positive "
+                            f"counts: {counts}")
+    gates = extra.get("gates") or {}
+    for key in ("container_create_applies", "container_create_applies_max",
+                "gang_apply_o1_in_members", "ok"):
+        if key not in gates:
+            problems.append(f"churn: gates.{key} missing")
+    if gates.get("ok") is not True:
+        problems.append(f"churn: regression gate failed: {gates}")
+    return problems
+
+
+def main() -> int:
+    src = open(sys.argv[1]) if len(sys.argv) > 1 else sys.stdin
+    try:
+        raw = [ln for ln in src.read().splitlines() if ln.strip()]
+    finally:
+        if src is not sys.stdin:
+            src.close()
+    lines = []
+    for i, ln in enumerate(raw):
+        try:
+            lines.append(json.loads(ln))
+        except json.JSONDecodeError as e:
+            print(f"check_churn_schema: line {i} is not JSON: {e}")
+            return 1
+    problems = validate_lines(lines)
+    for p in problems:
+        print(f"check_churn_schema: {p}")
+    if problems:
+        return 1
+    print(f"check_churn_schema: OK ({len(lines)} lines, gates pass)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,51 @@ def test_default_plan_covers_verdict_done_set():
     assert all(est > 0 for _, est, _ in bench.riders(full=True))
 
 
+class TestChurnFamily:
+    """The control-plane churn family (``make bench-churn``): runs green on
+    the fake runtime at tiny scale and emits exactly the schema the driver
+    pipeline (scripts/check_churn_schema.py) consumes."""
+
+    @pytest.fixture(scope="class")
+    def churn(self):
+        return bench.measure_control_plane_churn(n_containers=3, n_gangs=2)
+
+    def test_schema_checker_accepts_the_emitted_line(self, churn):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_churn_create_ready_ms_p50",
+                "value": churn["create_ready_ms_p50"], "unit": "ms",
+                "vs_baseline": 1.0, "extra": churn}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        del bad["extra"]["round_trips"]["gang_create_4host"]
+        assert any("gang_create_4host" in p for p in validate_lines([bad]))
+
+    def test_round_trip_gates_hold(self, churn):
+        """The tentpole invariants, pinned in tier-1 at tiny scale:
+        container create stays within 3 atomic applies and a gang's apply
+        count is O(1) in its member count."""
+        gates = churn["gates"]
+        assert gates["ok"] is True
+        assert 1 <= gates["container_create_applies"] <= 3
+        assert gates["gang_apply_o1_in_members"] is True
+        rt = churn["round_trips"]
+        assert (rt["gang_create_2host"]["apply"]
+                == rt["gang_create_4host"]["apply"] >= 1)
+        # quantiles are internally consistent
+        stats = churn["containers"]
+        for flow in ("create", "replace", "delete"):
+            assert (stats[f"{flow}_ms_p50"] <= stats[f"{flow}_ms_p95"]
+                    <= stats[f"{flow}_ms_max"])
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

@@ -37,6 +37,7 @@ from tpu_docker_api.service.crashpoints import (
     JOB_CRASH_POINTS,
     KNOWN_CRASH_POINTS,
     QUEUE_CRASH_POINTS,
+    TXN_CRASH_POINTS,
     SimulatedCrash,
     armed,
 )
@@ -109,8 +110,12 @@ def test_case_matrix_covers_every_crash_point():
     # the durable-queue matrix drives BOTH flows (data copy + drain)
     # through every queue lifecycle point
     assert set(QUEUE_CRASH_POINTS) == set(QUEUE_POINTS)
+    # the txn matrix crashes three write flows on both sides of every
+    # KV.apply commit they perform
+    assert {p for _, p in TXN_CASES} == set(TXN_CRASH_POINTS)
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
-            | set(QUEUE_CRASH_POINTS) == set(KNOWN_CRASH_POINTS))
+            | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
+            == set(KNOWN_CRASH_POINTS))
 
 
 def _mutations(runtime: FakeRuntime) -> list:
@@ -937,3 +942,86 @@ class TestAmbiguousEngineFailures:
         assert check_invariants(
             runtime, prg.store, prg.container_versions,
             prg.chip_scheduler, prg.port_scheduler) == []
+
+
+#: txn-boundary chaos: the KV.apply commit is where every batched version
+#: transition becomes durable, so each flow is crashed at EVERY apply it
+#: performs (skip=k targets the k-th), on both sides of the boundary
+TXN_FLOWS = ("container-create", "rolling-replace", "gang-create")
+TXN_CASES = [(f, p) for f in TXN_FLOWS for p in TXN_CRASH_POINTS]
+
+
+@pytest.mark.parametrize("flow,point", TXN_CASES,
+                         ids=[f"{f}@{p}" for f, p in TXN_CASES])
+def test_txn_boundary_crash_converges(tmp_path, flow, point):
+    """Both halves of the batch contract, at every commit a flow makes:
+    a crash BEFORE the apply leaves the whole batch unwritten (nothing to
+    leak), a crash AFTER leaves it fully written (and the reconciler
+    finishes the flow forward). skip=k walks the crash across the flow's
+    k-th apply; the loop ends when the flow completes crash-free (k is
+    past the flow's last commit)."""
+    crashes = 0
+    for k in range(16):
+        kv = MemoryKV()
+        if flow == "gang-create":
+            rt0, rt1 = FakeRuntime(), FakeRuntime()
+            prg = boot_pod(kv, rt0, rt1)
+            mutate = lambda: prg.job_svc.run_job(JobRun(
+                image_name="jax", job_name="train", chip_count=16))
+        else:
+            runtime = FakeRuntime(root=str(tmp_path / f"rt-{point}-{k}"))
+            prg = boot(kv, runtime)
+            if flow == "rolling-replace":
+                setup_family(prg, tmp_path)
+                mutate = lambda: _grow(prg.container_svc)
+            else:
+                mutate = lambda: prg.container_svc.run_container(
+                    ContainerRun(image_name="jax", container_name="web",
+                                 chip_count=2))
+        try:
+            with armed(point, skip=k):
+                mutate()
+            break  # k is past the flow's last apply: matrix exhausted
+        except SimulatedCrash:
+            crashes += 1
+
+        # the daemon died mid-flow; a fresh one repairs over the same state
+        if flow == "gang-create":
+            prg2 = boot_pod(kv, rt0, rt1)
+            prg2.reconciler.reconcile()
+            problems = _job_oracle(prg2)
+        else:
+            prg2 = boot(kv, runtime)
+            prg2.reconciler.reconcile()
+            problems = check_invariants(
+                runtime, prg2.store, prg2.container_versions,
+                prg2.chip_scheduler, prg2.port_scheduler)
+        assert problems == [], f"{flow}@{point} skip={k}: {problems}"
+        # the repair is a fixpoint
+        assert prg2.reconciler.reconcile()["actions"] == []
+    else:
+        pytest.fail(f"{flow} never completed within 16 applies")
+    assert crashes >= 1, f"{flow} performed no KV.apply at all"
+
+
+def test_txn_before_apply_leaves_batch_unwritten(tmp_path):
+    """The pre-commit half of the contract, asserted directly on the store:
+    dying at txn.before_apply of container-create's FIRST apply (the claim
+    txn) must leave no spec and no claim durable — only the version-pointer
+    bump, which the reconciler scrubs."""
+    kv = MemoryKV()
+    runtime = FakeRuntime(root=str(tmp_path / "rt"))
+    prg = boot(kv, runtime)
+    with armed("txn.before_apply"):
+        with pytest.raises(SimulatedCrash):
+            prg.container_svc.run_container(ContainerRun(
+                image_name="jax", container_name="web", chip_count=2))
+    from tpu_docker_api.state import keys
+    assert kv.range_prefix(keys.family_prefix(keys.Resource.CONTAINERS,
+                                              "web")) == {}
+    assert "web" not in (kv.get_or(keys.SCHEDULER_CHIPS_KEY) or "{}")
+    prg2 = boot(kv, runtime)
+    prg2.reconciler.reconcile()
+    assert check_invariants(
+        runtime, prg2.store, prg2.container_versions,
+        prg2.chip_scheduler, prg2.port_scheduler) == []

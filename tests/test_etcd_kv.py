@@ -48,6 +48,8 @@ class _FakeGateway(BaseHTTPRequestHandler):
     def _do_POST(self):
         length = int(self.headers.get("Content-Length") or 0)
         body = json.loads(self.rfile.read(length))
+        if self.path == "/v3/kv/txn":
+            return self._do_txn(body)
         key = base64.b64decode(body["key"])
         range_end = (base64.b64decode(body["range_end"])
                      if "range_end" in body else None)
@@ -82,6 +84,52 @@ class _FakeGateway(BaseHTTPRequestHandler):
             return self._reply({"header": {}, "deleted": str(len(doomed))})
         self.send_error(404)
 
+    def _do_txn(self, body: dict):
+        """No-compare txn: the success branch always commits, atomically —
+        staged against a copy so a rejected batch changes nothing. Enforces
+        etcd's duplicate-key rule (server txn.go checkIntervals: a put may
+        not overlap another put or a delete range in the same branch), so a
+        production batch the real server would reject fails here too."""
+        self.server.txn_count += 1
+
+        def covers(k: bytes, key: bytes, range_end: bytes | None) -> bool:
+            if range_end is None:
+                return k == key
+            if range_end == b"\0":   # etcd sentinel: all keys >= key
+                return k >= key
+            return key <= k < range_end
+
+        staged = dict(self.store)
+        put_keys: set[bytes] = set()
+        del_ranges: list[tuple[bytes, bytes | None]] = []
+        for req in body.get("success", []):
+            if "requestPut" in req:
+                put = req["requestPut"]
+                k = base64.b64decode(put["key"])
+                if k in put_keys:
+                    return self.send_error(
+                        400, "duplicate key given in txn request")
+                put_keys.add(k)
+                staged[k] = base64.b64decode(put["value"])
+            elif "requestDeleteRange" in req:
+                dr = req["requestDeleteRange"]
+                key = base64.b64decode(dr["key"])
+                range_end = (base64.b64decode(dr["range_end"])
+                             if "range_end" in dr else None)
+                del_ranges.append((key, range_end))
+                for k in list(staged):
+                    if covers(k, key, range_end):
+                        del staged[k]
+            else:
+                return self.send_error(400)
+        for k in put_keys:
+            if any(covers(k, key, end) for key, end in del_ranges):
+                return self.send_error(
+                    400, "duplicate key given in txn request")
+        self.store.clear()
+        self.store.update(staged)
+        return self._reply({"header": {}, "succeeded": True})
+
     def _reply(self, payload: dict):
         data = json.dumps(payload).encode()
         self.send_response(200)
@@ -97,6 +145,7 @@ def gateway():
     server.store = {}
     server.fail_next = 0
     server.fail_seen = 0
+    server.txn_count = 0
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     try:
@@ -253,6 +302,49 @@ class TestStoreOutageNormalization:
             kv.get("/absent")
 
 
+class TestEtcdTxn:
+    """``KV.apply`` on etcd: one native ``/v3/kv/txn`` per batch (the
+    tentpole's round-trip collapse), riding the write path's
+    normalize-but-never-retry rule."""
+
+    def test_apply_is_one_native_txn(self, kv, gateway):
+        kv.put("/f/old", "x")
+        kv.put("/p/a", "1")
+        kv.put("/p/b", "2")
+        kv.apply([
+            ("put", "/f/v/0", "spec"), ("put", "/f/latest", "0"),
+            ("delete", "/f/old"), ("delete_prefix", "/p/"),
+        ])
+        assert gateway.txn_count == 1  # the whole batch = ONE round trip
+        assert kv.get("/f/v/0") == "spec"
+        assert kv.get("/f/latest") == "0"
+        assert kv.get_or("/f/old") is None
+        assert kv.range_prefix("/p/") == {}
+
+    def test_apply_matches_memory_kv_semantics(self, kv):
+        mem = MemoryKV()
+        for target in (kv, mem):
+            target.put("/c/a/0", "1")
+            target.put("/c/b/0", "2")
+            target.apply([("put", "/c/a/1", "3"), ("delete", "/c/a/0"),
+                          ("delete_prefix", "/c/b/")])
+        assert kv.range_prefix("/c/") == mem.range_prefix("/c/")
+
+    def test_txn_outage_normalized_never_retried(self, gateway):
+        """A txn is a WRITE: connection faults normalize to the typed
+        StoreUnavailable after exactly ONE attempt — a blind re-apply
+        after an ambiguous timeout could double-commit a batch whose
+        first attempt landed."""
+        kv = EtcdKV(f"http://127.0.0.1:{gateway.server_address[1]}",
+                    retry_attempts=3, retry_base_s=0.001, retry_max_s=0.01)
+        gateway.fail_next = 1
+        with pytest.raises(errors.StoreUnavailable):
+            kv.apply([("put", "/w", "1")])
+        assert gateway.fail_seen == 1  # no retry despite the read budget
+        assert gateway.fail_next == 0
+        assert kv.get_or("/w") is None
+
+
 ETCD_ADDR = os.environ.get("ETCD_ADDR", "")
 
 
@@ -272,6 +364,10 @@ class TestRealEtcd:
             kv.delete_prefix(f"{pfx}/a/")
             assert kv.range_prefix(f"{pfx}/a/") == {}
             assert kv.get(f"{pfx}/b") == "3"
+            kv.apply([("put", f"{pfx}/t/0", "x"), ("delete", f"{pfx}/b")])
+            assert kv.range_prefix(pfx) == {f"{pfx}/t/0": "x"}
+            kv.apply([("delete_prefix", f"{pfx}/t/")])
+            assert kv.range_prefix(pfx) == {}
         finally:
             kv.delete_prefix(pfx)
 

@@ -18,8 +18,9 @@ from tpu_docker_api.scheduler.topology import GENERATIONS, HostTopology
 from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun
 from tpu_docker_api.service.job import JobService
 from tpu_docker_api.state import keys
-from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.kv import CountingKV, MemoryKV
 from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.txn import StoreTxn
 from tpu_docker_api.state.version import VersionMap
 
 
@@ -142,6 +143,63 @@ class TestPodScheduler:
         assert st["freeHosts"] == 6
         assert st["globalMeshShape"] == [4, 4, 2]
         assert "j-1" in st["slices"]
+
+
+class TestGangClaims:
+    """PodScheduler.apply_slices — the gang-level all-or-nothing claim the
+    job flows commit through (one lock hold, one persist / one deferred
+    StoreTxn commit for the WHOLE gang)."""
+
+    def test_whole_gang_is_one_apply(self):
+        kv = CountingKV(MemoryKV())
+        pod = make_pod(kv)
+        sched = PodScheduler(pod, kv)
+        txn = StoreTxn(kv)
+        base = kv.snapshot()
+        grants = sched.apply_slices([(f"g#{k}", 8, "") for k in range(4)],
+                                    txn=txn)
+        assert [len(g.hosts) for g in grants] == [2, 2, 2, 2]
+        # nothing written yet: every participant deferred into the txn
+        assert CountingKV.delta(base, kv.snapshot()) == {}
+        txn.commit()
+        # slice registry + all 8 host chip maps = ONE store round trip
+        assert CountingKV.delta(base, kv.snapshot()) == {"apply": 1}
+        # and the commit is durable: a restarted scheduler sees every grant
+        sched2 = PodScheduler(make_pod(kv), kv)
+        assert all(sched2.get_grant(f"g#{k}") is not None for k in range(4))
+
+    def test_infeasible_member_releases_whole_gang(self, pod, sched):
+        # 3×16 chips > the pod's 32: the third member cannot place
+        with pytest.raises(errors.ChipNotEnough):
+            sched.apply_slices([("g#0", 16, ""), ("g#1", 16, ""),
+                                ("g#2", 16, "")])
+        for k in range(3):
+            assert sched.get_grant(f"g#{k}") is None
+        # every chip of the unwound members is allocatable again
+        grant = sched.apply_slice(n_chips=32, owner="whole")
+        assert grant.n_chips == 32
+
+    def test_duplicate_owner_mid_batch_releases_earlier_members(self, sched):
+        sched.apply_slice(n_chips=4, owner="taken")
+        with pytest.raises(errors.ContainerExisted):
+            sched.apply_slices([("fresh", 4, ""), ("taken", 4, "")])
+        assert sched.get_grant("fresh") is None
+        # 'fresh' was fully unwound: the same owner can claim again
+        assert sched.apply_slice(n_chips=4, owner="fresh").n_chips == 4
+
+    def test_txn_failure_persists_nothing(self):
+        kv = MemoryKV()
+        pod = make_pod(kv)
+        sched = PodScheduler(pod, kv)
+        txn = StoreTxn(kv)
+        with pytest.raises(errors.ChipNotEnough):
+            sched.apply_slices([("g#0", 16, ""), ("g#1", 16, ""),
+                                ("g#2", 16, "")], txn=txn)
+        # the failed gang never touched the store: a fresh boot sees a
+        # completely clean pod
+        assert kv.range_prefix("") == {}
+        sched2 = PodScheduler(make_pod(kv), kv)
+        assert sched2.apply_slice(n_chips=32, owner="all").n_chips == 32
 
 
 class TestJobService:

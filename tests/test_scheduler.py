@@ -11,7 +11,8 @@ from tpu_docker_api.scheduler.topology import (
     parse_accelerator_type,
     parse_slice_shape,
 )
-from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.kv import CountingKV, MemoryKV
+from tpu_docker_api.state.txn import StoreTxn
 
 
 class TestTopology:
@@ -158,6 +159,95 @@ class TestChipScheduler:
         sched, _ = self.make("v5p-16")  # 8 chips, mesh 2x2x2
         ids, contiguous = sched.apply_chips(0, shape="2x2x2")
         assert contiguous and len(ids) == 8
+
+
+class TestBulkClaims:
+    """Gang-level claim/release primitives: every member of a batch claims
+    all-or-nothing ACROSS the batch, in one lock hold and one persist (or
+    zero, deferred into a StoreTxn) — the scheduler half of the tentpole."""
+
+    def test_chips_bulk_claims_all_members_in_one_persist(self):
+        kv = CountingKV(MemoryKV())
+        sched = ChipScheduler(HostTopology.build("v5e-8"), kv)
+        base = kv.snapshot()
+        assert sched.try_claim_chips_bulk(
+            [("g-0", [0, 1]), ("g-1", [2, 3])]) == []
+        assert CountingKV.delta(base, kv.snapshot()) == {"put": 1}
+        assert len(sched.free_chips) == 4
+
+    def test_chips_bulk_conflict_anywhere_claims_nothing(self):
+        sched = ChipScheduler(HostTopology.build("v5e-8"), MemoryKV())
+        sched.try_claim_chips([3], "taken")
+        conflicts = sched.try_claim_chips_bulk(
+            [("g-0", [0, 1]), ("g-1", [3]), ("g-2", [99])])
+        assert conflicts == [3, 99]
+        # the feasible first member claimed NOTHING
+        assert len(sched.free_chips) == 7
+
+    def test_chips_bulk_cross_owner_duplicate_is_a_conflict(self):
+        """Two members of one batch asking for the SAME free chip must
+        conflict — a double-grant silently resolved by member order would
+        hand one chip to two containers."""
+        sched = ChipScheduler(HostTopology.build("v5e-8"), MemoryKV())
+        assert sched.try_claim_chips_bulk(
+            [("g-0", [3, 4]), ("g-1", [3])]) == [3]
+        assert len(sched.free_chips) == 8  # nothing claimed
+        # same owner re-listing a chip is idempotent, not a conflict
+        assert sched.try_claim_chips_bulk(
+            [("g-0", [3]), ("g-0", [3, 4])]) == []
+
+    def test_ports_bulk_cross_owner_duplicate_is_a_conflict(self):
+        ps = PortScheduler(MemoryKV(), 40000, 40009)
+        assert ps.try_claim_ports_bulk(
+            [("a", [40000]), ("b", [40000, 40001])]) == [40000]
+        assert ps.n_free == 10
+        assert ps.try_claim_ports_bulk(
+            [("a", [40000]), ("a", [40000])]) == []
+
+    def test_chips_bulk_defers_into_txn(self):
+        kv = MemoryKV()
+        sched = ChipScheduler(HostTopology.build("v5e-8"), kv, "/chips")
+        txn = StoreTxn(kv)
+        assert sched.try_claim_chips_bulk([("g", [0, 1])], txn=txn) == []
+        assert kv.get_or("/chips") is None  # nothing durable pre-commit
+        txn.commit()
+        assert "g" in kv.get("/chips")
+        # the release mirrors: deferred, then durable in the same shape
+        txn2 = StoreTxn(kv)
+        sched.restore_chips([0, 1], owner="g", txn=txn2)
+        assert "g" in kv.get("/chips")
+        txn2.commit()
+        assert "g" not in kv.get("/chips")
+
+    def test_ports_bulk_mirror(self):
+        kv = CountingKV(MemoryKV())
+        ps = PortScheduler(kv, 40000, 40009, store_key="/ports")
+        base = kv.snapshot()
+        assert ps.try_claim_ports_bulk(
+            [("a", [40000, 40001]), ("b", [40002])]) == []
+        assert CountingKV.delta(base, kv.snapshot()) == {"put": 1}
+        # conflict anywhere in the batch claims nothing
+        assert ps.try_claim_ports_bulk(
+            [("c", [40003]), ("c", [40001])]) == [40001]
+        assert ps.n_free == 7
+        # bulk release: both owners' ports free in ONE atomic apply
+        txn = StoreTxn(kv)
+        ps.restore_ports([40000, 40001], owner="a", txn=txn)
+        ps.restore_ports([40002], owner="b", txn=txn)
+        base = kv.snapshot()
+        txn.commit()
+        assert CountingKV.delta(base, kv.snapshot()) == {"apply": 1}
+        assert ps.n_free == 10
+
+    def test_bulk_claim_survives_restart(self):
+        kv = MemoryKV()
+        sched = ChipScheduler(HostTopology.build("v5e-8"), kv)
+        txn = StoreTxn(kv)
+        sched.try_claim_chips_bulk([("g-0", [0]), ("g-1", [1])], txn=txn)
+        txn.commit()
+        sched2 = ChipScheduler(HostTopology.build("v5e-8"), kv)
+        assert sched2.try_claim_chips([0], "g-0") == []  # idempotent re-own
+        assert sched2.try_claim_chips([1], "intruder") == [1]
 
 
 class TestPortScheduler:

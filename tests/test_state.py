@@ -1,15 +1,18 @@
 """State layer: KV backends, per-version store, version maps, work queue."""
 
 import json
+import sqlite3
 import threading
+import time
 
 import pytest
 
 from tpu_docker_api import errors
 from tpu_docker_api.schemas.state import ContainerState, VolumeState
+from tpu_docker_api.service.crashpoints import SimulatedCrash, armed
 from tpu_docker_api.state import keys
 from tpu_docker_api.state.keys import Resource, split_versioned_name
-from tpu_docker_api.state.kv import MemoryKV, SqliteKV
+from tpu_docker_api.state.kv import CountingKV, MemoryKV, SqliteKV
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
 from tpu_docker_api.state.workqueue import (
@@ -69,6 +72,136 @@ class TestKV:
         d = SqliteKV(str(tmp_path / "d.db"))  # default is nonzero too
         assert d._conn.execute("PRAGMA busy_timeout").fetchone()[0] > 0
         d.close()
+
+
+class TestKVApply:
+    """``KV.apply`` — the atomic multi-key batch every version transition
+    commits through (the etcd-txn / apiserver write pattern). EtcdKV's
+    native-txn implementation is covered in test_etcd_kv.py."""
+
+    def test_mixed_batch_applies(self, kv):
+        kv.put("/f/old", "x")
+        kv.put("/purge/a", "1")
+        kv.put("/purge/b", "2")
+        kv.apply([
+            ("put", "/f/v/0", "spec"),
+            ("put", "/f/latest", "0"),
+            ("delete", "/f/old"),
+            ("delete_prefix", "/purge/"),
+        ])
+        assert kv.get("/f/v/0") == "spec"
+        assert kv.get("/f/latest") == "0"
+        assert kv.get_or("/f/old") is None
+        assert kv.range_prefix("/purge/") == {}
+
+    def test_empty_batch_is_a_noop(self, kv):
+        kv.apply([])
+
+    def test_malformed_op_rejected_before_any_write(self, kv):
+        for bad in [("frob", "/k"), ("put", "/k"), ("delete", "/k", "v"),
+                    ("put", "/k", "v", "extra")]:
+            with pytest.raises(ValueError):
+                kv.apply([("put", "/ok", "1"), bad])
+        # validation runs over the WHOLE batch before the first write
+        assert kv.get_or("/ok") is None
+
+    def test_sqlite_mid_batch_failure_rolls_back_everything(self, tmp_path):
+        s = SqliteKV(str(tmp_path / "atomic.db"))
+        s.put("/keep", "safe")
+        with pytest.raises((sqlite3.InterfaceError, sqlite3.ProgrammingError)):
+            # second op is unbindable: the first must not survive it
+            s.apply([("put", "/a", "1"), ("put", "/b", object())])
+        assert s.get_or("/a") is None
+        assert s.get("/keep") == "safe"
+        s.put("/after", "ok")  # the connection stays usable post-rollback
+        assert s.get("/after") == "ok"
+        s.close()
+
+    def test_crash_point_brackets_the_commit(self, kv):
+        """Both halves of the txn-boundary contract, at the KV layer: a
+        crash at txn.before_apply leaves NOTHING applied, a crash at
+        txn.after_apply leaves EVERYTHING applied."""
+        with armed("txn.before_apply"):
+            with pytest.raises(SimulatedCrash):
+                kv.apply([("put", "/t", "1")])
+        assert kv.get_or("/t") is None
+        with armed("txn.after_apply"):
+            with pytest.raises(SimulatedCrash):
+                kv.apply([("put", "/t", "1"), ("put", "/u", "2")])
+        assert kv.get("/t") == "1"
+        assert kv.get("/u") == "2"
+
+    def test_crash_point_skip_targets_kth_apply(self, kv):
+        """skip=k lets chaos cases walk the crash across a flow's k-th
+        commit — the first k applies must land untouched."""
+        with armed("txn.before_apply", skip=1):
+            kv.apply([("put", "/first", "1")])
+            with pytest.raises(SimulatedCrash):
+                kv.apply([("put", "/second", "2")])
+        assert kv.get("/first") == "1"
+        assert kv.get_or("/second") is None
+
+    def test_sqlite_foreign_lock_is_bounded_and_atomic(self, tmp_path):
+        """A foreign writer holding the database (backup tooling, a second
+        daemon by mistake) makes the batched mutations fail after the
+        bounded busy wait — with the whole batch rolled back, never half of
+        it (the sqlite-layer analog of the PR 5 ``_OutageKV`` tests)."""
+        path = str(tmp_path / "locked.db")
+        s = SqliteKV(path, busy_timeout_s=0.05)
+        s.put("/fam/a", "1")
+        s.put("/fam/b", "2")
+        foreign = sqlite3.connect(path)
+        foreign.execute("BEGIN IMMEDIATE")  # foreign write lock
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(sqlite3.OperationalError):
+                s.delete_prefix("/fam/")
+            with pytest.raises(sqlite3.OperationalError):
+                s.apply([("put", "/fam/c", "3"), ("delete", "/fam/a")])
+            assert time.monotonic() - t0 < 5.0  # bounded wait, not a hang
+        finally:
+            foreign.rollback()
+            foreign.close()
+        # WAL readers see the untouched pre-batch state
+        assert s.range_prefix("/fam/") == {"/fam/a": "1", "/fam/b": "2"}
+        s.delete_prefix("/fam/")  # and the lock's release unblocks writes
+        assert s.range_prefix("/fam/") == {}
+        s.close()
+
+
+class TestCountingKV:
+    """The churn benchmark's round-trip instrumentation (bench.py gates on
+    its deltas, so its counting semantics are load-bearing)."""
+
+    def test_counts_each_round_trip_once(self):
+        kv = CountingKV(MemoryKV())
+        kv.put("/a", "1")
+        kv.get("/a")
+        kv.apply([("put", "/b", "2"), ("delete", "/a")])
+        assert kv.snapshot() == {"put": 1, "get": 1, "apply": 1}
+        assert kv.inner.get("/b") == "2"  # delegated for real
+        assert CountingKV.delta({"put": 1}, kv.snapshot()) == {
+            "get": 1, "apply": 1}
+
+    def test_apply_fires_crash_points_once_per_batch(self):
+        """The wrapper delegates to the inner BACKEND's ``_apply`` — if it
+        went through the inner ``apply`` template the txn crash points
+        would fire twice per batch and skip-indexed chaos cases would land
+        on the wrong commit."""
+        kv = CountingKV(MemoryKV())
+        with armed("txn.before_apply", skip=1):
+            kv.apply([("put", "/x", "1")])  # a double fire would crash here
+        assert kv.inner.get("/x") == "1"
+        assert kv.snapshot()["apply"] == 1
+
+    def test_state_store_version_transition_is_one_round_trip(self):
+        """The tentpole invariant at its smallest: a put_container (version
+        record + latest pointer) is ONE apply, not two puts."""
+        kv = CountingKV(MemoryKV())
+        StateStore(kv).put_container(
+            ContainerState("web-0", 0, {"name": "web-0"}))
+        assert kv.snapshot() == {"apply": 1}
+        assert StateStore(kv).get_container("web").container_name == "web-0"
 
 
 class TestKeys:

@@ -201,10 +201,22 @@ class PodScheduler:
 
     # -- persistence -------------------------------------------------------------
 
-    def _persist_locked(self) -> None:
-        self._kv.put(self._key, json.dumps(
-            {o: g.to_dict() for o, g in sorted(self._grants.items())}
-        ))
+    def _serialized_locked(self) -> str:
+        return json.dumps(
+            {o: g.to_dict() for o, g in sorted(self._grants.items())})
+
+    def _persist_locked(self, txn=None) -> None:
+        """Immediate write, or deferred into a StoreTxn so a whole gang's
+        slice registry + per-host chip maps commit as one atomic apply
+        (state/txn.py; RANK_POD orders this lock before the host leaf
+        locks, matching apply_slice's own nesting)."""
+        if txn is not None:
+            from tpu_docker_api.state.txn import RANK_POD
+
+            txn.enlist(RANK_POD, self._key, self._mu,
+                       lambda: [("put", self._key, self._serialized_locked())])
+            return
+        self._kv.put(self._key, self._serialized_locked())
 
     # -- host schedulability (cordon / down) --------------------------------------
 
@@ -327,7 +339,8 @@ class PodScheduler:
 
     def apply_slice(self, n_chips: int = 0, accelerator_type: str = "",
                     owner: str = "",
-                    exclude_hosts: set[str] | None = None) -> SliceAllocation:
+                    exclude_hosts: set[str] | None = None,
+                    txn=None) -> SliceAllocation:
         """Allocate ``n_chips`` (or the chip count implied by an accelerator
         type like "v5p-64"). Sub-host counts delegate to one host's chip
         scheduler; whole-host multiples allocate an ICI-contiguous host block.
@@ -337,40 +350,76 @@ class PodScheduler:
         grant (gang migration: the new placement must avoid the dead host
         even before the monitor has marked it).
         """
-        if accelerator_type:
-            gen, n_chips = parse_accelerator_type(accelerator_type)
-            if gen.name != self.pod.generation.name:
-                raise errors.TopologyUnknown(
-                    f"pod is {self.pod.generation.name}, asked for {gen.name}"
-                )
-        if n_chips <= 0:
-            raise errors.BadRequest("slice needs a positive chip count")
-        if not owner:
-            raise errors.BadRequest("slice allocation requires an owner")
+        return self.apply_slices([(owner, n_chips, accelerator_type)],
+                                 exclude_hosts=exclude_hosts, txn=txn)[0]
+
+    def apply_slices(self, asks: list[tuple[str, int, str]],
+                     exclude_hosts: set[str] | None = None,
+                     txn=None) -> list[SliceAllocation]:
+        """Gang-level all-or-nothing allocation: every ``(owner, n_chips,
+        accelerator_type)`` ask granted under ONE lock hold, persisted as
+        ONE snapshot (or deferred into the flow's StoreTxn) — either the
+        whole gang's slices exist or none do, with no partial-claim window
+        for a crash or a rival gang to land in. On any infeasibility the
+        already-claimed members are released in-memory and nothing was
+        persisted (txn path) / the pre-claim snapshot is rewritten (sync
+        path)."""
         per_host = self.pod.chips_per_host
+        resolved: list[tuple[str, int]] = []
+        for owner, n_chips, accelerator_type in asks:
+            if accelerator_type:
+                gen, n_chips = parse_accelerator_type(accelerator_type)
+                if gen.name != self.pod.generation.name:
+                    raise errors.TopologyUnknown(
+                        f"pod is {self.pod.generation.name}, asked for {gen.name}"
+                    )
+            if n_chips <= 0:
+                raise errors.BadRequest("slice needs a positive chip count")
+            if not owner:
+                raise errors.BadRequest("slice allocation requires an owner")
+            resolved.append((owner, n_chips))
         with self._mu:
             banned = self._unschedulable_locked(exclude_hosts)
-            if owner in self._grants:
-                raise errors.ContainerExisted(f"slice owner {owner} already holds a grant")
-            if n_chips < per_host or len(self.pod.hosts) == 1:
-                grant = self._apply_sub_host_locked(n_chips, owner, banned)
-            else:
-                # deterministic infeasibilities are BadRequest, not
-                # ChipNotEnough: callers treat ChipNotEnough as a capacity
-                # problem that freeing other slices could solve
-                if n_chips % per_host:
-                    raise errors.BadRequest(
-                        f"multi-host slices are host-granular: {n_chips} chips "
-                        f"is not a multiple of {per_host} chips/host"
-                    )
-                grant = self._apply_hosts_locked(n_chips // per_host, owner,
-                                                 banned)
-            self._grants[owner] = grant
-            self._persist_locked()
-            return grant
+            granted: list[SliceAllocation] = []
+            try:
+                for owner, n_chips in resolved:
+                    if owner in self._grants:
+                        raise errors.ContainerExisted(
+                            f"slice owner {owner} already holds a grant")
+                    if n_chips < per_host or len(self.pod.hosts) == 1:
+                        grant = self._apply_sub_host_locked(
+                            n_chips, owner, banned, txn)
+                    else:
+                        # deterministic infeasibilities are BadRequest, not
+                        # ChipNotEnough: callers treat ChipNotEnough as a
+                        # capacity problem that freeing other slices could
+                        # solve
+                        if n_chips % per_host:
+                            raise errors.BadRequest(
+                                f"multi-host slices are host-granular: "
+                                f"{n_chips} chips is not a multiple of "
+                                f"{per_host} chips/host"
+                            )
+                        grant = self._apply_hosts_locked(
+                            n_chips // per_host, owner, banned, txn)
+                    self._grants[owner] = grant
+                    granted.append(grant)
+            except Exception:
+                # all-or-nothing unwind: release every member already
+                # granted in this batch (same txn ⇒ still unpersisted)
+                for g in granted:
+                    self._grants.pop(g.owner, None)
+                    for host_id, chips in g.hosts:
+                        host = self.pod.hosts.get(host_id)
+                        if host is not None:
+                            host.chips.restore_chips(chips, owner=g.owner,
+                                                     txn=txn)
+                raise
+            self._persist_locked(txn)
+            return granted
 
     def _apply_sub_host_locked(self, n: int, owner: str,
-                               banned: set[str]) -> SliceAllocation:
+                               banned: set[str], txn=None) -> SliceAllocation:
         """Tightest-fit host first (least free chips that still satisfy), then
         host id for determinism."""
         ranked = sorted(
@@ -381,7 +430,8 @@ class PodScheduler:
             if len(host.chips.free_chips) < n:
                 continue
             try:
-                chips, contiguous = host.chips.apply_chips(n, owner=owner)
+                chips, contiguous = host.chips.apply_chips(n, owner=owner,
+                                                           txn=txn)
             except errors.ChipNotEnough:
                 continue
             return SliceAllocation(owner, [(host.host_id, chips)], (1, 1, 1),
@@ -395,7 +445,7 @@ class PodScheduler:
         )
 
     def _apply_hosts_locked(self, n_hosts: int, owner: str,
-                            banned: set[str]) -> SliceAllocation:
+                            banned: set[str], txn=None) -> SliceAllocation:
         # deterministic infeasibility (no axis-aligned tiling exists) is
         # BadRequest, not ChipNotEnough: callers treat ChipNotEnough as a
         # capacity problem that freeing other slices could solve
@@ -433,13 +483,14 @@ class PodScheduler:
         try:
             for coord in block:
                 host = self._by_coord(coord)
-                chips, _ = host.chips.apply_chips(host.topology.n_chips, owner=owner)
+                chips, _ = host.chips.apply_chips(host.topology.n_chips,
+                                                  owner=owner, txn=txn)
                 claimed.append(host)
                 members.append((host.host_id, chips))
         except errors.ChipNotEnough:
             # roll back partial claims (should not happen: hosts were fully free)
             for host, (_, chips) in zip(claimed, members):
-                host.chips.restore_chips(chips, owner=owner)
+                host.chips.restore_chips(chips, owner=owner, txn=txn)
             raise
         return SliceAllocation(owner, members, shape, True)
 
@@ -448,7 +499,7 @@ class PodScheduler:
         assert host is not None, f"no host at grid {coord}"
         return host
 
-    def restore_slice(self, owner: str) -> None:
+    def restore_slice(self, owner: str, txn=None) -> None:
         """Free every chip of the owner's grant (owner-guarded, so a double
         restore or a stale caller cannot free re-allocated chips)."""
         with self._mu:
@@ -458,5 +509,5 @@ class PodScheduler:
             for host_id, chips in grant.hosts:
                 host = self.pod.hosts.get(host_id)
                 if host is not None:
-                    host.chips.restore_chips(chips, owner=owner)
-            self._persist_locked()
+                    host.chips.restore_chips(chips, owner=owner, txn=txn)
+            self._persist_locked(txn)

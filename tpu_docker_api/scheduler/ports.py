@@ -49,19 +49,28 @@ class PortScheduler:
             if not start_port <= self._cursor <= end_port:
                 self._cursor = start_port
 
-    def _persist_locked(self) -> None:
-        self._kv.put(
-            self._key,
-            json.dumps({"used": {str(p): o for p, o in sorted(self._used.items())},
-                        "cursor": self._cursor}),
-        )
+    def _serialized_locked(self) -> str:
+        return json.dumps({"used": {str(p): o for p, o in sorted(self._used.items())},
+                           "cursor": self._cursor})
+
+    def _persist_locked(self, txn=None) -> None:
+        """Immediate write, or deferred into a StoreTxn (the gang-claim /
+        bulk-release batches; ops_fn re-snapshots under this lock at commit
+        time — see state/txn.py)."""
+        if txn is not None:
+            from tpu_docker_api.state.txn import RANK_HOST
+
+            txn.enlist(RANK_HOST, self._key, self._mu,
+                       lambda: [("put", self._key, self._serialized_locked())])
+            return
+        self._kv.put(self._key, self._serialized_locked())
 
     @property
     def n_free(self) -> int:
         with self._mu:
             return (self.end_port - self.start_port + 1) - len(self._used)
 
-    def apply_ports(self, n: int, owner: str = "") -> list[int]:
+    def apply_ports(self, n: int, owner: str = "", txn=None) -> list[int]:
         """Allocate ``n`` distinct host ports (reference ApplyPorts,
         scheduler.go:85-111)."""
         if n <= 0:
@@ -80,27 +89,40 @@ class PortScheduler:
                         break
                 p = p + 1 if p < self.end_port else self.start_port
             self._cursor = out[-1] + 1 if out[-1] < self.end_port else self.start_port
-            self._persist_locked()
+            self._persist_locked(txn)
             return out
 
-    def try_claim_ports(self, ports: list[int], owner: str) -> list[int]:
+    def try_claim_ports(self, ports: list[int], owner: str,
+                        txn=None) -> list[int]:
         """Claim SPECIFIC ports for ``owner`` (reconciler adoption/re-claim,
         mirroring ChipScheduler.try_claim_chips). All-or-nothing: returns
         conflicts and claims nothing unless empty."""
+        return self.try_claim_ports_bulk([(owner, ports)], txn=txn)
+
+    def try_claim_ports_bulk(self, claims: list[tuple[str, list[int]]],
+                             txn=None) -> list[int]:
+        """Multi-member variant (mirrors try_claim_chips_bulk): every
+        ``(owner, ports)`` pair claimed all-or-nothing across the batch in
+        one lock hold + one persist. Returns conflicts (empty = claimed);
+        a port asked for by two different owners in the batch conflicts."""
         with self._mu:
-            conflicts = sorted(
-                p for p in ports
+            want: dict[int, str] = {}
+            conflicts = {
+                p for owner, ports in claims for p in ports
                 if not self.start_port <= p <= self.end_port
                 or self._used.get(p, owner) != owner
-            )
+                or want.setdefault(p, owner) != owner
+            }
             if conflicts:
-                return conflicts
-            for p in ports:
-                self._used[p] = owner
-            self._persist_locked()
+                return sorted(conflicts)
+            for owner, ports in claims:
+                for p in ports:
+                    self._used[p] = owner
+            self._persist_locked(txn)
             return []
 
-    def restore_ports(self, ports: list[int], owner: str | None = None) -> None:
+    def restore_ports(self, ports: list[int], owner: str | None = None,
+                      txn=None) -> None:
         """Return ports to the pool (reference RestorePorts, scheduler.go:114-125).
         With ``owner`` set, only ports still held by that owner are freed
         (double-free guard, mirroring ChipScheduler.restore_chips)."""
@@ -109,7 +131,7 @@ class PortScheduler:
                 if owner is not None and self._used.get(p) != owner:
                     continue
                 self._used.pop(p, None)
-            self._persist_locked()
+            self._persist_locked(txn)
 
     def status(self) -> dict:
         """Snapshot for GET /resources/ports (reference GetPortStatus +

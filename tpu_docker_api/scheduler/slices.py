@@ -80,8 +80,21 @@ class ChipScheduler:
 
     # -- persistence -------------------------------------------------------------
 
-    def _persist_locked(self) -> None:
-        self._kv.put(self._key, json.dumps({str(k): v for k, v in sorted(self._used.items())}))
+    def _serialized_locked(self) -> str:
+        return json.dumps({str(k): v for k, v in sorted(self._used.items())})
+
+    def _persist_locked(self, txn=None) -> None:
+        """Write the ownership snapshot — immediately, or deferred into a
+        :class:`~tpu_docker_api.state.txn.StoreTxn` when the caller batches
+        this claim with the rest of a flow's writes (ops_fn re-snapshots at
+        commit time, under this lock)."""
+        if txn is not None:
+            from tpu_docker_api.state.txn import RANK_HOST
+
+            txn.enlist(RANK_HOST, self._key, self._mu,
+                       lambda: [("put", self._key, self._serialized_locked())])
+            return
+        self._kv.put(self._key, self._serialized_locked())
 
     # -- queries -----------------------------------------------------------------
 
@@ -122,7 +135,7 @@ class ChipScheduler:
     # -- allocation --------------------------------------------------------------
 
     def apply_chips(
-        self, n: int, shape: str = "", owner: str = ""
+        self, n: int, shape: str = "", owner: str = "", txn=None
     ) -> tuple[list[int], bool]:
         """Allocate ``n`` chips (or an explicit ``shape`` like "2x2").
 
@@ -145,7 +158,7 @@ class ChipScheduler:
                         f"no free ICI-contiguous {shape} block "
                         f"(free={len(free)}/{self.topology.n_chips})"
                     )
-                self._claim_locked(block, owner)
+                self._claim_locked(block, owner, txn)
                 return block, True
             if n > len(free):
                 raise errors.ChipNotEnough(
@@ -155,35 +168,52 @@ class ChipScheduler:
             for cand in candidate_shapes(n, self.topology.mesh_shape):
                 block = self._find_block_locked(cand, free)
                 if block is not None:
-                    self._claim_locked(block, owner)
+                    self._claim_locked(block, owner, txn)
                     return block, True
             # scattered fallback (parity: the reference never guarantees
             # adjacency at all) — deterministic lowest-id-first
             picked = sorted(free)[:n]
-            self._claim_locked(picked, owner)
+            self._claim_locked(picked, owner, txn)
             return picked, False
 
-    def try_claim_chips(self, chip_ids: list[int], owner: str) -> list[int]:
+    def try_claim_chips(self, chip_ids: list[int], owner: str,
+                        txn=None) -> list[int]:
         """Claim SPECIFIC chips for ``owner`` — the reconciler's adoption
         path (re-own a container found in the runtime but absent from the
         allocation map). All-or-nothing: returns the conflicting chip ids
         (held by a different owner or outside the topology) and claims
         nothing unless the list is empty. Chips already owned by ``owner``
         are fine (idempotent re-adoption)."""
+        return self.try_claim_chips_bulk([(owner, chip_ids)], txn=txn)
+
+    def try_claim_chips_bulk(self, claims: list[tuple[str, list[int]]],
+                             txn=None) -> list[int]:
+        """Multi-member variant: claim every ``(owner, chip_ids)`` pair
+        all-or-nothing ACROSS the whole batch, in one lock hold and one
+        persist — a gang's members re-claim (reconciler adoption, unwind
+        re-claims) as one scheduler apply, not N windows a crash or a rival
+        claim can land between. Returns the conflicting chip ids (empty =
+        everything claimed). A chip asked for by two DIFFERENT owners
+        within the batch is itself a conflict — a double-grant must never
+        depend on member order."""
         with self._mu:
-            conflicts = sorted(
-                c for c in chip_ids
+            want: dict[int, str] = {}
+            conflicts = {
+                c for owner, chip_ids in claims for c in chip_ids
                 if c not in self.topology.coords
                 or self._used.get(c, owner) != owner
-            )
+                or want.setdefault(c, owner) != owner
+            }
             if conflicts:
-                return conflicts
-            for c in chip_ids:
-                self._used[c] = owner
-            self._persist_locked()
+                return sorted(conflicts)
+            for owner, chip_ids in claims:
+                for c in chip_ids:
+                    self._used[c] = owner
+            self._persist_locked(txn)
             return []
 
-    def restore_chips(self, chip_ids: list[int], owner: str | None = None) -> None:
+    def restore_chips(self, chip_ids: list[int], owner: str | None = None,
+                      txn=None) -> None:
         """Return chips to the pool (reference RestoreGpus, scheduler.go:93-104).
 
         With ``owner`` set, only chips still held by that owner are freed —
@@ -195,12 +225,13 @@ class ChipScheduler:
                 if owner is not None and self._used.get(cid) != owner:
                     continue
                 self._used.pop(cid, None)
-            self._persist_locked()
+            self._persist_locked(txn)
 
-    def _claim_locked(self, chip_ids: list[int], owner: str) -> None:
+    def _claim_locked(self, chip_ids: list[int], owner: str,
+                      txn=None) -> None:
         for cid in chip_ids:
             self._used[cid] = owner
-        self._persist_locked()
+        self._persist_locked(txn)
 
     # -- block search ------------------------------------------------------------
 

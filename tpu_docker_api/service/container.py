@@ -51,6 +51,7 @@ from tpu_docker_api.schemas.state import ContainerState
 from tpu_docker_api.service.crashpoints import crash_point
 from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_name
 from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.txn import StoreTxn
 from tpu_docker_api.state.version import VersionMap
 from tpu_docker_api.state.workqueue import TaskRecord, WorkQueue
 
@@ -178,15 +179,22 @@ class ContainerService:
                     for p in req.container_ports
                 ],
             )
+            # the chip claim defers into the flow's txn so chips + ports
+            # commit as ONE atomic apply inside _run_new_version — container
+            # create is 3 store round trips total (version bump, claim txn,
+            # spec txn), not one per mutation
+            claim_txn = StoreTxn(self.store.kv)
             chip_ids, contiguous = self.chips.apply_chips(
-                req.chip_count, shape=req.slice_shape, owner=base
+                req.chip_count, shape=req.slice_shape, owner=base,
+                txn=claim_txn,
             )
             try:
                 render_tpu_attachment(
                     spec, chip_ids, self.chips.topology,
                     ici_contiguous=contiguous, libtpu_path=self.libtpu_path,
                 )
-                name = self._run_new_version(base, spec, start_now=True)
+                name = self._run_new_version(base, spec, start_now=True,
+                                             claim_txn=claim_txn)
             except Exception:
                 self.chips.restore_chips(chip_ids, owner=base)
                 raise
@@ -194,23 +202,32 @@ class ContainerService:
                      contiguous)
             return {"name": name, "chipIds": chip_ids, "iciContiguous": contiguous}
 
-    def _run_new_version(self, base: str, spec: ContainerSpec, start_now: bool) -> str:
-        """Version bump → port alloc → create [→ start] → persist, with full
-        rollback on failure (reference runContainer, service/container.go:463-535).
-        The spec persists synchronously so a version pointer always has its
-        spec, even across a crash."""
+    def _run_new_version(self, base: str, spec: ContainerSpec, start_now: bool,
+                         claim_txn: StoreTxn | None = None) -> str:
+        """Version bump → atomic claim txn (ports, plus whatever the caller
+        enlisted — run_container defers its chip claim in) → create
+        [→ start] → persist, with full rollback on failure (reference
+        runContainer, service/container.go:463-535). The spec persists
+        synchronously so a version pointer always has its spec, even across
+        a crash; the claim commits BEFORE the container exists, so a crash
+        after create always finds its claims durable (the invariant the
+        reconciler's leak sweep is built on)."""
         prev = self.versions.get(base)
         version = self.versions.next_version(base)
         name = versioned_name(base, version)
         spec.name = name
         crash_point("replace.after_version_bump")
 
+        txn = claim_txn if claim_txn is not None else StoreTxn(self.store.kv)
         fresh_ports: list[int] = []
         need = [pb for pb in spec.port_bindings if pb.host_port == 0]
         try:
-            fresh_ports = self.ports.apply_ports(len(need), owner=base)
+            fresh_ports = self.ports.apply_ports(len(need), owner=base,
+                                                 txn=txn)
             for pb, hp in zip(need, fresh_ports):
                 pb.host_port = hp
+            # ONE store round trip claims everything this version owns
+            txn.commit()
             try:
                 self.runtime.container_create(spec)
             except Exception:
@@ -245,17 +262,23 @@ class ContainerService:
         base, _, latest_name = self._resolve_latest(name)
         with self._locks.hold(base):
             # remove EVERY runtime version of the family, not only the latest —
-            # retired versions are kept stopped for rollback and must not leak
+            # retired versions are kept stopped for rollback and must not leak.
+            # Resource frees batch into one atomic apply after the loop: a
+            # 5-version family releases in 1 store round trip, not 10
+            release_txn = StoreTxn(self.store.kv)
             for member in self._family_runtime_members(base):
                 try:
                     info = self.runtime.container_inspect(member)
                     self.runtime.container_remove(member, force=req.force)
-                    self.chips.restore_chips(info.spec.chip_ids, owner=base)
+                    self.chips.restore_chips(info.spec.chip_ids, owner=base,
+                                             txn=release_txn)
                     self.ports.restore_ports(
-                        [pb.host_port for pb in info.spec.port_bindings], owner=base
+                        [pb.host_port for pb in info.spec.port_bindings],
+                        owner=base, txn=release_txn,
                     )
                 except errors.ContainerNotExist:
                     continue
+            release_txn.commit()
             if req.del_etcd_info_and_version_record:
                 # submit BEFORE dropping the version pointer: a saturated
                 # queue (429) there would otherwise leak the state family

@@ -74,8 +74,19 @@ QUEUE_CRASH_POINTS = (
     "queue.ack",
 )
 
+#: KV-transaction boundary (state/kv.py ``KV.apply``): every batched
+#: version transition commits through here, so two labels prove the whole
+#: contract — pre-txn crash ⇒ nothing applied, post-txn crash ⇒ everything
+#: applied and the reconciler finishes the flow forward
+TXN_CRASH_POINTS = (
+    # ops validated, the atomic commit not yet issued
+    "txn.before_apply",
+    # the atomic commit is durable, the flow's remaining steps are not
+    "txn.after_apply",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
-                      + QUEUE_CRASH_POINTS)
+                      + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):
@@ -87,25 +98,36 @@ class SimulatedCrash(BaseException):
         self.label = label
 
 
-_armed: set[str] | None = None
+_armed: dict[str, int] | None = None  # label → hits to skip before crashing
 _mu = threading.Lock()
 
 
 def crash_point(label: str) -> None:
-    """No-op unless ``label`` is armed; then raises SimulatedCrash."""
-    if _armed is not None and label in _armed:
-        raise SimulatedCrash(label)
+    """No-op unless ``label`` is armed; then raises SimulatedCrash (after
+    consuming the label's remaining skip budget — see :func:`armed`)."""
+    if _armed is None or label not in _armed:
+        return
+    with _mu:
+        if _armed is None or label not in _armed:
+            return
+        if _armed[label] > 0:
+            _armed[label] -= 1
+            return
+    raise SimulatedCrash(label)
 
 
 @contextlib.contextmanager
-def armed(*labels: str):
-    """Arm crash points for the duration of a test block."""
+def armed(*labels: str, skip: int = 0):
+    """Arm crash points for the duration of a test block. ``skip`` lets the
+    first N hits of each label pass before crashing — the txn boundary
+    fires once per ``KV.apply``, so a flow with several batched commits
+    needs an index to say WHICH commit the daemon dies at."""
     global _armed
     unknown = set(labels) - set(KNOWN_CRASH_POINTS)
     if unknown:
         raise ValueError(f"unknown crash points: {sorted(unknown)}")
     with _mu:
-        _armed = set(labels)
+        _armed = {label: skip for label in labels}
     try:
         yield
     finally:

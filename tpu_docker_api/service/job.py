@@ -52,6 +52,7 @@ from tpu_docker_api.state.keys import (
     versioned_name,
 )
 from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.txn import StoreTxn
 from tpu_docker_api.state.version import VersionMap
 from tpu_docker_api.workload.jaxenv import (
     DistributedJob,
@@ -131,8 +132,12 @@ class JobService:
     def _apply_slices(self, n_chips: int, num_slices: int,
                       accelerator_type: str, vname: str,
                       exclude_hosts: set[str] | None = None,
+                      txn: StoreTxn | None = None,
                       ) -> list[SliceAllocation]:
-        """One ICI-slice grant per slice, all-or-nothing."""
+        """One ICI-slice grant per slice — gang-level all-or-nothing in ONE
+        scheduler apply (PodScheduler.apply_slices batches every member's
+        chip map and the slice registry into a single lock hold; with a txn
+        the persist defers into the flow's one claim commit)."""
         if num_slices > 1 and accelerator_type:
             # apply_slice overrides n_chips from the type, so the type would
             # be granted PER SLICE while every size precheck assumes a total
@@ -143,31 +148,27 @@ class JobService:
         if n_chips % num_slices:
             raise errors.BadRequest(
                 f"chipCount {n_chips} must divide by numSlices {num_slices}")
-        grants: list[SliceAllocation] = []
-        try:
-            for k in range(num_slices):
-                grants.append(self.slices.apply_slice(
-                    n_chips=n_chips // num_slices,
-                    accelerator_type=accelerator_type,
-                    owner=self._slice_owner(vname, k, num_slices),
-                    exclude_hosts=exclude_hosts,
-                ))
-        except Exception:
-            for k in range(len(grants)):
-                self.slices.restore_slice(self._slice_owner(vname, k, num_slices))
-            raise
-        return grants
+        return self.slices.apply_slices(
+            [(self._slice_owner(vname, k, num_slices),
+              n_chips // num_slices, accelerator_type)
+             for k in range(num_slices)],
+            exclude_hosts=exclude_hosts, txn=txn)
 
-    def _restore_slices(self, vname: str, num_slices: int) -> None:
+    def _restore_slices(self, vname: str, num_slices: int,
+                        txn: StoreTxn | None = None) -> None:
         for k in range(num_slices):
-            self.slices.restore_slice(self._slice_owner(vname, k, num_slices))
+            self.slices.restore_slice(self._slice_owner(vname, k, num_slices),
+                                      txn=txn)
 
     def _build_placements(
-        self, grants: list[SliceAllocation], owner: str
+        self, grants: list[SliceAllocation], owner: str,
+        txn: StoreTxn | None = None,
     ) -> tuple[list[ProcessPlacement], int, int, dict[str, list[int]]]:
         """Placements over all slices (slice-major, global process ids) +
         coordinator port + megascale port (0 unless multislice) + the host
-        ports claimed per host (for rollback/free)."""
+        ports claimed per host (for rollback/free). With a txn, every
+        host's port claim defers into the flow's single claim commit — the
+        whole gang's ports are one store round trip, not one per member."""
         claimed: dict[str, list[int]] = {}
         placements: list[ProcessPlacement] = []
         multislice = len(grants) > 1
@@ -179,7 +180,8 @@ class JobService:
                     # process 0 also publishes the coordinator port (+ the
                     # megascale DCN port when multislice)
                     n_ports = (3 if multislice else 2) if pid == 0 else 1
-                    ports = host.ports.apply_ports(n_ports, owner=owner)
+                    ports = host.ports.apply_ports(n_ports, owner=owner,
+                                                   txn=txn)
                     claimed.setdefault(host_id, []).extend(ports)
                     placements.append(ProcessPlacement(
                         process_id=pid,
@@ -194,13 +196,15 @@ class JobService:
             coordinator_port = first_host_ports[1]
             megascale_port = first_host_ports[2] if multislice else 0
         except Exception:
-            self._free_ports(claimed, owner)
+            self._free_ports(claimed, owner, txn=txn)
             raise
         return placements, coordinator_port, megascale_port, claimed
 
-    def _free_ports(self, claimed: dict[str, list[int]], owner: str) -> None:
+    def _free_ports(self, claimed: dict[str, list[int]], owner: str,
+                    txn: StoreTxn | None = None) -> None:
         for host_id, ports in claimed.items():
-            self.pod.hosts[host_id].ports.restore_ports(ports, owner=owner)
+            self.pod.hosts[host_id].ports.restore_ports(ports, owner=owner,
+                                                        txn=txn)
 
     def _specs_for(self, job_versioned: str, grants: list[SliceAllocation],
                    placements: list[ProcessPlacement], coordinator_port: int,
@@ -269,26 +273,35 @@ class JobService:
                      num_slices: int = 1,
                      exclude_hosts: set[str] | None = None,
                      carry: dict | None = None) -> JobState:
-        """Slice alloc → version bump → ports → render → create[+start] →
-        persist, with full rollback (the job-level _run_new_version).
-        ``carry`` merges extra JobState fields into the persisted record
-        (migration carries the budget counters onto the new version)."""
+        """Version bump → ONE atomic claim txn (every slice's chips, the
+        slice registry, every host's ports) → render → create[+start] →
+        persist JobState (one more apply), with full rollback (the
+        job-level _run_new_version). An N-member gang is O(1) store round
+        trips, not O(N): bump, claim commit, state commit. ``carry`` merges
+        extra JobState fields into the persisted record (migration carries
+        the budget counters onto the new version)."""
         prev = self.versions.get(base)
         version = self.versions.next_version(base)
         job_versioned = versioned_name(base, version)
         crash_point("job.run.after_version_bump")
+        txn = StoreTxn(self.store.kv)
         try:
             grants = self._apply_slices(
                 n_chips, num_slices, accelerator_type, job_versioned,
-                exclude_hosts=exclude_hosts)
+                exclude_hosts=exclude_hosts, txn=txn)
             try:
                 placements, coordinator_port, megascale_port, claimed = (
-                    self._build_placements(grants, job_versioned))
+                    self._build_placements(grants, job_versioned, txn=txn))
                 try:
                     specs = self._specs_for(
                         job_versioned, grants, placements, coordinator_port,
                         megascale_port, image, cmd, env, binds,
                     )
+                    # the whole gang's claims become durable together,
+                    # BEFORE any container exists — a crash after create
+                    # always finds its claims in the store (the invariant
+                    # the reconciler's scrub/leak sweeps are built on)
+                    txn.commit()
                     self._create_and_start(grants, specs, start_now=start_now)
                 except Exception:
                     self._free_ports(claimed, job_versioned)
@@ -394,8 +407,7 @@ class JobService:
                 ))
 
             def _free_old() -> None:
-                self._restore_slices(old.job_name, old.num_slices)
-                self._free_state_ports(old)
+                self._release_version_resources(old)
 
             def _resume_old() -> None:
                 # store record first: if the restart fails too, the family's
@@ -637,8 +649,7 @@ class JobService:
                 # coordinator last); stops on unreachable hosts are
                 # best-effort — the members there are beyond reach
                 self._stop_members(old, reverse=True)
-                self._restore_slices(old.job_name, old.num_slices)
-                self._free_state_ports(old)
+                self._release_version_resources(old)
                 released = True
                 crash_point("job.migrate.after_release")
                 st = self._run_version(
@@ -659,8 +670,7 @@ class JobService:
             self._start_members(st)
             crash_point("job.migrate.after_start_new")
             if not released:
-                self._restore_slices(old.job_name, old.num_slices)
-                self._free_state_ports(old)
+                self._release_version_resources(old)
             self._emit("gang-migrated", st.job_name, reason=reason,
                        from_hosts=sorted(exclude_hosts),
                        migration=st.migrations)
@@ -753,22 +763,39 @@ class JobService:
                 return True
         return False
 
+    def _release_version_resources(self, st: JobState,
+                                   txn: StoreTxn | None = None) -> None:
+        """Free one version's slices + every host's ports — the release
+        mirror of the gang claim txn: ONE atomic apply (or deferred into a
+        caller's larger batch) instead of a per-slice/per-host persist
+        loop."""
+        own_txn = txn is None
+        if own_txn:
+            txn = StoreTxn(self.store.kv)
+        self._restore_slices(st.job_name, st.num_slices, txn=txn)
+        self._free_state_ports(st, txn=txn)
+        if own_txn:
+            txn.commit()
+
     def _release_job_resources(self, base: str) -> None:
         """Free slices + ports of EVERY stored version of the family
-        (owner-guarded restores — double frees are no-ops)."""
+        (owner-guarded restores — double frees are no-ops), batched into
+        one store round trip across all versions."""
+        txn = StoreTxn(self.store.kv)
         for version in self.store.history(Resource.JOBS, base):
             vname = versioned_name(base, version)
             try:
                 vst = self.store.get_job(vname)
             except errors.NotExistInStore:
                 continue
-            self._restore_slices(vname, vst.num_slices)
-            self._free_state_ports(vst)
+            self._release_version_resources(vst, txn=txn)
+        txn.commit()
 
     def delete_job(self, name: str, req: JobDelete) -> None:
         base, _, latest_name = self._resolve_latest(name)
         with self._locks.hold(base):
             history = self.store.history(Resource.JOBS, base)
+            release_txn = StoreTxn(self.store.kv)
             for version in history:
                 vname = versioned_name(base, version)
                 try:
@@ -788,8 +815,8 @@ class JobService:
                         # KV record must still work (the container is lost
                         # either way — logged for the post-reboot janitor)
                         log.warning("remove of %s skipped: %s", cname, e)
-                self._restore_slices(vname, st.num_slices)
-                self._free_state_ports(st)
+                self._release_version_resources(st, txn=release_txn)
+            release_txn.commit()
             if req.del_state_and_version_record:
                 self.store.delete_family(Resource.JOBS, base)
                 self.versions.remove(base)
@@ -843,8 +870,7 @@ class JobService:
                 host.runtime.container_remove(cname, force=True)
             except (errors.ContainerNotExist, *errors.HOST_PATH_ERRORS):
                 pass
-        self._restore_slices(st.job_name, st.num_slices)
-        self._free_state_ports(st)
+        self._release_version_resources(st)
         self.store.delete_version(Resource.JOBS, st.job_name)
         self.versions.rollback(base, rollback_to)
 
@@ -866,7 +892,8 @@ class JobService:
             except errors.HOST_PATH_ERRORS as e:
                 log.warning("stop of %s skipped: %s", cname, e)
 
-    def _free_state_ports(self, st: JobState) -> None:
+    def _free_state_ports(self, st: JobState,
+                          txn: StoreTxn | None = None) -> None:
         for host_id, _, pid, _, tpu_port in st.placements:
             host = self.pod.hosts.get(host_id)
             if host is None:
@@ -876,7 +903,7 @@ class JobService:
                 ports.append(st.coordinator_port)
                 if st.megascale_port:
                     ports.append(st.megascale_port)
-            host.ports.restore_ports(ports, owner=st.job_name)
+            host.ports.restore_ports(ports, owner=st.job_name, txn=txn)
 
     def _info_dict(self, st: JobState, live: bool = False) -> dict:
         per_slice = max(len(st.placements) // st.num_slices, 1)

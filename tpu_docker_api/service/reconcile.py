@@ -306,6 +306,15 @@ class Reconciler:
             if prev is None:
                 self._act(actions, dry_run, "drop-empty-family", base,
                           fn=lambda: self.versions.remove(base))
+                # members the dying flow already created can never be
+                # adopted (no spec survived the crash) — remove them in
+                # THIS sweep, not one orphan pass later: the repair must
+                # be a fixpoint
+                for v in sorted(members):
+                    name = members[v]
+                    self._act(actions, dry_run, "remove-orphan", name,
+                              fn=lambda n=name: self.runtime.container_remove(
+                                  n, force=True))
                 self._release_all(base, actions, dry_run)
                 return False
             self._act(actions, dry_run, "rollback-version-pointer", latest_name,

@@ -9,7 +9,10 @@ Parity: reference ``internal/etcd/{client,common}.go`` — a clientv3 wrapper wi
   the reference's deployment shape without a grpc/protobuf dependency.
 
 All backends add ``range_prefix``/``delete_prefix``, which the reference lacks
-and which per-version key layout (state/keys.py) needs.
+and which per-version key layout (state/keys.py) needs, and ``apply`` — an
+atomic multi-key put/delete batch (the etcd txn / Kubernetes-apiserver write
+pattern) so a version transition is ONE store round trip instead of a
+sequence of windows a crash can land between.
 """
 
 from __future__ import annotations
@@ -21,6 +24,10 @@ import threading
 import time
 
 from tpu_docker_api import errors
+
+#: op kinds KV.apply accepts: ("put", key, value) | ("delete", key) |
+#: ("delete_prefix", prefix)
+_APPLY_OPS = {"put": 3, "delete": 2, "delete_prefix": 2}
 
 
 class KV(abc.ABC):
@@ -44,6 +51,37 @@ class KV(abc.ABC):
     def delete_prefix(self, prefix: str) -> None:
         for k in self.range_prefix(prefix):
             self.delete(k)
+
+    def apply(self, ops: list[tuple]) -> None:
+        """Atomically apply a batch of ``("put", k, v)`` / ``("delete", k)``
+        / ``("delete_prefix", p)`` ops — all land or none do. The two
+        ``txn.*`` crash points bracket the commit so the chaos suite can
+        prove both halves of the contract: a crash BEFORE the txn leaves
+        nothing applied, a crash AFTER leaves everything applied (and the
+        reconciler finishes the flow forward). Subclasses override
+        ``_apply`` with a genuinely atomic implementation; the base
+        fallback (sequential ops) keeps wrapper/test KVs working but is
+        NOT atomic."""
+        from tpu_docker_api.service.crashpoints import crash_point
+
+        if not ops:
+            return
+        for op in ops:
+            want = _APPLY_OPS.get(op[0])
+            if want is None or len(op) != want:
+                raise ValueError(f"malformed apply op {op!r}")
+        crash_point("txn.before_apply")
+        self._apply(ops)
+        crash_point("txn.after_apply")
+
+    def _apply(self, ops: list[tuple]) -> None:
+        for op in ops:
+            if op[0] == "put":
+                self.put(op[1], op[2])
+            elif op[0] == "delete":
+                self.delete(op[1])
+            else:
+                self.delete_prefix(op[1])
 
     def get_or(self, key: str, default: str | None = None) -> str | None:
         try:
@@ -79,6 +117,24 @@ class MemoryKV(KV):
     def range_prefix(self, prefix: str) -> dict[str, str]:
         with self._mu:
             return {k: v for k, v in sorted(self._d.items()) if k.startswith(prefix)}
+
+    def delete_prefix(self, prefix: str) -> None:
+        # one lock hold, not one delete per key — the purge paths submit a
+        # single op and the backend must honor that shape
+        with self._mu:
+            for k in [k for k in self._d if k.startswith(prefix)]:
+                del self._d[k]
+
+    def _apply(self, ops: list[tuple]) -> None:
+        with self._mu:
+            for op in ops:
+                if op[0] == "put":
+                    self._d[op[1]] = op[2]
+                elif op[0] == "delete":
+                    self._d.pop(op[1], None)
+                else:
+                    for k in [k for k in self._d if k.startswith(op[1])]:
+                        del self._d[k]
 
 
 class SqliteKV(KV):
@@ -128,13 +184,68 @@ class SqliteKV(KV):
             self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
             self._conn.commit()
 
+    @staticmethod
+    def _prefix_where(prefix: str) -> tuple[str, tuple]:
+        """One index-friendly range predicate (``k >= prefix AND k <
+        end``) selecting exactly the prefix's subtree — the per-key GLOB
+        scan this replaces walked the whole table. Falls back to GLOB for
+        prefixes whose incremented end is not valid TEXT (raw-0xff keys —
+        an etcd-wire artifact sqlite deployments never store)."""
+        if not prefix:
+            return "1=1", ()
+        end = _prefix_end(prefix)
+        try:
+            end.encode()
+        except UnicodeEncodeError:  # pragma: no cover — non-TEXT end
+            return "k GLOB ?", (prefix.replace("[", "[[]") + "*",)
+        if end == "\0":  # all-0xff prefix: no upper bound
+            return "k >= ?", (prefix,)
+        return "k >= ? AND k < ?", (prefix, end)
+
     def range_prefix(self, prefix: str) -> dict[str, str]:
+        where, params = self._prefix_where(prefix)
         with self._mu:
             rows = self._conn.execute(
-                "SELECT k, v FROM kv WHERE k GLOB ? ORDER BY k",
-                (prefix.replace("[", "[[]") + "*",),
+                f"SELECT k, v FROM kv WHERE {where} ORDER BY k", params,
             ).fetchall()
         return dict(rows)
+
+    def delete_prefix(self, prefix: str) -> None:
+        """One bounded DELETE in one transaction — a purge of an N-key
+        family is a single statement, not N round trips, and a crash
+        mid-purge can never leave half a family behind."""
+        where, params = self._prefix_where(prefix)
+        with self._mu:
+            try:
+                self._conn.execute(f"DELETE FROM kv WHERE {where}", params)
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
+    def _apply(self, ops: list[tuple]) -> None:
+        """All ops in ONE sqlite transaction: a mid-batch failure (or a
+        crash before the commit) rolls everything back."""
+        with self._mu:
+            try:
+                for op in ops:
+                    if op[0] == "put":
+                        self._conn.execute(
+                            "INSERT INTO kv(k, v) VALUES(?, ?) "
+                            "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                            (op[1], op[2]),
+                        )
+                    elif op[0] == "delete":
+                        self._conn.execute(
+                            "DELETE FROM kv WHERE k = ?", (op[1],))
+                    else:
+                        where, params = self._prefix_where(op[1])
+                        self._conn.execute(
+                            f"DELETE FROM kv WHERE {where}", params)
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
 
     def close(self) -> None:
         with self._mu:
@@ -235,8 +346,86 @@ class EtcdKV(KV):
             {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
         )
 
+    def _apply(self, ops: list[tuple]) -> None:
+        """Native etcd transaction (``/v3/kv/txn`` with no compares: the
+        success branch always commits, atomically). A txn is a WRITE, so it
+        rides the normalize-but-never-retry path — a blind re-apply after an
+        ambiguous timeout could double-commit a batch whose first attempt
+        landed (``idempotent=False`` is load-bearing, not a default)."""
+        success = []
+        for op in ops:
+            if op[0] == "put":
+                success.append({"requestPut": {
+                    "key": _b64(op[1]), "value": _b64(op[2])}})
+            elif op[0] == "delete":
+                success.append({"requestDeleteRange": {"key": _b64(op[1])}})
+            else:
+                success.append({"requestDeleteRange": {
+                    "key": _b64(op[1]),
+                    "range_end": _b64(_prefix_end(op[1]))}})
+        self._post("/v3/kv/txn", {"success": success}, idempotent=False)
+
     def close(self) -> None:
         self._session.close()
+
+
+class CountingKV(KV):
+    """Instrumentation wrapper: counts store round trips per KV method.
+
+    The churn benchmark (bench.py ``--cp-family churn``) wraps the daemon's
+    store in one of these to report **round trips per control-plane flow**
+    — the regression gate that keeps "batched" an invariant instead of an
+    adjective. Each counted unit is one network round trip on etcd: an
+    ``apply`` of 40 ops counts once, which is the whole point."""
+
+    def __init__(self, inner: KV) -> None:
+        self.inner = inner
+        self._mu = threading.Lock()
+        self.counts: dict[str, int] = {}
+
+    def _count(self, method: str) -> None:
+        with self._mu:
+            self.counts[method] = self.counts.get(method, 0) + 1
+
+    def snapshot(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self.counts)
+
+    @staticmethod
+    def delta(before: dict[str, int], after: dict[str, int]) -> dict[str, int]:
+        """Per-method round trips between two snapshots (zeroes dropped)."""
+        out = {k: after[k] - before.get(k, 0) for k in after}
+        return {k: v for k, v in out.items() if v}
+
+    def put(self, key: str, value: str) -> None:
+        self._count("put")
+        self.inner.put(key, value)
+
+    def get(self, key: str) -> str:
+        self._count("get")
+        return self.inner.get(key)
+
+    def delete(self, key: str) -> None:
+        self._count("delete")
+        self.inner.delete(key)
+
+    def range_prefix(self, prefix: str) -> dict[str, str]:
+        self._count("range_prefix")
+        return self.inner.range_prefix(prefix)
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._count("delete_prefix")
+        self.inner.delete_prefix(prefix)
+
+    def _apply(self, ops: list[tuple]) -> None:
+        # delegate to the inner BACKEND's atomic _apply (not its public
+        # apply: the base template already validated and fired the crash
+        # points once — they must not fire twice per batch)
+        self._count("apply")
+        self.inner._apply(ops)
+
+    def close(self) -> None:
+        self.inner.close()
 
 
 def _b64(s: str) -> str:

@@ -23,8 +23,20 @@ class StateStore:
     # -- generic ----------------------------------------------------------------
 
     def _put(self, resource: Resource, base: str, version: int, payload: dict) -> None:
-        self.kv.put(keys.version_key(resource, base, version), json.dumps(payload))
-        self.kv.put(keys.latest_key(resource, base), str(version))
+        # one atomic apply, not two puts: the version record and the family's
+        # latest pointer land together — no crash window where a pointer
+        # names a spec that was never written (and one store round trip per
+        # version transition instead of two)
+        self.kv.apply(self._put_ops(resource, base, version, payload))
+
+    @staticmethod
+    def _put_ops(resource: Resource, base: str, version: int,
+                 payload: dict) -> list[tuple]:
+        return [
+            ("put", keys.version_key(resource, base, version),
+             json.dumps(payload)),
+            ("put", keys.latest_key(resource, base), str(version)),
+        ]
 
     def _get(self, resource: Resource, name: str) -> dict:
         """Fetch by versioned name, or by base name (⇒ latest version)."""

@@ -259,6 +259,10 @@ class WorkQueue:
     def _exec_delete_state_family(self, rec: TaskRecord) -> None:
         from tpu_docker_api.state.store import StateStore
 
+        # one delete_prefix round trip: the whole family subtree (every
+        # version + the latest pointer) drops atomically on every backend
+        # (single sqlite txn / single etcd DeleteRange) — a replayed purge
+        # can never leave half a family behind
         StateStore(self._kv).delete_family(
             keys.Resource(rec.params["resource"]), rec.params["base"])
 
@@ -546,19 +550,22 @@ class WorkQueue:
                 log.exception("compensation for %s failed", rec.label())
 
     def _ack(self, rec: TaskRecord) -> None:
-        """Done: drop the journal entry, then its marker (that order — the
-        marker must outlive the record, or a replay of a half-acked record
-        would re-copy), then release the local claim LAST so a concurrent
-        replayer can never adopt the record while its marker is going
-        away. A store outage leaves the entry inflight — the next replay
-        re-runs it, which the marker makes safe — so degrade loudly
-        rather than retry-looping."""
+        """Done: drop the journal entry and its marker in ONE atomic apply
+        — the old two-delete sequence had a crash window (entry gone,
+        marker leaked) that the orphan sweep existed to mop up; batching
+        closes it and halves the ack's store round trips. The local claim
+        releases LAST so a concurrent replayer can never adopt the record
+        while its marker is going away. A store outage leaves the entry
+        inflight — the next replay re-runs it, which the marker makes safe
+        — so degrade loudly rather than retry-looping."""
         rec.state = "done"
         try:
+            ops: list[tuple] = []
             if rec.seq >= 0:
-                self._kv.delete(keys.queue_task_key(rec.seq))
+                ops.append(("delete", keys.queue_task_key(rec.seq)))
             # degraded (seq<0) records may still have written a marker
-            self._kv.delete(keys.queue_marker_key(rec.task_id))
+            ops.append(("delete", keys.queue_marker_key(rec.task_id)))
+            self._kv.apply(ops)
         except Exception as e:  # noqa: BLE001
             self._degrade("journal-ack-failed", f"{rec.label()}: {e}")
         finally:
@@ -728,10 +735,16 @@ class WorkQueue:
             live = {rec.task_id for rec in records}
             with self._local_mu:
                 live |= self._local_ids
-            for key in self._kv.range_prefix(keys.QUEUE_MARKERS_PREFIX):
-                task_id = key.rsplit("/", 1)[-1]
-                if task_id not in live:
-                    self._kv.delete(key)
+            doomed = [
+                key for key in self._kv.range_prefix(keys.QUEUE_MARKERS_PREFIX)
+                if key.rsplit("/", 1)[-1] not in live
+            ]
+            # batched deletes, chunked under etcd's max-txn-ops (default
+            # 128) so a huge orphan backlog still GCs incrementally instead
+            # of failing wholesale forever (sweep is GC: no atomicity need)
+            for i in range(0, len(doomed), 100):
+                self._kv.apply([("delete", key)
+                                for key in doomed[i:i + 100]])
         except Exception as e:  # noqa: BLE001 — GC, never required
             log.warning("workqueue: marker sweep skipped: %s", e)
 
