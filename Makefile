@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn bench-failover openapi sample-interface run clean
 
 all: native openapi
 
@@ -46,6 +46,11 @@ bench-churn:                 ## control-plane churn family, reduced iters (fake 
 	$(PY) bench.py --control-plane --cp-family churn --cp-iters 40 --churn-gangs 6 > bench-churn.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-churn.json.tmp
 	mv bench-churn.json.tmp bench-churn.json
+
+bench-failover:              ## HA failover family: kill the leader under churn, time-to-recovered-writes + schema gate
+	$(PY) bench.py --control-plane --cp-family failover --failovers 4 > bench-failover.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-failover.json.tmp
+	mv bench-failover.json.tmp bench-failover.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

@@ -296,6 +296,192 @@ def measure_control_plane_churn(n_containers: int = 1000,
     }
 
 
+def measure_control_plane_failover(n_failovers: int = 5,
+                                   ttl_s: float = 1.0) -> dict:
+    """Control-plane failover family (``--control-plane --cp-family
+    failover``): two HA daemons (``leader_election = true``,
+    service/leader.py) over ONE shared store + fake runtime, with a churn
+    worker issuing container create/delete cycles at the current leader the
+    whole time. Each iteration HARD-kills the leader — heartbeat stopped
+    with the lease left in place, API closed, writers halted, exactly what
+    a SIGKILL leaves behind — and measures **time-to-recovered-writes**:
+    kill to the first mutation the standby accepts AND commits after
+    stealing the expired lease, replaying the dead leader's journal on the
+    way up (docs/robustness.md "HA control plane").
+
+    Self-gating like the churn family: every failover must recover, every
+    deposed leader's epoch-fenced write must be REJECTED by the store
+    (``errors.GuardFailed``), the fencing epoch must grow by exactly one
+    per handoff, and recovery p95 must stay inside a generous
+    TTL-derived budget. A violated gate flips ``gates.ok`` — main() turns
+    that into a nonzero exit."""
+    import statistics
+    import threading
+    import urllib.request
+
+    from tpu_docker_api import errors
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.state.kv import MemoryKV
+
+    if n_failovers < 2:
+        raise ValueError("failover needs >= 2 iterations for quantiles")
+    kv = MemoryKV()
+    runtime = FakeRuntime()
+
+    def boot(holder: str) -> Program:
+        prg = Program(Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            start_port=44000, end_port=44999, health_watch_interval=0,
+            reconcile_interval=0, leader_election=True,
+            leader_ttl_s=ttl_s, leader_id=holder,
+        ), host="127.0.0.1", kv=kv, runtime=runtime)
+        prg.init()
+        prg.start()
+        return prg
+
+    def wait_leader(prg: Program, timeout_s: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if prg.leader_elector.is_leader:
+                return
+            time.sleep(0.005)
+        raise RuntimeError(f"{prg.leader_elector.holder_id} never acquired "
+                           f"the lease within {timeout_s}s")
+
+    def call(port: int, method, path, body=None, timeout=5.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def hard_kill(prg: Program) -> None:
+        """What SIGKILL leaves: the lease NOT released (the standby must
+        wait out the TTL), no writer shutdown grace, API gone."""
+        prg.leader_elector.close(release=False)
+        prg.api_server.close()
+        prg._stop_writers()
+
+    # churn load: one container cycled at whichever daemon currently
+    # leads; failures during the failover window are the point, not a
+    # problem (a single reused name bounds any orphan to one container,
+    # which the next cycle's delete — or the new leader's startup
+    # reconcile — cleans up)
+    leader_port = {"port": 0}
+    stop_load = threading.Event()
+
+    def churn_load() -> None:
+        while not stop_load.is_set():
+            port = leader_port["port"]
+            try:
+                call(port, "POST", "/api/v1/containers",
+                     {"imageName": "jax", "containerName": "bench-load",
+                      "chipCount": 1})
+                call(port, "DELETE", "/api/v1/containers/bench-load",
+                     {"force": True, "delEtcdInfoAndVersionRecord": True})
+            except Exception:
+                try:
+                    call(port, "DELETE", "/api/v1/containers/bench-load",
+                         {"force": True, "delEtcdInfoAndVersionRecord": True})
+                except Exception:
+                    pass
+                stop_load.wait(0.01)
+
+    leader = boot("bench-a")
+    wait_leader(leader)
+    standby = boot("bench-b")
+    leader_port["port"] = leader.api_server.port
+    load_thread = threading.Thread(target=churn_load, daemon=True)
+    load_thread.start()
+
+    hard_timeout_s = max(ttl_s * 10, 30.0)
+    recoveries_ms: list[float] = []
+    epochs: list[int] = []
+    fenced_rejected = 0
+    recovered_all = True
+    try:
+        for k in range(n_failovers):
+            time.sleep(ttl_s / 2)  # let the churn worker actually churn
+            t0 = time.perf_counter()
+            hard_kill(leader)
+            # first ACCEPTED+COMMITTED mutation on the survivor = recovery
+            probe, recovered = f"fo{k}", False
+            while time.perf_counter() - t0 < hard_timeout_s:
+                try:
+                    call(standby.api_server.port, "POST",
+                         "/api/v1/containers",
+                         {"imageName": "jax", "containerName": probe,
+                          "chipCount": 1}, timeout=2.0)
+                    recovered = True
+                    break
+                except Exception:
+                    time.sleep(0.01)
+            if not recovered:
+                recovered_all = False
+                break
+            recoveries_ms.append((time.perf_counter() - t0) * 1e3)
+            epochs.append(standby.leader_elector.epoch)
+            leader_port["port"] = standby.api_server.port
+            call(standby.api_server.port, "DELETE",
+                 f"/api/v1/containers/{probe}",
+                 {"force": True, "delEtcdInfoAndVersionRecord": True})
+            # the deposed leader still believes it leads; the STORE must
+            # reject its epoch-fenced write
+            try:
+                leader.kv.put("/apis/v1/bench/fence-probe", "stale")
+            except errors.GuardFailed:
+                fenced_rejected += 1
+            except Exception:
+                pass
+            leader, standby = standby, boot(f"bench-{k}")
+    finally:
+        stop_load.set()
+        load_thread.join(timeout=5)
+        for prg in (leader, standby):
+            try:
+                prg.leader_elector.close(release=True)
+                prg.api_server.close()
+                prg._stop_writers()
+            except Exception:
+                pass
+
+    if not recovered_all or not recoveries_ms:
+        raise RuntimeError(
+            f"failover {len(recoveries_ms)}: standby never recovered "
+            f"writes within {hard_timeout_s}s")
+    qs = statistics.quantiles(recoveries_ms, n=20)
+    quants = {"p50": round(statistics.median(recoveries_ms), 3),
+              "p95": round(min(qs[18], max(recoveries_ms)), 3),
+              "max": round(max(recoveries_ms), 3)}
+    epoch_monotonic = all(b == a + 1 for a, b in zip(epochs, epochs[1:]))
+    # generous: expiry wait (ttl) + one renew interval of detection lag +
+    # slack for writer boot, journal replay and a loaded CI host
+    budget_ms = (ttl_s + ttl_s / 3.0 + 3.0) * 1e3
+    return {
+        "family": "failover",
+        "iters": {"failovers": n_failovers},
+        "ttl_s": ttl_s,
+        "recovery_ms": quants,
+        "recoveries_ms": [round(v, 3) for v in recoveries_ms],
+        "epochs": epochs,
+        "fenced": {"attempts": n_failovers, "rejected": fenced_rejected},
+        "gates": {
+            "recovered_all": recovered_all,
+            "fenced_rejected_all": fenced_rejected == n_failovers,
+            "epoch_monotonic": epoch_monotonic,
+            "recovery_p95_budget_ms": round(budget_ms, 1),
+            "ok": bool(recovered_all and fenced_rejected == n_failovers
+                       and epoch_monotonic and quants["p95"] <= budget_ms),
+        },
+    }
+
+
 def main() -> int | None:
     """Returns a nonzero exit code on backend-init failure (consumed by
     the ``sys.exit(main())`` entry); None = success."""
@@ -311,16 +497,24 @@ def main() -> int | None:
     parser.add_argument("--cp-runtime", default="fake",
                         choices=["fake", "docker"])
     parser.add_argument("--cp-family", default="create",
-                        choices=["create", "churn"],
+                        choices=["create", "churn", "failover"],
                         help="create = create→ready latency; churn = "
                              "create→ready→replace→delete for containers "
-                             "AND gangs with store round-trips per flow")
+                             "AND gangs with store round-trips per flow; "
+                             "failover = kill the HA leader under churn "
+                             "load, time-to-recovered-writes on the "
+                             "standby")
     parser.add_argument("--cp-iters", type=int, default=100,
                         help="iterations (create family) / container "
                              "cycles (churn family)")
     parser.add_argument("--churn-gangs", type=int, default=0,
                         help="gang cycles for the churn family; 0 = "
                              "cp-iters // 10 (min 2)")
+    parser.add_argument("--failovers", type=int, default=5,
+                        help="leader kills for the failover family")
+    parser.add_argument("--failover-ttl", type=float, default=1.0,
+                        help="leader lease TTL seconds for the failover "
+                             "family (the recovery ceiling under test)")
     parser.add_argument("--full", action="store_true",
                         help="also run the long-tail riders (the second "
                              "stream-count per serving point, unfused "
@@ -346,6 +540,9 @@ def main() -> int | None:
                 cp = measure_control_plane_churn(
                     args.cp_iters,
                     args.churn_gangs or max(args.cp_iters // 10, 2))
+            elif args.cp_family == "failover":
+                cp = measure_control_plane_failover(
+                    args.failovers, ttl_s=args.failover_ttl)
             else:
                 cp = measure_control_plane(args.cp_iters, args.cp_runtime)
         except Exception as e:
@@ -354,11 +551,18 @@ def main() -> int | None:
                   "error": {"error": f"{type(e).__name__}: {str(e)[:300]}",
                             "family": args.cp_family}})
             return 1
+        if args.cp_family == "failover":
+            headline = ("control_plane_failover_recovery_ms_p50",
+                        cp["recovery_ms"]["p50"])
+        elif args.cp_family == "churn":
+            headline = ("control_plane_churn_create_ready_ms_p50",
+                        cp["create_ready_ms_p50"])
+        else:
+            headline = ("container_create_ready_ms_p50",
+                        cp["create_ready_ms_p50"])
         emit({
-            "metric": ("control_plane_churn_create_ready_ms_p50"
-                       if args.cp_family == "churn"
-                       else "container_create_ready_ms_p50"),
-            "value": cp["create_ready_ms_p50"],
+            "metric": headline[0],
+            "value": headline[1],
             "unit": "ms",
             # the reference publishes no latency numbers (BASELINE.md) —
             # this metric exists to be measured, not compared
@@ -366,7 +570,8 @@ def main() -> int | None:
             "extra": cp,
         })
         if not cp.get("gates", {"ok": True})["ok"]:
-            emit({"metric": "control_plane_churn_gate", "value": 0,
+            emit({"metric": f"control_plane_{args.cp_family}_gate",
+                  "value": 0,
                   "unit": "bool", "vs_baseline": 0.0, "rc": 1,
                   "error": {"error": f"regression gate failed: "
                                      f"{cp['gates']}",

@@ -1,12 +1,16 @@
 #!/usr/bin/env python
-"""Validate the churn-family BENCH artifact (``make bench-churn``).
+"""Validate control-plane BENCH artifacts (``make bench-churn`` /
+``make bench-failover``).
 
 Reads JSON lines from stdin (or a file argument) and asserts the schema the
 driver-side BENCH pipeline consumes: every line carries the
-{metric, value, unit, vs_baseline} envelope, and the churn headline carries
-latency quantiles, per-flow store round trips, and a passing regression
-gate. Exit 0 = consumable artifact, nonzero = a structural problem printed
-one-per-line (the same loud-failure contract as bench_boot).
+{metric, value, unit, vs_baseline} envelope, and the family headline
+(detected from ``extra.family``) carries its full payload — latency
+quantiles, per-flow store round trips and a passing regression gate for
+``churn``; recovery quantiles, per-failover fencing proof and a passing
+regression gate for ``failover``. Exit 0 = consumable artifact, nonzero =
+a structural problem printed one-per-line (the same loud-failure contract
+as bench_boot).
 """
 
 from __future__ import annotations
@@ -24,6 +28,45 @@ ROUND_TRIP_FLOWS = ("container_create", "container_replace",
                     "gang_delete_4host")
 
 
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_failover(extra: dict) -> list[str]:
+    """The failover-family headline payload: recovery quantiles over N
+    leader kills, the fencing proof, and a passing gate."""
+    problems: list[str] = []
+    n = (extra.get("iters") or {}).get("failovers")
+    if not (isinstance(n, int) and n >= 2):
+        problems.append(f"failover: iters.failovers must be an int >= 2, "
+                        f"got {n!r}")
+    if not _num(extra.get("ttl_s")):
+        problems.append("failover: ttl_s is not a number")
+    rec = extra.get("recovery_ms") or {}
+    for q in QUANTS:
+        if not _num(rec.get(q)):
+            problems.append(f"failover: recovery_ms.{q} missing")
+    series = extra.get("recoveries_ms")
+    if (not isinstance(series, list) or len(series) != n
+            or not all(_num(v) and v > 0 for v in series)):
+        problems.append("failover: recoveries_ms must list one positive "
+                        "recovery per failover")
+    fenced = extra.get("fenced") or {}
+    if fenced.get("attempts") != n:
+        problems.append(f"failover: fenced.attempts != failovers: {fenced}")
+    if fenced.get("rejected") != n:
+        problems.append(f"failover: a deposed leader's write was NOT "
+                        f"rejected: {fenced}")
+    gates = extra.get("gates") or {}
+    for key in ("recovered_all", "fenced_rejected_all", "epoch_monotonic",
+                "recovery_p95_budget_ms", "ok"):
+        if key not in gates:
+            problems.append(f"failover: gates.{key} missing")
+    if gates.get("ok") is not True:
+        problems.append(f"failover: regression gate failed: {gates}")
+    return problems
+
+
 def validate_lines(lines: list[dict]) -> list[str]:
     """Return every schema violation found (empty = consumable)."""
     problems: list[str] = []
@@ -33,14 +76,18 @@ def validate_lines(lines: list[dict]) -> list[str]:
         missing = [k for k in ENVELOPE if k not in line]
         if missing:
             problems.append(f"line {i}: missing envelope keys {missing}")
+    failover = [ln for ln in lines
+                if (ln.get("extra") or {}).get("family") == "failover"]
+    if failover:
+        return problems + validate_failover(failover[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn headline line (extra.family == churn)"]
+        return problems + ["no churn or failover headline line "
+                           "(extra.family)"]
     extra = churn[0]["extra"]
 
-    def num(v) -> bool:
-        return isinstance(v, (int, float)) and not isinstance(v, bool)
+    num = _num
 
     if not num(extra.get("create_ready_ms_p50")):
         problems.append("churn: create_ready_ms_p50 is not a number")

@@ -176,6 +176,12 @@ def main() -> None:
          "Async tasks that exhausted their retries — never silently "
          "re-queued forever (the reference's workQueue loops infinitely).")
     call("GET", "/healthz", None)
+    call("GET", "/api/v1/leader", None,
+         "HA election view. This deployment runs without leader election "
+         "(`leader_election = false`), so the role is `single`; in a "
+         "replicated fleet one daemon reports `leader` and the rest "
+         "`standby` (standbys answer mutations with 503 + the holder as "
+         "redirect hint — see docs/robustness.md \"HA control plane\").")
     emit("`GET /metrics` serves Prometheus text format (request counts, "
          "latency histograms, chip/port/queue gauges).")
 

@@ -148,6 +148,53 @@ class TestChurnFamily:
                     <= stats[f"{flow}_ms_max"])
 
 
+class TestFailoverFamily:
+    """The HA failover family (``make bench-failover``): two leader-elected
+    daemons over one store, the leader hard-killed under churn load, at
+    tiny scale — pinning both the artifact schema
+    (scripts/check_churn_schema.py) and the tentpole invariants: writes
+    recover on the standby within the TTL-derived budget and every deposed
+    leader's epoch-fenced write is rejected by the store."""
+
+    @pytest.fixture(scope="class")
+    def failover(self):
+        return bench.measure_control_plane_failover(n_failovers=2,
+                                                    ttl_s=0.5)
+
+    def test_schema_checker_accepts_the_emitted_line(self, failover):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_failover_recovery_ms_p50",
+                "value": failover["recovery_ms"]["p50"], "unit": "ms",
+                "vs_baseline": 1.0, "extra": failover}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it,
+        # and so must a fenced write that LANDED
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["fenced"]["rejected"] = 0
+        assert any("rejected" in p for p in validate_lines([bad]))
+
+    def test_failover_gates_hold(self, failover):
+        gates = failover["gates"]
+        assert gates["ok"] is True
+        assert gates["recovered_all"] is True
+        assert gates["fenced_rejected_all"] is True
+        assert gates["epoch_monotonic"] is True
+        rec = failover["recovery_ms"]
+        assert rec["p50"] <= rec["p95"] <= rec["max"]
+        assert rec["p95"] <= gates["recovery_p95_budget_ms"]
+        # each handoff bumped the fencing epoch exactly once
+        assert failover["epochs"] == sorted(failover["epochs"])
+        assert len(failover["recoveries_ms"]) == 2
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

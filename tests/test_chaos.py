@@ -36,6 +36,7 @@ from tpu_docker_api.service.crashpoints import (
     CONTAINER_CRASH_POINTS,
     JOB_CRASH_POINTS,
     KNOWN_CRASH_POINTS,
+    LEADER_CRASH_POINTS,
     QUEUE_CRASH_POINTS,
     TXN_CRASH_POINTS,
     SimulatedCrash,
@@ -113,8 +114,11 @@ def test_case_matrix_covers_every_crash_point():
     # the txn matrix crashes three write flows on both sides of every
     # KV.apply commit they perform
     assert {p for _, p in TXN_CASES} == set(TXN_CRASH_POINTS)
+    # the failover matrix kills the leader at every election-lifecycle point
+    assert set(LEADER_POINTS) == set(LEADER_CRASH_POINTS)
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
+            | set(LEADER_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
 
@@ -1002,6 +1006,181 @@ def test_txn_boundary_crash_converges(tmp_path, flow, point):
     else:
         pytest.fail(f"{flow} never completed within 16 applies")
     assert crashes >= 1, f"{flow} performed no KV.apply at all"
+
+
+#: election-lifecycle crash points: the failover matrix kills the leader at
+#: each and proves the standby takes over within the lease TTL
+LEADER_POINTS = ("leader.after_acquire", "leader.after_start_writers",
+                 "leader.after_renew")
+
+
+def boot_ha(kv, runtime, holder, clock) -> Program:
+    """An HA fleet member over the shared KV + runtime: election on, writer
+    subsystems follow the lease, virtual clock drives TTL expiry. The
+    elector heartbeat thread is never started — tests step() it by hand."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099, host_probe_interval_s=0,
+        job_supervise_interval=0, reconcile_interval=0,
+        leader_election=True, leader_ttl_s=30.0, leader_id=holder,
+    )
+    prg = Program(cfg, kv=kv, runtime=runtime,
+                  leader_clock=lambda: clock["now"])
+    prg.init()
+    return prg
+
+
+class TestFailoverChaos:
+    """THE HA acceptance scenario (docs/robustness.md "HA control plane"):
+    two daemons over one KV; the leader is killed at every ``leader.*``
+    crash point mid-handoff of an interrupted rolling replace. The standby
+    must stay hands-off while the lease lives, acquire at the FIRST step
+    past the deadline (within the TTL), replay the dead leader's journal
+    (PR 5 machinery), and converge to one live version with zero leaks —
+    while every epoch-fenced write from the deposed leader is rejected by
+    the store itself."""
+
+    @pytest.mark.parametrize("point", LEADER_POINTS)
+    def test_leader_killed_standby_acquires_and_converges(self, tmp_path, point):
+        from tpu_docker_api import errors
+        from tpu_docker_api.state import keys
+        import json as _json
+
+        kv = MemoryKV()
+        runtime = FakeRuntime(root=str(tmp_path / "rt"))
+
+        # a PREVIOUS control-plane incarnation left an interrupted rolling
+        # replace: train-1 created, the copy+start record journaled but
+        # never executed (its queue never ran) — durable intent only
+        prg0 = boot(kv, runtime)
+        setup_family(prg0, tmp_path)
+        _grow(prg0.container_svc)
+
+        clock = {"now": 1000.0}
+        a = boot_ha(kv, runtime, "daemon-a", clock)
+        if point == "leader.after_renew":
+            # an ESTABLISHED leader: acquires cleanly (writers start, the
+            # journal replays under epoch 1), then dies right after a
+            # heartbeat renewal — the lease is freshly extended, so the
+            # standby must wait out the full TTL from the renewal
+            a.leader_elector.step()
+            assert a.leader_elector.is_leader
+            clock["now"] += 10.0
+            with armed(point):
+                with pytest.raises(SimulatedCrash):
+                    a.leader_elector.step()
+        else:
+            # dies mid-acquire: after_acquire = lease durable but writers
+            # never started (the journal record is still pending);
+            # after_start_writers = writers up and replay done, then death
+            with armed(point):
+                with pytest.raises(SimulatedCrash):
+                    a.leader_elector.step()
+        assert a.leader_elector.epoch == 1  # the fencing token it died with
+
+        # the standby: hands-off while the dead leader's lease is live
+        b = boot_ha(kv, runtime, "daemon-b", clock)
+        b.leader_elector.step()
+        assert not b.leader_elector.is_leader, "stole a live lease"
+        assert b.wq._thread is None  # writer subsystems truly idle
+
+        # ... and acquires at the FIRST step past the deadline (≤ TTL)
+        deadline = _json.loads(kv.get(keys.LEADER_LEASE_KEY))["deadline"]
+        assert deadline - clock["now"] <= b.cfg.leader_ttl_s
+        clock["now"] = deadline + 0.001
+        b.leader_elector.step()
+        assert b.leader_elector.is_leader
+        assert b.leader_elector.epoch == 2
+
+        # the acquire replayed the journal and converged the interrupted
+        # replace forward: one live version, data intact, zero leaks
+        problems = check_invariants(
+            runtime, b.store, b.container_versions,
+            b.chip_scheduler, b.port_scheduler)
+        assert problems == [], f"{point}: {problems}"
+        latest = b.container_versions.get("train")
+        assert latest == 1
+        running = [n for n in runtime.container_list()
+                   if runtime.container_inspect(n).running]
+        assert running == ["train-1"]
+        with open(f"{runtime.container_data_dir('train-1')}/ckpt.txt") as f:
+            assert f.read() == "step=100"
+        stats = b.wq.stats()
+        assert stats["journal"]["pending"] == 0
+        assert stats["journal"]["inflight"] == 0
+        # the repair is a fixpoint
+        assert b.reconciler.reconcile()["actions"] == []
+
+        # split-brain proof: the deposed leader still BELIEVES it leads,
+        # but every fenced write path loses the epoch compare — bare puts,
+        # journal-style applies, and a full StoreTxn commit alike
+        assert a.leader_elector.is_leader
+        store_before = dict(kv.range_prefix("/"))
+        with pytest.raises(errors.GuardFailed):
+            a.kv.put("/apis/v1/fence-probe", "stale")
+        with pytest.raises(errors.GuardFailed):
+            a.kv.apply([("delete", keys.LEADER_EPOCH_KEY)])
+        from tpu_docker_api.state.txn import StoreTxn
+        txn = StoreTxn(a.kv)
+        txn.add_op(("put", "/apis/v1/fence-probe", "via-txn"))
+        with pytest.raises(errors.GuardFailed):
+            txn.commit()
+        assert dict(kv.range_prefix("/")) == store_before
+        # ... while the new leader's writes (and renewals) sail through
+        b.kv.put("/apis/v1/fence-probe", "fresh")
+        assert kv.get("/apis/v1/fence-probe") == "fresh"
+        clock["now"] += 5.0
+        b.leader_elector.step()
+        assert b.leader_elector.is_leader
+
+    def test_deposed_leader_journal_claim_and_ack_are_fenced(self, tmp_path):
+        """The journal claim/ack path specifically: a record the OLD leader
+        is still executing when deposed must not claim, mutate, or ack
+        (journal delete) state the new leader owns — every fenced journal
+        write degrades loudly inside the queue, the record survives intact,
+        and the NEW leader's replay executes it exactly once."""
+        from tpu_docker_api import errors
+        from tpu_docker_api.service.leader import FencedKV, LeaderElector
+        from tpu_docker_api.state import keys
+        from tpu_docker_api.state.workqueue import WorkQueue
+
+        kv = MemoryKV()
+        clock = {"now": 0.0}
+        a = LeaderElector(kv, "daemon-a", ttl_s=30.0,
+                          clock=lambda: clock["now"])
+        b = LeaderElector(kv, "daemon-b", ttl_s=30.0,
+                          clock=lambda: clock["now"])
+        a.step()
+        assert a.is_leader
+
+        # A journals a record through its fenced store (sync loop never
+        # started: the record is pure durable intent when A is deposed)
+        wq_a = WorkQueue(FencedKV(kv, a.fence_guards),
+                         backoff_base_s=0.001, backoff_max_s=0.01, seed=1)
+        wq_a.submit_record("put_kv", {"key": "/apis/v1/x", "value": "1"})
+        clock["now"] += 31.0
+        b.step()
+        assert b.is_leader and b.epoch == 2
+
+        # A (unaware) now runs the record inline: the claim write, the
+        # handler's put and the ack are ALL fenced — nothing lands, the
+        # failures are loud, and the journal entry is untouched
+        journal_before = dict(kv.range_prefix(keys.QUEUE_TASKS_PREFIX))
+        assert len(journal_before) == 1
+        wq_a.replay_journal(include_local=True)
+        stats = wq_a.stats()
+        assert stats["journalWriteFailures"] > 0
+        assert any("guard on " + keys.LEADER_EPOCH_KEY in e["detail"]
+                   for e in stats["events"])
+        assert kv.get_or("/apis/v1/x") is None  # the effect never landed
+        assert dict(kv.range_prefix(keys.QUEUE_TASKS_PREFIX)) == journal_before
+
+        # the NEW leader's (fenced, epoch 2) queue adopts and finishes it
+        wq_b = WorkQueue(FencedKV(kv, b.fence_guards))
+        outcomes = wq_b.replay_journal()
+        assert [o["state"] for o in outcomes] == ["done"]
+        assert kv.get("/apis/v1/x") == "1"
+        assert kv.range_prefix(keys.QUEUE_TASKS_PREFIX) == {}
 
 
 def test_txn_before_apply_leaves_batch_unwritten(tmp_path):

@@ -85,12 +85,28 @@ class _FakeGateway(BaseHTTPRequestHandler):
         self.send_error(404)
 
     def _do_txn(self, body: dict):
-        """No-compare txn: the success branch always commits, atomically —
-        staged against a copy so a rejected batch changes nothing. Enforces
-        etcd's duplicate-key rule (server txn.go checkIntervals: a put may
-        not overlap another put or a delete range in the same branch), so a
-        production batch the real server would reject fails here too."""
+        """Txn with compare support: evaluate the ``compare`` list against
+        the live store first — any mismatch answers with ``succeeded``
+        omitted (proto3 JSON drops false booleans) and commits NOTHING.
+        The success branch then commits atomically — staged against a copy
+        so a rejected batch changes nothing. Enforces etcd's duplicate-key
+        rule (server txn.go checkIntervals: a put may not overlap another
+        put or a delete range in the same branch), so a production batch
+        the real server would reject fails here too."""
         self.server.txn_count += 1
+        for cmp_ in body.get("compare", []):
+            k = base64.b64decode(cmp_["key"])
+            if cmp_.get("target") == "VERSION":
+                # the absence guard: VERSION == 0 ⇔ key never put
+                want_absent = str(cmp_.get("version", "0")) == "0"
+                if (k in self.store) == want_absent:
+                    return self._reply({"header": {}})
+            elif cmp_.get("target") == "VALUE":
+                want = base64.b64decode(cmp_.get("value", ""))
+                if self.store.get(k) != want:
+                    return self._reply({"header": {}})
+            else:
+                return self.send_error(400, "unsupported compare target")
 
         def covers(k: bytes, key: bytes, range_end: bytes | None) -> bool:
             if range_end is None:

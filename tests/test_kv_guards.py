@@ -1,0 +1,258 @@
+"""Guarded-write (CAS) conformance across all three KV backends.
+
+One contract, three implementations: etcd's native ``/v3/kv/txn`` compares,
+sqlite's compare-inside-the-transaction (BEGIN IMMEDIATE), and memory's
+compare-under-the-lock. The suite pins the properties the HA control plane
+rides on:
+
+- the contention LOSER gets the typed :class:`errors.GuardFailed` and the
+  store shows the winner's write untouched;
+- create-if-absent (``expected=None``) admits exactly one creator;
+- a failed guard applies NOTHING of a multi-op batch (compare and commit
+  are one atomic unit);
+- on etcd, a guarded apply is ONE ``/v3/kv/txn`` round trip riding the
+  normalize-but-never-retry WRITE path;
+- a deposed leader's epoch-fenced write is rejected on every backend
+  (the acceptance-criteria split-brain proof, driven through the real
+  LeaderElector + FencedKV pair).
+"""
+
+import threading
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import EtcdKV, MemoryKV, SqliteKV
+
+BACKENDS = ("memory", "sqlite", "etcd")
+
+
+@pytest.fixture()
+def gateway():
+    # the bytes-level fake etcd grpc-gateway, shared with test_etcd_kv
+    # (pytest puts this directory on sys.path in no-package layouts)
+    from http.server import ThreadingHTTPServer
+
+    from test_etcd_kv import _FakeGateway
+
+    pytest.importorskip("requests")
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGateway)
+    server.store = {}
+    server.fail_next = 0
+    server.fail_seen = 0
+    server.txn_count = 0
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+@pytest.fixture(params=BACKENDS)
+def kv(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryKV()
+    elif request.param == "sqlite":
+        s = SqliteKV(str(tmp_path / "guards.db"))
+        yield s
+        s.close()
+    else:
+        gw = request.getfixturevalue("gateway")
+        yield EtcdKV(f"http://127.0.0.1:{gw.server_address[1]}")
+
+
+class TestGuardContract:
+    def test_cas_create_if_absent_admits_one_creator(self, kv):
+        kv.cas("/lease", None, "holder-a")
+        assert kv.get("/lease") == "holder-a"
+        with pytest.raises(errors.GuardFailed):
+            kv.cas("/lease", None, "holder-b")  # the loser, typed
+        assert kv.get("/lease") == "holder-a"   # winner untouched
+
+    def test_cas_value_compare_loser_gets_typed_failure(self, kv):
+        kv.put("/k", "v1")
+        kv.cas("/k", "v1", "v2")
+        with pytest.raises(errors.GuardFailed):
+            kv.cas("/k", "v1", "v3")  # stale expectation
+        assert kv.get("/k") == "v2"
+
+    def test_guard_against_absent_key_fails_value_compare(self, kv):
+        with pytest.raises(errors.GuardFailed):
+            kv.cas("/missing", "anything", "new")
+        assert kv.get_or("/missing") is None
+
+    def test_failed_guard_applies_nothing_of_the_batch(self, kv):
+        """Compare and commit are one atomic unit: a lost guard must not
+        leak ANY op of a multi-op batch (the lease + epoch write pair the
+        elector issues)."""
+        kv.put("/lease", "someone-else")
+        kv.put("/epoch", "7")
+        kv.put("/fam/a", "1")
+        with pytest.raises(errors.GuardFailed):
+            kv.apply(
+                [("put", "/lease", "me"), ("put", "/epoch", "8"),
+                 ("delete", "/fam/a"), ("delete_prefix", "/fam/")],
+                guards=[("value", "/lease", "nobody")])
+        assert kv.get("/lease") == "someone-else"
+        assert kv.get("/epoch") == "7"
+        assert kv.get("/fam/a") == "1"
+
+    def test_guard_only_apply_asserts_without_writing(self, kv):
+        """An ops-free guarded apply is a pure fencing assert: it raises on
+        mismatch and writes nothing on success."""
+        kv.put("/epoch", "3")
+        kv.apply([], guards=[("value", "/epoch", "3")])
+        with pytest.raises(errors.GuardFailed):
+            kv.apply([], guards=[("value", "/epoch", "4")])
+
+    def test_guarded_apply_passes_and_lands_whole_batch(self, kv):
+        kv.put("/lease", "old")
+        kv.apply([("put", "/lease", "new"), ("put", "/epoch", "1")],
+                 guards=[("value", "/lease", "old")])
+        assert kv.get("/lease") == "new"
+        assert kv.get("/epoch") == "1"
+
+    def test_malformed_guard_rejected_before_any_write(self, kv):
+        for bad in [("value", "/k"), ("version", "/k", "1"),
+                    ("value", "/k", 7)]:
+            with pytest.raises(ValueError):
+                kv.apply([("put", "/ok", "1")], guards=[bad])
+        assert kv.get_or("/ok") is None
+
+    def test_racing_cas_admits_exactly_one_winner(self, kv):
+        """The elector race at its smallest: N writers CAS from the same
+        observed base; exactly one lands, the rest get the typed loss."""
+        kv.put("/lease", "expired")
+        outcomes: list[str] = []
+        mu = threading.Lock()
+
+        def contender(name: str):
+            try:
+                kv.cas("/lease", "expired", name)
+                with mu:
+                    outcomes.append(name)
+            except errors.GuardFailed:
+                pass
+
+        threads = [threading.Thread(target=contender, args=(f"w{i}",))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(outcomes) == 1
+        assert kv.get("/lease") == outcomes[0]
+
+
+class TestEtcdGuardWire:
+    """etcd specifics: the guarded apply is ONE native txn with compares,
+    and it rides the WRITE path — normalized to StoreUnavailable after
+    exactly one attempt, never blind-retried (an ambiguous timeout could
+    have committed; a retry could double-steal a lease)."""
+
+    def _kv(self, gateway, attempts=3):
+        return EtcdKV(f"http://127.0.0.1:{gateway.server_address[1]}",
+                      retry_attempts=attempts, retry_base_s=0.001,
+                      retry_max_s=0.01)
+
+    def test_guarded_apply_is_one_txn_round_trip(self, gateway):
+        kv = self._kv(gateway)
+        kv.put("/lease", "old")
+        gateway.txn_count = 0
+        kv.apply([("put", "/lease", "new"), ("put", "/epoch", "5")],
+                 guards=[("value", "/lease", "old")])
+        assert gateway.txn_count == 1  # compare + both puts: ONE round trip
+        gateway.txn_count = 0
+        with pytest.raises(errors.GuardFailed):
+            kv.apply([("put", "/lease", "x")],
+                     guards=[("value", "/lease", "old")])
+        assert gateway.txn_count == 1  # the loss is also a single trip
+
+    def test_absence_guard_maps_to_version_zero_compare(self, gateway):
+        kv = self._kv(gateway)
+        kv.apply([("put", "/lease", "me")],
+                 guards=[("value", "/lease", None)])
+        assert kv.get("/lease") == "me"
+        with pytest.raises(errors.GuardFailed):
+            kv.apply([("put", "/lease", "you")],
+                     guards=[("value", "/lease", None)])
+        assert kv.get("/lease") == "me"
+
+    def test_guarded_write_never_retried_on_connection_fault(self, gateway):
+        kv = self._kv(gateway, attempts=3)
+        gateway.fail_next = 1
+        with pytest.raises(errors.StoreUnavailable):
+            kv.cas("/lease", None, "me")
+        # exactly ONE attempt consumed the fault despite the read budget
+        assert gateway.fail_seen == 1
+        assert gateway.fail_next == 0
+        assert kv.get_or("/lease") is None
+
+    def test_guard_failure_is_not_store_unavailable(self, gateway):
+        """The two txn outcomes must stay distinguishable: a lost compare
+        is a typed app-level loss, not an outage (and vice versa)."""
+        kv = self._kv(gateway)
+        kv.put("/k", "v")
+        with pytest.raises(errors.GuardFailed) as ei:
+            kv.cas("/k", "stale", "new")
+        assert not isinstance(ei.value, errors.StoreUnavailable)
+
+
+@pytest.mark.chaos
+class TestEpochFencingAcrossBackends:
+    """Acceptance criterion: a deposed leader's epoch-fenced write is
+    rejected on all three KV backends — driven through the real elector +
+    FencedKV pair, exactly as the daemon wires them."""
+
+    def test_deposed_leader_write_rejected(self, kv):
+        from tpu_docker_api.service.leader import FencedKV, LeaderElector
+
+        clock = {"now": 100.0}
+        a = LeaderElector(kv, "daemon-a", ttl_s=10.0,
+                          clock=lambda: clock["now"])
+        b = LeaderElector(kv, "daemon-b", ttl_s=10.0,
+                          clock=lambda: clock["now"])
+        fenced_a = FencedKV(kv, a.fence_guards)
+        fenced_b = FencedKV(kv, b.fence_guards)
+
+        a.step()
+        assert a.is_leader and a.epoch == 1
+        fenced_a.put("/apis/v1/probe", "from-a")  # fenced write while leading
+
+        # A goes silent past its TTL; B steals with a bumped epoch
+        clock["now"] += 11.0
+        b.step()
+        assert b.is_leader and b.epoch == 2
+        assert kv.get(keys.LEADER_EPOCH_KEY) == "2"
+
+        # A still BELIEVES it leads (a partitioned daemon does); its next
+        # write loses the epoch compare on the store itself
+        assert a.is_leader
+        with pytest.raises(errors.GuardFailed):
+            fenced_a.put("/apis/v1/probe", "stale-from-a")
+        with pytest.raises(errors.GuardFailed):
+            fenced_a.apply([("delete", "/apis/v1/probe")])
+        assert kv.get("/apis/v1/probe") == "from-a"
+        # ... and the new leader's writes sail through
+        fenced_b.put("/apis/v1/probe", "from-b")
+        assert kv.get("/apis/v1/probe") == "from-b"
+
+    def test_release_keeps_epoch_monotonic(self, kv):
+        """A graceful release deletes the lease but never the epoch key:
+        leadership handed back and forth must yield strictly increasing
+        epochs, or fencing would admit a stale writer."""
+        from tpu_docker_api.service.leader import LeaderElector
+
+        clock = {"now": 0.0}
+        a = LeaderElector(kv, "a", ttl_s=5.0, clock=lambda: clock["now"])
+        b = LeaderElector(kv, "b", ttl_s=5.0, clock=lambda: clock["now"])
+        a.step()
+        assert a.epoch == 1
+        a.close(release=True)
+        assert kv.get_or(keys.LEADER_LEASE_KEY) is None
+        assert kv.get(keys.LEADER_EPOCH_KEY) == "1"
+        b.step()  # immediate acquire — no TTL wait after a clean release
+        assert b.is_leader and b.epoch == 2

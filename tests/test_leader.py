@@ -1,0 +1,415 @@
+"""Leader election, role-split daemon, and standby HTTP contract
+(docs/robustness.md "HA control plane").
+
+The elector unit tests drive :meth:`LeaderElector.step` with a virtual
+clock; the daemon tests boot two real ``Program``s over one shared KV and
+assert the role split end to end over HTTP: API serving is always-on,
+writer subsystems follow the lease, standbys answer mutations with 503 +
+the leader hint, and ``leader_election = false`` (the default) keeps the
+single-process behavior.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.service.leader import FencedKV, LeaderElector
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+
+
+def lease(kv) -> dict | None:
+    raw = kv.get_or(keys.LEADER_LEASE_KEY)
+    return None if raw is None else json.loads(raw)
+
+
+class TestLeaderElector:
+    def _pair(self, kv=None, ttl=10.0, **kwargs):
+        kv = kv or MemoryKV()
+        clock = {"now": 1000.0}
+        mk = lambda name: LeaderElector(kv, name, ttl_s=ttl,
+                                        clock=lambda: clock["now"], **kwargs)
+        return kv, clock, mk("a"), mk("b")
+
+    def test_acquire_on_empty_store_epoch_one(self):
+        kv, clock, a, _ = self._pair()
+        a.step()
+        assert a.is_leader and a.epoch == 1
+        rec = lease(kv)
+        assert rec["holderId"] == "a" and rec["epoch"] == 1
+        assert rec["deadline"] == pytest.approx(1000.0 + 10.0)
+        assert kv.get(keys.LEADER_EPOCH_KEY) == "1"
+        view = a.status_view()
+        assert view["role"] == "leader" and view["fencingEpoch"] == 1
+
+    def test_standby_defers_to_live_lease_then_steals_expired(self):
+        kv, clock, a, b = self._pair()
+        a.step()
+        b.step()
+        assert not b.is_leader  # live lease: no steal, no split brain
+        assert b.status_view()["role"] == "standby"
+        assert b.status_view()["holderId"] == "a"
+        clock["now"] += 10.001  # a went silent past its TTL
+        b.step()
+        assert b.is_leader and b.epoch == 2
+        assert lease(kv)["holderId"] == "b"
+
+    def test_renew_extends_deadline_within_ttl(self):
+        kv, clock, a, b = self._pair()
+        a.step()
+        clock["now"] += 6.0
+        a.step()  # renew at t+6: deadline pushed to t+16
+        assert lease(kv)["deadline"] == pytest.approx(1006.0 + 10.0)
+        clock["now"] += 6.0  # t+12: original deadline passed, renewed not
+        b.step()
+        assert not b.is_leader  # the renewal kept the lease alive
+        assert a.is_leader
+
+    def test_deposed_leader_demotes_on_renew_and_fires_on_loss(self):
+        losses = []
+        kv = MemoryKV()
+        clock = {"now": 0.0}
+        a = LeaderElector(kv, "a", ttl_s=5.0, clock=lambda: clock["now"],
+                          on_loss=lambda reason: losses.append(reason))
+        b = LeaderElector(kv, "b", ttl_s=5.0, clock=lambda: clock["now"])
+        a.step()
+        clock["now"] += 6.0
+        b.step()  # steals the expired lease
+        assert b.is_leader
+        a.step()  # a's renew CAS loses against b's record
+        assert not a.is_leader
+        assert len(losses) == 1 and "stolen" in losses[0]
+        # the fencing epoch survives demotion: in-flight writes keep failing
+        assert a.epoch == 1
+        assert a.fence_guards() == [("value", keys.LEADER_EPOCH_KEY, "1")]
+
+    def test_on_acquire_fires_with_epoch(self):
+        acquired = []
+        kv = MemoryKV()
+        a = LeaderElector(kv, "a", ttl_s=5.0, clock=lambda: 0.0,
+                          on_acquire=lambda epoch: acquired.append(epoch))
+        a.step()
+        a.step()  # renewals must NOT re-fire the callback
+        assert acquired == [1]
+
+    def test_losing_contender_stays_standby_without_callbacks(self):
+        kv, clock, a, b = self._pair()
+        a.step()
+        # b races on the same expired view a just refreshed — the CAS on
+        # the exact observed value makes b lose cleanly
+        clock["now"] += 10.001
+        a.step()  # a renews late but first
+        b.step()  # b read the OLD record... a's renew already landed
+        # exactly one leader either way
+        assert a.is_leader != b.is_leader or not (a.is_leader and b.is_leader)
+        assert lease(kv)["epoch"] == max(a.epoch, b.epoch)
+
+    def test_fence_guards_empty_before_first_acquire(self):
+        kv, _, a, _ = self._pair()
+        assert a.fence_guards() == []
+        fenced = FencedKV(kv, a.fence_guards)
+        fenced.put("/boot", "ok")  # pre-acquire writes pass unfenced
+        assert kv.get("/boot") == "ok"
+
+    def test_unreadable_lease_record_is_treated_as_expired(self):
+        kv, clock, a, _ = self._pair()
+        kv.put(keys.LEADER_LEASE_KEY, "not json {")
+        a.step()
+        assert a.is_leader and a.epoch == 1
+
+    def test_epoch_outgrows_tampered_epoch_key(self):
+        """The epoch key may outrun the lease record (a release keeps it);
+        acquisition must take the max of both before bumping."""
+        kv, clock, a, _ = self._pair()
+        kv.put(keys.LEADER_EPOCH_KEY, "41")
+        a.step()
+        assert a.epoch == 42
+
+    def test_hard_close_keeps_lease_for_ttl(self):
+        kv, clock, a, b = self._pair()
+        a.step()
+        a.close(release=False)  # the bench/chaos hard-kill model
+        b.step()
+        assert not b.is_leader  # lease still held until expiry
+        clock["now"] += 10.001
+        b.step()
+        assert b.is_leader
+
+    def test_mutation_gate_closed_until_on_acquire_completes(self):
+        """accepts_mutations opens only AFTER on_acquire returns (the API
+        gate must not admit writes against mirrors the leadership handoff
+        is still re-seeding), and closes before on_loss fires."""
+        seen = {}
+        kv = MemoryKV()
+        clock = {"now": 1000.0}
+        a = LeaderElector(
+            kv, "a", ttl_s=10.0, clock=lambda: clock["now"],
+            on_acquire=lambda e: seen.update(
+                during_acquire=a.accepts_mutations),
+            on_loss=lambda r: seen.update(during_loss=a.accepts_mutations))
+        assert not a.accepts_mutations
+        a.step()
+        assert seen["during_acquire"] is False  # boot window: gate closed
+        assert a.accepts_mutations  # ... and opens once writers are up
+        # deposed: the gate closes before the writers are torn down
+        b = LeaderElector(kv, "b", ttl_s=10.0, clock=lambda: clock["now"])
+        clock["now"] += 10.001
+        b.step()
+        a.step()  # renew loses its CAS → demote
+        assert seen["during_loss"] is False
+        assert not a.accepts_mutations and not a.is_leader
+
+    def test_leader_hint_served_without_store_reads(self):
+        """The 503 path must not turn a retry storm against a standby into
+        store traffic: after a heartbeat observed the lease, the hint is
+        answered from memory (fresh reads happen at heartbeat cadence)."""
+        reads = {"n": 0}
+
+        class _CountingReads(MemoryKV):
+            def get(self, key):
+                reads["n"] += 1
+                return super().get(key)
+
+        kv = _CountingReads()
+        clock = {"now": 1000.0}
+        mk = lambda n: LeaderElector(kv, n, ttl_s=10.0,
+                                     clock=lambda: clock["now"])
+        a, b = mk("a"), mk("b")
+        a.step()
+        b.step()  # standby heartbeat: observes a's lease
+        before = reads["n"]
+        for _ in range(50):
+            msg = b.standby_message()
+            assert "a" in msg
+            assert b.leader_hint()["holderId"] == "a"
+        assert reads["n"] == before  # zero store reads on the 503 path
+        # the leader's own hint is equally store-free
+        before = reads["n"]
+        assert a.leader_hint()["holderId"] == "a"
+        assert reads["n"] == before
+        # the next heartbeat refreshes the observation (bounded staleness)
+        clock["now"] += 10.001
+        b.step()
+        assert b.is_leader
+        assert b.leader_hint()["holderId"] == "b"
+
+
+def _ha_config(**over):
+    base = dict(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=41000, end_port=41099, health_watch_interval=0,
+        host_probe_interval_s=0, job_supervise_interval=0,
+        reconcile_interval=0,
+        leader_election=True, leader_ttl_s=5.0,
+        leader_renew_interval_s=0.05,
+    )
+    base.update(over)
+    return config_mod.Config(**base)
+
+
+def call(port, method, path, body=None):
+    """(http_status, envelope) — urllib raises on 503, the standby's
+    whole point, so both arms funnel to one return shape."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def wait_until(fn, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+class TestDaemonRoleSplit:
+    @pytest.fixture()
+    def fleet(self):
+        """Two daemons, one shared KV + runtime, virtual lease clock (the
+        heartbeat threads are real; the frozen clock pins who may steal)."""
+        kv = MemoryKV()
+        runtime = FakeRuntime()
+        clock = {"now": 0.0}
+        progs = []
+        for name in ("alpha", "beta"):
+            prg = Program(_ha_config(leader_id=name), host="127.0.0.1",
+                          kv=kv, runtime=runtime,
+                          leader_clock=lambda: clock["now"])
+            prg.init()
+            progs.append(prg)
+        progs[0].start()
+        wait_until(lambda: progs[0].leader_elector.is_leader, what="alpha lease")
+        progs[1].start()
+        try:
+            yield kv, clock, progs
+        finally:
+            for prg in progs:
+                try:
+                    prg.stop()
+                except Exception:
+                    pass
+
+    def test_standby_serves_reads_and_503s_mutations_with_hint(self, fleet):
+        kv, clock, (alpha, beta) = fleet
+        a_port, b_port = alpha.api_server.port, beta.api_server.port
+        assert not beta.leader_elector.is_leader
+
+        # the leader takes the mutation
+        status, out = call(a_port, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "web", "chipCount": 0})
+        assert (status, out["code"]) == (200, 200)
+
+        # the standby serves reads — including state the leader just wrote
+        status, out = call(b_port, "GET", "/api/v1/containers/web-0")
+        assert (status, out["code"]) == (200, 200)
+        status, out = call(b_port, "GET", "/healthz")
+        assert out["data"]["role"] == "standby"
+
+        # ... and 503s every mutation, with the leader as the hint
+        status, out = call(b_port, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "nope", "chipCount": 0})
+        assert status == 503
+        assert out["code"] == errors.NotLeader.code
+        assert "alpha" in out["msg"]
+        status, out = call(b_port, "DELETE", "/api/v1/containers/web")
+        assert (status, out["code"]) == (503, errors.NotLeader.code)
+        # nothing half-validated, nothing created
+        assert beta.container_versions.get("nope") is None
+
+        # role views agree
+        _, out = call(a_port, "GET", "/api/v1/leader")
+        assert out["data"]["role"] == "leader"
+        assert out["data"]["holderId"] == "alpha"
+        assert out["data"]["epoch"] == 1
+        _, out = call(b_port, "GET", "/api/v1/leader")
+        assert out["data"]["role"] == "standby"
+        assert out["data"]["holderId"] == "alpha"
+        _, out = call(a_port, "GET", "/healthz")
+        assert out["data"]["role"] == "leader"
+
+        # writer subsystems follow the lease: only the leader's queue runs
+        assert not alpha.wq.closed
+        assert beta.wq._thread is None
+
+    def test_standby_reads_track_leader_rolls_and_deletes(self, fleet):
+        """Staleness on a standby is bounded by ONE store read, not by the
+        standby's lifetime: version bumps (rolling replace) and family
+        deletes the leader performs after the standby booted must be
+        visible to the standby's next read."""
+        kv, clock, (alpha, beta) = fleet
+        a_port, b_port = alpha.api_server.port, beta.api_server.port
+
+        status, out = call(a_port, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "web", "chipCount": 2})
+        assert (status, out["code"]) == (200, 200)
+        assert beta.container_versions.get("web") == 0
+
+        # the leader rolls web 0 → 1 behind the standby's back
+        status, out = call(a_port, "PATCH", "/api/v1/containers/web-0/tpu",
+                           {"chipCount": 4})
+        assert (status, out["code"]) == (200, 200)
+        assert beta.container_versions.get("web") == 1
+        status, out = call(b_port, "GET", "/api/v1/containers/web-1")
+        assert (status, out["code"]) == (200, 200)
+
+        # ... and deletes the family: the standby must not resurrect it
+        status, out = call(a_port, "DELETE", "/api/v1/containers/web", {
+            "force": True, "delEtcdInfoAndVersionRecord": True})
+        assert (status, out["code"]) == (200, 200)
+        wait_until(lambda: beta.container_versions.get("web") is None,
+                   what="standby observing the delete")
+        assert "web" not in beta.container_versions.snapshot()
+
+    def test_graceful_stop_hands_over_without_ttl_wait(self, fleet):
+        kv, clock, (alpha, beta) = fleet
+        b_port = beta.api_server.port
+        alpha.stop()  # releases the lease (clock frozen: no expiry path)
+        # accepts_mutations, not is_leader: the gate stays closed until
+        # beta's writer subsystems finish booting
+        wait_until(lambda: beta.leader_elector.accepts_mutations,
+                   what="beta lease")
+        status, out = call(b_port, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": "after", "chipCount": 0})
+        assert (status, out["code"]) == (200, 200)
+        _, out = call(b_port, "GET", "/api/v1/leader")
+        assert out["data"]["role"] == "leader"
+        assert out["data"]["epoch"] == 2  # epochs only ever go up
+
+    def test_election_disabled_default_single_process_behavior(self, tmp_path):
+        """leader_election = false (the default): no elector, writers start
+        unconditionally, mutations work, /healthz says single."""
+        cfg = config_mod.Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            start_port=41100, end_port=41199, health_watch_interval=0)
+        assert cfg.leader_election is False
+        prg = Program(cfg, host="127.0.0.1", kv=MemoryKV(),
+                      runtime=FakeRuntime())
+        prg.init()
+        assert prg.leader_elector is None
+        assert prg.kv is prg._raw_kv  # no fencing wrapper in the write path
+        prg.start()
+        try:
+            port = prg.api_server.port
+            assert prg.wq._thread is not None  # writers started in start()
+            status, out = call(port, "POST", "/api/v1/containers", {
+                "imageName": "jax", "containerName": "solo", "chipCount": 0})
+            assert (status, out["code"]) == (200, 200)
+            _, out = call(port, "GET", "/healthz")
+            assert out["data"]["role"] == "single"
+            _, out = call(port, "GET", "/api/v1/leader")
+            assert out["data"] == {
+                "election": False, "role": "single", "accepting": True,
+                "selfId": None, "holderId": None, "epoch": None,
+                "deadline": None, "advertise": "", "ttlS": None,
+                "fencingEpoch": 0}
+        finally:
+            prg.stop()
+
+
+class TestProgramStopPartialInit:
+    """Satellite: stop() tolerates a partially-completed init, so a failed
+    boot surfaces its root cause instead of an AttributeError from cleanup."""
+
+    def test_stop_before_init_is_safe(self):
+        prg = Program(config_mod.Config(store_backend="memory",
+                                        runtime_backend="fake"))
+        prg.stop()  # nothing initialized: must be a clean no-op
+
+    def test_stop_after_failed_store_open_is_safe(self):
+        cfg = config_mod.Config(store_backend="etcd",
+                                etcd_addr="http://127.0.0.1:9",  # discard port
+                                runtime_backend="fake")
+        prg = Program(cfg)
+        with pytest.raises(errors.StoreUnavailable):
+            prg.init()
+        prg.stop()  # kv/wq/pod never materialized
+
+    def test_stop_after_mid_init_failure_closes_what_exists(self):
+        """Die between the work queue and the pod (the detect sidecar is
+        unreachable): stop() must close the live subsystems and skip the
+        missing ones."""
+        pytest.importorskip("requests")
+        cfg = config_mod.Config(
+            store_backend="memory", runtime_backend="fake",
+            detect_tpu_addr="http://127.0.0.1:9")
+        prg = Program(cfg, kv=MemoryKV(), runtime=FakeRuntime())
+        with pytest.raises(Exception):
+            prg.init()  # topology discovery explodes after kv/wq exist
+        assert hasattr(prg, "wq") and not hasattr(prg, "pod")
+        prg.stop()
+        assert prg.wq.closed

@@ -78,6 +78,29 @@ class TestChipScheduler:
         ys = {c[1] for c in coords}
         assert len(xs) == 2 and len(ys) == 2  # a 2x2 block, not a line
 
+    def test_boot_restore_is_read_only(self):
+        """Re-constructing over an existing registry must issue ZERO store
+        writes: under HA a standby boots while the leader is live, and a
+        boot write-back (unfenced — the standby never held an epoch) would
+        clobber claims the leader committed after the standby's read. Only
+        a topology change (stored chips outside the current grid) may
+        persist, because dropping them is a genuine repair."""
+        sched, kv = self.make()
+        sched.apply_chips(4, owner="held")
+        counting = CountingKV(kv)
+        restored = ChipScheduler(HostTopology.build("v5e-8"), counting)
+        assert restored.status()["freeChips"] == 4  # the claim survived
+        writes = {m: n for m, n in counting.snapshot().items()
+                  if m in ("put", "delete", "delete_prefix", "apply")}
+        assert writes == {}, f"boot restore wrote to the store: {writes}"
+        # the repair path still persists: a shrunk topology drops chips
+        sched.apply_chips(4, owner="rest")  # now every chip 0-7 is owned
+        smaller = ChipScheduler(HostTopology.build("v5e-4"), counting)
+        after = counting.snapshot()
+        assert after.get("apply", 0) + after.get("put", 0) >= 1
+        assert smaller.status()["totalChips"] == 4
+        assert smaller.status()["freeChips"] == 0  # in-grid claims kept
+
     def test_deterministic(self):
         """Reference iterates a Go map ⇒ nondeterministic pick
         (gpuscheduler/scheduler.go:74-82). Ours must be reproducible."""

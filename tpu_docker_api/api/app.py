@@ -35,6 +35,7 @@ Route table:
     POST   /api/v1/hosts/{name}/uncordon       lift the cordon
     POST   /api/v1/hosts/{name}/drain          cordon + migrate gangs off (async)
     GET    /api/v1/health/hosts                per-host probe + breaker state
+    GET    /api/v1/leader                      election role, holder, epoch, lease deadline
     GET    /api/v1/queue                       durable work-queue stats
     GET    /api/v1/dead-letters                durable dead-letter set
     POST   /api/v1/dead-letters/retry          re-enqueue the dead letters
@@ -107,6 +108,8 @@ class Router:
 
         self._routes: list[tuple[str, re.Pattern, str, callable]] = []
         self.metrics = metrics if metrics is not None else MetricsRegistry()
+        #: HA role gate; build_router sets it (None = no gating)
+        self.leader_elector = None
 
     def add(self, method: str, pattern: str, handler) -> None:
         regex = re.compile(
@@ -137,8 +140,14 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  chip_scheduler, port_scheduler, work_queue=None,
                  health_watcher=None, metrics=None,
                  job_svc=None, pod_scheduler=None, reconciler=None,
-                 job_supervisor=None, host_monitor=None) -> Router:
+                 job_supervisor=None, host_monitor=None,
+                 leader_elector=None) -> Router:
     r = Router(metrics=metrics)
+    # HA role gate (service/leader.py): on a standby replica every non-GET
+    # request is answered 503 + the leader hint BEFORE dispatch — reads
+    # stay local, mutations belong to the lease holder. None (single-
+    # process, or election disabled) gates nothing.
+    r.leader_elector = leader_elector
 
     # -- containers (reference api/container.go:19-38) ---------------------------
 
@@ -359,14 +368,32 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/resources/tpus", lambda body, **_: chip_scheduler.status())
     r.add("GET", "/api/v1/resources/gpus", lambda body, **_: chip_scheduler.status())
     r.add("GET", "/api/v1/resources/ports", lambda body, **_: port_scheduler.status())
-    r.add("GET", "/healthz",
-          lambda body, **_: {"status": "ok", **build_info()})
+
+    def healthz(body, **_):
+        # role surfaced next to liveness: load balancers route mutations by
+        # it, and "single" keeps the no-election deployment unambiguous
+        role = ("single" if leader_elector is None
+                else ("leader" if leader_elector.is_leader else "standby"))
+        return {"status": "ok", "role": role, **build_info()}
+
+    r.add("GET", "/healthz", healthz)
+
+    def leader_view(body, **_):
+        if leader_elector is None:
+            return {"election": False, "role": "single", "accepting": True,
+                    "selfId": None, "holderId": None, "epoch": None,
+                    "deadline": None, "advertise": "", "ttlS": None,
+                    "fencingEpoch": 0}
+        return leader_elector.status_view()
+
+    r.add("GET", "/api/v1/leader", leader_view)
     if (health_watcher is not None or job_supervisor is not None
-            or host_monitor is not None):
+            or host_monitor is not None or leader_elector is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
-        # supervisor) and host health transitions (host monitor), ordered
-        # by timestamp (SURVEY.md §5.3)
+        # supervisor), host health transitions (host monitor) and
+        # leadership transitions (elector), ordered by timestamp
+        # (SURVEY.md §5.3)
         def h_events(body, **_):
             try:
                 limit = int(body.get("limit", 100))
@@ -379,6 +406,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                 events.extend(job_supervisor.events_view(limit=limit))
             if host_monitor is not None:
                 events.extend(host_monitor.events_view(limit=limit))
+            if leader_elector is not None:
+                events.extend(leader_elector.events_view(limit=limit))
             events.sort(key=lambda e: e.get("ts", 0))
             return events[-limit:] if limit > 0 else []
 
@@ -495,8 +524,22 @@ def build_handler(router: Router):
                 if found is None:
                     raise errors.BadRequest(f"no route for {method} {path}")
                 handler, params, _ = found
+                # body read (drained even for requests we reject: leaving
+                # it on a keep-alive socket would desync the connection —
+                # the next request would be parsed from leftover bytes)...
                 length = int(self.headers.get("Content-Length") or 0)
                 raw = self.rfile.read(length) if length else b""
+                # ...but the HA standby contract gates BEFORE parsing or
+                # validating it: reads (GET) serve locally, every mutation
+                # gets 503 + the leader hint — a standby never
+                # half-validates a request it will not execute. Mutations
+                # are also rejected while a NEW leader's writer subsystems
+                # are still booting (accepts_mutations), so no request can
+                # race the leadership-handoff cache reload
+                elector = router.leader_elector
+                if (method != "GET" and elector is not None
+                        and not elector.accepts_mutations):
+                    raise errors.NotLeader(elector.standby_message())
                 body = json.loads(raw) if raw else {}
                 if not isinstance(body, dict):
                     raise errors.BadRequest("body must be a JSON object")

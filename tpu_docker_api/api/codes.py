@@ -25,12 +25,14 @@ MESSAGES: dict[int, str] = {
     10403: "bytes in use exceed the requested size",
     10501: "not found in state store",
     10502: "state store unavailable",
+    10503: "guarded write lost its compare",
     10601: "not enough free TPU chips",
     10602: "not enough free host ports",
     10603: "unknown TPU topology",
     10701: "host engine unreachable",
     10801: "work queue saturated; retry later",
     10802: "work queue closed",
+    10901: "not the leader; send mutations to the lease holder",
 }
 
 

@@ -200,6 +200,11 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Per-job gang status: phase (running/restarting/migrating/failed/"
      "stopped), restart + migration budgets, dead/missing members, "
      "unreachable hosts, backoff remaining", None),
+    ("GET", "/api/v1/leader", "getLeader",
+     "HA control-plane election view: this replica's role (single/leader/"
+     "standby), the lease holder, the monotonically increasing fencing "
+     "epoch, and the lease deadline. Standbys answer every mutation with "
+     "503 + this holder as the redirect hint", None),
     ("GET", "/api/v1/queue", "getQueueStats",
      "Durable work-queue view: in-memory depth, journal lifecycle counts "
      "(pending/inflight/dead), degradation events and counters", None),

@@ -101,6 +101,23 @@ class Config:
     store_retry_attempts: int = 3
     store_retry_base_s: float = 0.05
     store_retry_max_s: float = 1.0
+    # HA control plane (service/leader.py): when true, this daemon is one
+    # replica of a fleet sharing the state store — API serving is always-on,
+    # but the writer subsystems (work-queue sync loop, reconciler, job
+    # supervisor, host monitor, health watcher) run only while this replica
+    # holds the leader lease; standbys serve reads and answer mutations
+    # with 503 + a leader hint. False (the default) keeps today's
+    # single-process behavior exactly: no lease, no fencing, writers start
+    # unconditionally.
+    leader_election: bool = False
+    # lease time-to-live: a dead leader's lease is stealable this long
+    # after its last renewal — the failover ceiling
+    leader_ttl_s: float = 15.0
+    # heartbeat renewal interval; 0 ⇒ ttl/3 (renew well inside the TTL so
+    # one missed heartbeat never costs the lease)
+    leader_renew_interval_s: float = 0.0
+    # identity in the lease record; "" ⇒ hostname:pid
+    leader_id: str = ""
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container

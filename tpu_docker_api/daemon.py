@@ -40,7 +40,8 @@ log = logging.getLogger(__name__)
 
 class Program:
     def __init__(self, cfg: config_mod.Config, host: str = "0.0.0.0",
-                 kv=None, runtime=None, pod_runtimes=None) -> None:
+                 kv=None, runtime=None, pod_runtimes=None,
+                 leader_clock=None) -> None:
         self.cfg = cfg
         self.host = host
         self.api_server: ApiServer | None = None
@@ -50,10 +51,13 @@ class Program:
         # Program a fresh empty store and hide every crash bug).
         # ``pod_runtimes`` extends the seam to multi-host pods: host_id →
         # runtime for non-local [[pod_hosts]] entries, so a "restarted"
-        # daemon sees the same remote engines the dead one drove
+        # daemon sees the same remote engines the dead one drove.
+        # ``leader_clock`` extends it to the leader lease: the failover
+        # chaos harness drives TTL expiry with a virtual clock
         self._injected_kv = kv
         self._injected_runtime = runtime
         self._injected_pod_runtimes = pod_runtimes or {}
+        self._injected_leader_clock = leader_clock
 
     def init(self) -> None:
         cfg = self.cfg
@@ -62,13 +66,30 @@ class Program:
         # metrics first: the work queue's degradation counters need a home
         # before any durable submit can happen
         self.metrics = MetricsRegistry()
-        self.kv = self._injected_kv or open_store(
+        raw_kv = self._injected_kv or open_store(
             cfg.store_backend, etcd_addr=cfg.etcd_addr,
             sqlite_path=cfg.sqlite_path,
             retry_attempts=cfg.store_retry_attempts,
             retry_base_s=cfg.store_retry_base_s,
             retry_max_s=cfg.store_retry_max_s,
         )
+        self._raw_kv = raw_kv
+        self.leader_elector = None
+        if cfg.leader_election:
+            # HA fleet member: EVERY write this process issues — StoreTxn
+            # commits, journal claim/ack, scheduler persists — carries an
+            # epoch-fencing guard once the elector has held leadership, so
+            # a deposed leader's in-flight writes fail typed instead of
+            # corrupting state the new leader owns. The elector itself is
+            # constructed at the end of init (its callbacks start/stop the
+            # writer subsystems built below); the fence closure reads it
+            # late. leader_election = false skips the wrapper entirely:
+            # single-process deployments keep today's store byte-for-byte
+            from tpu_docker_api.service.leader import FencedKV
+
+            self.kv = FencedKV(raw_kv, self._fence_guards)
+        else:
+            self.kv = raw_kv
         self.store = StateStore(self.kv)
         self.runtime = self._injected_runtime or (
             open_runtime("docker", docker_host=cfg.docker_host)
@@ -86,8 +107,22 @@ class Program:
         self.port_scheduler = PortScheduler(
             self.kv, cfg.start_port, cfg.end_port
         )
-        self.container_versions = VersionMap(self.kv, keys.VERSIONS_CONTAINER_KEY)
-        self.volume_versions = VersionMap(self.kv, keys.VERSIONS_VOLUME_KEY)
+        # read-through while STANDING BY: the leader creates, rolls and
+        # deletes families behind this replica's back, so a standby's
+        # version reads must re-seed from the store every time (staleness
+        # bounded by one read). The callable resolves the role live —
+        # once this replica leads, its own map is authoritative again and
+        # the extra reads stop
+        standby_read_through = (
+            (lambda: self.leader_elector is not None
+             and not self.leader_elector.is_leader)
+            if cfg.leader_election else False)
+        self.container_versions = VersionMap(
+            self.kv, keys.VERSIONS_CONTAINER_KEY,
+            read_through=standby_read_through)
+        self.volume_versions = VersionMap(
+            self.kv, keys.VERSIONS_VOLUME_KEY,
+            read_through=standby_read_through)
         self.container_svc = ContainerService(
             self.runtime, self.store, self.chip_scheduler, self.port_scheduler,
             self.container_versions, self.wq, libtpu_path=cfg.libtpu_path,
@@ -97,7 +132,8 @@ class Program:
         )
         self.pod = self._build_pod(topology)
         self.pod_scheduler = PodScheduler(self.pod, self.kv)
-        self.job_versions = VersionMap(self.kv, keys.VERSIONS_JOB_KEY)
+        self.job_versions = VersionMap(self.kv, keys.VERSIONS_JOB_KEY,
+                                       read_through=standby_read_through)
         self.job_svc = JobService(
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path,
@@ -154,6 +190,69 @@ class Program:
             # family state
             work_queue=self.wq,
         )
+        # constructed here (not in start) so the router always has the
+        # instance regardless of role: on an HA standby the watcher exists
+        # but only STARTS when the lease is acquired
+        self.health_watcher = None
+        if cfg.health_watch_interval > 0:
+            from tpu_docker_api.service.watch import HealthWatcher
+
+            self.health_watcher = HealthWatcher(
+                self.runtime,
+                interval_s=cfg.health_watch_interval,
+                restart_policy=cfg.restart_policy,
+                crash_handler=self.container_svc.handle_crash,
+                # gang members are the supervisor's: the container path
+                # declines them (never restart one member in isolation).
+                # Only wired when the supervisor loop actually runs —
+                # delegating to a stopped supervisor would strand crashed
+                # members with no recovery path at all
+                job_crash_handler=(
+                    self.job_supervisor.handle_member_death
+                    if cfg.job_supervise_interval > 0 else None),
+                restart_backoff_s=cfg.restart_backoff_s,
+                restart_backoff_max_s=cfg.restart_backoff_max_s,
+                registry=self.metrics,
+            )
+        if cfg.leader_election:
+            import os
+            import socket
+
+            from tpu_docker_api.service.leader import LeaderElector
+
+            holder = cfg.leader_id or f"{socket.gethostname()}:{os.getpid()}"
+            elector_kwargs = {}
+            if self._injected_leader_clock is not None:
+                elector_kwargs["clock"] = self._injected_leader_clock
+            # the elector rides the RAW store: its lease writes carry their
+            # own CAS guards (fencing the epoch bump on the epoch it
+            # replaces would be circular)
+            self.leader_elector = LeaderElector(
+                raw_kv, holder_id=holder, ttl_s=cfg.leader_ttl_s,
+                renew_interval_s=cfg.leader_renew_interval_s or None,
+                on_acquire=lambda epoch: self._start_writers(),
+                on_loss=lambda reason: self._stop_writers(),
+                advertise=f"{self.host}:{cfg.port}",
+                **elector_kwargs,
+            )
+
+    def _reload_caches(self) -> None:
+        """Re-read every stateful mirror (version maps, slice registry +
+        cordons, per-host chip/port maps — the local host's schedulers are
+        shared with the pod, so the host walk covers them)."""
+        for vm in (self.container_versions, self.volume_versions,
+                   self.job_versions):
+            vm.reload_from_store()
+        self.pod_scheduler.reload_from_store()
+        for host in self.pod.hosts.values():
+            host.chips.reload_from_store()
+            host.ports.reload_from_store()
+
+    def _fence_guards(self) -> list:
+        """Fence closure for the FencedKV wrapper (leader_election only):
+        empty until the elector first acquires, then the acquired epoch."""
+        elector = getattr(self, "leader_elector", None)
+        return [] if elector is None else elector.fence_guards()
 
     def _build_pod(self, local_topology: HostTopology) -> Pod:
         """Multi-host pod from [[pod_hosts]] config, else a single-host pod
@@ -249,14 +348,29 @@ class Program:
                  cfg.accelerator_type)
         return HostTopology.build(cfg.accelerator_type)
 
-    def start(self) -> None:
+    def _start_writers(self) -> None:
+        """The writer role: every subsystem that MUTATES shared state.
+        Single-process deployments run this unconditionally in start();
+        in an HA fleet (leader_election = true) exactly one replica runs
+        it at a time — on lease acquire — and halts it on loss, so the
+        invariants the chaos suite proves survive N daemons sharing one
+        store."""
+        if self.leader_elector is not None:
+            # leadership handoff, step one: re-seed every in-memory KV
+            # mirror from the store. This replica may have booted long
+            # before the dead leader's last write — supervising gangs or
+            # sweeping leaks against boot-time scheduler/version snapshots
+            # would re-allocate claimed chips and "repair" healthy state
+            self._reload_caches()
         self.wq.start()
         if self.cfg.reconcile_on_start:
             # repair whatever a previous incarnation left half-done BEFORE
             # serving traffic (an interrupted rolling replace must not be
-            # visible as two live versions). A failed sweep must not block
-            # boot — a recovery feature that crash-loops the daemon is worse
-            # than the drift it would repair
+            # visible as two live versions) — under leader election this is
+            # also the journal-ownership handoff: the new leader adopts and
+            # replays the dead one's pending records here. A failed sweep
+            # must not block boot — a recovery feature that crash-loops the
+            # daemon is worse than the drift it would repair
             try:
                 report = self.reconciler.reconcile()
                 if report["actions"]:
@@ -272,49 +386,13 @@ class Program:
             self.job_supervisor.start()
         if self.host_monitor is not None:
             self.host_monitor.start()
-        self.health_watcher = None
-        if self.cfg.health_watch_interval > 0:
-            from tpu_docker_api.service.watch import HealthWatcher
-
-            self.health_watcher = HealthWatcher(
-                self.runtime,
-                interval_s=self.cfg.health_watch_interval,
-                restart_policy=self.cfg.restart_policy,
-                crash_handler=self.container_svc.handle_crash,
-                # gang members are the supervisor's: the container path
-                # declines them (never restart one member in isolation).
-                # Only wired when the supervisor loop actually runs —
-                # delegating to a stopped supervisor would strand crashed
-                # members with no recovery path at all
-                job_crash_handler=(
-                    self.job_supervisor.handle_member_death
-                    if self.cfg.job_supervise_interval > 0 else None),
-                restart_backoff_s=self.cfg.restart_backoff_s,
-                restart_backoff_max_s=self.cfg.restart_backoff_max_s,
-                registry=self.metrics,
-            )
+        if self.health_watcher is not None:
             self.health_watcher.start()
-        router = build_router(
-            self.container_svc, self.volume_svc,
-            self.chip_scheduler, self.port_scheduler, work_queue=self.wq,
-            health_watcher=self.health_watcher, metrics=self.metrics,
-            job_svc=self.job_svc, pod_scheduler=self.pod_scheduler,
-            reconciler=self.reconciler, job_supervisor=self.job_supervisor,
-            host_monitor=self.host_monitor,
-        )
-        bi = build_info()  # warm the git probe BEFORE serving /healthz
-        self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
-        self.api_server.start()
-        log.info("tpu-docker-api %s (%s@%s) serving on %s:%d "
-                 "(%d chips, ports %d-%d)",
-                 bi["version"], bi["branch"], bi["commit"],
-                 self.host, self.api_server.port,
-                 self.chip_scheduler.topology.n_chips,
-                 self.cfg.start_port, self.cfg.end_port)
 
-    def stop(self) -> None:
-        if self.api_server:
-            self.api_server.close()
+    def _stop_writers(self) -> None:
+        """Halt the writer role (lease loss, shutdown). Every close is
+        guarded and restartable: a later re-acquire calls _start_writers
+        again on the same instances."""
         if getattr(self, "health_watcher", None) is not None:
             self.health_watcher.close()
         if getattr(self, "host_monitor", None) is not None:
@@ -323,12 +401,58 @@ class Program:
             self.job_supervisor.close()
         if getattr(self, "reconciler", None) is not None:
             self.reconciler.close()
-        self.wq.close()
-        for host in self.pod.hosts.values():
-            if host.runtime is not self.runtime:
-                host.runtime.close()
-        self.runtime.close()
-        self.kv.close()
+        if getattr(self, "wq", None) is not None:
+            self.wq.close()
+
+    def start(self) -> None:
+        if self.leader_elector is None:
+            # single-process: writers start unconditionally, as always
+            self._start_writers()
+        router = build_router(
+            self.container_svc, self.volume_svc,
+            self.chip_scheduler, self.port_scheduler, work_queue=self.wq,
+            health_watcher=self.health_watcher, metrics=self.metrics,
+            job_svc=self.job_svc, pod_scheduler=self.pod_scheduler,
+            reconciler=self.reconciler, job_supervisor=self.job_supervisor,
+            host_monitor=self.host_monitor,
+            leader_elector=self.leader_elector,
+        )
+        bi = build_info()  # warm the git probe BEFORE serving /healthz
+        self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
+        self.api_server.start()
+        if self.leader_elector is not None:
+            # serving is up (reads + 503-with-hint on mutations) BEFORE the
+            # election begins: a standby is useful from its first second
+            self.leader_elector.start()
+        log.info("tpu-docker-api %s (%s@%s) serving on %s:%d "
+                 "(%d chips, ports %d-%d)%s",
+                 bi["version"], bi["branch"], bi["commit"],
+                 self.host, self.api_server.port,
+                 self.chip_scheduler.topology.n_chips,
+                 self.cfg.start_port, self.cfg.end_port,
+                 " [leader election enabled]"
+                 if self.leader_elector is not None else "")
+
+    def stop(self) -> None:
+        """Shutdown — tolerant of a partially-completed init (every subsystem
+        access is guarded), so a failed boot reports its root cause instead
+        of masking it with an AttributeError during cleanup."""
+        if getattr(self, "api_server", None) is not None:
+            self.api_server.close()
+        if getattr(self, "leader_elector", None) is not None:
+            # graceful: release the lease so the standby takes over NOW
+            # instead of waiting out the TTL (the epoch key stays put —
+            # fencing monotonicity)
+            self.leader_elector.close(release=True)
+        self._stop_writers()
+        if getattr(self, "pod", None) is not None:
+            for host in self.pod.hosts.values():
+                if host.runtime is not self.runtime:
+                    host.runtime.close()
+        if getattr(self, "runtime", None) is not None:
+            self.runtime.close()
+        if getattr(self, "kv", None) is not None:
+            self.kv.close()
         log.info("tpu-docker-api stopped")
 
 

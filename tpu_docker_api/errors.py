@@ -109,6 +109,17 @@ class StoreUnavailable(ApiError):
     code = 10502
 
 
+class GuardFailed(ApiError):
+    """A guarded KV write (``KV.apply(..., guards=...)`` / ``KV.cas``) lost
+    its compare: the store's current value no longer matches what the
+    writer asserted. This is the typed contention-loser signal — a lease
+    CAS that raced another elector, or an epoch-fenced write from a leader
+    that was deposed mid-flight. NEVER blind-retried at the KV layer: the
+    caller must re-read and re-decide (an elector demotes; a fenced writer
+    abandons the flow for the new leader to own)."""
+    code = 10503
+
+
 # --- schedulers (xerrors/scheduler.go:8-10) -----------------------------------
 
 class ChipNotEnough(ApiError):
@@ -144,6 +155,17 @@ class QueueClosed(ApiError):
     request succeeds against the next daemon, so retry-aware clients and
     proxies must see transient backpressure, not a final app error."""
     code = 10802
+    http_status = 503
+
+
+# --- leader election (service/leader.py) --------------------------------------
+
+class NotLeader(ApiError):
+    """This replica is a standby: it serves reads, but mutations belong to
+    the lease holder. HTTP 503 (like QueueClosed) so retry-aware clients
+    and proxies treat it as transient routing, not a final app error — the
+    message carries the current leader's identity as the redirect hint."""
+    code = 10901
     http_status = 503
 
 

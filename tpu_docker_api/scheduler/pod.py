@@ -199,6 +199,19 @@ class PodScheduler:
         #:  possibly-stale verdict from before its own death
         self._down: set[str] = set()
 
+    def reload_from_store(self) -> None:
+        """Replace the slice registry + cordon mirrors with the store's
+        truth — the leadership-handoff cache refresh. The down set stays:
+        it is this process's OWN reachability observation, not shared
+        state."""
+        raw = self._kv.get_or(self._key)
+        cordon_raw = self._kv.get_or(self._cordon_key)
+        with self._mu:
+            self._grants = ({o: SliceAllocation.from_dict(d)
+                             for o, d in json.loads(raw).items()}
+                            if raw else {})
+            self._cordoned = set(json.loads(cordon_raw)) if cordon_raw else set()
+
     # -- persistence -------------------------------------------------------------
 
     def _serialized_locked(self) -> str:

@@ -39,15 +39,29 @@ class PortScheduler:
         self._cursor = start_port
         raw = kv.get_or(store_key)
         if raw:
-            state = json.loads(raw)
-            used = state["used"]
-            if isinstance(used, list):  # legacy ownerless layout
-                used = {p: "" for p in used}
-            self._used = {int(p): o for p, o in used.items()
-                          if start_port <= int(p) <= end_port}
-            self._cursor = state.get("cursor", start_port)
-            if not start_port <= self._cursor <= end_port:
-                self._cursor = start_port
+            self._restore_locked(raw)
+
+    def _restore_locked(self, raw: str) -> None:
+        state = json.loads(raw)
+        used = state["used"]
+        if isinstance(used, list):  # legacy ownerless layout
+            used = {p: "" for p in used}
+        self._used = {int(p): o for p, o in used.items()
+                      if self.start_port <= int(p) <= self.end_port}
+        self._cursor = state.get("cursor", self.start_port)
+        if not self.start_port <= self._cursor <= self.end_port:
+            self._cursor = self.start_port
+
+    def reload_from_store(self) -> None:
+        """Replace the in-memory mirror with the store's truth — the
+        leadership-handoff cache refresh (see ChipScheduler)."""
+        raw = self._kv.get_or(self._key)
+        with self._mu:
+            if raw:
+                self._restore_locked(raw)
+            else:
+                self._used = {}
+                self._cursor = self.start_port
 
     def _serialized_locked(self) -> str:
         return json.dumps({"used": {str(p): o for p, o in sorted(self._used.items())},

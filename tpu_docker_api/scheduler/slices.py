@@ -74,9 +74,26 @@ class ChipScheduler:
         raw = kv.get_or(store_key)
         if raw:
             # restore-from-store path (reference initFormEtcd, scheduler.go:123-140)
-            self._used = {int(k): v for k, v in json.loads(raw).items()
-                          if int(k) in topology.coords}
-            self._persist_locked()
+            stored = {int(k): v for k, v in json.loads(raw).items()}
+            self._used = {k: v for k, v in stored.items()
+                          if k in topology.coords}
+            if self._used != stored:
+                # persist ONLY when the topology filter dropped chips (a
+                # genuine repair after a topology change). An unconditional
+                # boot write-back would let a booting HA standby — whose
+                # fence is still empty — clobber a claim the live leader
+                # committed between our read and this write
+                self._persist_locked()
+
+    def reload_from_store(self) -> None:
+        """Replace the in-memory ownership mirror with the store's truth —
+        the leadership-handoff cache refresh: a standby promoted to leader
+        may have booted long before the old leader's last claim. Read-only
+        (no re-persist): refreshing a cache must never be a write."""
+        raw = self._kv.get_or(self._key)
+        with self._mu:
+            self._used = ({int(k): v for k, v in json.loads(raw).items()
+                           if int(k) in self.topology.coords} if raw else {})
 
     # -- persistence -------------------------------------------------------------
 

@@ -85,8 +85,25 @@ TXN_CRASH_POINTS = (
     "txn.after_apply",
 )
 
+#: leader-election lifecycle (service/leader.py): the failover chaos matrix
+#: kills the leader daemon at each of these and proves the standby acquires
+#: within the lease TTL, replays the journal, and converges — while the
+#: deposed leader's epoch-fenced writes are rejected
+LEADER_CRASH_POINTS = (
+    # lease + epoch durably written (we hold leadership), the on-acquire
+    # callbacks (writer-subsystem boot, startup reconcile) not yet run
+    "leader.after_acquire",
+    # writer subsystems started and the startup reconcile/replay finished —
+    # the steady state every established leader dies from
+    "leader.after_start_writers",
+    # heartbeat renewal landed: the lease deadline was just pushed out, so
+    # a standby must wait out the FULL TTL before stealing
+    "leader.after_renew",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
-                      + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS)
+                      + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
+                      + LEADER_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

@@ -301,6 +301,9 @@ class HostMonitor:
     # -- lifecycle ---------------------------------------------------------------
 
     def start(self) -> None:
+        # clear, don't assume fresh: under leader election the monitor is
+        # stopped on lease loss and restarted on re-acquire
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="host-monitor", daemon=True)
         self._thread.start()

@@ -127,6 +127,9 @@ class JobSupervisor:
     # -- lifecycle ---------------------------------------------------------------
 
     def start(self) -> None:
+        # clear, don't assume fresh: under leader election the supervisor
+        # is stopped on lease loss and restarted on re-acquire
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="job-supervise", daemon=True)
         self._thread.start()

@@ -124,6 +124,9 @@ class Reconciler:
     # -- lifecycle (periodic mode) ------------------------------------------------
 
     def start_periodic(self, interval_s: float) -> None:
+        # clear, don't assume fresh: under leader election the periodic
+        # sweep is stopped on lease loss and restarted on re-acquire
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, args=(interval_s,), name="reconcile", daemon=True)
         self._thread.start()

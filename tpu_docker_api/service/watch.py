@@ -91,6 +91,9 @@ class HealthWatcher:
     # -- lifecycle ---------------------------------------------------------------
 
     def start(self) -> None:
+        # clear, don't assume fresh: under leader election the watcher is
+        # stopped on lease loss and restarted on re-acquire
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="health-watch", daemon=True)
         self._thread.start()

@@ -90,6 +90,20 @@ VERSIONS_VOLUME_KEY = f"{PREFIX}/versions/volumes"
 VERSIONS_JOB_KEY = f"{PREFIX}/versions/jobs"
 
 
+# -- leader election (service/leader.py) ---------------------------------------
+#: the TTL lease record: JSON {holderId, epoch, deadline, ttlS, advertise}.
+#: Written ONLY via CAS on its previous exact value (create-if-absent on an
+#: empty store), renewed by the holder's heartbeat, stolen after expiry.
+LEADER_LEASE_KEY = f"{PREFIX}/leader/lease"
+#: the fencing token: the epoch number alone, bumped atomically with every
+#: leadership change and NEVER deleted (a graceful release drops the lease
+#: but keeps the epoch, so epochs are monotonic across the store's whole
+#: life). Every write a leader issues is guarded on this key still holding
+#: the epoch it acquired — a deposed leader's in-flight write loses the
+#: compare instead of corrupting state the new leader owns.
+LEADER_EPOCH_KEY = f"{PREFIX}/leader/epoch"
+
+
 #: operator cordon set (service/host_health.py + scheduler/pod.py): JSON
 #: list of host ids that must receive no new placements; persisted so a
 #: cordon survives daemon restarts (uncordon is the only way out)

@@ -13,6 +13,13 @@ and which per-version key layout (state/keys.py) needs, and ``apply`` — an
 atomic multi-key put/delete batch (the etcd txn / Kubernetes-apiserver write
 pattern) so a version transition is ONE store round trip instead of a
 sequence of windows a crash can land between.
+
+``apply`` also takes **guards** — compare preconditions evaluated atomically
+with the batch (etcd's native txn compares; sqlite/memory check under the
+same txn/lock that applies the ops). A failed guard applies NOTHING and
+raises the typed :class:`errors.GuardFailed`. This is the primitive the HA
+control plane rides: leader-lease CAS (service/leader.py) and epoch fencing
+of a deposed leader's writes are both one guarded apply.
 """
 
 from __future__ import annotations
@@ -28,6 +35,28 @@ from tpu_docker_api import errors
 #: op kinds KV.apply accepts: ("put", key, value) | ("delete", key) |
 #: ("delete_prefix", prefix)
 _APPLY_OPS = {"put": 3, "delete": 2, "delete_prefix": 2}
+
+
+def _check_guards(guards: list[tuple] | None) -> list[tuple]:
+    """Validate guard shapes: ``("value", key, expected)`` with expected a
+    str (current value must equal it) or None (key must be absent)."""
+    guards = list(guards or [])
+    for g in guards:
+        if (len(g) != 3 or g[0] != "value" or not isinstance(g[1], str)
+                or not (g[2] is None or isinstance(g[2], str))):
+            raise ValueError(f"malformed guard {g!r}")
+    return guards
+
+
+def _guard_mismatch(key: str, expected: str | None,
+                    actual: str | None) -> "errors.GuardFailed":
+    def short(v):
+        if v is None:
+            return "<absent>"
+        return v if len(v) <= 64 else v[:61] + "..."
+
+    return errors.GuardFailed(
+        f"guard on {key}: expected {short(expected)}, found {short(actual)}")
 
 
 class KV(abc.ABC):
@@ -52,29 +81,46 @@ class KV(abc.ABC):
         for k in self.range_prefix(prefix):
             self.delete(k)
 
-    def apply(self, ops: list[tuple]) -> None:
+    def apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
         """Atomically apply a batch of ``("put", k, v)`` / ``("delete", k)``
         / ``("delete_prefix", p)`` ops — all land or none do. The two
         ``txn.*`` crash points bracket the commit so the chaos suite can
         prove both halves of the contract: a crash BEFORE the txn leaves
         nothing applied, a crash AFTER leaves everything applied (and the
-        reconciler finishes the flow forward). Subclasses override
-        ``_apply`` with a genuinely atomic implementation; the base
-        fallback (sequential ops) keeps wrapper/test KVs working but is
-        NOT atomic."""
+        reconciler finishes the flow forward).
+
+        ``guards`` are compare preconditions — ``("value", key, expected)``
+        where ``expected`` is the exact current value (str) or None for
+        "key must be absent" — evaluated atomically WITH the batch: a
+        mismatch applies nothing and raises the typed
+        :class:`errors.GuardFailed` (the contention loser's signal; never
+        blind-retried at this layer). Subclasses override ``_apply`` with a
+        genuinely atomic implementation; the base fallback (check, then
+        sequential ops) keeps wrapper/test KVs working but is NOT atomic."""
         from tpu_docker_api.service.crashpoints import crash_point
 
-        if not ops:
+        guards = _check_guards(guards)
+        if not ops and not guards:
             return
         for op in ops:
             want = _APPLY_OPS.get(op[0])
             if want is None or len(op) != want:
                 raise ValueError(f"malformed apply op {op!r}")
         crash_point("txn.before_apply")
-        self._apply(ops)
+        self._apply(ops, guards)
         crash_point("txn.after_apply")
 
-    def _apply(self, ops: list[tuple]) -> None:
+    def cas(self, key: str, expected: str | None, new: str) -> None:
+        """Compare-and-swap convenience: write ``new`` iff the key's current
+        value is exactly ``expected`` (None = create-if-absent). Raises
+        :class:`errors.GuardFailed` when the compare loses."""
+        self.apply([("put", key, new)], guards=[("value", key, expected)])
+
+    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
+        for _, key, expected in guards or []:
+            actual = self.get_or(key)
+            if actual != expected:
+                raise _guard_mismatch(key, expected, actual)
         for op in ops:
             if op[0] == "put":
                 self.put(op[1], op[2])
@@ -125,8 +171,14 @@ class MemoryKV(KV):
             for k in [k for k in self._d if k.startswith(prefix)]:
                 del self._d[k]
 
-    def _apply(self, ops: list[tuple]) -> None:
+    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
         with self._mu:
+            # guards evaluate under the SAME lock hold that applies the ops:
+            # no other writer can slip between the compare and the commit
+            for _, key, expected in guards or []:
+                actual = self._d.get(key)
+                if actual != expected:
+                    raise _guard_mismatch(key, expected, actual)
             for op in ops:
                 if op[0] == "put":
                     self._d[op[1]] = op[2]
@@ -223,11 +275,23 @@ class SqliteKV(KV):
                 self._conn.rollback()
                 raise
 
-    def _apply(self, ops: list[tuple]) -> None:
+    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
         """All ops in ONE sqlite transaction: a mid-batch failure (or a
-        crash before the commit) rolls everything back."""
+        crash before the commit) rolls everything back. Guards SELECT and
+        compare inside that transaction — BEGIN IMMEDIATE takes the write
+        lock up front, so even a foreign process (second daemon, backup
+        tooling) cannot change a guarded key between the compare and the
+        commit."""
         with self._mu:
             try:
+                if guards:
+                    self._conn.execute("BEGIN IMMEDIATE")
+                    for _, key, expected in guards:
+                        row = self._conn.execute(
+                            "SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+                        actual = None if row is None else row[0]
+                        if actual != expected:
+                            raise _guard_mismatch(key, expected, actual)
                 for op in ops:
                     if op[0] == "put":
                         self._conn.execute(
@@ -346,12 +410,24 @@ class EtcdKV(KV):
             {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
         )
 
-    def _apply(self, ops: list[tuple]) -> None:
-        """Native etcd transaction (``/v3/kv/txn`` with no compares: the
-        success branch always commits, atomically). A txn is a WRITE, so it
-        rides the normalize-but-never-retry path — a blind re-apply after an
-        ambiguous timeout could double-commit a batch whose first attempt
-        landed (``idempotent=False`` is load-bearing, not a default)."""
+    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
+        """Native etcd transaction (``/v3/kv/txn``): guards map to the txn's
+        ``compare`` list — a value guard is a VALUE compare, an absence
+        guard (expected None) is ``VERSION == 0``, etcd's "key was never
+        put" sentinel — so the compare-and-commit is ONE server-side atomic
+        round trip, with no failure branch (a lost compare changes
+        nothing). A txn is a WRITE, so it rides the normalize-but-never-
+        retry path — a blind re-apply after an ambiguous timeout could
+        double-commit a batch whose first attempt landed
+        (``idempotent=False`` is load-bearing, not a default)."""
+        compare = []
+        for _, key, expected in guards or []:
+            if expected is None:
+                compare.append({"key": _b64(key), "result": "EQUAL",
+                                "target": "VERSION", "version": "0"})
+            else:
+                compare.append({"key": _b64(key), "result": "EQUAL",
+                                "target": "VALUE", "value": _b64(expected)})
         success = []
         for op in ops:
             if op[0] == "put":
@@ -363,7 +439,16 @@ class EtcdKV(KV):
                 success.append({"requestDeleteRange": {
                     "key": _b64(op[1]),
                     "range_end": _b64(_prefix_end(op[1]))}})
-        self._post("/v3/kv/txn", {"success": success}, idempotent=False)
+        body: dict = {"success": success}
+        if compare:
+            body["compare"] = compare
+        resp = self._post("/v3/kv/txn", body, idempotent=False)
+        # proto3 JSON omits false booleans: an absent ``succeeded`` on a
+        # guarded txn IS the failed compare
+        if compare and not resp.get("succeeded"):
+            raise errors.GuardFailed(
+                f"etcd txn compare failed on "
+                f"{[g[1] for g in guards or []]}")
 
     def close(self) -> None:
         self._session.close()
@@ -417,12 +502,12 @@ class CountingKV(KV):
         self._count("delete_prefix")
         self.inner.delete_prefix(prefix)
 
-    def _apply(self, ops: list[tuple]) -> None:
+    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
         # delegate to the inner BACKEND's atomic _apply (not its public
         # apply: the base template already validated and fired the crash
         # points once — they must not fire twice per batch)
         self._count("apply")
-        self.inner._apply(ops)
+        self.inner._apply(ops, guards)
 
     def close(self) -> None:
         self.inner.close()

@@ -15,9 +15,20 @@ from tpu_docker_api.state.kv import KV
 
 
 class VersionMap:
-    def __init__(self, kv: KV, store_key: str) -> None:
+    def __init__(self, kv: KV, store_key: str,
+                 read_through=False) -> None:
         self._kv = kv
         self._key = store_key
+        #: HA fleets pass a callable here (daemon wiring: "am I a
+        #: standby right now?"): while it returns True every read re-seeds
+        #: from the store first, because the leader rolls, creates and
+        #: deletes families behind this replica's back — a hit is no more
+        #: trustworthy than a miss (staleness must be bounded by one read,
+        #: not by this replica's lifetime). A leader (callable False) and
+        #: single-process deployments (the bool default) keep the pure
+        #: in-memory map: every write is local, zero extra reads.
+        self._read_through = (read_through if callable(read_through)
+                              else (lambda: read_through))
         self._mu = threading.Lock()
         raw = kv.get_or(store_key)
         self._m: dict[str, int] = json.loads(raw) if raw else {}
@@ -25,7 +36,17 @@ class VersionMap:
     def _persist_locked(self) -> None:
         self._kv.put(self._key, json.dumps(self._m, sort_keys=True))
 
+    def reload_from_store(self) -> None:
+        """Replace the in-memory mirror with the store's truth — the
+        leadership-handoff cache refresh (a promoted standby may have
+        booted long before the old leader's last write)."""
+        raw = self._kv.get_or(self._key)
+        with self._mu:
+            self._m = json.loads(raw) if raw else {}
+
     def get(self, name: str) -> int | None:
+        if self._read_through():
+            self.reload_from_store()
         with self._mu:
             return self._m.get(name)
 
@@ -66,5 +87,7 @@ class VersionMap:
             self._persist_locked()
 
     def snapshot(self) -> dict[str, int]:
+        if self._read_through():
+            self.reload_from_store()
         with self._mu:
             return dict(self._m)
