@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn bench-failover openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads openapi sample-interface run clean
 
 all: native openapi
 
@@ -51,6 +51,11 @@ bench-failover:              ## HA failover family: kill the leader under churn,
 	$(PY) bench.py --control-plane --cp-family failover --failovers 4 > bench-failover.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-failover.json.tmp
 	mv bench-failover.json.tmp bench-failover.json
+
+bench-reads:                 ## HA reads family: GET throughput per role + store-reads-per-request audit + schema gate
+	$(PY) bench.py --control-plane --cp-family reads --cp-iters 400 > bench-reads.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-reads.json.tmp
+	mv bench-reads.json.tmp bench-reads.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

@@ -482,6 +482,200 @@ def measure_control_plane_failover(n_failovers: int = 5,
     }
 
 
+def measure_control_plane_reads(n_reads: int = 2000, readers: int = 4,
+                                audit_reads: int = 25) -> dict:
+    """Control-plane reads family (``--control-plane --cp-family reads``):
+    the read-scaling half of the HA story, measured. Three real daemons
+    over ONE shared store + fake runtime (the failover family's harness
+    shape): a leader, a standby with the watch-fed informer read cache
+    (``read_cache = "informer"``, state/informer.py), and a standby on the
+    old per-request read-through path. Concurrent readers hammer the GET
+    surface of each role over real HTTP, reporting reads/sec and p50/p95
+    latency per role — and a ``CountingKV`` audit of **store round trips
+    per request** (quiesced window, sequential requests, per-method
+    deltas divided by request count).
+
+    Self-gating like churn/failover: the informer standby must serve at
+    ~0 store reads per request (watch traffic is amortized, not
+    per-request), a leader write must become visible on the informer
+    standby within the documented lag budget, and the read-through
+    standby must still audit at ≥ 1 read per request — so a bypassed or
+    miswired counter fails the gate loudly instead of passing a vacuous
+    0 == 0. A violated gate flips ``gates.ok``; main() turns that into a
+    nonzero exit."""
+    import statistics
+    import threading
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.state.kv import CountingKV, MemoryKV
+
+    if n_reads < readers * 2:
+        raise ValueError(f"need n_reads >= 2 per reader, got {n_reads}")
+    counting = CountingKV(MemoryKV())
+    runtime = FakeRuntime()
+    # TTL far beyond the bench's wall time: after the boot-time election
+    # steps, the heartbeat threads sleep through the whole measurement, so
+    # elector lease reads can never pollute the per-request audit windows
+    ttl_s = 120.0
+
+    progs: list = []
+
+    def boot(holder: str, read_cache: str) -> Program:
+        prg = Program(Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            start_port=45000, end_port=45999, health_watch_interval=0,
+            host_probe_interval_s=0, job_supervise_interval=0,
+            reconcile_interval=0, leader_election=True,
+            leader_ttl_s=ttl_s, leader_id=holder, read_cache=read_cache,
+        ), host="127.0.0.1", kv=counting, runtime=runtime)
+        # registered BEFORE init: stop() tolerates partial init, so a
+        # daemon that dies mid-boot still gets torn down by the finally
+        progs.append(prg)
+        prg.init()
+        prg.start()
+        return prg
+
+    def call(port: int, method, path, body=None, timeout=5.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def wait_for(cond, what: str, timeout_s: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.005)
+        raise RuntimeError(f"reads family: timed out waiting for {what}")
+
+    names = [f"read{i}" for i in range(4)]
+    quants = None
+    try:
+        # boot INSIDE the guard: a failed acquisition wait or a standby
+        # boot error must still stop the daemons already running (this
+        # path runs under tier-1 pytest — leaked HTTP/elector threads
+        # holding the port range would poison the rest of the suite)
+        leader = boot("reads-leader", "informer")
+        wait_for(lambda: leader.leader_elector.accepts_mutations,
+                 "leader acquisition")
+        standby_inf = boot("reads-standby-informer", "informer")
+        standby_rt = boot("reads-standby-readthrough", "read-through")
+
+        for name in names:
+            call(leader.api_server.port, "POST", "/api/v1/containers",
+                 {"imageName": "jax", "containerName": name, "chipCount": 1})
+        # the informer standby must be synced AND see the seeds before the
+        # clock starts — a cold mirror would measure the fallback path
+        wait_for(lambda: standby_inf.informer.synced
+                 and all(standby_inf.container_versions.get(n) == 0
+                         for n in names),
+                 "informer standby syncing the seed data")
+
+        roles = [("leader", leader), ("standby_informer", standby_inf),
+                 ("standby_read_through", standby_rt)]
+
+        def hammer(port: int) -> tuple[list[float], float]:
+            lat_ms: list[list[float]] = [[] for _ in range(readers)]
+            per_reader = n_reads // readers
+
+            def reader(slot: int) -> None:
+                for i in range(per_reader):
+                    path = f"/api/v1/containers/{names[i % len(names)]}-0"
+                    t0 = time.perf_counter()
+                    call(port, "GET", path)
+                    lat_ms[slot].append((time.perf_counter() - t0) * 1e3)
+
+            threads = [threading.Thread(target=reader, args=(s,))
+                       for s in range(readers)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_s = time.perf_counter() - t0
+            return [v for chunk in lat_ms for v in chunk], wall_s
+
+        def audit(port: int) -> float:
+            """Store READ round trips per GET, over a quiesced sequential
+            window (get + range_prefix — the methods a read can cost)."""
+            before = counting.snapshot()
+            for i in range(audit_reads):
+                call(port, "GET",
+                     f"/api/v1/containers/{names[i % len(names)]}-0")
+            delta = CountingKV.delta(before, counting.snapshot())
+            reads = delta.get("get", 0) + delta.get("range_prefix", 0)
+            return round(reads / audit_reads, 4)
+
+        out_roles: dict[str, dict] = {}
+        for role, prg in roles:
+            lat, wall_s = hammer(prg.api_server.port)
+            qs = statistics.quantiles(lat, n=20)
+            out_roles[role] = {
+                "rps": round(len(lat) / wall_s, 1),
+                "p50_ms": round(statistics.median(lat), 3),
+                "p95_ms": round(min(qs[18], max(lat)), 3),
+                "max_ms": round(max(lat), 3),
+                "reads_per_req": audit(prg.api_server.port),
+            }
+
+        # leader-write → informer-standby-visible lag: the staleness bound
+        # the read cache trades its zero round trips for
+        lag_budget_ms = 2000.0
+        t0 = time.perf_counter()
+        call(leader.api_server.port, "POST", "/api/v1/containers",
+             {"imageName": "jax", "containerName": "visprobe",
+              "chipCount": 1})
+        lag_ms = None
+        while time.perf_counter() - t0 < lag_budget_ms / 1e3 * 2:
+            try:
+                call(standby_inf.api_server.port, "GET",
+                     "/api/v1/containers/visprobe-0")
+                lag_ms = round((time.perf_counter() - t0) * 1e3, 3)
+                break
+            except Exception:
+                time.sleep(0.002)
+
+        inf_reads = out_roles["standby_informer"]["reads_per_req"]
+        rt_reads = out_roles["standby_read_through"]["reads_per_req"]
+        # ≤ 0.1 = "~0 with slack for a stray background read", not "small":
+        # a single per-request store read would audit at 1.0 and fail
+        inf_budget = 0.1
+        quants = {
+            "family": "reads",
+            "iters": {"reads": n_reads, "readers": readers,
+                      "audit_reads": audit_reads, "seeded": len(names)},
+            "roles": out_roles,
+            "visibility_lag_ms": lag_ms,
+            "gates": {
+                "standby_informer_reads_per_req": inf_reads,
+                "standby_informer_reads_budget": inf_budget,
+                "read_through_reads_per_req": rt_reads,
+                "visibility_lag_ms": lag_ms,
+                "visibility_lag_budget_ms": lag_budget_ms,
+                "ok": bool(inf_reads <= inf_budget
+                           and rt_reads >= 1.0
+                           and lag_ms is not None
+                           and lag_ms <= lag_budget_ms),
+            },
+        }
+    finally:
+        for prg in progs:
+            try:
+                prg.stop()
+            except Exception:
+                pass
+    return quants
+
+
 def main() -> int | None:
     """Returns a nonzero exit code on backend-init failure (consumed by
     the ``sys.exit(main())`` entry); None = success."""
@@ -497,16 +691,23 @@ def main() -> int | None:
     parser.add_argument("--cp-runtime", default="fake",
                         choices=["fake", "docker"])
     parser.add_argument("--cp-family", default="create",
-                        choices=["create", "churn", "failover"],
+                        choices=["create", "churn", "failover", "reads"],
                         help="create = create→ready latency; churn = "
                              "create→ready→replace→delete for containers "
                              "AND gangs with store round-trips per flow; "
                              "failover = kill the HA leader under churn "
                              "load, time-to-recovered-writes on the "
-                             "standby")
+                             "standby; reads = hammer the GET surface on "
+                             "leader + informer standby + read-through "
+                             "standby, with a store-reads-per-request "
+                             "audit")
     parser.add_argument("--cp-iters", type=int, default=100,
                         help="iterations (create family) / container "
-                             "cycles (churn family)")
+                             "cycles (churn family) / total GETs per role "
+                             "(reads family)")
+    parser.add_argument("--read-workers", type=int, default=4,
+                        help="concurrent reader threads for the reads "
+                             "family")
     parser.add_argument("--churn-gangs", type=int, default=0,
                         help="gang cycles for the churn family; 0 = "
                              "cp-iters // 10 (min 2)")
@@ -543,6 +744,9 @@ def main() -> int | None:
             elif args.cp_family == "failover":
                 cp = measure_control_plane_failover(
                     args.failovers, ttl_s=args.failover_ttl)
+            elif args.cp_family == "reads":
+                cp = measure_control_plane_reads(
+                    args.cp_iters, readers=args.read_workers)
             else:
                 cp = measure_control_plane(args.cp_iters, args.cp_runtime)
         except Exception as e:
@@ -551,19 +755,24 @@ def main() -> int | None:
                   "error": {"error": f"{type(e).__name__}: {str(e)[:300]}",
                             "family": args.cp_family}})
             return 1
+        unit = "ms"
         if args.cp_family == "failover":
             headline = ("control_plane_failover_recovery_ms_p50",
                         cp["recovery_ms"]["p50"])
         elif args.cp_family == "churn":
             headline = ("control_plane_churn_create_ready_ms_p50",
                         cp["create_ready_ms_p50"])
+        elif args.cp_family == "reads":
+            headline = ("control_plane_reads_standby_informer_rps",
+                        cp["roles"]["standby_informer"]["rps"])
+            unit = "reads/s"
         else:
             headline = ("container_create_ready_ms_p50",
                         cp["create_ready_ms_p50"])
         emit({
             "metric": headline[0],
             "value": headline[1],
-            "unit": "ms",
+            "unit": unit,
             # the reference publishes no latency numbers (BASELINE.md) —
             # this metric exists to be measured, not compared
             "vs_baseline": 1.0,

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Validate control-plane BENCH artifacts (``make bench-churn`` /
-``make bench-failover``).
+``make bench-failover`` / ``make bench-reads``).
 
 Reads JSON lines from stdin (or a file argument) and asserts the schema the
 driver-side BENCH pipeline consumes: every line carries the
@@ -8,9 +8,10 @@ driver-side BENCH pipeline consumes: every line carries the
 (detected from ``extra.family``) carries its full payload — latency
 quantiles, per-flow store round trips and a passing regression gate for
 ``churn``; recovery quantiles, per-failover fencing proof and a passing
-regression gate for ``failover``. Exit 0 = consumable artifact, nonzero =
-a structural problem printed one-per-line (the same loud-failure contract
-as bench_boot).
+regression gate for ``failover``; per-role throughput/latency and the
+store-reads-per-request audit (informer ~0, read-through ≥ 1) for
+``reads``. Exit 0 = consumable artifact, nonzero = a structural problem
+printed one-per-line (the same loud-failure contract as bench_boot).
 """
 
 from __future__ import annotations
@@ -26,6 +27,8 @@ ROUND_TRIP_FLOWS = ("container_create", "container_replace",
                     "container_delete", "gang_create_2host",
                     "gang_create_4host", "gang_delete_2host",
                     "gang_delete_4host")
+READ_ROLES = ("leader", "standby_informer", "standby_read_through")
+READ_ROLE_KEYS = ("rps", "p50_ms", "p95_ms", "max_ms", "reads_per_req")
 
 
 def _num(v) -> bool:
@@ -67,6 +70,42 @@ def validate_failover(extra: dict) -> list[str]:
     return problems
 
 
+def validate_reads(extra: dict) -> list[str]:
+    """The reads-family headline payload: per-role throughput/latency, the
+    store-reads-per-request audit, and a passing gate. The audit gates are
+    re-checked here (not just gates.ok): a zeroed read-through counter is
+    the vacuous-0==0 failure mode this family exists to prevent."""
+    problems: list[str] = []
+    n = (extra.get("iters") or {}).get("reads")
+    if not (isinstance(n, int) and n >= 2):
+        problems.append(f"reads: iters.reads must be an int >= 2, got {n!r}")
+    roles = extra.get("roles") or {}
+    for role in READ_ROLES:
+        stats = roles.get(role) or {}
+        for key in READ_ROLE_KEYS:
+            if not _num(stats.get(key)):
+                problems.append(f"reads: roles.{role}.{key} missing")
+    gates = extra.get("gates") or {}
+    for key in ("standby_informer_reads_per_req",
+                "standby_informer_reads_budget",
+                "read_through_reads_per_req", "visibility_lag_ms",
+                "visibility_lag_budget_ms", "ok"):
+        if key not in gates:
+            problems.append(f"reads: gates.{key} missing")
+    rt = gates.get("read_through_reads_per_req")
+    if not _num(rt) or rt < 1:
+        problems.append(f"reads: read-through audited below 1 store read "
+                        f"per request ({rt!r}) — the counter is bypassed "
+                        f"or miswired, so the informer's ~0 proves nothing")
+    lag = gates.get("visibility_lag_ms")
+    if not _num(lag) or lag <= 0:
+        problems.append(f"reads: visibility_lag_ms must be a positive "
+                        f"number, got {lag!r}")
+    if gates.get("ok") is not True:
+        problems.append(f"reads: regression gate failed: {gates}")
+    return problems
+
+
 def validate_lines(lines: list[dict]) -> list[str]:
     """Return every schema violation found (empty = consumable)."""
     problems: list[str] = []
@@ -80,10 +119,14 @@ def validate_lines(lines: list[dict]) -> list[str]:
                 if (ln.get("extra") or {}).get("family") == "failover"]
     if failover:
         return problems + validate_failover(failover[0]["extra"])
+    reads = [ln for ln in lines
+             if (ln.get("extra") or {}).get("family") == "reads"]
+    if reads:
+        return problems + validate_reads(reads[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn or failover headline line "
+        return problems + ["no churn, failover or reads headline line "
                            "(extra.family)"]
     extra = churn[0]["extra"]
 

@@ -1,0 +1,278 @@
+"""In-process etcd grpc-gateway fake, shared by the KV test suites.
+
+Implements etcd's contract at the BYTES level (store keyed by raw bytes,
+[key, range_end) byte-interval comparison) over real HTTP, so EtcdKV's
+wire behavior — base64 keys/values, the single-``\\0`` "everything from
+key" sentinel, txn compare evaluation, duplicate-key txn rejection — is
+testable without a server. Grown for the watch layer (ISSUE 8): every
+mutation bumps a server revision and appends per-key events (one revision
+per REQUEST, shared by all keys a txn/deleterange touches — etcd
+semantics), and ``/v3/watch`` streams them back chunked, proto3-JSON
+shaped (PUT type omitted, ``compact_revision`` cancel for a start
+revision at or below ``server.compacted``).
+"""
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeGateway(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    def finish(self):
+        # a watch client tearing its socket down mid-stream is normal
+        # teardown, not a handler error worth a stderr traceback
+        try:
+            super().finish()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
+    @property
+    def store(self) -> dict[bytes, bytes]:
+        return self.server.store
+
+    def do_POST(self):
+        # connection-fault injection: abort the next N requests at the
+        # socket level (no HTTP response at all) — what a dying etcd or a
+        # mid-restart gateway looks like to the client
+        if getattr(self.server, "fail_next", 0) > 0:
+            self.server.fail_next -= 1
+            self.server.fail_seen += 1
+            self.close_connection = True
+            self.connection.close()
+            return
+        self._do_POST()
+
+    def _emit(self, op: str, key: bytes, value: bytes | None) -> None:
+        """One event at the server's CURRENT revision (the caller bumped
+        it once for the whole request, etcd-style)."""
+        self.server.events.append((self.server.rev, op, key, value))
+
+    def _do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length))
+        if self.path == "/v3/kv/txn":
+            return self._do_txn(body)
+        if self.path == "/v3/watch":
+            return self._do_watch(body)
+        key = base64.b64decode(body["key"])
+        range_end = (base64.b64decode(body["range_end"])
+                     if "range_end" in body else None)
+
+        def in_range(k: bytes) -> bool:
+            if range_end is None:
+                return k == key
+            if range_end == b"\0":   # etcd sentinel: all keys >= key
+                return k >= key
+            return key <= k < range_end
+
+        if self.path == "/v3/kv/put":
+            self.server.rev += 1
+            self.store[key] = base64.b64decode(body["value"])
+            self._emit("put", key, self.store[key])
+            return self._reply({"header": self._header()})
+        if self.path == "/v3/kv/range":
+            kvs = [
+                {"key": base64.b64encode(k).decode(),
+                 "value": base64.b64encode(v).decode()}
+                for k, v in sorted(self.store.items()) if in_range(k)
+            ]
+            limit = int(body.get("limit", 0))
+            if limit:
+                kvs = kvs[:limit]
+            resp = {"header": self._header(), "count": str(len(kvs))}
+            if kvs:  # the gateway omits empty kvs arrays
+                resp["kvs"] = kvs
+            return self._reply(resp)
+        if self.path == "/v3/kv/deleterange":
+            doomed = sorted(k for k in self.store if in_range(k))
+            if doomed:
+                # one revision for the whole request, one event per key —
+                # exactly how etcd expands a range delete
+                self.server.rev += 1
+                for k in doomed:
+                    del self.store[k]
+                    self._emit("delete", k, None)
+            return self._reply({"header": self._header(),
+                                "deleted": str(len(doomed))})
+        self.send_error(404)
+
+    def _header(self) -> dict:
+        return {"revision": str(self.server.rev)}
+
+    def _reply(self, payload: dict):
+        data = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _do_txn(self, body: dict):
+        """Txn with compare support: evaluate the ``compare`` list against
+        the live store first — any mismatch answers with ``succeeded``
+        omitted (proto3 JSON drops false booleans) and commits NOTHING.
+        The success branch then commits atomically — staged against a copy
+        so a rejected batch changes nothing. Enforces etcd's duplicate-key
+        rule (server txn.go checkIntervals: a put may not overlap another
+        put or a delete range in the same branch), so a production batch
+        the real server would reject fails here too."""
+        self.server.txn_count += 1
+        for cmp_ in body.get("compare", []):
+            k = base64.b64decode(cmp_["key"])
+            if cmp_.get("target") == "VERSION":
+                # the absence guard: VERSION == 0 ⇔ key never put
+                want_absent = str(cmp_.get("version", "0")) == "0"
+                if (k in self.store) == want_absent:
+                    return self._reply({"header": self._header()})
+            elif cmp_.get("target") == "VALUE":
+                want = base64.b64decode(cmp_.get("value", ""))
+                if self.store.get(k) != want:
+                    return self._reply({"header": self._header()})
+            else:
+                return self.send_error(400, "unsupported compare target")
+
+        def covers(k: bytes, key: bytes, range_end: bytes | None) -> bool:
+            if range_end is None:
+                return k == key
+            if range_end == b"\0":   # etcd sentinel: all keys >= key
+                return k >= key
+            return key <= k < range_end
+
+        staged = dict(self.store)
+        events: list[tuple[str, bytes, bytes | None]] = []
+        put_keys: set[bytes] = set()
+        del_ranges: list[tuple[bytes, bytes | None]] = []
+        for req in body.get("success", []):
+            if "requestPut" in req:
+                put = req["requestPut"]
+                k = base64.b64decode(put["key"])
+                if k in put_keys:
+                    return self.send_error(
+                        400, "duplicate key given in txn request")
+                put_keys.add(k)
+                staged[k] = base64.b64decode(put["value"])
+                events.append(("put", k, staged[k]))
+            elif "requestDeleteRange" in req:
+                dr = req["requestDeleteRange"]
+                key = base64.b64decode(dr["key"])
+                range_end = (base64.b64decode(dr["range_end"])
+                             if "range_end" in dr else None)
+                del_ranges.append((key, range_end))
+                for k in sorted(staged):
+                    if covers(k, key, range_end):
+                        del staged[k]
+                        events.append(("delete", k, None))
+            else:
+                return self.send_error(400)
+        for k in put_keys:
+            if any(covers(k, key, end) for key, end in del_ranges):
+                return self.send_error(
+                    400, "duplicate key given in txn request")
+        self.store.clear()
+        self.store.update(staged)
+        if events:
+            # a committed txn is ONE revision, stamped on every event
+            self.server.rev += 1
+            for op, k, v in events:
+                self._emit(op, k, v)
+        return self._reply({"header": self._header(), "succeeded": True})
+
+    def _do_watch(self, body: dict):
+        """Chunked ``/v3/watch`` stream: a created response first, then
+        event batches as the server's log grows, until the client closes
+        the connection. ``start_revision`` is INCLUSIVE (etcd semantics);
+        at or below ``server.compacted`` the stream is canceled with
+        ``compact_revision`` — the client maps that to WatchLost."""
+        self.close_connection = True  # a watch stream never pipelines
+        create = body.get("create_request", {})
+        key = base64.b64decode(create["key"])
+        range_end = (base64.b64decode(create["range_end"])
+                     if "range_end" in create else None)
+        start_rev = int(create.get("start_revision", 0) or 0)
+
+        def in_range(k: bytes) -> bool:
+            if range_end is None:
+                return k == key
+            if range_end == b"\0":
+                return k >= key
+            return key <= k < range_end
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_chunk(obj: dict) -> None:
+            data = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        if start_rev and start_rev <= getattr(self.server, "compacted", 0):
+            write_chunk({"result": {
+                "header": self._header(), "canceled": True,
+                "compact_revision": str(self.server.compacted)}})
+            self.wfile.write(b"0\r\n\r\n")
+            return
+        write_chunk({"result": {"header": self._header(), "created": True}})
+        delivered = max(start_rev - 1, 0)  # inclusive start
+        try:
+            while not getattr(self.server, "watch_stop", False):
+                batch = [e for e in self.server.events
+                         if e[0] > delivered and in_range(e[2])]
+                pending = [e for e in self.server.events if e[0] > delivered]
+                if batch:
+                    events = []
+                    for rev, op, k, v in batch:
+                        ev = {"kv": {"key": base64.b64encode(k).decode(),
+                                     "mod_revision": str(rev)}}
+                        if op == "put":
+                            ev["kv"]["value"] = base64.b64encode(v).decode()
+                        else:  # proto3 JSON omits the default PUT type
+                            ev["type"] = "DELETE"
+                        events.append(ev)
+                    write_chunk({"result": {"header": self._header(),
+                                            "events": events}})
+                if pending:
+                    delivered = max(e[0] for e in pending)
+                time.sleep(0.02)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass  # client closed the stream: normal watch teardown
+
+
+def make_gateway() -> ThreadingHTTPServer:
+    """A started-state server object (caller runs serve_forever)."""
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeGateway)
+    # watch streams are long-lived handler threads: never block close on
+    # them (watch_stop unblocks their loops, daemon covers the stragglers)
+    server.daemon_threads = True
+    server.block_on_close = False
+    server.store = {}
+    server.fail_next = 0
+    server.fail_seen = 0
+    server.txn_count = 0
+    server.rev = 0
+    server.events = []
+    server.compacted = 0
+    server.watch_stop = False
+    return server
+
+
+def start_gateway() -> tuple[ThreadingHTTPServer, threading.Thread]:
+    server = make_gateway()
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t
+
+
+def stop_gateway(server: ThreadingHTTPServer) -> None:
+    # unblock any open watch streams first, or shutdown() waits on them
+    server.watch_stop = True
+    server.shutdown()
+    server.server_close()

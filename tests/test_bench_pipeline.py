@@ -195,6 +195,61 @@ class TestFailoverFamily:
         assert len(failover["recoveries_ms"]) == 2
 
 
+class TestReadsFamily:
+    """The watch-fed read-path family (``make bench-reads``): leader +
+    informer standby + read-through standby over one store at tiny scale —
+    pinning both the artifact schema (scripts/check_churn_schema.py) and
+    the tentpole invariants: standby informer GETs audit at ~0 store round
+    trips per request, read-through still audits ≥ 1 per request (so the
+    informer's zero is proven against a live counter, not a bypassed one),
+    and a leader write becomes standby-visible within the lag budget."""
+
+    @pytest.fixture(scope="class")
+    def reads(self):
+        return bench.measure_control_plane_reads(n_reads=60, readers=3,
+                                                 audit_reads=10)
+
+    def test_schema_checker_accepts_the_emitted_line(self, reads):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_reads_standby_informer_rps",
+                "value": reads["roles"]["standby_informer"]["rps"],
+                "unit": "reads/s", "vs_baseline": 1.0, "extra": reads}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... and so must a read-through audit of zero — the vacuous-
+        # counter failure mode this family exists to catch
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["read_through_reads_per_req"] = 0
+        assert any("read-through" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        del bad["extra"]["roles"]["standby_informer"]
+        assert any("standby_informer" in p for p in validate_lines([bad]))
+
+    def test_reads_gates_hold(self, reads):
+        gates = reads["gates"]
+        assert gates["ok"] is True
+        # the tentpole: watch-fed standby reads cost ~0 store round trips
+        assert (gates["standby_informer_reads_per_req"]
+                <= gates["standby_informer_reads_budget"])
+        # the audit is live: the uncached role pays ≥ 1 read per request
+        assert gates["read_through_reads_per_req"] >= 1.0
+        # leader-write → standby-visible within the documented lag bound
+        assert 0 < gates["visibility_lag_ms"] <= gates[
+            "visibility_lag_budget_ms"]
+        for role in ("leader", "standby_informer", "standby_read_through"):
+            stats = reads["roles"][role]
+            assert stats["p50_ms"] <= stats["p95_ms"] <= stats["max_ms"]
+            assert stats["rps"] > 0
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

@@ -2,173 +2,33 @@
 
 The gateway JSON shapes — base64 keys/values, ``range_end`` byte-interval
 semantics, the single-``\\0`` "everything from key" sentinel — are exactly
-what only breaks against a real server, so the fake implements etcd's
-contract at the BYTES level (store keyed by raw bytes, [key, range_end)
-byte-order comparison) and the tests drive every EtcdKV method through real
-HTTP. A gated tier runs the same contract against a live etcd when
-ETCD_ADDR is set.
+what only breaks against a real server, so the fake (tests/etcd_gateway.py,
+shared with the watch conformance suite) implements etcd's contract at the
+BYTES level (store keyed by raw bytes, [key, range_end) byte-order
+comparison) and the tests drive every EtcdKV method through real HTTP. A
+gated tier runs the same contract against a live etcd when ETCD_ADDR is
+set.
 """
 
-import base64
-import json
 import os
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
 requests = pytest.importorskip("requests")
 
+from etcd_gateway import start_gateway, stop_gateway
+
 from tpu_docker_api import errors
 from tpu_docker_api.state.kv import EtcdKV, MemoryKV, _prefix_end
 
 
-class _FakeGateway(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
-
-    def log_message(self, *args):
-        pass
-
-    @property
-    def store(self) -> dict[bytes, bytes]:
-        return self.server.store
-
-    def do_POST(self):
-        # connection-fault injection: abort the next N requests at the
-        # socket level (no HTTP response at all) — what a dying etcd or a
-        # mid-restart gateway looks like to the client
-        if getattr(self.server, "fail_next", 0) > 0:
-            self.server.fail_next -= 1
-            self.server.fail_seen += 1
-            self.close_connection = True
-            self.connection.close()
-            return
-        self._do_POST()
-
-    def _do_POST(self):
-        length = int(self.headers.get("Content-Length") or 0)
-        body = json.loads(self.rfile.read(length))
-        if self.path == "/v3/kv/txn":
-            return self._do_txn(body)
-        key = base64.b64decode(body["key"])
-        range_end = (base64.b64decode(body["range_end"])
-                     if "range_end" in body else None)
-
-        def in_range(k: bytes) -> bool:
-            if range_end is None:
-                return k == key
-            if range_end == b"\0":   # etcd sentinel: all keys >= key
-                return k >= key
-            return key <= k < range_end
-
-        if self.path == "/v3/kv/put":
-            self.store[key] = base64.b64decode(body["value"])
-            return self._reply({"header": {"revision": "1"}})
-        if self.path == "/v3/kv/range":
-            kvs = [
-                {"key": base64.b64encode(k).decode(),
-                 "value": base64.b64encode(v).decode()}
-                for k, v in sorted(self.store.items()) if in_range(k)
-            ]
-            limit = int(body.get("limit", 0))
-            if limit:
-                kvs = kvs[:limit]
-            resp = {"header": {}, "count": str(len(kvs))}
-            if kvs:  # the gateway omits empty kvs arrays
-                resp["kvs"] = kvs
-            return self._reply(resp)
-        if self.path == "/v3/kv/deleterange":
-            doomed = [k for k in self.store if in_range(k)]
-            for k in doomed:
-                del self.store[k]
-            return self._reply({"header": {}, "deleted": str(len(doomed))})
-        self.send_error(404)
-
-    def _do_txn(self, body: dict):
-        """Txn with compare support: evaluate the ``compare`` list against
-        the live store first — any mismatch answers with ``succeeded``
-        omitted (proto3 JSON drops false booleans) and commits NOTHING.
-        The success branch then commits atomically — staged against a copy
-        so a rejected batch changes nothing. Enforces etcd's duplicate-key
-        rule (server txn.go checkIntervals: a put may not overlap another
-        put or a delete range in the same branch), so a production batch
-        the real server would reject fails here too."""
-        self.server.txn_count += 1
-        for cmp_ in body.get("compare", []):
-            k = base64.b64decode(cmp_["key"])
-            if cmp_.get("target") == "VERSION":
-                # the absence guard: VERSION == 0 ⇔ key never put
-                want_absent = str(cmp_.get("version", "0")) == "0"
-                if (k in self.store) == want_absent:
-                    return self._reply({"header": {}})
-            elif cmp_.get("target") == "VALUE":
-                want = base64.b64decode(cmp_.get("value", ""))
-                if self.store.get(k) != want:
-                    return self._reply({"header": {}})
-            else:
-                return self.send_error(400, "unsupported compare target")
-
-        def covers(k: bytes, key: bytes, range_end: bytes | None) -> bool:
-            if range_end is None:
-                return k == key
-            if range_end == b"\0":   # etcd sentinel: all keys >= key
-                return k >= key
-            return key <= k < range_end
-
-        staged = dict(self.store)
-        put_keys: set[bytes] = set()
-        del_ranges: list[tuple[bytes, bytes | None]] = []
-        for req in body.get("success", []):
-            if "requestPut" in req:
-                put = req["requestPut"]
-                k = base64.b64decode(put["key"])
-                if k in put_keys:
-                    return self.send_error(
-                        400, "duplicate key given in txn request")
-                put_keys.add(k)
-                staged[k] = base64.b64decode(put["value"])
-            elif "requestDeleteRange" in req:
-                dr = req["requestDeleteRange"]
-                key = base64.b64decode(dr["key"])
-                range_end = (base64.b64decode(dr["range_end"])
-                             if "range_end" in dr else None)
-                del_ranges.append((key, range_end))
-                for k in list(staged):
-                    if covers(k, key, range_end):
-                        del staged[k]
-            else:
-                return self.send_error(400)
-        for k in put_keys:
-            if any(covers(k, key, end) for key, end in del_ranges):
-                return self.send_error(
-                    400, "duplicate key given in txn request")
-        self.store.clear()
-        self.store.update(staged)
-        return self._reply({"header": {}, "succeeded": True})
-
-    def _reply(self, payload: dict):
-        data = json.dumps(payload).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-
-
 @pytest.fixture()
 def gateway():
-    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGateway)
-    server.store = {}
-    server.fail_next = 0
-    server.fail_seen = 0
-    server.txn_count = 0
-    t = threading.Thread(target=server.serve_forever, daemon=True)
-    t.start()
+    server, _ = start_gateway()
     try:
         yield server
     finally:
-        server.shutdown()
-        server.server_close()
+        stop_gateway(server)
 
 
 @pytest.fixture()

@@ -1,0 +1,384 @@
+"""Informer reflector + watch-fed standby read path (state/informer.py,
+docs/perf.md "Read path").
+
+Unit tier: the reflector against MemoryKV (handler delivery, mirror
+correctness, WatchLost → relist, store-outage degradation + recovery,
+telemetry), InformerReadKV routing/fallback, and the VersionMap shadow.
+Integration tier: two real ``Program``s over ONE sqlite FILE — each opens
+its own SqliteKV connection, so the standby's mirror is fed purely by the
+changelog a separate store instance wrote (the two-real-processes shape
+PR 7 verified for writes, now proven for the read path) — asserting the
+staleness contract: a leader write becomes standby-visible within the
+watch-lag bound, with the standby's reads served from its mirror.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.informer import Informer, InformerReadKV
+from tpu_docker_api.state.kv import CountingKV, MemoryKV
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+
+def wait_until(fn, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.005)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def make_informer(kv, registry=None, **kw):
+    kw.setdefault("relist_backoff_base_s", 0.01)
+    kw.setdefault("relist_backoff_max_s", 0.05)
+    kw.setdefault("poll_timeout_s", 0.05)
+    return Informer(kv, keys.PREFIX + "/", registry=registry, **kw)
+
+
+class TestInformerReflector:
+    def test_initial_list_then_watch_replay(self):
+        kv = MemoryKV()
+        kv.put(f"{keys.PREFIX}/containers/pre/latest", "0")
+        seen = []
+        inf = make_informer(kv)
+        inf.register(f"{keys.PREFIX}/containers/", seen.append)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="initial sync")
+            # the initial list reaches handlers as synthetic events
+            assert [(e.op, e.key) for e in seen] == [
+                ("put", f"{keys.PREFIX}/containers/pre/latest")]
+            assert inf.get(f"{keys.PREFIX}/containers/pre/latest") == "0"
+            # live events replay into the mirror AND the handlers
+            kv.put(f"{keys.PREFIX}/containers/new/latest", "1")
+            kv.put(f"{keys.PREFIX}/volumes/other/latest", "9")  # filtered
+            kv.delete(f"{keys.PREFIX}/containers/pre/latest")
+            wait_until(lambda: len(seen) == 3, what="event delivery")
+            assert (inf.get(f"{keys.PREFIX}/containers/pre/latest") is None)
+            assert inf.range_prefix(f"{keys.PREFIX}/containers/") == {
+                f"{keys.PREFIX}/containers/new/latest": "1"}
+            # ...but the mirror itself spans the whole tree
+            assert (inf.get(f"{keys.PREFIX}/volumes/other/latest") == "9")
+        finally:
+            inf.close()
+
+    def test_watch_lost_relists_and_emits_degradation(self):
+        """The loud-degrade contract: a gap flips synced off, shows up in
+        the events ring and the relist counter, and the relist emits
+        synthetic diff events for exactly what the gap swallowed."""
+        kv = MemoryKV(log_retain=4)
+        registry = MetricsRegistry()
+        inf = make_informer(kv, registry=registry)
+        seen = []
+        inf.register(keys.PREFIX + "/", seen.append)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="initial sync")
+            inf.close()  # wedge the consumer so the log overruns it
+            for i in range(12):
+                kv.put(f"{keys.PREFIX}/burst/{i:02d}", str(i))
+            seen.clear()
+            inf.start()
+            wait_until(
+                lambda: inf.synced
+                and registry.counter_value("informer_relists_total") >= 2,
+                what="relist after gap")
+            wait_until(lambda: len(seen) >= 12, what="diff replay")
+            # every swallowed key arrived exactly once, via the diff
+            assert sorted(e.key for e in seen) == sorted(
+                f"{keys.PREFIX}/burst/{i:02d}" for i in range(12))
+            assert inf.get(f"{keys.PREFIX}/burst/11") == "11"
+        finally:
+            inf.close()
+
+    def test_store_outage_degrades_then_recovers(self):
+        class _OutageKV(MemoryKV):
+            def __init__(self):
+                super().__init__()
+                self.fail_lists = 0
+
+            def range_prefix_with_rev(self, prefix):
+                if self.fail_lists > 0:
+                    self.fail_lists -= 1
+                    raise errors.StoreUnavailable("injected outage")
+                return super().range_prefix_with_rev(prefix)
+
+        kv = _OutageKV()
+        kv.put(f"{keys.PREFIX}/x", "1")
+        kv.fail_lists = 2
+        inf = make_informer(kv)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="recovery after outage")
+            assert inf.get(f"{keys.PREFIX}/x") == "1"
+            degradations = [e for e in inf.events_view()
+                            if e["event"] == "informer-degraded"]
+            assert len(degradations) == 2
+            assert all(d["reason"] == "store-outage" for d in degradations)
+        finally:
+            inf.close()
+
+    def test_relist_diff_includes_deletes(self):
+        """A delete the gap swallowed must surface as a synthetic delete
+        event — a cache that only diffed puts would resurrect families."""
+        kv = MemoryKV(log_retain=4)
+        inf = make_informer(kv)
+        key = f"{keys.PREFIX}/containers/doomed/latest"
+        kv.put(key, "0")
+        seen = []
+        inf.register(key, seen.append)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="initial sync")
+            inf.close()
+            kv.delete(key)
+            for i in range(12):  # overrun the log so resume is impossible
+                kv.put(f"{keys.PREFIX}/noise/{i}", "x")
+            inf.start()
+            wait_until(lambda: any(e.op == "delete" for e in seen),
+                       what="synthetic delete from relist diff")
+            assert inf.get(key) is None
+        finally:
+            inf.close()
+
+    def test_status_view_reads_registry_counters(self):
+        registry = MetricsRegistry()
+        kv = MemoryKV()
+        inf = make_informer(kv, registry=registry)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="sync")
+            kv.put(f"{keys.PREFIX}/a", "1")
+            wait_until(
+                lambda: inf.status_view()["eventsTotal"] >= 1,
+                what="event counter")
+            view = inf.status_view()
+            assert view["synced"] is True
+            assert view["relistsTotal"] == 1
+            assert view["lastRev"] >= 1
+            assert view["watchLagMs"] >= 0
+            rendered = registry.render()
+            assert "informer_events_total" in rendered
+            assert "informer_watch_lag_ms" in rendered
+        finally:
+            inf.close()
+
+
+class TestInformerReadKV:
+    def _wired(self, active):
+        counting = CountingKV(MemoryKV())
+        counting.put(f"{keys.PREFIX}/containers/web/latest", "3")
+        registry = MetricsRegistry()
+        inf = make_informer(counting, registry=registry)
+        read_kv = InformerReadKV(counting, inf, active=active)
+        return counting, inf, read_kv, registry
+
+    def test_active_and_synced_serves_mirror_with_zero_store_reads(self):
+        counting, inf, read_kv, registry = self._wired(active=lambda: True)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, timeout_s=10, what="sync")
+            before = counting.snapshot()
+            key = f"{keys.PREFIX}/containers/web/latest"
+            for _ in range(20):
+                assert read_kv.get(key) == "3"
+                assert read_kv.range_prefix(
+                    f"{keys.PREFIX}/containers/") == {key: "3"}
+            delta = CountingKV.delta(before, counting.snapshot())
+            assert delta.get("get", 0) == 0
+            assert delta.get("range_prefix", 0) == 0
+            # ABSENCE is served authoritatively from the mirror too
+            with pytest.raises(errors.NotExistInStore):
+                read_kv.get(f"{keys.PREFIX}/containers/nope/latest")
+            assert registry.counter_value("informer_cache_hits_total") >= 40
+        finally:
+            inf.close()
+
+    def test_inactive_or_unsynced_falls_through_to_store(self):
+        counting, inf, read_kv, registry = self._wired(active=lambda: True)
+        key = f"{keys.PREFIX}/containers/web/latest"
+        # informer never started: unsynced ⇒ read-through fallback + miss
+        assert read_kv.get(key) == "3"
+        assert registry.counter_value("informer_cache_misses_total") == 1
+        # leader role (active False): plain store reads, not even a miss
+        counting2, inf2, read_kv2, registry2 = self._wired(
+            active=lambda: False)
+        assert read_kv2.get(key) == "3"
+        assert registry2.counter_value("informer_cache_misses_total") == 0
+
+    def test_writes_always_pass_through(self):
+        counting, inf, read_kv, _ = self._wired(active=lambda: True)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="sync")
+            read_kv.put(f"{keys.PREFIX}/w", "1")
+            read_kv.apply([("put", f"{keys.PREFIX}/w2", "2")])
+            assert counting.inner.get(f"{keys.PREFIX}/w") == "1"
+            assert counting.inner.get(f"{keys.PREFIX}/w2") == "2"
+            read_kv.delete_prefix(f"{keys.PREFIX}/w")
+            assert counting.inner.get_or(f"{keys.PREFIX}/w") is None
+        finally:
+            inf.close()
+
+
+class TestVersionMapShadow:
+    def test_standby_reads_are_watch_fed_with_zero_store_reads(self):
+        counting = CountingKV(MemoryKV())
+        writer = VersionMap(counting, keys.VERSIONS_CONTAINER_KEY)
+        writer.next_version("web")  # -> 0
+        standby = VersionMap(counting, keys.VERSIONS_CONTAINER_KEY,
+                             read_through=lambda: True)
+        inf = make_informer(counting)
+        standby.attach_informer(inf)
+        inf.start()
+        try:
+            wait_until(lambda: inf.synced, what="sync")
+            before = counting.snapshot()
+            for _ in range(25):
+                assert standby.get("web") == 0
+                assert standby.contains("web")
+                assert standby.snapshot() == {"web": 0}
+            assert CountingKV.delta(
+                before, counting.snapshot()).get("get", 0) == 0
+            # a leader-side bump flows through the watch, not a read
+            writer.next_version("web")
+            wait_until(lambda: standby.get("web") == 1,
+                       what="shadow observing the bump")
+            # family delete flows too (no resurrect)
+            writer.remove("web")
+            wait_until(lambda: standby.get("web") is None,
+                       what="shadow observing the delete")
+        finally:
+            inf.close()
+
+    def test_degraded_informer_falls_back_to_read_through(self):
+        counting = CountingKV(MemoryKV())
+        writer = VersionMap(counting, keys.VERSIONS_CONTAINER_KEY)
+        writer.next_version("web")
+        standby = VersionMap(counting, keys.VERSIONS_CONTAINER_KEY,
+                             read_through=lambda: True)
+        inf = make_informer(counting)  # NEVER started ⇒ unsynced
+        standby.attach_informer(inf)
+        writer.next_version("web")  # bump AFTER the standby's boot seed
+        before = counting.snapshot()
+        assert standby.get("web") == 1  # fresh: re-seeded from the store
+        assert CountingKV.delta(
+            before, counting.snapshot()).get("get", 0) == 1
+
+    def test_leader_map_never_consults_the_shadow(self):
+        """The shadow is read-only standby material: a (possibly lagging)
+        event stream must not be able to roll the authoritative map back
+        and re-issue a version number."""
+        kv = MemoryKV()
+        vm = VersionMap(kv, keys.VERSIONS_CONTAINER_KEY,
+                        read_through=lambda: False)  # leader role
+        inf = make_informer(kv)
+        vm.attach_informer(inf)
+        # simulate a stale shadow (an event the informer applied late)
+        vm._shadow = {"web": 0}
+        assert vm.next_version("web") == 0
+        assert vm.next_version("web") == 1  # local map, not the shadow
+        assert vm.get("web") == 1
+
+
+class TestTwoProgramsOneSqliteFile:
+    """The integration staleness bound: leader and standby are separate
+    Program instances over separate SqliteKV connections to ONE file —
+    the watch path is the sqlite changelog, exactly what two real daemon
+    processes would share."""
+
+    @pytest.fixture()
+    def fleet(self, tmp_path):
+        runtime = FakeRuntime()
+        progs = []
+        for name in ("sq-leader", "sq-standby"):
+            cfg = config_mod.Config(
+                port=0, store_backend="sqlite",
+                sqlite_path=str(tmp_path / "shared.db"),
+                runtime_backend="fake",
+                start_port=41200, end_port=41299,
+                health_watch_interval=0, host_probe_interval_s=0,
+                job_supervise_interval=0, reconcile_interval=0,
+                leader_election=True, leader_ttl_s=30.0,
+                leader_renew_interval_s=0.05, leader_id=name)
+            prg = Program(cfg, host="127.0.0.1", runtime=runtime)
+            prg.init()
+            prg.start()
+            progs.append(prg)
+            if name == "sq-leader":
+                wait_until(lambda: prg.leader_elector.accepts_mutations,
+                           what="leader acquisition")
+        try:
+            yield progs
+        finally:
+            for prg in progs:
+                try:
+                    prg.stop()
+                except Exception:
+                    pass
+
+    @staticmethod
+    def _call(port, method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_leader_write_visible_on_standby_within_lag_bound(self, fleet):
+        leader, standby = fleet
+        assert standby.informer is not None
+        wait_until(lambda: standby.informer.synced, what="standby sync")
+        assert not standby.leader_elector.is_leader
+
+        status, out = self._call(
+            leader.api_server.port, "POST", "/api/v1/containers",
+            {"imageName": "jax", "containerName": "shared", "chipCount": 0})
+        assert (status, out["code"]) == (200, 200)
+
+        # the documented staleness bound: watch lag, not replica uptime.
+        # 2 s is the reads-family budget; the sqlite poll cadence is 20 ms,
+        # so this passes with two orders of magnitude of slack or fails
+        # for a real reason.
+        t0 = time.monotonic()
+        wait_until(
+            lambda: self._call(standby.api_server.port, "GET",
+                               "/api/v1/containers/shared-0")[1]["code"]
+            == 200,
+            timeout_s=2.0, what="standby visibility within the lag budget")
+        lag_s = time.monotonic() - t0
+        assert lag_s <= 2.0
+
+        # the read was served by the informer path, and the roles held
+        _, health = self._call(standby.api_server.port, "GET", "/healthz")
+        assert health["data"]["role"] == "standby"
+        assert health["data"]["informer"]["synced"] is True
+        assert health["data"]["informer"]["cacheHits"] >= 1
+        _, lead = self._call(standby.api_server.port, "GET",
+                             "/api/v1/leader")
+        assert lead["data"]["role"] == "standby"
+        assert lead["data"]["informer"]["synced"] is True
+
+        # family delete propagates too — the standby must not resurrect
+        status, out = self._call(
+            leader.api_server.port, "DELETE", "/api/v1/containers/shared",
+            {"force": True, "delEtcdInfoAndVersionRecord": True})
+        assert (status, out["code"]) == (200, 200)
+        wait_until(
+            lambda: standby.container_versions.get("shared") is None,
+            timeout_s=2.0, what="standby observing the family delete")

@@ -30,25 +30,17 @@ BACKENDS = ("memory", "sqlite", "etcd")
 
 @pytest.fixture()
 def gateway():
-    # the bytes-level fake etcd grpc-gateway, shared with test_etcd_kv
-    # (pytest puts this directory on sys.path in no-package layouts)
-    from http.server import ThreadingHTTPServer
-
-    from test_etcd_kv import _FakeGateway
-
+    # the bytes-level fake etcd grpc-gateway (tests/etcd_gateway.py,
+    # shared with test_etcd_kv/test_kv_watch; pytest puts this directory
+    # on sys.path in no-package layouts)
     pytest.importorskip("requests")
-    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakeGateway)
-    server.store = {}
-    server.fail_next = 0
-    server.fail_seen = 0
-    server.txn_count = 0
-    t = threading.Thread(target=server.serve_forever, daemon=True)
-    t.start()
+    from etcd_gateway import start_gateway, stop_gateway
+
+    server, _ = start_gateway()
     try:
         yield server
     finally:
-        server.shutdown()
-        server.server_close()
+        stop_gateway(server)
 
 
 @pytest.fixture(params=BACKENDS)

@@ -1,0 +1,308 @@
+"""Watch conformance across the three KV backends (docs/perf.md "Read
+path").
+
+One parametrized suite drives MemoryKV (in-process subscribers notified
+under the mutation's lock hold), SqliteKV (changelog table written in the
+SAME transaction as the data, tailed by indexed rev — including from a
+second instance over the same file, the two-real-processes story), and
+EtcdKV (native ``/v3/watch`` stream against the shared fake gateway,
+tests/etcd_gateway.py). The contract under test is what the informer
+(state/informer.py) builds on: list-then-watch loses nothing, revisions
+are monotonic, ``delete_prefix`` expands per key, and any gap —
+compaction, overflow — is a typed WatchLost, never a silent hole.
+"""
+
+import time
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.state.kv import (
+    CountingKV,
+    MemoryKV,
+    SqliteKV,
+    WatchEvent,
+)
+
+BACKENDS = ("memory", "sqlite", "etcd")
+
+
+def drain(watch, want: int, timeout_s: float = 5.0) -> list[WatchEvent]:
+    """Poll until ``want`` events arrived (tolerates per-backend delivery
+    cadence: push for memory, poll for sqlite, stream for etcd)."""
+    events: list[WatchEvent] = []
+    deadline = time.monotonic() + timeout_s
+    while len(events) < want and time.monotonic() < deadline:
+        events.extend(watch.poll(0.1))
+    return events
+
+
+def expect_lost(watch, timeout_s: float = 5.0) -> errors.WatchLost:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            watch.poll(0.1)
+        except errors.WatchLost as e:
+            return e
+    pytest.fail("watch never raised WatchLost")
+
+
+@pytest.fixture(params=BACKENDS)
+def kv(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryKV()
+    elif request.param == "sqlite":
+        store = SqliteKV(str(tmp_path / "watch.db"))
+        yield store
+        store.close()
+    else:
+        requests = pytest.importorskip("requests")  # noqa: F841
+        from etcd_gateway import start_gateway, stop_gateway
+
+        from tpu_docker_api.state.kv import EtcdKV
+
+        server, _ = start_gateway()
+        store = EtcdKV(f"http://127.0.0.1:{server.server_address[1]}")
+        yield store
+        store.close()
+        stop_gateway(server)
+
+
+class TestWatchConformance:
+    def test_list_then_watch_loses_nothing(self, kv):
+        """The informer handshake: a snapshot at rev R plus a watch from R
+        covers every mutation exactly once — no gap, no double."""
+        kv.put("/w/seed", "s0")
+        snap, rev = kv.range_prefix_with_rev("/w/")
+        assert snap == {"/w/seed": "s0"}
+        w = kv.watch("/w/", rev)
+        try:
+            kv.put("/w/a", "1")
+            kv.put("/w/b", "2")
+            kv.delete("/w/a")
+            events = drain(w, 3)
+            # the seed predates the snapshot: it must NOT be replayed
+            assert [(e.op, e.key, e.value) for e in events] == [
+                ("put", "/w/a", "1"), ("put", "/w/b", "2"),
+                ("delete", "/w/a", None)]
+            assert all(e.rev > rev for e in events)
+        finally:
+            w.close()
+
+    def test_revs_monotonic_across_mutations(self, kv):
+        w = kv.watch("/w/", kv.current_rev())
+        try:
+            for i in range(5):
+                kv.put(f"/w/k{i}", str(i))
+            events = drain(w, 5)
+            revs = [e.rev for e in events]
+            assert revs == sorted(revs)
+            # separate mutations never share a revision
+            assert len(set(revs)) == 5
+        finally:
+            w.close()
+
+    def test_prefix_filtering(self, kv):
+        w = kv.watch("/w/in/", kv.current_rev())
+        try:
+            kv.put("/w/out", "x")
+            kv.put("/w/in/a", "y")
+            kv.put("/other", "z")
+            events = drain(w, 1)
+            assert [(e.op, e.key) for e in events] == [("put", "/w/in/a")]
+            assert not w.poll(0.2)
+        finally:
+            w.close()
+
+    def test_apply_batch_delivered_whole_in_order(self, kv):
+        kv.put("/w/gone", "old")
+        w = kv.watch("/w/", kv.current_rev())
+        try:
+            kv.apply([("put", "/w/a", "1"), ("put", "/w/b", "2"),
+                      ("delete", "/w/gone")])
+            events = drain(w, 3)
+            assert [(e.op, e.key) for e in events] == [
+                ("put", "/w/a"), ("put", "/w/b"), ("delete", "/w/gone")]
+            # non-decreasing within the batch (etcd stamps one rev per txn;
+            # memory/sqlite one per key — both satisfy the contract)
+            revs = [e.rev for e in events]
+            assert revs == sorted(revs)
+        finally:
+            w.close()
+
+    def test_delete_prefix_expands_per_existing_key(self, kv):
+        """A cache fed by this stream never needs a relist for a family
+        purge: each existing key gets its own delete event, and deleting
+        nothing emits nothing."""
+        kv.put("/w/fam/a", "1")
+        kv.put("/w/fam/b", "2")
+        kv.put("/w/other", "3")
+        w = kv.watch("/w/", kv.current_rev())
+        try:
+            kv.delete("/w/absent")          # no such key: no event
+            kv.delete_prefix("/w/nothing/")  # empty prefix: no event
+            kv.delete_prefix("/w/fam/")
+            events = drain(w, 2)
+            assert sorted((e.op, e.key) for e in events) == [
+                ("delete", "/w/fam/a"), ("delete", "/w/fam/b")]
+            assert not w.poll(0.2)
+        finally:
+            w.close()
+
+    def test_compacted_start_rev_is_typed_watch_lost(self, kv, tmp_path):
+        """A watcher resuming from a revision the backend no longer
+        retains must get WatchLost (relist signal), never a silent gap.
+        Per-backend retention knob: tiny log for memory/sqlite; the etcd
+        case (server-side compaction) lives in TestEtcdWatchGateway."""
+        if isinstance(kv, MemoryKV):
+            store = MemoryKV(log_retain=4)
+        elif isinstance(kv, SqliteKV):
+            store = SqliteKV(str(tmp_path / "compact.db"),
+                             log_retain=4, trim_every=1)
+        else:
+            pytest.skip("etcd compaction covered by "
+                        "TestEtcdWatchGateway::"
+                        "test_compaction_cancel_maps_to_watch_lost")
+        for i in range(12):
+            store.put(f"/w/c{i}", str(i))
+        w = store.watch("/w/", start_rev=1)
+        try:
+            expect_lost(w)
+        finally:
+            w.close()
+            store.close()
+
+
+class TestEtcdWatchGateway:
+    """Gateway-specific watch behavior (real HTTP chunked stream)."""
+
+    @pytest.fixture()
+    def pair(self):
+        pytest.importorskip("requests")
+        from etcd_gateway import start_gateway, stop_gateway
+
+        from tpu_docker_api.state.kv import EtcdKV
+
+        server, _ = start_gateway()
+        kv = EtcdKV(f"http://127.0.0.1:{server.server_address[1]}")
+        try:
+            yield server, kv
+        finally:
+            kv.close()
+            stop_gateway(server)
+
+    def test_txn_events_share_one_revision(self, pair):
+        server, kv = pair
+        w = kv.watch("/t/", kv.current_rev())
+        try:
+            kv.apply([("put", "/t/a", "1"), ("put", "/t/b", "2")])
+            events = drain(w, 2)
+            assert len(events) == 2
+            assert events[0].rev == events[1].rev  # one txn, one revision
+        finally:
+            w.close()
+
+    def test_compaction_cancel_maps_to_watch_lost(self, pair):
+        server, kv = pair
+        for i in range(5):
+            kv.put(f"/t/k{i}", str(i))
+        server.compacted = 3
+        w = kv.watch("/t/", start_rev=1)
+        try:
+            e = expect_lost(w)
+            assert "compact" in str(e)
+        finally:
+            w.close()
+
+    def test_close_tears_down_the_stream(self, pair):
+        server, kv = pair
+        w = kv.watch("/t/", 0)
+        w.close()
+        assert w.poll(0.1) == []  # closed: quiet, not an error
+
+    def test_range_with_rev_tracks_header_revision(self, pair):
+        server, kv = pair
+        _, rev0 = kv.range_prefix_with_rev("/t/")
+        kv.put("/t/a", "1")
+        snap, rev1 = kv.range_prefix_with_rev("/t/")
+        assert snap == {"/t/a": "1"}
+        assert rev1 == rev0 + 1
+
+
+class TestSqliteChangelog:
+    """The same-transaction property that makes shared-file watch sound."""
+
+    def test_failed_guard_logs_nothing(self, tmp_path):
+        """Data write and changelog row are one transaction: a rolled-back
+        apply leaves NEITHER (a watcher can never see a mutation that did
+        not happen)."""
+        store = SqliteKV(str(tmp_path / "atomic.db"))
+        store.put("/s/seed", "v")
+        rev = store.current_rev()
+        w = store.watch("/s/", rev)
+        with pytest.raises(errors.GuardFailed):
+            store.apply([("put", "/s/x", "1")],
+                        guards=[("value", "/s/seed", "WRONG")])
+        assert store.current_rev() == rev
+        assert w.poll(0.3) == []
+        assert store.get_or("/s/x") is None
+        w.close()
+        store.close()
+
+    def test_second_instance_over_same_file_sees_events(self, tmp_path):
+        """Two SqliteKV instances over one file = two processes sharing
+        the store (the HA verification shape): a watch opened on B sees
+        A's committed mutations, in order, with revisions assigned by the
+        shared AUTOINCREMENT — monotonic across writers."""
+        path = str(tmp_path / "shared.db")
+        a, b = SqliteKV(path), SqliteKV(path)
+        w = b.watch("/s/", b.current_rev())
+        try:
+            a.put("/s/1", "x")
+            b.put("/s/2", "y")   # interleaved writers
+            a.delete("/s/1")
+            events = drain(w, 3)
+            assert [(e.op, e.key) for e in events] == [
+                ("put", "/s/1"), ("put", "/s/2"), ("delete", "/s/1")]
+            assert [e.rev for e in events] == sorted(e.rev for e in events)
+        finally:
+            w.close()
+            a.close()
+            b.close()
+
+
+class TestWrapperDelegation:
+    """CountingKV/FencedKV sit in the daemon's store stack: watch and the
+    rev-snapshot read must pass through (and watch traffic must be counted
+    as ONE open, not per event — the amortization the bench audits)."""
+
+    def test_counting_kv_counts_watch_once(self):
+        counting = CountingKV(MemoryKV())
+        snap, rev = counting.range_prefix_with_rev("/c/")
+        w = counting.watch("/c/", rev)
+        try:
+            for i in range(10):
+                counting.put(f"/c/k{i}", str(i))
+            assert len(drain(w, 10)) == 10
+            counts = counting.snapshot()
+            assert counts["watch"] == 1          # one stream open
+            assert counts["range_prefix"] == 1   # the list half
+        finally:
+            w.close()
+
+    def test_fenced_kv_watch_is_unfenced_read(self):
+        from tpu_docker_api.service.leader import FencedKV
+
+        inner = MemoryKV()
+        fenced = FencedKV(inner, lambda: [("value", "/nope", "never")])
+        # every WRITE through the fence loses its guard...
+        with pytest.raises(errors.GuardFailed):
+            fenced.put("/f/a", "1")
+        # ...but watch + rev-listing are reads: they must work regardless
+        snap, rev = fenced.range_prefix_with_rev("/f/")
+        w = fenced.watch("/f/", rev)
+        try:
+            inner.put("/f/a", "1")
+            assert [(e.op, e.key) for e in drain(w, 1)] == [("put", "/f/a")]
+        finally:
+            w.close()

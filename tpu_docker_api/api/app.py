@@ -45,6 +45,7 @@ Route table:
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import re
@@ -141,7 +142,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  health_watcher=None, metrics=None,
                  job_svc=None, pod_scheduler=None, reconciler=None,
                  job_supervisor=None, host_monitor=None,
-                 leader_elector=None) -> Router:
+                 leader_elector=None, informer=None) -> Router:
     r = Router(metrics=metrics)
     # HA role gate (service/leader.py): on a standby replica every non-GET
     # request is answered 503 + the leader hint BEFORE dispatch — reads
@@ -374,7 +375,13 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         # it, and "single" keeps the no-election deployment unambiguous
         role = ("single" if leader_elector is None
                 else ("leader" if leader_elector.is_leader else "standby"))
-        return {"status": "ok", "role": role, **build_info()}
+        out = {"status": "ok", "role": role, **build_info()}
+        if informer is not None:
+            # read-path health rides liveness: a standby whose informer is
+            # degraded still serves (read-through fallback) but slower —
+            # load balancers and operators see it here
+            out["informer"] = informer.status_view()
+        return out
 
     r.add("GET", "/healthz", healthz)
 
@@ -384,32 +391,38 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                     "selfId": None, "holderId": None, "epoch": None,
                     "deadline": None, "advertise": "", "ttlS": None,
                     "fencingEpoch": 0}
-        return leader_elector.status_view()
+        out = leader_elector.status_view()
+        if informer is not None:
+            out["informer"] = informer.status_view()
+        return out
 
     r.add("GET", "/api/v1/leader", leader_view)
     if (health_watcher is not None or job_supervisor is not None
-            or host_monitor is not None or leader_elector is not None):
+            or host_monitor is not None or leader_elector is not None
+            or informer is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
-        # supervisor), host health transitions (host monitor) and
-        # leadership transitions (elector), ordered by timestamp
-        # (SURVEY.md §5.3)
+        # supervisor), host health transitions (host monitor), leadership
+        # transitions (elector) and informer degradations, ordered by
+        # timestamp (SURVEY.md §5.3)
         def h_events(body, **_):
             try:
                 limit = int(body.get("limit", 100))
             except (TypeError, ValueError):
                 raise errors.BadRequest("limit must be an integer") from None
-            events = []
-            if health_watcher is not None:
-                events.extend(health_watcher.events_view(limit=limit))
-            if job_supervisor is not None:
-                events.extend(job_supervisor.events_view(limit=limit))
-            if host_monitor is not None:
-                events.extend(host_monitor.events_view(limit=limit))
-            if leader_elector is not None:
-                events.extend(leader_elector.events_view(limit=limit))
-            events.sort(key=lambda e: e.get("ts", 0))
-            return events[-limit:] if limit > 0 else []
+            if limit <= 0:
+                return []
+            # each source ring is already time-ordered (append-only deques
+            # stamped at append time), so MERGE the sorted rings instead of
+            # re-sorting the concatenation on every request — this is a hot
+            # observability path under bench load, and n·log(n) over the
+            # combined rings per GET was pure waste
+            rings = [src.events_view(limit=limit)
+                     for src in (health_watcher, job_supervisor,
+                                 host_monitor, leader_elector, informer)
+                     if src is not None]
+            merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
+            return list(merged)[-limit:]
 
         r.add("GET", "/api/v1/events", h_events)
     if health_watcher is not None:

@@ -192,8 +192,9 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "cordon/schedulability", None),
     ("GET", "/api/v1/events", "getHealthEvents",
      "Container liveness transitions (health watcher) merged with gang "
-     "lifecycle events (job supervisor) and host health transitions "
-     "(host monitor), ordered by timestamp", None),
+     "lifecycle events (job supervisor), host health transitions "
+     "(host monitor), leadership transitions and informer degradations — "
+     "pre-sorted rings merged by timestamp", None),
     ("GET", "/api/v1/health/containers", "getHealthStatus",
      "Per-container liveness + restart bookkeeping", None),
     ("GET", "/api/v1/health/jobs", "getJobHealth",
@@ -204,7 +205,9 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "HA control-plane election view: this replica's role (single/leader/"
      "standby), the lease holder, the monotonically increasing fencing "
      "epoch, and the lease deadline. Standbys answer every mutation with "
-     "503 + this holder as the redirect hint", None),
+     "503 + this holder as the redirect hint. With read_cache=informer the "
+     "watch-fed read-cache state rides along (synced, lastRev, watchLagMs, "
+     "event/relist/cache-hit counters)", None),
     ("GET", "/api/v1/queue", "getQueueStats",
      "Durable work-queue view: in-memory depth, journal lifecycle counts "
      "(pending/inflight/dead), degradation events and counters", None),
@@ -227,7 +230,10 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("GET", "/api/v1/debug/threads", "getThreadDump",
      "Per-thread stack dump (the pprof-goroutine analog): hung copies and "
      "deadlocked family locks are visible here", None),
-    ("GET", "/healthz", "healthz", "Process liveness", None),
+    ("GET", "/healthz", "healthz",
+     "Process liveness + HA role; with read_cache=informer also the "
+     "watch-fed read-cache health (a degraded informer still serves via "
+     "read-through fallback, but slower — visible here)", None),
     ("GET", "/metrics", "metrics",
      "Prometheus text format: request/latency/chip/port/queue gauges", None),
 ]

@@ -118,6 +118,14 @@ class Config:
     leader_renew_interval_s: float = 0.0
     # identity in the lease record; "" ⇒ hostname:pid
     leader_id: str = ""
+    # standby read path (state/informer.py; only meaningful with
+    # leader_election = true): "informer" (default) serves standby GETs
+    # from a watch-fed local mirror — zero store round trips per request,
+    # staleness bounded by watch lag — falling back to per-read
+    # read-through whenever the informer is unsynced/degraded;
+    # "read-through" keeps PR 7's per-read store re-seeding unconditionally.
+    # Leader and single-process read behavior is identical either way.
+    read_cache: str = "informer"
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container
@@ -144,4 +152,8 @@ def load(path: str | None = None) -> Config:
         raise ValueError(
             f"restart_policy must be 'none' or 'on-failure', "
             f"got {cfg.restart_policy!r}")
+    if cfg.read_cache not in ("informer", "read-through"):
+        raise ValueError(
+            f"read_cache must be 'informer' or 'read-through', "
+            f"got {cfg.read_cache!r}")
     return cfg

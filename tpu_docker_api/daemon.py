@@ -90,7 +90,26 @@ class Program:
             self.kv = FencedKV(raw_kv, self._fence_guards)
         else:
             self.kv = raw_kv
-        self.store = StateStore(self.kv)
+        # watch-fed standby read path (state/informer.py, ROADMAP item 2's
+        # "stateless API replicas serving reads from watch-fed caches"):
+        # one informer mirrors the whole /apis/v1 tree off the RAW store
+        # (watch is a read; fencing never applies), and read_kv routes
+        # get/range_prefix to that mirror while this replica stands by —
+        # zero store round trips per GET, staleness bounded by watch lag.
+        # Writes, leader reads, degraded-informer reads and the whole
+        # read_cache="read-through" / leader_election=false configuration
+        # pass through to self.kv byte-for-byte.
+        self.informer = None
+        read_kv = self.kv
+        if cfg.leader_election and cfg.read_cache == "informer":
+            from tpu_docker_api.state.informer import Informer, InformerReadKV
+
+            self.informer = Informer(raw_kv, keys.PREFIX + "/",
+                                     registry=self.metrics)
+            read_kv = InformerReadKV(self.kv, self.informer,
+                                     active=self._standby_reads_active)
+        self.read_kv = read_kv
+        self.store = StateStore(read_kv)
         self.runtime = self._injected_runtime or (
             open_runtime("docker", docker_host=cfg.docker_host)
             if cfg.runtime_backend == "docker"
@@ -118,10 +137,10 @@ class Program:
              and not self.leader_elector.is_leader)
             if cfg.leader_election else False)
         self.container_versions = VersionMap(
-            self.kv, keys.VERSIONS_CONTAINER_KEY,
+            read_kv, keys.VERSIONS_CONTAINER_KEY,
             read_through=standby_read_through)
         self.volume_versions = VersionMap(
-            self.kv, keys.VERSIONS_VOLUME_KEY,
+            read_kv, keys.VERSIONS_VOLUME_KEY,
             read_through=standby_read_through)
         self.container_svc = ContainerService(
             self.runtime, self.store, self.chip_scheduler, self.port_scheduler,
@@ -132,8 +151,16 @@ class Program:
         )
         self.pod = self._build_pod(topology)
         self.pod_scheduler = PodScheduler(self.pod, self.kv)
-        self.job_versions = VersionMap(self.kv, keys.VERSIONS_JOB_KEY,
+        self.job_versions = VersionMap(read_kv, keys.VERSIONS_JOB_KEY,
                                        read_through=standby_read_through)
+        if self.informer is not None:
+            # standby version reads go fully watch-fed: zero store reads
+            # AND zero JSON re-parses per request (the shadow updates on
+            # events, not on reads); the informer-degraded fallback inside
+            # VersionMap keeps the old read-through staleness bound
+            for vm in (self.container_versions, self.volume_versions,
+                       self.job_versions):
+                vm.attach_informer(self.informer)
         self.job_svc = JobService(
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path,
@@ -253,6 +280,15 @@ class Program:
         empty until the elector first acquires, then the acquired epoch."""
         elector = getattr(self, "leader_elector", None)
         return [] if elector is None else elector.fence_guards()
+
+    def _standby_reads_active(self) -> bool:
+        """InformerReadKV's role predicate: serve reads from the mirror
+        only while STANDING BY. The leader's own maps are authoritative
+        (every write is local), and the leadership-handoff cache reload in
+        _start_writers must read the real store — is_leader flips True
+        before on_acquire fires, so those reloads pass through here."""
+        elector = getattr(self, "leader_elector", None)
+        return elector is not None and not elector.is_leader
 
     def _build_pod(self, local_topology: HostTopology) -> Pod:
         """Multi-host pod from [[pod_hosts]] config, else a single-host pod
@@ -405,6 +441,12 @@ class Program:
             self.wq.close()
 
     def start(self) -> None:
+        if self.informer is not None:
+            # the mirror warms on BOTH roles (a demoted leader must serve
+            # cached reads immediately, not after a cold list) and before
+            # the elector, so a standby's first GETs can already hit it;
+            # until the initial list lands, reads fall through to the store
+            self.informer.start()
         if self.leader_elector is None:
             # single-process: writers start unconditionally, as always
             self._start_writers()
@@ -416,6 +458,7 @@ class Program:
             reconciler=self.reconciler, job_supervisor=self.job_supervisor,
             host_monitor=self.host_monitor,
             leader_elector=self.leader_elector,
+            informer=self.informer,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -444,6 +487,8 @@ class Program:
             # instead of waiting out the TTL (the epoch key stays put —
             # fencing monotonicity)
             self.leader_elector.close(release=True)
+        if getattr(self, "informer", None) is not None:
+            self.informer.close()
         self._stop_writers()
         if getattr(self, "pod", None) is not None:
             for host in self.pod.hosts.values():

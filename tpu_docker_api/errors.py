@@ -120,6 +120,18 @@ class GuardFailed(ApiError):
     code = 10503
 
 
+class WatchLost(ApiError):
+    """A ``KV.watch`` stream can no longer deliver a gapless event
+    sequence: the changelog was compacted past the watcher's revision, a
+    slow consumer overflowed its buffer, or the server canceled the
+    stream. The continuation contract is broken — the ONLY correct
+    recovery is a full relist (``range_prefix_with_rev``) and a fresh
+    watch from the new revision, which is exactly what the informer
+    (state/informer.py) does. Never silently swallowed: a cache that kept
+    serving across a gap would hide deletes forever."""
+    code = 10504
+
+
 # --- schedulers (xerrors/scheduler.go:8-10) -----------------------------------
 
 class ChipNotEnough(ApiError):

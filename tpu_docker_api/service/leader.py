@@ -401,6 +401,16 @@ class FencedKV(KV):
     def range_prefix(self, prefix: str) -> dict[str, str]:
         return self.inner.range_prefix(prefix)
 
+    def range_prefix_with_rev(self, prefix: str):
+        return self.inner.range_prefix_with_rev(prefix)
+
+    def current_rev(self) -> int:
+        return self.inner.current_rev()
+
+    def watch(self, prefix: str, start_rev: int = 0):
+        # watch is a READ: standbys tail freely, fencing never applies
+        return self.inner.watch(prefix, start_rev)
+
     def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
         # the base template (our public ``apply``) already validated and
         # fired the txn crash points — delegate to the inner BACKEND's

@@ -20,21 +20,76 @@ same txn/lock that applies the ops). A failed guard applies NOTHING and
 raises the typed :class:`errors.GuardFailed`. This is the primitive the HA
 control plane rides: leader-lease CAS (service/leader.py) and epoch fencing
 of a deposed leader's writes are both one guarded apply.
+
+The read-scaling half is **watch**: every mutation is stamped with a
+monotonic revision and emitted as a ``(rev, op, key, value)`` event, so a
+standby replica can list once and then tail changes instead of re-reading
+the store per request (the client-go informer pattern, state/informer.py).
+``MemoryKV`` notifies in-process subscribers under the same lock hold that
+applies the mutation; ``SqliteKV`` appends to a changelog table INSIDE the
+same transaction as the data write (watchers — including ones in other
+processes sharing the file — tail it by indexed rev); ``EtcdKV`` rides the
+native ``/v3/watch`` stream. ``delete_prefix`` expands to one delete event
+per existing key, so a watch-fed cache never needs a relist on the happy
+path; a gap (compaction, slow-consumer overflow, canceled stream) surfaces
+as the typed :class:`errors.WatchLost`, whose only correct recovery is
+relist-then-rewatch.
 """
 
 from __future__ import annotations
 
 import abc
 import base64
+import collections
 import sqlite3
 import threading
 import time
+from typing import NamedTuple
 
 from tpu_docker_api import errors
 
 #: op kinds KV.apply accepts: ("put", key, value) | ("delete", key) |
 #: ("delete_prefix", prefix)
 _APPLY_OPS = {"put": 3, "delete": 2, "delete_prefix": 2}
+
+#: events retained for watch replay/buffering on the hermetic backends
+#: (MemoryKV global log + per-watch queues; SqliteKV changelog rows). A
+#: watcher that falls further behind than this loses the gapless contract
+#: and gets a typed WatchLost instead of a silent gap.
+WATCH_LOG_RETAIN = 4096
+
+
+class WatchEvent(NamedTuple):
+    """One mutation, as a watcher sees it. ``rev`` is the store's monotonic
+    revision: non-decreasing across events, strictly greater than any
+    earlier mutation's rev (etcd stamps every key changed by one txn with
+    the same rev; memory/sqlite stamp per key). ``op`` is ``"put"`` or
+    ``"delete"``; ``value`` is None for deletes. A ``delete_prefix`` is
+    always expanded to one event per key that actually existed — deleting
+    an absent key emits nothing, matching etcd."""
+
+    rev: int
+    op: str
+    key: str
+    value: str | None
+
+
+class Watch(abc.ABC):
+    """Handle on an event stream from :meth:`KV.watch`. Pull-based so every
+    backend (push-notified memory, poll-tailed sqlite, streamed etcd) looks
+    identical to the informer loop that consumes it."""
+
+    @abc.abstractmethod
+    def poll(self, timeout_s: float = 0.0) -> list[WatchEvent]:
+        """Events since the last poll, in rev order; blocks up to
+        ``timeout_s`` when none are pending ([] on timeout). Raises
+        :class:`errors.WatchLost` when the gapless contract is broken
+        (compaction past our rev, buffer overflow, canceled stream) and
+        :class:`errors.StoreUnavailable` when the path to the store died —
+        both mean relist-then-rewatch."""
+
+    def close(self) -> None:  # noqa: B027
+        pass
 
 
 def _check_guards(guards: list[tuple] | None) -> list[tuple]:
@@ -135,20 +190,98 @@ class KV(abc.ABC):
         except errors.NotExistInStore:
             return default
 
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        """Tail mutations under ``prefix``: events with rev **strictly
+        greater than** ``start_rev``, in order, with no gaps. Pair with
+        :meth:`range_prefix_with_rev` for the list-then-watch handshake:
+        snapshot at rev R, watch from R, and nothing is missed or doubled.
+        Backends implement this; plain wrapper KVs delegate."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support watch")
+
+    def current_rev(self) -> int:
+        """The store's latest revision (0 = no mutation ever observed).
+        Backends with real revision tracking override; the base returns 0
+        so simple test doubles keep working (watch from 0 = everything)."""
+        return 0
+
+    def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
+        """Atomic (snapshot, revision) pair — the list half of the informer
+        handshake. The revision is taken with the snapshot (same lock hold
+        / read transaction / response header), so ``watch(prefix, rev)``
+        delivers exactly the mutations the snapshot does not contain."""
+        return self.range_prefix(prefix), self.current_rev()
+
     def close(self) -> None:  # noqa: B027
         pass
 
 
-class MemoryKV(KV):
-    """In-process dict store for hermetic tests."""
+class _MemoryWatch(Watch):
+    """Per-subscriber bounded queue. The emitting thread offers events
+    under the store lock; poll drains under the watch's own condition (the
+    kv-lock → watch-lock order is one-way, so no deadlock)."""
 
-    def __init__(self) -> None:
+    def __init__(self, kv: "MemoryKV", prefix: str, maxlen: int) -> None:
+        self._kv = kv
+        self.prefix = prefix
+        self._maxlen = maxlen
+        self._cv = threading.Condition()
+        self._q: collections.deque[WatchEvent] = collections.deque()
+        self._lost: str | None = None
+
+    def _offer(self, events: list[WatchEvent]) -> None:
+        """Called by the mutator with kv._mu held."""
+        with self._cv:
+            for ev in events:
+                if not ev.key.startswith(self.prefix):
+                    continue
+                if self._q and len(self._q) >= self._maxlen:
+                    # a slow consumer must lose LOUDLY, not drop silently
+                    self._lost = (f"watch buffer overflow at "
+                                  f"{self._maxlen} events")
+                    break
+                self._q.append(ev)
+            self._cv.notify_all()
+
+    def _mark_lost(self, why: str) -> None:
+        with self._cv:
+            self._lost = why
+            self._cv.notify_all()
+
+    def poll(self, timeout_s: float = 0.0) -> list[WatchEvent]:
+        with self._cv:
+            if not self._q and self._lost is None and timeout_s > 0:
+                self._cv.wait(timeout_s)
+            if self._lost is not None:
+                raise errors.WatchLost(self._lost)
+            out = list(self._q)
+            self._q.clear()
+            return out
+
+    def close(self) -> None:
+        self._kv._unsubscribe(self)
+
+
+class MemoryKV(KV):
+    """In-process dict store for hermetic tests (and the shared-object
+    store multi-``Program`` harnesses inject). Every mutation funnels
+    through :meth:`_apply`, which stamps revisions and notifies watch
+    subscribers under the SAME lock hold that applies the ops — an
+    in-process watcher can never observe a gap or a reordering."""
+
+    def __init__(self, log_retain: int = WATCH_LOG_RETAIN) -> None:
         self._d: dict[str, str] = {}
         self._mu = threading.Lock()
+        self._rev = 0
+        self._log_retain = log_retain
+        self._log: collections.deque[WatchEvent] = collections.deque()
+        self._trimmed_below = 0  # revs <= this are gone from the log
+        self._watches: list[_MemoryWatch] = []
 
     def put(self, key: str, value: str) -> None:
-        with self._mu:
-            self._d[key] = value
+        self._apply([("put", key, value)])
 
     def get(self, key: str) -> str:
         with self._mu:
@@ -157,8 +290,7 @@ class MemoryKV(KV):
             return self._d[key]
 
     def delete(self, key: str) -> None:
-        with self._mu:
-            self._d.pop(key, None)
+        self._apply([("delete", key)])
 
     def range_prefix(self, prefix: str) -> dict[str, str]:
         with self._mu:
@@ -167,9 +299,35 @@ class MemoryKV(KV):
     def delete_prefix(self, prefix: str) -> None:
         # one lock hold, not one delete per key — the purge paths submit a
         # single op and the backend must honor that shape
+        self._apply([("delete_prefix", prefix)])
+
+    def current_rev(self) -> int:
         with self._mu:
-            for k in [k for k in self._d if k.startswith(prefix)]:
-                del self._d[k]
+            return self._rev
+
+    def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
+        with self._mu:
+            snap = {k: v for k, v in sorted(self._d.items())
+                    if k.startswith(prefix)}
+            return snap, self._rev
+
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        w = _MemoryWatch(self, prefix, maxlen=self._log_retain)
+        with self._mu:
+            if start_rev < self._trimmed_below:
+                # replay would have a hole: fail at first poll, like etcd's
+                # compacted-revision cancel
+                w._mark_lost(f"start rev {start_rev} compacted (log "
+                             f"trimmed through rev {self._trimmed_below})")
+            else:
+                w._offer([ev for ev in self._log if ev.rev > start_rev])
+            self._watches.append(w)
+        return w
+
+    def _unsubscribe(self, w: _MemoryWatch) -> None:
+        with self._mu:
+            if w in self._watches:
+                self._watches.remove(w)
 
     def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
         with self._mu:
@@ -179,18 +337,64 @@ class MemoryKV(KV):
                 actual = self._d.get(key)
                 if actual != expected:
                     raise _guard_mismatch(key, expected, actual)
+            events: list[WatchEvent] = []
+
+            def emit(op: str, key: str, value: str | None) -> None:
+                self._rev += 1
+                events.append(WatchEvent(self._rev, op, key, value))
+
             for op in ops:
                 if op[0] == "put":
                     self._d[op[1]] = op[2]
+                    emit("put", op[1], op[2])
                 elif op[0] == "delete":
-                    self._d.pop(op[1], None)
+                    if self._d.pop(op[1], None) is not None:
+                        emit("delete", op[1], None)
                 else:
-                    for k in [k for k in self._d if k.startswith(op[1])]:
+                    for k in [k for k in sorted(self._d)
+                              if k.startswith(op[1])]:
                         del self._d[k]
+                        emit("delete", k, None)
+            for ev in events:
+                if len(self._log) >= self._log_retain:
+                    self._trimmed_below = self._log.popleft().rev
+                self._log.append(ev)
+            for w in self._watches:
+                w._offer(events)
+
+
+class _SqliteWatch(Watch):
+    """Tail of the ``kv_log`` changelog table by indexed rev. Works across
+    PROCESSES: any SqliteKV instance over the same file sees rows the
+    moment the writer's transaction commits (this is what makes two real
+    daemons over shared sqlite — the HA verification setup — watchable).
+    Poll is a bounded-cadence scan; staleness is one poll interval."""
+
+    SCAN_SLEEP_S = 0.02
+
+    def __init__(self, kv: "SqliteKV", prefix: str, start_rev: int) -> None:
+        self._kv = kv
+        self.prefix = prefix
+        self._last_rev = start_rev
+
+    def poll(self, timeout_s: float = 0.0) -> list[WatchEvent]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            events, scanned = self._kv._read_log_since(
+                self._last_rev, self.prefix)
+            # advance past non-matching rows too, or every poll re-scans them
+            self._last_rev = max(self._last_rev, scanned)
+            if events:
+                return events
+            if time.monotonic() >= deadline:
+                return []
+            time.sleep(min(self.SCAN_SLEEP_S,
+                           max(deadline - time.monotonic(), 0.001)))
 
 
 class SqliteKV(KV):
-    """Durable store on sqlite (WAL). One table, synchronous writes.
+    """Durable store on sqlite (WAL). One data table, one changelog table,
+    synchronous writes.
 
     Unlike the reference — which flushes scheduler/version state only on
     graceful Stop (SURVEY.md §3.1) — every ``put`` here commits, so a hard
@@ -198,31 +402,53 @@ class SqliteKV(KV):
     process holding the database (backup tooling, a second daemon by
     mistake) makes ops block up to ``busy_timeout_s`` and then fail,
     instead of raising ``database is locked`` instantly or hanging.
+
+    Every mutation routes through :meth:`_apply`, which appends one
+    ``kv_log`` row per changed key INSIDE the same transaction as the data
+    write: a committed mutation and its watch event are indivisible (a
+    crash can never persist one without the other), and the AUTOINCREMENT
+    rev is monotonic across every process sharing the file. The log is
+    trimmed to ``log_retain`` rows (watermark in ``kv_meta``); a watcher
+    behind the watermark gets a typed WatchLost.
     """
 
     BUSY_TIMEOUT_S = 5.0
+    TRIM_EVERY = 64
 
-    def __init__(self, path: str, busy_timeout_s: float = BUSY_TIMEOUT_S) -> None:
+    def __init__(self, path: str, busy_timeout_s: float = BUSY_TIMEOUT_S,
+                 log_retain: int = WATCH_LOG_RETAIN,
+                 trim_every: int = TRIM_EVERY) -> None:
         self._conn = sqlite3.connect(
             path, timeout=busy_timeout_s, check_same_thread=False
         )
         self._mu = threading.Lock()
+        self._log_retain = log_retain
+        self._trim_every = max(1, trim_every)
+        self._applies_since_trim = 0
         with self._mu:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute(f"PRAGMA busy_timeout={int(busy_timeout_s * 1000)}")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT NOT NULL)"
             )
+            # AUTOINCREMENT (not bare rowid): revs must never be reused
+            # after a trim, or a watcher could silently resume across a gap
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv_log ("
+                "rev INTEGER PRIMARY KEY AUTOINCREMENT, "
+                "op TEXT NOT NULL, k TEXT NOT NULL, v TEXT)"
+            )
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv_meta (k TEXT PRIMARY KEY, "
+                "v TEXT NOT NULL)"
+            )
+            self._conn.execute(
+                "INSERT OR IGNORE INTO kv_meta(k, v) VALUES('trim_rev', '0')"
+            )
             self._conn.commit()
 
     def put(self, key: str, value: str) -> None:
-        with self._mu:
-            self._conn.execute(
-                "INSERT INTO kv(k, v) VALUES(?, ?) "
-                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
-                (key, value),
-            )
-            self._conn.commit()
+        self._apply([("put", key, value)])
 
     def get(self, key: str) -> str:
         with self._mu:
@@ -232,9 +458,7 @@ class SqliteKV(KV):
         return row[0]
 
     def delete(self, key: str) -> None:
-        with self._mu:
-            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
-            self._conn.commit()
+        self._apply([("delete", key)])
 
     @staticmethod
     def _prefix_where(prefix: str) -> tuple[str, tuple]:
@@ -263,35 +487,95 @@ class SqliteKV(KV):
         return dict(rows)
 
     def delete_prefix(self, prefix: str) -> None:
-        """One bounded DELETE in one transaction — a purge of an N-key
-        family is a single statement, not N round trips, and a crash
-        mid-purge can never leave half a family behind."""
+        """One transaction: a single bounded DELETE statement for the data
+        rows (a purge of an N-key family is not N round trips) plus the
+        per-key changelog expansion, so a crash mid-purge can never leave
+        half a family behind — or a family gone but unobservable."""
+        self._apply([("delete_prefix", prefix)])
+
+    def current_rev(self) -> int:
+        with self._mu:
+            return self._current_rev_locked()
+
+    def _current_rev_locked(self) -> int:
+        # sqlite_sequence survives log trims; MAX(rev) alone would regress
+        # after a full trim of a quiet store
+        row = self._conn.execute(
+            "SELECT seq FROM sqlite_sequence WHERE name = 'kv_log'"
+        ).fetchone()
+        return int(row[0]) if row else 0
+
+    def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
         where, params = self._prefix_where(prefix)
         with self._mu:
             try:
-                self._conn.execute(f"DELETE FROM kv WHERE {where}", params)
+                # explicit txn: the snapshot and its rev are one consistent
+                # read even with a foreign process writing concurrently
+                self._conn.execute("BEGIN")
+                rows = self._conn.execute(
+                    f"SELECT k, v FROM kv WHERE {where} ORDER BY k", params,
+                ).fetchall()
+                rev = self._current_rev_locked()
                 self._conn.commit()
             except BaseException:
                 self._conn.rollback()
                 raise
+        return dict(rows), rev
 
-    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
-        """All ops in ONE sqlite transaction: a mid-batch failure (or a
-        crash before the commit) rolls everything back. Guards SELECT and
-        compare inside that transaction — BEGIN IMMEDIATE takes the write
-        lock up front, so even a foreign process (second daemon, backup
-        tooling) cannot change a guarded key between the compare and the
-        commit."""
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        return _SqliteWatch(self, prefix, start_rev)
+
+    def _read_log_since(self, last_rev: int,
+                        prefix: str) -> tuple[list[WatchEvent], int]:
+        """(matching events with rev > last_rev, highest rev scanned).
+        Raises WatchLost when the trim watermark passed last_rev — the
+        changelog no longer proves there is no gap. Watermark and rows are
+        read in ONE explicit transaction (one WAL snapshot): two
+        autocommit statements would let a FOREIGN process's trim land
+        between them, passing the staleness check against the old
+        watermark while the row scan already reflects the post-trim log —
+        a silent, permanently undetected gap."""
         with self._mu:
             try:
-                if guards:
-                    self._conn.execute("BEGIN IMMEDIATE")
-                    for _, key, expected in guards:
-                        row = self._conn.execute(
-                            "SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
-                        actual = None if row is None else row[0]
-                        if actual != expected:
-                            raise _guard_mismatch(key, expected, actual)
+                self._conn.execute("BEGIN")
+                trim_rev = int(self._conn.execute(
+                    "SELECT v FROM kv_meta WHERE k = 'trim_rev'"
+                ).fetchone()[0])
+                rows = self._conn.execute(
+                    "SELECT rev, op, k, v FROM kv_log WHERE rev > ? "
+                    "ORDER BY rev LIMIT 1000", (last_rev,),
+                ).fetchall()
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+        if last_rev < trim_rev:
+            raise errors.WatchLost(
+                f"changelog compacted to rev {trim_rev}, watcher at "
+                f"{last_rev}")
+        events = [WatchEvent(int(r), op, k, v) for r, op, k, v in rows
+                  if k.startswith(prefix)]
+        scanned = int(rows[-1][0]) if rows else last_rev
+        return events, scanned
+
+    def _apply(self, ops: list[tuple], guards: list[tuple] | None = None) -> None:
+        """All ops — data rows AND their changelog rows — in ONE sqlite
+        transaction: a mid-batch failure (or a crash before the commit)
+        rolls everything back, so a mutation and its watch event are
+        indivisible. Guards SELECT and compare inside that transaction —
+        BEGIN IMMEDIATE takes the write lock up front, so even a foreign
+        process (second daemon, backup tooling) cannot change a guarded
+        key between the compare and the commit."""
+        with self._mu:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                for _, key, expected in guards or []:
+                    row = self._conn.execute(
+                        "SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+                    actual = None if row is None else row[0]
+                    if actual != expected:
+                        raise _guard_mismatch(key, expected, actual)
+                log_rows: list[tuple[str, str, str | None]] = []
                 for op in ops:
                     if op[0] == "put":
                         self._conn.execute(
@@ -299,17 +583,43 @@ class SqliteKV(KV):
                             "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
                             (op[1], op[2]),
                         )
+                        log_rows.append(("put", op[1], op[2]))
                     elif op[0] == "delete":
-                        self._conn.execute(
+                        cur = self._conn.execute(
                             "DELETE FROM kv WHERE k = ?", (op[1],))
+                        if cur.rowcount > 0:
+                            log_rows.append(("delete", op[1], None))
                     else:
                         where, params = self._prefix_where(op[1])
+                        doomed = self._conn.execute(
+                            f"SELECT k FROM kv WHERE {where} ORDER BY k",
+                            params).fetchall()
                         self._conn.execute(
                             f"DELETE FROM kv WHERE {where}", params)
+                        log_rows.extend(("delete", k, None) for (k,) in doomed)
+                self._conn.executemany(
+                    "INSERT INTO kv_log(op, k, v) VALUES(?, ?, ?)", log_rows)
+                self._applies_since_trim += 1
+                if self._applies_since_trim >= self._trim_every:
+                    self._applies_since_trim = 0
+                    self._trim_log_locked()
                 self._conn.commit()
             except BaseException:
                 self._conn.rollback()
                 raise
+
+    def _trim_log_locked(self) -> None:
+        """Bound the changelog (inside the caller's transaction): drop rows
+        below ``max_rev - log_retain`` and advance the watermark watchers
+        compare against."""
+        max_rev = self._current_rev_locked()
+        floor = max_rev - self._log_retain
+        if floor <= 0:
+            return
+        self._conn.execute("DELETE FROM kv_log WHERE rev <= ?", (floor,))
+        self._conn.execute(
+            "UPDATE kv_meta SET v = ? WHERE k = 'trim_rev' "
+            "AND CAST(v AS INTEGER) < ?", (str(floor), floor))
 
     def close(self) -> None:
         with self._mu:
@@ -404,6 +714,31 @@ class EtcdKV(KV):
                for kv in resp.get("kvs", [])}
         return dict(sorted(out.items()))
 
+    def current_rev(self) -> int:
+        resp = self._post("/v3/kv/range", {"key": _b64("\0"), "limit": 1},
+                          idempotent=True)
+        return int(resp.get("header", {}).get("revision", 0))
+
+    def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
+        """One range call: the response header's revision IS the snapshot's
+        revision (etcd's own list-then-watch handshake)."""
+        resp = self._post(
+            "/v3/kv/range",
+            {"key": _b64(prefix), "range_end": _b64(_prefix_end(prefix))},
+            idempotent=True,
+        )
+        out = {_unb64_key(kv["key"]): _unb64(kv["value"])
+               for kv in resp.get("kvs", [])}
+        return dict(sorted(out.items())), int(
+            resp.get("header", {}).get("revision", 0))
+
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        """Native ``/v3/watch`` stream on the gateway: the server pushes
+        events (deletes already expanded per key by etcd itself); a
+        compacted start revision comes back as a cancel response carrying
+        ``compact_revision``, surfaced as the typed WatchLost."""
+        return _EtcdWatch(self, prefix, start_rev)
+
     def delete_prefix(self, prefix: str) -> None:
         self._post(
             "/v3/kv/deleterange",
@@ -454,6 +789,135 @@ class EtcdKV(KV):
         self._session.close()
 
 
+class _EtcdWatch(Watch):
+    """One ``/v3/watch`` stream. A dedicated reader thread blocks on the
+    chunked HTTP response and feeds a queue; poll drains it — the informer
+    loop never blocks on a socket it cannot time-bound. The stream dying
+    (connection reset, gateway restart) is a StoreUnavailable at the next
+    poll; a cancel/compaction response is a WatchLost. Either way the
+    consumer relists."""
+
+    def __init__(self, kv: "EtcdKV", prefix: str, start_rev: int) -> None:
+        import json as _json
+
+        self._json = _json
+        self._kv = kv
+        self.prefix = prefix
+        self._cv = threading.Condition()
+        self._q: collections.deque[WatchEvent] = collections.deque()
+        self._error: Exception | None = None
+        self._closed = False
+        body = {"create_request": {
+            "key": _b64(prefix),
+            "range_end": _b64(_prefix_end(prefix)),
+            # etcd's start_revision is INCLUSIVE; our contract is
+            # "events with rev > start_rev"
+            "start_revision": str(start_rev + 1),
+        }}
+        try:
+            self._resp = kv._session.post(
+                kv._addr + "/v3/watch", json=body, stream=True,
+                timeout=(kv.DIAL_TIMEOUT_S, None))
+            self._resp.raise_for_status()
+        except (kv._requests.ConnectionError, kv._requests.Timeout,
+                kv._requests.HTTPError) as e:
+            raise errors.StoreUnavailable(
+                f"etcd watch {kv._addr}: {type(e).__name__}: {e}") from e
+        self._thread = threading.Thread(
+            target=self._read_loop, name="etcd-watch", daemon=True)
+        self._thread.start()
+
+    def _read_loop(self) -> None:
+        try:
+            self._read_stream()
+        finally:
+            # the reader OWNS the response: closing it from another thread
+            # would deadlock on the buffered-reader lock this thread holds
+            # while blocked in iter_lines (close() unblocks us by shutting
+            # the socket down instead)
+            try:
+                self._resp.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _read_stream(self) -> None:
+        try:
+            for line in self._resp.iter_lines():
+                if not line:
+                    continue
+                result = self._json.loads(line).get("result", {})
+                if result.get("compact_revision") or result.get("canceled"):
+                    self._fail(errors.WatchLost(
+                        f"watch canceled (compacted at "
+                        f"{result.get('compact_revision')})"))
+                    return
+                if result.get("created"):
+                    continue
+                header_rev = int(result.get("header", {}).get("revision", 0))
+                events = []
+                for ev in result.get("events", []):
+                    kv_ = ev.get("kv", {})
+                    # proto3 JSON omits default enum values: no "type" IS PUT
+                    is_put = ev.get("type", "PUT") == "PUT"
+                    events.append(WatchEvent(
+                        int(kv_.get("mod_revision", header_rev)),
+                        "put" if is_put else "delete",
+                        _unb64_key(kv_["key"]),
+                        _unb64(kv_.get("value", "")) if is_put else None))
+                if events:
+                    with self._cv:
+                        self._q.extend(events)
+                        self._cv.notify_all()
+        except Exception as e:  # noqa: BLE001 — stream death
+            if not self._closed:
+                self._fail(errors.StoreUnavailable(
+                    f"etcd watch stream died: {type(e).__name__}: {e}"))
+
+    def _fail(self, err: Exception) -> None:
+        with self._cv:
+            self._error = err
+            self._cv.notify_all()
+
+    def poll(self, timeout_s: float = 0.0) -> list[WatchEvent]:
+        with self._cv:
+            if not self._q and self._error is None and not self._closed \
+                    and timeout_s > 0:
+                self._cv.wait(timeout_s)
+            if self._q:
+                out = list(self._q)
+                self._q.clear()
+                return out
+            if self._error is not None and not self._closed:
+                raise self._error
+            return []
+
+    def close(self) -> None:
+        self._closed = True
+        # shut the SOCKET down rather than closing the response: a close
+        # here would contend for the buffered-reader lock the reader
+        # thread holds while blocked mid-recv (observed deadlock); a
+        # shutdown makes that recv return EOF, the stream iterator end,
+        # and the reader close the response itself
+        import socket as socket_mod
+
+        raw = getattr(self._resp, "raw", None)
+        conn = (getattr(raw, "_connection", None)
+                or getattr(raw, "connection", None))
+        sock = getattr(conn, "sock", None)
+        try:
+            if sock is not None:
+                sock.shutdown(socket_mod.SHUT_RDWR)
+            else:  # pragma: no cover — urllib3 layout drift fallback
+                self._resp.close()
+        except OSError:
+            pass
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+
 class CountingKV(KV):
     """Instrumentation wrapper: counts store round trips per KV method.
 
@@ -497,6 +961,19 @@ class CountingKV(KV):
     def range_prefix(self, prefix: str) -> dict[str, str]:
         self._count("range_prefix")
         return self.inner.range_prefix(prefix)
+
+    def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
+        self._count("range_prefix")
+        return self.inner.range_prefix_with_rev(prefix)
+
+    def current_rev(self) -> int:
+        return self.inner.current_rev()
+
+    def watch(self, prefix: str, start_rev: int = 0) -> Watch:
+        # counted once per stream OPEN — the whole point of watch is that
+        # the events themselves are not per-request round trips
+        self._count("watch")
+        return self.inner.watch(prefix, start_rev)
 
     def delete_prefix(self, prefix: str) -> None:
         self._count("delete_prefix")

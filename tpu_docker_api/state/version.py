@@ -27,11 +27,41 @@ class VersionMap:
         #: not by this replica's lifetime). A leader (callable False) and
         #: single-process deployments (the bool default) keep the pure
         #: in-memory map: every write is local, zero extra reads.
+        #: With an informer attached (attach_informer), the standby read
+        #: path upgrades again: watch-fed shadow, zero reads AND zero
+        #: JSON re-parses per request.
         self._read_through = (read_through if callable(read_through)
                               else (lambda: read_through))
         self._mu = threading.Lock()
         raw = kv.get_or(store_key)
         self._m: dict[str, int] = json.loads(raw) if raw else {}
+        self._informer = None
+        #: standby-read shadow, replaced wholesale on every watch event for
+        #: our key. READ-only: writers (next_version/set/rollback) never
+        #: consult it, so a transiently-lagging event stream can at worst
+        #: serve a bounded-stale read — it can never roll the authoritative
+        #: map backwards and re-issue an old version number.
+        self._shadow: dict[str, int] = {}
+
+    def attach_informer(self, informer) -> None:
+        """Standby mode: replace per-read store re-seeding with watch-fed
+        updates (state/informer.py). Reads served from the shadow while the
+        informer is synced; any degradation falls back to the per-read
+        read-through path, so staleness is NEVER worse than before."""
+        with self._mu:
+            self._shadow = dict(self._m)
+        self._informer = informer
+        informer.register(self._key, self._on_informer_event)
+
+    def _on_informer_event(self, ev) -> None:
+        if ev.key != self._key:
+            return  # a longer key sharing our key as its prefix
+        m = json.loads(ev.value) if (ev.op == "put" and ev.value) else {}
+        with self._mu:
+            self._shadow = m
+
+    def _shadow_live(self) -> bool:
+        return self._informer is not None and self._informer.synced
 
     def _persist_locked(self) -> None:
         self._kv.put(self._key, json.dumps(self._m, sort_keys=True))
@@ -46,6 +76,9 @@ class VersionMap:
 
     def get(self, name: str) -> int | None:
         if self._read_through():
+            if self._shadow_live():
+                with self._mu:
+                    return self._shadow.get(name)
             self.reload_from_store()
         with self._mu:
             return self._m.get(name)
@@ -88,6 +121,9 @@ class VersionMap:
 
     def snapshot(self) -> dict[str, int]:
         if self._read_through():
+            if self._shadow_live():
+                with self._mu:
+                    return dict(self._shadow)
             self.reload_from_store()
         with self._mu:
             return dict(self._m)

@@ -67,6 +67,16 @@ class MetricsRegistry:
             self._help.setdefault(name, ("gauge", help))
             self._gauge_fns[name] = fn
 
+    def counter_value(self, name: str, labels: dict | None = None) -> float:
+        """Read a counter's current value (0.0 if never incremented).
+        Lets a subsystem keep the registry as its ONE set of books — the
+        informer's status view (healthz, GET /api/v1/leader) reads back
+        exactly the counters it exports at /metrics, so the two surfaces
+        can never disagree."""
+        with self._lock:
+            series = self._counters.get(name, {})
+            return series.get(tuple(sorted((labels or {}).items())), 0.0)
+
     def counter_fn(self, name: str, fn: Callable[[], float],
                    help: str = "") -> None:
         """Register a pull-time COUNTER (a monotonically increasing
