@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout openapi sample-interface run clean
 
 all: native openapi
 
@@ -56,6 +56,11 @@ bench-reads:                 ## HA reads family: GET throughput per role + store
 	$(PY) bench.py --control-plane --cp-family reads --cp-iters 400 > bench-reads.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-reads.json.tmp
 	mv bench-reads.json.tmp bench-reads.json
+
+bench-fanout:                ## runtime fan-out family: gang lifecycle walls vs member count + ordering/round-trip gates
+	$(PY) bench.py --control-plane --cp-family fanout --fanout-iters 2 > bench-fanout.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-fanout.json.tmp
+	mv bench-fanout.json.tmp bench-fanout.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

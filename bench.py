@@ -676,6 +676,199 @@ def measure_control_plane_reads(n_reads: int = 2000, readers: int = 4,
     return quants
 
 
+def measure_control_plane_fanout(latency_ms: float = 50.0,
+                                 iters: int = 3,
+                                 fanout_workers: int = 8) -> dict:
+    """Control-plane fan-out family (``--control-plane --cp-family
+    fanout``): gang create→start→stop→delete at several member counts
+    against per-host ``FaultyRuntime`` engines with an injected per-call
+    latency — the multi-host-pod shape where every engine round trip
+    costs real wall time. All engines journal into ONE shared call log,
+    so ordering is auditable *across* hosts.
+
+    Self-gating on the tentpole invariants:
+
+    - **wall-clock is O(slowest host), not O(members)**: 8-member gang
+      create must stay within 2.5× the 2-member wall (serial would be
+      ~4×, since a create is O(members) engine calls);
+    - **ordering audit**: in the shared journal, the coordinator's start
+      is strictly before any worker's start and the coordinator's stop is
+      strictly after every worker's stop — concurrency must never break
+      the gang barriers;
+    - **store round trips unchanged**: gang create still audits at ≤ 3
+      atomic ``apply`` batches and O(1) in member count (the PR 6
+      CountingKV gate) — concurrency must not add store round trips.
+
+    A violated gate flips ``gates.ok``; main() turns that into a nonzero
+    exit."""
+    import threading
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.runtime.faulty import FaultPlan, FaultRule, FaultyRuntime
+    from tpu_docker_api.state.kv import CountingKV, MemoryKV
+
+    if iters < 1:
+        raise ValueError(f"fanout family needs iters >= 1, got {iters}")
+    # fixed, not a parameter: the gate key (wall_ratio_8v2), the schema
+    # checker and main()'s headline all name the 2- and 8-member points
+    members = (2, 4, 8)
+    n_hosts = max(members)
+    latency_s = latency_ms / 1e3
+    journal: list = []
+    journal_lock = threading.Lock()
+
+    def slow_engine() -> FaultyRuntime:
+        """One host's engine: every lifecycle op pays the injected
+        latency, forever; all hosts share one journal."""
+        rules = [FaultRule(op=op, mode="latency", latency_s=latency_s,
+                           times=-1)
+                 for op in ("container_create", "container_start",
+                            "container_stop", "container_remove")]
+        return FaultyRuntime(FakeRuntime(), FaultPlan(rules=rules),
+                             journal=journal, journal_lock=journal_lock)
+
+    counting = CountingKV(MemoryKV())
+    pod_runtimes = {f"h{i}": slow_engine() for i in range(1, n_hosts)}
+    prog = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=46000, end_port=47999, health_watch_interval=0,
+        host_probe_interval_s=0, job_supervise_interval=0,
+        reconcile_interval=0, fanout_workers=fanout_workers,
+        pod_hosts=[
+            {"host_id": "h0", "address": "10.0.0.1",
+             "grid_coord": [0, 0, 0], "local": True}
+        ] + [
+            {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+             "grid_coord": [i, 0, 0], "runtime_backend": "fake"}
+            for i in range(1, n_hosts)
+        ],
+    ), host="127.0.0.1", kv=counting, runtime=slow_engine(),
+        pod_runtimes=pod_runtimes)
+    prog.init()
+    prog.start()
+    chips_per_host = prog.pod.chips_per_host
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return (time.perf_counter() - t0) * 1e3
+
+    def audit_ordering(vname: str, m: int) -> list[str]:
+        """Gang barriers in the SHARED journal: coordinator start first,
+        coordinator stop last. Returns the violations found (empty=ok)."""
+        coord = f"{vname}-p0"
+        workers = {f"{vname}-p{i}" for i in range(1, m)}
+        with journal_lock:
+            snap = list(journal)
+        starts = [(i, t) for i, (op, t, _) in enumerate(snap)
+                  if op == "container_start" and (t == coord or t in workers)]
+        stops = [(i, t) for i, (op, t, _) in enumerate(snap)
+                 if op == "container_stop" and (t == coord or t in workers)]
+        problems = []
+        coord_starts = [i for i, t in starts if t == coord]
+        worker_starts = [i for i, t in starts if t != coord]
+        if not coord_starts or len(worker_starts) != m - 1:
+            problems.append(f"{vname}: start log incomplete "
+                            f"({len(coord_starts)} coord, "
+                            f"{len(worker_starts)} workers)")
+        elif coord_starts[0] >= min(worker_starts):
+            problems.append(f"{vname}: a worker started before the "
+                            f"coordinator")
+        coord_stops = [i for i, t in stops if t == coord]
+        worker_stops = [i for i, t in stops if t != coord]
+        if not coord_stops or len(worker_stops) != m - 1:
+            problems.append(f"{vname}: stop log incomplete")
+        elif coord_stops[-1] <= max(worker_stops):
+            problems.append(f"{vname}: the coordinator stopped before "
+                            f"some worker")
+        return problems
+
+    per_members: dict[str, dict] = {}
+    ordering_problems: list[str] = []
+    applies: dict[int, int] = {}
+    try:
+        for m in members:
+            walls: dict[str, list[float]] = {
+                "create": [], "stop": [], "delete": []}
+            for k in range(iters):
+                name = f"fan{m}i{k}"
+                walls["create"].append(timed(lambda: call(
+                    "POST", "/api/v1/jobs",
+                    {"imageName": "jax", "jobName": name,
+                     "chipCount": chips_per_host * m})))
+                info = call("GET", f"/api/v1/jobs/{name}")
+                if info["data"].get("phase") != "running":
+                    raise RuntimeError(f"gang {name} not running: "
+                                       f"{info['data']}")
+                walls["stop"].append(timed(lambda: call(
+                    "POST", f"/api/v1/jobs/{name}/stop")))
+                walls["delete"].append(timed(lambda: call(
+                    "DELETE", f"/api/v1/jobs/{name}", {
+                        "force": True, "delStateAndVersionRecord": True})))
+                ordering_problems += audit_ordering(f"{name}-0", m)
+            # store round-trip audit: one quiesced create per member count
+            before = counting.snapshot()
+            call("POST", "/api/v1/jobs",
+                 {"imageName": "jax", "jobName": f"audit{m}",
+                  "chipCount": chips_per_host * m})
+            applies[m] = CountingKV.delta(
+                before, counting.snapshot()).get("apply", 0)
+            call("DELETE", f"/api/v1/jobs/audit{m}", {
+                "force": True, "delStateAndVersionRecord": True})
+            per_members[str(m)] = {
+                f"{flow}_ms_min": round(min(ms), 3)
+                for flow, ms in walls.items()
+            } | {
+                f"{flow}_ms_max": round(max(ms), 3)
+                for flow, ms in walls.items()
+            }
+    finally:
+        prog.stop()
+
+    lo, hi = str(min(members)), str(max(members))
+    ratio = (per_members[hi]["create_ms_min"]
+             / max(per_members[lo]["create_ms_min"], 1e-9))
+    ratio_budget = 2.5
+    gang_applies = applies[max(members)]
+    # >= 1 keeps the gate honest: a write path that stopped routing
+    # through the counted apply must FAIL, not pass vacuously
+    applies_o1 = (gang_applies >= 1
+                  and all(v == gang_applies for v in applies.values()))
+    return {
+        "family": "fanout",
+        "iters": {"iters": iters, "members": list(members),
+                  "latency_ms": latency_ms,
+                  "fanout_workers": fanout_workers},
+        "members": per_members,
+        "gang_create_applies": {str(m): v for m, v in applies.items()},
+        "ordering_problems": ordering_problems,
+        "gates": {
+            "wall_ratio_8v2": round(ratio, 3),
+            "wall_ratio_budget": ratio_budget,
+            "ordering_ok": not ordering_problems,
+            "gang_create_applies": gang_applies,
+            "gang_create_applies_max": 3,
+            "gang_apply_o1_in_members": applies_o1,
+            "ok": bool(ratio <= ratio_budget and not ordering_problems
+                       and 1 <= gang_applies <= 3 and applies_o1),
+        },
+    }
+
+
 def main() -> int | None:
     """Returns a nonzero exit code on backend-init failure (consumed by
     the ``sys.exit(main())`` entry); None = success."""
@@ -691,7 +884,8 @@ def main() -> int | None:
     parser.add_argument("--cp-runtime", default="fake",
                         choices=["fake", "docker"])
     parser.add_argument("--cp-family", default="create",
-                        choices=["create", "churn", "failover", "reads"],
+                        choices=["create", "churn", "failover", "reads",
+                                 "fanout"],
                         help="create = create→ready latency; churn = "
                              "create→ready→replace→delete for containers "
                              "AND gangs with store round-trips per flow; "
@@ -700,7 +894,10 @@ def main() -> int | None:
                              "standby; reads = hammer the GET surface on "
                              "leader + informer standby + read-through "
                              "standby, with a store-reads-per-request "
-                             "audit")
+                             "audit; fanout = gang lifecycle at member "
+                             "counts {2,4,8} against slow engines, "
+                             "gating wall-clock O(slowest host), gang "
+                             "ordering and store round trips")
     parser.add_argument("--cp-iters", type=int, default=100,
                         help="iterations (create family) / container "
                              "cycles (churn family) / total GETs per role "
@@ -713,6 +910,12 @@ def main() -> int | None:
                              "cp-iters // 10 (min 2)")
     parser.add_argument("--failovers", type=int, default=5,
                         help="leader kills for the failover family")
+    parser.add_argument("--fanout-iters", type=int, default=3,
+                        help="gang lifecycle cycles per member count for "
+                             "the fanout family (min wall is gated)")
+    parser.add_argument("--fanout-latency-ms", type=float, default=50.0,
+                        help="injected per-engine-call latency for the "
+                             "fanout family")
     parser.add_argument("--failover-ttl", type=float, default=1.0,
                         help="leader lease TTL seconds for the failover "
                              "family (the recovery ceiling under test)")
@@ -747,6 +950,10 @@ def main() -> int | None:
             elif args.cp_family == "reads":
                 cp = measure_control_plane_reads(
                     args.cp_iters, readers=args.read_workers)
+            elif args.cp_family == "fanout":
+                cp = measure_control_plane_fanout(
+                    iters=args.fanout_iters,
+                    latency_ms=args.fanout_latency_ms)
             else:
                 cp = measure_control_plane(args.cp_iters, args.cp_runtime)
         except Exception as e:
@@ -766,6 +973,9 @@ def main() -> int | None:
             headline = ("control_plane_reads_standby_informer_rps",
                         cp["roles"]["standby_informer"]["rps"])
             unit = "reads/s"
+        elif args.cp_family == "fanout":
+            headline = ("control_plane_fanout_gang8_create_ms",
+                        cp["members"]["8"]["create_ms_min"])
         else:
             headline = ("container_create_ready_ms_p50",
                         cp["create_ready_ms_p50"])

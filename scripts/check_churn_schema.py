@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Validate control-plane BENCH artifacts (``make bench-churn`` /
-``make bench-failover`` / ``make bench-reads``).
+``make bench-failover`` / ``make bench-reads`` / ``make bench-fanout``).
 
 Reads JSON lines from stdin (or a file argument) and asserts the schema the
 driver-side BENCH pipeline consumes: every line carries the
@@ -10,8 +10,10 @@ quantiles, per-flow store round trips and a passing regression gate for
 ``churn``; recovery quantiles, per-failover fencing proof and a passing
 regression gate for ``failover``; per-role throughput/latency and the
 store-reads-per-request audit (informer ~0, read-through ≥ 1) for
-``reads``. Exit 0 = consumable artifact, nonzero = a structural problem
-printed one-per-line (the same loud-failure contract as bench_boot).
+``reads``; per-member-count lifecycle walls, the wall-ratio/ordering/
+round-trip gates for ``fanout``. Exit 0 = consumable artifact, nonzero =
+a structural problem printed one-per-line (the same loud-failure
+contract as bench_boot).
 """
 
 from __future__ import annotations
@@ -106,6 +108,65 @@ def validate_reads(extra: dict) -> list[str]:
     return problems
 
 
+FANOUT_FLOWS = ("create", "stop", "delete")
+
+
+def validate_fanout(extra: dict) -> list[str]:
+    """The fanout-family headline payload: per-member-count lifecycle
+    walls, a passing wall-ratio gate (8-member ≤ budget × 2-member), a
+    clean cross-host ordering audit, and the unchanged PR 6 store
+    round-trip gate. The ratio and ordering gates are re-checked here
+    (not just gates.ok): a zeroed wall or a skipped audit must fail
+    loudly, never pass as a vacuous bool."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    if not (isinstance(it.get("iters"), int) and it["iters"] >= 1):
+        problems.append(f"fanout: iters.iters must be an int >= 1, "
+                        f"got {it.get('iters')!r}")
+    member_counts = it.get("members")
+    if (not isinstance(member_counts, list) or len(member_counts) < 2
+            or not all(isinstance(m, int) and m >= 2
+                       for m in member_counts)):
+        problems.append(f"fanout: iters.members must list >= 2 member "
+                        f"counts, got {member_counts!r}")
+        member_counts = []
+    stats = extra.get("members") or {}
+    for m in member_counts:
+        entry = stats.get(str(m)) or {}
+        for flow in FANOUT_FLOWS:
+            for q in ("min", "max"):
+                v = entry.get(f"{flow}_ms_{q}")
+                if not _num(v) or v <= 0:
+                    problems.append(f"fanout: members.{m}.{flow}_ms_{q} "
+                                    f"must be a positive number, got {v!r}")
+    gates = extra.get("gates") or {}
+    for key in ("wall_ratio_8v2", "wall_ratio_budget", "ordering_ok",
+                "gang_create_applies", "gang_create_applies_max",
+                "gang_apply_o1_in_members", "ok"):
+        if key not in gates:
+            problems.append(f"fanout: gates.{key} missing")
+    ratio = gates.get("wall_ratio_8v2")
+    budget = gates.get("wall_ratio_budget")
+    if not _num(ratio) or ratio <= 0:
+        problems.append(f"fanout: wall_ratio_8v2 must be a positive "
+                        f"number, got {ratio!r}")
+    elif _num(budget) and ratio > budget:
+        problems.append(f"fanout: 8-member create wall is {ratio}x the "
+                        f"2-member wall (> {budget}x budget) — the fan-out "
+                        f"is serializing")
+    if gates.get("ordering_ok") is not True:
+        problems.append(f"fanout: gang ordering audit failed: "
+                        f"{extra.get('ordering_problems')}")
+    applies = gates.get("gang_create_applies")
+    if not (isinstance(applies, int) and 1 <= applies <= 3):
+        problems.append(f"fanout: gang_create_applies must be 1..3, got "
+                        f"{applies!r} (concurrency must not add store "
+                        f"round trips)")
+    if gates.get("ok") is not True:
+        problems.append(f"fanout: regression gate failed: {gates}")
+    return problems
+
+
 def validate_lines(lines: list[dict]) -> list[str]:
     """Return every schema violation found (empty = consumable)."""
     problems: list[str] = []
@@ -123,11 +184,15 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "reads"]
     if reads:
         return problems + validate_reads(reads[0]["extra"])
+    fanout = [ln for ln in lines
+              if (ln.get("extra") or {}).get("family") == "fanout"]
+    if fanout:
+        return problems + validate_fanout(fanout[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn, failover or reads headline line "
-                           "(extra.family)"]
+        return problems + ["no churn, failover, reads or fanout headline "
+                           "line (extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

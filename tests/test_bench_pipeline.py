@@ -250,6 +250,68 @@ class TestReadsFamily:
             assert stats["rps"] > 0
 
 
+class TestFanoutFamily:
+    """The runtime fan-out family (``make bench-fanout``): gang lifecycle
+    at member counts {2,4,8} against latency-injected engines at tiny
+    scale — pinning both the artifact schema (scripts/check_churn_schema
+    .py) and the tentpole invariants: 8-member gang create wall-clock
+    stays within the 2.5× budget of the 2-member wall (serial would be
+    ~4×), the cross-host ordering audit holds (coordinator-start strictly
+    first, coordinator-stop strictly last), and gang create still costs
+    ≤ 3 store applies, O(1) in member count (no regression of the PR 6
+    churn gate under concurrency)."""
+
+    @pytest.fixture(scope="class")
+    def fanout(self):
+        return bench.measure_control_plane_fanout(iters=1, latency_ms=25.0)
+
+    def test_schema_checker_accepts_the_emitted_line(self, fanout):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_fanout_gang8_create_ms",
+                "value": fanout["members"]["8"]["create_ms_min"],
+                "unit": "ms", "vs_baseline": 1.0, "extra": fanout}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... and so must a wall ratio past the budget (a fan-out that
+        # quietly serialized) or a failed ordering audit
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["wall_ratio_8v2"] = 3.9
+        assert any("serializing" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ordering_ok"] = False
+        assert any("ordering" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        del bad["extra"]["members"]["4"]
+        assert any("members.4" in p for p in validate_lines([bad]))
+
+    def test_fanout_gates_hold(self, fanout):
+        gates = fanout["gates"]
+        assert gates["ok"] is True
+        # the tentpole: lifecycle wall-clock is O(slowest host), not
+        # O(members) — 4x the members must NOT cost 4x the wall
+        assert 0 < gates["wall_ratio_8v2"] <= gates["wall_ratio_budget"]
+        # concurrency never broke the gang barriers
+        assert gates["ordering_ok"] is True
+        assert fanout["ordering_problems"] == []
+        # ... and never added store round trips (the PR 6 invariant)
+        assert 1 <= gates["gang_create_applies"] <= 3
+        assert gates["gang_apply_o1_in_members"] is True
+        applies = fanout["gang_create_applies"]
+        assert applies["2"] == applies["4"] == applies["8"]
+        for m in ("2", "4", "8"):
+            stats = fanout["members"][m]
+            for flow in ("create", "stop", "delete"):
+                assert 0 < stats[f"{flow}_ms_min"] <= stats[f"{flow}_ms_max"]
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

@@ -34,6 +34,7 @@ from tpu_docker_api.schemas.container import (
 from tpu_docker_api.schemas.job import JobPatchChips, JobRun
 from tpu_docker_api.service.crashpoints import (
     CONTAINER_CRASH_POINTS,
+    FANOUT_CRASH_POINTS,
     JOB_CRASH_POINTS,
     KNOWN_CRASH_POINTS,
     LEADER_CRASH_POINTS,
@@ -116,9 +117,12 @@ def test_case_matrix_covers_every_crash_point():
     assert {p for _, p in TXN_CASES} == set(TXN_CRASH_POINTS)
     # the failover matrix kills the leader at every election-lifecycle point
     assert set(LEADER_POINTS) == set(LEADER_CRASH_POINTS)
+    # the fan-out matrix crashes two flows inside half-landed concurrent
+    # batches (create, quiesce-stop)
+    assert {p for _, p in FANOUT_CASES} == set(FANOUT_CRASH_POINTS)
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
-            | set(LEADER_CRASH_POINTS)
+            | set(LEADER_CRASH_POINTS) | set(FANOUT_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
 
@@ -322,6 +326,100 @@ def test_job_crash_without_reconcile_violates_invariants():
             prg.job_svc.patch_job_chips("train", JobPatchChips(chip_count=8))
     prg2 = boot_pod(kv, rt0, rt1)
     assert _job_oracle(prg2) != []
+
+
+#: fan-out flows × the mid-batch crash point (runtime/fanout.py): the
+#: daemon dies while a CONCURRENT engine batch is half-landed — at least
+#: one call settled, peers possibly in flight (awaited before the crash
+#: propagates, so the post-crash world is settled but arbitrary-subset)
+FANOUT_CASES = (
+    [("run", p) for p in FANOUT_CRASH_POINTS]
+    + [("rescale-quiesce", p) for p in FANOUT_CRASH_POINTS]
+)
+
+
+def boot_fanout_pod(kv, runtimes, workers=4) -> Program:
+    """A 4-host v5e pod with a CONCURRENT fan-out (workers=4), so the
+    armed crash really does fire while sibling calls are in flight."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099, fanout_workers=workers,
+        pod_hosts=[
+            {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+             "grid_coord": [i, 0, 0],
+             **({"local": True} if i == 0 else
+                {"runtime_backend": "fake"})}
+            for i in range(4)
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=runtimes["h0"],
+                  pod_runtimes={h: r for h, r in runtimes.items()
+                                if h != "h0"})
+    prg.init()
+    return prg
+
+
+@pytest.mark.parametrize("flow,point", FANOUT_CASES,
+                         ids=[f"{f}@{p}" for f, p in FANOUT_CASES])
+def test_fanout_mid_batch_crash_reconcile_converges(flow, point):
+    """Kill the daemon INSIDE a concurrent fan-out batch:
+
+    - ``run``: the gang-create batch is half-landed (claims committed,
+      some members created, JobState never persisted);
+    - ``rescale-quiesce`` (skip=1): the new version is fully created (not
+      started) and the crash lands mid worker-stop batch of the old
+      gang's quiesce — old gang half-stopped, still marked running.
+
+    A fresh control plane over the same engines must reconcile both to
+    one live version with zero leaks, fixpoint."""
+    kv = MemoryKV()
+    rts = {f"h{i}": FakeRuntime() for i in range(4)}
+    prg = boot_fanout_pod(kv, rts)
+    chips = prg.pod.chips_per_host
+
+    if flow == "rescale-quiesce":
+        # 2-member gang on h0+h1; the rescale to one host takes the fast
+        # path onto free capacity. Batches: #1 create-new (skip passes),
+        # #2 old-gang worker stops (CRASH mid-batch)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=chips * 2))
+        with armed(point, skip=1):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.patch_job_chips(
+                    "train", JobPatchChips(chip_count=chips))
+    else:
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.run_job(JobRun(image_name="jax",
+                                           job_name="train",
+                                           chip_count=chips * 4))
+
+    prg2 = boot_fanout_pod(kv, rts)
+    dry = prg2.reconciler.reconcile(dry_run=True)
+    assert dry["actions"], f"no drift reported at {flow}@{point}"
+    report = prg2.reconciler.reconcile()
+    assert report["actions"], f"nothing repaired at {flow}@{point}"
+
+    problems = _job_oracle(prg2)
+    assert problems == [], f"{flow}@{point}: {problems}"
+
+    latest = prg2.job_versions.get("train")
+    if flow == "run":
+        # the half-created gang was scrubbed: family gone, capacity free
+        assert latest is None
+        assert all(len(h.chips.free_chips) == chips
+                   for h in prg2.pod.hosts.values())
+        for rt in rts.values():
+            assert rt.container_list() == []
+    else:
+        st = prg2.store.get_job(f"train-{latest}")
+        assert st.phase == "running"
+        for host_id, cname, *_ in st.placements:
+            assert prg2.pod.hosts[host_id].runtime.container_inspect(
+                cname).running, f"{cname} dead after reconcile"
+
+    # a second sweep finds nothing: the repair is a fixpoint
+    assert prg2.reconciler.reconcile()["actions"] == []
 
 
 class TestJobCrashLoop:

@@ -436,6 +436,9 @@ class TestTransientRetry:
                 raise exc
             return real_open(rt, timeout)
 
+        # drain the keep-alive pool so the next request must CONNECT —
+        # these tests are about the reconnect policy, not reuse
+        rt._pool.clear()
         rt._open_connection = flaky
         rt.RETRY_BACKOFF_S = 0.001
         return counter
@@ -468,6 +471,64 @@ class TestInspectStatus:
         rt.container_start("t0")
         engine.containers["t0"]["State"]["Status"] = "running"
         assert rt.container_inspect("t0").status == "running"
+
+
+class TestConnectionPool:
+    """The keep-alive pool behind every request (runtime fan-out made the
+    per-request connect() the transport bottleneck): reuse across
+    requests, stale-socket detection before reuse, bounded idle
+    retention — while the retry contract stays GET-only (TestTransientRetry
+    above proves POSTs are still one-shot)."""
+
+    def test_requests_reuse_one_keep_alive_connection(self, rt):
+        # the constructor's ping opened (and pooled) the first connection
+        assert rt.pool_view()["created"] == 1
+        rt.container_list()
+        rt.container_list()
+        view = rt.pool_view()
+        assert view["created"] == 1, "a request dialed instead of reusing"
+        assert view["reused"] >= 2
+        assert view["idle"] == 1 and view["inUse"] == 0
+
+    def test_posts_ride_the_pool_too(self, rt, engine):
+        rt.container_create(make_spec())
+        rt.container_start("t0")
+        assert rt.pool_view()["created"] == 1
+
+    def test_stale_socket_detected_and_replaced(self, rt):
+        rt.container_list()
+        assert rt.pool_view()["idle"] == 1
+        # dockerd restart while the connection idles: the server half
+        # goes away — model it by shutting the socket down, which makes
+        # it readable (EOF), the pre-reuse staleness signal
+        idle_conn = rt._pool._idle[0]
+        idle_conn.sock.shutdown(socket.SHUT_RDWR)
+        # the next GET must detect the dead socket BEFORE reusing it and
+        # dial fresh — no error surfaces to the caller
+        assert rt.container_list() == []
+        view = rt.pool_view()
+        assert view["staleDropped"] == 1
+        assert view["created"] == 2
+
+    def test_closed_fd_counts_as_stale(self, rt):
+        rt.container_list()
+        rt._pool._idle[0].sock.close()
+        assert rt.container_list() == []
+        assert rt.pool_view()["staleDropped"] == 1
+
+    def test_idle_retention_is_bounded(self, rt):
+        conns = [rt._pool.acquire(rt._open_connection, 5.0)[0]
+                 for _ in range(7)]
+        for c in conns:
+            rt._pool.release(c, reusable=True)
+        view = rt.pool_view()
+        assert view["idle"] <= view["size"] == 4
+        assert view["inUse"] == 0
+
+    def test_close_drains_the_pool(self, rt):
+        rt.container_list()
+        rt.close()
+        assert rt.pool_view()["idle"] == 0
 
 
 DOCKER_SOCK = "/var/run/docker.sock"

@@ -1,0 +1,443 @@
+"""Runtime fan-out layer (runtime/fanout.py) + its concurrency contracts.
+
+Three tiers:
+
+- the :class:`Fanout` primitive itself — positional results, per-call
+  exception collection, serial (workers=1) byte-for-byte equivalence with
+  the old loops (stop at first failure, later calls never dispatched),
+  BaseException (the chaos kill) propagation;
+- the gang contracts under REAL concurrency (workers=4 over per-host
+  engines sharing one journal): coordinator-start strictly before any
+  worker-start, coordinator-stop strictly after all worker-stops,
+  partial-failure rollback removing every created member, thread-safe
+  call journaling in FaultyRuntime/FakeRuntime;
+- the transport under concurrency: the keep-alive connection pool
+  (reuse, stale-socket detection, GET-only reconnect lives in
+  test_docker_http.py) and BreakerRuntime's single-flight half-open
+  probe under a concurrent stampede.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.fanout import Fanout
+from tpu_docker_api.runtime.faulty import (
+    FaultPlan,
+    FaultRule,
+    FaultyRuntime,
+    fail_nth,
+)
+from tpu_docker_api.schemas.job import JobRun
+from tpu_docker_api.service.host_health import BreakerRuntime
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+
+class TestFanoutPrimitive:
+    def test_results_positional_and_ok(self):
+        f = Fanout(4)
+        res = f.run([(str(i), "op", lambda i=i: i * 10) for i in range(6)])
+        assert [r.value for r in res] == [0, 10, 20, 30, 40, 50]
+        assert all(r.ok for r in res)
+        f.close()
+
+    def test_exceptions_collected_not_raised(self):
+        f = Fanout(4)
+
+        def boom():
+            raise errors.ApiError("nope")
+
+        res = f.run([("a", "op", lambda: 1), ("b", "op", boom),
+                     ("c", "op", lambda: 3)])
+        assert res[0].value == 1 and res[2].value == 3
+        assert isinstance(res[1].error, errors.ApiError)
+        with pytest.raises(errors.ApiError):
+            res[1].unwrap()
+        f.close()
+
+    def test_serial_stops_at_first_failure(self):
+        """workers=1 is the old loop: calls run in order, the first
+        Exception stops dispatch, later calls are skipped (they must NEVER
+        run — a create after a failed create is a behavior change)."""
+        ran = []
+
+        def mk(i, fail=False):
+            def fn():
+                ran.append(i)
+                if fail:
+                    raise errors.ApiError(f"call {i}")
+                return i
+            return fn
+
+        f = Fanout(1)
+        res = f.run([("0", "op", mk(0)), ("1", "op", mk(1, fail=True)),
+                     ("2", "op", mk(2)), ("3", "op", mk(3))])
+        assert ran == [0, 1]
+        assert res[0].ok and res[1].error is not None
+        assert res[2].skipped and res[3].skipped
+        with pytest.raises(RuntimeError, match="skipped"):
+            res[2].unwrap()
+
+    def test_serial_preserves_submission_order(self):
+        order = []
+        f = Fanout(1)
+        f.run([(str(i), "op", lambda i=i: order.append(i))
+               for i in range(5)])
+        assert order == [0, 1, 2, 3, 4]
+
+    def test_base_exception_propagates(self):
+        """A BaseException (the chaos harness's SimulatedCrash) must NOT
+        be swallowed into a result — the kill -9 model requires it to
+        reach the caller, in both serial and parallel modes."""
+        class Kill(BaseException):
+            pass
+
+        def die():
+            raise Kill()
+
+        for workers in (1, 4):
+            f = Fanout(workers)
+            with pytest.raises(Kill):
+                f.run([("a", "op", lambda: 1), ("b", "op", die),
+                       ("c", "op", lambda: time.sleep(0.01) or 3)])
+            f.close()
+
+    def test_parallel_actually_overlaps(self):
+        """4 calls × 80 ms sleeps on 4 workers must take ~one sleep, not
+        four (generous ceiling for loaded CI)."""
+        f = Fanout(4)
+        t0 = time.perf_counter()
+        f.run([(str(i), "op", lambda: time.sleep(0.08)) for i in range(4)])
+        wall = time.perf_counter() - t0
+        assert wall < 0.25, f"no overlap: {wall:.3f}s for 4x80ms"
+        f.close()
+
+    def test_telemetry_counters(self):
+        reg = MetricsRegistry()
+        f = Fanout(2, registry=reg)
+        f.run([("a", "container_create", lambda: 1),
+               ("b", "container_create", lambda: 2)])
+        f.run([("c", "container_stop", lambda: 3)])
+        assert reg.counter_value("runtime_calls_total",
+                                 {"op": "container_create"}) == 2
+        assert reg.counter_value("runtime_calls_total",
+                                 {"op": "container_stop"}) == 1
+        assert reg.counter_value("fanout_batches_total") == 2
+        assert "fanout_batch_ms" in reg.render()
+        view = f.status_view()
+        assert view["workers"] == 2 and view["calls"] == 3
+        f.close()
+
+    def test_empty_batch(self):
+        assert Fanout(4).run([]) == []
+
+
+def boot_fan_pod(kv, n_hosts=4, workers=4, journal=None, plans=None):
+    """An n-host pod whose per-host engines are FaultyRuntimes over ONE
+    shared journal — the cross-host ordering oracle."""
+    journal = journal if journal is not None else []
+    jlock = threading.Lock()
+    rts = {
+        f"h{i}": FaultyRuntime(
+            FakeRuntime(), (plans or {}).get(f"h{i}") or FaultPlan(),
+            journal=journal, journal_lock=jlock)
+        for i in range(n_hosts)
+    }
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099, fanout_workers=workers,
+        pod_hosts=[
+            {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+             "grid_coord": [i, 0, 0], **({"local": True} if i == 0 else
+                                         {"runtime_backend": "fake"})}
+            for i in range(n_hosts)
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=rts["h0"],
+                  pod_runtimes={h: r for h, r in rts.items() if h != "h0"})
+    prg.init()
+    return prg, rts, journal
+
+
+class TestGangConcurrencyContracts:
+    """The barriers that must survive parallelism, asserted on the
+    audited cross-host call journal."""
+
+    def _starts_stops(self, journal, vname, n):
+        coord = f"{vname}-p0"
+        workers = {f"{vname}-p{i}" for i in range(1, n)}
+        starts = [(i, t) for i, (op, t, _) in enumerate(journal)
+                  if op == "container_start"]
+        stops = [(i, t) for i, (op, t, _) in enumerate(journal)
+                 if op == "container_stop"]
+        return coord, workers, starts, stops
+
+    def test_coordinator_first_start_coordinator_last_stop(self):
+        from tpu_docker_api.state.kv import MemoryKV
+
+        prg, rts, journal = boot_fan_pod(MemoryKV(), n_hosts=4, workers=4)
+        chips = prg.pod.chips_per_host * 4
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=chips))
+        prg.job_svc.stop_job("train")
+        coord, workers, starts, stops = self._starts_stops(
+            journal, "train-0", 4)
+        coord_start = min(i for i, t in starts if t == coord)
+        worker_starts = [i for i, t in starts if t in workers]
+        assert len(worker_starts) == 3
+        assert coord_start < min(worker_starts), \
+            "a worker started before the coordinator"
+        coord_stop = max(i for i, t in stops if t == coord)
+        worker_stops = [i for i, t in stops if t in workers]
+        assert len(worker_stops) == 3
+        assert coord_stop > max(worker_stops), \
+            "the coordinator stopped before some worker"
+
+    def test_restart_gang_keeps_ordering_under_fanout(self):
+        from tpu_docker_api.state.kv import MemoryKV
+
+        prg, rts, journal = boot_fan_pod(MemoryKV(), n_hosts=4, workers=4)
+        chips = prg.pod.chips_per_host * 4
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=chips))
+        del journal[:]
+        rts["h2"].crash_container("train-0-p2")
+        prg.job_svc.restart_gang("train", reason="test")
+        coord, workers, starts, stops = self._starts_stops(
+            journal, "train-0", 4)
+        # recovery: stop everything (coordinator LAST), start everything
+        # (coordinator FIRST)
+        assert max(i for i, t in stops if t == coord) \
+            > max(i for i, t in stops if t in workers)
+        assert min(i for i, t in starts if t == coord) \
+            < min(i for i, t in starts if t in workers)
+
+    def test_partial_failure_rollback_removes_every_created_member(self):
+        """One host's create fails mid-batch: under concurrency the OTHER
+        creates may already have landed — the rollback must remove every
+        one of them, and the gang's claims must all be released."""
+        from tpu_docker_api.state.kv import MemoryKV
+
+        plans = {"h2": FaultPlan(rules=[fail_nth("container_create", 1)])}
+        prg, rts, journal = boot_fan_pod(MemoryKV(), n_hosts=4, workers=4,
+                                         plans=plans)
+        chips = prg.pod.chips_per_host * 4
+        with pytest.raises(errors.ApiError):
+            prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                       chip_count=chips))
+        for hid, rt in rts.items():
+            assert rt.inner.container_list() == [], \
+                f"{hid} kept a container after rollback"
+        assert prg.job_versions.get("train") is None
+        for host in prg.pod.hosts.values():
+            assert len(host.chips.free_chips) == prg.pod.chips_per_host
+            assert host.ports.status()["owners"] == {}
+
+    def test_delete_fans_out_and_removes_all(self):
+        from tpu_docker_api.schemas.job import JobDelete
+        from tpu_docker_api.state.kv import MemoryKV
+
+        prg, rts, journal = boot_fan_pod(MemoryKV(), n_hosts=4, workers=4)
+        chips = prg.pod.chips_per_host * 4
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=chips))
+        prg.job_svc.delete_job("train", JobDelete(
+            force=True, del_state_and_version_record=True))
+        for rt in rts.values():
+            assert rt.inner.container_list() == []
+        assert prg.job_versions.get("train") is None
+
+
+class TestThreadSafeFakes:
+    """The satellite fix: concurrent fan-out calls must not corrupt the
+    call log (a lost append would break the chaos suite's and the
+    ordering audit's oracles)."""
+
+    def test_faulty_runtime_concurrent_journal_is_complete(self):
+        rt = FaultyRuntime(FakeRuntime(), FaultPlan())
+        n, threads = 50, []
+
+        def worker(i):
+            spec_calls = []
+            for k in range(4):
+                spec_calls.append(rt.container_exists(f"c{i}-{k}"))
+
+        for i in range(n):
+            threads.append(threading.Thread(target=worker, args=(i,)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(rt.calls) == n * 4
+        assert rt.op_count("container_exists") == n * 4
+
+    def test_faulty_rule_fires_exactly_once_under_concurrency(self):
+        """A times=1 rule consumed by racing callers must fire exactly
+        once — double-firing would make chaos plans nondeterministic."""
+        rt = FaultyRuntime(FakeRuntime(), FaultPlan(rules=[
+            FaultRule(op="container_list", on_calls=frozenset(), times=1)]))
+        failures = []
+
+        def worker():
+            try:
+                rt.container_list()
+            except Exception as e:  # noqa: BLE001
+                failures.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(failures) == 1
+        assert len([c for c in rt.calls if c[2] == "fail"]) == 1
+
+    def test_fake_runtime_concurrent_ops(self):
+        from tpu_docker_api.runtime.spec import ContainerSpec
+
+        rt = FakeRuntime()
+        threads = [
+            threading.Thread(target=lambda i=i: (
+                rt.container_create(ContainerSpec(name=f"c{i}", image="jax")),
+                rt.container_start(f"c{i}"),
+                rt.container_stop(f"c{i}"),
+                rt.container_remove(f"c{i}")))
+            for i in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rt.container_list() == []
+        assert len(rt.calls) == 24 * 4
+
+
+class TestMonitorAndSupervisorFanout:
+    def test_probe_once_is_concurrent_across_hosts(self):
+        """4 hosts × 100 ms probe latency: a concurrent probe pass must
+        finish in ~one latency, far under the 400 ms serial sum."""
+        from tpu_docker_api.state.kv import MemoryKV
+
+        plans = {
+            f"h{i}": FaultPlan(rules=[FaultRule(
+                op="container_list", mode="latency", latency_s=0.1,
+                times=-1)])
+            for i in range(4)
+        }
+        prg, rts, _ = boot_fan_pod(MemoryKV(), n_hosts=4, workers=4,
+                                   plans=plans)
+        monitor = prg.host_monitor
+        assert monitor is not None
+        t0 = time.perf_counter()
+        monitor.probe_once()
+        wall = time.perf_counter() - t0
+        assert wall < 0.3, f"probe pass serialized: {wall:.3f}s"
+        view = monitor.status_view()
+        assert all(h["state"] == "healthy" for h in view["hosts"].values())
+
+    def test_supervisor_liveness_scan_matches_serial_verdicts(self):
+        """Same observations at workers=4 as the old serial loop: dead /
+        missing lists keep placement order."""
+        from tpu_docker_api.state.kv import MemoryKV
+
+        prg, rts, _ = boot_fan_pod(MemoryKV(), n_hosts=4, workers=4)
+        chips = prg.pod.chips_per_host * 4
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=chips))
+        st = prg.store.get_job("train-0")
+        rts["h1"].crash_container("train-0-p1")
+        rts["h3"].inner.container_remove("train-0-p3", force=True)
+        dead, missing, crashed, unreachable = \
+            prg.job_supervisor._member_liveness(st)
+        assert dead == ["train-0-p1"]
+        assert missing == ["train-0-p3"]
+        assert crashed is True
+        assert unreachable == []
+        rts["h2"].set_unreachable(True)
+        dead, missing, crashed, unreachable = \
+            prg.job_supervisor._member_liveness(st)
+        assert unreachable == ["h2"]
+
+
+class TestFanoutSurfaces:
+    def test_healthz_surfaces_fanout_stats(self):
+        """The operator-facing half of the telemetry satellite: /healthz
+        carries the fan-out pool view (worker cap + saturation), and
+        /metrics exports the gauges."""
+        import json as _json
+        import urllib.request
+
+        from tpu_docker_api.state.kv import MemoryKV
+
+        prg, rts, _ = boot_fan_pod(MemoryKV(), n_hosts=2, workers=3)
+        prg.cfg.port = 0
+        try:
+            prg.start()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{prg.api_server.port}/healthz",
+                    timeout=5) as resp:
+                out = _json.loads(resp.read())["data"]
+            assert out["fanout"]["workers"] == 3
+            assert {"inflight", "batches", "calls"} <= set(out["fanout"])
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{prg.api_server.port}/metrics",
+                    timeout=5) as resp:
+                text = resp.read().decode()
+            assert "fanout_workers 3" in text
+            assert "fanout_inflight" in text
+            assert "engine_pool_in_use" in text
+        finally:
+            prg.stop()
+
+
+class TestBreakerConcurrency:
+    """The fan-out stampede scenario the half-open single-flight flag
+    exists for: N parallel callers hitting a recovering host must produce
+    exactly ONE probe against the engine."""
+
+    def test_single_probe_under_concurrent_callers(self):
+        clock = {"now": 0.0}
+        release = threading.Event()
+        probes = []
+
+        class SlowInner(FakeRuntime):
+            def container_list(self):
+                probes.append(threading.get_ident())
+                release.wait(2.0)
+                return super().container_list()
+
+        br = BreakerRuntime(SlowInner(), host_id="h1", threshold=1,
+                            cooldown_s=5.0, clock=lambda: clock["now"])
+        # open the breaker
+        with pytest.raises(errors.HostUnreachable):
+            br._call("x", lambda: (_ for _ in ()).throw(
+                ConnectionRefusedError()))
+        assert br.view()["state"] == "open"
+        clock["now"] = 6.0  # past cooldown: next call is THE probe
+        outcomes = []
+
+        def caller():
+            try:
+                outcomes.append(("ok", br.container_list()))
+            except errors.HostUnreachable as e:
+                outcomes.append(("fast-fail", str(e)))
+
+        threads = [threading.Thread(target=caller) for _ in range(8)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # let every caller hit the breaker
+        release.set()
+        for t in threads:
+            t.join()
+        assert len(probes) == 1, f"{len(probes)} probes reached the engine"
+        ok = [o for o in outcomes if o[0] == "ok"]
+        fast = [o for o in outcomes if o[0] == "fast-fail"]
+        assert len(ok) == 1 and len(fast) == 7
+        assert all("probe in flight" in msg or "circuit" in msg
+                   for _, msg in fast)
+        assert br.view()["state"] == "closed"

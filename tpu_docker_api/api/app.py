@@ -142,7 +142,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  health_watcher=None, metrics=None,
                  job_svc=None, pod_scheduler=None, reconciler=None,
                  job_supervisor=None, host_monitor=None,
-                 leader_elector=None, informer=None) -> Router:
+                 leader_elector=None, informer=None, fanout=None) -> Router:
     r = Router(metrics=metrics)
     # HA role gate (service/leader.py): on a standby replica every non-GET
     # request is answered 503 + the leader hint BEFORE dispatch — reads
@@ -381,6 +381,21 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             # degraded still serves (read-through fallback) but slower —
             # load balancers and operators see it here
             out["informer"] = informer.status_view()
+        if fanout is not None:
+            # fan-out saturation (workers/in-flight/batches): a pool pinned
+            # at its worker cap is the "lifecycle flows are serializing
+            # again" smell, surfaced next to liveness
+            out["fanout"] = fanout.status_view()
+        if job_svc is not None:
+            pools = {}
+            for hid, host in sorted(job_svc.pod.hosts.items()):
+                try:
+                    view = host.runtime.pool_view()
+                except AttributeError:
+                    continue  # engine without a connection pool (fake)
+                pools[hid] = view
+            if pools:
+                out["enginePools"] = pools
         return out
 
     r.add("GET", "/healthz", healthz)

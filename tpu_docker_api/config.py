@@ -41,6 +41,17 @@ class Config:
     runtime_backend: str = "docker"
     # docker engine socket (runtime_backend == "docker")
     docker_host: str = "unix:///var/run/docker.sock"
+    # runtime fan-out (runtime/fanout.py): max concurrent engine calls per
+    # multi-member batch — gang create/start/stop/remove, host probes,
+    # liveness scans, reconciler scrubs. 1 (the default) is byte-for-byte
+    # the old serial loops; raise toward the pod's host count on
+    # multi-host pods so lifecycle wall time is O(slowest host) not
+    # O(sum). Must be >= 1.
+    fanout_workers: int = 1
+    # keep-alive connection pool per docker engine: max IDLE sockets
+    # retained (concurrent demand beyond this still opens fresh
+    # connections; only retention is bounded). 0 disables reuse.
+    engine_pool_size: int = 4
     # path to libtpu.so to bind-mount into TPU containers ("" ⇒ image's own)
     libtpu_path: str = ""
     # health watcher (service/watch.py): poll interval; 0 disables the watcher
@@ -156,4 +167,7 @@ def load(path: str | None = None) -> Config:
         raise ValueError(
             f"read_cache must be 'informer' or 'read-through', "
             f"got {cfg.read_cache!r}")
+    if cfg.fanout_workers < 1:
+        raise ValueError(
+            f"fanout_workers must be >= 1, got {cfg.fanout_workers}")
     return cfg

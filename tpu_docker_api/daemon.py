@@ -110,8 +110,24 @@ class Program:
                                      active=self._standby_reads_active)
         self.read_kv = read_kv
         self.store = StateStore(read_kv)
+        # runtime fan-out: ONE bounded pool for the whole process (job
+        # service, supervisor, host monitor, reconciler), so total engine
+        # concurrency is capped by fanout_workers rather than multiplied
+        # across subsystems. workers=1 (default) is the serial singleton
+        # behavior with per-op telemetry
+        from tpu_docker_api.runtime.fanout import Fanout
+
+        self.fanout = Fanout(cfg.fanout_workers, registry=self.metrics,
+                             name="pod")
+        self.metrics.gauge_fn(
+            "fanout_inflight", self.fanout.inflight,
+            help="Engine calls currently submitted to the fan-out pool")
+        self.metrics.gauge_fn(
+            "fanout_workers", lambda: self.fanout.workers,
+            help="Fan-out pool size (config fanout_workers)")
         self.runtime = self._injected_runtime or (
-            open_runtime("docker", docker_host=cfg.docker_host)
+            open_runtime("docker", docker_host=cfg.docker_host,
+                         pool_size=cfg.engine_pool_size)
             if cfg.runtime_backend == "docker"
             else open_runtime("fake", allow_exec=True)
         )
@@ -163,8 +179,20 @@ class Program:
                 vm.attach_informer(self.informer)
         self.job_svc = JobService(
             self.pod, self.pod_scheduler, self.store, self.job_versions,
-            libtpu_path=cfg.libtpu_path,
+            libtpu_path=cfg.libtpu_path, fanout=self.fanout,
         )
+        # engine-pool saturation gauges: one set of books summed over the
+        # distinct engines behind this pod (the local runtime is shared by
+        # several PodHost entries; BreakerRuntime/FaultyRuntime delegate
+        # pool_view to the transport underneath)
+        self.metrics.gauge_fn(
+            "engine_pool_in_use",
+            lambda: self._engine_pool_stat("inUse"),
+            help="Engine keep-alive connections currently in use, all hosts")
+        self.metrics.gauge_fn(
+            "engine_pool_idle",
+            lambda: self._engine_pool_stat("idle"),
+            help="Idle engine keep-alive connections retained, all hosts")
         from tpu_docker_api.service.host_health import HostMonitor
         from tpu_docker_api.service.job_supervisor import JobSupervisor
         from tpu_docker_api.service.reconcile import Reconciler
@@ -181,6 +209,7 @@ class Program:
                 job_svc=self.job_svc, job_versions=self.job_versions,
                 work_queue=self.wq,
                 registry=self.metrics,
+                fanout=self.fanout,
                 # late-bound: the supervisor is constructed just below —
                 # a confirmed-down host must wake it immediately, not
                 # wait out the poll interval
@@ -200,6 +229,7 @@ class Program:
             backoff_jitter=cfg.job_backoff_jitter,
             registry=self.metrics,
             host_monitor=self.host_monitor,
+            fanout=self.fanout,
         )
         # job families allocate from the same local chip/port pools, so
         # their claims must be off-limits to the reconciler's leak sweep
@@ -216,6 +246,7 @@ class Program:
             # a dead daemon left (pending/in-flight records) before judging
             # family state
             work_queue=self.wq,
+            fanout=self.fanout,
         )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
@@ -275,6 +306,22 @@ class Program:
             host.chips.reload_from_store()
             host.ports.reload_from_store()
 
+    def _engine_pool_stat(self, key: str) -> float:
+        """Sum one connection-pool stat over the DISTINCT engines behind
+        the pod (the local runtime backs several PodHost entries once —
+        dedupe by identity; engines without a pool contribute 0)."""
+        total, seen = 0.0, set()
+        for host in self.pod.hosts.values():
+            rt = host.runtime
+            if id(rt) in seen:
+                continue
+            seen.add(id(rt))
+            try:
+                total += host.runtime.pool_view().get(key, 0)
+            except AttributeError:
+                continue
+        return total
+
     def _fence_guards(self) -> list:
         """Fence closure for the FencedKV wrapper (leader_election only):
         empty until the elector first acquires, then the acquired epoch."""
@@ -321,7 +368,8 @@ class Program:
                 continue
             runtime = self._injected_pod_runtimes.get(host_id) or (
                 open_runtime("docker", docker_host=entry.get(
-                    "docker_host", cfg.docker_host))
+                    "docker_host", cfg.docker_host),
+                    pool_size=cfg.engine_pool_size)
                 if entry.get("runtime_backend", cfg.runtime_backend) == "docker"
                 else open_runtime("fake", allow_exec=True)
             )
@@ -459,6 +507,7 @@ class Program:
             host_monitor=self.host_monitor,
             leader_elector=self.leader_elector,
             informer=self.informer,
+            fanout=self.fanout,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -490,6 +539,8 @@ class Program:
         if getattr(self, "informer", None) is not None:
             self.informer.close()
         self._stop_writers()
+        if getattr(self, "fanout", None) is not None:
+            self.fanout.close()
         if getattr(self, "pod", None) is not None:
             for host in self.pod.hosts.values():
                 if host.runtime is not self.runtime:

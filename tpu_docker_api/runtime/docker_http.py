@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import http.client
 import json
+import select
 import socket
 import struct
+import threading
 import time
 import urllib.parse
 
@@ -40,11 +42,115 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
         self.sock = sock
 
 
+class _ConnectionPool:
+    """Thread-safe keep-alive pool of unix-socket connections to ONE engine.
+
+    Fan-out made the transport the bottleneck: every request used to pay a
+    fresh ``connect()`` (docker_http.py pre-pool), and N concurrent gang
+    calls would pay N of them per batch. The pool retains up to ``size``
+    idle keep-alive connections; concurrent demand beyond that still gets
+    fresh connections (blocking callers on a full pool could deadlock a
+    fan-out batch against itself) — only idle *retention* is bounded, so a
+    burst never leaves an unbounded socket pile behind.
+
+    Staleness: a pooled connection can die while idle (dockerd restart).
+    ``acquire`` drops any idle connection whose socket is readable — on a
+    request-quiet keep-alive connection, readable means EOF or protocol
+    junk, never a valid state — so reuse of an obviously-dead socket is
+    avoided for every method. A connection that *still* fails mid-request
+    is the caller's retry-policy problem: idempotent GETs retry on a
+    fresh connection, non-idempotent requests stay one-shot.
+    """
+
+    def __init__(self, size: int = 4) -> None:
+        self.size = max(0, int(size))
+        self._mu = threading.Lock()
+        self._idle: list[_UnixHTTPConnection] = []
+        self._in_use = 0
+        self._created = 0
+        self._reused = 0
+        self._stale_dropped = 0
+        self._closed = False
+
+    @staticmethod
+    def _stale(conn: _UnixHTTPConnection) -> bool:
+        sock = conn.sock
+        if sock is None:
+            return True
+        try:
+            readable, _, _ = select.select([sock], [], [], 0)
+        except (OSError, ValueError):
+            return True
+        return bool(readable)
+
+    def acquire(self, open_fn, timeout: float
+                ) -> tuple[_UnixHTTPConnection, bool]:
+        """Return (connection, reused). ``open_fn(timeout)`` creates a
+        fresh one when no healthy idle connection exists."""
+        while True:
+            with self._mu:
+                if not self._idle:
+                    break
+                conn = self._idle.pop()
+                if self._stale(conn):
+                    self._stale_dropped += 1
+                else:
+                    self._in_use += 1
+                    self._reused += 1
+                    conn.timeout = timeout
+                    if conn.sock is not None:
+                        conn.sock.settimeout(timeout)
+                    return conn, True
+            conn.close()  # stale: closed outside the lock
+        conn = open_fn(timeout)
+        with self._mu:
+            self._created += 1
+            self._in_use += 1
+        return conn, False
+
+    def release(self, conn: _UnixHTTPConnection, reusable: bool) -> None:
+        with self._mu:
+            self._in_use = max(0, self._in_use - 1)
+            if (reusable and not self._closed
+                    and len(self._idle) < self.size):
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def clear(self) -> None:
+        """Drop every idle connection (pool stays usable) — the 'dockerd
+        restarted, start fresh' hook."""
+        with self._mu:
+            idle, self._idle = self._idle, []
+        for conn in idle:
+            conn.close()
+
+    def close_all(self) -> None:
+        with self._mu:
+            idle, self._idle = self._idle, []
+            self._closed = True
+        for conn in idle:
+            conn.close()
+
+    def view(self) -> dict:
+        with self._mu:
+            return {
+                "size": self.size,
+                "idle": len(self._idle),
+                "inUse": self._in_use,
+                "created": self._created,
+                "reused": self._reused,
+                "staleDropped": self._stale_dropped,
+            }
+
+
 class DockerRuntime(ContainerRuntime):
-    def __init__(self, docker_host: str = "unix:///var/run/docker.sock") -> None:
+    def __init__(self, docker_host: str = "unix:///var/run/docker.sock",
+                 pool_size: int = 4) -> None:
         if not docker_host.startswith("unix://"):
             raise ValueError(f"only unix:// docker hosts supported, got {docker_host}")
         self._socket_path = docker_host[len("unix://"):]
+        self._pool = _ConnectionPool(pool_size)
         self.ping()
 
     # -- transport ---------------------------------------------------------------
@@ -70,29 +176,51 @@ class DockerRuntime(ContainerRuntime):
         timeout: float = 60.0,
         retry: bool | None = None,
     ) -> tuple[int, bytes]:
+        """One Engine request over the keep-alive pool.
+
+        Retry policy is unchanged from the pre-pool transport: idempotent
+        GETs retry transient connection failures with backoff, everything
+        else is one-shot (a blindly repeated create/stop could
+        double-apply). The pool only changes WHERE the socket comes from:
+        a healthy idle keep-alive connection when one exists, a fresh
+        ``connect()`` otherwise. Any connection that fails mid-request is
+        discarded, so a GET's retry always reconnects — never replays on
+        the socket that just broke."""
         if retry is None:
             retry = method == "GET"
         attempts = self.RETRY_ATTEMPTS if retry else 1
         qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        payload = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if payload else {}
         for attempt in range(attempts):
             try:
-                conn = self._open_connection(timeout)
+                conn, _reused = self._pool.acquire(
+                    self._open_connection, timeout)
                 try:
-                    payload = (json.dumps(body).encode()
-                               if body is not None else None)
-                    headers = {"Content-Type": "application/json"} if payload else {}
                     conn.request(method, f"/{API_VERSION}{path}{qs}",
                                  body=payload, headers=headers)
                     resp = conn.getresponse()
                     data = resp.read()
-                    return resp.status, data
-                finally:
-                    conn.close()
+                except BaseException:
+                    # poisoned: an interrupted request/response leaves the
+                    # connection state unusable for keep-alive
+                    self._pool.release(conn, reusable=False)
+                    raise
+                self._pool.release(conn, reusable=not resp.will_close)
+                return resp.status, data
             except self._RETRYABLE:
                 if attempt == attempts - 1:
                     raise
                 time.sleep(self.RETRY_BACKOFF_S * (2 ** attempt))
         raise AssertionError("unreachable")  # pragma: no cover
+
+    def pool_view(self) -> dict:
+        """Connection-pool stats (surfaced in /healthz and as the
+        engine-pool gauges at /metrics)."""
+        return self._pool.view()
+
+    def close(self) -> None:
+        self._pool.close_all()
 
     def _json(self, method: str, path: str, params: dict | None = None,
               body: dict | None = None, ok: tuple[int, ...] = (200, 201, 204)):

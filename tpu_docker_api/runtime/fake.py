@@ -127,7 +127,9 @@ class FakeRuntime(ContainerRuntime):
             for e in info.spec.env:
                 k, _, v = e.partition("=")
                 env[k] = v
-        self.calls.append(("exec", name))
+            # journaled under the lock like every other op: concurrent
+            # fan-out callers must not corrupt the call log tests assert on
+            self.calls.append(("exec", name))
         if not self._allow_exec:
             return ExecResult(exit_code=0, output=f"[fake exec] {' '.join(cmd)}")
         proc = subprocess.run(

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import threading
 import time
 from typing import Callable, Iterable
 
@@ -112,12 +113,29 @@ class FaultyRuntime(ContainerRuntime):
     ``calls`` journals (op, target, outcome) where outcome ∈
     {"ok", "fail", "ambiguous", "latency"} — chaos tests assert on it the
     same way FakeRuntime tests assert on ``runtime.calls``.
+
+    Thread safety: call bookkeeping (the journal append, the per-op call
+    counter, the plan's rule matching) is guarded by a lock, so concurrent
+    fan-out callers cannot corrupt the log the chaos suite and the
+    ordering audit assert on. The journal entry is appended *before* the
+    inner op runs (and before a latency rule sleeps), so its order is the
+    call *start* order — per-caller order is preserved, and a barrier in
+    the caller (coordinator-start after the create batch settles) shows
+    up as a strict ordering in the journal.
+
+    ``journal`` / ``journal_lock`` let several per-host FaultyRuntimes
+    share ONE log: the fan-out ordering audit needs a *global* order
+    across hosts, which per-runtime lists cannot give.
     """
 
-    def __init__(self, inner: ContainerRuntime, plan: FaultPlan | None = None) -> None:
+    def __init__(self, inner: ContainerRuntime, plan: FaultPlan | None = None,
+                 journal: list | None = None,
+                 journal_lock: threading.Lock | None = None) -> None:
         self.inner = inner
         self.plan = plan or FaultPlan()
-        self.calls: list[tuple[str, str, str]] = []
+        self.calls: list[tuple[str, str, str]] = (
+            journal if journal is not None else [])
+        self._mu = journal_lock if journal_lock is not None else threading.Lock()
         self._counts: dict[str, int] = {}
         #: host-down switch (set_unreachable): every op fails with
         #: HostUnreachable while set — dockerd hang / host reboot / NIC
@@ -131,30 +149,39 @@ class FaultyRuntime(ContainerRuntime):
         self._unreachable = down
 
     def _invoke(self, op: str, target: str, fn: Callable):
-        if self._unreachable:
-            self.calls.append((op, target, "unreachable"))
-            raise errors.HostUnreachable(
-                f"engine unreachable: connection refused on {op}")
-        self._counts[op] = self._counts.get(op, 0) + 1
-        rule = self.plan.decide(op, self._counts[op])
+        # decide + journal under ONE lock hold: the (count, rule, entry)
+        # triple must be consistent even when fan-out callers race — the
+        # op itself (and a latency rule's sleep) runs outside the lock so
+        # concurrency stays real
+        with self._mu:
+            if self._unreachable:
+                self.calls.append((op, target, "unreachable"))
+                raise errors.HostUnreachable(
+                    f"engine unreachable: connection refused on {op}")
+            self._counts[op] = self._counts.get(op, 0) + 1
+            rule = self.plan.decide(op, self._counts[op])
+            if rule is None or rule.mode == "latency":
+                self.calls.append(
+                    (op, target, "ok" if rule is None else "latency"))
+            elif rule.mode == "fail":
+                self.calls.append((op, target, "fail"))
+                raise rule.error(op)
+            elif rule.mode == "unreachable":  # per-call rule
+                self.calls.append((op, target, "unreachable"))
+                raise errors.HostUnreachable(
+                    f"engine unreachable: connection refused on {op}")
         if rule is None:
-            self.calls.append((op, target, "ok"))
             return fn()
-        if rule.mode == "fail":
-            self.calls.append((op, target, "fail"))
-            raise rule.error(op)
-        if rule.mode == "unreachable":
-            self.calls.append((op, target, "unreachable"))
-            raise errors.HostUnreachable(
-                f"engine unreachable: connection refused on {op}")
         if rule.mode == "latency":
-            self.calls.append((op, target, "latency"))
             time.sleep(rule.latency_s)
             return fn()
-        # ambiguous: the op takes effect AND the caller sees an error
+        # ambiguous: the op takes effect AND the caller sees an error —
+        # journaled only once the effect actually LANDED (an inner op that
+        # itself raised must not leave an entry claiming it took effect)
         result = fn()
-        self.calls.append((op, target, "ambiguous"))
         del result
+        with self._mu:
+            self.calls.append((op, target, "ambiguous"))
         raise rule.error(op)
 
     # -- containers --------------------------------------------------------------

@@ -101,9 +101,17 @@ LEADER_CRASH_POINTS = (
     "leader.after_renew",
 )
 
+#: runtime fan-out layer (runtime/fanout.py): fires after the FIRST call
+#: of a batch completes, while the rest are un-dispatched (serial mode) or
+#: genuinely in flight (parallel mode) — the "concurrent create batch is
+#: half-landed" daemon death the reconciler must converge from
+FANOUT_CRASH_POINTS = (
+    "fanout.mid_batch",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
-                      + LEADER_CRASH_POINTS)
+                      + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

@@ -48,6 +48,7 @@ from tpu_docker_api.runtime.base import (
     ExecResult,
     VolumeInfo,
 )
+from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
@@ -271,8 +272,14 @@ class HostMonitor:
                  down_grace_s: float = 15.0, clock=time.monotonic,
                  job_svc=None, job_versions=None, work_queue=None,
                  on_down=None, registry: MetricsRegistry | None = None,
-                 max_events: int = 256) -> None:
+                 max_events: int = 256,
+                 fanout: Fanout | None = None) -> None:
         self.pod = pod
+        #: runtime fan-out: all hosts are probed as ONE concurrent batch,
+        #: so detection wall time is O(slowest host), not O(sum) — one
+        #: hung engine can no longer delay every other host's verdict by
+        #: its full timeout
+        self._fanout = fanout or SERIAL
         self.slices = slices            # PodScheduler (cordon/down marks)
         self._interval = interval_s
         self._grace = down_grace_s
@@ -324,18 +331,30 @@ class HostMonitor:
     # -- probing -----------------------------------------------------------------
 
     def probe_once(self) -> None:
-        for hid in sorted(self.pod.hosts):
-            host = self.pod.hosts[hid]
+        def probe(hid: str) -> str | None:
+            """None = alive, str = the connection error (host-path down)."""
             try:
-                host.runtime.container_list()
+                self.pod.hosts[hid].runtime.container_list()
             except CONNECTION_ERRORS as e:
-                self._probe_failed(hid, str(e))
+                return str(e)
             except Exception as e:  # noqa: BLE001 — engine responded:
                 # an application error is a LIVE host with a complaint
                 log.warning("host %s probe returned app error: %s", hid, e)
+            return None
+
+        # every host probed concurrently: detection wall time is the
+        # slowest single probe. Verdicts are applied in sorted host order
+        # AFTER the batch settles, so state transitions (and their events)
+        # stay deterministic regardless of probe completion order
+        hids = sorted(self.pod.hosts)
+        results = self._fanout.run([
+            (hid, "container_list", lambda h=hid: probe(h)) for hid in hids])
+        for hid, r in zip(hids, results):
+            err = r.unwrap()
+            if err is None:
                 self._probe_ok(hid)
             else:
-                self._probe_ok(hid)
+                self._probe_failed(hid, err)
 
     def _probe_ok(self, hid: str) -> None:
         now = self._clock()

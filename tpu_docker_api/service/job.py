@@ -40,6 +40,7 @@ import logging
 import re
 
 from tpu_docker_api import errors
+from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.pod import Pod, PodScheduler, SliceAllocation
 from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun, JobState
@@ -78,12 +79,18 @@ class JobService:
         store: StateStore,
         versions: VersionMap,
         libtpu_path: str = "",
+        fanout: Fanout | None = None,
     ) -> None:
         self.pod = pod
         self.slices = slices
         self.store = store
         self.versions = versions
         self.libtpu_path = libtpu_path
+        #: runtime fan-out (runtime/fanout.py): every multi-member engine
+        #: batch — create, start-workers, stop-workers, remove — routes
+        #: through it. The default is the serial singleton, byte-for-byte
+        #: the pre-fan-out loops; daemon.py wires the pod-wide pool
+        self.fanout = fanout or SERIAL
         self._locks = _FamilyLocks()
         #: optional event hook (set by JobSupervisor): called with
         #: (kind, job_name, **detail) for gang lifecycle transitions
@@ -246,26 +253,93 @@ class JobService:
     def _create_and_start(self, grants: list[SliceAllocation],
                           specs: list[ContainerSpec],
                           start_now: bool = True) -> None:
-        """Create every process container, then (optionally) start all
-        (coordinator first so peers find it); on any failure remove what was
-        created. ``start_now=False`` is the rescale path: containers are
-        created alongside the running old version and started only after it
-        quiesces."""
-        created: list[tuple[str, str]] = []  # (host_id, container name)
+        """Create every process container (one concurrent fan-out batch),
+        then (optionally) start the gang — coordinator first as a barrier,
+        workers concurrently after it; on any failure remove *everything*
+        that was created. ``start_now=False`` is the rescale path:
+        containers are created alongside the running old version and
+        started only after it quiesces."""
+        ordered = [(host_id, spec)
+                   for (host_id, _), spec in zip(self._host_order(grants),
+                                                 specs)]
+        pairs = [(host_id, spec.name) for host_id, spec in ordered]
+        results = self.fanout.run([
+            (spec.name, "container_create",
+             lambda h=host_id, s=spec: self.pod.hosts[h].runtime
+             .container_create(s))
+            for host_id, spec in ordered])
+        created = [pairs[i] for i, r in enumerate(results) if r.ok]
         try:
-            for (host_id, _), spec in zip(self._host_order(grants), specs):
-                self.pod.hosts[host_id].runtime.container_create(spec)
-                created.append((host_id, spec.name))
+            failure = next((r.error for r in results
+                            if r.error is not None), None)
+            if failure is not None:
+                raise failure
             if start_now:
-                for host_id, name in created:
-                    self.pod.hosts[host_id].runtime.container_start(name)
+                self._start_pairs(pairs)
         except Exception:
-            for host_id, name in created:
-                try:
-                    self.pod.hosts[host_id].runtime.container_remove(name, force=True)
-                except Exception:
-                    log.exception("rollback remove of %s on %s failed", name, host_id)
+            # rollback removes every member that was created — including
+            # the ones a concurrent batch landed AFTER the failing one
+            self._remove_pairs(created, force=True, log_failures=True)
             raise
+
+    def _start_pairs(self, pairs: list[tuple[str, str]]) -> None:
+        """Start a gang in process order with the concurrency contract:
+        the coordinator (process 0) starts FIRST and alone — a barrier, so
+        peers always find their rendezvous point — then every worker
+        starts concurrently. Raises the first failure (the caller's
+        rollback/adoption machinery takes over; in serial mode later
+        workers are never dispatched, exactly the old loop)."""
+        def start(host_id: str, cname: str) -> None:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                # stale placement (host removed from the pod config) — a
+                # meaningful error, not a raw KeyError→500
+                raise errors.ContainerNotExist(
+                    f"{cname}: host {host_id} is no longer in the pod")
+            host.runtime.container_start(cname)
+
+        for batch in (pairs[:1], pairs[1:]):
+            results = self.fanout.run([
+                (cname, "container_start",
+                 lambda h=host_id, c=cname: start(h, c))
+                for host_id, cname in batch])
+            for r in results:
+                if r.error is not None:
+                    raise r.error
+
+    def _remove_pairs(self, pairs: list[tuple[str, str]], force: bool = True,
+                      log_failures: bool = False) -> None:
+        """Concurrent tolerant removes — the shape every teardown path
+        (rollback, delete, scrub) shares. Missing containers and dead
+        engines never abort the batch: each member's failure handling is
+        inside its own call."""
+        def remove(host_id: str, cname: str) -> None:
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                return
+            try:
+                host.runtime.container_remove(cname, force=force)
+            except errors.ContainerNotExist:
+                pass
+            except Exception as e:  # noqa: BLE001
+                if log_failures:
+                    log.exception("rollback remove of %s on %s failed",
+                                  cname, host_id)
+                elif isinstance(e, errors.HOST_PATH_ERRORS):
+                    # the member is beyond a dead engine; the flow must
+                    # still make progress (the container is lost either
+                    # way — logged for the post-reboot janitor)
+                    log.warning("remove of %s skipped: %s", cname, e)
+                else:
+                    raise
+
+        results = self.fanout.run([
+            (cname, "container_remove",
+             lambda h=host_id, c=cname: remove(h, c))
+            for host_id, cname in pairs])
+        for r in results:
+            if r.error is not None:
+                raise r.error
 
     def _run_version(self, base: str, image: str, cmd: list[str], env: list[str],
                      binds: list[str], n_chips: int,
@@ -802,19 +876,10 @@ class JobService:
                     st = self.store.get_job(vname)
                 except errors.NotExistInStore:
                     continue
-                for host_id, cname, *_ in st.placements:
-                    host = self.pod.hosts.get(host_id)
-                    if host is None:
-                        continue
-                    try:
-                        host.runtime.container_remove(cname, force=req.force)
-                    except errors.ContainerNotExist:
-                        pass
-                    except errors.HOST_PATH_ERRORS as e:
-                        # the member is beyond a dead engine; removing the
-                        # KV record must still work (the container is lost
-                        # either way — logged for the post-reboot janitor)
-                        log.warning("remove of %s skipped: %s", cname, e)
+                # one concurrent batch per version: an N-member delete is
+                # O(slowest engine), not O(sum)
+                self._remove_pairs([(h, c) for h, c, *_ in st.placements],
+                                   force=req.force)
                 self._release_version_resources(st, txn=release_txn)
             release_txn.commit()
             if req.del_state_and_version_record:
@@ -848,49 +913,50 @@ class JobService:
     # -- internals ---------------------------------------------------------------
 
     def _start_members(self, st: JobState) -> None:
-        """Start in process order (coordinator first so peers find it)."""
-        for host_id, cname, *_ in st.placements:
-            host = self.pod.hosts.get(host_id)
-            if host is None:
-                # stale placement (host removed from the pod config) — a
-                # meaningful error, not a raw KeyError→500
-                raise errors.ContainerNotExist(
-                    f"{cname}: host {host_id} is no longer in the pod")
-            host.runtime.container_start(cname)
+        """Start in process order: coordinator first (a barrier — peers
+        must find it), then the workers as one concurrent batch."""
+        self._start_pairs([(h, c) for h, c, *_ in st.placements])
 
     def _teardown_version(self, st: JobState, rollback_to: int) -> None:
         """Remove a (possibly half-started) version's containers and free its
         resources — the compensation arm of the rescale fast path."""
         base, _ = split_versioned_name(st.job_name)
-        for host_id, cname, *_ in st.placements:
-            host = self.pod.hosts.get(host_id)
-            if host is None:
-                continue
-            try:
-                host.runtime.container_remove(cname, force=True)
-            except (errors.ContainerNotExist, *errors.HOST_PATH_ERRORS):
-                pass
+        self._remove_pairs([(h, c) for h, c, *_ in st.placements], force=True)
         self._release_version_resources(st)
         self.store.delete_version(Resource.JOBS, st.job_name)
         self.versions.rollback(base, rollback_to)
 
     def _stop_members(self, st: JobState, reverse: bool = False) -> None:
-        """``reverse=True`` is gang ordering: stop workers first, the
-        coordinator (process 0) last, so peers never lose their rendezvous
-        point while still draining. Stops are best-effort on unreachable
-        hosts — a member beyond a dead engine cannot be drained, and every
-        caller (quiesce, fail, migrate) must still make progress."""
-        placements = list(reversed(st.placements)) if reverse else st.placements
-        for host_id, cname, *_ in placements:
+        """``reverse=True`` is gang ordering: every worker stops first (one
+        concurrent batch — they drain in parallel), the coordinator
+        (process 0) strictly LAST, after the worker batch settles, so
+        peers never lose their rendezvous point while still draining.
+        Stops are best-effort on unreachable hosts — a member beyond a
+        dead engine cannot be drained, and every caller (quiesce, fail,
+        migrate) must still make progress."""
+        def stop(host_id: str, cname: str) -> None:
             host = self.pod.hosts.get(host_id)
             if host is None:
-                continue
+                return
             try:
                 host.runtime.container_stop(cname)
             except errors.ContainerNotExist:
                 pass
             except errors.HOST_PATH_ERRORS as e:
                 log.warning("stop of %s skipped: %s", cname, e)
+
+        pairs = [(h, c) for h, c, *_ in st.placements]
+        # the coordinator is its own barrier-separated batch on BOTH
+        # orderings; reverse additionally drains the workers in reversed
+        # submission order (inert under concurrency, byte-for-byte the
+        # old loop in serial mode)
+        batches = ((list(reversed(pairs[1:])), pairs[:1]) if reverse
+                   else (pairs[:1], pairs[1:]))
+        for batch in batches:
+            self.fanout.run([
+                (cname, "container_stop",
+                 lambda h=host_id, c=cname: stop(h, c))
+                for host_id, cname in batch])
 
     def _free_state_ports(self, st: JobState,
                           txn: StoreTxn | None = None) -> None:

@@ -46,6 +46,7 @@ import threading
 import time
 
 from tpu_docker_api import errors
+from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.state.keys import split_versioned_name, versioned_name
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 from tpu_docker_api.utils.backoff import backoff_delay_s
@@ -78,8 +79,13 @@ class JobSupervisor:
         registry: MetricsRegistry | None = None,
         max_events: int = 512,
         host_monitor=None,
+        fanout: Fanout | None = None,
     ) -> None:
         self.pod = pod
+        #: runtime fan-out: per-member liveness inspects run as one
+        #: concurrent batch per family, so a poll's wall time is O(slowest
+        #: host), not O(sum of hosts)
+        self._fanout = fanout or SERIAL
         self._svc = job_svc
         self._store = store
         self._versions = versions
@@ -347,27 +353,40 @@ class JobSupervisor:
         Members behind an unreachable engine are in NO other bucket: their
         state is unknown, and treating them as dead or missing is exactly
         the misclassification that burned restart budget on host faults."""
+        def probe(host_id: str, cname: str):
+            host = self.pod.hosts.get(host_id)
+            if host is None:
+                return ("missing", None)
+            try:
+                return ("info", host.runtime.container_inspect(cname))
+            except errors.ContainerNotExist:
+                return ("missing", None)
+            except errors.HOST_PATH_ERRORS:
+                return ("unreachable", host_id)
+
+        # one concurrent batch over the whole gang: wall time is the
+        # SLOWEST member inspect, not the sum — a slow or breaker-open
+        # host no longer serializes behind every healthy one. Results are
+        # positional, so the dead/missing lists keep placement order and
+        # the verdicts below stay deterministic.
+        results = self._fanout.run([
+            (cname, "container_inspect",
+             lambda h=host_id, c=cname: probe(h, c))
+            for host_id, cname, *_ in st.placements])
         dead: list[str] = []
         missing: list[str] = []
         unreachable: list[str] = []
         crashed = False
-        for host_id, cname, *_ in st.placements:
-            host = self.pod.hosts.get(host_id)
-            if host is None:
+        for (host_id, cname, *_), r in zip(st.placements, results):
+            kind, payload = r.unwrap()
+            if kind == "missing":
                 missing.append(cname)
-                continue
-            try:
-                info = host.runtime.container_inspect(cname)
-            except errors.ContainerNotExist:
-                missing.append(cname)
-                continue
-            except errors.HOST_PATH_ERRORS:
-                if host_id not in unreachable:
-                    unreachable.append(host_id)
-                continue
-            if not info.running:
+            elif kind == "unreachable":
+                if payload not in unreachable:
+                    unreachable.append(payload)
+            elif not payload.running:
                 dead.append(cname)
-                if info.exit_code != 0 or info.status == "created":
+                if payload.exit_code != 0 or payload.status == "created":
                     crashed = True
         return dead, missing, crashed, unreachable
 

@@ -46,6 +46,7 @@ import time
 
 from tpu_docker_api import errors
 from tpu_docker_api.runtime.base import ContainerRuntime
+from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.ports import PortScheduler
 from tpu_docker_api.scheduler.slices import ChipScheduler
@@ -83,8 +84,13 @@ class Reconciler:
         registry: MetricsRegistry | None = None,
         max_events: int = 512,
         work_queue=None,
+        fanout: Fanout | None = None,
     ) -> None:
         self.runtime = runtime
+        #: runtime fan-out: the gang member scans, stale-version sweeps
+        #: and half-created-job scrubs batch their per-member engine calls
+        #: so a sweep's wall time is O(slowest host), not O(sum)
+        self._fanout = fanout or SERIAL
         self.store = store
         self.chips = chips
         self.ports = ports
@@ -521,25 +527,33 @@ class Reconciler:
                               fn=lambda: self._job_versions.rollback(base, prev))
                 return
 
-            members = []  # (host, cname, info | None)
-            unreachable: list[str] = []  # host ids whose engine is down
-            for host_id, cname, *_ in st.placements:
+            def probe(host_id: str, cname: str):
                 host = self._job_svc.pod.hosts.get(host_id)
-                info = None
-                if host is not None:
-                    try:
-                        info = host.runtime.container_inspect(cname)
-                    except errors.ContainerNotExist:
-                        info = None
-                    except errors.HOST_PATH_ERRORS:
-                        # the member's state is UNKNOWN, not missing — a
-                        # connectivity fault must never read as a lost
-                        # container (fail-job-missing-members would
-                        # condemn the job on a network blip)
-                        if host_id not in unreachable:
-                            unreachable.append(host_id)
-                        members.append((host, cname, "unreachable"))
-                        continue
+                if host is None:
+                    return (host, None)
+                try:
+                    return (host, host.runtime.container_inspect(cname))
+                except errors.ContainerNotExist:
+                    return (host, None)
+                except errors.HOST_PATH_ERRORS:
+                    # the member's state is UNKNOWN, not missing — a
+                    # connectivity fault must never read as a lost
+                    # container (fail-job-missing-members would
+                    # condemn the job on a network blip)
+                    return (host, "unreachable")
+
+            # one concurrent batch over the gang (results positional, so
+            # the member/unreachable lists keep placement order)
+            scanned = self._fanout.run([
+                (cname, "container_inspect",
+                 lambda h=host_id, c=cname: probe(h, c))
+                for host_id, cname, *_ in st.placements])
+            members = []  # (host, cname, info | None | "unreachable")
+            unreachable: list[str] = []  # host ids whose engine is down
+            for (host_id, cname, *_), r in zip(st.placements, scanned):
+                host, info = r.unwrap()
+                if info == "unreachable" and host_id not in unreachable:
+                    unreachable.append(host_id)
                 members.append((host, cname, info))
 
             if st.desired_running and st.phase == "migrating":
@@ -672,21 +686,27 @@ class Reconciler:
                     vst = self.store.get_job(vname)
                 except errors.NotExistInStore:
                     continue
-                stale_running = []
-                for host_id, cname, *_ in vst.placements:
+                def stale_probe(host_id: str, cname: str) -> bool:
                     host = self._job_svc.pod.hosts.get(host_id)
                     if host is None:
-                        continue
+                        return False
                     try:
-                        if host.runtime.container_inspect(cname).running:
-                            stale_running.append(cname)
+                        return host.runtime.container_inspect(cname).running
                     except (errors.ContainerNotExist,
                             *errors.HOST_PATH_ERRORS):
                         # unreachable: unverifiable, and unquiesceable —
                         # but the KV-side resource frees below must still
                         # run (a migrated-away gang's old slice is pure
                         # control-plane state)
-                        pass
+                        return False
+
+                stale_scan = self._fanout.run([
+                    (cname, "container_inspect",
+                     lambda h=host_id, c=cname: stale_probe(h, c))
+                    for host_id, cname, *_ in vst.placements])
+                stale_running = [
+                    cname for (_, cname, *_), r
+                    in zip(vst.placements, stale_scan) if r.unwrap()]
                 if stale_running:
                     self._act(actions, dry_run, "retire-stale-job-version",
                               vname, members=stale_running,
@@ -714,14 +734,15 @@ class Reconciler:
         (``<vname>`` or ``<vname>#s<k>``), and host ports owned by it."""
         svc = self._job_svc
         prefix = f"{vname}-p"
-        for host in svc.pod.hosts.values():
+
+        def scrub_host(host) -> None:
             try:
                 names = list(host.runtime.container_list())
             except errors.HOST_PATH_ERRORS:
                 # can't enumerate a dead engine; the KV-side frees below
                 # still run, and any member it holds is swept when (if)
                 # the host returns
-                continue
+                names = []
             for cname in names:
                 if cname.startswith(prefix) and cname[len(prefix):].isdigit():
                     try:
@@ -729,6 +750,15 @@ class Reconciler:
                     except (errors.ContainerNotExist,
                             *errors.HOST_PATH_ERRORS):
                         pass
+
+        # the engine half of the scrub fans out (one task per host: list +
+        # member removes); port restores stay on this thread — they are KV
+        # writes, and concurrent frees would just contend on the store txn
+        for r in self._fanout.run([
+                (hid, "host_scrub", lambda h=host: scrub_host(h))
+                for hid, host in sorted(svc.pod.hosts.items())]):
+            r.unwrap()
+        for host in svc.pod.hosts.values():
             owned = [p for p, o in host.ports.status()["owners"].items()
                      if o == vname]
             if owned:
