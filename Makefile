@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout bench-preempt openapi sample-interface run clean
 
 all: native openapi
 
@@ -61,6 +61,11 @@ bench-fanout:                ## runtime fan-out family: gang lifecycle walls vs 
 	$(PY) bench.py --control-plane --cp-family fanout --fanout-iters 2 > bench-fanout.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-fanout.json.tmp
 	mv bench-fanout.json.tmp bench-fanout.json
+
+bench-preempt:               ## capacity-market family: fill with preemptible gangs, submit production, time-to-placed + preemption/legacy gates
+	$(PY) bench.py --control-plane --cp-family preempt > bench-preempt.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-preempt.json.tmp
+	mv bench-preempt.json.tmp bench-preempt.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

@@ -869,6 +869,174 @@ def measure_control_plane_fanout(latency_ms: float = 50.0,
     }
 
 
+def measure_control_plane_preempt(n_low: int = 4, n_high: int = 3,
+                                  chips_per_job: int = 2,
+                                  interval_s: float = 0.05,
+                                  timeout_s: float = 30.0) -> dict:
+    """Control-plane capacity-market family (``--control-plane
+    --cp-family preempt``): fill the pool with preemptible gangs, submit
+    production gangs over real HTTP, and measure time-to-placed while the
+    admission loop preempts for them. Self-gating on the tentpole
+    invariants:
+
+    - **every high-priority job places** (phase ``running`` within the
+      timeout) — the market never strands a production ask a preemption
+      could satisfy;
+    - **zero preemptions when holes suffice** — an identical production
+      burst into FREE capacity places immediately without touching any
+      running gang (backfill proven, not asserted);
+    - **legacy refusal preserved** — a second daemon with
+      ``admission_enabled=false`` still answers a full pool with the
+      byte-for-byte 10601 hard-fail (data: null).
+
+    A violated gate flips ``gates.ok``; main() turns that into a nonzero
+    exit."""
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+
+    if n_low < 1 or n_high < 1:
+        raise ValueError("preempt family needs n_low/n_high >= 1")
+
+    def boot(enabled: bool) -> Program:
+        prog = Program(Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            start_port=48000, end_port=48999, health_watch_interval=0,
+            host_probe_interval_s=0, job_supervise_interval=0,
+            reconcile_interval=0, admission_enabled=enabled,
+            admission_interval_s=interval_s,
+        ), host="127.0.0.1")
+        prog.init()
+        prog.start()
+        return prog
+
+    def call(prog, method, path, body=None, expect_error=False):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if not expect_error and out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def submit(prog, name, klass):
+        return call(prog, "POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": name,
+            "chipCount": chips_per_job, "priorityClass": klass})
+
+    def wait_placed(prog, name) -> None:
+        deadline = time.perf_counter() + timeout_s
+        info = {}
+        while time.perf_counter() < deadline:
+            info = call(prog, "GET", f"/api/v1/jobs/{name}")["data"]
+            if info.get("phase") == "running":
+                return
+            time.sleep(0.005)
+        raise RuntimeError(f"{name} never placed (still "
+                           f"{info.get('phase')!r}) within {timeout_s}s")
+
+    prog = boot(enabled=True)
+    try:
+        n_chips = prog.pod.n_chips
+        if n_low * chips_per_job < n_chips:
+            raise ValueError(
+                f"{n_low} low jobs x {chips_per_job} chips do not fill the "
+                f"{n_chips}-chip pool — the pressure phase would be vacuous")
+
+        def admission_view() -> dict:
+            return call(prog, "GET", "/api/v1/admission")["data"]
+
+        # phase A — holes: production burst into FREE capacity
+        holes_ms: list[float] = []
+        for i in range(n_high):
+            t0 = time.perf_counter()
+            out = submit(prog, f"hole{i}", "production")
+            if out["data"].get("phase") == "queued":
+                raise RuntimeError(f"hole{i} queued on a free pool: {out}")
+            holes_ms.append((time.perf_counter() - t0) * 1e3)
+        preempt_holes = admission_view()["preemptionsTotal"]
+        for i in range(n_high):
+            call(prog, "DELETE", f"/api/v1/jobs/hole{i}",
+                 {"force": True, "delStateAndVersionRecord": True})
+
+        # phase B — pressure: fill the pool with preemptible gangs, then
+        # submit the same production burst; the loop must preempt for it
+        filled = 0
+        for i in range(n_low):
+            out = submit(prog, f"low{i}", "preemptible")
+            if out["data"].get("phase") != "queued":
+                filled += 1
+        placed_ms: list[float] = []
+        queued_positions: list[int] = []
+        for i in range(n_high):
+            # time-to-placed = submit wall + queue wait + preemption +
+            # placement, observed the way a client would (polling GET)
+            t0 = time.perf_counter()
+            out = submit(prog, f"high{i}", "production")
+            queued_positions.append(out["data"].get("queuePosition", 0))
+            wait_placed(prog, f"high{i}")
+            placed_ms.append((time.perf_counter() - t0) * 1e3)
+        view = admission_view()
+        preempt_total = view["preemptionsTotal"]
+        admissions = view["admissionsTotal"]
+    finally:
+        prog.stop()
+
+    # phase C — legacy: admission disabled keeps today's refusal exactly
+    legacy = boot(enabled=False)
+    try:
+        call(legacy, "POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": "fill",
+            "chipCount": legacy.pod.n_chips})
+        refusal = call(legacy, "POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": "denied", "chipCount": 2},
+            expect_error=True)
+    finally:
+        legacy.stop()
+
+    def quantiles(ms: list[float]) -> dict:
+        s = sorted(ms)
+        return {"p50": round(s[len(s) // 2], 3),
+                "p95": round(s[min(len(s) - 1, int(len(s) * 0.95))], 3),
+                "max": round(s[-1], 3)}
+
+    pressure_preempts = preempt_total - preempt_holes
+    all_placed = len(placed_ms) == n_high
+    gates = {
+        "all_placed": all_placed,
+        "zero_preempt_with_holes": preempt_holes == 0,
+        "preemptions_with_holes": preempt_holes,
+        "preempted_under_pressure": pressure_preempts >= 1,
+        "legacy_refusal_code": refusal.get("code"),
+        "legacy_refusal_ok": (refusal.get("code") == 10601
+                              and refusal.get("data") is None),
+    }
+    gates["ok"] = bool(all_placed and gates["zero_preempt_with_holes"]
+                       and gates["preempted_under_pressure"]
+                       and gates["legacy_refusal_ok"])
+    return {
+        "family": "preempt",
+        "iters": {"low_jobs": filled, "high_jobs": n_high,
+                  "chips_per_job": chips_per_job,
+                  "pool_chips": n_chips,
+                  "admission_interval_s": interval_s},
+        "time_to_placed_ms": quantiles(placed_ms),
+        "placed_ms": [round(v, 3) for v in placed_ms],
+        "holes_time_to_placed_ms": quantiles(holes_ms),
+        "queued_positions": queued_positions,
+        "preemptions": {
+            "with_holes": preempt_holes,
+            "under_pressure": pressure_preempts,
+            "per_admission": round(
+                pressure_preempts / max(admissions, 1), 3),
+        },
+        "gates": gates,
+    }
+
+
 def main() -> int | None:
     """Returns a nonzero exit code on backend-init failure (consumed by
     the ``sys.exit(main())`` entry); None = success."""
@@ -885,7 +1053,7 @@ def main() -> int | None:
                         choices=["fake", "docker"])
     parser.add_argument("--cp-family", default="create",
                         choices=["create", "churn", "failover", "reads",
-                                 "fanout"],
+                                 "fanout", "preempt"],
                         help="create = create→ready latency; churn = "
                              "create→ready→replace→delete for containers "
                              "AND gangs with store round-trips per flow; "
@@ -897,7 +1065,12 @@ def main() -> int | None:
                              "audit; fanout = gang lifecycle at member "
                              "counts {2,4,8} against slow engines, "
                              "gating wall-clock O(slowest host), gang "
-                             "ordering and store round trips")
+                             "ordering and store round trips; preempt = "
+                             "fill the pool with preemptible gangs, "
+                             "submit production gangs, time-to-placed "
+                             "p50/p95 + preemptions-per-admission, gating "
+                             "all-high-placed / zero-preempt-with-holes / "
+                             "legacy refusal preserved")
     parser.add_argument("--cp-iters", type=int, default=100,
                         help="iterations (create family) / container "
                              "cycles (churn family) / total GETs per role "
@@ -916,6 +1089,12 @@ def main() -> int | None:
     parser.add_argument("--fanout-latency-ms", type=float, default=50.0,
                         help="injected per-engine-call latency for the "
                              "fanout family")
+    parser.add_argument("--preempt-low", type=int, default=4,
+                        help="preemptible gangs filling the pool for the "
+                             "preempt family")
+    parser.add_argument("--preempt-high", type=int, default=3,
+                        help="production gangs submitted under pressure "
+                             "for the preempt family")
     parser.add_argument("--failover-ttl", type=float, default=1.0,
                         help="leader lease TTL seconds for the failover "
                              "family (the recovery ceiling under test)")
@@ -954,6 +1133,9 @@ def main() -> int | None:
                 cp = measure_control_plane_fanout(
                     iters=args.fanout_iters,
                     latency_ms=args.fanout_latency_ms)
+            elif args.cp_family == "preempt":
+                cp = measure_control_plane_preempt(
+                    n_low=args.preempt_low, n_high=args.preempt_high)
             else:
                 cp = measure_control_plane(args.cp_iters, args.cp_runtime)
         except Exception as e:
@@ -976,6 +1158,9 @@ def main() -> int | None:
         elif args.cp_family == "fanout":
             headline = ("control_plane_fanout_gang8_create_ms",
                         cp["members"]["8"]["create_ms_min"])
+        elif args.cp_family == "preempt":
+            headline = ("control_plane_preempt_time_to_placed_ms_p50",
+                        cp["time_to_placed_ms"]["p50"])
         else:
             headline = ("container_create_ready_ms_p50",
                         cp["create_ready_ms_p50"])

@@ -108,6 +108,58 @@ def validate_reads(extra: dict) -> list[str]:
     return problems
 
 
+def validate_preempt(extra: dict) -> list[str]:
+    """The capacity-market family headline payload: time-to-placed
+    quantiles under preemption pressure, the per-phase preemption counts,
+    and a passing gate. The zero-preempt-with-holes and legacy-refusal
+    gates are re-checked here (not just gates.ok): a market that preempts
+    when holes suffice, or that broke the admission_enabled=false refusal
+    contract, must fail loudly at the schema layer too."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    for key in ("low_jobs", "high_jobs"):
+        if not (isinstance(it.get(key), int) and it[key] >= 1):
+            problems.append(f"preempt: iters.{key} must be an int >= 1, "
+                            f"got {it.get(key)!r}")
+    ttp = extra.get("time_to_placed_ms") or {}
+    for q in QUANTS:
+        if not _num(ttp.get(q)) or ttp[q] <= 0:
+            problems.append(f"preempt: time_to_placed_ms.{q} must be a "
+                            f"positive number, got {ttp.get(q)!r}")
+    series = extra.get("placed_ms")
+    n_high = it.get("high_jobs")
+    if (not isinstance(series, list)
+            or (isinstance(n_high, int) and len(series) != n_high)
+            or not all(_num(v) and v > 0 for v in series)):
+        problems.append("preempt: placed_ms must list one positive "
+                        "time-to-placed per high-priority job")
+    pre = extra.get("preemptions") or {}
+    if pre.get("with_holes") != 0:
+        problems.append(f"preempt: preemptions.with_holes is "
+                        f"{pre.get('with_holes')!r} — the market preempted "
+                        f"although free holes sufficed (backfill broken)")
+    up = pre.get("under_pressure")
+    if not (isinstance(up, int) and up >= 1):
+        problems.append(f"preempt: preemptions.under_pressure must be an "
+                        f"int >= 1, got {up!r} (a full pool admitted "
+                        f"production jobs without preempting anything?)")
+    gates = extra.get("gates") or {}
+    for key in ("all_placed", "zero_preempt_with_holes",
+                "preempted_under_pressure", "legacy_refusal_code",
+                "legacy_refusal_ok", "ok"):
+        if key not in gates:
+            problems.append(f"preempt: gates.{key} missing")
+    if gates.get("legacy_refusal_code") != 10601:
+        problems.append(f"preempt: admission_enabled=false no longer "
+                        f"refuses with 10601 "
+                        f"(got {gates.get('legacy_refusal_code')!r})")
+    if gates.get("all_placed") is not True:
+        problems.append("preempt: a high-priority job never placed")
+    if gates.get("ok") is not True:
+        problems.append(f"preempt: regression gate failed: {gates}")
+    return problems
+
+
 FANOUT_FLOWS = ("create", "stop", "delete")
 
 
@@ -188,11 +240,15 @@ def validate_lines(lines: list[dict]) -> list[str]:
               if (ln.get("extra") or {}).get("family") == "fanout"]
     if fanout:
         return problems + validate_fanout(fanout[0]["extra"])
+    preempt = [ln for ln in lines
+               if (ln.get("extra") or {}).get("family") == "preempt"]
+    if preempt:
+        return problems + validate_preempt(preempt[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn, failover, reads or fanout headline "
-                           "line (extra.family)"]
+        return problems + ["no churn, failover, reads, fanout or preempt "
+                           "headline line (extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

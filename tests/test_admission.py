@@ -1,0 +1,480 @@
+"""Capacity-market admission tests (docs/robustness.md "Capacity market").
+
+Queue-ordering invariants, pinned property-style:
+
+- victim selection is deterministic: strictly-lower priority only, lowest
+  priority first, then YOUNGEST first (largest submit seq — the paged.py
+  seniority rule), stopping at the minimal feasible prefix;
+- backfill never starves the head past ``admission_max_skips``: once the
+  bound is hit the queue stalls behind the blocked entry even though later
+  entries would fit;
+- a preempted job re-admits before an equal-priority queued job, even one
+  with an older submit seq;
+- ``stop_job`` on queued/preempted DEQUEUES, ``delete_job`` purges the
+  admission record, ``restart_job``/rescale on dormant phases reject;
+- ``admission_enabled=false`` keeps the legacy hard refusal byte-for-byte,
+  while enabled deployments answer capacity refusal with a queue position
+  (and flag never-placeable asks ``queueable: false``);
+- zero preemptions when holes suffice (backfill proven, not asserted), and
+  whole-host asks blocked only by fragmentation defragment via migration.
+"""
+
+import json
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun
+from tpu_docker_api.service.invariants import (
+    check_invariants,
+    check_job_invariants,
+)
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+
+
+def boot(n_hosts: int = 1, admission_enabled: bool = True,
+         max_skips: int = 4, kv=None, runtimes=None) -> Program:
+    """A Program over a fake pod: single-host (8 chips) by default, or an
+    n-host grid; the admission loop is disabled (interval 0) so tests
+    drive ``admit_once`` inline."""
+    kv = kv if kv is not None else MemoryKV()
+    runtimes = runtimes or {f"h{i}": FakeRuntime() for i in range(n_hosts)}
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        admission_enabled=admission_enabled, admission_interval_s=0,
+        admission_max_skips=max_skips,
+        pod_hosts=[] if n_hosts == 1 else [
+            {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+             "grid_coord": [i, 0, 0],
+             **({"local": True} if i == 0 else {"runtime_backend": "fake"})}
+            for i in range(n_hosts)
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=runtimes["h0"],
+                  pod_runtimes={h: r for h, r in runtimes.items()
+                                if h != "h0"})
+    prg.init()
+    return prg
+
+
+def run(prg, name, chips, klass="batch", **kw):
+    return prg.job_svc.run_job(JobRun(
+        image_name="jax", job_name=name, chip_count=chips,
+        priority_class=klass, **kw))
+
+
+def phase(prg, base):
+    return prg.store.get_job(
+        f"{base}-{prg.job_versions.get(base)}").phase
+
+
+def oracle(prg) -> list[str]:
+    problems = check_job_invariants(
+        prg.pod, prg.pod_scheduler, prg.store, prg.job_versions)
+    problems += check_invariants(
+        prg.runtime, prg.store, prg.container_versions,
+        prg.chip_scheduler, prg.port_scheduler,
+        job_versions=prg.job_versions)
+    return problems
+
+
+class TestOrderingInvariants:
+    def test_victim_selection_lowest_priority_then_youngest(self):
+        """Deterministic victim order: preemptible before batch, youngest
+        (largest submit seq) first within a class, and the selection stops
+        at the minimal feasible prefix."""
+        prg = boot(n_hosts=2)
+        run(prg, "a", 4, "batch")          # seq 0 → h0
+        run(prg, "b", 4, "preemptible")    # seq 1 → h0 (fills it)
+        run(prg, "c", 4, "preemptible")    # seq 2 → h1
+        victims = prg.admission._victims_for(
+            prg.admission.weight("production"), 16, 1, "req")
+        # 16 chips = both hosts fully free ⇒ every victim must go; the
+        # ORDER is the contract: preemptible (c youngest, then b), batch last
+        assert victims == ["c", "b", "a"]
+        # a sub-host ask stops at the minimal prefix (freeing c suffices:
+        # h1 then has 8 free for a 6-chip ask)
+        assert prg.admission._victims_for(
+            prg.admission.weight("production"), 6, 1, "req") == ["c"]
+        # eligibility is STRICTLY lower weight: nothing sits below the
+        # lowest class, so a preemptible requester can never preempt
+        assert prg.admission._victims_for(
+            prg.admission.weight("preemptible"), 4, 1, "req") == []
+
+    def test_preempted_readmits_before_equal_priority_queued(self):
+        """A preempted batch job outranks a QUEUED batch job with an older
+        submit seq: it already held capacity once."""
+        prg = boot(n_hosts=2)
+        run(prg, "a", 16, "batch")                       # fills the pool
+        assert run(prg, "b", 16, "batch")["phase"] == "queued"
+        assert run(prg, "c", 16, "production")["phase"] == "queued"
+        # production preempts a (the only strictly-lower victim) and places
+        assert [o["job"] for o in prg.admission.admit_once()] == ["c"]
+        assert phase(prg, "a") == "preempted"
+        assert phase(prg, "b") == "queued"
+        # free the pool: a re-admits ahead of b despite b's older seq
+        prg.job_svc.delete_job("c", JobDelete(
+            force=True, del_state_and_version_record=True))
+        assert [o["job"] for o in prg.admission.admit_once()] == ["a"]
+        assert phase(prg, "a") == "running"
+        assert phase(prg, "b") == "queued"
+        assert oracle(prg) == []
+
+    def test_backfill_never_starves_past_max_skips(self):
+        """EASY backfill with the starvation bound: small jobs may pass a
+        blocked head at most ``admission_max_skips`` times; then the queue
+        stalls behind it — capacity or not — until the head places."""
+        prg = boot(n_hosts=1, max_skips=2)
+
+        def free(name):
+            prg.job_svc.delete_job(name, JobDelete(
+                force=True, del_state_and_version_record=True))
+
+        run(prg, "base0", 4, "production")
+        run(prg, "blockA", 4, "production")   # pool now full
+        assert run(prg, "bigjob", 8, "production")["phase"] == "queued"
+        for i in range(3):
+            assert run(prg, f"f{i}", 4, "batch")["phase"] == "queued"
+        # two rounds of freed holes the head cannot use: each backfill
+        # past it charges one durable skip
+        free("blockA")
+        assert [o["job"] for o in prg.admission.admit_once()] == ["f0"]
+        free("f0")
+        assert [o["job"] for o in prg.admission.admit_once()] == ["f1"]
+        view = prg.admission.status_view()
+        head = next(e for e in view["entries"] if e["name"] == "bigjob")
+        assert head["skips"] == 2 and head["position"] == 1
+        # the bound is hit: f2 WOULD fit the next hole, but the queue now
+        # stalls behind the head — capacity or not — until it places
+        free("f1")
+        assert prg.admission.admit_once() == []
+        assert phase(prg, "f2") == "queued"
+        # capacity for the head returns: it places FIRST
+        free("base0")
+        assert [o["job"] for o in prg.admission.admit_once()] == ["bigjob"]
+        assert phase(prg, "bigjob") == "running"
+        free("bigjob")
+        assert [o["job"] for o in prg.admission.admit_once()] == ["f2"]
+        assert oracle(prg) == []
+
+    def test_preempted_records_exempt_from_starvation_gate(self):
+        """A max-skipped head stalls QUEUED work behind it — but never a
+        preempted victim's re-admission: victims restore capacity they
+        already held, and stranding them dormant on idle chips would be
+        the market defeating itself."""
+        prg = boot(n_hosts=1, max_skips=2)
+        run(prg, "base", 4, "production")   # un-preemptable blocker
+        run(prg, "low", 4, "preemptible")
+        # park low as preempted (the state a failed post-preempt placement
+        # leaves behind)
+        assert prg.admission._preempt_one(
+            "low", for_base="big",
+            requester_weight=prg.admission.weight("production"))
+        assert phase(prg, "low") == "preempted"
+        # a blocked head (8 chips; only 4 free, base is equal-class) that
+        # already exhausted its skip budget
+        assert run(prg, "big", 8, "production")["phase"] == "queued"
+        rec = next(r for r in prg.admission.records() if r.base == "big")
+        rec.skips = 2
+        prg.kv.put(rec.key(), rec.to_json())
+        # the head still cannot place (only 8 free minus nothing — but low
+        # re-admitting takes 4): the pass must re-admit LOW through the
+        # gate rather than break before reaching it
+        placed = [o["job"] for o in prg.admission.admit_once()]
+        assert "low" in placed
+        assert phase(prg, "low") == "running"
+        assert oracle(prg) == []
+
+    def test_zero_preemptions_when_holes_suffice(self):
+        """Backfill proven, not asserted: free capacity admits queued work
+        without touching any running gang."""
+        prg = boot(n_hosts=1)
+        run(prg, "low1", 4, "preemptible")
+        run(prg, "low2", 4, "preemptible")       # pool now full
+        assert run(prg, "hi", 4, "production")["phase"] == "queued"
+        prg.job_svc.delete_job("low2", JobDelete(
+            force=True, del_state_and_version_record=True))
+        assert [o["job"] for o in prg.admission.admit_once()] == ["hi"]
+        assert phase(prg, "hi") == "running"
+        assert phase(prg, "low1") == "running"   # untouched
+        assert prg.admission.status_view()["preemptionsTotal"] == 0
+
+
+class TestPhaseOperations:
+    def test_stop_dequeues_queued_job(self):
+        prg = boot(n_hosts=1)
+        run(prg, "fill", 8, "batch")
+        assert run(prg, "waiting", 4, "batch")["phase"] == "queued"
+        prg.job_svc.stop_job("waiting")
+        assert prg.admission.records() == []
+        assert phase(prg, "waiting") == "stopped"
+        # capacity later returns; the stopped job must NOT place
+        prg.job_svc.delete_job("fill", JobDelete(
+            force=True, del_state_and_version_record=True))
+        assert prg.admission.admit_once() == []
+        assert phase(prg, "waiting") == "stopped"
+
+    def test_stop_dequeues_preempted_job(self):
+        prg = boot(n_hosts=1)
+        run(prg, "low", 8, "preemptible")
+        run(prg, "hi", 8, "production")
+        prg.admission.admit_once()
+        assert phase(prg, "low") == "preempted"
+        prg.job_svc.stop_job("low")
+        assert prg.admission.records() == []
+        assert phase(prg, "low") == "stopped"
+        assert oracle(prg) == []
+
+    def test_delete_purges_admission_record(self):
+        prg = boot(n_hosts=1)
+        run(prg, "fill", 8, "batch")
+        run(prg, "waiting", 4, "batch")
+        prg.job_svc.delete_job("waiting", JobDelete(
+            force=True, del_state_and_version_record=True))
+        assert prg.admission.records() == []
+        assert prg.job_versions.get("waiting") is None
+        assert prg.kv.range_prefix(
+            keys.family_prefix(keys.Resource.JOBS, "waiting")) == {}
+
+    def test_restart_and_rescale_reject_dormant_phases(self):
+        prg = boot(n_hosts=1)
+        run(prg, "fill", 8, "preemptible")
+        run(prg, "waiting", 4, "batch")
+        with pytest.raises(errors.BadRequest, match="queued"):
+            prg.job_svc.restart_job("waiting")
+        with pytest.raises(errors.BadRequest, match="queued"):
+            prg.job_svc.patch_job_chips("waiting", JobPatchChips(chip_count=2))
+        run(prg, "hi", 8, "production")
+        prg.admission.admit_once()
+        assert phase(prg, "fill") == "preempted"
+        with pytest.raises(errors.BadRequest, match="preempted"):
+            prg.job_svc.restart_job("fill")
+
+    def test_restart_rejected_after_stop_of_grantless_job(self):
+        """A stopped job normally retains its grant for resume — but one
+        stopped out of queued/preempted owns NOTHING: restarting its old
+        members would double-bind chips the scheduler may have granted
+        elsewhere. Both shapes must reject loudly."""
+        prg = boot(n_hosts=1)
+        run(prg, "low", 8, "preemptible")
+        run(prg, "hi", 8, "production")
+        prg.admission.admit_once()
+        assert phase(prg, "low") == "preempted"
+        prg.job_svc.stop_job("low")          # dequeue: stays stopped
+        with pytest.raises(errors.BadRequest, match="slice grant"):
+            prg.job_svc.restart_job("low")
+        # ex-queued: stopped before ever placing — no members at all
+        run(prg, "ghost", 4, "batch")
+        assert phase(prg, "ghost") == "queued"
+        prg.job_svc.stop_job("ghost")
+        with pytest.raises(errors.BadRequest, match="never placed"):
+            prg.job_svc.restart_job("ghost")
+        assert oracle(prg) == []
+
+    def test_priority_and_seniority_survive_rescale(self):
+        """Class and submit seq are FAMILY identity: a rolling rescale's
+        new version must keep them, or the rescaled gang would drop to
+        the default class and become junior (preemptable by accident)."""
+        prg = boot(n_hosts=1)
+        run(prg, "svc", 2, "production")
+        seq0 = prg.store.get_job("svc-0").submitted_seq
+        prg.job_svc.patch_job_chips("svc", JobPatchChips(chip_count=4))
+        st = prg.store.get_job(f"svc-{prg.job_versions.get('svc')}")
+        assert st.version == 1
+        assert st.priority_class == "production"
+        assert st.submitted_seq == seq0
+
+    def test_supervisor_leaves_dormant_gangs_alone(self):
+        """A preempted gang's stopped members are the market's doing — the
+        supervisor must not restart them (that would double-bind the freed
+        capacity under the admitted job)."""
+        prg = boot(n_hosts=1)
+        run(prg, "low", 8, "preemptible")
+        run(prg, "hi", 8, "production")
+        prg.admission.admit_once()
+        assert phase(prg, "low") == "preempted"
+        prg.job_supervisor.poll_once()
+        assert phase(prg, "low") == "preempted"
+        low = prg.store.get_job(f"low-{prg.job_versions.get('low')}")
+        assert all(not prg.runtime.container_inspect(c).running
+                   for _, c, *_ in low.placements)
+        assert oracle(prg) == []
+
+
+class TestRefusalErgonomics:
+    def test_disabled_keeps_legacy_hard_fail(self):
+        """admission_enabled=false: the 10601 refusal is byte-for-byte
+        today's — same type, no data payload, nothing journaled."""
+        prg = boot(n_hosts=1, admission_enabled=False)
+        run(prg, "fill", 8)
+        with pytest.raises(errors.ChipNotEnough) as ei:
+            run(prg, "more", 4)
+        assert ei.value.data is None
+        assert prg.kv.range_prefix(keys.ADMISSION_PREFIX) == {}
+        assert prg.job_versions.get("more") is None
+        # the envelope a client sees is the legacy error shape exactly
+        from tpu_docker_api.api import response
+        assert json.loads(response.error(
+            ei.value.code, str(ei.value), data=ei.value.data)) == {
+                "code": 10601, "msg": str(ei.value), "data": None}
+
+    def test_enabled_returns_queue_position(self):
+        prg = boot(n_hosts=1)
+        run(prg, "fill", 8)
+        out = run(prg, "q1", 4, "batch")
+        assert out["phase"] == "queued"
+        assert out["queueable"] is True
+        assert out["queuePosition"] == 1
+        out = run(prg, "q2", 4, "batch")
+        assert out["queuePosition"] == 2
+        # GET /jobs/{name} surfaces the queue state too
+        info = prg.job_svc.get_job_info("q2")
+        assert info["phase"] == "queued"
+        assert info["queuePosition"] == 2
+        assert info["priorityClass"] == "batch"
+
+    def test_never_placeable_ask_flags_queueable_false(self):
+        """An ask no amount of preemption can satisfy hard-fails even with
+        admission enabled — flagged so clients can tell policy from
+        capacity."""
+        prg = boot(n_hosts=1)
+        with pytest.raises(errors.ChipNotEnough) as ei:
+            run(prg, "huge", 64)
+        assert ei.value.data == {"queueable": False}
+        assert prg.kv.range_prefix(keys.ADMISSION_PREFIX) == {}
+
+    def test_unknown_priority_class_rejected(self):
+        prg = boot(n_hosts=1)
+        with pytest.raises(errors.BadRequest, match="priorityClass"):
+            run(prg, "x", 2, "platinum")
+
+
+class TestDefragmentation:
+    def test_whole_host_ask_defragments_via_migration(self):
+        """Fragmentation, not scarcity: 8 free chips split 4+4 across two
+        hosts block a whole-host ask — the market migrates a sub-host gang
+        to compact, with ZERO preemptions (equal-priority jobs are never
+        victims)."""
+        prg = boot(n_hosts=3)
+        run(prg, "a", 4, "production")     # → h0
+        run(prg, "b", 8, "production")     # whole host → h1
+        run(prg, "c", 4, "production")     # → h0 (fills it)
+        run(prg, "d", 4, "production")     # → h2
+        prg.job_svc.delete_job("c", JobDelete(
+            force=True, del_state_and_version_record=True))
+        # h0: 4 used (a), h1: full (b), h2: 4 used (d) — 8 free, 0 whole
+        assert run(prg, "big", 8, "production")["phase"] == "queued"
+        assert [o["job"] for o in prg.admission.admit_once()] == ["big"]
+        assert phase(prg, "big") == "running"
+        # nothing was preempted; a (or d) was MIGRATED to make a hole
+        assert prg.admission.status_view()["preemptionsTotal"] == 0
+        for name in ("a", "b", "d"):
+            assert phase(prg, name) == "running"
+        assert oracle(prg) == []
+
+
+class TestOperatorSurface:
+    def test_admission_route_events_and_health(self):
+        from tpu_docker_api.api.app import build_router
+
+        prg = boot(n_hosts=1)
+        run(prg, "low", 8, "preemptible")
+        run(prg, "hi", 8, "production")
+        router = build_router(
+            prg.container_svc, prg.volume_svc, prg.chip_scheduler,
+            prg.port_scheduler, work_queue=prg.wq, metrics=prg.metrics,
+            job_svc=prg.job_svc, pod_scheduler=prg.pod_scheduler,
+            job_supervisor=prg.job_supervisor, admission=prg.admission)
+        view = router.dispatch("GET", "/api/v1/admission", {})
+        assert view["enabled"] is True
+        assert view["depth"] == 1
+        assert view["perClass"]["production"] == 1
+        assert view["entries"][0]["name"] == "hi"
+        assert view["classes"]["system"] > view["classes"]["production"]
+        prg.admission.admit_once()
+        view = router.dispatch("GET", "/api/v1/admission", {})
+        assert view["depth"] == 1   # low parked for re-admission
+        assert view["entries"][0]["state"] == "preempted"
+        assert view["preemptionsTotal"] == 1
+        assert view["admissionsTotal"] == 1
+        # events ride the merged ring
+        events = router.dispatch("GET", "/api/v1/events", {})
+        kinds = {e["event"] for e in events if "event" in e}
+        assert {"job-queued", "job-preempted", "job-admitted"} <= kinds
+        # /healthz carries the one-set-of-books read-back
+        health = router.dispatch("GET", "/healthz", {})
+        assert health["admission"]["enabled"] is True
+        assert health["admission"]["preemptionsTotal"] == 1
+        # /api/v1/health/jobs surfaces the class next to the phase
+        jobs = router.dispatch("GET", "/api/v1/health/jobs", {})
+        assert jobs["jobs"]["low"]["priorityClass"] == "preemptible"
+        assert jobs["jobs"]["low"]["phase"] == "preempted"
+
+    def test_metrics_series(self):
+        prg = boot(n_hosts=1)
+        run(prg, "low", 8, "preemptible")
+        run(prg, "hi", 8, "production")
+        prg.admission.admit_once()
+        text = prg.metrics.render()
+        assert 'admission_queue_depth{class="preemptible"} 1' in text
+        assert 'preemptions_total{victim_class="preemptible"} 1' in text
+        assert "admission_wait_ms" in text
+
+    def test_leader_standby_does_not_run_admission_loop(self):
+        """The admission loop is a WRITER: with leader election on it must
+        start/stop with the lease (daemon wiring), and with the loop
+        interval 0 it never starts at all."""
+        prg = boot(n_hosts=1)
+        assert prg.admission._thread is None
+        prg._start_writers()
+        try:
+            assert prg.admission._thread is None  # interval 0: inline only
+        finally:
+            prg._stop_writers()
+
+
+class TestConfigValidation:
+    def test_load_validates_admission_keys(self, tmp_path):
+        bad = tmp_path / "bad.toml"
+        bad.write_text('priority_class_default = "gold"\n')
+        with pytest.raises(ValueError, match="priority_class_default"):
+            config_mod.load(str(bad))
+        bad.write_text("admission_max_skips = -1\n")
+        with pytest.raises(ValueError, match="admission_max_skips"):
+            config_mod.load(str(bad))
+        bad.write_text("[priority_class_weights]\ngold = 1.5\n")
+        with pytest.raises(ValueError, match="integer"):
+            config_mod.load(str(bad))
+        good = tmp_path / "good.toml"
+        good.write_text(
+            'admission_enabled = true\nadmission_max_skips = 7\n'
+            'priority_class_default = "gold"\n'
+            "[priority_class_weights]\ngold = 10\nbronze = 1\n")
+        cfg = config_mod.load(str(good))
+        assert cfg.priority_class_weights == {"gold": 10, "bronze": 1}
+        assert cfg.admission_max_skips == 7
+
+    def test_custom_ladder_drives_admission(self):
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        cfg = config_mod.Config(
+            store_backend="memory", runtime_backend="fake",
+            health_watch_interval=0, end_port=40099,
+            admission_enabled=True, admission_interval_s=0,
+            priority_class_weights={"gold": 10, "bronze": 1},
+            priority_class_default="bronze",
+        )
+        prg = Program(cfg, kv=kv, runtime=rt)
+        prg.init()
+        run(prg, "cheap", 8, "")   # "" → the configured default, bronze
+        with pytest.raises(errors.BadRequest, match="priorityClass"):
+            run(prg, "x", 2, "batch")  # the default ladder is GONE
+        assert run(prg, "vip", 8, "gold")["phase"] == "queued"
+        prg.admission.admit_once()
+        assert phase(prg, "vip") == "running"
+        assert phase(prg, "cheap") == "preempted"

@@ -312,6 +312,67 @@ class TestFanoutFamily:
                 assert 0 < stats[f"{flow}_ms_min"] <= stats[f"{flow}_ms_max"]
 
 
+class TestPreemptFamily:
+    """The capacity-market family (``make bench-preempt``): fill the pool
+    with preemptible gangs on the fake runtime, submit production gangs,
+    at tiny scale — pinning both the artifact schema
+    (scripts/check_churn_schema.py) and the tentpole invariants: every
+    high-priority job places (the market never strands a production ask a
+    preemption could satisfy), ZERO preemptions when free holes suffice
+    (backfill proven, not asserted), and ``admission_enabled=false``
+    still answers a full pool with the byte-for-byte 10601 refusal."""
+
+    @pytest.fixture(scope="class")
+    def preempt(self):
+        return bench.measure_control_plane_preempt(n_low=4, n_high=2)
+
+    def test_schema_checker_accepts_the_emitted_line(self, preempt):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_preempt_time_to_placed_ms_p50",
+                "value": preempt["time_to_placed_ms"]["p50"],
+                "unit": "ms", "vs_baseline": 1.0, "extra": preempt}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... and so must a preemption where holes sufficed (the
+        # backfill-broken failure mode this family exists to catch), a
+        # changed legacy refusal code, or a stranded high-priority job
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["preemptions"]["with_holes"] = 1
+        assert any("holes" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["legacy_refusal_code"] = 10302
+        assert any("10601" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["all_placed"] = False
+        assert any("never placed" in p for p in validate_lines([bad]))
+
+    def test_preempt_gates_hold(self, preempt):
+        gates = preempt["gates"]
+        assert gates["ok"] is True
+        # the tentpole: every production submission placed, and pressure
+        # was resolved by preemption — not by luck or spare capacity
+        assert gates["all_placed"] is True
+        assert gates["preempted_under_pressure"] is True
+        assert preempt["preemptions"]["under_pressure"] >= 1
+        # zero preemptions when holes sufficed (backfill proven)
+        assert gates["zero_preempt_with_holes"] is True
+        assert preempt["preemptions"]["with_holes"] == 0
+        # admission_enabled=false keeps today's refusal contract
+        assert gates["legacy_refusal_ok"] is True
+        assert gates["legacy_refusal_code"] == 10601
+        ttp = preempt["time_to_placed_ms"]
+        assert 0 < ttp["p50"] <= ttp["p95"] <= ttp["max"]
+        assert len(preempt["placed_ms"]) == 2
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

@@ -31,8 +31,9 @@ from tpu_docker_api.schemas.container import (
     ContainerPort,
     ContainerRun,
 )
-from tpu_docker_api.schemas.job import JobPatchChips, JobRun
+from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun
 from tpu_docker_api.service.crashpoints import (
+    ADMISSION_CRASH_POINTS,
     CONTAINER_CRASH_POINTS,
     FANOUT_CRASH_POINTS,
     JOB_CRASH_POINTS,
@@ -120,9 +121,13 @@ def test_case_matrix_covers_every_crash_point():
     # the fan-out matrix crashes two flows inside half-landed concurrent
     # batches (create, quiesce-stop)
     assert {p for _, p in FANOUT_CASES} == set(FANOUT_CRASH_POINTS)
+    # the admission matrix kills the daemon at every capacity-market
+    # lifecycle point (admission.preempt fires twice: via skip=0/1)
+    assert {p for p, _ in ADMISSION_CASES} == set(ADMISSION_CRASH_POINTS)
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
             | set(LEADER_CRASH_POINTS) | set(FANOUT_CRASH_POINTS)
+            | set(ADMISSION_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
 
@@ -1302,3 +1307,148 @@ def test_txn_before_apply_leaves_batch_unwritten(tmp_path):
     assert check_invariants(
         runtime, prg2.store, prg2.container_versions,
         prg2.chip_scheduler, prg2.port_scheduler) == []
+
+
+#: capacity-market admission lifecycle (service/admission.py): every
+#: labeled point, with armed(..., skip=k) targeting admission.preempt's
+#: two firings — skip=0 dies right after the preempted-intent apply (gang
+#: still running), skip=1 after the quiesce but before the release
+ADMISSION_CASES = (
+    ("admission.enqueue", 0),
+    ("admission.select_victims", 0),
+    ("admission.preempt", 0),
+    ("admission.preempt", 1),
+    ("admission.readmit", 0),
+)
+
+
+def boot_admission_pod(kv, local_rt, remote_rt) -> Program:
+    """The 2-host pod shape with the capacity market enabled; the loop is
+    disabled (interval 0) so tests drive admission passes inline, under
+    armed crash points."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        admission_enabled=True, admission_interval_s=0,
+        pod_hosts=[
+            {"host_id": "h0", "address": "10.0.0.1", "grid_coord": [0, 0, 0],
+             "local": True},
+            {"host_id": "h1", "address": "10.0.0.2", "grid_coord": [1, 0, 0],
+             "runtime_backend": "fake"},
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=local_rt, pod_runtimes={"h1": remote_rt})
+    prg.init()
+    return prg
+
+
+class TestAdmissionChaos:
+    """Kill the daemon at every admission.* crash point mid-preemption
+    (docs/robustness.md "Capacity market"): a fresh Program over the same
+    store + engines must reconcile to one live version, zero leaks, the
+    victim either FULLY preempted (queued for re-admission, members
+    stopped, zero slices/ports) or FULLY running — never half-quiesced —
+    and the admission journal must replay exactly-once (no double
+    placement, no stranded record)."""
+
+    @pytest.mark.parametrize("point,skip", ADMISSION_CASES,
+                             ids=[f"{p}@skip{s}" for p, s in ADMISSION_CASES])
+    def test_preemption_crash_converges(self, point, skip):
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_admission_pod(kv, rt0, rt1)
+        # fill the pool: a preemptible 2-member gang over both hosts
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="low",
+                                   chip_count=16,
+                                   priority_class="preemptible"))
+        with armed(point, skip=skip):
+            with pytest.raises(SimulatedCrash):
+                if point == "admission.enqueue":
+                    # dies right after the queued JobState + record landed
+                    # atomically (the client never sees the response)
+                    prg.job_svc.run_job(JobRun(
+                        image_name="jax", job_name="high", chip_count=16,
+                        priority_class="production"))
+                else:
+                    prg.job_svc.run_job(JobRun(
+                        image_name="jax", job_name="high", chip_count=16,
+                        priority_class="production"))
+                    prg.admission.admit_once()
+
+        # the daemon is dead; a fresh control plane boots over the same state
+        prg2 = boot_admission_pod(kv, rt0, rt1)
+        prg2.reconciler.reconcile()
+        problems = _job_oracle(prg2)
+        assert problems == [], f"{point}@skip{skip}: {problems}"
+
+        # the victim is never half-quiesced: fully preempted (all members
+        # stopped, zero resources, a re-admission record) or fully running
+        low = prg2.store.get_job(f"low-{prg2.job_versions.get('low')}")
+        low_running = [
+            c for h, c, *_ in low.placements
+            if prg2.pod.hosts[h].runtime.container_inspect(c).running]
+        recs = {r.base: r for r in prg2.admission.records()}
+        if low.phase == "preempted":
+            assert low_running == []
+            assert recs["low"].kind == "preempted"
+        else:
+            assert low.phase == "running"
+            assert len(low_running) == len(low.placements)
+
+        # drain the market: the production job must end up placed exactly
+        # once, with the journal emptied of its record
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        high_v = prg2.job_versions.get("high")
+        assert high_v is not None
+        high = prg2.store.get_job(f"high-{high_v}")
+        assert high.phase == "running"
+        assert all(prg2.pod.hosts[h].runtime.container_inspect(c).running
+                   for h, c, *_ in high.placements)
+        assert all(r.base != "high" for r in prg2.admission.records())
+        # exactly-once: precisely ONE high version ever placed members
+        high_members = [n for rt in (rt0, rt1) for n in rt.container_list()
+                        if n.startswith("high-")]
+        versions = {n.split("-p")[0] for n in high_members}
+        assert len(versions) == 1, f"duplicated placement: {versions}"
+
+        assert _job_oracle(prg2) == []
+        # a second sweep finds nothing: the repair is a fixpoint
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    def test_readmit_crash_settles_record_without_double_place(self):
+        """The exactly-once half, isolated: the queued job PLACED but its
+        record survived the crash — the next daemon's reconcile must
+        settle the record (never re-place) and a subsequent admission
+        pass must be a no-op."""
+        kv = MemoryKV()
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot_admission_pod(kv, rt0, rt1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="low",
+                                   chip_count=16,
+                                   priority_class="preemptible"))
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="high",
+                                   chip_count=16,
+                                   priority_class="production"))
+        # free the pool the polite way so admission needs no preemption
+        prg.job_svc.delete_job("low", JobDelete(
+            force=True, del_state_and_version_record=True))
+        with armed("admission.readmit"):
+            with pytest.raises(SimulatedCrash):
+                prg.admission.admit_once()
+        # placed, record still present — the crash window under test
+        assert any(r.base == "high" for r in prg.admission.records())
+
+        prg2 = boot_admission_pod(kv, rt0, rt1)
+        report = prg2.reconciler.reconcile()
+        assert any(a["action"] == "settle-admission-record"
+                   for a in report["actions"])
+        assert prg2.admission.records() == []
+        assert prg2.admission.admit_once() == []
+        st = prg2.store.get_job(f"high-{prg2.job_versions.get('high')}")
+        assert st.phase == "running"
+        # exactly one placed version, one live gang
+        assert prg2.job_versions.get("high") == 1  # v0 queued, v1 placed
+        assert _job_oracle(prg2) == []
+        assert prg2.reconciler.reconcile()["actions"] == []

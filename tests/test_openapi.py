@@ -22,7 +22,8 @@ def _live_routes():
     svc = _Any()
     r = build_router(svc, svc, svc, svc, work_queue=svc, health_watcher=svc,
                      metrics=None, job_svc=svc, pod_scheduler=svc,
-                     reconciler=svc, job_supervisor=svc, host_monitor=svc)
+                     reconciler=svc, job_supervisor=svc, host_monitor=svc,
+                     admission=svc)
     routes = {(m, p) for m, _, p, _ in r._routes}
     routes.add(("GET", "/metrics"))
     return routes

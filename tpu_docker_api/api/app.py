@@ -142,7 +142,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  health_watcher=None, metrics=None,
                  job_svc=None, pod_scheduler=None, reconciler=None,
                  job_supervisor=None, host_monitor=None,
-                 leader_elector=None, informer=None, fanout=None) -> Router:
+                 leader_elector=None, informer=None, fanout=None,
+                 admission=None) -> Router:
     r = Router(metrics=metrics)
     # HA role gate (service/leader.py): on a standby replica every non-GET
     # request is answered 503 + the leader hint BEFORE dispatch — reads
@@ -337,6 +338,11 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         r.add("PATCH", "/api/v1/jobs/{name}/tpu", j_patch_chips)
         r.add("POST", "/api/v1/jobs/{name}/stop", j_stop)
         r.add("PATCH", "/api/v1/jobs/{name}/restart", j_restart)
+    if admission is not None:
+        # capacity market: queue depth, per-class counts, positions and the
+        # preemption/admission counters (the same books /metrics exports)
+        r.add("GET", "/api/v1/admission",
+              lambda body, **_: admission.status_view())
     if pod_scheduler is not None:
         r.add("GET", "/api/v1/resources/slices",
               lambda body, **_: pod_scheduler.status())
@@ -386,6 +392,11 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             # at its worker cap is the "lifecycle flows are serializing
             # again" smell, surfaced next to liveness
             out["fanout"] = fanout.status_view()
+        if admission is not None:
+            # capacity-market health: queue depth + the admission/
+            # preemption counters read back from the metrics registry
+            # (one set of books — /healthz and /metrics cannot disagree)
+            out["admission"] = admission.health_view()
         if job_svc is not None:
             pools = {}
             for hid, host in sorted(job_svc.pod.hosts.items()):
@@ -414,12 +425,12 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/leader", leader_view)
     if (health_watcher is not None or job_supervisor is not None
             or host_monitor is not None or leader_elector is not None
-            or informer is not None):
+            or informer is not None or admission is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
         # supervisor), host health transitions (host monitor), leadership
-        # transitions (elector) and informer degradations, ordered by
-        # timestamp (SURVEY.md §5.3)
+        # transitions (elector), informer degradations and capacity-market
+        # admissions/preemptions, ordered by timestamp (SURVEY.md §5.3)
         def h_events(body, **_):
             try:
                 limit = int(body.get("limit", 100))
@@ -434,7 +445,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             # combined rings per GET was pure waste
             rings = [src.events_view(limit=limit)
                      for src in (health_watcher, job_supervisor,
-                                 host_monitor, leader_elector, informer)
+                                 host_monitor, leader_elector, informer,
+                                 admission)
                      if src is not None]
             merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
             return list(merged)[-limit:]
@@ -583,7 +595,7 @@ def build_handler(router: Router):
                 # (QueueSaturated) carry a real 429 so clients and proxies
                 # treat them as retryable, never as success
                 http_status = e.http_status or 200
-                payload = response.error(e.code, str(e))
+                payload = response.error(e.code, str(e), data=e.data)
             except json.JSONDecodeError as e:
                 app_code = codes.BAD_REQUEST
                 payload = response.error(codes.BAD_REQUEST, f"invalid JSON: {e}")

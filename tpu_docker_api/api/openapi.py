@@ -91,7 +91,14 @@ _SCHEMAS: dict[str, dict] = {
          "env": _arr(_STR), "cmd": _arr(_STR),
          "numSlices": {**_INT, "description":
                        ">1 = multislice: chipCount splits into numSlices "
-                       "ICI slices stitched over DCN (MEGASCALE_* env)"}},
+                       "ICI slices stitched over DCN (MEGASCALE_* env)"},
+         "priorityClass": {**_STR, "description":
+                           "capacity-market class (default ladder: system > "
+                           "production > batch > preemptible; \"\" = the "
+                           "configured default). With admission enabled a "
+                           "full pool queues the job (phase \"queued\") "
+                           "instead of refusing, and higher classes may "
+                           "preempt strictly-lower ones"}},
         ["imageName", "jobName"]),
     "JobPatchChips": _obj({"chipCount": _INT, "acceleratorType": _STR}),
     "JobDelete": _obj({"force": _BOOL, "delStateAndVersionRecord": _BOOL}),
@@ -208,6 +215,12 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "503 + this holder as the redirect hint. With read_cache=informer the "
      "watch-fed read-cache state rides along (synced, lastRev, watchLagMs, "
      "event/relist/cache-hit counters)", None),
+    ("GET", "/api/v1/admission", "getAdmissionQueue",
+     "Capacity-market admission queue: depth, per-class counts, entry "
+     "positions/skip budgets, the configured priority ladder, and the "
+     "admission/preemption counters (the same books /metrics exports). "
+     "Queued jobs place automatically — backfilling holes, preempting "
+     "strictly-lower-priority gangs, defragmenting via migration", None),
     ("GET", "/api/v1/queue", "getQueueStats",
      "Durable work-queue view: in-memory depth, journal lifecycle counts "
      "(pending/inflight/dead), degradation events and counters", None),

@@ -16,7 +16,9 @@ def success(data: Any = None) -> bytes:
     ).encode()
 
 
-def error(code: int, msg: str = "") -> bytes:
+def error(code: int, msg: str = "", data: Any = None) -> bytes:
+    """``data`` defaults to None — the legacy error shape byte-for-byte;
+    typed errors may attach structured context (errors.ApiError.data)."""
     return json.dumps(
-        {"code": code, "msg": msg or codes.message(code), "data": None}
+        {"code": code, "msg": msg or codes.message(code), "data": data}
     ).encode()

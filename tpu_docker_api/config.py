@@ -137,6 +137,30 @@ class Config:
     # "read-through" keeps PR 7's per-read store re-seeding unconditionally.
     # Leader and single-process read behavior is identical either way.
     read_cache: str = "informer"
+    # capacity market (service/admission.py, docs/robustness.md "Capacity
+    # market"): when true, a POST /jobs that cannot place is parked in a
+    # durable admission queue (phase "queued") instead of hard-failing,
+    # higher-priority jobs may preempt strictly-lower-priority gangs, and
+    # queued work backfills holes. False (the default) keeps today's
+    # first-fit-or-refuse behavior byte-for-byte.
+    admission_enabled: bool = False
+    # admission-loop tick (a writer: leader-only under leader_election);
+    # 0 disables the loop — passes then run only via the reconciler's
+    # adoption and explicit admit_once() calls (test/bench hook)
+    admission_interval_s: float = 1.0
+    # starvation bound for EASY backfill: how many out-of-order admissions
+    # may overtake a blocked head-of-queue entry before the queue stalls
+    # behind it (the head then places before anything else moves)
+    admission_max_skips: int = 4
+    # the priority ladder: class name -> weight. Preemption is strictly
+    # lower-weight-only, so equal-weight classes never preempt each other.
+    # Weights resolve at decision time — retuning takes effect on the next
+    # admission pass without rewriting stored JobState.
+    priority_class_weights: dict = dataclasses.field(default_factory=lambda: {
+        "system": 1000, "production": 100, "batch": 10, "preemptible": 1,
+    })
+    # class assigned when POST /jobs carries no priorityClass
+    priority_class_default: str = "batch"
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container
@@ -170,4 +194,23 @@ def load(path: str | None = None) -> Config:
     if cfg.fanout_workers < 1:
         raise ValueError(
             f"fanout_workers must be >= 1, got {cfg.fanout_workers}")
+    if cfg.admission_max_skips < 0:
+        raise ValueError(
+            f"admission_max_skips must be >= 0, got {cfg.admission_max_skips}")
+    if (not isinstance(cfg.priority_class_weights, dict)
+            or not cfg.priority_class_weights):
+        raise ValueError("priority_class_weights must be a non-empty "
+                         "table of class -> integer weight")
+    for klass, weight in cfg.priority_class_weights.items():
+        if not isinstance(klass, str) or not klass:
+            raise ValueError(f"priority class names must be non-empty "
+                             f"strings, got {klass!r}")
+        if isinstance(weight, bool) or not isinstance(weight, int):
+            raise ValueError(f"priority_class_weights[{klass!r}] must be "
+                             f"an integer, got {weight!r}")
+    if cfg.priority_class_default not in cfg.priority_class_weights:
+        raise ValueError(
+            f"priority_class_default {cfg.priority_class_default!r} is not "
+            f"in priority_class_weights "
+            f"{sorted(cfg.priority_class_weights)}")
     return cfg

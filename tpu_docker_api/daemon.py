@@ -181,6 +181,24 @@ class Program:
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path, fanout=self.fanout,
         )
+        # capacity market (service/admission.py): constructed
+        # unconditionally — priority-class validation and submit-seq
+        # seniority stamping apply even without the market — while
+        # admission_enabled gates the policy itself (queue/preempt/
+        # backfill); disabled keeps the legacy hard refusal byte-for-byte
+        from tpu_docker_api.service.admission import AdmissionController
+
+        self.admission = AdmissionController(
+            self.job_svc, self.store, self.job_versions,
+            self.pod_scheduler, self.kv,
+            enabled=cfg.admission_enabled,
+            classes=cfg.priority_class_weights,
+            default_class=cfg.priority_class_default,
+            max_skips=cfg.admission_max_skips,
+            interval_s=cfg.admission_interval_s,
+            registry=self.metrics,
+        )
+        self.job_svc.admission = self.admission
         # engine-pool saturation gauges: one set of books summed over the
         # distinct engines behind this pod (the local runtime is shared by
         # several PodHost entries; BreakerRuntime/FaultyRuntime delegate
@@ -247,6 +265,10 @@ class Program:
             # family state
             work_queue=self.wq,
             fanout=self.fanout,
+            # admission-journal adoption (enabled deployments only): purge/
+            # settle/re-journal records after the family passes repaired
+            # any half-preempted gang
+            admission=self.admission if cfg.admission_enabled else None,
         )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
@@ -472,11 +494,18 @@ class Program:
             self.host_monitor.start()
         if self.health_watcher is not None:
             self.health_watcher.start()
+        if self.cfg.admission_enabled and self.cfg.admission_interval_s > 0:
+            # the admission loop mutates shared state (preemption, gang
+            # placement) — a writer like the supervisor, leader-only in
+            # an HA fleet
+            self.admission.start()
 
     def _stop_writers(self) -> None:
         """Halt the writer role (lease loss, shutdown). Every close is
         guarded and restartable: a later re-acquire calls _start_writers
         again on the same instances."""
+        if getattr(self, "admission", None) is not None:
+            self.admission.close()
         if getattr(self, "health_watcher", None) is not None:
             self.health_watcher.close()
         if getattr(self, "host_monitor", None) is not None:
@@ -508,6 +537,7 @@ class Program:
             leader_elector=self.leader_elector,
             informer=self.informer,
             fanout=self.fanout,
+            admission=self.admission,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)

@@ -20,6 +20,11 @@ class ApiError(Exception):
     #: intermediaries and clients must see queue saturation as a retryable
     #: transport-level condition (429), not a success
     http_status: int = 200
+    #: optional structured payload for the envelope's ``data`` field —
+    #: normally None (the legacy error shape, byte-for-byte); a raiser may
+    #: set it on the INSTANCE to attach machine-readable context (e.g. the
+    #: capacity market's ``{"queueable": false}`` on a ChipNotEnough)
+    data = None
 
     def __init__(self, msg: str = ""):
         super().__init__(msg or self.__class__.__doc__ or self.__class__.__name__)

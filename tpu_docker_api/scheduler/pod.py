@@ -348,6 +348,68 @@ class PodScheduler:
         with self._mu:
             return self._grants.get(owner)
 
+    def grants_view(self) -> dict[str, SliceAllocation]:
+        """Snapshot of every live grant — the victim-enumeration substrate
+        for the capacity market (service/admission.py): when
+        ``apply_slices`` refuses an ask, the admission controller walks
+        this map (owners resolve to job families via
+        ``keys.job_owner_base``) to find lower-priority gangs whose
+        release would make the ask placeable."""
+        with self._mu:
+            return dict(self._grants)
+
+    def fits(self, n_chips: int, num_slices: int = 1,
+             assume_freed: set[str] | None = None,
+             exclude_hosts: set[str] | None = None) -> bool:
+        """Non-mutating feasibility check: would ``apply_slices`` grant
+        this ask if the grants owned by ``assume_freed`` were released
+        first? Pure arithmetic under one lock hold — no claims, no
+        persists — so the admission controller can rank preemption
+        candidates without quiescing anything.
+
+        Count-based, deliberately conservative on the cheap side for
+        sub-host asks (the chip scheduler's scattered fallback makes any
+        per-host count satisfiable) and exact on fully-free-host counts
+        for multi-host asks; axis-aligned block shape feasibility is NOT
+        re-proven here, so a True can still lose to fragmentation at the
+        real ``apply_slices`` — callers must treat False as "do not
+        preempt for this" and True as "worth trying", never as a grant."""
+        if n_chips <= 0 or num_slices < 1 or n_chips % num_slices:
+            return False
+        per_slice = n_chips // num_slices
+        per_host = self.pod.chips_per_host
+        freed = assume_freed or set()
+        with self._mu:
+            banned = self._unschedulable_locked(exclude_hosts)
+            free: dict[str, int] = {}
+            for hid, h in self.pod.hosts.items():
+                if hid in banned:
+                    continue
+                free[hid] = len(h.chips.free_chips)
+            for owner, grant in self._grants.items():
+                if owner not in freed:
+                    continue
+                for hid, chips in grant.hosts:
+                    if hid in free:
+                        free[hid] += len(chips)
+        if per_slice < per_host or len(self.pod.hosts) == 1:
+            # sub-host slices: greedy tightest-fit packing over per-host
+            # free counts (mirrors _apply_sub_host_locked's ranking)
+            for _ in range(num_slices):
+                ranked = sorted((hid for hid in free
+                                 if free[hid] >= per_slice),
+                                key=lambda hid: (free[hid], hid))
+                if not ranked:
+                    return False
+                free[ranked[0]] -= per_slice
+            return True
+        if per_slice % per_host:
+            return False  # host-granular rule; apply_slices raises BadRequest
+        hosts_needed = (per_slice // per_host) * num_slices
+        fully_free = sum(1 for hid, n in free.items()
+                         if n == self.pod.hosts[hid].topology.n_chips)
+        return fully_free >= hosts_needed
+
     # -- allocation --------------------------------------------------------------
 
     def apply_slice(self, n_chips: int = 0, accelerator_type: str = "",

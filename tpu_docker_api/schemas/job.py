@@ -29,6 +29,11 @@ class JobRun:
     # >1 ⇒ multislice: chipCount splits into numSlices separate ICI slices
     # stitched over DCN with MEGASCALE_* env (workload/jaxenv.py)
     num_slices: int = 1
+    # capacity-market priority class (service/admission.py): one of the
+    # configured ``priority_class_weights`` names; "" ⇒ the configured
+    # default. Higher-weight jobs may preempt strictly-lower-weight gangs
+    # when the pool is full and admission is enabled
+    priority_class: str = ""
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "JobRun":
@@ -41,6 +46,7 @@ class JobRun:
             env=list(d.get("env", [])),
             cmd=list(d.get("cmd", [])),
             num_slices=errors.as_int(d.get("numSlices", 1), "numSlices"),
+            priority_class=d.get("priorityClass", ""),
         )
 
 
@@ -82,7 +88,23 @@ class JobDelete:
 #: re-apply excluding unhealthy hosts → start), charged to its own
 #: ``job_max_migrations`` budget. ``stopped`` is the user-requested
 #: quiesce (resources retained for resume).
-JOB_PHASES = ("running", "restarting", "migrating", "failed", "stopped")
+#:
+#: The capacity market (service/admission.py) adds two phases: ``queued``
+#: — admitted into the durable admission queue instead of hard-failing a
+#: full pool (no members exist yet, no resources held) — and
+#: ``preempted`` — the gang was quiesced and its slices/ports released to
+#: make room for a higher-priority job; it re-admits automatically, ahead
+#: of equal-priority queued jobs. Both are DORMANT: no member may run and
+#: the job owns zero slices/ports (invariants.py enforces it; supervisor
+#: and reconciler leave dormant members alone except to finish a
+#: half-quiesced preemption).
+JOB_PHASES = ("running", "restarting", "migrating", "failed", "stopped",
+              "queued", "preempted")
+
+#: phases with no runtime footprint: members must not run, and — except
+#: ``stopped``, which retains its grant for resume — the job owns nothing.
+#: Supervision, gang recovery and liveness classification all skip them.
+DORMANT_PHASES = ("failed", "stopped", "queued", "preempted")
 
 
 @dataclasses.dataclass
@@ -116,6 +138,18 @@ class JobState:
     migrations: int = 0
     # why the job went terminal (phase == "failed"), surfaced in the API
     failure_reason: str = ""
+    # capacity market (service/admission.py): the job's priority class
+    # name (weights resolve through config at decision time, so operators
+    # can retune without rewriting stored state)
+    priority_class: str = "batch"
+    # admission-order seniority: monotonically increasing submit sequence.
+    # Victim selection is lowest-priority-first then YOUNGEST-first
+    # (largest submitted_seq) — the paged.py seniority rule that makes
+    # preemption terminate (juniors can never displace seniors)
+    submitted_seq: int = 0
+    # times this job was preempted (observability; not a budget — a
+    # preempted job always re-admits when capacity returns)
+    preemptions: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -139,4 +173,7 @@ class JobState:
             restarts=int(d.get("restarts", 0)),
             migrations=int(d.get("migrations", 0)),
             failure_reason=d.get("failure_reason", ""),
+            priority_class=d.get("priority_class", "batch"),
+            submitted_seq=int(d.get("submitted_seq", 0)),
+            preemptions=int(d.get("preemptions", 0)),
         )

@@ -1,0 +1,808 @@
+"""Capacity market: priority classes, gang preemption, backfill admission.
+
+Admission used to be first-fit-or-refuse: a full pool hard-failed
+``POST /jobs`` with ``ChipNotEnough`` and scarce slices had no notion of
+who matters more. This subsystem turns capacity refusal into scheduling
+policy (ROADMAP item 4, the Borg/EASY shape):
+
+- **priority classes** — every job carries a ``priority_class`` (default
+  ladder ``system > production > batch > preemptible``; weights are config,
+  resolved at decision time so operators can retune live);
+- **a durable admission queue** — when a job cannot place and admission is
+  enabled, it is parked as phase ``queued``: a ``JobState`` with no members
+  plus an admission record under ``keys.ADMISSION_PREFIX``, written in ONE
+  atomic ``KV.apply`` so queued intent survives restarts and leader
+  failover (the PR 5 declarative-record pattern);
+- **preemption** — when a queued job outranks running gangs, victims are
+  selected strictly lowest-priority-first then youngest-first (the
+  ``infer/paged.py`` seniority rule: juniors can never displace seniors,
+  which is what makes preemption terminate), quiesced through the PR 3
+  gang stop path (workers first, coordinator last — checkpoint binds
+  intact), their claims released in one atomic batch (PR 6), and parked as
+  phase ``preempted`` for automatic re-admission ahead of equal-priority
+  queued work;
+- **backfill** — the queue drains out of strict precedence order only when
+  a job further back fits a hole the blocked head cannot use (EASY
+  backfill), bounded by ``admission_max_skips`` so the head always
+  eventually places (starvation bound);
+- **defragmentation** — when a whole-host gang cannot place but aggregate
+  capacity suffices, sub-host gangs are migrated off nearly-free hosts via
+  the PR 4 ``migrate_gang`` machinery (allocate-first, loud-fail — a live
+  gang is never released before its new placement exists) to compact
+  fragments.
+
+Every durable transition is bracketed by labeled crash points
+(``admission.enqueue`` / ``select_victims`` / ``preempt`` / ``readmit``)
+and the chaos matrix proves a daemon kill at any of them converges: one
+live version, zero leaks, the victim either fully preempted or fully
+running — never half-quiesced — and the journal replays exactly-once.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+
+from tpu_docker_api import errors
+from tpu_docker_api.schemas.job import JobState
+from tpu_docker_api.service.crashpoints import crash_point
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.keys import Resource, versioned_name
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
+
+log = logging.getLogger(__name__)
+
+#: the default priority ladder — weights are strictly ordered so "higher
+#: class" is unambiguous; config ``priority_class_weights`` replaces it
+DEFAULT_PRIORITY_CLASSES: dict[str, int] = {
+    "system": 1000, "production": 100, "batch": 10, "preemptible": 1,
+}
+DEFAULT_CLASS = "batch"
+#: how many backfill admissions may pass over a blocked head entry before
+#: the queue stalls behind it (config admission_max_skips)
+DEFAULT_MAX_SKIPS = 4
+
+#: admission_wait_ms histogram buckets (milliseconds)
+_WAIT_BUCKETS = (5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+                 30000, 60000)
+
+#: phases a gang must be in to be preemptible (an in-flight restart is
+#: still holding its grant; migrating gangs are left to finish first)
+_PREEMPTIBLE_PHASES = ("running", "restarting")
+
+
+class AdmissionRecord:
+    """One unit of queued intent — everything the NEXT daemon needs to
+    place this job: the family base (the spec itself lives on the queued/
+    preempted ``JobState``, resolved at admission time — the declarative-
+    record pattern), the priority class, the submit seq (precedence +
+    seniority), and the durable skip counter for the starvation bound."""
+
+    __slots__ = ("seq", "base", "kind", "klass", "skips", "ts", "accel")
+
+    def __init__(self, seq: int, base: str, kind: str, klass: str,
+                 skips: int = 0, ts: float = 0.0, accel: str = "") -> None:
+        self.seq = seq
+        self.base = base
+        self.kind = kind          # "queued" | "preempted"
+        self.klass = klass
+        self.skips = skips
+        self.ts = ts
+        self.accel = accel
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "seq": self.seq, "base": self.base, "kind": self.kind,
+            "class": self.klass, "skips": self.skips, "ts": self.ts,
+            "accel": self.accel,
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "AdmissionRecord":
+        d = json.loads(raw)
+        return cls(seq=int(d["seq"]), base=d["base"], kind=d["kind"],
+                   klass=d["class"], skips=int(d.get("skips", 0)),
+                   ts=float(d.get("ts", 0.0)), accel=d.get("accel", ""))
+
+    def key(self) -> str:
+        return keys.admission_record_key(self.seq)
+
+
+class AdmissionController:
+    """The admission loop + queue bookkeeping. Constructed unconditionally
+    by the daemon (class validation and seniority stamping are useful even
+    without the market); ``enabled`` gates the policy itself — when False,
+    capacity refusal keeps today's hard-fail byte-for-byte."""
+
+    def __init__(self, job_svc, store: StateStore, versions, slices, kv,
+                 enabled: bool = False,
+                 classes: dict[str, int] | None = None,
+                 default_class: str = DEFAULT_CLASS,
+                 max_skips: int = DEFAULT_MAX_SKIPS,
+                 interval_s: float = 1.0,
+                 registry: MetricsRegistry | None = None,
+                 max_events: int = 256) -> None:
+        self._svc = job_svc
+        self._store = store
+        self._versions = versions
+        self._slices = slices
+        self._kv = kv
+        self.enabled = enabled
+        self.classes = dict(classes) if classes else dict(
+            DEFAULT_PRIORITY_CLASSES)
+        self.default_class = default_class
+        self.max_skips = max_skips
+        self._interval = interval_s
+        self._registry = registry if registry is not None else REGISTRY
+        self._events: collections.deque = collections.deque(maxlen=max_events)
+        self._mu = threading.Lock()
+        #: serializes admission passes (the loop vs an inline test/route
+        #: trigger): two passes adopting the same record would double-place
+        self._pass_mu = threading.Lock()
+        #: submit sequence; None until the first journal scan (lazy, like
+        #: the work queue's, so a store outage degrades instead of failing
+        #: construction)
+        self._seq: int | None = None
+        #: anti-churn guard: head base → the grant-set snapshot a
+        #: preemption round was decided on that then FAILED to place the
+        #: head (the fits() heuristic lost to fragmentation). While the
+        #: grant set is unchanged, re-preempting would replay the exact
+        #: same futile eviction — victims re-admit, pool returns to this
+        #: snapshot, loop forever. Any real change (a placement, a
+        #: release, a delete) produces a new snapshot and re-arms.
+        self._preempt_futile: dict[str, frozenset] = {}
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- classes ------------------------------------------------------------------
+
+    def resolve_class(self, name: str) -> str:
+        """Validated class name ("" ⇒ the configured default)."""
+        pc = name or self.default_class
+        if pc not in self.classes:
+            raise errors.BadRequest(
+                f"unknown priorityClass {pc!r}: configured classes are "
+                f"{sorted(self.classes, key=self.classes.get, reverse=True)}")
+        return pc
+
+    def weight(self, name: str) -> int:
+        return self.classes.get(name, 0)
+
+    # -- seq / records ------------------------------------------------------------
+
+    def next_seq(self) -> int:
+        """Monotonic submit sequence — also stamped on immediately-placed
+        jobs, so victim selection's youngest-first rule has one total
+        order across queued and running work."""
+        with self._mu:
+            if self._seq is None:
+                top = -1
+                for k in self._kv.range_prefix(keys.ADMISSION_PREFIX):
+                    tail = k.rsplit("/", 1)[-1]
+                    if tail.isdigit():
+                        top = max(top, int(tail))
+                # running jobs carry their submit seq too — resume past it
+                for base in self._versions.snapshot():
+                    latest = self._versions.get(base)
+                    if latest is None:
+                        continue
+                    try:
+                        st = self._store.get_job(versioned_name(base, latest))
+                    except errors.NotExistInStore:
+                        continue
+                    top = max(top, st.submitted_seq)
+                self._seq = top + 1
+            out = self._seq
+            self._seq += 1
+            return out
+
+    def records(self) -> list[AdmissionRecord]:
+        out = []
+        for key, raw in sorted(
+                self._kv.range_prefix(keys.ADMISSION_PREFIX).items()):
+            try:
+                out.append(AdmissionRecord.from_json(raw))
+            except (ValueError, KeyError, TypeError):
+                log.warning("admission: unreadable record at %s", key)
+        return out
+
+    def _ordered(self, records: list[AdmissionRecord] | None = None
+                 ) -> list[AdmissionRecord]:
+        """Precedence order: class weight desc, preempted before queued
+        within a class (a preempted job already held capacity once — it
+        re-admits ahead of equal-priority newcomers), then submit order."""
+        if records is None:
+            records = self.records()
+        return sorted(records, key=lambda r: (
+            -self.weight(r.klass), 0 if r.kind == "preempted" else 1, r.seq))
+
+    def position(self, base: str) -> int | None:
+        """1-based queue position of a family, or None when not queued."""
+        for i, rec in enumerate(self._ordered()):
+            if rec.base == base:
+                return i + 1
+        return None
+
+    # -- enqueue (called by JobService.run_job under the family lock) -------------
+
+    def enqueue(self, base: str, req, want: int, priority_class: str) -> dict:
+        """Park a capacity-refused job as phase ``queued``: version 0
+        ``JobState`` (the spec, resolved at admission time) + the admission
+        record, ONE atomic apply — queued intent and the record can never
+        disagree, and both survive any crash after the commit."""
+        seq = self.next_seq()
+        version = self._versions.next_version(base)
+        st = JobState(
+            job_name=versioned_name(base, version), version=version,
+            image=req.image_name, cmd=list(req.cmd), env=list(req.env),
+            binds=list(req.binds), chip_count=want, coordinator_port=0,
+            placements=[], num_slices=req.num_slices, phase="queued",
+            priority_class=priority_class, submitted_seq=seq,
+        )
+        rec = AdmissionRecord(seq=seq, base=base, kind="queued",
+                              klass=priority_class, ts=time.time(),
+                              accel=req.accelerator_type)
+        try:
+            self._kv.apply(
+                StateStore._put_ops(Resource.JOBS, base, version,
+                                    st.to_dict())
+                + [("put", rec.key(), rec.to_json())])
+        except Exception:
+            # nothing durable landed (the apply is atomic): drop the
+            # version bump so the family does not exist half-made
+            self._versions.rollback(base, None)
+            raise
+        crash_point("admission.enqueue")
+        pos = self.position(base) or 1
+        self._record("job-queued", base, klass=priority_class, seq=seq,
+                     position=pos)
+        self._update_gauges()
+        self._wake.set()
+        log.info("admission: queued %s (%s, seq %d, position %d): pool "
+                 "full", base, priority_class, seq, pos)
+        return {
+            "name": st.job_name, "version": version, "image": st.image,
+            "chipCount": want, "coordinatorPort": 0, "desiredRunning": True,
+            "phase": "queued", "restarts": 0, "numSlices": st.num_slices,
+            "processes": [], "priorityClass": priority_class,
+            "queueable": True, "queuePosition": pos,
+        }
+
+    def discard(self, base: str) -> bool:
+        """Drop a family's admission record (stop dequeues, delete purges).
+        Caller holds the family lock; returns True when a record existed."""
+        doomed = [rec for rec in self.records() if rec.base == base]
+        for rec in doomed:
+            self._kv.delete(rec.key())
+            self._record("job-dequeued", base, klass=rec.klass, seq=rec.seq)
+        if doomed:
+            self._update_gauges()
+        return bool(doomed)
+
+    # -- the admission pass -------------------------------------------------------
+
+    def admit_once(self) -> list[dict]:
+        """One pass over the queue in precedence order:
+
+        1. every entry gets a plain placement attempt (holes are filled
+           without any preemption — backfill proven, not asserted);
+        2. the FIRST blocked entry (the effective head) may additionally
+           preempt strictly-lower-priority gangs, then defragment;
+        3. QUEUED entries admitted PAST a blocked one bump the blocked
+           entry's durable ``skips`` counter; once any blocked entry has
+           exhausted ``admission_max_skips``, queued work stops
+           overtaking it until it places (the starvation bound).
+
+        PREEMPTED records are exempt from the starvation gate on both
+        sides: re-admitting a victim restores capacity it already held —
+        that neither charges the head a skip nor may be stalled by it
+        (a max-skipped head that preempted victims it then failed to
+        place onto must never strand them dormant on idle capacity).
+        """
+        outcomes: list[dict] = []
+        with self._pass_mu:
+            blocked: list[AdmissionRecord] = []
+
+            def gated() -> bool:
+                return any(b.skips >= self.max_skips for b in blocked)
+
+            for rec in self._ordered():
+                if rec.kind != "preempted" and gated():
+                    # starvation bound: queued work stalls behind a
+                    # maximally-skipped head until it places
+                    continue
+                placed = self._try_admit(rec)
+                if placed is False and not blocked:
+                    # the effective head: preemption, then defragmentation
+                    snap = frozenset(self._slices.grants_view())
+                    if self._preempt_for(rec, snap):
+                        placed = self._try_admit(rec)
+                        if placed is False:
+                            # victims quiesced yet the head STILL lost to
+                            # the scheduler (fits() is a count heuristic):
+                            # remember the decision-time snapshot so the
+                            # identical state is never evicted for again
+                            self._preempt_futile[rec.base] = snap
+                    if placed is False and self._defragment_for(rec):
+                        placed = self._try_admit(rec)
+                if placed is None:
+                    continue  # stale record, settled — never 'blocked'
+                if placed:
+                    outcomes.append({"job": rec.base, "result": "placed",
+                                     "class": rec.klass})
+                    if blocked and rec.kind != "preempted":
+                        self._bump_skips(blocked)
+                else:
+                    blocked.append(rec)
+        if outcomes:
+            self._update_gauges()
+        return outcomes
+
+    def _try_admit(self, rec: AdmissionRecord) -> bool | None:
+        """Place one queued/preempted job if capacity allows. Returns True
+        (placed), False (no capacity), or None (record was stale and has
+        been settled). The spec is read from the stored ``JobState`` at
+        execution time, under the family lock."""
+        base = rec.base
+        with self._svc.family_lock(base):
+            latest = self._versions.get(base)
+            if latest is None:
+                # family deleted out from under the record
+                self._kv.delete(rec.key())
+                self._preempt_futile.pop(base, None)
+                return None
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                return None  # half-made version; the reconciler's case
+            if st.phase not in ("queued", "preempted"):
+                # already placed (a readmit-crash replay) or stopped/
+                # failed/deleted-keep-spec: settle the record exactly-once
+                self._kv.delete(rec.key())
+                self._preempt_futile.pop(base, None)
+                self._record("admission-record-settled", base,
+                             phase=st.phase, seq=rec.seq)
+                return None
+            carry = {
+                "priority_class": st.priority_class,
+                "submitted_seq": st.submitted_seq,
+                "restarts": st.restarts, "migrations": st.migrations,
+                "preemptions": st.preemptions,
+            }
+            try:
+                new_st = self._svc._run_version(
+                    base, st.image, st.cmd, st.env, st.binds, st.chip_count,
+                    rec.accel, num_slices=st.num_slices, carry=carry)
+            except (errors.ChipNotEnough, errors.PortNotEnough):
+                return False
+            crash_point("admission.readmit")
+            self._kv.delete(rec.key())
+            self._preempt_futile.pop(base, None)
+            wait_ms = max(0.0, (time.time() - rec.ts) * 1e3) if rec.ts else 0.0
+            self._registry.observe(
+                "admission_wait_ms", wait_ms, {"class": rec.klass},
+                buckets=_WAIT_BUCKETS,
+                help="Queue wait from enqueue/preemption to placement (ms)")
+            self._registry.counter_inc(
+                "admissions_total", {"class": rec.klass, "kind": rec.kind},
+                help="Queued/preempted jobs placed by the admission loop")
+            self._record("job-admitted", base, klass=rec.klass,
+                         via=rec.kind, version=new_st.version,
+                         wait_ms=round(wait_ms, 1), skips=rec.skips)
+            log.info("admission: placed %s (%s, %s) as %s after %.0f ms",
+                     base, rec.klass, rec.kind, new_st.job_name, wait_ms)
+            return True
+
+    def _bump_skips(self, blocked: list[AdmissionRecord]) -> None:
+        """A later entry was admitted past these blocked ones: charge each
+        of them one skip, durably — the starvation bound must survive a
+        daemon restart mid-backfill."""
+        for b in blocked:
+            b.skips += 1
+            try:
+                if self._kv.get_or(b.key()) is None:
+                    # settled/purged since this pass scanned it (a racing
+                    # delete_job): re-putting would resurrect a ghost
+                    continue
+                self._kv.put(b.key(), b.to_json())
+            except Exception as e:  # noqa: BLE001 — bookkeeping, not policy
+                log.warning("admission: skip bump for %s failed: %s",
+                            b.base, e)
+
+    # -- preemption ---------------------------------------------------------------
+
+    def _victims_for(self, weight: int, want: int, num_slices: int,
+                     requester: str) -> list[str]:
+        """Victim gangs whose release would (by the count heuristic) make
+        the ask placeable — the minimal prefix of the eligibility order:
+        strictly-lower priority only, lowest-priority first, then
+        YOUNGEST first (largest submitted_seq; the paged.py seniority rule
+        — juniors can never displace seniors, so preemption terminates),
+        base name as the deterministic tie-break. Empty ⇒ no feasible
+        combination (nothing is quiesced on a hunch)."""
+        eligible: list[tuple[int, int, str, JobState]] = []
+        for base in self._versions.snapshot():
+            if base == requester:
+                continue
+            latest = self._versions.get(base)
+            if latest is None:
+                continue
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                continue
+            w = self.weight(st.priority_class)
+            if (w < weight and st.desired_running
+                    and st.phase in _PREEMPTIBLE_PHASES):
+                eligible.append((w, -st.submitted_seq, base, st))
+        eligible.sort(key=lambda e: (e[0], e[1], e[2]))
+        chosen: list[str] = []
+        freed: set[str] = set()
+        for _, _, base, st in eligible:
+            chosen.append(base)
+            vname = versioned_name(base, st.version)
+            freed.add(vname)
+            freed.update(f"{vname}#s{k}" for k in range(st.num_slices))
+            if self._slices.fits(want, num_slices, assume_freed=freed):
+                return chosen
+        return []
+
+    def _preempt_for(self, rec: AdmissionRecord,
+                     snap: frozenset | None = None) -> bool:
+        """Select and preempt victims for a blocked entry. Returns True
+        when at least one victim was fully preempted (the caller retries
+        placement). ``snap`` is the caller's decision-time grant-set
+        snapshot: when it matches a round already proven futile for this
+        head, nothing is evicted again."""
+        if snap is not None and self._preempt_futile.get(rec.base) == snap:
+            return False
+        latest = self._versions.get(rec.base)
+        if latest is None:
+            return False
+        try:
+            st = self._store.get_job(versioned_name(rec.base, latest))
+        except errors.NotExistInStore:
+            return False
+        victims = self._victims_for(self.weight(rec.klass), st.chip_count,
+                                    st.num_slices, rec.base)
+        if not victims:
+            return False
+        preempted = 0
+        for victim in victims:
+            if self._preempt_one(victim, for_base=rec.base,
+                                 requester_weight=self.weight(rec.klass)):
+                preempted += 1
+        return preempted > 0
+
+    def _preempt_one(self, base: str, for_base: str,
+                     requester_weight: int) -> bool:
+        """Fully preempt one gang, crash-consistently:
+
+        1. re-validate under the victim's family lock (a user stop or a
+           priority retune that raced in wins — never condemn on a stale
+           snapshot);
+        2. ONE atomic apply: ``JobState`` phase → ``preempted`` + the
+           re-admission record — intent and record can never disagree;
+        3. quiesce through the gang stop path (workers first, coordinator
+           LAST; checkpoint binds intact, so re-admission resumes from the
+           step the victim flushed at);
+        4. release every slice and port in one atomic batch (PR 6 bulk
+           release).
+
+        A crash before step 2 leaves the victim fully running; after it,
+        the reconciler's dormant-phase repair finishes the quiesce and
+        release — never half-quiesced either way."""
+        with self._svc.family_lock(base):
+            latest = self._versions.get(base)
+            if latest is None:
+                return False
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                return False
+            if (not st.desired_running
+                    or st.phase not in _PREEMPTIBLE_PHASES
+                    or self.weight(st.priority_class) >= requester_weight):
+                return False
+            crash_point("admission.select_victims")
+            seq = self.next_seq()
+            parked = JobState.from_dict({
+                **st.to_dict(), "phase": "preempted",
+                "preemptions": st.preemptions + 1,
+            })
+            rec = AdmissionRecord(seq=seq, base=base, kind="preempted",
+                                  klass=st.priority_class, ts=time.time())
+            self._kv.apply(
+                StateStore._put_ops(Resource.JOBS, base, st.version,
+                                    parked.to_dict())
+                + [("put", rec.key(), rec.to_json())])
+            crash_point("admission.preempt")
+            self._svc._stop_members(st, reverse=True)
+            crash_point("admission.preempt")
+            self._svc._release_version_resources(st)
+            self._registry.counter_inc(
+                "preemptions_total", {"victim_class": st.priority_class},
+                help="Gangs preempted by higher-priority admissions")
+            self._record("job-preempted", base, klass=st.priority_class,
+                         for_job=for_base, seq=seq,
+                         preemptions=parked.preemptions)
+            log.info("admission: preempted %s (%s) for %s", base,
+                     st.priority_class, for_base)
+            return True
+
+    # -- defragmentation ----------------------------------------------------------
+
+    def _defragment_for(self, rec: AdmissionRecord) -> bool:
+        """Whole-host asks blocked by fragmentation, not scarcity: migrate
+        sub-host gangs off nearly-free hosts (fewest-used first) via
+        ``migrate_gang``'s allocate-first path — loud-fail, so a live gang
+        is never released before its new placement exists — until enough
+        fully-free hosts exist. Only gangs at-or-below the requester's
+        weight are moved, and a single failed migration aborts the pass
+        (the gang keeps running where it is)."""
+        latest = self._versions.get(rec.base)
+        if latest is None:
+            return False
+        try:
+            st = self._store.get_job(versioned_name(rec.base, latest))
+        except errors.NotExistInStore:
+            return False
+        per_host = self._svc.pod.chips_per_host
+        per_slice = st.chip_count // max(st.num_slices, 1)
+        if per_slice < per_host or len(self._svc.pod.hosts) == 1:
+            return False  # sub-host asks never need whole-host compaction
+        free_total = sum(len(h.chips.free_chips)
+                         for h in self._svc.pod.hosts.values())
+        if free_total < st.chip_count:
+            return False  # scarcity, not fragmentation
+        hosts_needed = (per_slice // per_host) * st.num_slices
+        weight = self.weight(rec.klass)
+        moved = False
+        for _ in range(hosts_needed):
+            fully_free = sum(
+                1 for h in self._svc.pod.hosts.values()
+                if len(h.chips.free_chips) == h.topology.n_chips)
+            if fully_free >= hosts_needed:
+                break
+            target = self._pick_defrag_host(weight)
+            if target is None:
+                break
+            for victim_base in self._host_gangs(target):
+                try:
+                    self._svc.migrate_gang(
+                        victim_base, exclude_hosts={target},
+                        reason=f"defragment for {rec.base}",
+                        count_migration=False, release_first_ok=False)
+                    moved = True
+                    self._record("job-defrag-migrated", victim_base,
+                                 host=target, for_job=rec.base)
+                except errors.ApiError as e:
+                    log.info("admission: defrag migration of %s off %s "
+                             "failed: %s", victim_base, target, e)
+                    return moved
+        return moved
+
+    def _pick_defrag_host(self, max_weight: int) -> str | None:
+        """The cheapest host to vacate: fewest used chips, every chip
+        owned by a migratable gang at-or-below the requester's weight."""
+        best: tuple[int, str] | None = None
+        for hid, host in sorted(self._svc.pod.hosts.items()):
+            used = host.topology.n_chips - len(host.chips.free_chips)
+            if used == 0 or used == host.topology.n_chips:
+                continue
+            gangs = self._host_gangs(hid)
+            if not gangs:
+                continue
+            movable = True
+            for base in gangs:
+                latest = self._versions.get(base)
+                if latest is None:
+                    movable = False
+                    break
+                try:
+                    st = self._store.get_job(versioned_name(base, latest))
+                except errors.NotExistInStore:
+                    movable = False
+                    break
+                if (st.phase not in _PREEMPTIBLE_PHASES
+                        or self.weight(st.priority_class) > max_weight
+                        or any(len(c) >= self._svc.pod.chips_per_host
+                               for h, c in self._iter_grant_hosts(st)
+                               if h == hid)):
+                    movable = False
+                    break
+            if movable and (best is None or used < best[0]):
+                best = (used, hid)
+        return best[1] if best else None
+
+    def _iter_grant_hosts(self, st: JobState):
+        vname = versioned_name(
+            keys.split_versioned_name(st.job_name)[0], st.version)
+        owners = ([vname] if st.num_slices == 1
+                  else [f"{vname}#s{k}" for k in range(st.num_slices)])
+        for owner in owners:
+            grant = self._slices.get_grant(owner)
+            if grant is not None:
+                yield from grant.hosts
+
+    def _host_gangs(self, host_id: str) -> list[str]:
+        """Job families holding a grant that touches ``host_id``."""
+        out = []
+        for owner, grant in sorted(self._slices.grants_view().items()):
+            if any(h == host_id for h, _ in grant.hosts):
+                base = keys.job_owner_base(owner)
+                if base not in out and self._versions.get(base) is not None:
+                    out.append(base)
+        return out
+
+    # -- reconciliation (journal adoption; driven by the reconciler) --------------
+
+    def reconcile_records(self, dry_run: bool = False) -> list[dict]:
+        """Exactly-once journal adoption after a crash or failover:
+
+        - a record whose family is gone is purged;
+        - a record whose job already left the queue (placed by a
+          readmit-crash run, stopped, failed) is settled — the replay
+          never double-places;
+        - a queued/preempted job that somehow lost its record (defensive:
+          the enqueue/preempt applies are atomic, so this means manual
+          store surgery) is re-journaled so it cannot be stranded.
+
+        Returns the actions (performed, or planned under ``dry_run``)."""
+        actions: list[dict] = []
+        seen_bases: set[str] = set()
+        for rec in self.records():
+            seen_bases.add(rec.base)
+            latest = self._versions.get(rec.base)
+            st = None
+            if latest is not None:
+                try:
+                    st = self._store.get_job(
+                        versioned_name(rec.base, latest))
+                except errors.NotExistInStore:
+                    st = None
+            if st is None:
+                actions.append({"action": "purge-admission-record",
+                                "target": rec.base, "seq": rec.seq})
+                if not dry_run:
+                    self._kv.delete(rec.key())
+                continue
+            if st.phase not in ("queued", "preempted"):
+                actions.append({"action": "settle-admission-record",
+                                "target": rec.base, "phase": st.phase,
+                                "seq": rec.seq})
+                if not dry_run:
+                    self._kv.delete(rec.key())
+        for base in self._versions.snapshot():
+            if base in seen_bases:
+                continue
+            latest = self._versions.get(base)
+            if latest is None:
+                continue
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                continue
+            if st.phase in ("queued", "preempted"):
+                actions.append({"action": "rejournal-admission-record",
+                                "target": base, "phase": st.phase})
+                if not dry_run:
+                    rec = AdmissionRecord(
+                        seq=self.next_seq(), base=base, kind=st.phase,
+                        klass=st.priority_class, ts=time.time())
+                    self._kv.put(rec.key(), rec.to_json())
+        if actions and not dry_run:
+            self._update_gauges()
+        return actions
+
+    # -- loop lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Launch the admission loop (a WRITER: under leader election it
+        runs on the lease holder only; restartable on re-acquire)."""
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="admission", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=self._interval + 5)
+            self._thread = None
+
+    def wake(self) -> None:
+        """Cut the interval short — a delete/stop/fail just freed capacity
+        the head of the queue may be waiting for."""
+        self._wake.set()
+
+    def _loop(self) -> None:
+        while True:
+            self._wake.wait(self._interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.admit_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("admission pass failed")
+
+    # -- views / telemetry --------------------------------------------------------
+
+    def _record(self, kind: str, job: str, **extra) -> None:
+        evt = {"ts": time.time(), "job": job, "event": kind, **extra}
+        with self._mu:
+            self._events.append(evt)
+
+    def events_view(self, limit: int = 100) -> list[dict]:
+        if limit <= 0:
+            return []
+        with self._mu:
+            return list(self._events)[-limit:]
+
+    def _update_gauges(self) -> None:
+        counts = {c: 0 for c in self.classes}
+        try:
+            for rec in self.records():
+                counts[rec.klass] = counts.get(rec.klass, 0) + 1
+        except Exception as e:  # noqa: BLE001 — telemetry must not raise
+            log.warning("admission: depth gauge refresh skipped: %s", e)
+            return
+        for klass, n in counts.items():
+            self._registry.gauge_set(
+                "admission_queue_depth", n, {"class": klass},
+                help="Jobs waiting in the admission queue, by class")
+
+    def status_view(self) -> dict:
+        """GET /api/v1/admission — the operator's queue view."""
+        ordered = self._ordered()
+        per_class: dict[str, int] = {c: 0 for c in self.classes}
+        now = time.time()
+        entries = []
+        for i, rec in enumerate(ordered):
+            per_class[rec.klass] = per_class.get(rec.klass, 0) + 1
+            entries.append({
+                "name": rec.base, "class": rec.klass, "state": rec.kind,
+                "position": i + 1, "skips": rec.skips,
+                "maxSkips": self.max_skips,
+                "waitingS": round(max(0.0, now - rec.ts), 1) if rec.ts else 0,
+            })
+        return {
+            "enabled": self.enabled,
+            "classes": dict(self.classes),
+            "defaultClass": self.default_class,
+            "maxSkips": self.max_skips,
+            "depth": len(ordered),
+            "perClass": per_class,
+            "entries": entries,
+            # one set of books: the same counters /metrics exports
+            "preemptionsTotal": self._preemptions_total(),
+            "admissionsTotal": self._admissions_total(),
+        }
+
+    def _preemptions_total(self) -> int:
+        return int(sum(self._registry.counter_value(
+            "preemptions_total", {"victim_class": c})
+            for c in self.classes))
+
+    def _admissions_total(self) -> int:
+        return int(sum(self._registry.counter_value(
+            "admissions_total", {"class": c, "kind": k})
+            for c in self.classes for k in ("queued", "preempted")))
+
+    def health_view(self) -> dict:
+        """Compact /healthz rider (registry read-back, never a store
+        scan failure surface)."""
+        try:
+            depth = len(self.records())
+        except Exception:  # noqa: BLE001
+            depth = -1  # store unreachable; liveness must still render
+        return {"enabled": self.enabled, "depth": depth,
+                "preemptionsTotal": self._preemptions_total(),
+                "admissionsTotal": self._admissions_total()}

@@ -109,9 +109,36 @@ FANOUT_CRASH_POINTS = (
     "fanout.mid_batch",
 )
 
+#: capacity-market admission lifecycle (service/admission.py): the chaos
+#: matrix kills the daemon at each of these mid-preemption and proves a
+#: fresh Program reconciles to one live version with zero leaks, the
+#: victim either fully preempted (queued for re-admission) or fully
+#: running — never half-quiesced — and the admission journal replays
+#: exactly-once
+ADMISSION_CRASH_POINTS = (
+    # the queued JobState + admission record are durable (ONE apply); the
+    # HTTP response was never sent — the record alone drives admission
+    "admission.enqueue",
+    # victims are chosen and re-validated under the victim's family lock;
+    # NOTHING durable has changed — a crash here leaves the victim fully
+    # running and the requester fully queued
+    "admission.select_victims",
+    # fires TWICE per victim (target with armed(..., skip=k)): skip=0 —
+    # the preempted-intent apply (JobState phase flip + re-admission
+    # record, atomic) is durable but the gang still runs; skip=1 — the
+    # gang is quiesced (workers first, coordinator last) but its slices
+    # and ports are not yet released
+    "admission.preempt",
+    # the queued/preempted job is PLACED (claims committed, gang created
+    # and started, JobState running) but its admission record is not yet
+    # deleted — replay must settle the record, never double-place
+    "admission.readmit",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
-                      + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS)
+                      + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS
+                      + ADMISSION_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

@@ -50,6 +50,7 @@ from tpu_docker_api.runtime.base import (
 )
 from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.schemas.job import DORMANT_PHASES
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -450,7 +451,11 @@ class HostMonitor:
                 st = self._job_svc.store.get_job(f"{base}-{latest}")
             except errors.NotExistInStore:
                 continue
-            if (st.desired_running and st.phase not in ("failed", "stopped")
+            # DORMANT covers queued/preempted too: a preempted gang keeps
+            # its stale placements but holds nothing on the host — a
+            # drain_gang record for it would only dead-letter (migrate
+            # rejects dormant phases); re-admission places post-cordon
+            if (st.desired_running and st.phase not in DORMANT_PHASES
                     and any(h == hid for h, *_ in st.placements)):
                 families.append(base)
         for base in families:

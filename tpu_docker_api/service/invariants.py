@@ -34,7 +34,7 @@ from tpu_docker_api.runtime.base import ContainerRuntime
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.ports import PortScheduler
 from tpu_docker_api.scheduler.slices import ChipScheduler
-from tpu_docker_api.schemas.job import JOB_PHASES
+from tpu_docker_api.schemas.job import DORMANT_PHASES, JOB_PHASES
 from tpu_docker_api.state.keys import (
     Resource,
     job_owner_base,
@@ -156,7 +156,9 @@ def check_job_invariants(
         if st.phase not in JOB_PHASES:
             problems.append(f"job {base}: unknown phase {st.phase!r}")
 
-        live = st.desired_running and st.phase not in ("failed", "stopped")
+        # queued/preempted are dormant like failed/stopped: no member may
+        # run (the capacity-market quiesce is complete or never started)
+        live = st.desired_running and st.phase not in DORMANT_PHASES
         member_running: dict[str, bool] = {}
         for host_id, cname, *_ in st.placements:
             host = pod.hosts.get(host_id)
@@ -223,13 +225,18 @@ def check_job_invariants(
         # latest version's grants/ports; retired versions own nothing
         held_slices = slice_owners.get(base, [])
         held_ports = port_owners.get(base, [])
-        if st.phase == "failed":
+        if st.phase in ("failed", "preempted", "queued"):
+            # failed is terminal; preempted was released to make room for
+            # a higher-priority gang; queued never claimed anything —
+            # all three must own ZERO slices and ports across every host
             if held_slices:
                 problems.append(
-                    f"job {base}: failed but owns slices {sorted(held_slices)}")
+                    f"job {base}: {st.phase} but owns slices "
+                    f"{sorted(held_slices)}")
             if held_ports:
                 problems.append(
-                    f"job {base}: failed but owns ports {sorted(held_ports)}")
+                    f"job {base}: {st.phase} but owns ports "
+                    f"{sorted(held_ports)}")
             continue
         expected_owners = {
             latest_name if st.num_slices == 1 else f"{latest_name}#s{k}"

@@ -95,6 +95,13 @@ class JobService:
         #: optional event hook (set by JobSupervisor): called with
         #: (kind, job_name, **detail) for gang lifecycle transitions
         self.event_sink = None
+        #: capacity market (service/admission.py), wired by the daemon.
+        #: When set, priority classes validate against the configured
+        #: ladder and — if ``admission.enabled`` — a capacity-refused
+        #: POST /jobs parks as phase "queued" instead of hard-failing.
+        #: None keeps the legacy refusal byte-for-byte (and validates
+        #: classes against the default ladder)
+        self.admission = None
 
     # -- helpers -----------------------------------------------------------------
 
@@ -408,6 +415,35 @@ class JobService:
 
     # -- flows -------------------------------------------------------------------
 
+    def _resolve_priority(self, name: str) -> str:
+        """Validated priority class ("" ⇒ default). With the admission
+        controller wired the configured ladder rules; without it the
+        default ladder still validates, so priorityClass is never a
+        silently-accepted typo."""
+        if self.admission is not None:
+            return self.admission.resolve_class(name)
+        from tpu_docker_api.service.admission import (
+            DEFAULT_CLASS,
+            DEFAULT_PRIORITY_CLASSES,
+        )
+
+        pc = name or DEFAULT_CLASS
+        if pc not in DEFAULT_PRIORITY_CLASSES:
+            raise errors.BadRequest(
+                f"unknown priorityClass {pc!r}: known classes are "
+                f"{sorted(DEFAULT_PRIORITY_CLASSES)}")
+        return pc
+
+    def _requested_chips(self, req: JobRun) -> int:
+        if req.accelerator_type:
+            from tpu_docker_api.scheduler.topology import (
+                parse_accelerator_type,
+            )
+
+            _, want = parse_accelerator_type(req.accelerator_type)
+            return want
+        return req.chip_count
+
     def run_job(self, req: JobRun) -> dict:
         base = req.job_name
         if not base or not BASE_NAME_RE.match(base):
@@ -420,14 +456,31 @@ class JobService:
             raise errors.BadRequest("chipCount or acceleratorType required")
         if req.num_slices < 1:
             raise errors.BadRequest("numSlices must be >= 1")
+        priority = self._resolve_priority(req.priority_class)
+        seq = self.admission.next_seq() if self.admission is not None else 0
         with self._locks.hold(base):
             if self.versions.contains(base):
                 raise errors.ContainerExisted(f"job {base}")
-            st = self._run_version(
-                base, req.image_name, req.cmd, req.env, req.binds,
-                req.chip_count, req.accelerator_type,
-                num_slices=req.num_slices,
-            )
+            try:
+                st = self._run_version(
+                    base, req.image_name, req.cmd, req.env, req.binds,
+                    req.chip_count, req.accelerator_type,
+                    num_slices=req.num_slices,
+                    carry={"priority_class": priority,
+                           "submitted_seq": seq},
+                )
+            except (errors.ChipNotEnough, errors.PortNotEnough) as e:
+                if self.admission is None or not self.admission.enabled:
+                    # legacy first-fit-or-refuse, byte-for-byte
+                    raise
+                want = self._requested_chips(req)
+                if want > self.pod.n_chips:
+                    # can NEVER place, even on an empty pool: queueing it
+                    # would park it forever — hard-fail, flagged so the
+                    # caller knows the market declined it on principle
+                    e.data = {"queueable": False}
+                    raise
+                return self.admission.enqueue(base, req, want, priority)
             log.info("run job %s: %d chips over %d hosts (%d slices)",
                      st.job_name, st.chip_count, len(st.placements),
                      st.num_slices)
@@ -453,6 +506,11 @@ class JobService:
         with self._locks.hold(base):
             base, _, latest_name = self._resolve_latest(name)
             old = self.store.get_job(latest_name)
+            if old.phase in ("queued", "preempted"):
+                raise errors.BadRequest(
+                    f"job {base} is {old.phase} (admission queue); it has "
+                    "no running gang to rescale — stop or delete it, or "
+                    "wait for admission")
             want = req.chip_count
             if req.accelerator_type:
                 from tpu_docker_api.scheduler.topology import parse_accelerator_type
@@ -489,13 +547,20 @@ class JobService:
                 self.store.put_job(JobState.from_dict(old.to_dict()))
                 self._start_members(old)
 
+            # identity travels with the family across versions: priority
+            # class and seniority (and the budgets) must survive a rescale
+            carry = {"priority_class": old.priority_class,
+                     "submitted_seq": old.submitted_seq,
+                     "preemptions": old.preemptions,
+                     "restarts": old.restarts,
+                     "migrations": old.migrations}
             try:
                 # fast path: reserve new capacity first, containers created
                 # but NOT started while the old version still runs
                 st = self._run_version(
                     base, old.image, old.cmd, old.env, old.binds,
                     want, req.accelerator_type, start_now=False,
-                    num_slices=old.num_slices,
+                    num_slices=old.num_slices, carry=carry,
                 )
                 try:
                     _quiesce_old()
@@ -519,14 +584,14 @@ class JobService:
                     st = self._run_version(
                         base, old.image, old.cmd, old.env, old.binds,
                         want, req.accelerator_type,
-                        num_slices=old.num_slices,
+                        num_slices=old.num_slices, carry=carry,
                     )
                 except Exception:
                     log.exception("rescale of %s failed; re-launching old shape",
                                   base)
                     self._run_version(base, old.image, old.cmd, old.env,
                                       old.binds, old.chip_count,
-                                      num_slices=old.num_slices)
+                                      num_slices=old.num_slices, carry=carry)
                     raise
             log.info("rescaled job %s: %d → %d chips (%s)", base,
                      old.chip_count, st.chip_count, st.job_name)
@@ -537,11 +602,21 @@ class JobService:
         with self._locks.hold(base):
             st = self.store.get_job(latest_name)
             # gang quiesce: workers drain first, the coordinator last, so
-            # collective peers never outlive their rendezvous point
+            # collective peers never outlive their rendezvous point (a
+            # queued job has no members — the batch is empty — and a
+            # preempted one is already quiesced; both still settle as
+            # "stopped" below, which is what DEQUEUES them)
             self._stop_members(st, reverse=True)
             self.store.put_job(JobState.from_dict(
                 {**st.to_dict(), "desired_running": False, "phase": "stopped"}
             ))
+            if self.admission is not None and self.admission.enabled:
+                # stop dequeues: a deliberately stopped job must not be
+                # admitted (or re-admitted) behind the operator's back.
+                # (Gated on enabled: the legacy deployment must not pay a
+                # journal scan per stop on a queue that cannot exist.)
+                self.admission.discard(base)
+                self.admission.wake()
             self._emit("job-stopped", st.job_name)
 
     def restart_job(self, name: str) -> dict:
@@ -558,6 +633,26 @@ class JobService:
                 raise errors.BadRequest(
                     f"job {base} is failed ({st.failure_reason or 'crash loop'});"
                     " its slices and ports were freed — delete and re-run it")
+            if st.phase in ("queued", "preempted"):
+                raise errors.BadRequest(
+                    f"job {base} is {st.phase} (admission queue); it starts "
+                    "automatically when capacity allows — stop or delete "
+                    "to cancel")
+            # a stopped job normally RETAINS its grant for exactly this
+            # resume — but one stopped out of queued/preempted owns
+            # nothing (the market released it), and starting its old
+            # members would double-bind chips the scheduler may have
+            # granted elsewhere
+            if not st.placements:
+                raise errors.BadRequest(
+                    f"job {base} was never placed (stopped while queued); "
+                    "delete and re-run it")
+            owners = ([latest_name] if st.num_slices == 1 else
+                      [f"{latest_name}#s{k}" for k in range(st.num_slices)])
+            if any(self.slices.get_grant(o) is None for o in owners):
+                raise errors.BadRequest(
+                    f"job {base} no longer holds its slice grant (it was "
+                    "preempted before stopping); delete and re-run it")
             # validate every placement host BEFORE stopping anything: a
             # stale placement must not take a healthy gang down halfway
             for host_id, cname, *_ in st.placements:
@@ -597,6 +692,11 @@ class JobService:
                 # that still names the dead host
                 raise errors.BadRequest(
                     f"job {base} is migrating off unhealthy hosts")
+            if st.phase in ("queued", "preempted"):
+                # dormant: no gang exists (or it is already quiesced and
+                # released) — the admission loop owns the next transition
+                raise errors.BadRequest(
+                    f"job {base} is {st.phase}; admission re-places it")
             if not st.desired_running:
                 # callers decide to recover on a pre-lock snapshot; a user
                 # stop that raced in wins — crash recovery must not revive
@@ -675,6 +775,10 @@ class JobService:
             if old.phase == "failed":
                 raise errors.BadRequest(
                     f"job {base} is failed: {old.failure_reason}")
+            if old.phase in ("queued", "preempted"):
+                raise errors.BadRequest(
+                    f"job {base} is {old.phase}; it holds no placement "
+                    "to migrate")
             if not old.desired_running:
                 raise errors.BadRequest(f"job {base} is stopped")
             finishing = old.phase == "migrating"
@@ -696,7 +800,10 @@ class JobService:
                 })
                 self.store.put_job(old)
             crash_point("job.migrate.after_mark")
-            carry = {"restarts": old.restarts, "migrations": old.migrations}
+            carry = {"restarts": old.restarts, "migrations": old.migrations,
+                     "priority_class": old.priority_class,
+                     "submitted_seq": old.submitted_seq,
+                     "preemptions": old.preemptions}
             released = False
             try:
                 # fast path: new slice + created-not-started containers
@@ -776,10 +883,13 @@ class JobService:
             if (only_if_migrations_ge is not None
                     and st.migrations < only_if_migrations_ge):
                 return st
-            if not st.desired_running or st.phase == "failed":
+            if not st.desired_running or st.phase in ("failed", "queued",
+                                                      "preempted"):
                 # a user stop / delete(keep-spec) that raced in wins: the
                 # caller's lock-free verdict is stale, and a deliberately
-                # stopped job must not be condemned as failed
+                # stopped job must not be condemned as failed — nor may a
+                # queued/preempted job, whose members are supposed to be
+                # absent (that is the admission queue, not a crash)
                 return st
             self._stop_members(st, reverse=True)
             self._release_job_resources(base)
@@ -787,6 +897,8 @@ class JobService:
                                      "desired_running": False,
                                      "failure_reason": reason})
             self.store.put_job(st)
+            if self.admission is not None and self.admission.enabled:
+                self.admission.wake()  # the freed slices may admit the queue head
             self._emit("job-failed", st.job_name, reason=reason)
             log.warning("job %s failed: %s", st.job_name, reason)
             return st
@@ -868,6 +980,12 @@ class JobService:
     def delete_job(self, name: str, req: JobDelete) -> None:
         base, _, latest_name = self._resolve_latest(name)
         with self._locks.hold(base):
+            if self.admission is not None and self.admission.enabled:
+                # delete purges the admission record FIRST — a concurrent
+                # admission pass must not place a job whose family is
+                # being torn down (the pass re-validates under this same
+                # family lock, so record-gone ⇒ it settles and moves on)
+                self.admission.discard(base)
             history = self.store.history(Resource.JOBS, base)
             release_txn = StoreTxn(self.store.kv)
             for version in history:
@@ -896,6 +1014,8 @@ class JobService:
                          "phase": "stopped"}))
                 except errors.NotExistInStore:
                     pass
+            if self.admission is not None and self.admission.enabled:
+                self.admission.wake()  # freed capacity may admit the queue head
             log.info("deleted job %s (%d versions)", base, len(history))
 
     def get_job_info(self, name: str) -> dict:
@@ -995,12 +1115,20 @@ class JobService:
                 for host_id, cname, pid, chips, tpu_port in st.placements
             ],
         }
+        out["priorityClass"] = st.priority_class
         if st.failure_reason:
             out["failureReason"] = st.failure_reason
         if st.megascale_port:
             out["megascalePort"] = st.megascale_port
         if st.migrations:
             out["migrations"] = st.migrations
+        if st.preemptions:
+            out["preemptions"] = st.preemptions
+        if st.phase in ("queued", "preempted") and self.admission is not None:
+            base, _ = split_versioned_name(st.job_name)
+            pos = self.admission.position(base)
+            if pos is not None:
+                out["queuePosition"] = pos
         if live:
             for proc in out["processes"]:
                 host = self.pod.hosts.get(proc["hostId"])

@@ -47,6 +47,7 @@ import time
 
 from tpu_docker_api import errors
 from tpu_docker_api.runtime.fanout import SERIAL, Fanout
+from tpu_docker_api.schemas.job import DORMANT_PHASES
 from tpu_docker_api.state.keys import split_versioned_name, versioned_name
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 from tpu_docker_api.utils.backoff import backoff_delay_s
@@ -211,7 +212,11 @@ class JobSupervisor:
             st = self._store.get_job(latest_name)
         except errors.NotExistInStore:
             return  # half-created version; the reconciler's jurisdiction
-        if not st.desired_running or st.phase in ("failed", "stopped"):
+        if not st.desired_running or st.phase in DORMANT_PHASES:
+            # dormant covers queued/preempted too: a queued job has no
+            # members to supervise, and a preempted gang's stopped members
+            # are the admission controller's doing — restarting them would
+            # undo the preemption and double-bind the freed capacity
             self._note_obs(base, [], [])
             return
         dead, missing, crashed, unreachable = self._member_liveness(st)
@@ -468,6 +473,7 @@ class JobSupervisor:
             out[base] = {
                 "version": latest,
                 "phase": st.phase,
+                "priorityClass": st.priority_class,
                 "desiredRunning": st.desired_running,
                 "restarts": st.restarts,
                 "maxRestarts": self._max_restarts,

@@ -48,6 +48,7 @@ from tpu_docker_api import errors
 from tpu_docker_api.runtime.base import ContainerRuntime
 from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.schemas.job import DORMANT_PHASES
 from tpu_docker_api.scheduler.ports import PortScheduler
 from tpu_docker_api.scheduler.slices import ChipScheduler
 from tpu_docker_api.state.keys import (
@@ -85,6 +86,7 @@ class Reconciler:
         max_events: int = 512,
         work_queue=None,
         fanout: Fanout | None = None,
+        admission=None,
     ) -> None:
         self.runtime = runtime
         #: runtime fan-out: the gang member scans, stale-version sweeps
@@ -120,6 +122,11 @@ class Reconciler:
         #: "migrating"): first sight finishes without counting, repeats
         #: count so a never-satisfiable migration converges to failed
         self._mig_adopted: set[str] = set()
+        #: capacity-market admission controller (service/admission.py):
+        #: the sweep adopts its journal — purging records whose family is
+        #: gone, settling records whose job already placed (the
+        #: readmit-crash exactly-once), re-journaling stranded intent
+        self._admission = admission
         self._registry = registry if registry is not None else REGISTRY
         self._mu = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=max_events)
@@ -178,6 +185,18 @@ class Reconciler:
                     # abort the sweep (SimulatedCrash, a BaseException,
                     # still propagates — that is the chaos harness's kill)
                     log.exception("job reconcile of %s failed", base)
+        if self._admission is not None:
+            # admission-journal adoption AFTER the family passes: a
+            # half-preempted victim is fully quiesced and released first,
+            # so record settlement judges the post-repair world
+            try:
+                for a in self._admission.reconcile_records(dry_run=dry_run):
+                    a = dict(a)
+                    self._act(actions, dry_run, a.pop("action"),
+                              a.pop("target"), **a)
+            except Exception as e:  # noqa: BLE001 — a store outage must
+                # not abort the sweep; records are re-read next pass
+                log.warning("reconcile: admission adoption failed: %s", e)
         self._sweep_foreign_owners(actions, dry_run)
 
         report = {
@@ -584,8 +603,8 @@ class Reconciler:
                               reason="reconcile adoption",
                               count_migration=not finishing))
                 return
-            if unreachable and st.desired_running and st.phase not in (
-                    "failed", "stopped"):
+            if unreachable and st.desired_running and (
+                    st.phase not in DORMANT_PHASES):
                 # members behind a dead engine: their liveness is
                 # unknowable from here. Down-vs-blip is the monitor's
                 # verdict and migration is the supervisor's repair — the
@@ -605,7 +624,7 @@ class Reconciler:
                         "hosts": sorted(unreachable)})
                 return
 
-            if st.desired_running and st.phase not in ("failed", "stopped"):
+            if st.desired_running and st.phase not in DORMANT_PHASES:
                 missing = [c for _, c, i in members if i is None]
                 dead = [c for _, c, i in members if i is not None
                         and i != "unreachable" and not i.running]
@@ -669,12 +688,19 @@ class Reconciler:
                            if i is not None and i != "unreachable"
                            and i.running]
                 if running:
+                    # for a preempted gang this is the daemon-died-between-
+                    # intent-and-quiesce repair: finish the gang-ordered
+                    # stop the admission controller never got to run
                     self._act(actions, dry_run, "stop-undesired-job-members",
                               latest_name, members=running,
                               fn=lambda: self._job_svc._stop_members(
                                   st, reverse=True))
-                if st.phase == "failed":
-                    self._job_resource_release(base, actions, dry_run)
+                if st.phase in ("failed", "preempted", "queued"):
+                    # failed AND preempted/queued jobs own nothing — the
+                    # preemption's release (or the never-placed queue
+                    # entry's absence of claims) must hold after any crash
+                    self._job_resource_release(base, actions, dry_run,
+                                               phase=st.phase)
 
             # stale older versions: a completed (or crashed-after-start)
             # rescale leaves the old gang quiesced — it must hold nothing
@@ -768,9 +794,11 @@ class Reconciler:
                 svc.slices.restore_slice(owner)
 
     def _job_resource_release(self, base: str, actions: list[dict],
-                              dry_run: bool) -> None:
-        """A terminal ``failed`` job owns nothing — free whatever any of its
-        versions still holds (owner-guarded; no-op when already clean)."""
+                              dry_run: bool, phase: str = "failed") -> None:
+        """A terminal ``failed`` job — and a ``preempted``/``queued`` one
+        (the capacity market's whole point is that their claims are
+        free) — owns nothing: release whatever any of its versions still
+        holds (owner-guarded; no-op when already clean)."""
         svc = self._job_svc
         held = [o for o in svc.slices.status()["slices"]
                 if job_owner_base(o) == base]
@@ -779,7 +807,9 @@ class Reconciler:
             for host in svc.pod.hosts.values()
             for o in host.ports.status()["owners"].values())
         if held or held_ports:
-            self._act(actions, dry_run, "release-failed-job-resources", base,
+            action = ("release-failed-job-resources" if phase == "failed"
+                      else "release-preempted-job-resources")
+            self._act(actions, dry_run, action, base,
                       slices=held,
                       fn=lambda: svc._release_job_resources(base))
 

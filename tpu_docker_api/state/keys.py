@@ -123,6 +123,21 @@ QUEUE_TASKS_PREFIX = f"{PREFIX}/queue/tasks/"
 QUEUE_MARKERS_PREFIX = f"{PREFIX}/queue/markers/"
 
 
+# -- durable admission queue (service/admission.py) ----------------------------
+#: capacity-market admission records: one JSON record per job waiting for
+#: capacity (state "queued") or parked after a preemption (state
+#: "preempted"), keyed by a zero-padded submit sequence so a prefix scan
+#: yields submit order. Written atomically WITH the job's ``JobState``
+#: phase flip (one KV.apply), so queued/preempted intent and the admission
+#: record can never disagree; deleted when the job places (or is stopped/
+#: deleted), so queued intent survives restarts and leader failover
+ADMISSION_PREFIX = f"{PREFIX}/admission/"
+
+
+def admission_record_key(seq: int) -> str:
+    return f"{ADMISSION_PREFIX}{seq:012d}"
+
+
 def queue_task_key(seq: int) -> str:
     return f"{QUEUE_TASKS_PREFIX}{seq:012d}"
 
