@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout bench-preempt openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout bench-preempt bench-serve-scale openapi sample-interface run clean
 
 all: native openapi
 
@@ -66,6 +66,11 @@ bench-preempt:               ## capacity-market family: fill with preemptible ga
 	$(PY) bench.py --control-plane --cp-family preempt > bench-preempt.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-preempt.json.tmp
 	mv bench-preempt.json.tmp bench-preempt.json
+
+bench-serve-scale:           ## service autoscaling family: offered-load step -> time-to-scaled, SLO recovery, scale-up-through-admission + zero-manual-ops gates
+	$(PY) bench.py --control-plane --cp-family serve-scale > bench-serve-scale.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-serve-scale.json.tmp
+	mv bench-serve-scale.json.tmp bench-serve-scale.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

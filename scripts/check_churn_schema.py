@@ -160,6 +160,60 @@ def validate_preempt(extra: dict) -> list[str]:
     return problems
 
 
+def validate_serve_scale(extra: dict) -> list[str]:
+    """The service-autoscaling family headline payload: time-to-scaled
+    quantiles over offered-load steps and a passing gate. The
+    time-to-scaled budget, admitted-via-queue and zero-manual-ops gates
+    are re-checked here (not just gates.ok): an autoscaler that bypassed
+    the admission market, leaned on manual operations, or blew the
+    scaling budget must fail loudly at the schema layer too."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    steps = it.get("steps")
+    if not (isinstance(steps, int) and steps >= 1):
+        problems.append(f"serve-scale: iters.steps must be an int >= 1, "
+                        f"got {steps!r}")
+    tts = extra.get("time_to_scaled_ms") or {}
+    for q in QUANTS:
+        if not _num(tts.get(q)) or tts[q] <= 0:
+            problems.append(f"serve-scale: time_to_scaled_ms.{q} must be "
+                            f"a positive number, got {tts.get(q)!r}")
+    series = extra.get("scaled_ms")
+    if (not isinstance(series, list)
+            or (isinstance(steps, int) and len(series) != steps)
+            or not all(_num(v) and v > 0 for v in series)):
+        problems.append("serve-scale: scaled_ms must list one positive "
+                        "time-to-scaled per offered-load step")
+    gates = extra.get("gates") or {}
+    for key in ("reached_target", "slo_recovered", "time_to_scaled_p50_ms",
+                "time_to_scaled_budget_ms", "admitted_via_queue",
+                "zero_manual_ops", "scale_down_converged",
+                "batch_preempted", "ok"):
+        if key not in gates:
+            problems.append(f"serve-scale: gates.{key} missing")
+    p50 = gates.get("time_to_scaled_p50_ms")
+    budget = gates.get("time_to_scaled_budget_ms")
+    if _num(p50) and _num(budget) and p50 > budget:
+        problems.append(f"serve-scale: time-to-scaled p50 {p50}ms blew the "
+                        f"{budget}ms budget")
+    via_queue = gates.get("admitted_via_queue")
+    if not (isinstance(via_queue, int) and via_queue >= 1):
+        problems.append(f"serve-scale: admitted_via_queue must be an int "
+                        f">= 1, got {via_queue!r} (no scale-up replica "
+                        f"entered through the admission journal — the "
+                        f"market path is unproven)")
+    if gates.get("zero_manual_ops") is not True:
+        problems.append(f"serve-scale: manual operations were issued "
+                        f"({gates.get('manual_ops')!r}) — the autoscaler "
+                        f"did not do this alone")
+    if gates.get("slo_recovered") is not True:
+        problems.append("serve-scale: the SLO never recovered after the "
+                        "offered-load step")
+    if gates.get("ok") is not True:
+        problems.append(f"serve-scale: regression gate failed: {gates}")
+    return problems
+
+
 FANOUT_FLOWS = ("create", "stop", "delete")
 
 
@@ -244,11 +298,15 @@ def validate_lines(lines: list[dict]) -> list[str]:
                if (ln.get("extra") or {}).get("family") == "preempt"]
     if preempt:
         return problems + validate_preempt(preempt[0]["extra"])
+    serve = [ln for ln in lines
+             if (ln.get("extra") or {}).get("family") == "serve-scale"]
+    if serve:
+        return problems + validate_serve_scale(serve[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn, failover, reads, fanout or preempt "
-                           "headline line (extra.family)"]
+        return problems + ["no churn, failover, reads, fanout, preempt or "
+                           "serve-scale headline line (extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

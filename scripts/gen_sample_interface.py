@@ -35,6 +35,9 @@ def emit(s: str = "") -> None:
 def main() -> None:
     cfg = Config(port=0, runtime_backend="fake", accelerator_type="v5p-8",
                  start_port=40000, end_port=40099, health_watch_interval=0,
+                 # no background autoscaler ticks: captured service payloads
+                 # must not depend on loop timing
+                 autoscale_interval_s=0,
                  pod_hosts=[
                      {"host_id": "me", "address": "10.0.0.1",
                       "grid_coord": [0, 0, 0], "local": True},
@@ -166,6 +169,31 @@ def main() -> None:
          "megascale port publishes on slice 0's first container.")
     call("DELETE", "/api/v1/jobs/multi",
          {"force": True, "delStateAndVersionRecord": True})
+    emit("## Services (declarative replicated serving)")
+    emit()
+    call("POST", "/api/v1/services",
+         {"serviceName": "llm", "imageName": "serve:tpu",
+          "chipsPerReplica": 4, "replicas": 2, "minReplicas": 1,
+          "maxReplicas": 4, "ttftP95TargetMs": 200, "queueDepthTarget": 4},
+         "Two replica gangs (`llm.r0`, `llm.r1`), each a distributed job "
+         "admitted at class `production` — so a traffic-driven scale-up "
+         "outranks `batch` training in the capacity market. The SLO-driven "
+         "autoscaler owns the replica count from here.")
+    call("POST", "/api/v1/services/llm/load", {"rps": 150},
+         "Synthetic traffic for fake-runtime replicas (bench/test load "
+         "generators); real replicas report TTFT/queue signals on their "
+         "`metricsPath` instead.")
+    call("GET", "/api/v1/services/llm", None,
+         "The scaling audit: per-replica phase (queued replicas show their "
+         "admission-queue position), SLO targets + last observed signals, "
+         "and the last autoscale decision with its reason.")
+    call("PATCH", "/api/v1/services/llm", {"replicas": 3},
+         "Manual scale — applied immediately and counted (the bench's "
+         "zero-manual-ops gate reads this counter); the autoscaler keeps "
+         "ruling afterwards.")
+    call("DELETE", "/api/v1/services/llm", None,
+         "Tears down every replica gang (workers-first quiesce, one-batch "
+         "release) and drops the family — no orphan fleet.")
     emit("## Resources & observability")
     emit()
     call("GET", "/api/v1/resources/tpus", None,

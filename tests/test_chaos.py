@@ -124,10 +124,14 @@ def test_case_matrix_covers_every_crash_point():
     # the admission matrix kills the daemon at every capacity-market
     # lifecycle point (admission.preempt fires twice: via skip=0/1)
     assert {p for p, _ in ADMISSION_CASES} == set(ADMISSION_CRASH_POINTS)
+    # the service matrix (tests/test_service.py TestServiceChaos) kills
+    # the daemon at every service.* lifecycle point
+    from tpu_docker_api.service.crashpoints import SERVICE_CRASH_POINTS
+
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
             | set(LEADER_CRASH_POINTS) | set(FANOUT_CRASH_POINTS)
-            | set(ADMISSION_CRASH_POINTS)
+            | set(ADMISSION_CRASH_POINTS) | set(SERVICE_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
 

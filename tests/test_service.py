@@ -1,0 +1,547 @@
+"""Service-resource tests (docs/robustness.md "Service & autoscaler").
+
+Property tier, pinned:
+
+- a Service owns exactly replica gang families ``0..replicas-1``, each a
+  real distributed job admitted at the service's priority class (default
+  ``production``) — a traffic-driven scale-up enters the capacity market
+  and may preempt strictly-lower classes (``batch`` training);
+- scale-down quiesces workers-first (coordinator strictly last) before
+  releasing the replica's slices and ports;
+- cooldowns + the hysteresis watermark keep an oscillating signal from
+  flapping the fleet;
+- delete tears down every replica (no orphan fleet); replica gangs whose
+  owning service is gone are garbage-collected marker-verified (a user
+  job that merely LOOKS replica-shaped is never touched);
+- the real signal path scrapes a replica-reported HTTP endpoint (the
+  paged engine's SLO export shape);
+- chaos matrix: a daemon kill at every ``service.*`` crash point
+  converges — after reboot + reconcile, exactly one fully-owned replica
+  set, zero leaks, fixpoint.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.schemas.job import JobRun
+from tpu_docker_api.schemas.service import (
+    SERVICE_OWNER_ENV,
+    ServiceCreate,
+    ServicePatch,
+)
+from tpu_docker_api.service.crashpoints import (
+    SERVICE_CRASH_POINTS,
+    SimulatedCrash,
+    armed,
+)
+from tpu_docker_api.service.invariants import (
+    check_invariants,
+    check_job_invariants,
+    check_service_invariants,
+)
+from tpu_docker_api.service.serving import replica_base, split_replica_base
+from tpu_docker_api.state.keys import Resource
+from tpu_docker_api.state.kv import MemoryKV
+
+
+def boot(n_hosts: int = 1, kv=None, runtimes=None, **scale_cfg) -> Program:
+    """A Program over a fake pod with inline-driven loops (admission +
+    autoscale intervals 0, zero cooldowns unless overridden)."""
+    kv = kv if kv is not None else MemoryKV()
+    runtimes = runtimes or {f"h{i}": FakeRuntime() for i in range(n_hosts)}
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        admission_enabled=True, admission_interval_s=0,
+        autoscale_interval_s=0,
+        autoscale_up_cooldown_s=scale_cfg.pop("up_cooldown", 0),
+        autoscale_down_cooldown_s=scale_cfg.pop("down_cooldown", 0),
+        autoscale_down_watermark=scale_cfg.pop("watermark", 0.5),
+        pod_hosts=[] if n_hosts == 1 else [
+            {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+             "grid_coord": [i, 0, 0],
+             **({"local": True} if i == 0 else {"runtime_backend": "fake"})}
+            for i in range(n_hosts)
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=runtimes["h0"],
+                  pod_runtimes={h: r for h, r in runtimes.items()
+                                if h != "h0"})
+    prg.init()
+    return prg
+
+
+def create(prg, name="web", chips=2, replicas=1, max_replicas=3, **kw):
+    return prg.serving.create_service(ServiceCreate(
+        service_name=name, image_name="serve", chips_per_replica=chips,
+        replicas=replicas, max_replicas=max_replicas, **kw))
+
+
+def oracle(prg) -> list[str]:
+    problems = check_service_invariants(
+        prg.store, prg.service_versions, prg.job_versions)
+    problems += check_job_invariants(
+        prg.pod, prg.pod_scheduler, prg.store, prg.job_versions)
+    problems += check_invariants(
+        prg.runtime, prg.store, prg.container_versions,
+        prg.chip_scheduler, prg.port_scheduler,
+        job_versions=prg.job_versions)
+    return problems
+
+
+def job_phase(prg, base):
+    return prg.store.get_job(
+        f"{base}-{prg.job_versions.get(base)}").phase
+
+
+class TestNaming:
+    def test_replica_base_round_trips(self):
+        assert replica_base("web", 2) == "web.r2"
+        assert split_replica_base("web.r2") == ("web", 2)
+        assert split_replica_base("a.b.r10") == ("a.b", 10)
+        assert split_replica_base("web") is None
+        assert split_replica_base("web.rx") is None
+        assert split_replica_base(".r1") is None
+
+
+class TestServiceLifecycle:
+    def test_create_owns_exactly_n_replica_gangs(self):
+        prg = boot()
+        out = create(prg, replicas=2)
+        assert out["phase"] == "active"
+        assert out["readyReplicas"] == 2
+        assert [r["family"] for r in out["replicaStatus"]] == [
+            "web.r0", "web.r1"]
+        # each replica is a REAL job at the service's class, marker-owned
+        st = prg.store.get_job("web.r0-0")
+        assert st.priority_class == "production"
+        assert f"{SERVICE_OWNER_ENV}=web" in st.env
+        assert oracle(prg) == []
+
+    def test_duplicate_and_bad_requests_reject(self):
+        prg = boot()
+        create(prg)
+        with pytest.raises(errors.ServiceExisted):
+            create(prg)
+        with pytest.raises(errors.BadRequest):
+            create(prg, name="bad", replicas=9)  # outside [min, max]
+        with pytest.raises(errors.BadRequest):
+            prg.serving.create_service(ServiceCreate(
+                service_name="x", image_name="serve"))  # no chips
+        with pytest.raises(errors.ServiceNotExist):
+            prg.serving.service_info("ghost")
+
+    def test_delete_tears_down_all_replicas(self):
+        prg = boot()
+        create(prg, replicas=3, max_replicas=3)
+        assert len(prg.job_versions.snapshot()) == 3
+        prg.serving.delete_service("web")
+        assert prg.service_versions.snapshot() == {}
+        assert prg.job_versions.snapshot() == {}
+        assert prg.pod_scheduler.status()["slices"] == {}
+        assert oracle(prg) == []
+        kinds = [e["event"] for e in prg.serving.events_view()]
+        assert "service-created" in kinds and "service-deleted" in kinds
+
+    def test_manual_scale_is_counted_and_audited(self):
+        prg = boot()
+        create(prg, replicas=1)
+        out = prg.serving.patch_service("web", ServicePatch(replicas=3))
+        assert out["replicas"] == 3 and out["readyReplicas"] == 3
+        assert out["manualScaleTotal"] == 1
+        assert out["lastScale"]["trigger"] == "manual"
+        assert out["lastScale"]["from"] == 1 and out["lastScale"]["to"] == 3
+        with pytest.raises(errors.BadRequest):
+            prg.serving.patch_service("web", ServicePatch(replicas=9))
+        assert oracle(prg) == []
+
+    def test_rolling_spec_update_rolls_every_replica(self):
+        prg = boot()
+        create(prg, replicas=2)
+        out = prg.serving.patch_service(
+            "web", ServicePatch(image_name="serve:v2"))
+        assert out["version"] == 1 and out["image"] == "serve:v2"
+        for rb in ("web.r0", "web.r1"):
+            st = prg.store.get_job(f"{rb}-{prg.job_versions.get(rb)}")
+            assert st.image == "serve:v2"
+            assert st.phase == "running"
+        assert oracle(prg) == []
+
+    def test_orphan_replica_gangs_gc_marker_verified(self):
+        prg = boot()
+        create(prg, replicas=2)
+        # a user job that merely LOOKS replica-shaped (no marker env)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="user.r1",
+                                   chip_count=2))
+        # out-of-band surgery: the service family vanishes, the fleet stays
+        prg.store.delete_family(Resource.SERVICES, "web")
+        prg.service_versions.remove("web")
+        report = prg.reconciler.reconcile()
+        gcd = [a for a in report["actions"]
+               if a["action"] == "gc-orphan-replica"]
+        assert {a["target"] for a in gcd} == {"web.r0", "web.r1"}
+        assert "web.r0" not in prg.job_versions.snapshot()
+        # the lookalike user job is untouched
+        assert job_phase(prg, "user.r1") == "running"
+        assert oracle(prg) == []
+
+
+class TestAutoscalePolicy:
+    def test_scale_up_admits_at_service_class_and_preempts(self):
+        """The tentpole scenario: a traffic burst scales the service up
+        THROUGH the admission market, preempting strictly-lower-class
+        batch training for the last replica."""
+        prg = boot()
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=4, priority_class="batch"))
+        create(prg, replicas=1, max_replicas=3)
+        prg.serving.set_offered_load("web", 280)  # wants ceil(1*2.8) = 3
+        prg.serving.tick()
+        info = prg.serving.service_info("web")
+        assert info["replicas"] == 3
+        assert info["lastScale"]["trigger"] == "autoscale"
+        # r1 filled the free hole; r2 had to queue — the admission pass
+        # preempts the batch gang for it (production > batch)
+        assert job_phase(prg, "web.r2") == "queued"
+        assert prg.admission.admit_once()
+        assert job_phase(prg, "web.r2") == "running"
+        assert job_phase(prg, "train") == "preempted"
+        assert prg.store.get_job("web.r2-1").priority_class == "production"
+        assert oracle(prg) == []
+        # burst over: scale-down releases capacity and training re-admits
+        prg.serving.set_offered_load("web", 10)
+        prg.serving.tick()
+        assert prg.serving.service_info("web")["replicas"] == 1
+        assert prg.admission.admit_once()
+        assert job_phase(prg, "train") == "running"
+        assert oracle(prg) == []
+
+    def test_scale_up_never_preempts_equal_or_higher(self):
+        prg = boot()
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="prod",
+                                   chip_count=6,
+                                   priority_class="production"))
+        create(prg, replicas=1, max_replicas=2)
+        prg.serving.set_offered_load("web", 150)
+        prg.serving.tick()
+        assert prg.serving.service_info("web")["replicas"] == 2
+        # no strictly-lower victim exists: the replica stays queued
+        prg.admission.admit_once()
+        assert job_phase(prg, "web.r1") == "queued"
+        assert job_phase(prg, "prod") == "running"
+
+    def test_scale_down_quiesces_workers_first(self):
+        """The surplus replica is a 2-host gang: its teardown must stop
+        the worker BEFORE the coordinator (the PR 3 gang quiesce), then
+        delete and release."""
+        rt0, rt1 = FakeRuntime(), FakeRuntime()
+        prg = boot(n_hosts=2, runtimes={"h0": rt0, "h1": rt1})
+        create(prg, chips=16, replicas=1, max_replicas=1,
+               min_replicas=0)
+        stops: list[str] = []
+        for host in prg.pod.hosts.values():
+            orig = host.runtime.container_stop
+
+            def spy(name, *a, _orig=orig, **kw):
+                stops.append(name)
+                return _orig(name, *a, **kw)
+
+            host.runtime.container_stop = spy
+        prg.serving.patch_service("web", ServicePatch(replicas=0))
+        assert stops == ["web.r0-0-p1", "web.r0-0-p0"], stops
+        assert prg.job_versions.snapshot() == {}
+        assert prg.pod_scheduler.status()["slices"] == {}
+        assert oracle(prg) == []
+
+    def test_oscillating_signal_never_flaps(self):
+        """Cooldowns + the hysteresis watermark: a signal oscillating
+        around the target changes nothing; only a SUSTAINED idle past the
+        down cooldown sheds a replica."""
+        prg = boot(up_cooldown=5.0, down_cooldown=10.0, watermark=0.5)
+        now = [0.0]
+        prg.serving._clock = lambda: now[0]
+        create(prg, replicas=1, max_replicas=3)
+        prg.serving.set_offered_load("web", 150)  # breach: scale 1 → 2
+        prg.serving.tick()
+        assert prg.serving.service_info("web")["replicas"] == 2
+
+        for t in range(1, 10):
+            now[0] = float(t)
+            # oscillate around the target: 150 rps / 2 replicas = 0.75 of
+            # target (dead zone), 90 rps / 2 = 0.45 (below watermark, but
+            # inside the down cooldown)
+            prg.serving.set_offered_load("web", 150 if t % 2 else 90)
+            prg.serving.tick()
+            assert prg.serving.service_info("web")["replicas"] == 2, (
+                f"flapped at t={t}")
+        info = prg.serving.service_info("web")
+        assert info["autoscaleTotal"] == 1  # exactly the initial scale-up
+
+        # sustained idle PAST the cooldown: one clean scale-down
+        now[0] = 20.0
+        prg.serving.set_offered_load("web", 40)
+        prg.serving.tick()
+        info = prg.serving.service_info("web")
+        assert info["replicas"] == 1
+        assert info["autoscaleTotal"] == 2
+        assert oracle(prg) == []
+
+    def test_scale_from_zero_recovers(self):
+        """A service at minReplicas=0 must come back when traffic does —
+        zero ready replicas is a breach when load is offered, not a
+        signal blackout."""
+        prg = boot()
+        create(prg, replicas=1, min_replicas=0, max_replicas=2)
+        prg.serving.set_offered_load("web", 0)
+        prg.serving.tick()
+        assert prg.serving.service_info("web")["replicas"] == 0
+        assert prg.job_versions.snapshot() == {}
+        prg.serving.set_offered_load("web", 150)
+        prg.serving.tick()
+        info = prg.serving.service_info("web")
+        assert info["replicas"] >= 1 and info["readyReplicas"] >= 1
+        assert oracle(prg) == []
+
+    def test_patch_rejects_nonpositive_targets_and_nan_load(self):
+        prg = boot()
+        create(prg)
+        with pytest.raises(errors.BadRequest):
+            prg.serving.patch_service(
+                "web", ServicePatch(queue_depth_target=0))
+        with pytest.raises(errors.BadRequest):
+            prg.serving.patch_service(
+                "web", ServicePatch(ttft_p95_target_ms=-1.0))
+        with pytest.raises(errors.BadRequest):
+            prg.serving.set_offered_load("web", float("nan"))
+        with pytest.raises(errors.BadRequest):
+            prg.serving.set_offered_load("web", float("inf"))
+        # DTO layer: malformed floats are 400s, never 500s; NaN rejected
+        with pytest.raises(errors.BadRequest):
+            ServiceCreate.from_dict({"serviceName": "x", "imageName": "i",
+                                     "ttftP95TargetMs": "200ms"})
+        with pytest.raises(errors.BadRequest):
+            ServiceCreate.from_dict({"serviceName": "x", "imageName": "i",
+                                     "replicaCapacityRps": float("nan")})
+
+    def test_min_max_retune_clamp_is_audited_as_manual(self):
+        prg = boot()
+        create(prg, replicas=3, max_replicas=3)
+        out = prg.serving.patch_service("web", ServicePatch(max_replicas=1))
+        assert out["replicas"] == 1
+        assert out["lastScale"]["trigger"] == "manual"
+        assert out["manualScaleTotal"] == 1
+        assert sorted(prg.job_versions.snapshot()) == ["web.r0"]
+        assert oracle(prg) == []
+
+    def test_no_signal_means_no_action(self):
+        prg = boot()
+        create(prg, replicas=2)
+        prg.serving.tick()  # no offered load, no metrics path
+        assert prg.serving.service_info("web")["replicas"] == 2
+        assert prg.serving.service_info("web")["lastScale"] is None
+
+    def test_http_scrape_drives_scale_up(self):
+        """The real signal path: the autoscaler scrapes the replica's
+        reported SLO endpoint (the paged engine's export shape) on the
+        coordinator port."""
+        prg = boot()
+        create(prg, replicas=1, max_replicas=2, metrics_path="/slo")
+        jst = prg.store.get_job("web.r0-0")
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = json.dumps({"ttftP95Ms": 900.0, "itlP95Ms": 42.0,
+                                   "queueDepth": 1}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = HTTPServer(("127.0.0.1", jst.coordinator_port), Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            prg.serving.tick()
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+        info = prg.serving.service_info("web")
+        assert info["replicas"] == 2
+        assert info["slo"]["lastObserved"]["ttftP95Ms"] == 900.0
+        assert "slo breach" in info["lastScale"]["reason"]
+
+
+class TestFailedReplicaHealing:
+    def test_failed_replica_is_replaced(self):
+        prg = boot()
+        create(prg, replicas=2)
+        prg.job_svc.fail_job("web.r1", "crash loop (test)")
+        assert job_phase(prg, "web.r1") == "failed"
+        prg.serving.tick()
+        assert job_phase(prg, "web.r1") == "running"
+        assert oracle(prg) == []
+
+
+@pytest.mark.chaos
+class TestServiceChaos:
+    """Kill the daemon at every service.* crash point; a fresh Program
+    over the same store + engines must reconcile to exactly one
+    fully-owned replica set (families 0..replicas-1, nothing beyond),
+    zero leaked chips/ports, and a fixpoint second sweep."""
+
+    def _drive(self, prg, point):
+        if point == "service.create.after_record":
+            create(prg, replicas=2)
+        elif point == "service.scale_up.after_mark":
+            create(prg, replicas=1)
+            prg.serving.set_offered_load("web", 250)  # wants 3
+            prg.serving.tick()
+        elif point in ("service.scale_down.after_mark",
+                       "service.scale_down.after_quiesce"):
+            create(prg, replicas=2)
+            prg.serving.patch_service("web", ServicePatch(replicas=1))
+        elif point == "service.roll.after_version":
+            create(prg, replicas=2)
+            prg.serving.patch_service(
+                "web", ServicePatch(image_name="serve:v2"))
+        elif point == "service.delete.after_mark":
+            create(prg, replicas=2)
+            prg.serving.delete_service("web")
+        else:  # pragma: no cover — keep the matrix exhaustive
+            raise AssertionError(f"unmapped crash point {point}")
+
+    @pytest.mark.parametrize("point", SERVICE_CRASH_POINTS)
+    def test_crash_converges_to_one_owned_replica_set(self, point):
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        prg = boot(kv=kv, runtimes={"h0": rt})
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                self._drive(prg, point)
+
+        # the daemon is dead; a fresh control plane boots over same state
+        prg2 = boot(kv=kv, runtimes={"h0": rt})
+        prg2.reconciler.reconcile()
+        # drain any admission records the repair enqueued (full pool case)
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        problems = oracle(prg2)
+        assert problems == [], f"{point}: {problems}"
+
+        if point == "service.delete.after_mark":
+            # teardown intent was durable: the sweep finished it
+            assert prg2.service_versions.snapshot() == {}
+            assert prg2.job_versions.snapshot() == {}
+            assert prg2.pod_scheduler.status()["slices"] == {}
+        else:
+            info = prg2.serving.service_info("web")
+            want = info["replicas"]
+            fams = sorted(prg2.job_versions.snapshot())
+            assert fams == [f"web.r{i}" for i in range(want)], (
+                f"{point}: fleet {fams} vs want {want}")
+            assert info["readyReplicas"] == want
+            if point == "service.roll.after_version":
+                # the new spec version won: every replica rolled forward
+                for rb in fams:
+                    assert prg2.store.get_job(
+                        f"{rb}-{prg2.job_versions.get(rb)}"
+                    ).image == "serve:v2"
+
+        # the repair is a fixpoint
+        assert prg2.reconciler.reconcile()["actions"] == [], point
+
+    def test_scale_up_crash_with_full_pool_queues_through_market(self):
+        """The scale_up.after_mark kill with a batch gang holding the
+        capacity: the NEXT daemon's reconcile submits the missing replica
+        through the admission queue, which preempts the batch gang."""
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        prg = boot(kv=kv, runtimes={"h0": rt})
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=4, priority_class="batch"))
+        create(prg, replicas=1, max_replicas=3)
+        prg.serving.set_offered_load("web", 280)
+        with armed("service.scale_up.after_mark"):
+            with pytest.raises(SimulatedCrash):
+                prg.serving.tick()
+
+        prg2 = boot(kv=kv, runtimes={"h0": rt})
+        prg2.reconciler.reconcile()
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        assert prg2.serving.service_info("web")["readyReplicas"] == 3
+        assert job_phase(prg2, "train") == "preempted"
+        assert oracle(prg2) == []
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+
+class TestConfigValidation:
+    def test_load_validates_service_keys(self, tmp_path):
+        good = tmp_path / "good.toml"
+        good.write_text('service_default_class = "system"\n'
+                        "autoscale_down_watermark = 0.8\n")
+        assert config_mod.load(str(good)).service_default_class == "system"
+        for bad in ('service_default_class = "gold"\n',
+                    "autoscale_down_watermark = 1.5\n",
+                    "autoscale_down_watermark = 0.0\n",
+                    "autoscale_interval_s = -1\n",
+                    "autoscale_up_cooldown_s = -1\n"):
+            p = tmp_path / "bad.toml"
+            p.write_text(bad)
+            with pytest.raises(ValueError):
+                config_mod.load(str(p))
+
+
+class TestHttpSurface:
+    def test_service_routes_and_events(self):
+        import urllib.request
+
+        prg = boot()
+        prg.start()
+        port = prg.api_server.port
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+
+        try:
+            out = call("POST", "/api/v1/services", {
+                "serviceName": "llm", "imageName": "serve",
+                "chipsPerReplica": 2, "replicas": 1, "maxReplicas": 2})
+            assert out["code"] == 200
+            assert out["data"]["priorityClass"] == "production"
+            assert call("GET", "/api/v1/services")["data"][0]["name"] == \
+                "llm-0"
+            out = call("POST", "/api/v1/services/llm/load", {"rps": 50})
+            assert out["data"]["offeredRps"] == 50.0
+            out = call("PATCH", "/api/v1/services/llm", {"replicas": 2})
+            assert out["data"]["replicas"] == 2
+            assert out["data"]["manualScaleTotal"] == 1
+            info = call("GET", "/api/v1/services/llm")["data"]
+            assert info["lastScale"]["trigger"] == "manual"
+            events = call("GET", "/api/v1/events?limit=100")["data"]
+            kinds = {e.get("event") for e in events}
+            assert {"service-created", "service-scaled"} <= kinds
+            assert call("DELETE", "/api/v1/services/llm")["code"] == 200
+            events = call("GET", "/api/v1/events?limit=100")["data"]
+            assert "service-deleted" in {e.get("event") for e in events}
+            out = call("GET", "/api/v1/services/llm")
+            assert out["code"] == errors.ServiceNotExist.code
+        finally:
+            prg.stop()

@@ -29,6 +29,12 @@ Route table:
     PATCH  /api/v1/volumes/{name}/size         resize
     GET    /api/v1/volumes/{name}/history      stored version history
     PATCH  /api/v1/volumes/{name}/rollback     roll to an older version's size
+    POST   /api/v1/services                    create a replicated service
+    GET    /api/v1/services                    list services
+    GET    /api/v1/services/{name}             replica fleet + last autoscale decision
+    PATCH  /api/v1/services/{name}             manual scale / policy / spec roll
+    DELETE /api/v1/services/{name}             tear down every replica
+    POST   /api/v1/services/{name}/load        synthetic offered-load injection
     GET    /api/v1/resources/tpus              chip scheduler view (alias: /gpus)
     GET    /api/v1/resources/ports             port scheduler view
     POST   /api/v1/hosts/{name}/cordon         no new placements on the host
@@ -143,7 +149,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  job_svc=None, pod_scheduler=None, reconciler=None,
                  job_supervisor=None, host_monitor=None,
                  leader_elector=None, informer=None, fanout=None,
-                 admission=None) -> Router:
+                 admission=None, serving=None) -> Router:
     r = Router(metrics=metrics)
     # HA role gate (service/leader.py): on a standby replica every non-GET
     # request is answered 503 + the leader hint BEFORE dispatch — reads
@@ -343,6 +349,50 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         # preemption/admission counters (the same books /metrics exports)
         r.add("GET", "/api/v1/admission",
               lambda body, **_: admission.status_view())
+
+    # -- Services (declarative replicated serving, service/serving.py) ------------
+
+    if serving is not None:
+        from tpu_docker_api.schemas.service import ServiceCreate, ServicePatch
+
+        def s_create(body, **_):
+            req = ServiceCreate.from_dict(body)
+            _validate_base_name(req.service_name)
+            return serving.create_service(req)
+
+        def s_info(body, name):
+            _validate_ref_name(name)
+            return serving.service_info(name)
+
+        def s_patch(body, name):
+            _validate_ref_name(name)
+            return serving.patch_service(name, ServicePatch.from_dict(body))
+
+        def s_delete(body, name):
+            _validate_ref_name(name)
+            serving.delete_service(name)
+            return None
+
+        def s_load(body, name):
+            # synthetic traffic injection (fake-runtime replicas): the
+            # load generator states offered rps; the autoscaler's next
+            # tick synthesizes per-replica SLO signals from it
+            _validate_ref_name(name)
+            if "rps" not in body:
+                raise errors.BadRequest("rps is required")
+            try:
+                rps = float(body["rps"])
+            except (TypeError, ValueError):
+                raise errors.BadRequest("rps must be a number") from None
+            return serving.set_offered_load(name, rps)
+
+        r.add("POST", "/api/v1/services", s_create)
+        r.add("GET", "/api/v1/services",
+              lambda body, **_: serving.list_services())
+        r.add("GET", "/api/v1/services/{name}", s_info)
+        r.add("PATCH", "/api/v1/services/{name}", s_patch)
+        r.add("DELETE", "/api/v1/services/{name}", s_delete)
+        r.add("POST", "/api/v1/services/{name}/load", s_load)
     if pod_scheduler is not None:
         r.add("GET", "/api/v1/resources/slices",
               lambda body, **_: pod_scheduler.status())
@@ -425,7 +475,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/api/v1/leader", leader_view)
     if (health_watcher is not None or job_supervisor is not None
             or host_monitor is not None or leader_elector is not None
-            or informer is not None or admission is not None):
+            or informer is not None or admission is not None
+            or serving is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
         # supervisor), host health transitions (host monitor), leadership
@@ -446,7 +497,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             rings = [src.events_view(limit=limit)
                      for src in (health_watcher, job_supervisor,
                                  host_monitor, leader_elector, informer,
-                                 admission)
+                                 admission, serving)
                      if src is not None]
             merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
             return list(merged)[-limit:]

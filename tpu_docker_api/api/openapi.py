@@ -102,6 +102,52 @@ _SCHEMAS: dict[str, dict] = {
         ["imageName", "jobName"]),
     "JobPatchChips": _obj({"chipCount": _INT, "acceleratorType": _STR}),
     "JobDelete": _obj({"force": _BOOL, "delStateAndVersionRecord": _BOOL}),
+    "ServiceCreate": _obj(
+        {"serviceName": {**_STR, "description": "base name, [a-zA-Z0-9_.]+"},
+         "imageName": _STR,
+         "chipsPerReplica": {**_INT, "description":
+                             "chips per replica gang (each replica is a "
+                             "distributed job)"},
+         "acceleratorType": {**_STR, "description":
+                             "alternative per-replica ask, e.g. \"v5e-8\""},
+         "replicas": {**_INT, "description": "initial replica count"},
+         "minReplicas": _INT, "maxReplicas": _INT,
+         "priorityClass": {**_STR, "description":
+                           "capacity-market class for every replica gang "
+                           "(default production — traffic-driven scale-ups "
+                           "may preempt batch/preemptible training)"},
+         "binds": _arr({**_STR, "description": "\"src:dest\""}),
+         "env": _arr(_STR), "cmd": _arr(_STR),
+         "ttftP95TargetMs": {"type": "number", "description":
+                             "SLO: scale up when worst replica TTFT p95 "
+                             "exceeds this"},
+         "queueDepthTarget": {**_INT, "description":
+                              "SLO: scale up when worst replica queue "
+                              "depth exceeds this"},
+         "replicaCapacityRps": {"type": "number", "description":
+                                "synthetic-load model: requests/s one "
+                                "replica absorbs before breaching"},
+         "metricsPath": {**_STR, "description":
+                         "replica-reported SLO endpoint path scraped on "
+                         "the coordinator port (the paged engine's SLO "
+                         "export); \"\" = synthetic signals only"}},
+        ["serviceName", "imageName"]),
+    "ServicePatch": _obj(
+        {"replicas": {**_INT, "description":
+                      "MANUAL scale (audited; the autoscaler keeps ruling "
+                      "afterwards)"},
+         "minReplicas": _INT, "maxReplicas": _INT,
+         "imageName": {**_STR, "description":
+                       "weight/spec update: a new immutable service "
+                       "version, rolled replica-by-replica"},
+         "ttftP95TargetMs": {"type": "number"},
+         "queueDepthTarget": _INT}),
+    "ServiceLoad": _obj(
+        {"rps": {"type": "number", "description":
+                 "offered load (requests/s) for the synthetic signal "
+                 "model; fake-runtime replicas synthesize TTFT/queue "
+                 "signals from it"}},
+        ["rps"]),
     "Rollback": _obj(
         {"version": {**_INT, "description": "stored version to roll back to"},
          "dataFrom": {**_STR, "enum": ["latest", "target"],
@@ -176,6 +222,26 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("PATCH", "/api/v1/jobs/{name}/restart", "restartJob",
      "Whole-gang restart: stop every member (coordinator last), start in "
      "process order (coordinator first); resets the restart budget", None),
+    ("POST", "/api/v1/services", "createService",
+     "Create a replicated service: N replica gangs (each a distributed "
+     "job at the service's priority class) behind one declarative record; "
+     "the SLO-driven autoscaler owns the replica count", "ServiceCreate"),
+    ("GET", "/api/v1/services", "listServices",
+     "Every service: phase, replica counts, last autoscale decision", None),
+    ("GET", "/api/v1/services/{name}", "getServiceInfo",
+     "Replica fleet detail (per-replica phase/queue position), SLO targets "
+     "+ last observed signals, and the last autoscale decision with its "
+     "reason — the no-log-reading scaling audit", None),
+    ("PATCH", "/api/v1/services/{name}", "patchService",
+     "Manual replica count (audited), min/max + SLO target retune, or an "
+     "imageName weight update rolled replica-by-replica through the "
+     "immutable-version replace sequencing", "ServicePatch"),
+    ("DELETE", "/api/v1/services/{name}", "deleteService",
+     "Tear down every replica gang (gang-ordered quiesce + one-batch "
+     "release) and drop the service family", None),
+    ("POST", "/api/v1/services/{name}/load", "setServiceLoad",
+     "Synthetic traffic injection: offered requests/s for the fake-runtime "
+     "signal model (bench/test load generators)", "ServiceLoad"),
     ("GET", "/api/v1/resources/tpus", "getTpus",
      "Chip map: coords, owner, fragmentation (largest free block)", None),
     ("GET", "/api/v1/resources/gpus", "getTpusCompat",

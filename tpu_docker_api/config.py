@@ -161,6 +161,26 @@ class Config:
     })
     # class assigned when POST /jobs carries no priorityClass
     priority_class_default: str = "batch"
+    # Service resource (service/serving.py, docs/robustness.md "Service &
+    # autoscaler"): class assigned when POST /services carries no
+    # priorityClass — production by default, so a traffic-driven scale-up
+    # outranks batch/preemptible training in the capacity market
+    service_default_class: str = "production"
+    # autoscaler tick (a writer: leader-only under leader_election);
+    # 0 disables the loop — services still converge via the reconciler's
+    # adoption and explicit tick() calls (test/bench hook)
+    autoscale_interval_s: float = 2.0
+    # minimum seconds between scale-UPs of one service (a breach inside
+    # the window waits; the pending scale-up usually resolves it)
+    autoscale_up_cooldown_s: float = 10.0
+    # minimum seconds after ANY scale before a scale-DOWN — deliberately
+    # longer than up: shedding capacity is cheap to delay, re-acquiring
+    # it may need a preemption
+    autoscale_down_cooldown_s: float = 30.0
+    # hysteresis: scale down only when the worst replica signal sits
+    # below watermark x target. The (watermark, 1.0] band is a dead zone,
+    # so a signal oscillating around the target never flaps the fleet
+    autoscale_down_watermark: float = 0.5
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container
@@ -177,6 +197,7 @@ def load(path: str | None = None) -> Config:
     file; we default instead so the hermetic test path needs no fixture file.
     """
     cfg = Config()
+    data: dict = {}
     if path:
         with open(path, "rb") as f:
             data = tomllib.load(f)
@@ -213,4 +234,22 @@ def load(path: str | None = None) -> Config:
             f"priority_class_default {cfg.priority_class_default!r} is not "
             f"in priority_class_weights "
             f"{sorted(cfg.priority_class_weights)}")
+    if cfg.service_default_class not in cfg.priority_class_weights:
+        if "service_default_class" in data:
+            raise ValueError(
+                f"service_default_class {cfg.service_default_class!r} is "
+                f"not in priority_class_weights "
+                f"{sorted(cfg.priority_class_weights)}")
+        # a custom ladder without "production": the un-set service default
+        # follows the job default instead of failing the whole config
+        cfg.service_default_class = cfg.priority_class_default
+    if cfg.autoscale_interval_s < 0:
+        raise ValueError(f"autoscale_interval_s must be >= 0, "
+                         f"got {cfg.autoscale_interval_s}")
+    if cfg.autoscale_up_cooldown_s < 0 or cfg.autoscale_down_cooldown_s < 0:
+        raise ValueError("autoscale cooldowns must be >= 0")
+    if not 0 < cfg.autoscale_down_watermark <= 1:
+        raise ValueError(
+            f"autoscale_down_watermark must be in (0, 1], "
+            f"got {cfg.autoscale_down_watermark}")
     return cfg

@@ -199,6 +199,26 @@ class Program:
             registry=self.metrics,
         )
         self.job_svc.admission = self.admission
+        # Service resource (service/serving.py): declarative replicated
+        # serving over replica gangs, scaled by the SLO-driven autoscaler
+        # through the capacity market at the service's priority class
+        from tpu_docker_api.service.serving import ServingService
+
+        self.service_versions = VersionMap(
+            read_kv, keys.VERSIONS_SERVICE_KEY,
+            read_through=standby_read_through)
+        if self.informer is not None:
+            self.service_versions.attach_informer(self.informer)
+        self.serving = ServingService(
+            self.job_svc, self.store, self.service_versions,
+            self.job_versions, admission=self.admission,
+            default_class=cfg.service_default_class,
+            interval_s=cfg.autoscale_interval_s,
+            up_cooldown_s=cfg.autoscale_up_cooldown_s,
+            down_cooldown_s=cfg.autoscale_down_cooldown_s,
+            down_watermark=cfg.autoscale_down_watermark,
+            registry=self.metrics,
+        )
         # engine-pool saturation gauges: one set of books summed over the
         # distinct engines behind this pod (the local runtime is shared by
         # several PodHost entries; BreakerRuntime/FaultyRuntime delegate
@@ -269,6 +289,10 @@ class Program:
             # settle/re-journal records after the family passes repaired
             # any half-preempted gang
             admission=self.admission if cfg.admission_enabled else None,
+            # Service adoption: converge every service to one fully-owned
+            # replica set after a crash (missing/surplus/orphan replicas,
+            # interrupted deletes and spec rolls)
+            serving=self.serving,
         )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
@@ -321,7 +345,7 @@ class Program:
         cordons, per-host chip/port maps — the local host's schedulers are
         shared with the pod, so the host walk covers them)."""
         for vm in (self.container_versions, self.volume_versions,
-                   self.job_versions):
+                   self.job_versions, self.service_versions):
             vm.reload_from_store()
         self.pod_scheduler.reload_from_store()
         for host in self.pod.hosts.values():
@@ -499,11 +523,18 @@ class Program:
             # placement) — a writer like the supervisor, leader-only in
             # an HA fleet
             self.admission.start()
+        if self.cfg.autoscale_interval_s > 0:
+            # the autoscaler mutates shared state (replica gangs, service
+            # records) — a writer like the admission loop, leader-only in
+            # an HA fleet
+            self.serving.start()
 
     def _stop_writers(self) -> None:
         """Halt the writer role (lease loss, shutdown). Every close is
         guarded and restartable: a later re-acquire calls _start_writers
         again on the same instances."""
+        if getattr(self, "serving", None) is not None:
+            self.serving.close()
         if getattr(self, "admission", None) is not None:
             self.admission.close()
         if getattr(self, "health_watcher", None) is not None:
@@ -538,6 +569,7 @@ class Program:
             informer=self.informer,
             fanout=self.fanout,
             admission=self.admission,
+            serving=self.serving,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)

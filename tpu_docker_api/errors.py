@@ -51,6 +51,23 @@ def as_int(value, field: str) -> int:
     return coerced
 
 
+def as_float(value, field: str) -> float:
+    """The float analog of :func:`as_int`: coerce a user-supplied request
+    field, mapping malformed input to :class:`BadRequest` instead of a
+    500. Rejects bool and NON-FINITE values — JSON's lax ``NaN`` /
+    ``Infinity`` would otherwise slide through every ``< 0`` validation
+    (NaN compares False against everything) and silently wedge or
+    saturate whatever policy consumes the number."""
+    import math
+
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise BadRequest(f"{field} must be a number")
+    coerced = float(value)
+    if not math.isfinite(coerced):
+        raise BadRequest(f"{field} must be a finite number")
+    return coerced
+
+
 # --- common (xerrors/common.go:7-10) ------------------------------------------
 
 class NoPatchRequired(ApiError):
@@ -187,6 +204,16 @@ class NotLeader(ApiError):
 
 
 # --- host failure domains (service/host_health.py) ----------------------------
+
+class ServiceExisted(ApiError):
+    """POST /services of a name that already has a service family."""
+    code = 11001
+
+
+class ServiceNotExist(ApiError):
+    """A /services/{name} op on an unknown service family."""
+    code = 11002
+
 
 class HostUnreachable(ApiError):
     """A pod host's container engine cannot be reached — connection refused,

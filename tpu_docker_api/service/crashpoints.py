@@ -135,10 +135,38 @@ ADMISSION_CRASH_POINTS = (
     "admission.readmit",
 )
 
+#: Service / autoscaler lifecycle (service/serving.py): the chaos matrix
+#: kills the daemon at each of these and proves a fresh Program's
+#: reconcile converges to exactly ONE fully-owned replica set — every
+#: replica family 0..replicas-1 exists and nothing beyond it, zero leaked
+#: chips/ports — never a half-scaled orphan fleet
+SERVICE_CRASH_POINTS = (
+    # the v0 ServiceState (replicas=N intent included) is durable in ONE
+    # apply; zero replica gangs exist yet — reconcile creates all N
+    "service.create.after_record",
+    # the scale-up decision (replicas=N+1 + lastScale) is durable; the
+    # new replica gang was never submitted — reconcile submits it
+    # (placing directly, or queueing through the admission market)
+    "service.scale_up.after_mark",
+    # the scale-down decision (replicas=N-1) is durable; the surplus
+    # replica gang still runs — reconcile tears it down
+    "service.scale_down.after_mark",
+    # the surplus gang is quiesced (workers first, coordinator last) but
+    # its family, slices and ports still exist — reconcile finishes the
+    # delete and release
+    "service.scale_down.after_quiesce",
+    # the new spec version + latest pointer are durable; every replica
+    # still runs the OLD spec — reconcile rolls them forward
+    "service.roll.after_version",
+    # phase "deleting" is durable; replica gangs still exist — reconcile
+    # finishes the teardown and drops the family
+    "service.delete.after_mark",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
                       + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS
-                      + ADMISSION_CRASH_POINTS)
+                      + ADMISSION_CRASH_POINTS + SERVICE_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

@@ -413,6 +413,69 @@ class JobService:
         self.store.put_job(st)
         return st
 
+    def _swap_version(self, base: str, old: JobState, carry: dict,
+                      run_new) -> JobState:
+        """THE rolling-replace state machine — one copy, shared by the
+        chip rescale and the spec/weight roll (both swap a running gang
+        for a new version of itself; only what the new version looks
+        like differs, so ``run_new(start_now)`` is the caller's).
+
+        Fast path (pool fits old+new): create the new gang un-started
+        while the old one runs, quiesce the old gang gang-ordered
+        (graceful stop ⇒ checkpoint flush), start the new one, free the
+        old slice — the two versions never run concurrently against the
+        shared binds. A swap failure tears the new version down and
+        resumes the old one. Fallback (``ChipNotEnough``: pool too small
+        for both): quiesce and free first, then allocate; on failure
+        re-launch the old shape (best-effort compensation — another
+        family could race for the freed capacity; logged and re-raised
+        either way). Caller holds the family lock."""
+
+        def _quiesce_old() -> None:
+            # gang ordering: workers flush their checkpoint shards first,
+            # the coordinator (the rendezvous point) last
+            self._stop_members(old, reverse=True)
+            self.store.put_job(JobState.from_dict(
+                {**old.to_dict(), "desired_running": False,
+                 "phase": "stopped"}))
+
+        def _resume_old() -> None:
+            # store record first: if the restart fails too, the family's
+            # latest pointer must already be back on the old version
+            self.store.put_job(JobState.from_dict(old.to_dict()))
+            self._start_members(old)
+
+        try:
+            st = run_new(start_now=False)
+            try:
+                _quiesce_old()
+                crash_point("job.patch.after_quiesce_old")
+                self._start_members(st)
+            except Exception:
+                # the old containers are intact: tear the new version
+                # down and resume the old one
+                log.exception("swap of %s failed; resuming old version",
+                              base)
+                self._teardown_version(st, old.version)
+                _resume_old()
+                raise
+            crash_point("job.patch.after_start_new")
+            self._release_version_resources(old)
+        except errors.ChipNotEnough:
+            # in-place: the freed old slice is the capacity
+            _quiesce_old()
+            self._release_version_resources(old)
+            try:
+                st = run_new(start_now=True)
+            except Exception:
+                log.exception("swap of %s failed; re-launching old shape",
+                              base)
+                self._run_version(base, old.image, old.cmd, old.env,
+                                  old.binds, old.chip_count,
+                                  num_slices=old.num_slices, carry=carry)
+                raise
+        return st
+
     # -- flows -------------------------------------------------------------------
 
     def _resolve_priority(self, name: str) -> str:
@@ -529,24 +592,6 @@ class JobService:
                 raise errors.ChipNotEnough(
                     f"want {want} chips, pod has {self.pod.n_chips}")
 
-            def _quiesce_old() -> None:
-                # gang ordering here too: workers flush their checkpoint
-                # shards first, the coordinator (the rendezvous point) last
-                self._stop_members(old, reverse=True)
-                self.store.put_job(JobState.from_dict(
-                    {**old.to_dict(), "desired_running": False,
-                     "phase": "stopped"}
-                ))
-
-            def _free_old() -> None:
-                self._release_version_resources(old)
-
-            def _resume_old() -> None:
-                # store record first: if the restart fails too, the family's
-                # latest pointer must already be back on the old version
-                self.store.put_job(JobState.from_dict(old.to_dict()))
-                self._start_members(old)
-
             # identity travels with the family across versions: priority
             # class and seniority (and the budgets) must survive a rescale
             carry = {"priority_class": old.priority_class,
@@ -554,47 +599,58 @@ class JobService:
                      "preemptions": old.preemptions,
                      "restarts": old.restarts,
                      "migrations": old.migrations}
-            try:
-                # fast path: reserve new capacity first, containers created
-                # but NOT started while the old version still runs
-                st = self._run_version(
+            st = self._swap_version(
+                base, old, carry,
+                lambda start_now: self._run_version(
                     base, old.image, old.cmd, old.env, old.binds,
-                    want, req.accelerator_type, start_now=False,
-                    num_slices=old.num_slices, carry=carry,
-                )
-                try:
-                    _quiesce_old()
-                    crash_point("job.patch.after_quiesce_old")
-                    self._start_members(st)
-                except Exception:
-                    # the old containers are intact: tear the new version
-                    # down and resume the old one
-                    log.exception("rescale swap of %s failed; resuming old "
-                                  "version", base)
-                    self._teardown_version(st, old.version)
-                    _resume_old()
-                    raise
-                crash_point("job.patch.after_start_new")
-                _free_old()
-            except errors.ChipNotEnough:
-                # rescale-in-place: the freed old slice is the capacity
-                _quiesce_old()
-                _free_old()
-                try:
-                    st = self._run_version(
-                        base, old.image, old.cmd, old.env, old.binds,
-                        want, req.accelerator_type,
-                        num_slices=old.num_slices, carry=carry,
-                    )
-                except Exception:
-                    log.exception("rescale of %s failed; re-launching old shape",
-                                  base)
-                    self._run_version(base, old.image, old.cmd, old.env,
-                                      old.binds, old.chip_count,
-                                      num_slices=old.num_slices, carry=carry)
-                    raise
+                    want, req.accelerator_type, start_now=start_now,
+                    num_slices=old.num_slices, carry=carry))
             log.info("rescaled job %s: %d → %d chips (%s)", base,
                      old.chip_count, st.chip_count, st.job_name)
+            return self._info_dict(st)
+
+    def replace_job_spec(self, name: str, image: str, cmd: list[str],
+                         env: list[str], binds: list[str]) -> dict:
+        """Rolling spec replace — the weight-update flow (service/serving.py
+        rides this for per-replica rollouts): same chip count, new
+        image/cmd/env/binds, sequenced exactly like ``patch_job_chips``:
+
+        Fast path (pool fits old+new): allocate + **create** the new gang
+        while the old one runs, quiesce the old gang (checkpoint flush),
+        **start** the new one, free the old slice. Fallback (pool too
+        small for both): quiesce and free first, then allocate; on failure
+        re-launch the old spec (best-effort compensation).
+
+        A queued/preempted job has no gang to roll: its stored spec is
+        rewritten in place — the admission loop resolves the spec at
+        placement time, so the next admission launches the new version.
+        """
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            base, _, latest_name = self._resolve_latest(name)
+            old = self.store.get_job(latest_name)
+            if old.phase == "failed":
+                raise errors.BadRequest(
+                    f"job {base} is failed: {old.failure_reason}")
+            if old.phase in ("queued", "preempted"):
+                new = JobState.from_dict({
+                    **old.to_dict(), "image": image, "cmd": list(cmd),
+                    "env": list(env), "binds": list(binds)})
+                self.store.put_job(new)
+                return self._info_dict(new)
+            carry = {"priority_class": old.priority_class,
+                     "submitted_seq": old.submitted_seq,
+                     "preemptions": old.preemptions,
+                     "restarts": old.restarts,
+                     "migrations": old.migrations}
+            st = self._swap_version(
+                base, old, carry,
+                lambda start_now: self._run_version(
+                    base, image, cmd, env, binds, old.chip_count,
+                    start_now=start_now, num_slices=old.num_slices,
+                    carry=carry))
+            log.info("rolled job %s spec: %s → %s (%s)", base, old.image,
+                     image, st.job_name)
             return self._info_dict(st)
 
     def stop_job(self, name: str) -> None:

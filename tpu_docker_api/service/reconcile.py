@@ -87,6 +87,7 @@ class Reconciler:
         work_queue=None,
         fanout: Fanout | None = None,
         admission=None,
+        serving=None,
     ) -> None:
         self.runtime = runtime
         #: runtime fan-out: the gang member scans, stale-version sweeps
@@ -127,6 +128,12 @@ class Reconciler:
         #: gone, settling records whose job already placed (the
         #: readmit-crash exactly-once), re-journaling stranded intent
         self._admission = admission
+        #: Service adoption (service/serving.py): after the job family
+        #: passes repaired every replica gang, the serving sweep converges
+        #: each service to exactly one fully-owned replica set — missing
+        #: replicas created, surplus/orphan fleets torn down, interrupted
+        #: deletes and spec rolls finished
+        self._serving = serving
         self._registry = registry if registry is not None else REGISTRY
         self._mu = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=max_events)
@@ -185,6 +192,20 @@ class Reconciler:
                     # abort the sweep (SimulatedCrash, a BaseException,
                     # still propagates — that is the chaos harness's kill)
                     log.exception("job reconcile of %s failed", base)
+        if self._serving is not None:
+            # Service adoption AFTER the job family passes (a half-created
+            # replica version is scrubbed first, so the serving sweep sees
+            # only adoptable gangs) and BEFORE admission adoption (replica
+            # creation may enqueue new admission records this same sweep
+            # then settles)
+            try:
+                for a in self._serving.reconcile_services(dry_run=dry_run):
+                    a = dict(a)
+                    self._act(actions, dry_run, a.pop("action"),
+                              a.pop("target"), **a)
+            except Exception as e:  # noqa: BLE001 — one subsystem must
+                # not abort the sweep; services are re-read next pass
+                log.warning("reconcile: service adoption failed: %s", e)
         if self._admission is not None:
             # admission-journal adoption AFTER the family passes: a
             # half-preempted victim is fully quiesced and released first,

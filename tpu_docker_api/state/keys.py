@@ -30,10 +30,12 @@ BASE_NAME_RE = re.compile(r"^[a-zA-Z0-9_.]+$")
 
 class Resource(str, enum.Enum):
     """Resource kinds (reference etcd/common.go:24-29 enums, plus the
-    distributed-job kind the TPU control plane adds)."""
+    distributed-job and replicated-service kinds the TPU control plane
+    adds)."""
     CONTAINERS = "containers"
     VOLUMES = "volumes"
     JOBS = "jobs"
+    SERVICES = "services"
 
 
 def split_versioned_name(name: str) -> tuple[str, int | None]:
@@ -88,6 +90,7 @@ SCHEDULER_SLICES_KEY = f"{PREFIX}/scheduler/slices"
 VERSIONS_CONTAINER_KEY = f"{PREFIX}/versions/containers"
 VERSIONS_VOLUME_KEY = f"{PREFIX}/versions/volumes"
 VERSIONS_JOB_KEY = f"{PREFIX}/versions/jobs"
+VERSIONS_SERVICE_KEY = f"{PREFIX}/versions/services"
 
 
 # -- leader election (service/leader.py) ---------------------------------------

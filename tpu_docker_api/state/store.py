@@ -92,6 +92,17 @@ class StateStore:
 
         return JobState.from_dict(self._get(Resource.JOBS, name))
 
+    # -- services ---------------------------------------------------------------
+
+    def put_service(self, st) -> None:
+        base, _ = keys.split_versioned_name(st.service_name)
+        self._put(Resource.SERVICES, base, st.version, st.to_dict())
+
+    def get_service(self, name: str):
+        from tpu_docker_api.schemas.service import ServiceState
+
+        return ServiceState.from_dict(self._get(Resource.SERVICES, name))
+
     # -- volumes ----------------------------------------------------------------
 
     def put_volume(self, st: VolumeState) -> None:
