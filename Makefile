@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout bench-preempt bench-serve-scale openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout bench-preempt bench-serve-scale bench-scale openapi sample-interface run clean
 
 all: native openapi
 
@@ -71,6 +71,11 @@ bench-serve-scale:           ## service autoscaling family: offered-load step ->
 	$(PY) bench.py --control-plane --cp-family serve-scale > bench-serve-scale.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-serve-scale.json.tmp
 	mv bench-serve-scale.json.tmp bench-serve-scale.json
+
+bench-scale:                 ## O(100k)-object scale family, reduced world: O(changes) reconcile reads, flat list p95, retention-bounded history + schema gate
+	$(PY) bench.py --control-plane --cp-family scale --scale-objects 12000 --scale-small 600 --scale-gangs 60 > bench-scale.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-scale.json.tmp
+	mv bench-scale.json.tmp bench-scale.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

@@ -1237,8 +1237,333 @@ def measure_control_plane_serve_scale(iters: int = 3,
 #: every control-plane family name — the one list argparse, the degraded
 #: path and the dispatchers validate against (a typo'd family must fail
 #: loudly, never silently fall through to a different benchmark)
+def measure_control_plane_scale(n_objects: int = 50000, n_small: int = 1000,
+                                n_gangs: int = 200, retention: int = 4,
+                                list_limit: int = 100, list_iters: int = 40,
+                                churn_families: int = 25,
+                                steady_read_budget: int = 12) -> dict:
+    """O(100k)-object scale family (``--control-plane --cp-family scale``):
+    seed ``n_objects`` fake-runtime container families + ``n_gangs`` job
+    families DIRECTLY into the store (consistent, drift-free world), boot
+    a daemon with the event-driven reconciler and the history compactor
+    armed, and gate the three tentpole claims:
+
+    - **steady-state reconcile is O(changes), not O(objects)**: after one
+      settling full pass, a zero-change auto pass must run in ``dirty``
+      mode and cost ≤ ``steady_read_budget`` CountingKV reads. The
+      contrast is measured, not assumed: a forced full dry-run pass must
+      cost ≥ ``n_objects`` reads — so a reconciler that silently fell
+      back to the O(N) scan blows the steady budget and FAILS, and a
+      bypassed counter fails the contrast gate (no vacuous 0 ≤ budget);
+    - **list p95 flat 1k → N**: a ``limit``-bounded list page must cost
+      the same at ``n_small`` and at ``n_objects`` families (ratio-gated
+      with a small absolute floor so tiny CI runs don't gate on noise),
+      and a full continue-token walk must visit every family exactly
+      once;
+    - **history stays ≤ retention under churn**: families seeded with
+      ``retention + 3`` versions compact down to exactly ``retention``
+      version records — except the latest pointer's version and any
+      version a live runtime member still references, which must
+      SURVIVE.
+
+    A violated gate flips ``gates.ok``; main() turns that into a nonzero
+    exit."""
+    import statistics
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.runtime.spec import ContainerSpec
+    from tpu_docker_api.schemas.job import JobState
+    from tpu_docker_api.schemas.state import ContainerState
+    from tpu_docker_api.state import keys
+    from tpu_docker_api.state.keys import Resource
+    from tpu_docker_api.state.kv import CountingKV, MemoryKV
+
+    if min(n_objects, n_small) < 2 * list_limit:
+        raise ValueError("scale family needs n >= 2 pages of families")
+    if retention < 2 or churn_families < 4:
+        raise ValueError("scale family needs retention >= 2 and >= 4 "
+                         "churn families")
+    churn_versions = retention + 3
+    live_ref_families = 3  # churn families that keep an OLD member alive
+
+    def seed_world(n_containers: int) -> tuple[CountingKV, FakeRuntime, dict]:
+        """A drift-free world: n_containers running container families,
+        churn_families over-retention families, n_gangs stopped job
+        families — version records + latest pointers + version maps +
+        runtime containers, batch-applied straight into the inner store
+        (seeding is setup, not the thing measured)."""
+        inner = MemoryKV(log_retain=16384)
+        runtime = FakeRuntime(allow_exec=True)
+        spec0 = ContainerSpec(name="seed", image="jax")
+        ops: list[tuple] = []
+        cmap: dict[str, int] = {}
+
+        def flush():
+            if ops:
+                inner.apply(ops)
+                ops.clear()
+
+        names = []
+        for i in range(n_containers):
+            base = f"s{i}"
+            name = f"{base}-0"
+            st = ContainerState(container_name=name, version=0,
+                                spec=dict(spec0.to_dict(), name=name))
+            ops.append(("put", keys.version_key(Resource.CONTAINERS, base, 0),
+                        json.dumps(st.to_dict())))
+            ops.append(("put", keys.latest_key(Resource.CONTAINERS, base), "0"))
+            cmap[base] = 0
+            names.append(name)
+            if len(ops) >= 100:
+                flush()
+        runtime.seed_running(names, spec0)
+        live_names = []
+        for i in range(churn_families):
+            base = f"c{i}"
+            latest = churn_versions - 1
+            for v in range(churn_versions):
+                name = f"{base}-{v}"
+                st = ContainerState(container_name=name, version=v,
+                                    spec=dict(spec0.to_dict(), name=name),
+                                    desired_running=(v == latest))
+                ops.append(("put",
+                            keys.version_key(Resource.CONTAINERS, base, v),
+                            json.dumps(st.to_dict())))
+            ops.append(("put", keys.latest_key(Resource.CONTAINERS, base),
+                        str(latest)))
+            cmap[base] = latest
+            live_names.append(f"{base}-{latest}")
+            flush()
+        runtime.seed_running(live_names, spec0)
+        # a few OLD versions keep a stopped-but-present member (the
+        # post-replace shape): the compactor must spare exactly those
+        # versions, and the reconciler must see zero drift in them
+        runtime.seed_running(
+            [f"c{i}-0" for i in range(live_ref_families)], spec0,
+            running=False)
+        jmap: dict[str, int] = {}
+        for i in range(n_gangs):
+            base = f"g{i}"
+            st = JobState(job_name=f"{base}-0", version=0, image="jax",
+                          cmd=[], env=[], binds=[], chip_count=0,
+                          coordinator_port=0, placements=[],
+                          desired_running=False, phase="stopped")
+            ops.append(("put", keys.version_key(Resource.JOBS, base, 0),
+                        json.dumps(st.to_dict())))
+            ops.append(("put", keys.latest_key(Resource.JOBS, base), "0"))
+            jmap[base] = 0
+            if len(ops) >= 100:
+                flush()
+        ops.append(("put", keys.VERSIONS_CONTAINER_KEY,
+                    json.dumps(cmap, sort_keys=True)))
+        ops.append(("put", keys.VERSIONS_JOB_KEY,
+                    json.dumps(jmap, sort_keys=True)))
+        flush()
+        return CountingKV(inner), runtime, cmap
+
+    def boot(counting: CountingKV, runtime: FakeRuntime) -> Program:
+        prog = Program(Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            start_port=45000, end_port=45999, health_watch_interval=0,
+            host_probe_interval_s=0, job_supervise_interval=0,
+            reconcile_on_start=False, reconcile_interval=0,
+            autoscale_interval_s=0,
+            reconcile_full_interval_s=3600,  # event-driven; full never due
+            history_retention_versions=retention,
+            history_compact_interval_s=3600,  # passes run via the route
+        ), host="127.0.0.1", kv=counting, runtime=runtime)
+        prog.init()
+        prog.start()
+        return prog
+
+    def call(prog, method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out["data"]
+
+    def wait_synced(prog, timeout_s: float = 180.0) -> None:
+        """Block until the dirty-feed reflector finished its initial
+        sync. Measurements are STEADY-STATE claims: during the initial
+        100k-event replay the informer thread is CPU-bound and competes
+        with every request for the GIL/store lock — that cold-start cost
+        is real but one-time, and it is not what the gates are about."""
+        deadline = time.monotonic() + timeout_s
+        while not prog.reconcile_informer.synced:
+            if time.monotonic() > deadline:
+                raise RuntimeError("dirty-feed informer never synced")
+            time.sleep(0.05)
+        # synced flips before the initial synthetic diff finishes FIRING
+        # (100k+ dirty-set observes on the informer thread) — wait for
+        # the mark counter to go quiet so measurements don't race the
+        # one-time replay storm
+        last = -1
+        while True:
+            cur = prog.reconciler.dirty_view()["marksTotal"]
+            if cur == last:
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError("dirty feed never went quiet")
+            last = cur
+            time.sleep(0.2)
+
+    def list_p95_ms(prog) -> float:
+        # bench and daemon share one CPython process, so generational GC
+        # passes walk the 100k+ seeded state objects and land as ~60 ms
+        # pauses in the p95 — an artifact of the in-process harness (a
+        # real deployment's store lives out of process), not of the list
+        # path this gate is about. Freeze the (static) seeded world for
+        # the measurement window; must run AFTER wait_synced or the
+        # informer's still-allocating sync re-creates the pressure.
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        try:
+            lat = []
+            for _ in range(list_iters):
+                t0 = time.perf_counter()
+                page = call(prog, "GET",
+                            f"/api/v1/containers?limit={list_limit}")
+                lat.append((time.perf_counter() - t0) * 1e3)
+                if not page["items"]:
+                    raise RuntimeError(
+                        "empty first list page on a seeded world")
+        finally:
+            gc.unfreeze()
+        qs = statistics.quantiles(lat, n=20)
+        return round(min(qs[18], max(lat)), 3)
+
+    # -- small-scale anchor: the flat-list baseline ---------------------------
+    counting, runtime, _ = seed_world(n_small)
+    prog = boot(counting, runtime)
+    try:
+        wait_synced(prog)
+        p95_small = list_p95_ms(prog)
+    finally:
+        prog.stop()
+
+    # -- the big world --------------------------------------------------------
+    counting, runtime, cmap = seed_world(n_objects)
+    expected_families = n_objects + churn_families
+    prog = boot(counting, runtime)
+    try:
+        wait_synced(prog)
+        p95_large = list_p95_ms(prog)
+
+        # full continue-token walk: every family exactly once, no dup/skip
+        seen: set[str] = set()
+        walked = 0
+        token = ""
+        while True:
+            q = f"/api/v1/containers?limit=2000" + (
+                f"&continue={token}" if token else "")
+            page = call(prog, "GET", q)
+            for it in page["items"]:
+                walked += 1
+                seen.add(it["name"])
+            token = page["continue"]
+            if not token:
+                break
+        walk_exact = (walked == expected_families
+                      and len(seen) == expected_families)
+
+        # settle: one real full pass consumes the startup/relist dirty
+        # backlog; the seeded world must be drift-free
+        settle = call(prog, "GET", "/api/v1/reconcile?mode=full")
+        steady_clean = (settle["mode"] == "full"
+                        and settle["driftCount"] == 0)
+
+        # steady state: a zero-change AUTO pass must choose dirty mode and
+        # cost O(changes) — here, O(0) plus the bounded adoption scans
+        before = counting.reads()
+        steady = call(prog, "GET", "/api/v1/reconcile")
+        steady_reads = counting.reads() - before
+        steady_mode = steady["mode"]
+
+        # contrast, measured not assumed: the full scan really is O(N)
+        before = counting.reads()
+        call(prog, "GET", "/api/v1/reconcile?mode=full&dryRun=true")
+        full_reads = counting.reads() - before
+
+        # bounded history: compact, then audit the churned families
+        compact = call(prog, "POST", "/api/v1/compact")
+        inner = counting.inner
+        latest_ok = live_ok = True
+        worst_nonlive = 0
+        for i in range(churn_families):
+            base = f"c{i}"
+            vkeys = inner.keys_prefix(
+                f"{keys.PREFIX}/containers/{base}/v/")
+            versions = {int(k.rsplit("/", 1)[1]) for k in vkeys}
+            if cmap[base] not in versions:
+                latest_ok = False
+            if i < live_ref_families:
+                if 0 not in versions:  # the live OLD member's version
+                    live_ok = False
+                # the spared live version rides above retention by design
+                worst_nonlive = max(worst_nonlive, len(versions - {0}))
+            else:
+                worst_nonlive = max(worst_nonlive, len(versions))
+    finally:
+        prog.stop()
+
+    flat_budget = 4.0
+    flat_floor_ms = 5.0
+    flat_ratio = round(p95_large / max(p95_small, 1e-6), 2)
+    gates = {
+        "steady_mode": steady_mode,
+        "steady_reads": steady_reads,
+        "steady_read_budget": steady_read_budget,
+        "steady_reads_bounded": (steady_mode == "dirty"
+                                 and steady_reads <= steady_read_budget),
+        "steady_clean": steady_clean,
+        "full_scan_reads": full_reads,
+        "full_scan_counted": full_reads >= n_objects,
+        "list_p95_small_ms": p95_small,
+        "list_p95_large_ms": p95_large,
+        "list_flat_ratio": flat_ratio,
+        "list_flat_budget": flat_budget,
+        "list_flat_floor_ms": flat_floor_ms,
+        "list_flat": (flat_ratio <= flat_budget
+                      or p95_large <= flat_floor_ms),
+        "walk_exact": walk_exact,
+        "retention": retention,
+        "retention_worst_versions": worst_nonlive,
+        "retention_ok": worst_nonlive <= retention,
+        "latest_protected": latest_ok,
+        "live_version_protected": live_ok,
+    }
+    gates["ok"] = bool(
+        gates["steady_reads_bounded"] and gates["steady_clean"]
+        and gates["full_scan_counted"] and gates["list_flat"]
+        and gates["walk_exact"] and gates["retention_ok"]
+        and gates["latest_protected"] and gates["live_version_protected"])
+    return {
+        "family": "scale",
+        "iters": {"objects": n_objects, "small": n_small,
+                  "gangs": n_gangs, "churn_families": churn_families,
+                  "list_iters": list_iters, "list_limit": list_limit},
+        "steady_reads": steady_reads,
+        "full_scan_reads": full_reads,
+        "list_p95_ms": {"small": p95_small, "large": p95_large,
+                        "ratio": flat_ratio},
+        "compact": {k: compact[k] for k in
+                    ("trimmedTotal", "protectedLive", "chunks")},
+        "gates": gates,
+    }
+
+
 CP_FAMILIES = ("create", "churn", "failover", "reads", "fanout",
-               "preempt", "serve-scale")
+               "preempt", "serve-scale", "scale")
 
 
 # control-plane family dispatch — shared by the --control-plane branch
@@ -1265,6 +1590,10 @@ def _run_cp_family(family: str, args) -> dict:
             n_low=args.preempt_low, n_high=args.preempt_high)
     if family == "serve-scale":
         return measure_control_plane_serve_scale(iters=args.serve_iters)
+    if family == "scale":
+        return measure_control_plane_scale(
+            n_objects=args.scale_objects, n_small=args.scale_small,
+            n_gangs=args.scale_gangs, retention=args.scale_retention)
     return measure_control_plane(args.cp_iters, args.cp_runtime)
 
 
@@ -1289,6 +1618,9 @@ def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
     if family == "serve-scale":
         return ("control_plane_serve_scale_time_to_scaled_ms_p50",
                 cp["time_to_scaled_ms"]["p50"], "ms")
+    if family == "scale":
+        return ("control_plane_scale_steady_reconcile_reads",
+                cp["steady_reads"], "reads")
     return ("container_create_ready_ms_p50", cp["create_ready_ms_p50"], "ms")
 
 
@@ -1301,7 +1633,8 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
     the artifact degrades instead of vanishing (the BENCH_r04/r05 class).
     ``BENCH_DEGRADED_FAMILIES`` (comma list) overrides the default set."""
     families = [f.strip() for f in os.environ.get(
-        "BENCH_DEGRADED_FAMILIES", "churn,preempt,serve-scale").split(",")
+        "BENCH_DEGRADED_FAMILIES",
+        "churn,preempt,serve-scale,scale").split(",")
         if f.strip()]
     green = 0
     for family in families:
@@ -1377,7 +1710,12 @@ def main() -> int | None:
                              "offered-load step against a Service beside "
                              "batch training, gating time-to-scaled, SLO "
                              "recovery, scale-up-through-the-admission-"
-                             "queue and zero manual operations")
+                             "queue and zero manual operations; scale = "
+                             "seed 50-100k fake-runtime objects, gating "
+                             "zero-change reconcile reads O(changes) vs "
+                             "the measured O(N) full scan, flat list p95 "
+                             "1k->N, and version history <= retention "
+                             "under churn")
     parser.add_argument("--cp-iters", type=int, default=100,
                         help="iterations (create family) / container "
                              "cycles (churn family) / total GETs per role "
@@ -1405,6 +1743,18 @@ def main() -> int | None:
     parser.add_argument("--serve-iters", type=int, default=3,
                         help="offered-load step cycles for the serve-scale "
                              "family")
+    parser.add_argument("--scale-objects", type=int, default=50000,
+                        help="container families seeded for the scale "
+                             "family's big world")
+    parser.add_argument("--scale-small", type=int, default=1000,
+                        help="container families in the scale family's "
+                             "small-world list-latency anchor")
+    parser.add_argument("--scale-gangs", type=int, default=200,
+                        help="job families seeded beside the containers "
+                             "for the scale family")
+    parser.add_argument("--scale-retention", type=int, default=4,
+                        help="history_retention_versions under test in "
+                             "the scale family")
     parser.add_argument("--skip-cp-evidence", action="store_true",
                         help="on backend-init failure, keep the legacy "
                              "fast rc-1 exit instead of running the no-TPU "

@@ -214,6 +214,73 @@ def validate_serve_scale(extra: dict) -> list[str]:
     return problems
 
 
+def validate_scale(extra: dict) -> list[str]:
+    """The O(100k)-object scale family headline payload. The O(changes)
+    read-count, the flat-list ratio and the retention bound are
+    re-checked here (not just gates.ok): a steady-state pass that
+    regressed to the O(N) scan, an un-counted full-scan contrast (the
+    vacuous 0 ≤ budget), or history growing past retention must fail
+    loudly at the schema layer too."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    for key in ("objects", "small", "gangs", "churn_families"):
+        if not (isinstance(it.get(key), int) and it[key] >= 1):
+            problems.append(f"scale: iters.{key} must be an int >= 1, "
+                            f"got {it.get(key)!r}")
+    gates = extra.get("gates") or {}
+    for key in ("steady_mode", "steady_reads", "steady_read_budget",
+                "steady_reads_bounded", "steady_clean", "full_scan_reads",
+                "full_scan_counted", "list_p95_small_ms",
+                "list_p95_large_ms", "list_flat_ratio", "list_flat_budget",
+                "list_flat_floor_ms", "list_flat", "walk_exact", "retention",
+                "retention_worst_versions", "retention_ok",
+                "latest_protected", "live_version_protected", "ok"):
+        if key not in gates:
+            problems.append(f"scale: gates.{key} missing")
+    if gates.get("steady_mode") != "dirty":
+        problems.append(f"scale: the steady-state pass ran in mode "
+                        f"{gates.get('steady_mode')!r}, not 'dirty' — the "
+                        f"event-driven path is unproven")
+    steady = gates.get("steady_reads")
+    budget = gates.get("steady_read_budget")
+    if not (isinstance(steady, int) and isinstance(budget, int)
+            and 0 <= steady <= budget):
+        problems.append(f"scale: steady_reads {steady!r} exceeds the "
+                        f"O(changes) budget {budget!r} — the zero-change "
+                        f"pass is scanning")
+    full = gates.get("full_scan_reads")
+    n = it.get("objects")
+    if not (isinstance(full, int) and isinstance(n, int) and full >= n):
+        problems.append(f"scale: full_scan_reads {full!r} < objects {n!r} "
+                        f"— the read counter is bypassed, the steady "
+                        f"budget would pass vacuously")
+    ratio = gates.get("list_flat_ratio")
+    rbudget = gates.get("list_flat_budget")
+    floor = gates.get("list_flat_floor_ms")
+    large = gates.get("list_p95_large_ms")
+    if not _num(ratio) or ratio <= 0:
+        problems.append(f"scale: list_flat_ratio must be a positive "
+                        f"number, got {ratio!r}")
+    elif _num(rbudget) and ratio > rbudget and (
+            not (_num(floor) and _num(large)) or large > floor):
+        problems.append(f"scale: list p95 grew {ratio}x from 1k to the "
+                        f"big world (> {rbudget}x budget) — lists are "
+                        f"not flat")
+    worst = gates.get("retention_worst_versions")
+    keep = gates.get("retention")
+    if not (isinstance(worst, int) and isinstance(keep, int)
+            and worst <= keep):
+        problems.append(f"scale: {worst!r} version records survived "
+                        f"compaction (> retention {keep!r})")
+    for key in ("walk_exact", "latest_protected",
+                "live_version_protected", "steady_clean"):
+        if gates.get(key) is not True:
+            problems.append(f"scale: gates.{key} is not true")
+    if gates.get("ok") is not True:
+        problems.append(f"scale: regression gate failed: {gates}")
+    return problems
+
+
 FANOUT_FLOWS = ("create", "stop", "delete")
 
 
@@ -302,11 +369,16 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "serve-scale"]
     if serve:
         return problems + validate_serve_scale(serve[0]["extra"])
+    scale = [ln for ln in lines
+             if (ln.get("extra") or {}).get("family") == "scale"]
+    if scale:
+        return problems + validate_scale(scale[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn, failover, reads, fanout, preempt or "
-                           "serve-scale headline line (extra.family)"]
+        return problems + ["no churn, failover, reads, fanout, preempt, "
+                           "serve-scale or scale headline line "
+                           "(extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

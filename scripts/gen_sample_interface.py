@@ -114,6 +114,13 @@ def main() -> None:
          "Restart re-applies chips via a new version when carded.")
     call("POST", "/api/v1/containers/demo/commit",
          {"newImageName": "demo-snapshot:v1"})
+    call("GET", "/api/v1/containers?limit=50", None,
+         "Paginated family list: `limit` bounds raw keys scanned per page, "
+         "`continue` (opaque, from the previous page) walks a rev-anchored "
+         "consistent snapshot — a concurrent write under the prefix expires "
+         "the token with HTTP 410 / code 10505, never a silent dup/skip. "
+         "Same contract on `/api/v1/volumes`, `/api/v1/jobs` and "
+         "`/api/v1/services`.")
     call("GET", "/api/v1/containers/demo/history", None,
          "Every stored version of the family — the per-version state store "
          "retains them all (the reference's latest-wins etcd layout keeps "

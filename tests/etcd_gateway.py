@@ -78,9 +78,27 @@ class FakeGateway(BaseHTTPRequestHandler):
             self._emit("put", key, self.store[key])
             return self._reply({"header": self._header()})
         if self.path == "/v3/kv/range":
+            rev_q = int(body.get("revision", 0) or 0)
+            if rev_q:
+                # MVCC emulation without MVCC storage: serve at an old
+                # revision only when the requested range provably hasn't
+                # changed since it (the live store IS that snapshot);
+                # otherwise answer the real server's compaction error —
+                # conservative but contract-compatible (the client's only
+                # recovery either way is a fresh first page)
+                changed = any(
+                    r > rev_q and (k2 == key if range_end is None
+                                   else in_range(k2))
+                    for (r, _op, k2, _v) in self.server.events)
+                if changed or rev_q <= self.server.compacted:
+                    return self._reply_error(
+                        400, "etcdserver: mvcc: required revision has "
+                             "been compacted")
+            keys_only = bool(body.get("keys_only"))
             kvs = [
                 {"key": base64.b64encode(k).decode(),
-                 "value": base64.b64encode(v).decode()}
+                 **({} if keys_only
+                    else {"value": base64.b64encode(v).decode()})}
                 for k, v in sorted(self.store.items()) if in_range(k)
             ]
             limit = int(body.get("limit", 0))
@@ -109,6 +127,16 @@ class FakeGateway(BaseHTTPRequestHandler):
     def _reply(self, payload: dict):
         data = json.dumps(payload).encode()
         self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_error(self, status: int, message: str):
+        """grpc-gateway error shape: JSON {error, code} on a non-200 —
+        what a real gateway answers for e.g. a compacted revision."""
+        data = json.dumps({"error": message, "code": 11}).encode()
+        self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()

@@ -434,6 +434,70 @@ class TestServeScaleFamily:
         assert len(serve["scaled_ms"]) == 2
 
 
+class TestScaleFamily:
+    """The O(100k)-object scale family (``make bench-scale``) at tiny
+    scale — pinning the artifact schema (scripts/check_churn_schema.py)
+    and the tentpole invariants: a zero-change steady-state reconcile
+    pass runs in ``dirty`` mode at O(changes) store reads while the
+    measured full scan really is O(N) (the contrast that makes the budget
+    non-vacuous), a limit-bounded list page costs the same at both world
+    sizes and a continue-token walk is exact, and churned families
+    compact down to retention with the latest pointer and live-referenced
+    versions protected."""
+
+    @pytest.fixture(scope="class")
+    def scale(self):
+        return bench.measure_control_plane_scale(
+            n_objects=400, n_small=240, n_gangs=12, retention=3,
+            list_iters=20, churn_families=6)
+
+    def test_schema_checker_accepts_the_emitted_line(self, scale):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_scale_steady_reconcile_reads",
+                "value": scale["steady_reads"],
+                "unit": "reads", "vs_baseline": 1.0, "extra": scale}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... a steady pass that regressed to the O(N) scan must fail
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["steady_reads"] = 10_000_000
+        assert any("scanning" in p for p in validate_lines([bad]))
+        # ... a bypassed read counter must fail, never pass vacuously
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["full_scan_reads"] = 0
+        assert any("bypassed" in p for p in validate_lines([bad]))
+        # ... and history growing past retention must fail
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["retention_worst_versions"] = 99
+        assert any("compaction" in p for p in validate_lines([bad]))
+
+    def test_scale_gates_hold(self, scale):
+        gates = scale["gates"]
+        assert gates["ok"] is True
+        # the tentpole: O(changes) steady state, measured against a
+        # genuinely-counted O(N) full scan
+        assert gates["steady_mode"] == "dirty"
+        assert gates["steady_reads"] <= gates["steady_read_budget"]
+        assert gates["full_scan_reads"] >= 400
+        assert gates["steady_clean"] is True
+        # bounded pages: flat cost and an exact no-dup/no-skip walk
+        assert gates["list_flat"] is True
+        assert gates["walk_exact"] is True
+        # bounded history: retention held, protections honored
+        assert gates["retention_worst_versions"] <= gates["retention"]
+        assert gates["latest_protected"] is True
+        assert gates["live_version_protected"] is True
+        assert scale["compact"]["trimmedTotal"] > 0
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

@@ -13,6 +13,9 @@ enqueued (data copy, deferred start) are lost with the process — the
 strictest possible crash model.
 """
 
+import json
+import time
+
 import pytest
 
 from tpu_docker_api import config as config_mod
@@ -34,12 +37,14 @@ from tpu_docker_api.schemas.container import (
 from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun
 from tpu_docker_api.service.crashpoints import (
     ADMISSION_CRASH_POINTS,
+    COMPACTOR_CRASH_POINTS,
     CONTAINER_CRASH_POINTS,
     FANOUT_CRASH_POINTS,
     JOB_CRASH_POINTS,
     KNOWN_CRASH_POINTS,
     LEADER_CRASH_POINTS,
     QUEUE_CRASH_POINTS,
+    RECONCILE_CRASH_POINTS,
     TXN_CRASH_POINTS,
     SimulatedCrash,
     armed,
@@ -124,6 +129,10 @@ def test_case_matrix_covers_every_crash_point():
     # the admission matrix kills the daemon at every capacity-market
     # lifecycle point (admission.preempt fires twice: via skip=0/1)
     assert {p for p, _ in ADMISSION_CASES} == set(ADMISSION_CRASH_POINTS)
+    # the scale matrix (TestScaleChaos) kills the compactor on both
+    # sides of the trim and the dirty-driven reconcile mid-pass
+    assert {p for p, _ in COMPACTOR_CASES} == set(COMPACTOR_CRASH_POINTS)
+    assert set(RECONCILE_CRASH_POINTS) == {RECONCILE_DIRTY_POINT}
     # the service matrix (tests/test_service.py TestServiceChaos) kills
     # the daemon at every service.* lifecycle point
     from tpu_docker_api.service.crashpoints import SERVICE_CRASH_POINTS
@@ -132,6 +141,7 @@ def test_case_matrix_covers_every_crash_point():
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
             | set(LEADER_CRASH_POINTS) | set(FANOUT_CRASH_POINTS)
             | set(ADMISSION_CRASH_POINTS) | set(SERVICE_CRASH_POINTS)
+            | set(RECONCILE_CRASH_POINTS) | set(COMPACTOR_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
 
@@ -1455,4 +1465,128 @@ class TestAdmissionChaos:
         # exactly one placed version, one live gang
         assert prg2.job_versions.get("high") == 1  # v0 queued, v1 placed
         assert _job_oracle(prg2) == []
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+
+# -- O(100k)-scale machinery (ISSUE 12): compactor + dirty-set crashes ---------
+
+#: (crash point, chunk index to die at) — before_trim dies with nothing
+#: deleted; mid_trim dies with exactly one ≤100-op chunk durable
+COMPACTOR_CASES = (("compact.before_trim", 0), ("compact.mid_trim", 0))
+RECONCILE_DIRTY_POINT = "reconcile.dirty_drained"
+
+
+class TestScaleChaos:
+    """History compaction and the event-driven reconcile are GC/cost
+    machinery — a daemon death inside either must leave the world exactly
+    as repairable as before: one live version, zero leaks, fixpoint, and
+    the latest pointer always resolvable."""
+
+    def _seed_history_world(self, versions=8):
+        """A family whose OLD versions' members are gone (the post-gang-
+        rescale / removed-container shape where compaction actually
+        trims): version records 0..N-1, latest pointer + map at N-1, one
+        running member at the latest."""
+        from tpu_docker_api.runtime.spec import ContainerSpec
+        from tpu_docker_api.schemas.state import ContainerState
+        from tpu_docker_api.state import keys as keys_mod
+        from tpu_docker_api.state.keys import Resource
+
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        spec0 = ContainerSpec(name="t", image="jax")
+        ops = []
+        for v in range(versions):
+            st = ContainerState(container_name=f"t-{v}", version=v,
+                                spec=dict(spec0.to_dict(), name=f"t-{v}"))
+            ops.append(("put",
+                        keys_mod.version_key(Resource.CONTAINERS, "t", v),
+                        json.dumps(st.to_dict())))
+        ops.append(("put", keys_mod.latest_key(Resource.CONTAINERS, "t"),
+                    str(versions - 1)))
+        ops.append(("put", keys_mod.VERSIONS_CONTAINER_KEY,
+                    json.dumps({"t": versions - 1})))
+        kv.apply(ops)
+        rt.seed_running([f"t-{versions - 1}"], spec0)
+        return kv, rt
+
+    def _compactor(self, prg, retention=2, chunk_ops=2):
+        from tpu_docker_api.service.compactor import HistoryCompactor
+        from tpu_docker_api.state.keys import Resource
+
+        return HistoryCompactor(
+            prg.kv, prg.store,
+            maps=[(Resource.CONTAINERS, prg.container_versions)],
+            retention=retention, runtime=prg.runtime, chunk_ops=chunk_ops)
+
+    @pytest.mark.parametrize("point,skip", COMPACTOR_CASES)
+    def test_compactor_crash_converges(self, point, skip):
+        from tpu_docker_api.state.keys import Resource
+
+        kv, rt = self._seed_history_world()
+        prg = boot(kv, rt)
+        comp = self._compactor(prg)
+        with armed(point, skip=skip):
+            with pytest.raises(SimulatedCrash):
+                comp.compact_once()
+
+        # the dead daemon's world: latest must still resolve, whatever
+        # subset of old records the partial trim removed
+        prg2 = boot(kv, rt)
+        assert prg2.store.get_container("t").version == 7
+        report = prg2.reconciler.reconcile()
+        assert report["actions"] == [], f"{point}: trim read as drift"
+        assert check_invariants(prg2.runtime, prg2.store,
+                                prg2.container_versions,
+                                prg2.chip_scheduler,
+                                prg2.port_scheduler) == []
+        assert prg2.reconciler.reconcile()["actions"] == []  # fixpoint
+
+        # a re-run finishes the interrupted trim exactly once
+        self._compactor(prg2).compact_once()
+        assert prg2.store.history(Resource.CONTAINERS, "t") == [6, 7]
+        assert prg2.runtime.container_inspect("t-7").running
+
+    def test_dirty_pass_crash_replays_as_full_on_reboot(self):
+        """The dirty-set is in-process state: a daemon killed between
+        draining it and repairing loses the marks with the process — the
+        restart contract (first pass full: everything dirty once) must
+        still converge the drift those marks tracked."""
+        from tpu_docker_api.service.reconcile import Reconciler
+        from tpu_docker_api.state.informer import Informer
+        from tpu_docker_api.state import keys as keys_mod
+
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        prg = boot(kv, rt)
+        prg.container_svc.run_container(ContainerRun(
+            image_name="jax", container_name="t", chip_count=2))
+
+        informer = Informer(kv, keys_mod.PREFIX + "/")
+        rec = Reconciler(
+            prg.runtime, prg.store, prg.chip_scheduler, prg.port_scheduler,
+            prg.container_versions, container_svc=prg.container_svc,
+            full_interval_s=3600)
+        rec.attach_dirty_feed(informer)
+        informer.start()
+        deadline = time.monotonic() + 5
+        while not informer.synced and time.monotonic() < deadline:
+            time.sleep(0.02)
+        rec.reconcile(mode="full")  # consume the startup full: clean world
+        # drift the watch stream sees: member died, state re-touched
+        rt.crash_container("t-0")
+        prg.store.put_container(prg.store.get_container("t-0"))
+        with armed(RECONCILE_DIRTY_POINT):
+            with pytest.raises(SimulatedCrash):
+                rec.reconcile(mode="dirty")
+        informer.close()  # the process "dies": every mark is gone
+
+        prg2 = boot(kv, rt)
+        report = prg2.reconciler.reconcile()
+        assert "restart-dead" in [a["action"] for a in report["actions"]]
+        assert prg2.runtime.container_inspect("t-0").running
+        assert check_invariants(prg2.runtime, prg2.store,
+                                prg2.container_versions,
+                                prg2.chip_scheduler,
+                                prg2.port_scheduler) == []
         assert prg2.reconciler.reconcile()["actions"] == []

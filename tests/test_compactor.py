@@ -1,0 +1,180 @@
+"""History compactor (service/compactor.py): retention bounds version
+history without ever touching the latest pointer's version or a version a
+live runtime member still references; settled admission records and acked
+queue markers drain; deletes ride ≤100-op chunks."""
+
+import json
+import types
+
+import pytest
+
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.runtime.spec import ContainerSpec
+from tpu_docker_api.schemas.job import JobState
+from tpu_docker_api.schemas.state import ContainerState
+from tpu_docker_api.service.compactor import HistoryCompactor
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.keys import Resource
+from tpu_docker_api.state.kv import KV, MemoryKV
+from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+
+class RecordingKV(KV):
+    """Pass-through wrapper that records every apply's op count."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.apply_sizes: list[int] = []
+
+    def put(self, k, v):
+        self.inner.put(k, v)
+
+    def get(self, k):
+        return self.inner.get(k)
+
+    def delete(self, k):
+        self.inner.delete(k)
+
+    def range_prefix(self, p):
+        return self.inner.range_prefix(p)
+
+    def keys_prefix(self, p, limit=0, start_after=""):
+        return self.inner.keys_prefix(p, limit=limit, start_after=start_after)
+
+    def _apply(self, ops, guards=None):
+        self.apply_sizes.append(len(ops))
+        self.inner._apply(ops, guards)
+
+
+class Env:
+    def __init__(self, tmp_path, retention=3, runtime=None):
+        self.kv = RecordingKV(MemoryKV())
+        self.store = StateStore(self.kv)
+        self.runtime = runtime if runtime is not None else FakeRuntime(
+            root=str(tmp_path))
+        self.cvm = VersionMap(self.kv, keys.VERSIONS_CONTAINER_KEY)
+        self.jvm = VersionMap(self.kv, keys.VERSIONS_JOB_KEY)
+        self.compactor = HistoryCompactor(
+            self.kv, self.store,
+            maps=[(Resource.CONTAINERS, self.cvm), (Resource.JOBS, self.jvm)],
+            retention=retention, runtime=self.runtime,
+            registry=MetricsRegistry(),
+        )
+
+    def seed_container_family(self, base, versions, latest=None):
+        for v in range(versions):
+            spec = ContainerSpec(name=f"{base}-{v}", image="jax").to_dict()
+            self.store.put_container(ContainerState(
+                container_name=f"{base}-{v}", version=v, spec=spec))
+        if latest is not None:
+            self.kv.put(keys.latest_key(Resource.CONTAINERS, base),
+                        str(latest))
+        self.cvm.set(base, latest if latest is not None else versions - 1)
+
+    def history(self, base):
+        return self.store.history(Resource.CONTAINERS, base)
+
+
+@pytest.fixture
+def env(tmp_path):
+    return Env(tmp_path)
+
+
+class TestRetention:
+    def test_trims_past_retention_keeping_newest(self, env):
+        env.seed_container_family("t", versions=8)
+        report = env.compactor.compact_once()
+        assert env.history("t") == [5, 6, 7]
+        assert report["trimmed"] == {"containers": 5}
+
+    def test_under_retention_untouched(self, env):
+        env.seed_container_family("t", versions=2)
+        assert env.compactor.compact_once()["trimmedTotal"] == 0
+        assert env.history("t") == [0, 1]
+
+    def test_latest_pointer_version_survives_any_age(self, env):
+        # rolled back: the pointer names an OLD version — it must survive
+        # even though the age rule would trim it
+        env.seed_container_family("t", versions=8, latest=1)
+        env.compactor.compact_once()
+        assert env.history("t") == [1, 5, 6, 7]
+
+    def test_live_member_version_survives(self, env):
+        env.seed_container_family("t", versions=8)
+        # an old version's container still exists in the runtime
+        env.runtime.seed_running(["t-2"], ContainerSpec(name="t-2",
+                                                        image="jax"),
+                                 running=False)
+        report = env.compactor.compact_once()
+        assert env.history("t") == [2, 5, 6, 7]
+        assert report["protectedLive"] == 1
+        # the spared version trims the moment its member is gone
+        env.runtime.container_remove("t-2", force=True)
+        env.compactor.compact_once()
+        assert env.history("t") == [5, 6, 7]
+
+    def test_live_job_member_version_survives(self, tmp_path):
+        rt = FakeRuntime(root=str(tmp_path))
+        env = Env(tmp_path, runtime=rt)
+        host = types.SimpleNamespace(runtime=rt)
+        env.compactor._pod = types.SimpleNamespace(hosts={"h0": host})
+        for v in range(6):
+            env.store.put_job(JobState(
+                job_name=f"j-{v}", version=v, image="jax", cmd=[], env=[],
+                binds=[], chip_count=0, coordinator_port=0,
+                placements=[["h0", f"j-{v}-p0", 0, [], 0]]))
+        env.jvm.set("j", 5)
+        rt.seed_running(["j-1-p0"], ContainerSpec(name="j-1-p0", image="jax"),
+                        running=False)
+        env.compactor.compact_once()
+        assert env.store.history(Resource.JOBS, "j") == [1, 3, 4, 5]
+
+    def test_deletes_ride_chunks_under_etcd_ceiling(self, tmp_path):
+        env = Env(tmp_path, retention=2)
+        for i in range(3):
+            env.seed_container_family(f"t{i}", versions=60)
+        env.kv.apply_sizes.clear()
+        env.compactor.compact_once()
+        doomed = 3 * (60 - 2)
+        assert sum(env.kv.apply_sizes) == doomed
+        assert max(env.kv.apply_sizes) <= 100
+        assert len(env.kv.apply_sizes) >= 2
+
+
+class TestDrains:
+    def test_orphan_admission_record_purged_live_kept(self, env):
+        env.store.put_job(JobState(
+            job_name="alive-0", version=0, image="jax", cmd=[], env=[],
+            binds=[], chip_count=0, coordinator_port=0, placements=[]))
+        env.jvm.set("alive", 0)
+        env.kv.put(keys.admission_record_key(1), json.dumps(
+            {"seq": 1, "base": "ghost", "kind": "queued", "class": "batch"}))
+        env.kv.put(keys.admission_record_key(2), json.dumps(
+            {"seq": 2, "base": "alive", "kind": "queued", "class": "batch"}))
+        report = env.compactor.compact_once()
+        assert report["admissionPurged"] == 1
+        left = env.kv.range_prefix(keys.ADMISSION_PREFIX)
+        assert list(left) == [keys.admission_record_key(2)]
+
+    def test_acked_markers_swept(self, tmp_path):
+        from tpu_docker_api.state.workqueue import WorkQueue
+
+        env = Env(tmp_path)
+        wq = WorkQueue(env.kv)
+        env.compactor._wq = wq
+        env.kv.put(keys.queue_marker_key("dead-task"), "{}")
+        env.compactor.compact_once()
+        assert env.kv.range_prefix(keys.QUEUE_MARKERS_PREFIX) == {}
+
+    def test_probe_failure_protects_the_version(self, env, monkeypatch):
+        env.seed_container_family("t", versions=6)
+
+        def boom(name):
+            raise RuntimeError("engine down")
+
+        monkeypatch.setattr(env.runtime, "container_exists", boom)
+        env.compactor.compact_once()
+        # nothing trimmed: every probe failed, every version protected
+        assert env.history("t") == [0, 1, 2, 3, 4, 5]

@@ -1,0 +1,300 @@
+"""Paginated lists (ISSUE 12): the bounded-range primitives
+(``keys_prefix`` / ``range_prefix_page``) on all three KV backends, the
+rev-anchored continue-token contract (a page walk is a consistent
+snapshot or a typed ContinueExpired — NEVER a silent dup/skip), and the
+HTTP list endpoints riding them."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+from etcd_gateway import start_gateway, stop_gateway
+
+from tpu_docker_api import errors
+from tpu_docker_api.state import pager
+from tpu_docker_api.state.keys import Resource
+from tpu_docker_api.state.kv import EtcdKV, MemoryKV, SqliteKV
+
+P = "/apis/v1/containers/"
+
+
+def seed(kv, n=9):
+    for i in range(n):
+        kv.put(f"{P}f{i}/latest", str(i))
+    kv.put("/apis/v1/volumes/other/latest", "0")  # outside the prefix
+
+
+@pytest.fixture()
+def gateway():
+    server, _ = start_gateway()
+    try:
+        yield server
+    finally:
+        stop_gateway(server)
+
+
+@pytest.fixture(params=["memory", "sqlite", "etcd"])
+def kv(request, tmp_path, gateway):
+    if request.param == "memory":
+        yield MemoryKV()
+    elif request.param == "sqlite":
+        k = SqliteKV(str(tmp_path / "kv.db"))
+        yield k
+        k.close()
+    else:
+        yield EtcdKV(f"http://127.0.0.1:{gateway.server_address[1]}")
+
+
+class TestKeysPrefix:
+    def test_keys_only_sorted_and_scoped(self, kv):
+        seed(kv)
+        ks = kv.keys_prefix(P)
+        assert ks == sorted(ks)
+        assert ks == [f"{P}f{i}/latest" for i in range(9)]
+
+    def test_limit_and_start_after(self, kv):
+        seed(kv)
+        first = kv.keys_prefix(P, limit=4)
+        assert len(first) == 4
+        rest = kv.keys_prefix(P, start_after=first[-1])
+        assert first + rest == kv.keys_prefix(P)
+
+    def test_matches_range_prefix_keys(self, kv):
+        seed(kv)
+        assert kv.keys_prefix(P) == list(kv.range_prefix(P))
+
+
+class TestRangePrefixPage:
+    def walk(self, kv, limit):
+        items, last, rev = {}, "", 0
+        while True:
+            page, rev = kv.range_prefix_page(P, limit, start_after=last,
+                                             at_rev=rev)
+            items.update(page)
+            if len(page) < limit:
+                return items, rev
+            last = max(page)
+
+    def test_full_walk_equals_range(self, kv):
+        seed(kv)
+        items, _ = self.walk(kv, limit=4)
+        assert items == kv.range_prefix(P)
+
+    def test_limit_bounds_each_page(self, kv):
+        seed(kv)
+        page, rev = kv.range_prefix_page(P, 3)
+        assert len(page) == 3 and rev > 0
+        assert list(page) == kv.keys_prefix(P, limit=3)
+
+    def test_start_after_is_exclusive(self, kv):
+        seed(kv)
+        page, _ = kv.range_prefix_page(P, 3, start_after=f"{P}f0/latest")
+        assert f"{P}f0/latest" not in page
+
+    def test_insert_between_pages_is_snapshot_or_410(self, kv):
+        """Both legal outcomes of a concurrent insert, NEVER a dup/skip:
+        an MVCC backend (etcd) serves the anchored snapshot — the new key
+        is invisible at that revision — while the log-proof backends
+        (memory/sqlite) conservatively expire the token."""
+        seed(kv)
+        page, rev = kv.range_prefix_page(P, 4)
+        kv.put(f"{P}f0a/latest", "9")  # lands INSIDE the walked window
+        try:
+            rest, _ = kv.range_prefix_page(P, 99, start_after=max(page),
+                                           at_rev=rev)
+        except errors.ContinueExpired:
+            return
+        assert f"{P}f0a/latest" not in rest
+        assert list(page) + list(rest) == [
+            f"{P}f{i}/latest" for i in range(9)]
+
+    def test_delete_between_pages_expires_the_token(self, kv):
+        seed(kv)
+        page, rev = kv.range_prefix_page(P, 4)
+        kv.delete(f"{P}f7/latest")
+        with pytest.raises(errors.ContinueExpired):
+            kv.range_prefix_page(P, 4, start_after=max(page), at_rev=rev)
+
+    def test_writes_outside_the_prefix_do_not_expire(self, kv):
+        seed(kv)
+        page, rev = kv.range_prefix_page(P, 4)
+        kv.put("/apis/v1/volumes/noise/latest", "1")
+        rest, _ = kv.range_prefix_page(P, 99, start_after=max(page),
+                                       at_rev=rev)
+        assert list(page) + list(rest) == kv.keys_prefix(P)
+
+    def test_never_dup_never_skip_under_churn(self, kv):
+        """The end-to-end contract: whatever interleaves with the walk,
+        the caller either gets the anchored snapshot exactly once or a
+        typed 410 — restart on 410 and the final walk is exact."""
+        seed(kv, n=12)
+        expected = set(kv.keys_prefix(P))
+        mutated = False
+        while True:
+            got: list[str] = []
+            last, rev = "", 0
+            try:
+                while True:
+                    page, rev = kv.range_prefix_page(P, 5, start_after=last,
+                                                     at_rev=rev)
+                    got.extend(page)
+                    if not mutated:
+                        # sabotage mid-walk exactly once
+                        kv.put(f"{P}f5a/latest", "x")
+                        expected.add(f"{P}f5a/latest")
+                        mutated = True
+                    if len(page) < 5:
+                        break
+                    last = max(page)
+            except errors.ContinueExpired:
+                continue  # restart the walk from a fresh anchor
+            assert sorted(got) == sorted(expected)
+            assert len(got) == len(set(got)), "a page walk duplicated keys"
+            return
+
+    def test_requires_positive_limit(self, kv):
+        with pytest.raises(ValueError):
+            kv.range_prefix_page(P, 0)
+
+
+class TestMemoryLogTrim:
+    def test_trimmed_log_expires_instead_of_guessing(self):
+        kv = MemoryKV(log_retain=8)
+        seed(kv, n=4)
+        _, rev = kv.range_prefix_page(P, 2)
+        for i in range(20):  # push the anchor past the trimmed window
+            kv.put(f"/apis/v1/volumes/n{i}/latest", "0")
+        with pytest.raises(errors.ContinueExpired):
+            kv.range_prefix_page(P, 2, start_after=f"{P}f0/latest",
+                                 at_rev=rev)
+
+
+class TestTokens:
+    def test_roundtrip(self):
+        tok = pager.encode_token(Resource.CONTAINERS, 42, f"{P}f3/latest")
+        assert pager.decode_token(tok, Resource.CONTAINERS) == (
+            42, f"{P}f3/latest")
+
+    def test_resource_mismatch_is_bad_request(self):
+        tok = pager.encode_token(Resource.CONTAINERS, 42, "k")
+        with pytest.raises(errors.BadRequest):
+            pager.decode_token(tok, Resource.VOLUMES)
+
+    def test_garbage_is_bad_request(self):
+        for garbage in ("notatoken", "e30", ""):
+            with pytest.raises(errors.BadRequest):
+                pager.decode_token(garbage, Resource.CONTAINERS)
+
+
+class TestListFamilies:
+    def test_folds_latest_pointers_only(self):
+        kv = MemoryKV()
+        kv.put(f"{P}a/latest", "2")
+        kv.put(f"{P}a/v/0000000002", "{}")
+        out = pager.list_families(kv, Resource.CONTAINERS, limit=10)
+        assert out["items"] == [{"name": "a", "version": 2}]
+        assert out["continue"] is None
+
+    def test_walk_visits_every_family_once(self):
+        kv = MemoryKV()
+        for i in range(25):
+            kv.put(f"{P}f{i:02d}/latest", "0")
+            kv.put(f"{P}f{i:02d}/v/0000000000", "{}")
+        names, token = [], ""
+        while True:
+            out = pager.list_families(kv, Resource.CONTAINERS, limit=7,
+                                      token=token)
+            names.extend(it["name"] for it in out["items"])
+            token = out["continue"]
+            if not token:
+                break
+        assert names == sorted(names) and len(names) == 25
+        assert len(set(names)) == 25
+
+    def test_unlimited_is_one_consistent_snapshot(self):
+        kv = MemoryKV()
+        seed(kv, n=5)
+        out = pager.list_families(kv, Resource.CONTAINERS)
+        assert [it["name"] for it in out["items"]] == [
+            f"f{i}" for i in range(5)]
+        assert out["continue"] is None
+
+
+class TestHttpListEndpoints:
+    @pytest.fixture()
+    def prog(self):
+        from tpu_docker_api.config import Config
+        from tpu_docker_api.daemon import Program
+
+        prg = Program(Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            health_watch_interval=0, host_probe_interval_s=0,
+            job_supervise_interval=0, autoscale_interval_s=0,
+            start_port=46000, end_port=46099,
+        ), host="127.0.0.1")
+        prg.init()
+        prg.start()
+        yield prg
+        prg.stop()
+
+    def call(self, prog, method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def _mk(self, prog, name):
+        from tpu_docker_api.schemas.container import ContainerRun
+
+        prog.container_svc.run_container(ContainerRun(
+            image_name="jax", container_name=name, chip_count=0))
+
+    def test_container_walk_over_http(self, prog):
+        for i in range(6):
+            self._mk(prog, f"w{i}")
+        names, token = [], ""
+        while True:
+            q = "/api/v1/containers?limit=4" + (
+                f"&continue={token}" if token else "")
+            out = self.call(prog, "GET", q)
+            assert out["code"] == 200
+            names.extend(it["name"] for it in out["data"]["items"])
+            token = out["data"]["continue"]
+            if not token:
+                break
+        assert names == [f"w{i}" for i in range(6)]
+
+    def test_concurrent_write_is_http_410(self, prog):
+        for i in range(6):
+            self._mk(prog, f"x{i}")
+        out = self.call(prog, "GET", "/api/v1/containers?limit=3")
+        token = out["data"]["continue"]
+        self._mk(prog, "x9")  # mutate under the prefix mid-walk
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self.call(prog, "GET",
+                      f"/api/v1/containers?limit=3&continue={token}")
+        assert err.value.code == 410
+        body = json.loads(err.value.read())
+        assert body["code"] == errors.ContinueExpired.code
+
+    def test_unlimited_legacy_shape(self, prog):
+        self._mk(prog, "solo")
+        out = self.call(prog, "GET", "/api/v1/containers")
+        assert out["data"]["continue"] is None
+        assert out["data"]["items"] == [{"name": "solo", "version": 0}]
+
+    def test_volume_and_job_lists_exist(self, prog):
+        for path in ("/api/v1/volumes", "/api/v1/jobs"):
+            out = self.call(prog, "GET", path + "?limit=5")
+            assert out["code"] == 200
+            assert out["data"]["items"] == []
+
+    def test_services_paged_shape_and_legacy(self, prog):
+        legacy = self.call(prog, "GET", "/api/v1/services")
+        assert legacy["data"] == []
+        paged = self.call(prog, "GET", "/api/v1/services?limit=5")
+        assert paged["data"]["items"] == []
+        assert paged["data"]["continue"] is None

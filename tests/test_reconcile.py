@@ -289,3 +289,214 @@ class TestSchedulerClaims:
         assert env.ports.status()["owners"][40001] == "b"
         # out-of-range ports are conflicts, not silent claims
         assert env.ports.try_claim_ports([99999], owner="b") == [99999]
+
+
+# -- event-driven reconcile (ISSUE 12): the dirty-set + pass modes -------------
+
+
+class DirtyEnv(Env):
+    """Env plus the watch-fed dirty feed: an Informer over the same store
+    wired into a full_interval_s reconciler, exactly the daemon's shape
+    when reconcile_full_interval_s > 0."""
+
+    def __init__(self, tmp_path, log_retain=4096):
+        super().__init__(tmp_path)
+        from tpu_docker_api.state.informer import Informer
+
+        self.kv2 = self.kv  # same store; the feed watches it raw
+        self.rec = Reconciler(
+            self.runtime, self.store, self.chips, self.ports, self.versions,
+            container_svc=self.svc, registry=self.registry,
+            full_interval_s=3600,
+        )
+        self.informer = Informer(self.kv, keys.PREFIX + "/",
+                                 registry=self.registry)
+        self.rec.attach_dirty_feed(self.informer)
+        self.informer.start()
+
+    def wait_quiet(self, timeout_s=10.0):
+        """Sync + the mark counter stable: events drained into the set."""
+        import time
+
+        deadline = time.time() + timeout_s
+        last = -1
+        while time.time() < deadline:
+            if self.informer.synced:
+                cur = self.rec.dirty_view()["marksTotal"]
+                if cur == last:
+                    return
+                last = cur
+            time.sleep(0.05)
+        raise AssertionError("dirty feed never went quiet")
+
+    def close(self):
+        self.informer.close()
+        super().close()
+
+
+@pytest.fixture
+def denv(tmp_path):
+    e = DirtyEnv(tmp_path)
+    yield e
+    e.close()
+
+
+class TestDirtyReconcile:
+    def test_first_pass_full_then_dirty(self, denv):
+        denv.run("a", chips=1)
+        denv.wait_quiet()
+        first = denv.rec.reconcile()
+        assert first["mode"] == "full"  # startup: everything dirty once
+        denv.wait_quiet()
+        second = denv.rec.reconcile()
+        assert second["mode"] == "dirty"
+
+    def test_dirty_pass_visits_only_dirty_families(self, denv):
+        for name in ("a", "b", "c"):
+            denv.run(name, chips=1)
+        denv.wait_quiet()
+        denv.rec.reconcile()  # settle (full)
+        denv.wait_quiet()
+        denv.rec.reconcile()  # drain the marks the settle pass re-emitted
+        denv.svc.stop_container("b-0")
+        denv.wait_quiet()
+        report = denv.rec.reconcile()
+        assert report["mode"] == "dirty"
+        assert report["visitedFamilies"] == 1
+        assert report["actions"] == []  # a clean stop is not drift
+
+    def test_dirty_pass_repairs_kv_visible_drift(self, denv):
+        denv.run("a", chips=1)
+        denv.run("noise", chips=1)
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        # KV-visible drift: a runtime death the watcher would miss plus a
+        # state touch (the put emits the event that marks the family)
+        denv.runtime.crash_container("a-0")
+        denv.store.put_container(denv.store.get_container("a-0"))
+        denv.wait_quiet()
+        report = denv.rec.reconcile()
+        assert report["mode"] == "dirty"
+        assert "restart-dead" in action_kinds(report)
+        assert denv.runtime.container_inspect("a-0").running
+        assert denv.check() == []
+
+    def test_orphan_adoption_through_dirty_pass(self, denv):
+        denv.run("a", chips=0)
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        # the family's stored records vanish (store surgery / interrupted
+        # purge): the delete events mark the family; the dirty pass sees a
+        # pointer naming nothing stored and converges it away, removing
+        # the now-unadoptable runtime member in the SAME pass
+        denv.kv.delete_prefix(keys.family_prefix(
+            keys.Resource.CONTAINERS, "a"))
+        denv.wait_quiet()
+        report = denv.rec.reconcile()
+        assert report["mode"] == "dirty"
+        assert "drop-empty-family" in action_kinds(report)
+        assert denv.versions.get("a") is None
+        assert not denv.runtime.container_exists("a-0")
+
+    def test_forced_modes_and_report(self, denv):
+        denv.wait_quiet()
+        assert denv.rec.reconcile(mode="full")["mode"] == "full"
+        assert denv.rec.reconcile(mode="dirty")["mode"] == "dirty"
+        with pytest.raises(ValueError):
+            denv.rec.reconcile(mode="bogus")
+
+    def test_forced_dirty_honors_pending_full(self, denv):
+        denv.wait_quiet()
+        # fresh feed: full is pending (startup) — a forced dirty pass must
+        # not skip the unaccounted backlog
+        assert denv.rec.dirty_view()["fullPending"] is True
+        assert denv.rec.reconcile(mode="dirty")["mode"] == "full"
+
+    def test_no_feed_always_full(self, env):
+        assert env.rec.reconcile(mode="dirty")["mode"] == "full"
+        assert env.rec.reconcile()["mode"] == "full"
+
+    def test_watch_lost_relist_marks_everything_dirty_once(self, tmp_path):
+        denv = DirtyEnv(tmp_path.joinpath("wl"), log_retain=4)
+        try:
+            # rebuild the store small so ONE burst overflows the watch
+            # buffer deterministically (maxlen rides log_retain)
+            from tpu_docker_api.state.kv import MemoryKV
+            from tpu_docker_api.state.informer import Informer
+
+            kv = MemoryKV(log_retain=4)
+            informer = Informer(kv, keys.PREFIX + "/")
+            rec = Reconciler(
+                denv.runtime, StateStore(kv), denv.chips, denv.ports,
+                VersionMap(kv, keys.VERSIONS_CONTAINER_KEY),
+                registry=MetricsRegistry(), full_interval_s=3600)
+            rec.attach_dirty_feed(informer)
+            informer.start()
+            import time
+
+            deadline = time.time() + 5
+            while not informer.synced and time.time() < deadline:
+                time.sleep(0.02)
+            rec.reconcile()  # consume the startup full
+            assert rec.dirty_view()["fullPending"] is False
+            # one 6-event batch into a 4-slot buffer: overflow => WatchLost
+            # => relist => the hook marks everything dirty again
+            kv.apply([("put", f"{keys.PREFIX}/containers/x{i}/latest", "0")
+                      for i in range(6)])
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if rec.dirty_view()["fullPending"]:
+                    break
+                time.sleep(0.02)
+            assert rec.dirty_view()["fullPending"] is True
+            assert rec.dirty_view()["fullReason"] == "relist"
+            assert rec.reconcile()["mode"] == "full"
+            informer.close()
+        finally:
+            denv.close()
+
+    def test_restart_replays_as_full_pass(self, denv, tmp_path):
+        """The dirty-set is in-process: whatever was dirty when a daemon
+        died is unknown, so a fresh reconciler over the same store starts
+        with a pending full — nothing marked before the death is lost."""
+        denv.run("a", chips=1)
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        # "restart": a second reconciler + feed over the SAME store
+        from tpu_docker_api.state.informer import Informer
+
+        informer2 = Informer(denv.kv, keys.PREFIX + "/")
+        rec2 = Reconciler(
+            denv.runtime, denv.store, denv.chips, denv.ports, denv.versions,
+            container_svc=denv.svc, registry=MetricsRegistry(),
+            full_interval_s=3600)
+        rec2.attach_dirty_feed(informer2)
+        informer2.start()
+        try:
+            assert rec2.dirty_view()["fullPending"] is True
+            assert rec2.reconcile(mode="dirty")["mode"] == "full"
+        finally:
+            informer2.close()
+
+    def test_crash_mid_dirty_pass_reinserts_the_batch(self, denv):
+        from tpu_docker_api.service.crashpoints import SimulatedCrash, armed
+
+        denv.run("a", chips=1)
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        denv.wait_quiet()
+        denv.rec.reconcile()
+        denv.svc.stop_container("a-0")
+        denv.wait_quiet()
+        assert denv.rec.dirty_view()["dirty"]["containers"] == 1
+        with armed("reconcile.dirty_drained"):
+            with pytest.raises(SimulatedCrash):
+                denv.rec.reconcile(mode="dirty")
+        # the drained batch went back: nothing silently lost
+        assert denv.rec.dirty_view()["dirty"]["containers"] == 1
+        report = denv.rec.reconcile(mode="dirty")
+        assert report["visitedFamilies"] == 1

@@ -51,6 +51,7 @@ Route table:
 
 from __future__ import annotations
 
+import collections
 import heapq
 import json
 import logging
@@ -149,8 +150,37 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  job_svc=None, pod_scheduler=None, reconciler=None,
                  job_supervisor=None, host_monitor=None,
                  leader_elector=None, informer=None, fanout=None,
-                 admission=None, serving=None) -> Router:
+                 admission=None, serving=None, compactor=None,
+                 list_default_limit: int = 0,
+                 list_max_limit: int = 5000) -> Router:
+    from tpu_docker_api.state import pager
+    from tpu_docker_api.state.keys import Resource
+
     r = Router(metrics=metrics)
+
+    def _page_params(body) -> tuple[int, str]:
+        """(effective limit, continue token) for a list request. No (or
+        non-positive) ?limit means the configured default — 0 keeps the
+        legacy unbounded single-page scan; an explicit limit is clamped
+        to list_max_limit."""
+        try:
+            # query params arrive as strings; a JSON body may send a number
+            limit = int(body.get("limit", 0))
+        except (TypeError, ValueError):
+            raise errors.BadRequest("limit must be an integer") from None
+        limit = min(limit, list_max_limit) if limit > 0 else list_default_limit
+        token = str(body.get("continue", "") or "")
+        return limit, token
+
+    def _family_list(resource: Resource):
+        def handler(body, **_):
+            limit, token = _page_params(body)
+            # the read-switch store: one bounded rev-anchored page per
+            # request (state/pager.py) — never an O(objects) scan unless
+            # the caller explicitly asked for the legacy unbounded shape
+            return pager.list_families(
+                container_svc.store.kv, resource, limit=limit, token=token)
+        return handler
     # HA role gate (service/leader.py): on a standby replica every non-GET
     # request is answered 503 + the leader hint BEFORE dispatch — reads
     # stay local, mutations belong to the lease holder. None (single-
@@ -247,6 +277,10 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         ))
 
     r.add("POST", "/api/v1/containers", run)
+    # paginated family lists ({items: [{name, version}], continue, rev};
+    # ?limit= + ?continue= walk a rev-anchored snapshot, HTTP 410
+    # ContinueExpired when the prefix moved under the walk)
+    r.add("GET", "/api/v1/containers", _family_list(Resource.CONTAINERS))
     r.add("GET", "/api/v1/containers/{name}", c_info)
     r.add("DELETE", "/api/v1/containers/{name}", c_delete)
     r.add("POST", "/api/v1/containers/{name}/execute", c_exec)
@@ -299,6 +333,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         ))
 
     r.add("POST", "/api/v1/volumes", v_create)
+    r.add("GET", "/api/v1/volumes", _family_list(Resource.VOLUMES))
     r.add("GET", "/api/v1/volumes/{name}", v_info)
     r.add("DELETE", "/api/v1/volumes/{name}", v_delete)
     r.add("PATCH", "/api/v1/volumes/{name}/size", v_patch_size)
@@ -339,6 +374,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             return job_svc.restart_job(name)
 
         r.add("POST", "/api/v1/jobs", j_run)
+        r.add("GET", "/api/v1/jobs", _family_list(Resource.JOBS))
         r.add("GET", "/api/v1/jobs/{name}", j_info)
         r.add("DELETE", "/api/v1/jobs/{name}", j_delete)
         r.add("PATCH", "/api/v1/jobs/{name}/tpu", j_patch_chips)
@@ -386,9 +422,24 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                 raise errors.BadRequest("rps must be a number") from None
             return serving.set_offered_load(name, rps)
 
+        def s_list(body, **_):
+            limit, token = _page_params(body)
+            if limit <= 0 and not token:
+                # legacy shape: the unbounded flat list
+                return serving.list_services()
+            page = pager.list_families(
+                container_svc.store.kv, Resource.SERVICES,
+                limit=limit, token=token)
+            items = []
+            for it in page["items"]:
+                s = serving.service_summary(it["name"])
+                if s is not None:
+                    items.append(s)
+            return {"items": items, "continue": page["continue"],
+                    "rev": page["rev"]}
+
         r.add("POST", "/api/v1/services", s_create)
-        r.add("GET", "/api/v1/services",
-              lambda body, **_: serving.list_services())
+        r.add("GET", "/api/v1/services", s_list)
         r.add("GET", "/api/v1/services/{name}", s_info)
         r.add("PATCH", "/api/v1/services/{name}", s_patch)
         r.add("DELETE", "/api/v1/services/{name}", s_delete)
@@ -447,6 +498,12 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             # preemption counters read back from the metrics registry
             # (one set of books — /healthz and /metrics cannot disagree)
             out["admission"] = admission.health_view()
+        if reconciler is not None:
+            dirty = reconciler.dirty_view()
+            if dirty is not None:
+                # event-driven reconcile health: pending dirty families +
+                # whether the next pass is forced full (startup/relist)
+                out["reconcileDirty"] = dirty
         if job_svc is not None:
             pools = {}
             for hid, host in sorted(job_svc.pod.hosts.items()):
@@ -499,8 +556,15 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                                  host_monitor, leader_elector, informer,
                                  admission, serving)
                      if src is not None]
-            merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
-            return list(merged)[-limit:]
+            # a bounded tail, not a materialize-then-slice: the merge is
+            # lazy, so pushing it through a maxlen deque keeps the cost
+            # O(total ring entries) time and O(limit) MEMORY — building
+            # list(merged) first held every ring's worth of dicts live
+            # per request on a hot observability path
+            tail: collections.deque = collections.deque(
+                heapq.merge(*rings, key=lambda e: e.get("ts", 0)),
+                maxlen=limit)
+            return list(tail)
 
         r.add("GET", "/api/v1/events", h_events)
     if health_watcher is not None:
@@ -525,10 +589,17 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         r.add("GET", "/api/v1/queue", lambda body, **_: work_queue.stats())
     if reconciler is not None:
         # KV-vs-runtime drift sweep (service/reconcile.py); ?dryRun=true
-        # reports the planned repairs without mutating anything
+        # reports the planned repairs without mutating anything; ?mode=
+        # forces the event-driven split (full = anti-entropy O(objects)
+        # scan, dirty = O(changes) watch-fed pass, auto = cadence) and
+        # the report names which one actually ran
         def reconcile_view(body, **_):
             dry = str(body.get("dryRun", "false")).lower() in ("1", "true", "yes")
-            return reconciler.reconcile(dry_run=dry)
+            mode = str(body.get("mode", "auto"))
+            if mode not in ("auto", "full", "dirty"):
+                raise errors.BadRequest(
+                    f"mode must be auto|full|dirty, got {mode!r}")
+            return reconciler.reconcile(dry_run=dry, mode=mode)
 
         r.add("GET", "/api/v1/reconcile", reconcile_view)
         # canonical mutating trigger (GET kept for the reference-style
@@ -536,6 +607,11 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         r.add("POST", "/api/v1/reconcile", reconcile_view)
         r.add("GET", "/api/v1/reconcile/events",
               lambda body, **_: reconciler.events_view())
+    if compactor is not None:
+        # bounded history (service/compactor.py): run one compaction pass
+        # now and return its report (what was trimmed / spared / purged)
+        r.add("POST", "/api/v1/compact",
+              lambda body, **_: compactor.compact_once())
 
     def debug_threads(body, **_):
         """Per-thread stack dump — the pprof-goroutine analog SURVEY.md §5.1

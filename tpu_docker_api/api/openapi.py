@@ -163,6 +163,12 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("POST", "/api/v1/containers", "runContainer",
      "Create a TPU (or cardless) container; allocates chips + host ports, "
      "persists the validated spec, returns name-0", "ContainerRun"),
+    ("GET", "/api/v1/containers", "listContainers",
+     "Paginated family list ({items: [{name, version}], continue, rev}): "
+     "?limit= bounds raw keys scanned per page, ?continue= walks a "
+     "rev-anchored consistent snapshot — a concurrent write under the "
+     "prefix expires the token with HTTP 410 (code 10505), never a "
+     "silent dup/skip", None),
     ("GET", "/api/v1/containers/{name}", "getContainerInfo",
      "Persisted spec + live runtime state; historical versions readable", None),
     ("DELETE", "/api/v1/containers/{name}", "deleteContainer",
@@ -194,6 +200,9 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "Rollback"),
     ("POST", "/api/v1/volumes", "createVolume",
      "Create a named, size-capped volume (overlay2/xfs analog)", "VolumeCreate"),
+    ("GET", "/api/v1/volumes", "listVolumes",
+     "Paginated volume-family list (same limit/continue contract as "
+     "GET /api/v1/containers)", None),
     ("GET", "/api/v1/volumes/{name}", "getVolumeInfo",
      "Persisted volume spec + mountpoint", None),
     ("DELETE", "/api/v1/volumes/{name}", "deleteVolume",
@@ -209,6 +218,9 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("POST", "/api/v1/jobs", "runJob",
      "Place a distributed JAX job: one process container per host over an "
      "ICI-contiguous slice, coordinator + TPU_PROCESS_* env rendered", "JobRun"),
+    ("GET", "/api/v1/jobs", "listJobs",
+     "Paginated job-family list (same limit/continue contract as "
+     "GET /api/v1/containers)", None),
     ("GET", "/api/v1/jobs/{name}", "getJobInfo",
      "Job spec + per-process live state + gang phase/restarts/failureReason; "
      "historical versions readable", None),
@@ -227,7 +239,9 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "job at the service's priority class) behind one declarative record; "
      "the SLO-driven autoscaler owns the replica count", "ServiceCreate"),
     ("GET", "/api/v1/services", "listServices",
-     "Every service: phase, replica counts, last autoscale decision", None),
+     "Every service: phase, replica counts, last autoscale decision; with "
+     "?limit=/?continue= the same rev-anchored pagination contract as "
+     "GET /api/v1/containers ({items, continue, rev})", None),
     ("GET", "/api/v1/services/{name}", "getServiceInfo",
      "Replica fleet detail (per-replica phase/queue position), SLO targets "
      "+ last observed signals, and the last autoscale decision with its "
@@ -301,11 +315,18 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("GET", "/api/v1/reconcile", "reconcile",
      "Sweep KV desired state vs runtime actual state and repair drift "
      "(orphans, half-completed replaces, leaked chips/ports); "
-     "?dryRun=true reports without mutating", None),
+     "?dryRun=true reports without mutating; ?mode=full|dirty|auto "
+     "forces the anti-entropy full scan or the O(changes) watch-fed "
+     "dirty pass — the report's `mode` names which ran", None),
     ("POST", "/api/v1/reconcile", "reconcilePost",
      "Canonical mutating reconcile trigger (same semantics as GET)", None),
     ("GET", "/api/v1/reconcile/events", "getReconcileEvents",
      "Recent drift-repair actions (ring buffer, newest last)", None),
+    ("POST", "/api/v1/compact", "compactHistory",
+     "Run one history-compaction pass now (history_retention_versions > "
+     "0): trim version records past retention — never the latest pointer "
+     "or a live-referenced version — purge settled admission records, "
+     "sweep acked queue markers; returns the trim report", None),
     ("GET", "/api/v1/debug/threads", "getThreadDump",
      "Per-thread stack dump (the pprof-goroutine analog): hung copies and "
      "deadlocked family locks are visible here", None),
@@ -316,6 +337,11 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("GET", "/metrics", "metrics",
      "Prometheus text format: request/latency/chip/port/queue gauges", None),
 ]
+
+
+#: GET list endpoints carrying the limit/continue pagination contract
+_PAGED_LIST_PATHS = {"/api/v1/containers", "/api/v1/volumes",
+                     "/api/v1/jobs", "/api/v1/services"}
 
 
 def build_spec() -> dict:
@@ -345,6 +371,22 @@ def build_spec() -> dict:
                 "description": "base name (latest version) or versioned "
                                "name-N (optimistic concurrency check)",
             }]
+        if method == "GET" and path in _PAGED_LIST_PATHS:
+            op["parameters"] = [
+                {"name": "limit", "in": "query", "required": False,
+                 "schema": _INT,
+                 "description": "max raw keys scanned per page (clamped "
+                                "to list_max_limit; 0/absent = the "
+                                "configured list_default_limit, whose 0 "
+                                "default keeps the legacy unbounded "
+                                "single-page scan)"},
+                {"name": "continue", "in": "query", "required": False,
+                 "schema": _STR,
+                 "description": "opaque token from the previous page; the "
+                                "walk serves one rev-anchored consistent "
+                                "snapshot or fails HTTP 410 "
+                                "ContinueExpired (code 10505)"},
+            ]
         if req_schema:
             op["requestBody"] = {"required": True, "content": {
                 "application/json": {"schema": {

@@ -62,6 +62,28 @@ class Config:
     # periodic reconcile interval; 0 disables the background sweep (the
     # startup pass still runs when reconcile_on_start is true)
     reconcile_interval: float = 0.0
+    # event-driven reconcile (service/reconcile.py DirtySet): > 0 turns
+    # periodic passes O(changes) — a watch-fed dirty-set of family base
+    # names decides what each pass visits, and the full O(objects) scan is
+    # demoted to an anti-entropy pass at most every this many seconds
+    # (out-of-band runtime drift like a manual `docker rm` emits no KV
+    # event, so the full pass must survive — just rarely). 0 (default)
+    # keeps every pass a full scan, byte-for-byte today's behavior.
+    reconcile_full_interval_s: float = 0.0
+    # bounded history (service/compactor.py): keep at most this many
+    # version records per resource family — the latest pointer's version
+    # and any version a live runtime member still references are NEVER
+    # trimmed regardless of age. 0 (default) disables compaction
+    # (unbounded history, today's behavior). >= 2 recommended: a rolling
+    # replace briefly references latest-1.
+    history_retention_versions: int = 0
+    # compaction cadence (a writer: leader-only under leader_election)
+    history_compact_interval_s: float = 60.0
+    # list pagination (state/pager.py): limit applied when a list request
+    # names none (0 = unlimited full scan, the legacy shape) and the hard
+    # cap a request's ?limit is clamped to
+    list_default_limit: int = 0
+    list_max_limit: int = 5000
     # "none" (observe only) | "on-failure" (bounded auto-restart)
     restart_policy: str = "none"
     # per-container restart backoff (service/watch.py): base seconds between
@@ -243,6 +265,22 @@ def load(path: str | None = None) -> Config:
         # a custom ladder without "production": the un-set service default
         # follows the job default instead of failing the whole config
         cfg.service_default_class = cfg.priority_class_default
+    if cfg.reconcile_full_interval_s < 0:
+        raise ValueError(f"reconcile_full_interval_s must be >= 0, "
+                         f"got {cfg.reconcile_full_interval_s}")
+    if cfg.history_retention_versions < 0:
+        raise ValueError(f"history_retention_versions must be >= 0, "
+                         f"got {cfg.history_retention_versions}")
+    if cfg.history_compact_interval_s <= 0:
+        raise ValueError(f"history_compact_interval_s must be > 0, "
+                         f"got {cfg.history_compact_interval_s}")
+    if cfg.list_max_limit < 1:
+        raise ValueError(f"list_max_limit must be >= 1, "
+                         f"got {cfg.list_max_limit}")
+    if cfg.list_default_limit < 0 or cfg.list_default_limit > cfg.list_max_limit:
+        raise ValueError(
+            f"list_default_limit must be in [0, list_max_limit], "
+            f"got {cfg.list_default_limit} (max {cfg.list_max_limit})")
     if cfg.autoscale_interval_s < 0:
         raise ValueError(f"autoscale_interval_s must be >= 0, "
                          f"got {cfg.autoscale_interval_s}")

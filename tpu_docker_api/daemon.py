@@ -293,7 +293,47 @@ class Program:
             # replica set after a crash (missing/surplus/orphan replicas,
             # interrupted deletes and spec rolls)
             serving=self.serving,
+            full_interval_s=cfg.reconcile_full_interval_s,
         )
+        # event-driven reconcile (ROADMAP item 4): feed the reconciler's
+        # dirty-set from the store's watch stream so periodic passes are
+        # O(changes). Reuses the read-path informer when one exists;
+        # otherwise a dedicated reflector over the RAW store (watch is a
+        # read — fencing never applies). reconcile_full_interval_s = 0
+        # (default) skips all of this: every pass stays a full scan.
+        self.reconcile_informer = None
+        if cfg.reconcile_full_interval_s > 0:
+            feed = self.informer
+            if feed is None:
+                from tpu_docker_api.state.informer import Informer
+
+                feed = Informer(raw_kv, keys.PREFIX + "/",
+                                registry=self.metrics)
+                self.reconcile_informer = feed
+            self.reconciler.attach_dirty_feed(feed)
+        # bounded history (service/compactor.py): a writer loop — started
+        # leader-only in _start_writers — trimming version records past
+        # history_retention_versions plus settled admission/marker garbage
+        self.compactor = None
+        if cfg.history_retention_versions > 0:
+            from tpu_docker_api.service.compactor import HistoryCompactor
+
+            self.compactor = HistoryCompactor(
+                self.kv, self.store,
+                maps=[(keys.Resource.CONTAINERS, self.container_versions),
+                      (keys.Resource.VOLUMES, self.volume_versions),
+                      (keys.Resource.JOBS, self.job_versions),
+                      (keys.Resource.SERVICES, self.service_versions)],
+                retention=cfg.history_retention_versions,
+                runtime=self.runtime, pod=self.pod, work_queue=self.wq,
+                interval_s=cfg.history_compact_interval_s,
+                registry=self.metrics,
+                # trim under the same family locks the API flows hold, so
+                # GC can never race a rollback/replace mid-read
+                locks={keys.Resource.CONTAINERS:
+                       self.container_svc.family_lock,
+                       keys.Resource.JOBS: self.job_svc.family_lock},
+            )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
         # but only STARTS when the lease is acquired
@@ -528,11 +568,17 @@ class Program:
             # records) — a writer like the admission loop, leader-only in
             # an HA fleet
             self.serving.start()
+        if self.compactor is not None:
+            # history compaction deletes shared state — a writer like the
+            # loops above, leader-only in an HA fleet
+            self.compactor.start()
 
     def _stop_writers(self) -> None:
         """Halt the writer role (lease loss, shutdown). Every close is
         guarded and restartable: a later re-acquire calls _start_writers
         again on the same instances."""
+        if getattr(self, "compactor", None) is not None:
+            self.compactor.close()
         if getattr(self, "serving", None) is not None:
             self.serving.close()
         if getattr(self, "admission", None) is not None:
@@ -555,6 +601,11 @@ class Program:
             # the elector, so a standby's first GETs can already hit it;
             # until the initial list lands, reads fall through to the store
             self.informer.start()
+        if self.reconcile_informer is not None:
+            # the dirty-feed reflector warms on both roles too: a standby
+            # promoted later must not start its first dirty passes from a
+            # cold, everything-is-dirty state
+            self.reconcile_informer.start()
         if self.leader_elector is None:
             # single-process: writers start unconditionally, as always
             self._start_writers()
@@ -570,6 +621,9 @@ class Program:
             fanout=self.fanout,
             admission=self.admission,
             serving=self.serving,
+            compactor=self.compactor,
+            list_default_limit=self.cfg.list_default_limit,
+            list_max_limit=self.cfg.list_max_limit,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -600,6 +654,8 @@ class Program:
             self.leader_elector.close(release=True)
         if getattr(self, "informer", None) is not None:
             self.informer.close()
+        if getattr(self, "reconcile_informer", None) is not None:
+            self.reconcile_informer.close()
         self._stop_writers()
         if getattr(self, "fanout", None) is not None:
             self.fanout.close()

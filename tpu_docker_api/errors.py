@@ -154,6 +154,19 @@ class WatchLost(ApiError):
     code = 10504
 
 
+class ContinueExpired(ApiError):
+    """A paginated list's ``continue`` token can no longer be honored: the
+    page sequence is rev-anchored (every page serves the SAME store
+    revision the first page did, so a walk never duplicates or skips a
+    key), and either the prefix was mutated past that revision or the
+    backend compacted the history needed to prove it wasn't. The
+    Kubernetes analog is the list API's 410 Gone — surfaced with a real
+    HTTP 410 so clients restart the walk from a fresh first page instead
+    of treating a broken snapshot as data."""
+    code = 10505
+    http_status = 410
+
+
 # --- schedulers (xerrors/scheduler.go:8-10) -----------------------------------
 
 class ChipNotEnough(ApiError):

@@ -10,6 +10,7 @@ smoke test of BASELINE.json config #1 without a docker daemon.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import shutil
 import subprocess
@@ -109,6 +110,29 @@ class FakeRuntime(ContainerRuntime):
     def container_inspect(self, name: str) -> ContainerInfo:
         with self._mu:
             return self._get(name)
+
+    def seed_running(self, names: list[str], spec: ContainerSpec,
+                     running: bool = True) -> None:
+        """Bulk-seed running containers sharing one spec and one data dir
+        — the O(100k)-object scale harness's seam (bench.py scale family,
+        tests). ``container_create`` makes a directory per container; at
+        50k+ seeded objects that is filesystem work the benchmark is not
+        measuring. Seeded containers behave exactly like created+started
+        ones minus the per-container data dir (copies would collide — the
+        scale world never exercises them)."""
+        data_dir = os.path.join(self._root, "seed", "merged")
+        os.makedirs(data_dir, exist_ok=True)
+        with self._mu:
+            for name in names:
+                if name in self._containers:
+                    raise errors.ContainerExisted(name)
+                self._containers[name] = ContainerInfo(
+                    name=name, id=uuid.uuid4().hex[:12], running=running,
+                    spec=dataclasses.replace(spec, name=name),
+                    data_dir=data_dir,
+                    status="running" if running else "exited",
+                    pid=os.getpid() if running else 0,
+                )
 
     def container_exists(self, name: str) -> bool:
         with self._mu:

@@ -163,10 +163,31 @@ SERVICE_CRASH_POINTS = (
     "service.delete.after_mark",
 )
 
+#: event-driven reconcile (service/reconcile.py): the dirty-set is
+#: in-process state derived from the watch stream — a daemon death after
+#: the pass DRAINED it but before the repairs ran must not lose the
+#: families it held. The contract is restart ⇒ full pass (everything is
+#: dirty once), proven by killing here and reconverging from a fresh boot
+RECONCILE_CRASH_POINTS = (
+    "reconcile.dirty_drained",
+)
+
+#: history compactor (service/compactor.py): trims are pure garbage
+#: collection — a crash at either side must leave every latest pointer
+#: and live-referenced version intact, and a re-run must finish the trim
+COMPACTOR_CRASH_POINTS = (
+    # doomed version keys are chosen; NOTHING is deleted yet
+    "compact.before_trim",
+    # the first ≤100-op delete chunk is durable, later chunks are not —
+    # the partially-trimmed family must still serve its latest version
+    "compact.mid_trim",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
                       + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS
-                      + ADMISSION_CRASH_POINTS + SERVICE_CRASH_POINTS)
+                      + ADMISSION_CRASH_POINTS + SERVICE_CRASH_POINTS
+                      + RECONCILE_CRASH_POINTS + COMPACTOR_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

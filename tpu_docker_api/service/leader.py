@@ -404,6 +404,17 @@ class FencedKV(KV):
     def range_prefix_with_rev(self, prefix: str):
         return self.inner.range_prefix_with_rev(prefix)
 
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        return self.inner.keys_prefix(prefix, limit=limit,
+                                      start_after=start_after)
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "", at_rev: int = 0):
+        return self.inner.range_prefix_page(prefix, limit,
+                                            start_after=start_after,
+                                            at_rev=at_rev)
+
     def current_rev(self) -> int:
         return self.inner.current_rev()
 

@@ -68,6 +68,98 @@ log = logging.getLogger(__name__)
 _MAX_FAMILY_PASSES = 5
 
 
+class DirtySet:
+    """Family-granular dirty tracking fed by the store's watch stream.
+
+    Every mutation under a resource prefix marks its family base dirty;
+    a ``dirty``-mode reconcile pass visits ONLY those families, so
+    steady-state control-plane cost is O(changes), not O(objects). Two
+    degraded states fall back to treat-everything-as-dirty
+    (``full_pending``): process start (the set is in-process — whatever
+    was dirty when a daemon died is unknown, so the first pass is full;
+    that IS the durable-replay contract) and a reflector relist (a
+    WatchLost gap swallowed an unknown set of events — the next pass is
+    full once, then event-driven again). Out-of-band RUNTIME drift
+    (``docker rm`` behind the daemon's back) never produces a KV event
+    at all; the periodic anti-entropy full pass exists exactly for it.
+
+    Services are deliberately NOT tracked: the serving adoption sweep
+    already walks every service on EVERY pass (dirty or full) — it is
+    one of the bounded adoption prefixes — so per-family service marks
+    would be collected and never individually consumed.
+    """
+
+    #: kinds the dirty pass visits per family, keyed by key-prefix segment
+    KINDS = (Resource.CONTAINERS.value, Resource.JOBS.value)
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._sets: dict[str, set[str]] = {k: set() for k in self.KINDS}
+        self._full_pending = True
+        self._full_reason = "startup"
+        self.marks_total = 0
+
+    def observe(self, ev) -> None:
+        """Watch-event handler (informer thread): map a state key to its
+        family and mark it. Keys outside the family layout (the
+        versions-map singletons, scheduler state, queue/admission
+        journals) are ignored — the adoption prefixes are scanned every
+        pass regardless, and map singletons always change alongside the
+        family keys on the flows that matter."""
+        from tpu_docker_api.state import keys as _keys
+
+        rest = ev.key[len(_keys.PREFIX) + 1:]
+        parts = rest.split("/")
+        if len(parts) >= 2 and parts[0] in self.KINDS and parts[1]:
+            self.mark(parts[0], parts[1])
+
+    def mark(self, kind: str, base: str) -> None:
+        with self._mu:
+            self._sets[kind].add(base)
+            self.marks_total += 1
+
+    def mark_all(self, reason: str) -> None:
+        with self._mu:
+            self._full_pending = True
+            self._full_reason = reason
+            # the per-family marks are subsumed by the pending full pass
+            for s in self._sets.values():
+                s.clear()
+
+    @property
+    def full_pending(self) -> bool:
+        return self._full_pending
+
+    def peek(self) -> dict[str, set[str]]:
+        """Copy without consuming — dry runs observe, they never eat
+        another pass's work."""
+        with self._mu:
+            return {k: set(s) for k, s in self._sets.items()}
+
+    def drain(self, consume_full: bool = False) -> dict[str, set[str]]:
+        with self._mu:
+            out = self._sets
+            self._sets = {k: set() for k in self.KINDS}
+            if consume_full:
+                self._full_pending = False
+            return out
+
+    def reinsert(self, sets: dict[str, set[str]]) -> None:
+        """Give a drained batch back (the pass died before repairing it)."""
+        with self._mu:
+            for k, s in sets.items():
+                self._sets[k].update(s)
+
+    def status_view(self) -> dict:
+        with self._mu:
+            return {
+                "fullPending": self._full_pending,
+                "fullReason": self._full_reason,
+                "dirty": {k: len(s) for k, s in self._sets.items()},
+                "marksTotal": self.marks_total,
+            }
+
+
 class Reconciler:
     def __init__(
         self,
@@ -88,6 +180,7 @@ class Reconciler:
         fanout: Fanout | None = None,
         admission=None,
         serving=None,
+        full_interval_s: float = 0.0,
     ) -> None:
         self.runtime = runtime
         #: runtime fan-out: the gang member scans, stale-version sweeps
@@ -135,11 +228,34 @@ class Reconciler:
         #: deletes and spec rolls finished
         self._serving = serving
         self._registry = registry if registry is not None else REGISTRY
+        #: event-driven mode (ROADMAP item 4): with a dirty feed attached,
+        #: periodic passes visit only watch-dirtied families and the full
+        #: scan is demoted to a rare anti-entropy pass every
+        #: ``full_interval_s`` seconds (<= 0: every pass is full — the
+        #: legacy behavior, and the safe default without a feed)
+        self._full_interval_s = full_interval_s
+        self._dirty: DirtySet | None = None
+        self._last_full: float | None = None
         self._mu = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=max_events)
         self._last_report: dict | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    def attach_dirty_feed(self, informer) -> None:
+        """Wire the dirty-set to a reflector (state/informer.py) over the
+        RAW store. Must run before ``informer.start()`` so the initial
+        list's synthetic events are observed too. The relist hook is the
+        WatchLost contract: any gap ⇒ the next pass is full, once."""
+        from tpu_docker_api.state import keys as _keys
+
+        self._dirty = DirtySet()
+        for kind in DirtySet.KINDS:
+            informer.register(f"{_keys.PREFIX}/{kind}/", self._dirty.observe)
+        informer.on_relist(lambda: self._dirty.mark_all("relist"))
+
+    def dirty_view(self) -> dict | None:
+        return None if self._dirty is None else self._dirty.status_view()
 
     # -- lifecycle (periodic mode) ------------------------------------------------
 
@@ -166,9 +282,79 @@ class Reconciler:
 
     # -- the sweep ----------------------------------------------------------------
 
-    def reconcile(self, dry_run: bool = False) -> dict:
+    def reconcile(self, dry_run: bool = False, mode: str = "auto") -> dict:
+        """One reconcile pass. ``mode``:
+
+        - ``"auto"`` — event-driven when a dirty feed is attached: a
+          ``dirty`` pass unless the anti-entropy interval elapsed or the
+          dirty-set demands a full pass (startup, relist); ``full``
+          otherwise (no feed ⇒ always full, the legacy behavior);
+        - ``"full"`` / ``"dirty"`` — force either (the API's ?mode=;
+          ``dirty`` without a feed degrades to ``full`` and says so).
+
+        The report carries ``mode`` (which actually ran) so callers and
+        the scale benchmark can assert which cost model they measured."""
+        if mode not in ("auto", "full", "dirty"):
+            raise ValueError(f"mode must be auto|full|dirty, got {mode!r}")
+        effective = mode
+        if self._dirty is None:
+            effective = "full"
+        elif mode == "auto":
+            full_due = (self._full_interval_s <= 0 or self._last_full is None
+                        or (time.monotonic() - self._last_full
+                            >= self._full_interval_s))
+            effective = ("full" if full_due or self._dirty.full_pending
+                         else "dirty")
+        elif mode == "dirty" and self._dirty.full_pending and not dry_run:
+            # a forced dirty pass must not silently skip the families a
+            # gap/restart left unaccounted — honor the pending full
+            effective = "full"
+
         t0 = time.perf_counter()
         actions: list[dict] = []
+        if effective == "dirty":
+            visited = self._reconcile_dirty(actions, dry_run)
+        else:
+            visited = self._reconcile_full(actions, dry_run)
+        report = {
+            "dryRun": dry_run,
+            "mode": effective,
+            "visitedFamilies": visited,
+            "actions": actions,
+            "driftCount": len(actions),
+            "durationMs": round((time.perf_counter() - t0) * 1e3, 2),
+        }
+        self._registry.counter_inc(
+            "reconcile_runs_total",
+            {"dryRun": str(dry_run).lower(), "mode": effective},
+            help="Reconcile sweeps executed")
+        if not dry_run:
+            with self._mu:
+                self._last_report = report
+        if actions:
+            log.info("reconcile[%s]%s: %d repairs: %s", effective,
+                     " (dry-run)" if dry_run else "", len(actions),
+                     [a["action"] for a in actions])
+        return report
+
+    def _reconcile_full(self, actions: list[dict], dry_run: bool) -> int:
+        if self._dirty is not None and not dry_run:
+            # everything is about to be visited: the pending marks (and
+            # any pending full) are subsumed. Events arriving DURING the
+            # sweep stay pending — a family mutated mid-sweep is simply
+            # revisited by the next dirty pass. If the sweep DIES before
+            # finishing, the except below re-demands a full pass — the
+            # families these consumed marks tracked must not fall into
+            # the dirty-only gap until the next anti-entropy interval
+            self._dirty.drain(consume_full=True)
+            try:
+                return self._full_body(actions, dry_run)
+            except BaseException:
+                self._dirty.mark_all("full-pass-aborted")
+                raise
+        return self._full_body(actions, dry_run)
+
+    def _full_body(self, actions: list[dict], dry_run: bool) -> int:
         self._replay_queue_journal(actions, dry_run)
         families = self.versions.snapshot()
         members = self._runtime_members()
@@ -176,14 +362,17 @@ class Reconciler:
         for base in sorted(families):
             if self._svc is not None and not dry_run:
                 with self._svc.family_lock(base):
-                    # under the lock, list fresh — the pre-lock snapshot
-                    # may predate a concurrent mutation
-                    self._reconcile_family(base, actions, dry_run)
+                    # under the lock, re-probe fresh — the pre-lock
+                    # snapshot may predate a concurrent mutation (the
+                    # snapshot's members ride along as probe candidates)
+                    self._reconcile_family(base, actions, dry_run,
+                                           hint=members.get(base, {}))
             else:
                 self._reconcile_family(base, actions, dry_run,
                                        members=members.get(base, {}))
         for base in sorted(set(members) - set(families)):
-            self._reconcile_orphan(base, actions, dry_run)
+            self._reconcile_orphan(base, actions, dry_run,
+                                   hint=members.get(base, {}))
         if self._job_svc is not None and self._job_versions is not None:
             for base in sorted(self._job_versions.snapshot()):
                 try:
@@ -192,6 +381,58 @@ class Reconciler:
                     # abort the sweep (SimulatedCrash, a BaseException,
                     # still propagates — that is the chaos harness's kill)
                     log.exception("job reconcile of %s failed", base)
+        self._adoption_passes(actions, dry_run)
+        self._sweep_foreign_owners(actions, dry_run)
+        if not dry_run:
+            self._last_full = time.monotonic()
+        return len(families) + len(set(members) - set(families))
+
+    def _reconcile_dirty(self, actions: list[dict], dry_run: bool) -> int:
+        """O(changes) pass: only families the watch stream marked since
+        the last drain, plus the adoption prefixes (queue journal,
+        admission records, service fleets — each a bounded scan of
+        PENDING work, not of the object space). The structural sweeps
+        that inherently need the full world (unadoptable-orphan removal,
+        the foreign-owner leak sweep) belong to the anti-entropy full
+        pass and are deliberately absent here."""
+        from tpu_docker_api.service.crashpoints import crash_point
+
+        drained = self._dirty.peek() if dry_run else self._dirty.drain()
+        try:
+            crash_point("reconcile.dirty_drained")
+            self._replay_queue_journal(actions, dry_run)
+            for base in sorted(drained[Resource.CONTAINERS.value]):
+                if self.versions.get(base) is not None:
+                    if self._svc is not None and not dry_run:
+                        with self._svc.family_lock(base):
+                            self._reconcile_family(base, actions, dry_run)
+                    else:
+                        self._reconcile_family(
+                            base, actions, dry_run,
+                            members=self._family_members(base))
+                else:
+                    # pointer gone: adopt from stored versions, or nothing
+                    # (unadoptable runtime leftovers have no KV trace and
+                    # therefore no event — the full pass removes those)
+                    self._reconcile_orphan(base, actions, dry_run)
+            if self._job_svc is not None and self._job_versions is not None:
+                for base in sorted(drained[Resource.JOBS.value]):
+                    try:
+                        self._reconcile_job_family(base, actions, dry_run)
+                    except Exception:  # noqa: BLE001 — as in the full pass
+                        log.exception("job reconcile of %s failed", base)
+            self._adoption_passes(actions, dry_run)
+        except BaseException:
+            # the pass died mid-way (SimulatedCrash, store outage): the
+            # un-repaired families must not vanish from the books — give
+            # the whole drained batch back (repairing twice is safe,
+            # skipping is not)
+            if not dry_run:
+                self._dirty.reinsert(drained)
+            raise
+        return sum(len(s) for s in drained.values())
+
+    def _adoption_passes(self, actions: list[dict], dry_run: bool) -> None:
         if self._serving is not None:
             # Service adoption AFTER the job family passes (a half-created
             # replica version is scrubbed first, so the serving sweep sees
@@ -218,25 +459,6 @@ class Reconciler:
             except Exception as e:  # noqa: BLE001 — a store outage must
                 # not abort the sweep; records are re-read next pass
                 log.warning("reconcile: admission adoption failed: %s", e)
-        self._sweep_foreign_owners(actions, dry_run)
-
-        report = {
-            "dryRun": dry_run,
-            "actions": actions,
-            "driftCount": len(actions),
-            "durationMs": round((time.perf_counter() - t0) * 1e3, 2),
-        }
-        self._registry.counter_inc(
-            "reconcile_runs_total", {"dryRun": str(dry_run).lower()},
-            help="Reconcile sweeps executed")
-        if not dry_run:
-            with self._mu:
-                self._last_report = report
-        if actions:
-            log.info("reconcile%s: %d repairs: %s",
-                     " (dry-run)" if dry_run else "", len(actions),
-                     [a["action"] for a in actions])
-        return report
 
     def _replay_queue_journal(self, actions: list[dict],
                               dry_run: bool) -> None:
@@ -313,20 +535,42 @@ class Reconciler:
         with self._mu:
             self._events.append({"ts": time.time(), "dryRun": dry_run, **entry})
 
-    def _family_members(self, base: str) -> dict[int, str]:
-        return self._runtime_members().get(base, {})
+    def _family_members(self, base: str,
+                        hint=None) -> dict[int, str]:
+        """Runtime members of one family, by BOUNDED candidate probing:
+        inspect only the versions the store knows (history + the latest
+        pointer) plus the caller's hint (the sweep's one runtime listing,
+        when it has one) — never a full ``container_list`` per family.
+        The old per-family full listing made a locked sweep O(N) runtime
+        calls PER FAMILY, i.e. O(N²) per pass at O(100k) objects. A
+        runtime container whose version has no KV trace at all is
+        invisible to the probe — exactly the unadoptable-orphan case the
+        full pass's one-listing orphan sweep owns."""
+        candidates: set[int] = set(
+            self.store.history(Resource.CONTAINERS, base))
+        latest = self.versions.get(base)
+        if latest is not None:
+            candidates.add(latest)
+        if hint:
+            candidates.update(hint)
+        out: dict[int, str] = {}
+        for v in sorted(candidates):
+            name = versioned_name(base, v)
+            if self.runtime.container_exists(name):
+                out[v] = name
+        return out
 
     # -- per-family repair --------------------------------------------------------
 
     def _reconcile_family(self, base: str, actions: list[dict],
                           dry_run: bool, members: dict[int, str] | None = None,
-                          ) -> None:
+                          hint=None) -> None:
         for _ in range(_MAX_FAMILY_PASSES):
             if members is None:
-                # locked path: list fresh under the family lock; refreshed
+                # locked path: probe fresh under the family lock; refreshed
                 # only after a structural repair — the only time it can
                 # change. Unlocked/dry-run callers pass the sweep's snapshot
-                members = self._family_members(base)
+                members = self._family_members(base, hint=hint)
             structural = self._family_pass(base, members, actions, dry_run)
             if not structural or dry_run:
                 # dry-run stops at the first structural repair: the cascade
@@ -483,22 +727,22 @@ class Reconciler:
     # -- orphans ------------------------------------------------------------------
 
     def _reconcile_orphan(self, base: str, actions: list[dict],
-                          dry_run: bool) -> None:
+                          dry_run: bool, hint=None) -> None:
         """Runtime containers whose family has no version pointer."""
         if self._svc is not None and not dry_run:
             with self._svc.family_lock(base):
-                self._orphan_pass(base, actions, dry_run)
+                self._orphan_pass(base, actions, dry_run, hint)
         else:
-            self._orphan_pass(base, actions, dry_run)
+            self._orphan_pass(base, actions, dry_run, hint)
 
     def _orphan_pass(self, base: str, actions: list[dict],
-                     dry_run: bool) -> None:
+                     dry_run: bool, hint=None) -> None:
         # re-check under the family lock: the pre-sweep snapshot may predate
         # a concurrent create (version bumped, container just created) —
         # force-removing that "orphan" would delete a container mid-build
         if self.versions.get(base) is not None:
             return
-        members = self._family_members(base)
+        members = self._family_members(base, hint=hint)
         if not members:
             return
         stored = set(self.store.history(Resource.CONTAINERS, base))

@@ -888,15 +888,23 @@ class ServingService:
             out["acceleratorType"] = st.accelerator_type
         return out
 
+    SUMMARY_KEYS = ("name", "version", "image", "phase", "priorityClass",
+                    "replicas", "readyReplicas", "minReplicas",
+                    "maxReplicas", "lastScale")
+
+    def service_summary(self, base: str) -> dict | None:
+        """One list-entry view (None for a family that vanished between
+        the name scan and the read — lists never 404 mid-walk)."""
+        try:
+            info = self.service_info(base)
+        except errors.ServiceNotExist:
+            return None
+        return {k: info[k] for k in self.SUMMARY_KEYS}
+
     def list_services(self) -> list[dict]:
         out = []
         for base in sorted(self._versions.snapshot()):
-            try:
-                info = self.service_info(base)
-            except errors.ServiceNotExist:
-                continue
-            out.append({k: info[k] for k in
-                        ("name", "version", "image", "phase",
-                         "priorityClass", "replicas", "readyReplicas",
-                         "minReplicas", "maxReplicas", "lastScale")})
+            s = self.service_summary(base)
+            if s is not None:
+                out.append(s)
         return out

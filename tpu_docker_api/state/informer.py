@@ -84,6 +84,7 @@ class Informer:
         #: up to that instant); None = never synced
         self._last_contact: float | None = None
         self._handlers: list[tuple[str, Callable[[WatchEvent], None]]] = []
+        self._relist_hooks: list[Callable[[], None]] = []
         self._events: collections.deque = collections.deque(maxlen=64)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -153,6 +154,16 @@ class Informer:
         seen too."""
         self._handlers.append((prefix, fn))
 
+    def on_relist(self, fn: Callable[[], None]) -> None:
+        """Subscribe to every full list+rewatch cycle — fired AFTER the
+        mirror swap, BEFORE the synthetic diff events. A consumer that
+        derives incremental state from the event stream (the reconciler's
+        dirty-set) uses this to fall back to treat-everything-as-changed:
+        a relist means a gap swallowed an unknown set of events, and the
+        synthetic diff only re-emits what the MIRROR noticed — a derived
+        store with wider state than the mirror must reset, not trust it."""
+        self._relist_hooks.append(fn)
+
     def _fire(self, events: list[WatchEvent]) -> None:
         for ev in events:
             for prefix, fn in self._handlers:
@@ -185,6 +196,11 @@ class Informer:
             "informer_relists_total",
             help="Full list+rewatch cycles (1 = the initial sync; more = "
                  "WatchLost or store-outage recoveries)")
+        for hook in self._relist_hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — one bad hook must not
+                log.exception("informer relist hook failed")
         self._fire(diff)
         return self._kv.watch(self.prefix, rev)
 
@@ -308,6 +324,27 @@ class InformerReadKV(KV):
             # the list-then-watch handshake would lose in-between events
             return self.informer.range_prefix_with_rev(prefix)
         return self.inner.range_prefix_with_rev(prefix)
+
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        if self._serving():
+            self._hit()
+            ks = [k for k in self.informer.range_prefix(prefix)
+                  if k > start_after]
+            return ks[:limit] if limit > 0 else ks
+        return self.inner.keys_prefix(prefix, limit=limit,
+                                      start_after=start_after)
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "",
+                          at_rev: int = 0) -> tuple[dict[str, str], int]:
+        # always the inner store: a page sequence is rev-anchored against
+        # the STORE's revision history, which the mirror cannot prove (its
+        # revs advance with watch lag) — a standby pays the read rather
+        # than risking a silently inconsistent walk
+        return self.inner.range_prefix_page(prefix, limit,
+                                            start_after=start_after,
+                                            at_rev=at_rev)
 
     def current_rev(self) -> int:
         return self.inner.current_rev()

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import abc
 import base64
+import bisect
 import collections
 import sqlite3
 import threading
@@ -131,6 +132,49 @@ class KV(abc.ABC):
     @abc.abstractmethod
     def range_prefix(self, prefix: str) -> dict[str, str]:
         """All key→value pairs whose key starts with ``prefix``, key-sorted."""
+
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        """Sorted keys under ``prefix`` — no value fetch, no deserialize
+        (etcd ``keys_only``, sqlite ``SELECT k``). ``start_after`` is
+        exclusive; ``limit`` ≤ 0 means unbounded. The cheap primitive for
+        callers that only inspect key names (latest-version derivation,
+        marker sweeps): at O(100k) objects, hauling every value over the
+        wire to throw it away was pure waste. Base fallback rides
+        ``range_prefix`` so wrapper/test KVs keep working; real backends
+        override with a values-free scan."""
+        ks = [k for k in self.range_prefix(prefix) if k > start_after]
+        return ks[:limit] if limit > 0 else ks
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "",
+                          at_rev: int = 0) -> tuple[dict[str, str], int]:
+        """One bounded, rev-anchored page: up to ``limit`` key→value pairs
+        with key > ``start_after`` under ``prefix`` (key order), plus the
+        revision the page reflects. ``at_rev = 0`` serves the current
+        state and returns its revision — the first page of a walk;
+        ``at_rev > 0`` must serve the SAME snapshot that revision did, or
+        raise the typed :class:`errors.ContinueExpired` — so a page
+        sequence is a consistent snapshot or a loud 410, never a silent
+        dup/skip. etcd serves old revisions natively (MVCC); memory and
+        sqlite prove no event touched the prefix since ``at_rev`` via
+        their watch logs (a trimmed log ⇒ ContinueExpired, same stance as
+        WatchLost). The base fallback (wrapper/test KVs) pages the full
+        range and can only anchor to the current revision."""
+        if limit <= 0:
+            raise ValueError("range_prefix_page requires limit > 0")
+        cur = self.current_rev()
+        if at_rev > 0 and at_rev != cur:
+            raise errors.ContinueExpired(
+                f"page anchored at rev {at_rev}, store at {cur}")
+        items = {}
+        for k, v in self.range_prefix(prefix).items():
+            if k <= start_after:
+                continue
+            items[k] = v
+            if len(items) >= limit:
+                break
+        return items, cur
 
     def delete_prefix(self, prefix: str) -> None:
         for k in self.range_prefix(prefix):
@@ -273,6 +317,11 @@ class MemoryKV(KV):
 
     def __init__(self, log_retain: int = WATCH_LOG_RETAIN) -> None:
         self._d: dict[str, str] = {}
+        #: sorted slice of the live keys, maintained incrementally (bisect
+        #: insert/remove) so prefix windows and bounded pages are
+        #: O(log N + result) instead of a full sort per call — at O(100k)
+        #: keys, sorting per list request is what made lists O(N log N)
+        self._keys: list[str] = []
         self._mu = threading.Lock()
         self._rev = 0
         self._log_retain = log_retain
@@ -292,9 +341,55 @@ class MemoryKV(KV):
     def delete(self, key: str) -> None:
         self._apply([("delete", key)])
 
+    def _window_locked(self, prefix: str, start_after: str = "") -> tuple[int, int]:
+        """[lo, hi) indices of self._keys inside ``prefix``, past
+        ``start_after`` (exclusive). Caller holds the lock."""
+        lo = bisect.bisect_right(self._keys, max(prefix, start_after)) \
+            if start_after >= prefix else bisect.bisect_left(self._keys, prefix)
+        if not prefix:
+            return lo, len(self._keys)
+        end = _prefix_end(prefix)
+        hi = len(self._keys) if end == "\0" \
+            else bisect.bisect_left(self._keys, end)
+        return lo, hi
+
     def range_prefix(self, prefix: str) -> dict[str, str]:
         with self._mu:
-            return {k: v for k, v in sorted(self._d.items()) if k.startswith(prefix)}
+            lo, hi = self._window_locked(prefix)
+            return {k: self._d[k] for k in self._keys[lo:hi]}
+
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        with self._mu:
+            lo, hi = self._window_locked(prefix, start_after)
+            if limit > 0:
+                hi = min(hi, lo + limit)
+            return self._keys[lo:hi]
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "",
+                          at_rev: int = 0) -> tuple[dict[str, str], int]:
+        if limit <= 0:
+            raise ValueError("range_prefix_page requires limit > 0")
+        with self._mu:
+            if at_rev > 0:
+                # serve at_rev iff we can PROVE the prefix is untouched
+                # since then: every event after at_rev is still in the log
+                # (else the proof is gone — same stance as WatchLost) and
+                # none of them landed under the prefix
+                if at_rev < self._trimmed_below:
+                    raise errors.ContinueExpired(
+                        f"page anchored at rev {at_rev}, log trimmed "
+                        f"through {self._trimmed_below}")
+                for ev in self._log:
+                    if ev.rev > at_rev and ev.key.startswith(prefix):
+                        raise errors.ContinueExpired(
+                            f"prefix {prefix!r} mutated at rev {ev.rev} "
+                            f"past the page anchor {at_rev}")
+            lo, hi = self._window_locked(prefix, start_after)
+            hi = min(hi, lo + limit)
+            return ({k: self._d[k] for k in self._keys[lo:hi]},
+                    at_rev or self._rev)
 
     def delete_prefix(self, prefix: str) -> None:
         # one lock hold, not one delete per key — the purge paths submit a
@@ -307,9 +402,8 @@ class MemoryKV(KV):
 
     def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
         with self._mu:
-            snap = {k: v for k, v in sorted(self._d.items())
-                    if k.startswith(prefix)}
-            return snap, self._rev
+            lo, hi = self._window_locked(prefix)
+            return {k: self._d[k] for k in self._keys[lo:hi]}, self._rev
 
     def watch(self, prefix: str, start_rev: int = 0) -> Watch:
         w = _MemoryWatch(self, prefix, maxlen=self._log_retain)
@@ -345,16 +439,20 @@ class MemoryKV(KV):
 
             for op in ops:
                 if op[0] == "put":
+                    if op[1] not in self._d:
+                        bisect.insort(self._keys, op[1])
                     self._d[op[1]] = op[2]
                     emit("put", op[1], op[2])
                 elif op[0] == "delete":
                     if self._d.pop(op[1], None) is not None:
+                        self._keys.pop(bisect.bisect_left(self._keys, op[1]))
                         emit("delete", op[1], None)
                 else:
-                    for k in [k for k in sorted(self._d)
-                              if k.startswith(op[1])]:
+                    lo, hi = self._window_locked(op[1])
+                    for k in self._keys[lo:hi]:
                         del self._d[k]
                         emit("delete", k, None)
+                    del self._keys[lo:hi]
             for ev in events:
                 if len(self._log) >= self._log_retain:
                     self._trimmed_below = self._log.popleft().rev
@@ -485,6 +583,65 @@ class SqliteKV(KV):
                 f"SELECT k, v FROM kv WHERE {where} ORDER BY k", params,
             ).fetchall()
         return dict(rows)
+
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        """Keys only — never deserializes a value row (``SELECT k`` rides
+        the primary-key index end to end)."""
+        where, params = self._prefix_where(prefix)
+        if start_after:
+            where += " AND k > ?"
+            params = params + (start_after,)
+        sql = f"SELECT k FROM kv WHERE {where} ORDER BY k"
+        if limit > 0:
+            sql += " LIMIT ?"
+            params = params + (limit,)
+        with self._mu:
+            rows = self._conn.execute(sql, params).fetchall()
+        return [k for (k,) in rows]
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "",
+                          at_rev: int = 0) -> tuple[dict[str, str], int]:
+        """One bounded SELECT (``k > ? AND k < ? ORDER BY k LIMIT ?``)
+        inside one read transaction with the rev-anchor proof: the page is
+        served at ``at_rev`` only if the changelog still covers every
+        event past it AND none of those events touched the prefix — the
+        same one-WAL-snapshot discipline as ``_read_log_since``."""
+        if limit <= 0:
+            raise ValueError("range_prefix_page requires limit > 0")
+        where, params = self._prefix_where(prefix)
+        page_where, page_params = where, params
+        if start_after:
+            page_where += " AND k > ?"
+            page_params = page_params + (start_after,)
+        with self._mu:
+            try:
+                self._conn.execute("BEGIN")
+                if at_rev > 0:
+                    trim_rev = int(self._conn.execute(
+                        "SELECT v FROM kv_meta WHERE k = 'trim_rev'"
+                    ).fetchone()[0])
+                    if at_rev < trim_rev:
+                        raise errors.ContinueExpired(
+                            f"page anchored at rev {at_rev}, changelog "
+                            f"trimmed through {trim_rev}")
+                    touched = self._conn.execute(
+                        f"SELECT rev FROM kv_log WHERE rev > ? AND {where} "
+                        f"LIMIT 1", (at_rev,) + params).fetchone()
+                    if touched is not None:
+                        raise errors.ContinueExpired(
+                            f"prefix {prefix!r} mutated at rev {touched[0]} "
+                            f"past the page anchor {at_rev}")
+                rows = self._conn.execute(
+                    f"SELECT k, v FROM kv WHERE {page_where} ORDER BY k "
+                    f"LIMIT ?", page_params + (limit,)).fetchall()
+                rev = at_rev or self._current_rev_locked()
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+        return dict(rows), rev
 
     def delete_prefix(self, prefix: str) -> None:
         """One transaction: a single bounded DELETE statement for the data
@@ -713,6 +870,50 @@ class EtcdKV(KV):
         out = {_unb64_key(kv["key"]): _unb64(kv["value"])
                for kv in resp.get("kvs", [])}
         return dict(sorted(out.items()))
+
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        """Native ``keys_only`` range: the server never ships a value byte."""
+        body = {"key": _b64(max(prefix, start_after + "\0")),
+                "range_end": _b64(_prefix_end(prefix)), "keys_only": True}
+        if limit > 0:
+            body["limit"] = str(limit)
+        resp = self._post("/v3/kv/range", body, idempotent=True)
+        return sorted(_unb64_key(kv["key"]) for kv in resp.get("kvs", []))
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "",
+                          at_rev: int = 0) -> tuple[dict[str, str], int]:
+        """Native MVCC page: ``limit`` + ``key`` (start_after + one NUL =
+        the smallest strictly-greater key) + ``revision`` on
+        ``/v3/kv/range``, so etcd itself serves every page of a walk at
+        the first page's revision. A compacted revision comes back as the
+        gateway's 400 ``...required revision has been compacted`` —
+        mapped to the typed ContinueExpired, exactly like Kubernetes'
+        410 Gone."""
+        if limit <= 0:
+            raise ValueError("range_prefix_page requires limit > 0")
+        body = {"key": _b64(max(prefix, start_after + "\0")),
+                "range_end": _b64(_prefix_end(prefix)),
+                "limit": str(limit)}
+        if at_rev > 0:
+            body["revision"] = str(at_rev)
+        try:
+            resp = self._post("/v3/kv/range", body, idempotent=True)
+        except self._requests.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.response.json().get("error", "")
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                detail = getattr(e.response, "text", "")[:200]
+            if "compacted" in detail:
+                raise errors.ContinueExpired(
+                    f"revision {at_rev} compacted: {detail}") from e
+            raise
+        out = {_unb64_key(kv["key"]): _unb64(kv["value"])
+               for kv in resp.get("kvs", [])}
+        return (dict(sorted(out.items())),
+                at_rev or int(resp.get("header", {}).get("revision", 0)))
 
     def current_rev(self) -> int:
         resp = self._post("/v3/kv/range", {"key": _b64("\0"), "limit": 1},
@@ -965,6 +1166,29 @@ class CountingKV(KV):
     def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
         self._count("range_prefix")
         return self.inner.range_prefix_with_rev(prefix)
+
+    def keys_prefix(self, prefix: str, limit: int = 0,
+                    start_after: str = "") -> list[str]:
+        self._count("keys_prefix")
+        return self.inner.keys_prefix(prefix, limit=limit,
+                                      start_after=start_after)
+
+    def range_prefix_page(self, prefix: str, limit: int,
+                          start_after: str = "",
+                          at_rev: int = 0) -> tuple[dict[str, str], int]:
+        self._count("range_prefix_page")
+        return self.inner.range_prefix_page(prefix, limit,
+                                            start_after=start_after,
+                                            at_rev=at_rev)
+
+    READ_METHODS = ("get", "range_prefix", "keys_prefix",
+                    "range_prefix_page")
+
+    def reads(self) -> int:
+        """Total store read round trips so far (the scale family's gated
+        quantity; watch streams are amortized and deliberately excluded)."""
+        with self._mu:
+            return sum(self.counts.get(m, 0) for m in self.READ_METHODS)
 
     def current_rev(self) -> int:
         return self.inner.current_rev()

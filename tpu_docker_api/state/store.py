@@ -56,11 +56,13 @@ class StateStore:
         return None if raw is None else int(raw)
 
     def history(self, resource: Resource, base: str) -> list[int]:
-        """Stored versions, oldest first — sorted numerically (KV prefix
-        scans return keys lexicographically, which puts v10 before v2)."""
+        """Stored versions, oldest first — sorted numerically (zero-padded
+        keys are already key-sorted, but parse-and-sort keeps this robust
+        to hand-written keys). Keys-only scan: deriving which versions
+        exist must not haul every version's full JSON over the wire."""
         prefix = f"{keys.PREFIX}/{resource.value}/{base}/v/"
         return sorted(
-            int(k.rsplit("/", 1)[1]) for k in self.kv.range_prefix(prefix))
+            int(k.rsplit("/", 1)[1]) for k in self.kv.keys_prefix(prefix))
 
     def delete_family(self, resource: Resource, name: str) -> None:
         """Drop every version + the latest pointer (delEtcdInfo semantics)."""

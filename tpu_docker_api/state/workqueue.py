@@ -719,6 +719,13 @@ class WorkQueue:
             self._sweep_orphan_markers(records)
         return outcomes
 
+    def sweep_orphan_markers(self) -> None:
+        """Public GC entry (service/compactor.py rides it): drop acked
+        copy-complete markers whose journal record is gone. Same
+        best-effort contract as the replay-time sweep — a failure logs
+        and waits for the next pass, never raises."""
+        self._sweep_orphan_markers()
+
     def _sweep_orphan_markers(self, records: list[TaskRecord] | None = None
                               ) -> None:
         """GC markers whose record is gone — a daemon death between _ack's
@@ -736,7 +743,9 @@ class WorkQueue:
             with self._local_mu:
                 live |= self._local_ids
             doomed = [
-                key for key in self._kv.range_prefix(keys.QUEUE_MARKERS_PREFIX)
+                # keys-only: marker values are never inspected here, and at
+                # scale the orphan sweep must not deserialize the backlog
+                key for key in self._kv.keys_prefix(keys.QUEUE_MARKERS_PREFIX)
                 if key.rsplit("/", 1)[-1] not in live
             ]
             # batched deletes, chunked under etcd's max-txn-ops (default
