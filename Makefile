@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn bench-failover bench-reads bench-fanout bench-preempt bench-serve-scale bench-scale openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-serve-scale bench-scale openapi sample-interface run clean
 
 all: native openapi
 
@@ -46,6 +46,11 @@ bench-churn:                 ## control-plane churn family, reduced iters (fake 
 	$(PY) bench.py --control-plane --cp-family churn --cp-iters 40 --churn-gangs 6 > bench-churn.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-churn.json.tmp
 	mv bench-churn.json.tmp bench-churn.json
+
+trace-check:                 ## tiny churn run asserting the trace completeness gate (one rooted trace per flow, >=80% coverage, async tail on-trace, disabled-mode <=1%)
+	$(PY) bench.py --control-plane --cp-family churn --cp-iters 4 --churn-gangs 2 > bench-trace.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-trace.json.tmp
+	rm bench-trace.json.tmp
 
 bench-failover:              ## HA failover family: kill the leader under churn, time-to-recovered-writes + schema gate
 	$(PY) bench.py --control-plane --cp-family failover --failovers 4 > bench-failover.json.tmp

@@ -340,6 +340,53 @@ def validate_fanout(extra: dict) -> list[str]:
     return problems
 
 
+TRACE_FLOWS = ("container_create", "container_replace", "container_delete",
+               "gang_create", "gang_delete")
+
+
+def validate_trace(extra: dict) -> list[str]:
+    """The trace completeness gate riding the churn family (ISSUE 14) —
+    re-checked at the schema layer, not just ``gates.ok``: a flow whose
+    trace lost its root, grew invisible time (coverage < the floor), or
+    dropped the async purge tail must fail loudly even if the in-bench
+    gate arithmetic regresses."""
+    problems: list[str] = []
+    tr = extra.get("trace") or {}
+    flows = tr.get("flows") or {}
+    gates = extra.get("gates") or {}
+    floor = gates.get("trace_coverage_min")
+    if not _num(floor) or not 0 < floor <= 1:
+        problems.append(f"trace: gates.trace_coverage_min must be in "
+                        f"(0, 1], got {floor!r}")
+        floor = 0.8
+    for flow in TRACE_FLOWS:
+        f = flows.get(flow) or {}
+        if f.get("rooted") is not True:
+            problems.append(f"trace: flow {flow} did not yield exactly one "
+                            f"rooted trace ({f.get('rooted')!r})")
+        cov = f.get("coverage")
+        if not _num(cov) or cov < floor:
+            problems.append(f"trace: flow {flow} span coverage {cov!r} is "
+                            f"below the {floor} floor — invisible time")
+        if not (isinstance(f.get("spans"), int) and f["spans"] >= 2):
+            problems.append(f"trace: flow {flow} recorded {f.get('spans')!r} "
+                            f"spans — the handler tree is missing")
+    tail = (flows.get("container_delete") or {}).get("asyncTailSpans")
+    if not (isinstance(tail, int) and tail >= 1):
+        problems.append(f"trace: container delete's async purge ran OFF its "
+                        f"trace (asyncTailSpans {tail!r}) — the queue "
+                        f"journal lost the context")
+    pct = gates.get("trace_disabled_overhead_pct")
+    budget = gates.get("trace_disabled_overhead_budget_pct")
+    if not _num(pct) or not _num(budget) or pct > budget:
+        problems.append(f"trace: disabled-mode accounting {pct!r}% blew the "
+                        f"{budget!r}% budget")
+    for key in ("trace_rooted", "trace_async_tail", "trace_ok"):
+        if gates.get(key) is not True:
+            problems.append(f"trace: gates.{key} is not true")
+    return problems
+
+
 def validate_lines(lines: list[dict]) -> list[str]:
     """Return every schema violation found (empty = consumable)."""
     problems: list[str] = []
@@ -406,6 +453,7 @@ def validate_lines(lines: list[dict]) -> list[str]:
             problems.append(f"churn: gates.{key} missing")
     if gates.get("ok") is not True:
         problems.append(f"churn: regression gate failed: {gates}")
+    problems.extend(validate_trace(extra))
     return problems
 
 

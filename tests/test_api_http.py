@@ -363,3 +363,198 @@ class TestDurableQueueRoutes:
             assert "retry later" in body["msg"]
         finally:
             gate.set()  # unwedge so fixture teardown's close() drains fast
+
+
+class TestTraceRoutes:
+    """The tracing surface (ISSUE 14): per-request root spans keyed by
+    X-Request-Id/traceparent, the /api/v1/traces exporters, the requestId
+    echo in the error envelope, the events?traceId= join, and the
+    http_requests_total/http_request_ms satellite metrics."""
+
+    @pytest.fixture
+    def traced(self, tmp_path):
+        from tpu_docker_api.telemetry.trace import Tracer
+
+        kv = MemoryKV()
+        store = StateStore(kv)
+        runtime = FakeRuntime(root=str(tmp_path), allow_exec=True)
+        chips = ChipScheduler(HostTopology.build("v5e-8"), kv)
+        ports = PortScheduler(kv, 40100, 40199)
+        tracer = Tracer(buffer_size=32, slow_ms=0.0001)
+        wq = WorkQueue(kv, tracer=tracer)
+        wq.start()
+        c_svc = ContainerService(
+            runtime, store, chips, ports,
+            VersionMap(kv, keys.VERSIONS_CONTAINER_KEY), wq,
+        )
+        v_svc = VolumeService(runtime, store,
+                              VersionMap(kv, keys.VERSIONS_VOLUME_KEY), wq)
+        srv = ApiServer(build_router(c_svc, v_svc, chips, ports,
+                                     work_queue=wq, tracer=tracer), port=0)
+        srv.start()
+        srv.wq = wq
+        srv.tracer = tracer
+        yield srv
+        srv.close()
+        wq.close()
+
+    def _call(self, server, method, path, body=None, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read()), dict(resp.headers)
+
+    def test_request_id_is_the_trace_id(self, traced):
+        out, hdr = self._call(traced, "POST", "/api/v1/containers",
+                              {"imageName": "jax", "containerName": "tr",
+                               "chipCount": 2},
+                              headers={"X-Request-Id": "req42"})
+        assert out["code"] == 200
+        assert hdr["X-Request-Id"] == "req42"
+        tree, _ = self._call(traced, "GET", "/api/v1/traces/req42")
+        spans = tree["data"]["spans"]
+        roots = [s for s in spans if not s["parentId"]]
+        assert len(roots) == 1
+        assert roots[0]["name"] == "http:POST /api/v1/containers"
+        assert roots[0]["attrs"]["requestId"] == "req42"
+        names = {s["name"] for s in spans}
+        assert "dispatch:/api/v1/containers" in names
+        assert "kv.apply" in names
+        assert "sched.chips.claim" in names
+
+    def test_traceparent_continues_remote_context(self, traced):
+        tid = "0af7651916cd43dd8448eb211c80319c"
+        sid = "b7ad6b7169203331"
+        self._call(traced, "GET", "/api/v1/resources/tpus",
+                   headers={"traceparent": f"00-{tid}-{sid}-01"})
+        tree, _ = self._call(traced, "GET", f"/api/v1/traces/{tid}")
+        http_span = next(s for s in tree["data"]["spans"]
+                         if s["name"].startswith("http:"))
+        assert http_span["parentId"] == sid  # remote parent, not a root
+
+    def test_error_envelope_carries_request_id(self, traced):
+        out, hdr = self._call(traced, "GET", "/api/v1/containers/nope-1",
+                              headers={"X-Request-Id": "bugreport7"})
+        assert out["code"] == 10302
+        assert out["requestId"] == "bugreport7"
+        assert hdr["X-Request-Id"] == "bugreport7"
+        # success envelopes keep the legacy three-key shape
+        ok, _ = self._call(traced, "GET", "/api/v1/resources/tpus")
+        assert set(ok) == {"code", "msg", "data"}
+
+    def test_trace_list_and_unknown_trace(self, traced):
+        self._call(traced, "GET", "/api/v1/resources/tpus",
+                   headers={"X-Request-Id": "listme"})
+        ls, _ = self._call(traced, "GET", "/api/v1/traces?limit=5")
+        data = ls["data"]
+        assert data["enabled"] is True
+        assert any(i["traceId"] == "listme" for i in data["items"])
+        assert data["items"][0]["rootCount"] == 1
+        missing, _ = self._call(traced, "GET", "/api/v1/traces/ghost")
+        assert missing["code"] == 10501
+
+    def test_events_filter_by_trace_id(self, traced):
+        # slow_ms is armed at ~0: every request emits a slow-trace event
+        self._call(traced, "GET", "/api/v1/resources/tpus",
+                   headers={"X-Request-Id": "evta"})
+        self._call(traced, "GET", "/api/v1/resources/ports",
+                   headers={"X-Request-Id": "evtb"})
+        evts, _ = self._call(traced, "GET", "/api/v1/events?traceId=evta")
+        assert evts["data"], "no events matched the trace"
+        assert all(e["traceId"] == "evta" for e in evts["data"])
+        allevts, _ = self._call(traced, "GET", "/api/v1/events")
+        assert len(allevts["data"]) > len(evts["data"])
+
+    def test_http_metrics_exposed(self, traced):
+        self._call(traced, "GET", "/api/v1/resources/tpus")
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{traced.port}/metrics").read().decode()
+        assert "# TYPE http_requests_total counter" in text
+        assert ('http_requests_total{code="200",method="GET",'
+                'route="/api/v1/resources/tpus"}') in text
+        assert "# TYPE http_request_ms histogram" in text
+        assert 'http_request_ms_bucket{le="+Inf"' in text
+
+    def test_async_tail_joins_the_request_trace(self, traced):
+        self._call(traced, "POST", "/api/v1/containers",
+                   {"imageName": "jax", "containerName": "tail",
+                    "chipCount": 0})
+        self._call(traced, "DELETE", "/api/v1/containers/tail",
+                   {"force": True, "delEtcdInfoAndVersionRecord": True},
+                   headers={"X-Request-Id": "deltail"})
+        traced.wq.drain()
+        tree, _ = self._call(traced, "GET", "/api/v1/traces/deltail")
+        names = [s["name"] for s in tree["data"]["spans"]]
+        assert "queue.task:delete_state_family" in names
+
+    def test_dual_header_trace_reachable_by_request_id(self, traced):
+        tid = "1af7651916cd43dd8448eb211c80319c"
+        self._call(traced, "GET", "/api/v1/resources/tpus",
+                   headers={"traceparent": f"00-{tid}-b7ad6b7169203331-01",
+                            "X-Request-Id": "proxyreq"})
+        # keyed by the traceparent id, but the runbook greps by the
+        # echoed requestId — the fallback root-attr index serves it
+        tree, _ = self._call(traced, "GET", "/api/v1/traces/proxyreq")
+        assert tree["code"] == 200
+        assert tree["data"]["traceId"] == tid
+
+    def test_events_filter_reaches_past_the_limit_window(self, traced):
+        self._call(traced, "GET", "/api/v1/resources/tpus",
+                   headers={"X-Request-Id": "oldtrace"})
+        # flood the tracer ring with newer slow-trace events (ring holds
+        # 128) so oldtrace's event falls outside the newest-20 window
+        for _ in range(40):
+            self._call(traced, "GET", "/api/v1/resources/ports")
+        unfiltered, _ = self._call(traced, "GET", "/api/v1/events?limit=20")
+        assert all(e.get("traceId") != "oldtrace" for e in unfiltered["data"])
+        filtered, _ = self._call(traced, "GET",
+                                 "/api/v1/events?traceId=oldtrace&limit=20")
+        assert filtered["data"], "filter lost events older than the window"
+        assert all(e["traceId"] == "oldtrace" for e in filtered["data"])
+
+    def test_crlf_in_request_id_cannot_split_response(self, traced):
+        import socket
+
+        # http.client's parse_headers preserves obs-fold CRLFs inside a
+        # header value — an unsanitized echo would emit the injected line
+        # as a real response header (response splitting)
+        raw = (b"GET /api/v1/resources/tpus HTTP/1.1\r\n"
+               b"Host: x\r\n"
+               b"X-Request-Id: abc\r\n Set-Cookie: pwned=1\r\n"
+               b"Connection: close\r\n\r\n")
+        with socket.create_connection(("127.0.0.1", traced.port)) as s:
+            s.sendall(raw)
+            resp = b""
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                resp += chunk
+        head = resp.split(b"\r\n\r\n", 1)[0].decode()
+        # the injected text may survive INSIDE the echoed value (harmless,
+        # one line) — what must never exist is a separate header LINE
+        lines = head.split("\r\n")
+        assert not any(ln.lower().startswith("set-cookie:")
+                       for ln in lines), head
+        echoed = next(ln for ln in lines
+                      if ln.lower().startswith("x-request-id:"))
+        assert "\r" not in echoed and "\n" not in echoed
+
+    def test_traceparent_continued_request_is_still_a_local_root(self, traced):
+        tid = "3af7651916cd43dd8448eb211c80319c"
+        _, hdr = self._call(
+            traced, "GET", "/api/v1/resources/tpus",
+            headers={"traceparent": f"00-{tid}-b7ad6b7169203331-01"})
+        # the W3C echo names the serving span
+        out_tp = hdr.get("traceparent", "")
+        assert out_tp.startswith(f"00-{tid}-")
+        # remote parentage does not demote the handler span: it is the
+        # LOCAL root (summaries count it, slow_ms fires on it)
+        ls = traced.tracer.summaries(limit=50)
+        entry = next(i for i in ls["items"] if i["traceId"] == tid)
+        assert entry["rootCount"] == 1
+        assert any(e.get("traceId") == tid
+                   for e in traced.tracer.events_view(limit=500))

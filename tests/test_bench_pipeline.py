@@ -584,3 +584,69 @@ def test_dead_backend_degrades_to_control_plane_evidence():
     last = lines[-1]
     assert last["metric"] == "bench_degraded"
     assert last["rc"] == 0 and last["value"] >= 1
+
+
+class TestTraceFamily:
+    """The trace completeness gate riding the churn family (``make
+    trace-check``): at tiny scale, every audited flow must yield one
+    rooted trace with >= 80% span coverage, the container delete's async
+    purge tail must ride its trace, and the disabled-mode accounting must
+    stay within 1% of the flow p50 — pinned in tier-1 with the schema
+    checker's validate_trace tamper checks."""
+
+    @pytest.fixture(scope="class")
+    def churn(self):
+        return bench.measure_control_plane_churn(n_containers=3, n_gangs=2)
+
+    def test_trace_gates_hold(self, churn):
+        tr = churn["trace"]
+        gates = churn["gates"]
+        assert gates["trace_ok"] is True
+        assert gates["trace_rooted"] is True
+        assert gates["trace_async_tail"] is True
+        assert gates["trace_coverage_worst"] >= gates["trace_coverage_min"]
+        assert (gates["trace_disabled_overhead_pct"]
+                <= gates["trace_disabled_overhead_budget_pct"])
+        flows = tr["flows"]
+        assert set(flows) == {"container_create", "container_replace",
+                              "container_delete", "gang_create",
+                              "gang_delete"}
+        for flow, f in flows.items():
+            assert f["rooted"] is True, flow
+            assert f["coverage"] >= 0.8, (flow, f)
+            assert f["spans"] >= 2, flow
+            assert f["rootMs"] > 0, flow
+        # the async purge tail landed in the SAME trace as the delete
+        assert flows["container_delete"]["asyncTailSpans"] >= 1
+        assert tr["enabled"] is True
+        # a real disabled-mode pass ran for the record
+        assert tr["disabled_create_ms_p50"] > 0
+
+    def test_schema_checker_pins_the_trace_invariants(self, churn):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_churn_create_ready_ms_p50",
+                "value": churn["create_ready_ms_p50"], "unit": "ms",
+                "vs_baseline": 1.0, "extra": churn}
+        assert validate_lines([line]) == []
+        # not a rubber stamp: a lost root must fail at the schema layer
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["trace"]["flows"]["gang_create"]["rooted"] = False
+        assert any("rooted" in p for p in validate_lines([bad]))
+        # ... so must invisible time ...
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["trace"]["flows"]["container_create"]["coverage"] = 0.5
+        assert any("coverage" in p for p in validate_lines([bad]))
+        # ... a purge tail that escaped its trace ...
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["trace"]["flows"]["container_delete"][
+            "asyncTailSpans"] = 0
+        assert any("async" in p for p in validate_lines([bad]))
+        # ... and a blown disabled-mode budget
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["trace_disabled_overhead_pct"] = 5.0
+        assert any("budget" in p for p in validate_lines([bad]))

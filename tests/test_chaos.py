@@ -1590,3 +1590,75 @@ class TestScaleChaos:
                                 prg2.chip_scheduler,
                                 prg2.port_scheduler) == []
         assert prg2.reconciler.reconcile()["actions"] == []
+
+
+class TestTraceChaos:
+    """Trace parity with the kill -9 model (ISSUE 14): a SimulatedCrash at
+    any crash point must never corrupt the trace buffer or leak an open
+    span (the in-flight spans close as status="lost"), and a record
+    replayed by the NEXT daemon records link=originTraceId — span links,
+    not parentage, across process death."""
+
+    @pytest.mark.parametrize("point", _REPLACE_POINTS + TXN_CRASH_POINTS)
+    def test_crash_closes_spans_lost_and_buffer_survives(
+            self, tmp_path, point):
+        kv, runtime = MemoryKV(), FakeRuntime(root=str(tmp_path))
+        prg = boot(kv, runtime)
+        setup_family(prg, tmp_path)
+        tracer = prg.tracer
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                with tracer.span("http:PATCH /containers/{name}/tpu") as root:
+                    _grow(prg.container_svc)
+        # the kill unwound every scope: nothing open, and the crashed
+        # flow's trace is intact and readable with a lost root
+        assert tracer.stats()["openSpans"] == 0
+        view = tracer.trace_view(root.trace_id)
+        assert view is not None
+        statuses = {s["name"]: s["status"] for s in view["spans"]}
+        assert statuses["http:PATCH /containers/{name}/tpu"] == "lost"
+        assert tracer.summaries()["items"][0]["status"] == "lost"
+        # ... and the fresh daemon reconciles the wreckage as usual
+        prg2 = boot(kv, runtime)
+        prg2.reconciler.reconcile()
+        assert check_invariants(
+            runtime, prg2.store, prg2.container_versions,
+            prg2.chip_scheduler, prg2.port_scheduler) == []
+
+    def test_reboot_replay_links_origin_trace(self, tmp_path):
+        from tpu_docker_api.schemas.container import ContainerDelete
+
+        kv, runtime = MemoryKV(), FakeRuntime(root=str(tmp_path))
+        prg = boot(kv, runtime)
+        setup_family(prg, tmp_path)
+        # the user's DELETE journals the purge record (trace context
+        # included) but the daemon "dies" before its queue runs it —
+        # boot() never starts the sync loop, the strictest crash model
+        with prg.tracer.span("http:DELETE /containers/{name}") as root:
+            prg.container_svc.delete_container("train", ContainerDelete(
+                force=True, del_etcd_info_and_version_record=True))
+        from tpu_docker_api.state import keys as keys_mod
+        recs = kv.range_prefix(keys_mod.QUEUE_TASKS_PREFIX)
+        assert recs, "purge record was not journaled"
+        assert all(json.loads(raw)["traceId"] == root.trace_id
+                   for raw in recs.values())
+
+        prg2 = boot(kv, runtime)
+        prg2.reconciler.reconcile()
+        assert kv.range_prefix(keys_mod.QUEUE_TASKS_PREFIX) == {}
+        items = prg2.tracer.summaries()["items"]
+        linked = [i for i in items if root.trace_id in i["links"]]
+        assert linked, f"no trace links the origin: {items}"
+        # the replay span lives in the ADOPTING flow's trace (here the
+        # startup reconcile pass) and LINKS the dead daemon's trace id —
+        # never grafted into the origin's span tree as a child
+        assert all(i["traceId"] != root.trace_id for i in linked)
+        replay_spans = [
+            s for i in linked
+            for s in prg2.tracer.trace_view(i["traceId"])["spans"]
+            if s["name"].startswith("queue.replay:")]
+        assert replay_spans, "no queue.replay span recorded"
+        assert all(s["links"] == [root.trace_id] for s in replay_spans)
+        assert check_invariants(
+            runtime, prg2.store, prg2.container_versions,
+            prg2.chip_scheduler, prg2.port_scheduler) == []

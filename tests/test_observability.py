@@ -285,3 +285,97 @@ class TestBuildInfo:
         info = build_info()
         assert set(info) == {"version", "branch", "commit"}
         assert all(isinstance(v, str) and v for v in info.values())
+
+
+class TestExpositionConformance:
+    """Prometheus text-exposition conformance (ISSUE 14 satellite): the
+    format was previously unpinned — a malformed line (raw newline in a
+    label, HELP after series, one name under two types) would ship
+    silently and break every scraper downstream."""
+
+    @staticmethod
+    def _parse(text):
+        """(help_lines, type_lines, series) with line indexes."""
+        helps, types, series = {}, {}, []
+        for i, line in enumerate(text.rstrip("\n").split("\n")):
+            if line.startswith("# HELP "):
+                name = line.split(" ", 3)[2]
+                assert name not in helps, f"duplicate HELP for {name}"
+                helps[name] = i
+            elif line.startswith("# TYPE "):
+                name = line.split(" ", 3)[2]
+                assert name not in types, f"duplicate TYPE for {name}"
+                types[name] = i
+            elif line.strip():
+                series.append((i, line))
+        return helps, types, series
+
+    def test_help_then_type_then_series_ordering(self):
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter_inc("a_total", {"x": "1"}, help="a counter")
+        reg.gauge_set("b_gauge", 2.0, help="a gauge")
+        reg.observe("c_ms", 1.5, help="a histogram")
+        helps, types, series = self._parse(reg.render())
+        for name in ("a_total", "b_gauge", "c_ms"):
+            assert helps[name] < types[name], f"{name}: TYPE before HELP"
+        for i, line in series:
+            base = line.split("{")[0].split(" ")[0]
+            base = (base.removesuffix("_bucket").removesuffix("_sum")
+                    .removesuffix("_count"))
+            assert types[base] < i, f"series line {line!r} before its TYPE"
+
+    def test_label_escaping_survives_hostile_values(self):
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter_inc("esc_total",
+                        {"path": 'a"b\\c\nd'}, help="hostile labels")
+        text = reg.render()
+        # exactly one series line — an unescaped newline would split it
+        lines = [ln for ln in text.split("\n")
+                 if ln.startswith("esc_total{")]
+        assert len(lines) == 1
+        assert lines[0] == 'esc_total{path="a\\"b\\\\c\\nd"} 1'
+
+    def test_help_escaping(self):
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter_inc("h_total", help="line one\nline two \\ slash")
+        text = reg.render()
+        assert "# HELP h_total line one\\nline two \\\\ slash" in text
+        assert "\nline two" not in text.replace("\\nline two", "")
+
+    def test_duplicate_name_different_type_fails_loudly(self):
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter_inc("dup_metric", help="as counter")
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge_set("dup_metric", 1.0)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.observe("dup_metric", 1.0)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge_fn("dup_metric", lambda: 0.0)
+        # same type re-registration stays fine
+        reg.counter_inc("dup_metric")
+        assert reg.counter_value("dup_metric") == 2.0
+
+    def test_histogram_buckets_cumulative_and_ordered(self):
+        from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        for v in (0.001, 0.3, 7.0):
+            reg.observe("lat_s", v, help="latencies")
+        text = reg.render()
+        buckets = []
+        for line in text.split("\n"):
+            if line.startswith("lat_s_bucket"):
+                le = line.split('le="')[1].split('"')[0]
+                buckets.append((le, int(line.rsplit(" ", 1)[1])))
+        assert buckets[-1][0] == "+Inf" and buckets[-1][1] == 3
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        assert "lat_s_count 3" in text

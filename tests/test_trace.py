@@ -1,0 +1,315 @@
+"""Unit tier for the control-plane tracer (telemetry/trace.py):
+span-tree shape, ring bounds, link-vs-parentage semantics across the
+work queue, crash/orphan parity, and the disabled-mode no-op contract."""
+
+import threading
+
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.state.workqueue import TaskRecord, WorkQueue
+from tpu_docker_api.telemetry import trace
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+from tpu_docker_api.telemetry.trace import Tracer
+
+
+class TestSpanTree:
+    def test_child_parentage_and_buffer_grouping(self):
+        t = Tracer(buffer_size=8)
+        with t.span("root", attrs={"k": "v"}) as root:
+            with trace.child("mid") as mid:
+                with trace.child("leaf", n=3) as leaf:
+                    pass
+        assert mid.trace_id == root.trace_id == leaf.trace_id
+        assert mid.parent_id == root.span_id
+        assert leaf.parent_id == mid.span_id
+        view = t.trace_view(root.trace_id)
+        assert [s["name"] for s in view["spans"]] == ["root", "mid", "leaf"]
+        assert all(s["status"] == "ok" for s in view["spans"])
+        assert view["spans"][2]["attrs"] == {"n": 3}
+        # durations nest: children never outlast the root
+        r, m, le = view["spans"]
+        assert r["durationMs"] >= m["durationMs"] >= le["durationMs"] >= 0
+
+    def test_summaries_newest_first_with_root_info(self):
+        t = Tracer(buffer_size=8)
+        with t.span("first"):
+            pass
+        with t.span("second"):
+            with trace.child("kid"):
+                pass
+        out = t.summaries()
+        assert [i["root"] for i in out["items"]] == ["second", "first"]
+        assert out["items"][0]["spans"] == 2
+        assert out["items"][0]["rootCount"] == 1
+        assert out["dropped"] == 0 and out["openSpans"] == 0
+
+    def test_exception_marks_error_baseexception_marks_lost(self):
+        t = Tracer(buffer_size=8)
+        try:
+            with t.span("bad") as s1:
+                raise ValueError("x")
+        except ValueError:
+            pass
+        assert t.trace_view(s1.trace_id)["spans"][0]["status"] == "error"
+
+        class Kill(BaseException):
+            pass
+
+        try:
+            with t.span("killed") as s2:
+                raise Kill()
+        except Kill:
+            pass
+        assert t.trace_view(s2.trace_id)["spans"][0]["status"] == "lost"
+        assert t.summaries()["items"][0]["status"] == "lost"
+
+    def test_child_of_cross_thread(self):
+        t = Tracer(buffer_size=8)
+        with t.span("batch") as batch:
+            def work():
+                with trace.child_of(batch, "engine.create", key="h1"):
+                    pass
+            th = threading.Thread(target=work)
+            th.start()
+            th.join()
+        spans = t.trace_view(batch.trace_id)["spans"]
+        eng = next(s for s in spans if s["name"] == "engine.create")
+        assert eng["parentId"] == batch.span_id
+        assert eng["attrs"]["key"] == "h1"
+
+
+class TestBufferBounds:
+    def test_ring_evicts_oldest_and_counts_dropped(self):
+        reg = MetricsRegistry()
+        t = Tracer(buffer_size=3, registry=reg)
+        ids = []
+        for i in range(5):
+            with t.span(f"r{i}") as s:
+                ids.append(s.trace_id)
+        assert t.stats()["dropped"] == 2
+        assert t.trace_view(ids[0]) is None and t.trace_view(ids[1]) is None
+        assert t.trace_view(ids[4]) is not None
+        assert reg.counter_value("trace_dropped_total",
+                                 {"kind": "trace"}) == 2
+        assert len(t.summaries()["items"]) == 3
+
+    def test_per_trace_span_cap(self, monkeypatch):
+        monkeypatch.setattr(trace, "MAX_SPANS_PER_TRACE", 4)
+        t = Tracer(buffer_size=4)
+        with t.span("root") as root:
+            for i in range(6):
+                with trace.child(f"c{i}"):
+                    pass
+        view = t.trace_view(root.trace_id)
+        assert len(view["spans"]) == 4
+        assert view["droppedSpans"] == 3  # 2 surplus children + the root
+
+    def test_orphans_closed_lost_on_tracer_close(self):
+        t = Tracer(buffer_size=4)
+        scope = t.span("leaked")
+        span = scope.__enter__()  # deliberately never exited
+        assert t.stats()["openSpans"] == 1
+        assert t.close_orphans() == 1
+        assert t.stats()["openSpans"] == 0
+        assert t.trace_view(span.trace_id)["spans"][0]["status"] == "lost"
+        trace._current.reset(scope._token)  # leave a clean context
+
+
+class TestDisabledMode:
+    def test_disabled_tracer_records_nothing(self):
+        t = Tracer(buffer_size=4, enabled=False)
+        with t.span("nope") as s:
+            assert s is None
+            # no current span either — children are no-ops too
+            with trace.child("kid") as k:
+                assert k is None
+        assert t.summaries()["items"] == []
+        assert t.stats()["openSpans"] == 0
+
+    def test_child_without_active_trace_is_noop(self):
+        assert trace.current() is None
+        with trace.child("orphan") as s:
+            assert s is None
+
+    def test_runtime_toggle(self):
+        t = Tracer(buffer_size=4, enabled=True)
+        t.set_enabled(False)
+        with t.span("off"):
+            pass
+        assert t.summaries()["items"] == []
+        t.set_enabled(True)
+        with t.span("on"):
+            pass
+        assert t.summaries()["items"][0]["root"] == "on"
+
+
+class TestLoopPassTrim:
+    def test_idle_pass_discarded_busy_pass_kept(self):
+        t = Tracer(buffer_size=8)
+        with trace.pass_span(t, "reconcile.pass"):
+            pass  # no children, ok → trimmed
+        assert t.summaries()["items"] == []
+        with trace.pass_span(t, "reconcile.pass") as busy:
+            with trace.child("kv.apply"):
+                pass
+        assert t.trace_view(busy.trace_id) is not None
+
+    def test_failed_idle_pass_kept(self):
+        t = Tracer(buffer_size=8)
+        try:
+            with trace.pass_span(t, "admission.pass") as s:
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert t.trace_view(s.trace_id)["spans"][0]["status"] == "error"
+
+    def test_pass_inside_request_rides_the_request_trace(self):
+        t = Tracer(buffer_size=8)
+        with t.span("http:GET /api/v1/reconcile") as root:
+            with trace.pass_span(t, "reconcile.pass") as p:
+                pass
+        # a child, not a root — and therefore never trimmed
+        assert p.trace_id == root.trace_id
+        assert p.parent_id == root.span_id
+        names = [s["name"] for s in t.trace_view(root.trace_id)["spans"]]
+        assert "reconcile.pass" in names
+
+
+class TestQueueTraceContext:
+    def _drain(self, kv):
+        return not kv.range_prefix(keys.QUEUE_TASKS_PREFIX)
+
+    def test_record_json_roundtrip_and_backcompat(self):
+        rec = TaskRecord(task_id="t1", kind="put_kv", params={"k": "v"},
+                         seq=3, trace_id="tr", span_id="sp")
+        back = TaskRecord.from_json(rec.to_json())
+        assert (back.trace_id, back.span_id) == ("tr", "sp")
+        # a journal written before this field existed still parses
+        legacy = ('{"id": "t2", "kind": "put_kv", "params": {}, "seq": 1, '
+                  '"state": "pending", "attempts": 0, "error": "", '
+                  '"idempotencyKey": ""}')
+        old = TaskRecord.from_json(legacy)
+        assert old.trace_id == "" and old.span_id == ""
+
+    def test_same_process_execution_continues_the_trace(self):
+        kv = MemoryKV()
+        t = Tracer(buffer_size=16)
+        wq = WorkQueue(kv, metrics=MetricsRegistry(), tracer=t)
+        with t.span("http:POST") as root:
+            wq.submit_record("put_kv", {"key": "/apis/v1/x", "value": "1"})
+        wq.start()
+        wq.drain()
+        wq.close()
+        spans = t.trace_view(root.trace_id)["spans"]
+        task = next(s for s in spans if s["name"] == "queue.task:put_kv")
+        assert task["parentId"] == root.span_id
+        assert task["links"] == []
+
+    def test_adopted_replay_links_origin_trace(self):
+        kv = MemoryKV()
+        submitter = Tracer(buffer_size=16)
+        wq1 = WorkQueue(kv, metrics=MetricsRegistry(), tracer=submitter)
+        with submitter.span("http:DELETE") as root:
+            wq1.submit_record("put_kv", {"key": "/apis/v1/y", "value": "2"})
+        # the submitting daemon "dies": a second queue over the same store
+        # adopts the journal (records are not local to it)
+        replayer = Tracer(buffer_size=16)
+        wq2 = WorkQueue(kv, metrics=MetricsRegistry(), tracer=replayer)
+        out = wq2.replay_journal()
+        assert [o["state"] for o in out] == ["done"]
+        assert self._drain(kv)
+        items = replayer.summaries()["items"]
+        replay = next(i for i in items if i["root"] == "queue.replay:put_kv")
+        # a fresh root LINKING the origin — not parented into it
+        assert replay["links"] == [root.trace_id]
+        assert replay["rootCount"] == 1
+
+
+class TestEventStamping:
+    def test_stamp_attaches_current_trace_id(self):
+        t = Tracer(buffer_size=4)
+        evt = {"ts": 1.0, "event": "x"}
+        assert "traceId" not in trace.stamp(dict(evt))
+        with t.span("root") as s:
+            stamped = trace.stamp(dict(evt))
+        assert stamped["traceId"] == s.trace_id
+
+    def test_slow_trace_event(self):
+        t = Tracer(buffer_size=4, slow_ms=0.0001)
+        with t.span("slowroot"):
+            pass
+        evts = t.events_view()
+        assert evts and evts[-1]["event"] == "slow-trace"
+        assert evts[-1]["name"] == "slowroot"
+        # children never emit slow-trace events, only roots
+        t2 = Tracer(buffer_size=4, slow_ms=0.0001)
+        with t2.span("r"):
+            with trace.child("kid"):
+                pass
+        assert all(e["name"] == "r" for e in t2.events_view())
+
+
+class TestTraceparent:
+    def test_parse_valid(self):
+        tid, sid = "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331"
+        assert trace.parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid)
+
+    def test_parse_garbage(self):
+        assert trace.parse_traceparent(None) is None
+        assert trace.parse_traceparent("") is None
+        assert trace.parse_traceparent("xx-yy") is None
+        assert trace.parse_traceparent("00-short-b7ad6b7169203331-01") is None
+        assert trace.parse_traceparent(
+            "00-" + "0" * 32 + "-b7ad6b7169203331-01") is None
+        assert trace.parse_traceparent(
+            "00-" + "g" * 32 + "-b7ad6b7169203331-01") is None
+
+    def test_format_roundtrip_and_opaque_ids(self):
+        t = Tracer(buffer_size=4)
+        with t.span("r") as s:
+            header = trace.format_traceparent(s)
+        assert trace.parse_traceparent(header) == (s.trace_id, s.span_id)
+        s.trace_id = "my-opaque-request-id"
+        assert trace.format_traceparent(s) == ""
+
+
+class TestReviewHardening:
+    def test_double_finish_never_duplicates_the_span(self):
+        # close_orphans racing the owning scope's exit: whoever pops the
+        # open entry first records the span; the loser is a no-op
+        t = Tracer(buffer_size=4)
+        scope = t.span("raced")
+        span = scope.__enter__()
+        assert t.close_orphans() == 1
+        scope.__exit__(None, None, None)  # the late unwind
+        view = t.trace_view(span.trace_id)
+        assert len(view["spans"]) == 1
+        assert view["spans"][0]["status"] == "lost"
+        assert t.summaries()["items"][0]["rootCount"] == 1
+
+    def test_find_by_request_id_fallback(self):
+        t = Tracer(buffer_size=8)
+        with t.span("http:GET /x", trace_id="w3c-trace-id",
+                    attrs={"requestId": "userreq"}):
+            pass
+        assert t.trace_view("userreq") is None
+        found = t.find_by_request_id("userreq")
+        assert found is not None and found["traceId"] == "w3c-trace-id"
+        assert t.find_by_request_id("ghost") is None
+
+    def test_contextless_record_is_a_task_not_a_replay(self):
+        # submitted while tracing was off (the bench's disabled-mode
+        # pass), executed after re-enable: an ordinary first execution —
+        # never labeled queue.replay, never carrying phantom links
+        kv = MemoryKV()
+        t = Tracer(buffer_size=16, enabled=False)
+        wq = WorkQueue(kv, metrics=MetricsRegistry(), tracer=t)
+        wq.submit_record("put_kv", {"key": "/apis/v1/z", "value": "3"})
+        t.set_enabled(True)
+        wq.start()
+        wq.drain()
+        wq.close()
+        roots = [i["root"] for i in t.summaries()["items"]]
+        assert "queue.replay:put_kv" not in roots
+        for i in t.summaries()["items"]:
+            assert i["links"] == []

@@ -118,6 +118,9 @@ class Router:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         #: HA role gate; build_router sets it (None = no gating)
         self.leader_elector = None
+        #: trace sink (telemetry/trace.py); build_router sets it (None =
+        #: request tracing off)
+        self.tracer = None
 
     def add(self, method: str, pattern: str, handler) -> None:
         regex = re.compile(
@@ -152,11 +155,13 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  leader_elector=None, informer=None, fanout=None,
                  admission=None, serving=None, compactor=None,
                  list_default_limit: int = 0,
-                 list_max_limit: int = 5000) -> Router:
+                 list_max_limit: int = 5000,
+                 tracer=None) -> Router:
     from tpu_docker_api.state import pager
     from tpu_docker_api.state.keys import Resource
 
     r = Router(metrics=metrics)
+    r.tracer = tracer
 
     def _page_params(body) -> tuple[int, str]:
         """(effective limit, continue token) for a list request. No (or
@@ -533,7 +538,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     if (health_watcher is not None or job_supervisor is not None
             or host_monitor is not None or leader_elector is not None
             or informer is not None or admission is not None
-            or serving is not None):
+            or serving is not None or tracer is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
         # supervisor), host health transitions (host monitor), leadership
@@ -551,19 +556,31 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             # re-sorting the concatenation on every request — this is a hot
             # observability path under bench load, and n·log(n) over the
             # combined rings per GET was pure waste
-            rings = [src.events_view(limit=limit)
+            # ?traceId= joins events to traces (every ring entry appended
+            # under an active span carries the id) — filtered BEFORE the
+            # tail so the caller gets up to `limit` MATCHING events, not
+            # whatever survives a blind truncation. A filtered request
+            # must also fetch each ring at FULL depth: per-ring `limit`
+            # truncation happens before the filter, so a trace's events
+            # older than the newest `limit` entries of their ring would
+            # silently vanish from the join
+            trace_id = str(body.get("traceId", "") or "")
+            per_ring = 1 << 20 if trace_id else limit
+            rings = [src.events_view(limit=per_ring)
                      for src in (health_watcher, job_supervisor,
                                  host_monitor, leader_elector, informer,
-                                 admission, serving)
+                                 admission, serving, tracer)
                      if src is not None]
+            merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
+            if trace_id:
+                merged = (e for e in merged
+                          if e.get("traceId") == trace_id)
             # a bounded tail, not a materialize-then-slice: the merge is
             # lazy, so pushing it through a maxlen deque keeps the cost
             # O(total ring entries) time and O(limit) MEMORY — building
             # list(merged) first held every ring's worth of dicts live
             # per request on a hot observability path
-            tail: collections.deque = collections.deque(
-                heapq.merge(*rings, key=lambda e: e.get("ts", 0)),
-                maxlen=limit)
+            tail: collections.deque = collections.deque(merged, maxlen=limit)
             return list(tail)
 
         r.add("GET", "/api/v1/events", h_events)
@@ -613,6 +630,32 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         r.add("POST", "/api/v1/compact",
               lambda body, **_: compactor.compact_once())
 
+    if tracer is not None:
+        # trace exporters (telemetry/trace.py, docs/observability.md):
+        # recent trace summaries + one full span tree, served from the
+        # bounded in-process ring
+        def t_list(body, **_):
+            try:
+                limit = int(body.get("limit", 100))
+            except (TypeError, ValueError):
+                raise errors.BadRequest("limit must be an integer") from None
+            return tracer.summaries(limit=limit)
+
+        def t_get(body, traceId):  # noqa: N803 — route param name
+            view = tracer.trace_view(traceId)
+            if view is None:
+                # a request that carried BOTH traceparent and X-Request-Id
+                # is keyed by the traceparent trace-id, but the envelope
+                # echoed the X-Request-Id — honor the runbook's "grep by
+                # requestId" by falling back to the root-attr index
+                view = tracer.find_by_request_id(traceId)
+            if view is None:
+                raise errors.NotExistInStore(f"trace {traceId}")
+            return view
+
+        r.add("GET", "/api/v1/traces", t_list)
+        r.add("GET", "/api/v1/traces/{traceId}", t_get)
+
     def debug_threads(body, **_):
         """Per-thread stack dump — the pprof-goroutine analog SURVEY.md §5.1
         asks for (the reference exposes nothing; a hung copy task or a
@@ -657,6 +700,12 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     return r
 
 
+#: http_request_ms histogram buckets (milliseconds — the registry default
+#: is second-scaled and would collapse every request into two bins)
+_HTTP_MS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                    500.0, 1000.0, 5000.0)
+
+
 def build_handler(router: Router):
     registry = router.metrics
 
@@ -668,10 +717,22 @@ def build_handler(router: Router):
             log.debug("http: " + fmt, *args)
 
         def _handle(self, method: str) -> None:
-            # tracing (SURVEY.md §5.1 — absent in the reference): every
-            # request gets an id, a span log line, and metric series keyed by
-            # route pattern
-            req_id = self.headers.get("X-Request-Id") or uuid.uuid4().hex[:12]
+            from tpu_docker_api.telemetry import trace
+
+            # request identity (SURVEY.md §5.1 — absent in the reference):
+            # a W3C traceparent names the remote trace context exactly;
+            # otherwise the caller's X-Request-Id doubles as the trace id
+            # (a user-reported failure is greppable straight into
+            # /api/v1/traces); otherwise both are freshly generated
+            tp = trace.parse_traceparent(self.headers.get("traceparent"))
+            # sanitize before echoing: http.client preserves obs-fold
+            # CRLFs inside a header value, and writing one back verbatim
+            # via send_header would let a client inject response-header
+            # lines (response splitting); bound the length too
+            raw_id = self.headers.get("X-Request-Id") or ""
+            req_id = ("".join(c for c in raw_id
+                              if c.isprintable() and c not in "\r\n")[:128]
+                      or (tp[0] if tp else uuid.uuid4().hex[:12]))
             path, _, query = self.path.partition("?")
             if method == "GET" and path == "/metrics":
                 body_bytes = registry.render().encode()
@@ -687,50 +748,78 @@ def build_handler(router: Router):
             t0 = time.perf_counter()
             app_code = codes.SUCCESS
             http_status = 200
-            try:
-                if found is None:
-                    raise errors.BadRequest(f"no route for {method} {path}")
-                handler, params, _ = found
-                # body read (drained even for requests we reject: leaving
-                # it on a keep-alive socket would desync the connection —
-                # the next request would be parsed from leftover bytes)...
-                length = int(self.headers.get("Content-Length") or 0)
-                raw = self.rfile.read(length) if length else b""
-                # ...but the HA standby contract gates BEFORE parsing or
-                # validating it: reads (GET) serve locally, every mutation
-                # gets 503 + the leader hint — a standby never
-                # half-validates a request it will not execute. Mutations
-                # are also rejected while a NEW leader's writer subsystems
-                # are still booting (accepts_mutations), so no request can
-                # race the leadership-handoff cache reload
-                elector = router.leader_elector
-                if (method != "GET" and elector is not None
-                        and not elector.accepts_mutations):
-                    raise errors.NotLeader(elector.standby_message())
-                body = json.loads(raw) if raw else {}
-                if not isinstance(body, dict):
-                    raise errors.BadRequest("body must be a JSON object")
-                # query params merge under the body (body wins): GET handlers
-                # take options like ?limit=5 the natural way
-                for k, vs in urllib.parse.parse_qs(query).items():
-                    body.setdefault(k, vs[-1])
-                data = handler(body=body, **params)
-                payload = response.success(data)
-            except errors.ApiError as e:
-                app_code = e.code
-                # the one deviation from always-200: backpressure errors
-                # (QueueSaturated) carry a real 429 so clients and proxies
-                # treat them as retryable, never as success
-                http_status = e.http_status or 200
-                payload = response.error(e.code, str(e), data=e.data)
-            except json.JSONDecodeError as e:
-                app_code = codes.BAD_REQUEST
-                payload = response.error(codes.BAD_REQUEST, f"invalid JSON: {e}")
-            except Exception as e:  # noqa: BLE001 — envelope every failure
-                app_code = codes.SERVER_ERROR
-                log.exception("unhandled error on %s %s id=%s",
-                              method, self.path, req_id)
-                payload = response.error(codes.SERVER_ERROR, str(e))
+            # root span per request: the trace id continues the remote
+            # context (traceparent wins, then X-Request-Id); the span
+            # brackets everything from body read to envelope build, and
+            # the dispatch child below covers the actual route handler —
+            # time between the two is the HTTP layer's own overhead
+            tracer = router.tracer
+            span_scope = (tracer.span(
+                f"http:{method} {route}",
+                trace_id=(tp[0] if tp else req_id),
+                parent_id=(tp[1] if tp else ""),
+                # a traceparent-continued request has a REMOTE parent yet
+                # is still this process's serving root: it must count as
+                # rooted and fire slow-trace events
+                root=True,
+                attrs={"method": method, "route": route,
+                       "requestId": req_id})
+                if tracer is not None else trace.NOOP)
+            with span_scope as root_span:
+                try:
+                    if found is None:
+                        raise errors.BadRequest(
+                            f"no route for {method} {path}")
+                    handler, params, _ = found
+                    # body read (drained even for requests we reject: leaving
+                    # it on a keep-alive socket would desync the connection —
+                    # the next request would be parsed from leftover bytes)...
+                    length = int(self.headers.get("Content-Length") or 0)
+                    raw = self.rfile.read(length) if length else b""
+                    # ...but the HA standby contract gates BEFORE parsing or
+                    # validating it: reads (GET) serve locally, every mutation
+                    # gets 503 + the leader hint — a standby never
+                    # half-validates a request it will not execute. Mutations
+                    # are also rejected while a NEW leader's writer subsystems
+                    # are still booting (accepts_mutations), so no request can
+                    # race the leadership-handoff cache reload
+                    elector = router.leader_elector
+                    if (method != "GET" and elector is not None
+                            and not elector.accepts_mutations):
+                        raise errors.NotLeader(elector.standby_message())
+                    body = json.loads(raw) if raw else {}
+                    if not isinstance(body, dict):
+                        raise errors.BadRequest("body must be a JSON object")
+                    # query params merge under the body (body wins): GET
+                    # handlers take options like ?limit=5 the natural way
+                    for k, vs in urllib.parse.parse_qs(query).items():
+                        body.setdefault(k, vs[-1])
+                    with trace.child(f"dispatch:{route}"):
+                        data = handler(body=body, **params)
+                    payload = response.success(data)
+                except errors.ApiError as e:
+                    app_code = e.code
+                    # the one deviation from always-200: backpressure errors
+                    # (QueueSaturated) carry a real 429 so clients and
+                    # proxies treat them as retryable, never as success
+                    http_status = e.http_status or 200
+                    payload = response.error(e.code, str(e), data=e.data,
+                                             request_id=req_id)
+                except json.JSONDecodeError as e:
+                    app_code = codes.BAD_REQUEST
+                    payload = response.error(codes.BAD_REQUEST,
+                                             f"invalid JSON: {e}",
+                                             request_id=req_id)
+                except Exception as e:  # noqa: BLE001 — envelope every failure
+                    app_code = codes.SERVER_ERROR
+                    log.exception("unhandled error on %s %s id=%s",
+                                  method, self.path, req_id)
+                    payload = response.error(codes.SERVER_ERROR, str(e),
+                                             request_id=req_id)
+                if root_span is not None:
+                    root_span.attrs["code"] = app_code
+                    if app_code != codes.SUCCESS:
+                        root_span.status = "error"
             dur = time.perf_counter() - t0
             labels = {"method": method, "route": route, "code": str(app_code)}
             registry.counter_inc("api_requests_total", labels,
@@ -738,6 +827,17 @@ def build_handler(router: Router):
             registry.observe("api_request_duration_seconds",
                              dur, {"method": method, "route": route},
                              help="API request latency")
+            # the satellite pair keyed by HTTP status (route template, not
+            # raw path — label cardinality stays bounded by the route table)
+            registry.counter_inc(
+                "http_requests_total",
+                {"method": method, "route": route, "code": str(http_status)},
+                help="HTTP requests by route, method and status")
+            registry.observe(
+                "http_request_ms", dur * 1e3,
+                {"method": method, "route": route},
+                buckets=_HTTP_MS_BUCKETS,
+                help="HTTP request wall time, milliseconds")
             log.info("%s %s code=%d dur=%.1fms id=%s",
                      method, path, app_code, dur * 1e3, req_id)
             # reference: always HTTP 200, app code in envelope
@@ -745,6 +845,13 @@ def build_handler(router: Router):
             self.send_response(http_status)
             self.send_header("Content-Type", "application/json")
             self.send_header("X-Request-Id", req_id)
+            if root_span is not None:
+                # the W3C echo: tell the caller which span served them
+                # (only emittable when the trace id is wire-legal 32-hex —
+                # opaque X-Request-Id trace keys have no valid traceparent)
+                tp_out = trace.format_traceparent(root_span)
+                if tp_out:
+                    self.send_header("traceparent", tp_out)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)

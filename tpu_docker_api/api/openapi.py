@@ -280,8 +280,21 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("GET", "/api/v1/events", "getHealthEvents",
      "Container liveness transitions (health watcher) merged with gang "
      "lifecycle events (job supervisor), host health transitions "
-     "(host monitor), leadership transitions and informer degradations — "
-     "pre-sorted rings merged by timestamp", None),
+     "(host monitor), leadership transitions, informer degradations and "
+     "slow-trace events — pre-sorted rings merged by timestamp; "
+     "?traceId= filters to the events stamped by one trace", None),
+    ("GET", "/api/v1/traces", "listTraces",
+     "Recent trace summaries from the bounded in-process trace ring "
+     "(telemetry/trace.py): root span name, span count, status "
+     "(ok/error/lost), duration, cross-trace links — newest first, plus "
+     "the ring's dropped/open-span counters; ?limit= bounds the page",
+     None),
+    ("GET", "/api/v1/traces/{traceId}", "getTrace",
+     "One trace's full span tree: every span's name, parentId, attrs, "
+     "monotonic start, duration, status and links — the 'where did this "
+     "request's latency go' view. The trace id is the request's "
+     "X-Request-Id (or traceparent trace-id), so a user-reported request "
+     "id greps straight to its tree", None),
     ("GET", "/api/v1/health/containers", "getHealthStatus",
      "Per-container liveness + restart bookkeeping", None),
     ("GET", "/api/v1/health/jobs", "getJobHealth",
@@ -371,6 +384,24 @@ def build_spec() -> dict:
                 "description": "base name (latest version) or versioned "
                                "name-N (optimistic concurrency check)",
             }]
+        if "{traceId}" in path:
+            op["parameters"] = [{
+                "name": "traceId", "in": "path", "required": True,
+                "schema": _STR,
+                "description": "trace id — the request's X-Request-Id or "
+                               "traceparent trace-id",
+            }]
+        if path == "/api/v1/events":
+            op["parameters"] = [
+                {"name": "limit", "in": "query", "required": False,
+                 "schema": _INT,
+                 "description": "max events returned (default 100)"},
+                {"name": "traceId", "in": "query", "required": False,
+                 "schema": _STR,
+                 "description": "only events stamped by this trace — "
+                                "joins the event rings to "
+                                "/api/v1/traces/{traceId}"},
+            ]
         if method == "GET" and path in _PAGED_LIST_PATHS:
             op["parameters"] = [
                 {"name": "limit", "in": "query", "required": False,

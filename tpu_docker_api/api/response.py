@@ -16,9 +16,14 @@ def success(data: Any = None) -> bytes:
     ).encode()
 
 
-def error(code: int, msg: str = "", data: Any = None) -> bytes:
+def error(code: int, msg: str = "", data: Any = None,
+          request_id: str = "") -> bytes:
     """``data`` defaults to None — the legacy error shape byte-for-byte;
-    typed errors may attach structured context (errors.ApiError.data)."""
-    return json.dumps(
-        {"code": code, "msg": msg or codes.message(code), "data": data}
-    ).encode()
+    typed errors may attach structured context (errors.ApiError.data).
+    ``request_id`` (the HTTP layer passes its X-Request-Id) is echoed as
+    ``requestId`` so a user-reported failure is greppable in traces and
+    events; empty keeps the legacy three-key envelope exactly."""
+    body = {"code": code, "msg": msg or codes.message(code), "data": data}
+    if request_id:
+        body["requestId"] = request_id
+    return json.dumps(body).encode()

@@ -203,6 +203,20 @@ class Config:
     # below watermark x target. The (watermark, 1.0] band is a dead zone,
     # so a signal oscillating around the target never flaps the fleet
     autoscale_down_watermark: float = 0.5
+    # control-plane tracing (telemetry/trace.py, docs/observability.md):
+    # always-on-sampled span trees from the HTTP handler down to store
+    # applies, scheduler claims, lock waits, runtime fan-out and the async
+    # queue tail, exported at GET /api/v1/traces. False turns every span
+    # site into a no-op (one context-local read) — the churn benchmark
+    # gates the disabled-mode cost at <= 1% of the flow p50.
+    tracing_enabled: bool = True
+    # bounded in-process trace ring: how many recent traces are kept
+    # (O(buffer) memory; eviction is normal and counted loudly in
+    # trace_dropped_total)
+    trace_buffer_size: int = 256
+    # slow-trace threshold (ms): a root span slower than this emits a
+    # "slow-trace" event into the merged /api/v1/events ring; 0 disables
+    trace_slow_ms: float = 0.0
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container
@@ -281,6 +295,12 @@ def load(path: str | None = None) -> Config:
         raise ValueError(
             f"list_default_limit must be in [0, list_max_limit], "
             f"got {cfg.list_default_limit} (max {cfg.list_max_limit})")
+    if cfg.trace_buffer_size < 1:
+        raise ValueError(f"trace_buffer_size must be >= 1, "
+                         f"got {cfg.trace_buffer_size}")
+    if cfg.trace_slow_ms < 0:
+        raise ValueError(f"trace_slow_ms must be >= 0, "
+                         f"got {cfg.trace_slow_ms}")
     if cfg.autoscale_interval_s < 0:
         raise ValueError(f"autoscale_interval_s must be >= 0, "
                          f"got {cfg.autoscale_interval_s}")

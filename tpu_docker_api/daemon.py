@@ -66,6 +66,16 @@ class Program:
         # metrics first: the work queue's degradation counters need a home
         # before any durable submit can happen
         self.metrics = MetricsRegistry()
+        # tracer second: every subsystem below records spans into this one
+        # sink (one per Program — multi-daemon test processes must not
+        # cross-contaminate buffers); tracing_enabled=false makes every
+        # span site a no-op
+        from tpu_docker_api.telemetry.trace import Tracer
+
+        self.tracer = Tracer(buffer_size=cfg.trace_buffer_size,
+                             enabled=cfg.tracing_enabled,
+                             registry=self.metrics,
+                             slow_ms=cfg.trace_slow_ms)
         raw_kv = self._injected_kv or open_store(
             cfg.store_backend, etcd_addr=cfg.etcd_addr,
             sqlite_path=cfg.sqlite_path,
@@ -136,6 +146,7 @@ class Program:
             submit_timeout_s=cfg.queue_submit_timeout_s,
             close_deadline_s=cfg.queue_close_deadline_s,
             metrics=self.metrics,
+            tracer=self.tracer,
         )
         topology = self._discover_topology()
         self.chip_scheduler = ChipScheduler(topology, self.kv)
@@ -197,6 +208,7 @@ class Program:
             max_skips=cfg.admission_max_skips,
             interval_s=cfg.admission_interval_s,
             registry=self.metrics,
+            tracer=self.tracer,
         )
         self.job_svc.admission = self.admission
         # Service resource (service/serving.py): declarative replicated
@@ -218,6 +230,7 @@ class Program:
             down_cooldown_s=cfg.autoscale_down_cooldown_s,
             down_watermark=cfg.autoscale_down_watermark,
             registry=self.metrics,
+            tracer=self.tracer,
         )
         # engine-pool saturation gauges: one set of books summed over the
         # distinct engines behind this pod (the local runtime is shared by
@@ -294,6 +307,7 @@ class Program:
             # interrupted deletes and spec rolls)
             serving=self.serving,
             full_interval_s=cfg.reconcile_full_interval_s,
+            tracer=self.tracer,
         )
         # event-driven reconcile (ROADMAP item 4): feed the reconciler's
         # dirty-set from the store's watch stream so periodic passes are
@@ -333,6 +347,7 @@ class Program:
                 locks={keys.Resource.CONTAINERS:
                        self.container_svc.family_lock,
                        keys.Resource.JOBS: self.job_svc.family_lock},
+                tracer=self.tracer,
             )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
@@ -624,6 +639,7 @@ class Program:
             compactor=self.compactor,
             list_default_limit=self.cfg.list_default_limit,
             list_max_limit=self.cfg.list_max_limit,
+            tracer=self.tracer,
         )
         bi = build_info()  # warm the git probe BEFORE serving /healthz
         self.api_server = ApiServer(router, host=self.host, port=self.cfg.port)
@@ -667,6 +683,10 @@ class Program:
             self.runtime.close()
         if getattr(self, "kv", None) is not None:
             self.kv.close()
+        if getattr(self, "tracer", None) is not None:
+            # reboot contract: no daemon ends with open spans — whatever a
+            # dying flow left open closes as status="lost"
+            self.tracer.close()
         log.info("tpu-docker-api stopped")
 
 

@@ -52,6 +52,7 @@ import time
 from typing import Callable, Sequence
 
 from tpu_docker_api.service.crashpoints import crash_point
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry
 
 #: fanout_batch_ms histogram buckets (milliseconds — the default registry
@@ -116,16 +117,21 @@ class Fanout:
         if not calls:
             return []
         t0 = time.perf_counter()
-        try:
-            if self.workers == 1 or len(calls) == 1:
-                results = self._run_serial(calls)
-            else:
-                results = self._run_parallel(calls)
-        finally:
-            self._account(calls, t0)
+        # one span per batch; each call records a child under it (the
+        # explicit-parent form — pool worker threads don't inherit the
+        # caller's context). No active trace ⇒ both are shared no-ops.
+        with trace.child("fanout.batch", calls=len(calls),
+                         workers=self.workers) as batch_span:
+            try:
+                if self.workers == 1 or len(calls) == 1:
+                    results = self._run_serial(calls, batch_span)
+                else:
+                    results = self._run_parallel(calls, batch_span)
+            finally:
+                self._account(calls, t0)
         return results
 
-    def _run_serial(self, calls) -> list[FanoutResult]:
+    def _run_serial(self, calls, batch_span=None) -> list[FanoutResult]:
         results: list[FanoutResult] = []
         failed = False
         for i, (key, op, fn) in enumerate(calls):
@@ -133,7 +139,9 @@ class Fanout:
                 results.append(FanoutResult(key=key, skipped=True))
                 continue
             try:
-                results.append(FanoutResult(key=key, ok=True, value=fn()))
+                results.append(FanoutResult(
+                    key=key, ok=True,
+                    value=self._guarded_call(fn, batch_span, op, key)))
             except Exception as e:  # noqa: BLE001 — collected per contract
                 results.append(FanoutResult(key=key, error=e))
                 failed = True
@@ -143,7 +151,12 @@ class Fanout:
                 crash_point("fanout.mid_batch")
         return results
 
-    def _run_parallel(self, calls) -> list[FanoutResult]:
+    @staticmethod
+    def _guarded_call(fn, batch_span, op: str, key: str):
+        with trace.child_of(batch_span, f"engine.{op}", key=key):
+            return fn()
+
+    def _run_parallel(self, calls, batch_span=None) -> list[FanoutResult]:
         pool = self._ensure_pool()
         futures: list[concurrent.futures.Future] = []
         with self._mu:
@@ -158,7 +171,8 @@ class Fanout:
             # settled when reconciliation starts
             try:
                 for key, op, fn in calls:
-                    futures.append(pool.submit(self._guard, fn))
+                    futures.append(pool.submit(self._guard, fn,
+                                               batch_span, op, key))
                 results: list[FanoutResult] = [None] * len(calls)  # type: ignore
                 # collect in as-completed order (the mid-batch crash point
                 # must fire while peers are genuinely in flight), fill
@@ -188,12 +202,14 @@ class Fanout:
                 self._inflight -= len(calls)
 
     @staticmethod
-    def _guard(fn) -> tuple[str, object]:
+    def _guard(fn, batch_span=None, op: str = "",
+               key: str = "") -> tuple[str, object]:
         """Worker-side wrapper: never let an exception live only inside a
         Future (a dropped Future would swallow a SimulatedCrash and break
-        the kill -9 model)."""
+        the kill -9 model). The per-call span closes before the outcome is
+        captured, so a SimulatedCrash marks it ``lost`` on the way out."""
         try:
-            return "ok", fn()
+            return "ok", Fanout._guarded_call(fn, batch_span, op, key)
         except Exception as e:  # noqa: BLE001
             return "error", e
         except BaseException as e:  # SimulatedCrash et al.

@@ -40,6 +40,7 @@ from tpu_docker_api.scheduler.topology import (
 )
 from tpu_docker_api.state import keys
 from tpu_docker_api.state.kv import KV
+from tpu_docker_api.telemetry import trace
 
 Shape = tuple[int, int, int]
 Coord = tuple[int, int, int]
@@ -428,6 +429,7 @@ class PodScheduler:
         return self.apply_slices([(owner, n_chips, accelerator_type)],
                                  exclude_hosts=exclude_hosts, txn=txn)[0]
 
+    @trace.traced("sched.slices.claim")
     def apply_slices(self, asks: list[tuple[str, int, str]],
                      exclude_hosts: set[str] | None = None,
                      txn=None) -> list[SliceAllocation]:

@@ -17,6 +17,7 @@ import threading
 from tpu_docker_api import errors
 from tpu_docker_api.state import keys
 from tpu_docker_api.state.kv import KV
+from tpu_docker_api.telemetry import trace
 
 
 class PortScheduler:
@@ -84,6 +85,7 @@ class PortScheduler:
         with self._mu:
             return (self.end_port - self.start_port + 1) - len(self._used)
 
+    @trace.traced("sched.ports.claim")
     def apply_ports(self, n: int, owner: str = "", txn=None) -> list[int]:
         """Allocate ``n`` distinct host ports (reference ApplyPorts,
         scheduler.go:85-111)."""
@@ -113,6 +115,7 @@ class PortScheduler:
         conflicts and claims nothing unless empty."""
         return self.try_claim_ports_bulk([(owner, ports)], txn=txn)
 
+    @trace.traced("sched.ports.claim_bulk")
     def try_claim_ports_bulk(self, claims: list[tuple[str, list[int]]],
                              txn=None) -> list[int]:
         """Multi-member variant (mirrors try_claim_chips_bulk): every

@@ -26,6 +26,7 @@ from tpu_docker_api import errors
 from tpu_docker_api.scheduler.topology import HostTopology, parse_slice_shape
 from tpu_docker_api.state import keys
 from tpu_docker_api.state.kv import KV
+from tpu_docker_api.telemetry import trace
 
 Shape = tuple[int, int, int]
 Coord = tuple[int, int, int]
@@ -151,6 +152,7 @@ class ChipScheduler:
 
     # -- allocation --------------------------------------------------------------
 
+    @trace.traced("sched.chips.claim")
     def apply_chips(
         self, n: int, shape: str = "", owner: str = "", txn=None
     ) -> tuple[list[int], bool]:
@@ -203,6 +205,7 @@ class ChipScheduler:
         are fine (idempotent re-adoption)."""
         return self.try_claim_chips_bulk([(owner, chip_ids)], txn=txn)
 
+    @trace.traced("sched.chips.claim_bulk")
     def try_claim_chips_bulk(self, claims: list[tuple[str, list[int]]],
                              txn=None) -> list[int]:
         """Multi-member variant: claim every ``(owner, chip_ids)`` pair

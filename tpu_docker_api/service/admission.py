@@ -50,6 +50,7 @@ from tpu_docker_api import errors
 from tpu_docker_api.schemas.job import JobState
 from tpu_docker_api.service.crashpoints import crash_point
 from tpu_docker_api.state import keys
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.state.keys import Resource, versioned_name
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
@@ -82,10 +83,12 @@ class AdmissionRecord:
     record pattern), the priority class, the submit seq (precedence +
     seniority), and the durable skip counter for the starvation bound."""
 
-    __slots__ = ("seq", "base", "kind", "klass", "skips", "ts", "accel")
+    __slots__ = ("seq", "base", "kind", "klass", "skips", "ts", "accel",
+                 "trace_id")
 
     def __init__(self, seq: int, base: str, kind: str, klass: str,
-                 skips: int = 0, ts: float = 0.0, accel: str = "") -> None:
+                 skips: int = 0, ts: float = 0.0, accel: str = "",
+                 trace_id: str = "") -> None:
         self.seq = seq
         self.base = base
         self.kind = kind          # "queued" | "preempted"
@@ -93,12 +96,16 @@ class AdmissionRecord:
         self.skips = skips
         self.ts = ts
         self.accel = accel
+        #: originating trace (the enqueueing request, or the admission
+        #: pass that preempted): a later placement — possibly by another
+        #: daemon after a failover — LINKS back to it
+        self.trace_id = trace_id
 
     def to_json(self) -> str:
         return json.dumps({
             "seq": self.seq, "base": self.base, "kind": self.kind,
             "class": self.klass, "skips": self.skips, "ts": self.ts,
-            "accel": self.accel,
+            "accel": self.accel, "traceId": self.trace_id,
         }, sort_keys=True)
 
     @classmethod
@@ -106,7 +113,8 @@ class AdmissionRecord:
         d = json.loads(raw)
         return cls(seq=int(d["seq"]), base=d["base"], kind=d["kind"],
                    klass=d["class"], skips=int(d.get("skips", 0)),
-                   ts=float(d.get("ts", 0.0)), accel=d.get("accel", ""))
+                   ts=float(d.get("ts", 0.0)), accel=d.get("accel", ""),
+                   trace_id=d.get("traceId", ""))
 
     def key(self) -> str:
         return keys.admission_record_key(self.seq)
@@ -125,8 +133,11 @@ class AdmissionController:
                  max_skips: int = DEFAULT_MAX_SKIPS,
                  interval_s: float = 1.0,
                  registry: MetricsRegistry | None = None,
-                 max_events: int = 256) -> None:
+                 max_events: int = 256,
+                 tracer=None) -> None:
         self._svc = job_svc
+        #: trace sink for self-rooted per-pass spans (idle passes trimmed)
+        self._tracer = tracer
         self._store = store
         self._versions = versions
         self._slices = slices
@@ -246,7 +257,8 @@ class AdmissionController:
         )
         rec = AdmissionRecord(seq=seq, base=base, kind="queued",
                               klass=priority_class, ts=time.time(),
-                              accel=req.accelerator_type)
+                              accel=req.accelerator_type,
+                              trace_id=trace.current_trace_id())
         try:
             self._kv.apply(
                 StateStore._put_ops(Resource.JOBS, base, version,
@@ -305,7 +317,8 @@ class AdmissionController:
         place onto must never strand them dormant on idle capacity).
         """
         outcomes: list[dict] = []
-        with self._pass_mu:
+        with trace.pass_span(self._tracer, "admission.pass") as span, \
+                self._pass_mu:
             blocked: list[AdmissionRecord] = []
 
             def gated() -> bool:
@@ -339,6 +352,9 @@ class AdmissionController:
                         self._bump_skips(blocked)
                 else:
                     blocked.append(rec)
+            if span is not None:
+                span.attrs["placed"] = len(outcomes)
+                span.attrs["blocked"] = len(blocked)
         if outcomes:
             self._update_gauges()
         return outcomes
@@ -349,6 +365,16 @@ class AdmissionController:
         been settled). The spec is read from the stored ``JobState`` at
         execution time, under the family lock."""
         base = rec.base
+        # the placement span LINKS the record's originating trace: after a
+        # failover the journal is all that connects the user's enqueue to
+        # the daemon that finally placed it
+        with trace.child(f"admission.place:{base}", seq=rec.seq) as span:
+            if span is not None and rec.trace_id:
+                span.links = (rec.trace_id,)
+            return self._try_admit_locked(rec, base)
+
+    def _try_admit_locked(self, rec: AdmissionRecord,
+                          base: str) -> bool | None:
         with self._svc.family_lock(base):
             latest = self._versions.get(base)
             if latest is None:
@@ -516,7 +542,8 @@ class AdmissionController:
                 "preemptions": st.preemptions + 1,
             })
             rec = AdmissionRecord(seq=seq, base=base, kind="preempted",
-                                  klass=st.priority_class, ts=time.time())
+                                  klass=st.priority_class, ts=time.time(),
+                                  trace_id=trace.current_trace_id())
             self._kv.apply(
                 StateStore._put_ops(Resource.JOBS, base, st.version,
                                     parked.to_dict())
@@ -736,7 +763,8 @@ class AdmissionController:
     # -- views / telemetry --------------------------------------------------------
 
     def _record(self, kind: str, job: str, **extra) -> None:
-        evt = {"ts": time.time(), "job": job, "event": kind, **extra}
+        evt = trace.stamp({"ts": time.time(), "job": job, "event": kind,
+                           **extra})
         with self._mu:
             self._events.append(evt)
 

@@ -43,6 +43,7 @@ from tpu_docker_api.state import keys
 from tpu_docker_api.state.keys import Resource, versioned_name
 from tpu_docker_api.state.kv import KV
 from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -59,9 +60,12 @@ class HistoryCompactor:
                  interval_s: float = 60.0,
                  registry: MetricsRegistry | None = None,
                  chunk_ops: int = CHUNK_OPS,
-                 locks: dict | None = None) -> None:
+                 locks: dict | None = None,
+                 tracer=None) -> None:
         self._kv = kv
         self._store = store
+        #: trace sink for self-rooted per-pass spans (idle passes trimmed)
+        self._tracer = tracer
         #: per-resource family-lock providers (base -> context manager):
         #: a family's doomed-selection AND delete run under its service
         #: lock, so a concurrent rollback that just confirmed a version
@@ -111,6 +115,10 @@ class HistoryCompactor:
     def compact_once(self) -> dict:
         """One full compaction pass; returns the report (also kept for
         :meth:`last_report` / the POST /api/v1/compact route)."""
+        with trace.pass_span(self._tracer, "compact.pass"):
+            return self._compact_once_inner()
+
+    def _compact_once_inner(self) -> dict:
         from tpu_docker_api.service.crashpoints import crash_point
 
         t0 = time.perf_counter()

@@ -53,6 +53,7 @@ from tpu_docker_api.state.keys import Resource, split_versioned_name, versioned_
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.txn import StoreTxn
 from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.state.workqueue import TaskRecord, WorkQueue
 
 log = logging.getLogger(__name__)
@@ -82,8 +83,16 @@ class _FamilyLocks:
     def hold(self, base: str):
         with self._mu:
             lock = self._locks.setdefault(base, threading.RLock())
-        with lock:
+        # lock-wait time is otherwise the INVISIBLE cost of a flow: a span
+        # records only when the fast try-acquire loses (contention) — the
+        # uncontended path stays one non-blocking acquire, no span at all
+        if not lock.acquire(blocking=False):
+            with trace.child("lock.family.wait", base=base):
+                lock.acquire()
+        try:
             yield
+        finally:
+            lock.release()
 
 
 class ContainerService:

@@ -51,6 +51,7 @@ from tpu_docker_api.runtime.base import (
 from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.schemas.job import DORMANT_PHASES
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -512,7 +513,8 @@ class HostMonitor:
     # -- views -------------------------------------------------------------------
 
     def _record(self, kind: str, host: str, **extra) -> None:
-        evt = {"ts": time.time(), "host": host, "event": kind, **extra}
+        evt = trace.stamp({"ts": time.time(), "host": host, "event": kind,
+                           **extra})
         with self._mu:
             self._events.append(evt)
         log.info("host event: %s %s %s", host, kind, extra or "")

@@ -49,6 +49,7 @@ from tpu_docker_api import errors
 from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.schemas.job import DORMANT_PHASES
 from tpu_docker_api.state.keys import split_versioned_name, versioned_name
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 from tpu_docker_api.utils.backoff import backoff_delay_s
 
@@ -443,7 +444,8 @@ class JobSupervisor:
         self._record(kind, job_name, **detail)
 
     def _record(self, kind: str, job: str, **extra) -> None:
-        evt = {"ts": time.time(), "job": job, "event": kind, **extra}
+        evt = trace.stamp({"ts": time.time(), "job": job, "event": kind,
+                           **extra})
         with self._mu:
             self._events.append(evt)
         log.info("job event: %s %s %s", job, kind, extra or "")

@@ -41,6 +41,7 @@ from typing import Callable
 from tpu_docker_api import errors
 from tpu_docker_api.service.crashpoints import crash_point
 from tpu_docker_api.state import keys
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.state.kv import KV
 
 log = logging.getLogger(__name__)
@@ -201,8 +202,9 @@ class LeaderElector:
         return list(self._events)[-limit:]  # deque snapshots are thread-safe
 
     def _event(self, event: str, **extra) -> None:
-        self._events.append({"ts": time.time(), "event": event,
-                             "holder": self.holder_id, **extra})
+        self._events.append(trace.stamp(
+            {"ts": time.time(), "event": event,
+             "holder": self.holder_id, **extra}))
 
     # -- the election step --------------------------------------------------------
 

@@ -59,6 +59,7 @@ from tpu_docker_api.state.keys import (
 )
 from tpu_docker_api.state.store import StateStore
 from tpu_docker_api.state.version import VersionMap
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -181,8 +182,12 @@ class Reconciler:
         admission=None,
         serving=None,
         full_interval_s: float = 0.0,
+        tracer=None,
     ) -> None:
         self.runtime = runtime
+        #: trace sink for self-rooted per-pass spans (daemon wires the
+        #: Program's tracer); an idle pass's trace is trimmed, not buffered
+        self._tracer = tracer
         #: runtime fan-out: the gang member scans, stale-version sweeps
         #: and half-created-job scrubs batch their per-member engine calls
         #: so a sweep's wall time is O(slowest host), not O(sum)
@@ -312,10 +317,17 @@ class Reconciler:
 
         t0 = time.perf_counter()
         actions: list[dict] = []
-        if effective == "dirty":
-            visited = self._reconcile_dirty(actions, dry_run)
-        else:
-            visited = self._reconcile_full(actions, dry_run)
+        # one self-rooted trace per loop pass (background cost must be
+        # attributable too); via the HTTP route it rides the request trace
+        with trace.pass_span(self._tracer, "reconcile.pass",
+                             mode=effective, dryRun=dry_run) as span:
+            if effective == "dirty":
+                visited = self._reconcile_dirty(actions, dry_run)
+            else:
+                visited = self._reconcile_full(actions, dry_run)
+            if span is not None:
+                span.attrs["actions"] = len(actions)
+                span.attrs["visitedFamilies"] = visited
         report = {
             "dryRun": dry_run,
             "mode": effective,
@@ -533,7 +545,8 @@ class Reconciler:
                 log.warning("reconcile: %s %s failed: %s", action, target,
                             entry["error"])
         with self._mu:
-            self._events.append({"ts": time.time(), "dryRun": dry_run, **entry})
+            self._events.append(trace.stamp(
+                {"ts": time.time(), "dryRun": dry_run, **entry}))
 
     def _family_members(self, base: str,
                         hint=None) -> dict[int, str]:
@@ -882,11 +895,11 @@ class Reconciler:
                          "host(s) %s; leaving to the host monitor/"
                          "supervisor", latest_name, sorted(unreachable))
                 with self._mu:
-                    self._events.append({
+                    self._events.append(trace.stamp({
                         "ts": time.time(), "dryRun": dry_run,
                         "action": "skip-unreachable-job",
                         "target": latest_name,
-                        "hosts": sorted(unreachable)})
+                        "hosts": sorted(unreachable)}))
                 return
 
             if st.desired_running and st.phase not in DORMANT_PHASES:

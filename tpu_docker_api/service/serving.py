@@ -69,6 +69,7 @@ from tpu_docker_api.state.keys import (
     versioned_name,
 )
 from tpu_docker_api.state.store import StateStore
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -108,8 +109,11 @@ class ServingService:
                  scrape_timeout_s: float = 0.5,
                  registry: MetricsRegistry | None = None,
                  max_events: int = 256,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic,
+                 tracer=None) -> None:
         self._job = job_svc
+        #: trace sink for self-rooted per-tick spans (idle ticks trimmed)
+        self._tracer = tracer
         self._store = store
         self._versions = versions          # service VersionMap
         self._job_versions = job_versions
@@ -199,7 +203,8 @@ class ServingService:
         return sorted(out)
 
     def _record(self, kind: str, service: str, **extra) -> None:
-        evt = {"ts": time.time(), "service": service, "event": kind, **extra}
+        evt = trace.stamp({"ts": time.time(), "service": service,
+                           "event": kind, **extra})
         with self._mu:
             self._events.append(evt)
 
@@ -688,6 +693,10 @@ class ServingService:
         """One autoscaler pass over every service: converge the fleet,
         read signals, decide. Public — tests and the bench drive it
         inline the way ``admit_once`` is driven."""
+        with trace.pass_span(self._tracer, "autoscale.tick"):
+            self._tick_inner()
+
+    def _tick_inner(self) -> None:
         for base in sorted(self._versions.snapshot()):
             try:
                 with self._locks.hold(base):

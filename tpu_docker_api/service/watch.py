@@ -20,6 +20,7 @@ import threading
 import time
 
 from tpu_docker_api.runtime.base import ContainerRuntime
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 
 log = logging.getLogger(__name__)
@@ -225,8 +226,8 @@ class HealthWatcher:
     # -- views -------------------------------------------------------------------
 
     def _record(self, name: str, kind: str, running: bool, **extra) -> None:
-        evt = {"ts": time.time(), "container": name, "event": kind,
-               "running": running, **extra}
+        evt = trace.stamp({"ts": time.time(), "container": name,
+                           "event": kind, "running": running, **extra})
         with self._mu:
             self._events.append(evt)
         log.info("event: %s %s running=%s %s", name, kind, running,

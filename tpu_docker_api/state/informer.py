@@ -41,6 +41,7 @@ import time
 from typing import Callable
 
 from tpu_docker_api import errors
+from tpu_docker_api.telemetry import trace
 from tpu_docker_api.state.kv import KV, Watch, WatchEvent
 from tpu_docker_api.utils.backoff import backoff_delay_s
 
@@ -224,8 +225,9 @@ class Informer:
         self._synced = False
         log.warning("informer[%s] degraded (%s): %s",
                     self.prefix, reason, detail)
-        self._events.append({"ts": time.time(), "event": "informer-degraded",
-                             "reason": reason, "detail": detail[:300]})
+        self._events.append(trace.stamp(
+            {"ts": time.time(), "event": "informer-degraded",
+             "reason": reason, "detail": detail[:300]}))
 
     def _loop(self) -> None:
         attempt = 0

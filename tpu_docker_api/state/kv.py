@@ -197,6 +197,7 @@ class KV(abc.ABC):
         genuinely atomic implementation; the base fallback (check, then
         sequential ops) keeps wrapper/test KVs working but is NOT atomic."""
         from tpu_docker_api.service.crashpoints import crash_point
+        from tpu_docker_api.telemetry import trace
 
         guards = _check_guards(guards)
         if not ops and not guards:
@@ -205,9 +206,13 @@ class KV(abc.ABC):
             want = _APPLY_OPS.get(op[0])
             if want is None or len(op) != want:
                 raise ValueError(f"malformed apply op {op!r}")
-        crash_point("txn.before_apply")
-        self._apply(ops, guards)
-        crash_point("txn.after_apply")
+        # the crash points sit INSIDE the span, so a simulated kill at
+        # either txn boundary closes it as status="lost" — the trace shows
+        # exactly which commit the daemon died around
+        with trace.child("kv.apply", ops=len(ops), guards=len(guards)):
+            crash_point("txn.before_apply")
+            self._apply(ops, guards)
+            crash_point("txn.after_apply")
 
     def cas(self, key: str, expected: str | None, new: str) -> None:
         """Compare-and-swap convenience: write ``new`` iff the key's current

@@ -14,6 +14,7 @@ from tpu_docker_api.schemas.state import ContainerState, VolumeState
 from tpu_docker_api.state import keys
 from tpu_docker_api.state.keys import Resource
 from tpu_docker_api.state.kv import KV
+from tpu_docker_api.telemetry import trace
 
 
 class StateStore:
@@ -27,7 +28,9 @@ class StateStore:
         # latest pointer land together — no crash window where a pointer
         # names a spec that was never written (and one store round trip per
         # version transition instead of two)
-        self.kv.apply(self._put_ops(resource, base, version, payload))
+        with trace.child("store.put", resource=resource.value, base=base,
+                         version=version):
+            self.kv.apply(self._put_ops(resource, base, version, payload))
 
     @staticmethod
     def _put_ops(resource: Resource, base: str, version: int,
@@ -40,16 +43,17 @@ class StateStore:
 
     def _get(self, resource: Resource, name: str) -> dict:
         """Fetch by versioned name, or by base name (⇒ latest version)."""
-        base, version = keys.split_versioned_name(name)
-        if version is None:
-            latest = self.kv.get_or(keys.latest_key(resource, base))
-            if latest is None:
+        with trace.child("store.get", resource=resource.value, target=name):
+            base, version = keys.split_versioned_name(name)
+            if version is None:
+                latest = self.kv.get_or(keys.latest_key(resource, base))
+                if latest is None:
+                    raise errors.NotExistInStore(name)
+                version = int(latest)
+            raw = self.kv.get_or(keys.version_key(resource, base, version))
+            if raw is None:
                 raise errors.NotExistInStore(name)
-            version = int(latest)
-        raw = self.kv.get_or(keys.version_key(resource, base, version))
-        if raw is None:
-            raise errors.NotExistInStore(name)
-        return json.loads(raw)
+            return json.loads(raw)
 
     def latest_version(self, resource: Resource, base: str) -> int | None:
         raw = self.kv.get_or(keys.latest_key(resource, base))

@@ -30,6 +30,7 @@ import threading
 from typing import Callable
 
 from tpu_docker_api.state.kv import KV
+from tpu_docker_api.telemetry import trace
 
 #: lock-acquisition ranks (see module docstring): outer locks first
 RANK_POD = 0      # PodScheduler (nests into host chip locks in apply_slice)
@@ -81,16 +82,19 @@ class StoreTxn:
         parts = sorted(self._parts.items(),
                        key=lambda kv_: (kv_[1][0], kv_[0]))
         held: list[threading.Lock] = []
-        try:
-            for _, (_, lock, _) in parts:
-                lock.acquire()
-                held.append(lock)
-            ops: list[tuple] = []
-            for _, (_, _, ops_fn) in parts:
-                ops.extend(ops_fn())
-            ops.extend(self._ops)
-            if ops:
-                self._kv.apply(ops)
-        finally:
-            for lock in reversed(held):
-                lock.release()
+        with trace.child("store.txn", participants=len(parts)) as span:
+            try:
+                for _, (_, lock, _) in parts:
+                    lock.acquire()
+                    held.append(lock)
+                ops: list[tuple] = []
+                for _, (_, _, ops_fn) in parts:
+                    ops.extend(ops_fn())
+                ops.extend(self._ops)
+                if span is not None:
+                    span.attrs["ops"] = len(ops)
+                if ops:
+                    self._kv.apply(ops)
+            finally:
+                for lock in reversed(held):
+                    lock.release()

@@ -58,6 +58,7 @@ from typing import Any, Callable
 
 from tpu_docker_api import errors
 from tpu_docker_api.state import keys
+from tpu_docker_api.telemetry import trace as trace_mod
 
 log = logging.getLogger(__name__)
 
@@ -131,7 +132,10 @@ Task = PutKVTask | DelKeyTask | CopyTask | FnTask
 class TaskRecord:
     """One unit of durable async work: a kind resolved through the registry
     plus JSON-serializable params — everything the NEXT daemon needs to
-    finish work this one started."""
+    finish work this one started. ``trace_id``/``span_id`` persist the
+    submitting request's trace context, so the async tail continues that
+    trace in-process and a post-crash replay can LINK back to it (span
+    links, not parentage — the origin's span tree died with its daemon)."""
 
     task_id: str
     kind: str
@@ -141,12 +145,15 @@ class TaskRecord:
     attempts: int = 0
     error: str = ""
     idempotency_key: str = ""
+    trace_id: str = ""
+    span_id: str = ""
 
     def to_json(self) -> str:
         return json.dumps({
             "id": self.task_id, "kind": self.kind, "params": self.params,
             "seq": self.seq, "state": self.state, "attempts": self.attempts,
             "error": self.error, "idempotencyKey": self.idempotency_key,
+            "traceId": self.trace_id, "spanId": self.span_id,
         }, sort_keys=True)
 
     @classmethod
@@ -156,7 +163,9 @@ class TaskRecord:
                    seq=int(d["seq"]), state=d.get("state", "pending"),
                    attempts=int(d.get("attempts", 0)),
                    error=d.get("error", ""),
-                   idempotency_key=d.get("idempotencyKey", ""))
+                   idempotency_key=d.get("idempotencyKey", ""),
+                   trace_id=d.get("traceId", ""),
+                   span_id=d.get("spanId", ""))
 
     def label(self) -> str:
         return f"{self.kind}:{self.task_id}"
@@ -184,6 +193,7 @@ class WorkQueue:
         submit_timeout_s: float = DEFAULT_SUBMIT_TIMEOUT_S,
         close_deadline_s: float = DEFAULT_CLOSE_DEADLINE_S,
         metrics=None,
+        tracer=None,
     ) -> None:
         from tpu_docker_api.utils.files import copy_dir_contents
 
@@ -232,6 +242,10 @@ class WorkQueue:
             from tpu_docker_api.telemetry.metrics import REGISTRY
             metrics = REGISTRY
         self._metrics = metrics
+        #: trace sink for task-execution spans (daemon wires the Program's
+        #: tracer); None ⇒ records still CARRY trace context, execution
+        #: just records no spans of its own
+        self._tracer = tracer
         self._registry: dict[str, TaskHandler] = {}
         # built-in declarative kinds every deployment has
         self.register("put_kv",
@@ -300,9 +314,12 @@ class WorkQueue:
                     log.info("workqueue: %s submit deduplicated against "
                              "active record %s:%s", kind, kind, dup_id)
                     return dup_id
+            cur = trace_mod.current()
             rec = TaskRecord(task_id=uuid.uuid4().hex[:12], kind=kind,
                              params=dict(params), seq=self._next_seq(),
-                             idempotency_key=idempotency_key)
+                             idempotency_key=idempotency_key,
+                             trace_id=cur.trace_id if cur else "",
+                             span_id=cur.span_id if cur else "")
             # claim local ownership BEFORE the journal write: once the
             # record is visible in KV, a concurrent reconcile's replay
             # must already see it as ours, or it would double-run it
@@ -313,9 +330,12 @@ class WorkQueue:
         except Exception as e:  # noqa: BLE001 — durability degrades, loudly
             self._degrade("journal-write-failed", f"{kind}: {e}")
             if rec is None:
+                cur = trace_mod.current()
                 rec = TaskRecord(task_id=uuid.uuid4().hex[:12], kind=kind,
                                  params=dict(params), seq=-1,
-                                 idempotency_key=idempotency_key)
+                                 idempotency_key=idempotency_key,
+                                 trace_id=cur.trace_id if cur else "",
+                                 span_id=cur.span_id if cur else "")
                 with self._local_mu:
                     self._local_ids.add(rec.task_id)
             else:
@@ -469,8 +489,8 @@ class WorkQueue:
         self._metrics.counter_inc(
             "workqueue_degraded_total", {"kind": kind},
             help="Durability-path failures the queue degraded through")
-        self._events.append({"ts": time.time(), "event": kind,
-                             "detail": detail})
+        self._events.append(trace_mod.stamp(
+            {"ts": time.time(), "event": kind, "detail": detail}))
 
     # -- consumer side ------------------------------------------------------------
 
@@ -488,11 +508,39 @@ class WorkQueue:
             finally:
                 self._q.task_done()
 
-    def _run_record(self, rec: TaskRecord) -> None:
+    def _task_scope(self, rec: TaskRecord, adopted: bool):
+        """Span scope for one record execution. Same-process execution
+        CONTINUES the submitting trace (same traceId, parent = the submit
+        span); an adopted replay — this daemon did not submit the record,
+        or a reboot reclaimed it — starts a fresh self-rooted trace with
+        ``link=originTraceId``: the origin's span tree ended with its
+        process, so parentage would fabricate a timeline."""
+        if self._tracer is None:
+            return trace_mod.NOOP
+        attrs = {"taskId": rec.task_id, "seq": rec.seq}
+        if not rec.trace_id:
+            # a record submitted with no active trace (tracing was off at
+            # submit, or a bare internal submit): its FIRST execution is
+            # an ordinary task, never a "replay" — a self-rooted span,
+            # trimmed like a loop pass when nothing happened beneath it
+            return self._tracer.span(f"queue.task:{rec.kind}", attrs=attrs,
+                                     trim_idle=True)
+        if not adopted:
+            return self._tracer.span(f"queue.task:{rec.kind}",
+                                     trace_id=rec.trace_id,
+                                     parent_id=rec.span_id, attrs=attrs)
+        return self._tracer.span(f"queue.replay:{rec.kind}",
+                                 links=(rec.trace_id,), attrs=attrs)
+
+    def _run_record(self, rec: TaskRecord, adopted: bool = False) -> None:
         """Full record lifecycle: claim (journal ``inflight``) → execute
         with bounded retries → ack (journal delete) or dead-letter
         (journal ``dead`` + compensation). The three ``queue.*`` crash
         points mark the boundaries the chaos harness kills at."""
+        with self._task_scope(rec, adopted):
+            self._run_record_inner(rec)
+
+    def _run_record_inner(self, rec: TaskRecord) -> None:
         from tpu_docker_api.service.crashpoints import crash_point
 
         rec.state = "inflight"
@@ -708,7 +756,7 @@ class WorkQueue:
                         continue
                 log.info("workqueue: replaying adopted record %s (%s)",
                          rec.label(), rec.state)
-                self._run_record(rec)
+                self._run_record(rec, adopted=True)
                 outcomes.append({
                     "target": rec.label(), "kind": rec.kind,
                     "state": "dead" if rec.state == "dead" else "done",

@@ -16,12 +16,25 @@ _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                     10.0)
 
 
+def _esc_label(v) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote AND newline (an unescaped newline would split the series line in
+    two and ship a silently malformed exposition)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(v: str) -> str:
+    """HELP-text escaping per the exposition format: backslash and
+    newline only (quotes are legal in help text)."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{k}="{str(v).replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
-        for k, v in sorted(labels.items())
+        f'{k}="{_esc_label(v)}"' for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
 
@@ -46,10 +59,23 @@ class MetricsRegistry:
         self._label_names.setdefault(name, tuple(sorted(labels)))
         return tuple(sorted(labels.items()))
 
+    def _declare(self, name: str, typ: str, help: str) -> None:
+        """Register (or re-assert) a metric's type. A name reused with a
+        DIFFERENT type is a programming error that would render a
+        duplicate/contradictory exposition — fail loudly at the mutation
+        site instead of shipping a malformed /metrics page silently."""
+        cur = self._help.get(name)
+        if cur is None:
+            self._help[name] = (typ, help)
+        elif cur[0] != typ:
+            raise ValueError(
+                f"metric {name!r} already registered as {cur[0]}, "
+                f"cannot re-register as {typ}")
+
     def counter_inc(self, name: str, labels: dict | None = None,
                     value: float = 1.0, help: str = "") -> None:
         with self._lock:
-            self._help.setdefault(name, ("counter", help))
+            self._declare(name, "counter", help)
             series = self._counters.setdefault(name, {})
             key = self._series_key(name, labels)
             series[key] = series.get(key, 0.0) + value
@@ -57,14 +83,14 @@ class MetricsRegistry:
     def gauge_set(self, name: str, value: float, labels: dict | None = None,
                   help: str = "") -> None:
         with self._lock:
-            self._help.setdefault(name, ("gauge", help))
+            self._declare(name, "gauge", help)
             self._gauges.setdefault(name, {})[
                 self._series_key(name, labels)] = value
 
     def gauge_fn(self, name: str, fn: Callable[[], float], help: str = "") -> None:
         """Register a pull-time gauge (queue depth, free chips, ...)."""
         with self._lock:
-            self._help.setdefault(name, ("gauge", help))
+            self._declare(name, "gauge", help)
             self._gauge_fns[name] = fn
 
     def counter_value(self, name: str, labels: dict | None = None) -> float:
@@ -85,14 +111,14 @@ class MetricsRegistry:
         apply rate()/increase() with reset handling — exporting a
         monotonic series as a gauge breaks exactly that."""
         with self._lock:
-            self._help.setdefault(name, ("counter", help))
+            self._declare(name, "counter", help)
             self._counter_fns[name] = fn
 
     def observe(self, name: str, value: float, labels: dict | None = None,
                 buckets: Iterable[float] = _DEFAULT_BUCKETS,
                 help: str = "") -> None:
         with self._lock:
-            self._help.setdefault(name, ("histogram", help))
+            self._declare(name, "histogram", help)
             bks = self._hist_buckets.setdefault(name, tuple(buckets))
             series = self._hists.setdefault(name, {})
             key = self._series_key(name, labels)
@@ -111,7 +137,7 @@ class MetricsRegistry:
         with self._lock:
             for name, (typ, hlp) in sorted(self._help.items()):
                 if hlp:
-                    out.append(f"# HELP {name} {hlp}")
+                    out.append(f"# HELP {name} {_esc_help(hlp)}")
                 out.append(f"# TYPE {name} {typ}")
                 if typ == "counter":
                     if name in self._counter_fns:
