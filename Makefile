@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-serve-scale bench-scale openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-scale openapi sample-interface run clean
 
 all: native openapi
 
@@ -71,6 +71,11 @@ bench-preempt:               ## capacity-market family: fill with preemptible ga
 	$(PY) bench.py --control-plane --cp-family preempt > bench-preempt.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-preempt.json.tmp
 	mv bench-preempt.json.tmp bench-preempt.json
+
+bench-resize:                ## elastic-gang family: partial-preempt shrink + grow-back through the queue + host-loss shrink; time-to-shrunk + zero-full-preempt gates
+	$(PY) bench.py --control-plane --cp-family resize > bench-resize.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-resize.json.tmp
+	mv bench-resize.json.tmp bench-resize.json
 
 bench-serve-scale:           ## service autoscaling family: offered-load step -> time-to-scaled, SLO recovery, scale-up-through-admission + zero-manual-ops gates
 	$(PY) bench.py --control-plane --cp-family serve-scale > bench-serve-scale.json.tmp

@@ -1175,6 +1175,220 @@ def measure_control_plane_preempt(n_low: int = 4, n_high: int = 3,
     }
 
 
+def measure_control_plane_resize(iters: int = 3, n_hosts: int = 4,
+                                 interval_s: float = 0.05,
+                                 shrink_budget_ms: float = 5000.0,
+                                 down_grace_s: float = 0.2,
+                                 timeout_s: float = 30.0) -> dict:
+    """Elastic-gang resize family (``--control-plane --cp-family
+    resize``; docs/robustness.md "Elastic gangs"). Two scenarios, both
+    self-gating:
+
+    **Partial preemption + grow-back** (over real HTTP): an elastic
+    preemptible gang fills the pod; a production one-host burst must be
+    satisfied by SHRINKING the gang (spare members donated, time-to-shrunk
+    measured submit→both-running) with **zero full preemptions** — the
+    victim keeps training at reduced batch size. Deleting the production
+    job must GROW the gang BACK through the admission queue (the journaled
+    grow-back record, preempted-grade precedence), proven by the
+    ``job-partially-preempted`` / ``job-growback-queued`` / grow-back
+    ``job-admitted`` events in the merged ring.
+
+    **Host loss** (in-process, FaultyRuntime): killing one host's engine
+    must shrink the gang to its survivors — zero gang restarts charged,
+    zero migrations, the restart budget untouched — within the same
+    time-to-shrunk budget (measured kill→shrunken-and-running, so the
+    down-grace window is part of the honest number).
+
+    A violated gate flips ``gates.ok``; main() turns that into a nonzero
+    exit."""
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.faulty import FaultyRuntime
+
+    if iters < 1 or n_hosts < 3:
+        raise ValueError("resize family needs iters >= 1, n_hosts >= 3")
+
+    def pod_hosts():
+        return [{"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+                 "grid_coord": [i, 0, 0],
+                 **({"local": True} if i == 0
+                    else {"runtime_backend": "fake"})}
+                for i in range(n_hosts)]
+
+    def call(prog, method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def wait_until(fn, what: str) -> float:
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        while time.perf_counter() < deadline:
+            if fn():
+                return (time.perf_counter() - t0) * 1e3
+            time.sleep(0.005)
+        raise RuntimeError(f"timed out waiting for {what}")
+
+    # ── scenario A: partial preemption shrinks, grow-back restores ──────
+    prog = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=47000, end_port=47999, health_watch_interval=0,
+        host_probe_interval_s=0, job_supervise_interval=0,
+        reconcile_interval=0, admission_enabled=True,
+        admission_interval_s=interval_s, pod_hosts=pod_hosts(),
+    ), host="127.0.0.1")
+    prog.init()
+    prog.start()
+    shrink_ms: list[float] = []
+    growback_ms: list[float] = []
+    try:
+        per_host = prog.pod.chips_per_host
+        full = n_hosts * per_host
+
+        def members(name) -> int:
+            return call(prog, "GET", f"/api/v1/jobs/{name}")["data"].get(
+                "membersActual", -1)
+
+        def phase(name) -> str:
+            return call(prog, "GET", f"/api/v1/jobs/{name}")["data"]["phase"]
+
+        def admission_view() -> dict:
+            return call(prog, "GET", "/api/v1/admission")["data"]
+
+        out = call(prog, "POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": "don", "chipCount": full,
+            "priorityClass": "preemptible", "elastic": True,
+            "minMembers": 1})
+        if out["data"]["phase"] != "running":
+            raise RuntimeError(f"elastic filler never placed: {out}")
+        for i in range(iters):
+            t0 = time.perf_counter()
+            call(prog, "POST", "/api/v1/jobs", {
+                "imageName": "jax", "jobName": f"prod{i}",
+                "chipCount": per_host, "priorityClass": "production"})
+            wait_until(lambda: phase(f"prod{i}") == "running"
+                       and members("don") == n_hosts - 1,
+                       f"prod{i} placed via shrink of don")
+            shrink_ms.append((time.perf_counter() - t0) * 1e3)
+            call(prog, "DELETE", f"/api/v1/jobs/prod{i}",
+                 {"force": True, "delStateAndVersionRecord": True})
+            growback_ms.append(wait_until(
+                lambda: members("don") == n_hosts
+                and phase("don") == "running",
+                "don grown back through the queue"))
+        view = admission_view()
+        full_preempts = view["preemptionsTotal"]
+        partial_preempts = view["partialPreemptionsTotal"]
+        events = call(prog, "GET", "/api/v1/events?limit=500")["data"]
+        kinds = [e.get("event") for e in events]
+        growback_admits = sum(
+            1 for e in events
+            if e.get("event") == "job-admitted" and e.get("via") == "growback")
+    finally:
+        prog.stop()
+
+    # ── scenario B: host loss shrinks instead of migrating/failing ──────
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.runtime.faulty import FaultPlan
+    from tpu_docker_api.state.kv import MemoryKV
+
+    rts = {f"h{i}": FaultyRuntime(FakeRuntime(), FaultPlan())
+           for i in range(n_hosts)}
+    prog = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=47000, end_port=47999, health_watch_interval=0,
+        host_probe_interval_s=0.02, host_down_grace_s=down_grace_s,
+        job_supervise_interval=0.02, reconcile_interval=0,
+        admission_enabled=True, admission_interval_s=interval_s,
+        pod_hosts=pod_hosts(),
+    ), host="127.0.0.1", kv=MemoryKV(), runtime=rts["h0"],
+        pod_runtimes={h: r for h, r in rts.items() if h != "h0"})
+    prog.init()
+    prog.start()
+    try:
+        per_host = prog.pod.chips_per_host
+        out = call(prog, "POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": "train",
+            "chipCount": n_hosts * per_host,
+            "priorityClass": "batch", "elastic": True, "minMembers": 1})
+        if out["data"]["phase"] != "running":
+            raise RuntimeError(f"elastic gang never placed: {out}")
+        victim_host = f"h{n_hosts - 1}"
+        rts[victim_host].set_unreachable(True)
+        t0 = time.perf_counter()
+
+        def shrunk() -> bool:
+            d = call(prog, "GET", "/api/v1/jobs/train")["data"]
+            return (d["phase"] == "running"
+                    and d.get("membersActual") == n_hosts - 1
+                    and all(p["hostId"] != victim_host
+                            for p in d["processes"]))
+
+        wait_until(shrunk, "host-loss shrink of train")
+        host_loss_ms = (time.perf_counter() - t0) * 1e3
+        shrink_ms.append(host_loss_ms)
+        d = call(prog, "GET", "/api/v1/jobs/train")["data"]
+        restarts_burned = d.get("restarts", 0)
+        migrations_burned = d.get("migrations", 0)
+        growback_queued = d.get("growbackQueuePosition") is not None
+    finally:
+        prog.stop()
+
+    def quantiles(ms: list[float]) -> dict:
+        s = sorted(ms)
+        return {"p50": round(s[len(s) // 2], 3),
+                "p95": round(s[min(len(s) - 1, int(len(s) * 0.95))], 3),
+                "max": round(s[-1], 3)}
+
+    gates = {
+        "shrink_budget_ms": shrink_budget_ms,
+        "time_to_shrunk_p95_ok": quantiles(shrink_ms)["p95"]
+        <= shrink_budget_ms,
+        # the tentpole invariant: when shrink suffices, NOTHING dies whole
+        "zero_full_preemptions": full_preempts == 0,
+        "full_preemptions": full_preempts,
+        "partial_preemptions": partial_preempts,
+        "partial_preempted": partial_preempts >= iters,
+        "partial_preempt_event": "job-partially-preempted" in kinds,
+        "growback_queued_event": "job-growback-queued" in kinds,
+        # grow-back landed THROUGH the queue, not via a private retry
+        "growback_via_queue": growback_admits >= iters,
+        "growback_admits": growback_admits,
+        # host loss: shrink absorbed it — no restart/migration budget burn
+        "host_loss_shrunk": True,
+        "host_loss_zero_restarts": restarts_burned == 0,
+        "host_loss_zero_migrations": migrations_burned == 0,
+        "host_loss_growback_queued": growback_queued,
+    }
+    gates["ok"] = bool(
+        gates["time_to_shrunk_p95_ok"] and gates["zero_full_preemptions"]
+        and gates["partial_preempted"] and gates["partial_preempt_event"]
+        and gates["growback_queued_event"] and gates["growback_via_queue"]
+        and gates["host_loss_zero_restarts"]
+        and gates["host_loss_zero_migrations"]
+        and gates["host_loss_growback_queued"])
+    return {
+        "family": "resize",
+        "iters": {"cycles": iters, "hosts": n_hosts,
+                  "admission_interval_s": interval_s,
+                  "down_grace_s": down_grace_s},
+        "time_to_shrunk_ms": quantiles(shrink_ms),
+        "shrunk_ms": [round(v, 3) for v in shrink_ms],
+        "growback_ms": quantiles(growback_ms),
+        "host_loss_ms": round(host_loss_ms, 3),
+        "gates": gates,
+    }
+
+
 def measure_control_plane_serve_scale(iters: int = 3,
                                       chips_per_replica: int = 2,
                                       max_replicas: int = 3,
@@ -1701,7 +1915,7 @@ def measure_control_plane_scale(n_objects: int = 50000, n_small: int = 1000,
 
 
 CP_FAMILIES = ("create", "churn", "failover", "reads", "fanout",
-               "preempt", "serve-scale", "scale")
+               "preempt", "resize", "serve-scale", "scale")
 
 
 # control-plane family dispatch — shared by the --control-plane branch
@@ -1726,6 +1940,8 @@ def _run_cp_family(family: str, args) -> dict:
     if family == "preempt":
         return measure_control_plane_preempt(
             n_low=args.preempt_low, n_high=args.preempt_high)
+    if family == "resize":
+        return measure_control_plane_resize(iters=args.resize_iters)
     if family == "serve-scale":
         return measure_control_plane_serve_scale(iters=args.serve_iters)
     if family == "scale":
@@ -1753,6 +1969,9 @@ def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
     if family == "preempt":
         return ("control_plane_preempt_time_to_placed_ms_p50",
                 cp["time_to_placed_ms"]["p50"], "ms")
+    if family == "resize":
+        return ("control_plane_resize_time_to_shrunk_ms_p50",
+                cp["time_to_shrunk_ms"]["p50"], "ms")
     if family == "serve-scale":
         return ("control_plane_serve_scale_time_to_scaled_ms_p50",
                 cp["time_to_scaled_ms"]["p50"], "ms")
@@ -1772,7 +1991,7 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
     ``BENCH_DEGRADED_FAMILIES`` (comma list) overrides the default set."""
     families = [f.strip() for f in os.environ.get(
         "BENCH_DEGRADED_FAMILIES",
-        "churn,preempt,serve-scale,scale").split(",")
+        "churn,preempt,resize,serve-scale,scale").split(",")
         if f.strip()]
     green = 0
     for family in families:
@@ -1844,7 +2063,12 @@ def main() -> int | None:
                              "submit production gangs, time-to-placed "
                              "p50/p95 + preemptions-per-admission, gating "
                              "all-high-placed / zero-preempt-with-holes / "
-                             "legacy refusal preserved; serve-scale = "
+                             "legacy refusal preserved; resize = elastic "
+                             "gangs: partial-preempt shrink + grow-back "
+                             "through the queue + host-loss shrink, "
+                             "gating time-to-shrunk and zero full "
+                             "preemptions when shrink suffices; "
+                             "serve-scale = "
                              "offered-load step against a Service beside "
                              "batch training, gating time-to-scaled, SLO "
                              "recovery, scale-up-through-the-admission-"
@@ -1878,6 +2102,9 @@ def main() -> int | None:
     parser.add_argument("--preempt-high", type=int, default=3,
                         help="production gangs submitted under pressure "
                              "for the preempt family")
+    parser.add_argument("--resize-iters", type=int, default=3,
+                        help="partial-preempt shrink + grow-back cycles "
+                             "for the resize family")
     parser.add_argument("--serve-iters", type=int, default=3,
                         help="offered-load step cycles for the serve-scale "
                              "family")

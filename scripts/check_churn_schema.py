@@ -160,6 +160,75 @@ def validate_preempt(extra: dict) -> list[str]:
     return problems
 
 
+def validate_resize(extra: dict) -> list[str]:
+    """The elastic-gang family headline payload: time-to-shrunk quantiles
+    over partial-preemption cycles + the host-loss shrink, grow-back
+    counts, and a passing gate. The zero-full-preempt-when-shrink-suffices
+    and shrink-budget contracts are re-checked here (not just gates.ok):
+    a market that killed a whole gang when spare members sufficed, a
+    shrink that blew its budget, or a grow-back that bypassed the
+    admission queue must fail loudly at the schema layer too."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    cycles = it.get("cycles")
+    if not (isinstance(cycles, int) and cycles >= 1):
+        problems.append(f"resize: iters.cycles must be an int >= 1, "
+                        f"got {cycles!r}")
+    if not (isinstance(it.get("hosts"), int) and it["hosts"] >= 3):
+        problems.append(f"resize: iters.hosts must be an int >= 3, "
+                        f"got {it.get('hosts')!r}")
+    tts = extra.get("time_to_shrunk_ms") or {}
+    for q in QUANTS:
+        if not _num(tts.get(q)) or tts[q] <= 0:
+            problems.append(f"resize: time_to_shrunk_ms.{q} must be a "
+                            f"positive number, got {tts.get(q)!r}")
+    series = extra.get("shrunk_ms")
+    if (not isinstance(series, list)
+            or (isinstance(cycles, int) and len(series) != cycles + 1)
+            or not all(_num(v) and v > 0 for v in series)):
+        problems.append("resize: shrunk_ms must list one positive "
+                        "time-to-shrunk per partial-preempt cycle plus "
+                        "the host-loss shrink")
+    gates = extra.get("gates") or {}
+    for key in ("shrink_budget_ms", "time_to_shrunk_p95_ok",
+                "zero_full_preemptions", "full_preemptions",
+                "partial_preemptions", "partial_preempted",
+                "partial_preempt_event", "growback_queued_event",
+                "growback_via_queue", "growback_admits",
+                "host_loss_zero_restarts", "host_loss_zero_migrations",
+                "host_loss_growback_queued", "ok"):
+        if key not in gates:
+            problems.append(f"resize: gates.{key} missing")
+    if gates.get("full_preemptions") != 0:
+        problems.append(
+            f"resize: gates.full_preemptions is "
+            f"{gates.get('full_preemptions')!r} — a whole gang died "
+            f"although shrink sufficed (partial preemption broken)")
+    pp = gates.get("partial_preemptions")
+    if not (isinstance(pp, int) and pp >= 1):
+        problems.append(f"resize: gates.partial_preemptions must be an "
+                        f"int >= 1, got {pp!r} (no spare member was ever "
+                        f"donated?)")
+    ga = gates.get("growback_admits")
+    if not (isinstance(ga, int) and ga >= 1):
+        problems.append(f"resize: gates.growback_admits must be an int "
+                        f">= 1, got {ga!r} (no grow-back landed through "
+                        f"the admission queue — the market path is "
+                        f"unproven)")
+    budget = gates.get("shrink_budget_ms")
+    if _num(budget) and _num(tts.get("p95")) and tts["p95"] > budget:
+        problems.append(f"resize: time-to-shrunk p95 {tts['p95']}ms blew "
+                        f"the {budget}ms budget")
+    for key in ("host_loss_zero_restarts", "host_loss_zero_migrations"):
+        if gates.get(key) is not True:
+            problems.append(f"resize: {key} is {gates.get(key)!r} — a "
+                            f"host loss burned a restart/migration budget "
+                            f"a shrink should have absorbed")
+    if gates.get("ok") is not True:
+        problems.append(f"resize: regression gate failed: {gates}")
+    return problems
+
+
 def validate_serve_scale(extra: dict) -> list[str]:
     """The service-autoscaling family headline payload: time-to-scaled
     quantiles over offered-load steps and a passing gate. The
@@ -412,6 +481,10 @@ def validate_lines(lines: list[dict]) -> list[str]:
                if (ln.get("extra") or {}).get("family") == "preempt"]
     if preempt:
         return problems + validate_preempt(preempt[0]["extra"])
+    resize = [ln for ln in lines
+              if (ln.get("extra") or {}).get("family") == "resize"]
+    if resize:
+        return problems + validate_resize(resize[0]["extra"])
     serve = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "serve-scale"]
     if serve:
@@ -424,7 +497,7 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
         return problems + ["no churn, failover, reads, fanout, preempt, "
-                           "serve-scale or scale headline line "
+                           "resize, serve-scale or scale headline line "
                            "(extra.family)"]
     extra = churn[0]["extra"]
 

@@ -478,3 +478,229 @@ class TestConfigValidation:
         prg.admission.admit_once()
         assert phase(prg, "vip") == "running"
         assert phase(prg, "cheap") == "preempted"
+
+
+class TestPartialPreemption:
+    """Elastic gangs in the capacity market (docs/robustness.md "Elastic
+    gangs"), property-style:
+
+    - spare members are taken from elastic strictly-lower-class gangs
+      BEFORE any whole gang dies (zero full preemptions when shrink
+      suffices);
+    - a gang never shrinks below its ``minMembers`` floor — when spares
+      cannot make room the victim is condemned WHOLE, exactly like PR 10;
+    - only strictly-lower classes donate; youngest donors donate first;
+    - with no elastic victim in range the plan is byte-for-byte
+      ``_victims_for`` — non-elastic deployments keep PR 10 semantics;
+    - the shrunken gang grows BACK through the admission queue once
+      pressure lifts (preempted-grade precedence, record settled
+      exactly-once).
+    """
+
+    def members(self, prg, base) -> int:
+        return len(prg.store.get_job(
+            f"{base}-{prg.job_versions.get(base)}").placements)
+
+    def test_spare_members_taken_before_any_whole_gang_dies(self):
+        prg = boot(n_hosts=4)
+        run(prg, "don", 32, "preemptible", elastic=True, min_members=1)
+        assert run(prg, "prod", 8, "production")["phase"] == "queued"
+        assert [o["job"] for o in prg.admission.admit_once()] == ["prod"]
+        # the donor SHRANK (4 → 3 members) and keeps running; nothing died
+        assert phase(prg, "don") == "running"
+        assert self.members(prg, "don") == 3
+        assert phase(prg, "prod") == "running"
+        view = prg.admission.status_view()
+        assert view["preemptionsTotal"] == 0
+        assert view["partialPreemptionsTotal"] == 1
+        # the donation journaled a grow-back record at the donor's class
+        recs = [(r.base, r.kind) for r in prg.admission.records()]
+        assert ("don", "growback") in recs
+        info = prg.job_svc.get_job_info("don")
+        assert info["membersDesired"] == 4 and info["membersActual"] == 3
+        assert info["growbackQueuePosition"] == 1
+        assert info["lastResize"]["direction"] == "down"
+        assert oracle(prg) == []
+
+    def test_growback_lands_through_the_queue_after_pressure_lifts(self):
+        prg = boot(n_hosts=4)
+        run(prg, "don", 32, "preemptible", elastic=True, min_members=1)
+        run(prg, "prod", 8, "production")
+        prg.admission.admit_once()
+        assert self.members(prg, "don") == 3
+        # pressure stays: a pass with a full pool grows nothing
+        assert prg.admission.admit_once() == []
+        assert self.members(prg, "don") == 3
+        # pressure lifts: the grow-back record admits through the queue
+        prg.job_svc.delete_job("prod", JobDelete(
+            force=True, del_state_and_version_record=True))
+        assert [o["job"] for o in prg.admission.admit_once()] == ["don"]
+        assert self.members(prg, "don") == 4
+        assert phase(prg, "don") == "running"
+        # settled exactly-once: no record left, a second pass is a no-op
+        assert prg.admission.records() == []
+        assert prg.admission.admit_once() == []
+        assert oracle(prg) == []
+        assert prg.reconciler.reconcile()["actions"] == []
+        # job_resize_max bounds ATTEMPTS of one resize, never the
+        # lifetime counter: with the bound at 2 and 2 resizes already on
+        # the books, the next shrink/grow cycle still works
+        prg.job_svc.resize_max = 2
+        assert prg.store.get_job(
+            f"don-{prg.job_versions.get('don')}").resizes == 2
+        run(prg, "prod2", 8, "production")
+        prg.admission.admit_once()
+        assert self.members(prg, "don") == 3
+        prg.job_svc.delete_job("prod2", JobDelete(
+            force=True, del_state_and_version_record=True))
+        prg.admission.admit_once()
+        assert self.members(prg, "don") == 4
+        assert phase(prg, "don") == "running"
+
+    def test_growback_parks_while_resize_disabled(self):
+        """job_resize_enabled=false parks a pending grow-back (the record
+        survives, nothing grows); re-enabling resumes it."""
+        prg = boot(n_hosts=4)
+        run(prg, "don", 32, "preemptible", elastic=True, min_members=1)
+        run(prg, "prod", 8, "production")
+        prg.admission.admit_once()
+        prg.job_svc.delete_job("prod", JobDelete(
+            force=True, del_state_and_version_record=True))
+        prg.job_svc.resize_enabled = False
+        assert prg.admission.admit_once() == []
+        assert self.members(prg, "don") == 3
+        assert {r.kind for r in prg.admission.records()
+                if r.base == "don"} == {"growback"}
+        prg.job_svc.resize_enabled = True
+        assert [o["job"] for o in prg.admission.admit_once()] == ["don"]
+        assert self.members(prg, "don") == 4
+
+    def test_never_below_min_members_whole_gang_condemned_instead(self):
+        """Spares stop at the floor: a 4-member gang with minMembers=3 can
+        donate ONE host; a 2-host ask then needs the whole gang — PR 10
+        whole-gang preemption, never a below-floor shrink."""
+        prg = boot(n_hosts=4)
+        run(prg, "don", 32, "preemptible", elastic=True, min_members=3)
+        assert run(prg, "prod", 16, "production")["phase"] == "queued"
+        assert [o["job"] for o in prg.admission.admit_once()] == ["prod"]
+        assert phase(prg, "prod") == "running"
+        assert phase(prg, "don") == "preempted"
+        view = prg.admission.status_view()
+        assert view["preemptionsTotal"] == 1
+        recs = {r.base: r.kind for r in prg.admission.records()}
+        assert recs["don"] == "preempted"
+        assert oracle(prg) == []
+
+    def test_strictly_lower_class_only(self):
+        """An elastic gang at the requester's own class never donates —
+        eligibility is strictly-lower weight, same as whole-gang
+        preemption."""
+        prg = boot(n_hosts=2)
+        run(prg, "peer", 16, "production", elastic=True, min_members=1)
+        assert run(prg, "prod", 8, "production")["phase"] == "queued"
+        assert prg.admission.admit_once() == []
+        assert self.members(prg, "peer") == 2
+        assert phase(prg, "prod") == "queued"
+        assert oracle(prg) == []
+
+    def test_youngest_elastic_donor_first(self):
+        """Within the donor class the YOUNGEST gang donates first (the
+        paged.py seniority rule, applied member-wise)."""
+        prg = boot(n_hosts=4)
+        run(prg, "old", 16, "preemptible", elastic=True, min_members=1)
+        run(prg, "young", 16, "preemptible", elastic=True, min_members=1)
+        assert run(prg, "prod", 8, "production")["phase"] == "queued"
+        assert [o["job"] for o in prg.admission.admit_once()] == ["prod"]
+        assert self.members(prg, "young") == 1   # donated
+        assert self.members(prg, "old") == 2     # untouched
+        assert oracle(prg) == []
+
+    def test_plan_is_pr10_victims_byte_for_byte_without_elastic_donors(self):
+        """With no elastic victim in range the partial-preemption planner
+        degenerates to exactly ``_victims_for`` — the PR 10 contract the
+        ordering tests above pin stays byte-for-byte."""
+        prg = boot(n_hosts=2)
+        run(prg, "a", 4, "batch")
+        run(prg, "b", 4, "preemptible")
+        run(prg, "c", 4, "preemptible")
+        w = prg.admission.weight("production")
+        for want in (16, 6, 4):
+            assert (prg.admission._preempt_plan(w, want, 1, "req")
+                    == [("full", b, 0)
+                        for b in prg.admission._victims_for(w, want, 1,
+                                                            "req")])
+
+    def test_resize_disabled_keeps_whole_gang_preemption(self):
+        """job_resize_enabled=false: the donor pool is ignored and the
+        market behaves exactly like PR 10 — the elastic gang dies whole."""
+        prg = boot(n_hosts=4)
+        prg.job_svc.resize_enabled = False
+        run(prg, "don", 32, "preemptible", elastic=True, min_members=1)
+        run(prg, "prod", 8, "production")
+        prg.admission.admit_once()
+        assert phase(prg, "don") == "preempted"
+        assert phase(prg, "prod") == "running"
+        assert prg.admission.status_view()["partialPreemptionsTotal"] == 0
+        assert oracle(prg) == []
+
+    def test_elastic_validation(self):
+        prg = boot(n_hosts=4)
+        with pytest.raises(errors.BadRequest, match="single-slice"):
+            run(prg, "x", 32, "batch", elastic=True, num_slices=2)
+        with pytest.raises(errors.BadRequest, match=">= 2 whole hosts"):
+            run(prg, "x", 4, "batch", elastic=True)
+        with pytest.raises(errors.BadRequest, match="minMembers"):
+            run(prg, "x", 16, "batch", elastic=True, min_members=5)
+        with pytest.raises(errors.BadRequest, match="elastic"):
+            run(prg, "x", 16, "batch", min_members=1)
+        # an elastic job queues with its contract intact (resolved at
+        # admission time like the rest of the spec)
+        run(prg, "fill", 32, "production")
+        out = run(prg, "el", 16, "batch", elastic=True, min_members=2)
+        assert out["phase"] == "queued"
+        st = prg.store.get_job(f"el-{prg.job_versions.get('el')}")
+        assert st.elastic and st.min_members == 2 and st.members_desired == 2
+
+    def test_rescale_updates_elastic_contract(self):
+        """A user rescale rewrites membersDesired (grow-back targets the
+        new shape) and rejects shapes the elastic contract cannot hold."""
+        prg = boot(n_hosts=4)
+        run(prg, "el", 32, "batch", elastic=True, min_members=3)
+        with pytest.raises(errors.BadRequest, match="whole-host"):
+            prg.job_svc.patch_job_chips("el", JobPatchChips(chip_count=4))
+        with pytest.raises(errors.BadRequest, match="minMembers"):
+            # 2 hosts is a legal elastic shape but below the floor of 3
+            prg.job_svc.patch_job_chips(
+                "el", JobPatchChips(chip_count=16))
+        prg.job_svc.patch_job_chips("el", JobPatchChips(chip_count=24))
+        st = prg.store.get_job(f"el-{prg.job_versions.get('el')}")
+        assert st.members_desired == 3 and st.elastic
+        assert oracle(prg) == []
+
+    def test_blocked_growback_never_freezes_queued_admissions(self):
+        """A grow-back that cannot place (its gang needs a WHOLE host,
+        only sub-host holes churn) waits indefinitely by design — but it
+        must never accrue skips and trip the starvation gate: queued work
+        keeps backfilling past it forever."""
+        prg = boot(n_hosts=4, max_skips=2)
+        run(prg, "halfpin", 4, "batch")    # h0 half-used: never fully free
+        run(prg, "don", 24, "batch", elastic=True, min_members=1)  # h1-h3
+        assert run(prg, "prod", 8, "production")["phase"] == "queued"
+        prg.admission.admit_once()         # don shrinks 3 → 2, prod places
+        assert self.members(prg, "don") == 2
+        assert phase(prg, "prod") == "running"
+        run(prg, "g0", 4, "batch")         # takes h0's last 4 chips
+        # churn sub-host holes past the blocked grow-back, beyond max_skips
+        prev = "g0"
+        for i in range(3):
+            assert run(prg, f"f{i}", 4, "batch")["phase"] == "queued"
+            prg.job_svc.delete_job(prev, JobDelete(
+                force=True, del_state_and_version_record=True))
+            assert [o["job"] for o in prg.admission.admit_once()] \
+                == [f"f{i}"], f"queued admission froze at backfill {i}"
+            prev = f"f{i}"
+        # the grow-back is still waiting, uncharged, and the gang intact
+        rec = next(r for r in prg.admission.records() if r.base == "don")
+        assert rec.kind == "growback" and rec.skips == 0
+        assert self.members(prg, "don") == 2
+        assert oracle(prg) == []

@@ -312,6 +312,73 @@ class TestFanoutFamily:
                 assert 0 < stats[f"{flow}_ms_min"] <= stats[f"{flow}_ms_max"]
 
 
+class TestResizeFamily:
+    """The elastic-gang family (``make bench-resize``) at tiny scale —
+    pinning both the artifact schema (scripts/check_churn_schema.py) and
+    the tentpole invariants: a production burst into a full pod is
+    satisfied by SHRINKING the elastic gang (zero full preemptions when
+    shrink suffices), the gang grows BACK through the admission queue
+    once pressure lifts, and a host loss shrinks the gang with zero
+    restart/migration budget burned."""
+
+    @pytest.fixture(scope="class")
+    def resize(self):
+        return bench.measure_control_plane_resize(iters=2)
+
+    def test_schema_checker_accepts_the_emitted_line(self, resize):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_resize_time_to_shrunk_ms_p50",
+                "value": resize["time_to_shrunk_ms"]["p50"],
+                "unit": "ms", "vs_baseline": 1.0, "extra": resize}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... and so must a full preemption where shrink sufficed (the
+        # failure mode this family exists to catch), a grow-back that
+        # bypassed the queue, or a blown shrink budget
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["full_preemptions"] = 1
+        assert any("whole gang died" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["growback_admits"] = 0
+        assert any("admission queue" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["time_to_shrunk_ms"]["p95"] = (
+            bad["extra"]["gates"]["shrink_budget_ms"] + 1)
+        assert any("budget" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["host_loss_zero_restarts"] = False
+        assert any("burned a restart" in p for p in validate_lines([bad]))
+
+    def test_resize_gates_hold(self, resize):
+        gates = resize["gates"]
+        assert gates["ok"] is True
+        # the tentpole: shrink sufficed, so NOTHING died whole
+        assert gates["zero_full_preemptions"] is True
+        assert gates["full_preemptions"] == 0
+        assert gates["partial_preemptions"] >= 2
+        # grow-back landed through the queue, with the journal events
+        assert gates["growback_via_queue"] is True
+        assert gates["growback_admits"] >= 2
+        assert gates["partial_preempt_event"] is True
+        assert gates["growback_queued_event"] is True
+        # host loss: absorbed by the shrink, budgets untouched
+        assert gates["host_loss_zero_restarts"] is True
+        assert gates["host_loss_zero_migrations"] is True
+        assert gates["host_loss_growback_queued"] is True
+        tts = resize["time_to_shrunk_ms"]
+        assert 0 < tts["p50"] <= tts["p95"] <= tts["max"]
+        assert tts["p95"] <= gates["shrink_budget_ms"]
+        assert len(resize["shrunk_ms"]) == 3  # 2 cycles + host loss
+
+
 class TestPreemptFamily:
     """The capacity-market family (``make bench-preempt``): fill the pool
     with preemptible gangs on the fake runtime, submit production gangs,
@@ -570,7 +637,8 @@ def test_dead_backend_degrades_to_control_plane_evidence():
         capture_output=True, text=True, timeout=180,
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
              # one quick family keeps the pin fast; the full default set
-             # (churn,preempt,serve-scale) runs in real BENCH captures
+             # (churn,preempt,resize,serve-scale,scale) runs in real
+             # BENCH captures
              "BENCH_DEGRADED_FAMILIES": "serve-scale"})
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     lines = [json.loads(ln) for ln in proc.stdout.splitlines() if ln]

@@ -45,6 +45,7 @@ from tpu_docker_api.service.crashpoints import (
     LEADER_CRASH_POINTS,
     QUEUE_CRASH_POINTS,
     RECONCILE_CRASH_POINTS,
+    RESIZE_CRASH_POINTS,
     TXN_CRASH_POINTS,
     SimulatedCrash,
     armed,
@@ -129,6 +130,10 @@ def test_case_matrix_covers_every_crash_point():
     # the admission matrix kills the daemon at every capacity-market
     # lifecycle point (admission.preempt fires twice: via skip=0/1)
     assert {p for p, _ in ADMISSION_CASES} == set(ADMISSION_CRASH_POINTS)
+    # the resize matrix (TestResizeChaos) kills the daemon at every
+    # elastic-gang lifecycle point (job.resize.after_start_new fires
+    # twice: via skip=0/1)
+    assert {p for p, _ in RESIZE_CASES} == set(RESIZE_CRASH_POINTS)
     # the scale matrix (TestScaleChaos) kills the compactor on both
     # sides of the trim and the dirty-driven reconcile mid-pass
     assert {p for p, _ in COMPACTOR_CASES} == set(COMPACTOR_CRASH_POINTS)
@@ -140,7 +145,8 @@ def test_case_matrix_covers_every_crash_point():
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
             | set(LEADER_CRASH_POINTS) | set(FANOUT_CRASH_POINTS)
-            | set(ADMISSION_CRASH_POINTS) | set(SERVICE_CRASH_POINTS)
+            | set(ADMISSION_CRASH_POINTS) | set(RESIZE_CRASH_POINTS)
+            | set(SERVICE_CRASH_POINTS)
             | set(RECONCILE_CRASH_POINTS) | set(COMPACTOR_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
@@ -1465,6 +1471,168 @@ class TestAdmissionChaos:
         # exactly one placed version, one live gang
         assert prg2.job_versions.get("high") == 1  # v0 queued, v1 placed
         assert _job_oracle(prg2) == []
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+
+# -- elastic-gang resize machinery (docs/robustness.md "Elastic gangs") -------
+
+#: (crash point, skip) — job.resize.after_start_new fires twice on a
+#: shrink: skip=0 dies before the grow-back record is journaled (reconcile
+#: must re-journal it), skip=1 dies with the record durable
+RESIZE_CASES = (
+    ("admission.partial_preempt", 0),
+    ("job.resize.after_mark", 0),
+    ("job.resize.after_quiesce", 0),
+    ("job.resize.after_create_new", 0),
+    ("job.resize.after_start_new", 0),
+    ("job.resize.after_start_new", 1),
+)
+
+
+def boot_resize_pod(kv, rts) -> Program:
+    """A 4-host pod with the capacity market enabled (admission loop off:
+    tests drive passes inline, under armed crash points)."""
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        admission_enabled=True, admission_interval_s=0,
+        pod_hosts=[
+            {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+             "grid_coord": [i, 0, 0],
+             **({"local": True} if i == 0
+                else {"runtime_backend": "fake"})}
+            for i in range(4)
+        ],
+    )
+    prg = Program(cfg, kv=kv, runtime=rts["h0"],
+                  pod_runtimes={h: r for h, r in rts.items() if h != "h0"})
+    prg.init()
+    return prg
+
+
+class TestResizeChaos:
+    """Kill the daemon at every resize crash point mid-partial-preemption
+    (docs/robustness.md "Elastic gangs"): a fresh Program over the same
+    store + engines must reconcile to ONE live version, zero leaks, the
+    elastic victim at either the OLD size or the NEW size — never
+    half-resized — and the grow-back intent must survive (or be
+    re-journaled) so the gang still grows back once pressure lifts."""
+
+    @pytest.mark.parametrize("point,skip", RESIZE_CASES,
+                             ids=[f"{p}@skip{s}" for p, s in RESIZE_CASES])
+    def test_resize_crash_converges(self, point, skip):
+        kv = MemoryKV()
+        rts = {f"h{i}": FakeRuntime() for i in range(4)}
+        prg = boot_resize_pod(kv, rts)
+        # an elastic preemptible gang fills all 4 hosts (minMembers=1)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="don",
+                                   chip_count=32,
+                                   priority_class="preemptible",
+                                   elastic=True, min_members=1))
+        # a production 1-host ask must be satisfied by SHRINKING don
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="prod",
+                                   chip_count=8,
+                                   priority_class="production"))
+        with armed(point, skip=skip):
+            with pytest.raises(SimulatedCrash):
+                prg.admission.admit_once()
+
+        # the daemon is dead; a fresh control plane boots over the wreck
+        prg2 = boot_resize_pod(kv, rts)
+        prg2.reconciler.reconcile()
+        problems = _job_oracle(prg2)
+        assert problems == [], f"{point}@skip{skip}: {problems}"
+
+        # never half-resized: don runs at the old size or the new size,
+        # with exactly its placements' members running
+        don = prg2.store.get_job(f"don-{prg2.job_versions.get('don')}")
+        assert don.phase == "running", f"{point}@skip{skip}: {don.phase}"
+        assert len(don.placements) in (3, 4)
+        don_running = [
+            c for h, c, *_ in don.placements
+            if prg2.pod.hosts[h].runtime.container_inspect(c).running]
+        assert len(don_running) == len(don.placements)
+
+        # drain the market: prod places exactly once (via the shrink) and
+        # the shrunken don holds a grow-back record
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        prod = prg2.store.get_job(f"prod-{prg2.job_versions.get('prod')}")
+        assert prod.phase == "running"
+        don = prg2.store.get_job(f"don-{prg2.job_versions.get('don')}")
+        assert len(don.placements) == 3 and don.phase == "running"
+        recs = {r.base: r.kind for r in prg2.admission.records()}
+        assert recs.get("don") == "growback", f"{point}@skip{skip}: {recs}"
+        # exactly-once: precisely ONE prod version ever placed members
+        prod_members = [n for rt in rts.values()
+                        for n in rt.container_list()
+                        if n.startswith("prod-")]
+        versions = {n.split("-p")[0] for n in prod_members}
+        assert len(versions) == 1, f"duplicated placement: {versions}"
+
+        # pressure lifts: the grow-back lands THROUGH the queue
+        prg2.job_svc.delete_job("prod", JobDelete(
+            force=True, del_state_and_version_record=True))
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        don = prg2.store.get_job(f"don-{prg2.job_versions.get('don')}")
+        assert len(don.placements) == 4 and don.phase == "running"
+        assert all(r.base != "don" for r in prg2.admission.records())
+
+        assert _job_oracle(prg2) == []
+        # a second sweep finds nothing: the repair is a fixpoint
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+    def test_host_death_mid_shrink_double_fault_converges(self):
+        """The double fault: a host dies, the supervisor starts an elastic
+        shrink off it, and the daemon is killed mid-shrink while the host
+        is STILL dead. Adoption must finish the shrink forward, excluding
+        the dead host (the intent's excludeHosts plus adoption-time
+        unreachability) — converging to the survivors with ZERO restart
+        or migration budget burned."""
+        from tpu_docker_api.service.host_health import HostMonitor
+        from tpu_docker_api.service.job_supervisor import JobSupervisor
+
+        kv = MemoryKV()
+        inner = {f"h{i}": FakeRuntime() for i in range(4)}
+        rts = {"h0": inner["h0"],
+               **{f"h{i}": FaultyRuntime(inner[f"h{i}"], FaultPlan())
+                  for i in range(1, 4)}}
+        prg = boot_resize_pod(kv, rts)
+        clock = {"now": 0.0}
+        mon = HostMonitor(prg.pod, prg.pod_scheduler, down_grace_s=10.0,
+                          clock=lambda: clock["now"])
+        sup = JobSupervisor(prg.pod, prg.job_svc, prg.store,
+                            prg.job_versions, backoff_jitter=0.0,
+                            clock=lambda: clock["now"], host_monitor=mon)
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=32,
+                                   priority_class="batch",
+                                   elastic=True, min_members=1))
+        rts["h3"].set_unreachable(True)
+        mon.probe_once()                 # t=0 → suspect
+        clock["now"] = 30.0
+        mon.probe_once()                 # grace elapsed → down
+        with armed("job.resize.after_quiesce"):
+            with pytest.raises(SimulatedCrash):
+                sup.poll_once()
+        st = prg.store.get_job(f"train-{prg.job_versions.get('train')}")
+        assert st.phase == "scaling_down"   # the crash window under test
+
+        # fresh control plane; h3 is STILL unreachable at adoption time
+        prg2 = boot_resize_pod(kv, rts)
+        prg2.reconciler.reconcile()
+        st = prg2.store.get_job(f"train-{prg2.job_versions.get('train')}")
+        assert st.phase == "running"
+        assert len(st.placements) == 3
+        assert all(h != "h3" for h, *_ in st.placements)
+        assert st.restarts == 0 and st.migrations == 0
+        recs = {r.base: r.kind for r in prg2.admission.records()}
+        assert recs.get("train") == "growback"
+        problems = [p for p in _job_oracle(prg2) if "unreachable" not in p]
+        assert problems == []
         assert prg2.reconciler.reconcile()["actions"] == []
 
 

@@ -314,3 +314,89 @@ class TestOperatorSurface:
         assert all(h == "h1" for h, *_ in st.placements)
         # drain is operator-driven: the fault-migration budget is untouched
         assert st.migrations == 0
+
+
+class TestDrainShrink:
+    """Elastic gangs drain by SHRINKING (docs/robustness.md "Elastic
+    gangs"): the drained host's members are dropped (never below
+    minMembers) instead of re-placing the whole gang — fewer moved bytes
+    on a live drain — and the dropped members grow back through the
+    admission queue onto other hosts (the drained one is cordoned)."""
+
+    def _pod4(self, admission=True):
+        kv = MemoryKV()
+        rts = {f"h{i}": FakeRuntime() for i in range(4)}
+        cfg = config_mod.Config(
+            store_backend="memory", runtime_backend="fake",
+            health_watch_interval=0, end_port=40099,
+            admission_enabled=admission, admission_interval_s=0,
+            pod_hosts=[
+                {"host_id": f"h{i}", "address": f"10.0.0.{i + 1}",
+                 "grid_coord": [i, 0, 0],
+                 **({"local": True} if i == 0
+                    else {"runtime_backend": "fake"})}
+                for i in range(4)
+            ],
+        )
+        prg = Program(cfg, kv=kv, runtime=rts["h0"],
+                      pod_runtimes={h: r for h, r in rts.items()
+                                    if h != "h0"})
+        prg.init()
+        return prg
+
+    def test_drain_offers_shrink_before_migration(self):
+        prg = self._pod4()
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=32, elastic=True,
+                                   min_members=1))
+        out = prg.host_monitor.drain("h3")
+        assert out["drainingJobs"] == ["train"]
+        prg.wq.start()
+        prg.wq.drain()
+        prg.wq.close()
+        st = prg.store.get_job(f"train-{prg.job_versions.get('train')}")
+        assert st.phase == "running"
+        assert len(st.placements) == 3
+        assert all(h != "h3" for h, *_ in st.placements)
+        # a shrink, not a migration: neither budget was touched
+        assert st.migrations == 0 and st.restarts == 0
+        assert st.resizes == 1
+        kinds = [e.get("event")
+                 for e in prg.host_monitor.events_view(limit=100)]
+        assert "job-drain-shrunk" in kinds
+        # the dropped member waits in the admission queue; the drained
+        # host is cordoned, so the grow-back holds until capacity returns
+        recs = {r.base: r.kind for r in prg.admission.records()}
+        assert recs.get("train") == "growback"
+        assert prg.admission.admit_once() == []
+        assert len(prg.store.get_job(
+            f"train-{prg.job_versions.get('train')}").placements) == 3
+        # uncordon: the next pass grows the gang back to full size
+        prg.host_monitor.uncordon("h3")
+        assert [o["job"] for o in prg.admission.admit_once()] == ["train"]
+        st = prg.store.get_job(f"train-{prg.job_versions.get('train')}")
+        assert len(st.placements) == 4 and st.phase == "running"
+
+    def test_drain_below_floor_falls_back_to_migration(self):
+        """A gang already at its minMembers floor cannot shrink: the
+        drain falls back to whole-gang migration (the pre-elastic
+        behavior), keeping the drain promise — moved, never stopped."""
+        prg = self._pod4()
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=16, elastic=True,
+                                   min_members=2))  # 2 hosts, floor 2
+        st = prg.store.get_job("train-0")
+        drained = st.placements[0][0]
+        out = prg.host_monitor.drain(drained)
+        assert out["drainingJobs"] == ["train"]
+        prg.wq.start()
+        prg.wq.drain()
+        prg.wq.close()
+        st = prg.store.get_job(f"train-{prg.job_versions.get('train')}")
+        assert st.phase == "running"
+        assert len(st.placements) == 2          # full size preserved
+        assert all(h != drained for h, *_ in st.placements)
+        assert st.resizes == 0                   # no shrink happened
+        kinds = [e.get("event")
+                 for e in prg.host_monitor.events_view(limit=100)]
+        assert "job-drained" in kinds

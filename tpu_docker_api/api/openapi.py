@@ -98,7 +98,20 @@ _SCHEMAS: dict[str, dict] = {
                            "configured default). With admission enabled a "
                            "full pool queues the job (phase \"queued\") "
                            "instead of refusing, and higher classes may "
-                           "preempt strictly-lower ones"}},
+                           "preempt strictly-lower ones"},
+         "elastic": {**_BOOL, "description":
+                     "elastic data-parallel gang: host loss / drain / "
+                     "partial preemption SHRINK the gang to its surviving "
+                     "hosts (never below minMembers) instead of killing "
+                     "it, and a durable grow-back record re-admits the "
+                     "lost members through the capacity market once "
+                     "pressure lifts. Requires a single-slice whole-host "
+                     "gang spanning >= 2 hosts. Job info then reports "
+                     "membersDesired/membersActual/minMembers, lastResize "
+                     "and growbackQueuePosition"},
+         "minMembers": {**_INT, "description":
+                        "smallest member (host) count an elastic gang may "
+                        "shrink to (default 1; elastic only)"}},
         ["imageName", "jobName"]),
     "JobPatchChips": _obj({"chipCount": _INT, "acceleratorType": _STR}),
     "JobDelete": _obj({"force": _BOOL, "delStateAndVersionRecord": _BOOL}),

@@ -119,6 +119,19 @@ class Config:
     # SEPARATE from job_max_restarts, so dead hosts never eat the
     # crash-restart budget (and crash loops never eat this one)
     job_max_migrations: int = 3
+    # elastic gangs (docs/robustness.md "Elastic gangs"): when true, jobs
+    # submitted with elastic=true SHRINK to their surviving hosts on host
+    # loss / drain / partial preemption (never below minMembers) and grow
+    # back through the admission queue, instead of migrating whole or
+    # dying. False disables every automatic resize decision (supervisor,
+    # drain, admission) — non-elastic jobs behave identically either way.
+    job_resize_enabled: bool = True
+    # attempts of ONE resize before adoption gives up on a thrashing gang
+    # (terminal "failed") — a loop bound for crash-adoption retries, not
+    # a policy budget: it reads last_resize.attempts, never the lifetime
+    # resize counter, so a long-lived elastic gang's normal shrink/grow
+    # history can never trip it
+    job_resize_max: int = 8
     # durable work queue (state/workqueue.py): how long a producer (API
     # thread) may block on a full queue before the typed QueueSaturated
     # error (HTTP 429) — never forever
@@ -254,6 +267,16 @@ def load(path: str | None = None) -> Config:
     if cfg.admission_max_skips < 0:
         raise ValueError(
             f"admission_max_skips must be >= 0, got {cfg.admission_max_skips}")
+    if not isinstance(cfg.job_resize_enabled, bool):
+        raise ValueError(
+            f"job_resize_enabled must be a boolean, "
+            f"got {cfg.job_resize_enabled!r}")
+    if isinstance(cfg.job_resize_max, bool) \
+            or not isinstance(cfg.job_resize_max, int) \
+            or cfg.job_resize_max < 1:
+        raise ValueError(
+            f"job_resize_max must be an integer >= 1, "
+            f"got {cfg.job_resize_max!r}")
     if (not isinstance(cfg.priority_class_weights, dict)
             or not cfg.priority_class_weights):
         raise ValueError("priority_class_weights must be a non-empty "

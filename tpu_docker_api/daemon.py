@@ -191,6 +191,12 @@ class Program:
         self.job_svc = JobService(
             self.pod, self.pod_scheduler, self.store, self.job_versions,
             libtpu_path=cfg.libtpu_path, fanout=self.fanout,
+            registry=self.metrics,
+            # elastic gangs (docs/robustness.md "Elastic gangs"): one gate
+            # + one loop bound, consulted by every resize decision site
+            # (supervisor, drain, admission) through the job service
+            resize_enabled=cfg.job_resize_enabled,
+            resize_max=cfg.job_resize_max,
         )
         # capacity market (service/admission.py): constructed
         # unconditionally — priority-class validation and submit-seq

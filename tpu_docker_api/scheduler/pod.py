@@ -377,8 +377,6 @@ class PodScheduler:
         preempt for this" and True as "worth trying", never as a grant."""
         if n_chips <= 0 or num_slices < 1 or n_chips % num_slices:
             return False
-        per_slice = n_chips // num_slices
-        per_host = self.pod.chips_per_host
         freed = assume_freed or set()
         with self._mu:
             banned = self._unschedulable_locked(exclude_hosts)
@@ -393,6 +391,31 @@ class PodScheduler:
                 for hid, chips in grant.hosts:
                     if hid in free:
                         free[hid] += len(chips)
+        return self.fits_counts(n_chips, num_slices, free)
+
+    def free_view(self, exclude_hosts: set[str] | None = None
+                  ) -> dict[str, int]:
+        """Free chips per SCHEDULABLE host — the substrate for the
+        partial-preemption simulator (service/admission.py): the caller
+        mutates a copy (adding the chips a planned shrink/preemption
+        would free) and re-checks ``fits_counts`` after each step."""
+        with self._mu:
+            banned = self._unschedulable_locked(exclude_hosts)
+            return {hid: len(h.chips.free_chips)
+                    for hid, h in self.pod.hosts.items()
+                    if hid not in banned}
+
+    def fits_counts(self, n_chips: int, num_slices: int,
+                    free: dict[str, int]) -> bool:
+        """The arithmetic half of ``fits``: feasibility over a
+        caller-provided free-chips-per-host map (no lock, no claims).
+        Same conservative contract as ``fits``: True means "worth
+        trying", never a grant."""
+        if n_chips <= 0 or num_slices < 1 or n_chips % num_slices:
+            return False
+        per_slice = n_chips // num_slices
+        per_host = self.pod.chips_per_host
+        free = dict(free)
         if per_slice < per_host or len(self.pod.hosts) == 1:
             # sub-host slices: greedy tightest-fit packing over per-host
             # free counts (mirrors _apply_sub_host_locked's ranking)

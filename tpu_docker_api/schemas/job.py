@@ -34,6 +34,16 @@ class JobRun:
     # default. Higher-weight jobs may preempt strictly-lower-weight gangs
     # when the pool is full and admission is enabled
     priority_class: str = ""
+    # elastic data-parallel gang (docs/robustness.md "Elastic gangs"):
+    # when true, a host loss or a partial preemption SHRINKS the gang to
+    # its surviving hosts (never below minMembers) instead of killing it,
+    # and a durable grow-back record re-admits the lost members through
+    # the capacity market once pressure lifts. Requires a single-slice
+    # whole-host gang spanning >= 2 hosts.
+    elastic: bool = False
+    # the smallest member (host) count an elastic gang may shrink to;
+    # 0 ⇒ 1 (elastic jobs only)
+    min_members: int = 0
 
     @staticmethod
     def from_dict(d: dict[str, Any]) -> "JobRun":
@@ -47,6 +57,8 @@ class JobRun:
             cmd=list(d.get("cmd", [])),
             num_slices=errors.as_int(d.get("numSlices", 1), "numSlices"),
             priority_class=d.get("priorityClass", ""),
+            elastic=bool(d.get("elastic", False)),
+            min_members=errors.as_int(d.get("minMembers", 0), "minMembers"),
         )
 
 
@@ -98,8 +110,20 @@ class JobDelete:
 #: the job owns zero slices/ports (invariants.py enforces it; supervisor
 #: and reconciler leave dormant members alone except to finish a
 #: half-quiesced preemption).
+#:
+#: Elastic gangs add two in-flight phases: ``scaling_down`` — the gang is
+#: being shrunk to its surviving hosts (host loss) or donating spare
+#: members (partial preemption); ``scaling_up`` — a grow-back admitted
+#: through the capacity market is restoring lost members. Both are
+#: persisted FIRST (like ``restarting``/``migrating``) so a daemon death
+#: mid-resize is adoptable: the reconciler/supervisor finish the resize
+#: forward without re-counting it, and at rest neither phase may survive
+#: (invariants.py flags a scaling phase at rest as a violation).
 JOB_PHASES = ("running", "restarting", "migrating", "failed", "stopped",
-              "queued", "preempted")
+              "queued", "preempted", "scaling_down", "scaling_up")
+
+#: in-flight resize phases (service/job.py ``resize_gang``)
+SCALING_PHASES = ("scaling_down", "scaling_up")
 
 #: phases with no runtime footprint: members must not run, and — except
 #: ``stopped``, which retains its grant for resume — the job owns nothing.
@@ -150,6 +174,25 @@ class JobState:
     # times this job was preempted (observability; not a budget — a
     # preempted job always re-admits when capacity returns)
     preemptions: int = 0
+    # elastic gang contract (docs/robustness.md "Elastic gangs"): when
+    # true, host loss / partial preemption shrink the gang (never below
+    # min_members) instead of killing it, and members_desired records the
+    # FULL member count the gang grows back to through the admission
+    # queue. Non-elastic jobs keep all three at their zero defaults.
+    elastic: bool = False
+    min_members: int = 0
+    members_desired: int = 0
+    # lifetime resizes executed (observability — grows without bound on a
+    # healthy long-lived elastic gang; shrinks and grow-backs both count)
+    resizes: int = 0
+    # the last (or in-flight, while phase is scaling_*) resize:
+    # {"direction": "down"|"up", "reason", "ts", "fromMembers",
+    #  "toMembers", "excludeHosts": [host ids], "attempts": n} —
+    # persisted BEFORE the resize acts so adoption knows the target;
+    # "attempts" counts retries of THIS resize and is what
+    # ``job_resize_max`` bounds (never the lifetime counter); {} = never
+    # resized
+    last_resize: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -176,4 +219,9 @@ class JobState:
             priority_class=d.get("priority_class", "batch"),
             submitted_seq=int(d.get("submitted_seq", 0)),
             preemptions=int(d.get("preemptions", 0)),
+            elastic=bool(d.get("elastic", False)),
+            min_members=int(d.get("min_members", 0)),
+            members_desired=int(d.get("members_desired", 0)),
+            resizes=int(d.get("resizes", 0)),
+            last_resize=dict(d.get("last_resize") or {}),
         )

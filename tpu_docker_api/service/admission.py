@@ -21,6 +21,17 @@ policy (ROADMAP item 4, the Borg/EASY shape):
   intact), their claims released in one atomic batch (PR 6), and parked as
   phase ``preempted`` for automatic re-admission ahead of equal-priority
   queued work;
+- **partial preemption** (docs/robustness.md "Elastic gangs") — before
+  condemning any whole gang, the victim loop takes SPARE MEMBERS (down to
+  ``minMembers``) from elastic strictly-lower-class gangs, lowest class
+  first, youngest first, one member at a time until the ask fits: a
+  preemptible training gang donates capacity in units of hosts, not jobs.
+  Each donation is a crash-consistent ``JobService.resize_gang`` shrink,
+  and the shrunken gang journals a durable **grow-back** record
+  (``kind == "growback"``) that re-admits the lost members with
+  preempted-grade precedence once pressure lifts. With no elastic victim
+  in range the plan degenerates to PR 10's whole-gang selection
+  byte-for-byte;
 - **backfill** — the queue drains out of strict precedence order only when
   a job further back fits a hole the blocked head cannot use (EASY
   backfill), bounded by ``admission_max_skips`` so the head always
@@ -224,13 +235,15 @@ class AdmissionController:
 
     def _ordered(self, records: list[AdmissionRecord] | None = None
                  ) -> list[AdmissionRecord]:
-        """Precedence order: class weight desc, preempted before queued
-        within a class (a preempted job already held capacity once — it
-        re-admits ahead of equal-priority newcomers), then submit order."""
+        """Precedence order: class weight desc, preempted — and grow-back,
+        which is the partial-preemption victim's re-admission — before
+        queued within a class (both already held the capacity once; they
+        re-admit ahead of equal-priority newcomers), then submit order."""
         if records is None:
             records = self.records()
         return sorted(records, key=lambda r: (
-            -self.weight(r.klass), 0 if r.kind == "preempted" else 1, r.seq))
+            -self.weight(r.klass),
+            0 if r.kind in ("preempted", "growback") else 1, r.seq))
 
     def position(self, base: str) -> int | None:
         """1-based queue position of a family, or None when not queued."""
@@ -248,12 +261,19 @@ class AdmissionController:
         disagree, and both survive any crash after the commit."""
         seq = self.next_seq()
         version = self._versions.next_version(base)
+        per_host = self._svc.pod.chips_per_host
         st = JobState(
             job_name=versioned_name(base, version), version=version,
             image=req.image_name, cmd=list(req.cmd), env=list(req.env),
             binds=list(req.binds), chip_count=want, coordinator_port=0,
             placements=[], num_slices=req.num_slices, phase="queued",
             priority_class=priority_class, submitted_seq=seq,
+            # the elastic contract is resolved at submit time like the
+            # rest of the spec, so an admission after any number of
+            # failovers still places an elastic gang
+            elastic=req.elastic,
+            min_members=(req.min_members or 1) if req.elastic else 0,
+            members_desired=want // per_host if req.elastic else 0,
         )
         rec = AdmissionRecord(seq=seq, base=base, kind="queued",
                               klass=priority_class, ts=time.time(),
@@ -296,6 +316,80 @@ class AdmissionController:
             self._update_gauges()
         return bool(doomed)
 
+    def enqueue_growback(self, base: str, klass: str) -> int:
+        """Journal the durable grow-back intent of a shrunken elastic
+        gang (called by ``JobService.resize_gang`` after a shrink lands):
+        a ``kind == "growback"`` record at the job's own class,
+        re-admitted with preempted-grade precedence — the victim of a
+        partial preemption restores capacity it already held, exactly
+        like a whole-gang victim does. One pending grow-back per family:
+        a re-shrink replaces the record (the newest target governs; the
+        job's ``members_desired`` is the declarative truth either way).
+        Returns the 1-based queue position."""
+        for r in self.records():
+            if r.base == base and r.kind == "growback":
+                self._kv.delete(r.key())
+        seq = self.next_seq()
+        rec = AdmissionRecord(seq=seq, base=base, kind="growback",
+                              klass=klass, ts=time.time(),
+                              trace_id=trace.current_trace_id())
+        self._kv.put(rec.key(), rec.to_json())
+        pos = self.position(base) or 1
+        self._record("job-growback-queued", base, klass=klass, seq=seq,
+                     position=pos)
+        self._update_gauges()
+        self._wake.set()
+        log.info("admission: grow-back of %s queued (%s, seq %d, "
+                 "position %d)", base, klass, seq, pos)
+        return pos
+
+    def park_preempted(self, base: str, reason: str = "") -> JobState | None:
+        """Park a gang as ``preempted`` outside the victim-selection path
+        — the resize-exhaustion fallback (service/job.py): an elastic gang
+        that cannot place at ANY legal size right now must not die when a
+        market exists to re-admit it. Same crash contract as
+        ``_preempt_one`` steps 2-4: ONE atomic apply (phase flip +
+        re-admission record), gang-ordered quiesce, bulk release — all
+        no-ops where the failed resize already got that far. Returns the
+        parked state, or None when the job raced away."""
+        with self._svc.family_lock(base):
+            latest = self._versions.get(base)
+            if latest is None:
+                return None
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                return None
+            if (not st.desired_running
+                    or st.phase in ("failed", "stopped", "queued",
+                                    "preempted")):
+                return None
+            seq = self.next_seq()
+            parked = JobState.from_dict({
+                **st.to_dict(), "phase": "preempted",
+                "preemptions": st.preemptions + 1,
+            })
+            rec = AdmissionRecord(seq=seq, base=base, kind="preempted",
+                                  klass=st.priority_class, ts=time.time(),
+                                  trace_id=trace.current_trace_id())
+            self._kv.apply(
+                StateStore._put_ops(Resource.JOBS, base, st.version,
+                                    parked.to_dict())
+                + [("put", rec.key(), rec.to_json())])
+            self._svc._stop_members(st, reverse=True)
+            self._svc._release_version_resources(st)
+            self._registry.counter_inc(
+                "preemptions_total", {"victim_class": st.priority_class},
+                help="Gangs preempted by higher-priority admissions")
+            self._record("job-preempted", base, klass=st.priority_class,
+                         reason=reason, seq=seq,
+                         preemptions=parked.preemptions)
+            self._update_gauges()
+            self._wake.set()
+            log.info("admission: parked %s preempted: %s", base,
+                     reason or "resize exhausted")
+            return parked
+
     # -- the admission pass -------------------------------------------------------
 
     def admit_once(self) -> list[dict]:
@@ -310,11 +404,14 @@ class AdmissionController:
            exhausted ``admission_max_skips``, queued work stops
            overtaking it until it places (the starvation bound).
 
-        PREEMPTED records are exempt from the starvation gate on both
-        sides: re-admitting a victim restores capacity it already held —
-        that neither charges the head a skip nor may be stalled by it
-        (a max-skipped head that preempted victims it then failed to
+        PREEMPTED records — and GROW-BACK records, the partial-preemption
+        victims' re-admissions — are exempt from the starvation gate on
+        both sides: re-admitting a victim restores capacity it already
+        held — that neither charges the head a skip nor may be stalled by
+        it (a max-skipped head that preempted victims it then failed to
         place onto must never strand them dormant on idle capacity).
+        Grow-backs additionally never preempt or defragment: a gang grows
+        back when pressure LIFTS, it does not create pressure of its own.
         """
         outcomes: list[dict] = []
         with trace.pass_span(self._tracer, "admission.pass") as span, \
@@ -325,12 +422,13 @@ class AdmissionController:
                 return any(b.skips >= self.max_skips for b in blocked)
 
             for rec in self._ordered():
-                if rec.kind != "preempted" and gated():
+                if rec.kind == "queued" and gated():
                     # starvation bound: queued work stalls behind a
                     # maximally-skipped head until it places
                     continue
                 placed = self._try_admit(rec)
-                if placed is False and not blocked:
+                if placed is False and not blocked \
+                        and rec.kind != "growback":
                     # the effective head: preemption, then defragmentation
                     snap = frozenset(self._slices.grants_view())
                     if self._preempt_for(rec, snap):
@@ -348,7 +446,7 @@ class AdmissionController:
                 if placed:
                     outcomes.append({"job": rec.base, "result": "placed",
                                      "class": rec.klass})
-                    if blocked and rec.kind != "preempted":
+                    if blocked and rec.kind == "queued":
                         self._bump_skips(blocked)
                 else:
                     blocked.append(rec)
@@ -371,6 +469,8 @@ class AdmissionController:
         with trace.child(f"admission.place:{base}", seq=rec.seq) as span:
             if span is not None and rec.trace_id:
                 span.links = (rec.trace_id,)
+            if rec.kind == "growback":
+                return self._try_growback_locked(rec, base)
             return self._try_admit_locked(rec, base)
 
     def _try_admit_locked(self, rec: AdmissionRecord,
@@ -394,12 +494,7 @@ class AdmissionController:
                 self._record("admission-record-settled", base,
                              phase=st.phase, seq=rec.seq)
                 return None
-            carry = {
-                "priority_class": st.priority_class,
-                "submitted_seq": st.submitted_seq,
-                "restarts": st.restarts, "migrations": st.migrations,
-                "preemptions": st.preemptions,
-            }
+            carry = self._svc._carry_identity(st)
             try:
                 new_st = self._svc._run_version(
                     base, st.image, st.cmd, st.env, st.binds, st.chip_count,
@@ -424,11 +519,92 @@ class AdmissionController:
                      base, rec.klass, rec.kind, new_st.job_name, wait_ms)
             return True
 
+    def _try_growback_locked(self, rec: AdmissionRecord,
+                             base: str) -> bool | None:
+        """Grow a shrunken elastic gang back toward ``members_desired``.
+        Returns True (grown), False (no capacity yet, or the gang is
+        dormant/mid-repair — the record keeps waiting), or None (stale —
+        the gang already grew back, stopped, failed or vanished; the
+        record is settled exactly-once). Growth only happens when the
+        count heuristic says the FULL size fits with the gang's own grant
+        re-used — pressure must actually have lifted."""
+        with self._svc.family_lock(base):
+            latest = self._versions.get(base)
+            if latest is None:
+                self._kv.delete(rec.key())
+                return None
+            try:
+                st = self._store.get_job(versioned_name(base, latest))
+            except errors.NotExistInStore:
+                return None  # half-made version; the reconciler's case
+            desired = st.members_desired or 0
+            cur = len(st.placements)
+            if (not st.elastic or not st.desired_running
+                    or st.phase in ("failed", "stopped")
+                    or (st.phase == "running" and cur >= desired)):
+                # grown back already (or a rescale restored it), stopped,
+                # failed, or no longer elastic: settle exactly-once
+                self._kv.delete(rec.key())
+                self._record("admission-record-settled", base,
+                             phase=st.phase, seq=rec.seq)
+                return None
+            if st.phase != "running":
+                # queued/preempted/restarting/migrating/scaling: the gang
+                # grows back after its current transition settles
+                return False
+            if not getattr(self._svc, "resize_enabled", True):
+                # job_resize_enabled=false disables EVERY automatic
+                # resize decision — the record parks (not settled:
+                # re-enabling the gate resumes the grow-back)
+                return False
+            per_host = self._svc.pod.chips_per_host
+            if not self._slices.fits(desired * per_host, 1,
+                                     assume_freed={st.job_name}):
+                return False
+            try:
+                new_st = self._svc.resize_gang(base, desired,
+                                               reason="growback")
+            except (errors.ChipNotEnough, errors.PortNotEnough):
+                return False
+            except errors.ApiError as e:
+                log.info("admission: grow-back of %s declined: %s", base, e)
+                return False
+            if len(new_st.placements) < desired:
+                # the grow fell back to a smaller size (fragmentation):
+                # resize_gang re-journaled a fresh grow-back record — this
+                # one is superseded, keep waiting
+                return False
+            crash_point("admission.readmit")
+            self._kv.delete(rec.key())
+            wait_ms = max(0.0, (time.time() - rec.ts) * 1e3) if rec.ts else 0.0
+            self._registry.observe(
+                "admission_wait_ms", wait_ms, {"class": rec.klass},
+                buckets=_WAIT_BUCKETS,
+                help="Queue wait from enqueue/preemption to placement (ms)")
+            self._registry.counter_inc(
+                "admissions_total", {"class": rec.klass, "kind": "growback"},
+                help="Queued/preempted jobs placed by the admission loop")
+            self._record("job-admitted", base, klass=rec.klass,
+                         via="growback", version=new_st.version,
+                         members=len(new_st.placements),
+                         wait_ms=round(wait_ms, 1))
+            log.info("admission: grew %s back to %d members (%s) after "
+                     "%.0f ms", base, len(new_st.placements), rec.klass,
+                     wait_ms)
+            return True
+
     def _bump_skips(self, blocked: list[AdmissionRecord]) -> None:
         """A later entry was admitted past these blocked ones: charge each
         of them one skip, durably — the starvation bound must survive a
-        daemon restart mid-backfill."""
+        daemon restart mid-backfill. Grow-back records are never charged:
+        they wait for pressure to lift by design (possibly forever on a
+        busy pool), and a max-skipped grow-back would trip the gate and
+        freeze every queued admission for a gang that is already
+        RUNNING — the opposite of 'a grow-back creates no pressure of
+        its own'."""
         for b in blocked:
+            if b.kind == "growback":
+                continue
             b.skips += 1
             try:
                 if self._kv.get_or(b.key()) is None:
@@ -442,15 +618,13 @@ class AdmissionController:
 
     # -- preemption ---------------------------------------------------------------
 
-    def _victims_for(self, weight: int, want: int, num_slices: int,
-                     requester: str) -> list[str]:
-        """Victim gangs whose release would (by the count heuristic) make
-        the ask placeable — the minimal prefix of the eligibility order:
-        strictly-lower priority only, lowest-priority first, then
-        YOUNGEST first (largest submitted_seq; the paged.py seniority rule
-        — juniors can never displace seniors, so preemption terminates),
-        base name as the deterministic tie-break. Empty ⇒ no feasible
-        combination (nothing is quiesced on a hunch)."""
+    def _eligible(self, weight: int,
+                  requester: str) -> list[tuple[int, int, str, JobState]]:
+        """Preemptible gangs strictly below ``weight``, in victim order:
+        lowest-priority first, then YOUNGEST first (largest submitted_seq;
+        the paged.py seniority rule — juniors can never displace seniors,
+        so preemption terminates), base name as the deterministic
+        tie-break."""
         eligible: list[tuple[int, int, str, JobState]] = []
         for base in self._versions.snapshot():
             if base == requester:
@@ -467,8 +641,22 @@ class AdmissionController:
                     and st.phase in _PREEMPTIBLE_PHASES):
                 eligible.append((w, -st.submitted_seq, base, st))
         eligible.sort(key=lambda e: (e[0], e[1], e[2]))
+        return eligible
+
+    def _victims_for(self, weight: int, want: int, num_slices: int,
+                     requester: str,
+                     eligible: list | None = None) -> list[str]:
+        """WHOLE-gang victims whose release would (by the count heuristic)
+        make the ask placeable — the minimal prefix of the eligibility
+        order. Empty ⇒ no feasible combination (nothing is quiesced on a
+        hunch). PR 10 semantics, byte-for-byte: the partial-preemption
+        planner falls back to exactly this when no elastic donor exists
+        (passing its already-computed ``eligible`` scan — one store walk
+        per planning round, not two)."""
         chosen: list[str] = []
         freed: set[str] = set()
+        if eligible is None:
+            eligible = self._eligible(weight, requester)
         for _, _, base, st in eligible:
             chosen.append(base)
             vname = versioned_name(base, st.version)
@@ -478,13 +666,97 @@ class AdmissionController:
                 return chosen
         return []
 
+    @staticmethod
+    def _is_donor(st: JobState) -> bool:
+        """An elastic gang with spare members to donate: running (an
+        in-flight restart is not shrunk under), single-slice, and above
+        its ``min_members`` floor."""
+        return (st.elastic and st.num_slices == 1
+                and st.phase == "running" and st.desired_running
+                and len(st.placements) > max(st.min_members, 1))
+
+    def _preempt_plan(self, weight: int, want: int, num_slices: int,
+                      requester: str) -> list[tuple[str, str, int]]:
+        """The victim plan: ``("shrink", base, keep_members)`` entries
+        (spare members taken from elastic gangs) followed by
+        ``("full", base, 0)`` entries (whole-gang preemptions). Phase 1
+        walks the eligibility order donating ONE member at a time from
+        each elastic gang (minimal feasible set — lowest class first,
+        youngest first) and stops the moment the count heuristic says the
+        ask fits: when shrink suffices, NO whole gang dies. Phase 2 — only
+        if every spare member together still cannot make room — condemns
+        whole gangs in the same order, upgrading an already-planned shrink
+        to a full preemption (its floor members are capacity too). Empty
+        plan ⇒ no feasible combination, nothing is touched on a hunch.
+
+        With no elastic donor in range (or resizing disabled) the plan is
+        exactly ``_victims_for`` — PR 10's whole-gang selection,
+        byte-for-byte."""
+        eligible = self._eligible(weight, requester)
+        donors = [e for e in eligible if self._is_donor(e[3])]
+        if not donors or not getattr(self._svc, "resize_enabled", True):
+            return [("full", b, 0)
+                    for b in self._victims_for(weight, want, num_slices,
+                                               requester,
+                                               eligible=eligible)]
+        base_free = self._slices.free_view()
+        shrink: dict[str, int] = {}   # base → members kept (insertion order)
+        full: list[str] = []
+
+        def grant_hosts(st: JobState) -> list[tuple[str, list[int]]]:
+            vname = versioned_name(
+                keys.split_versioned_name(st.job_name)[0], st.version)
+            owners = ([vname] if st.num_slices == 1
+                      else [f"{vname}#s{k}" for k in range(st.num_slices)])
+            hosts: list[tuple[str, list[int]]] = []
+            for o in owners:
+                g = self._slices.get_grant(o)
+                if g is not None:
+                    hosts.extend(g.hosts)
+            return hosts
+
+        # grants are stable for the duration of the plan: fetch each
+        # victim's host list once, not once per simulation step
+        hosts_of = {base: grant_hosts(st) for _, _, base, st in eligible}
+
+        def feasible() -> bool:
+            # simulate the frees: a shrink keeps its first ``kept`` member
+            # hosts (grant order == process order) and frees the rest; a
+            # full preemption frees everything
+            free = dict(base_free)
+            for b in list(shrink) + full:
+                kept = 0 if b in full else shrink[b]
+                for hid, chips in hosts_of[b][kept:]:
+                    if hid in free:
+                        free[hid] += len(chips)
+            return self._slices.fits_counts(want, num_slices, free)
+
+        # phase 1 — spare members only, one host at a time
+        for _, _, b, st in eligible:
+            if not self._is_donor(st):
+                continue
+            floor = max(st.min_members, 1)
+            for kept in range(len(st.placements) - 1, floor - 1, -1):
+                shrink[b] = kept
+                if feasible():
+                    return [("shrink", x, k) for x, k in shrink.items()]
+        # phase 2 — whole gangs (shrink plans upgrade to full)
+        for _, _, b, st in eligible:
+            full.append(b)
+            shrink.pop(b, None)
+            if feasible():
+                return ([("shrink", x, k) for x, k in shrink.items()]
+                        + [("full", x, 0) for x in full])
+        return []
+
     def _preempt_for(self, rec: AdmissionRecord,
                      snap: frozenset | None = None) -> bool:
-        """Select and preempt victims for a blocked entry. Returns True
-        when at least one victim was fully preempted (the caller retries
-        placement). ``snap`` is the caller's decision-time grant-set
-        snapshot: when it matches a round already proven futile for this
-        head, nothing is evicted again."""
+        """Select and preempt (or partially preempt) victims for a blocked
+        entry. Returns True when at least one victim donated capacity —
+        spare members from an elastic shrink, or a whole gang — so the
+        caller retries placement. ``snap`` is the caller's decision-time
+        grant-set snapshot: when it matches a round already proven futile
+        for this head, nothing is evicted again."""
         if snap is not None and self._preempt_futile.get(rec.base) == snap:
             return False
         latest = self._versions.get(rec.base)
@@ -494,16 +766,52 @@ class AdmissionController:
             st = self._store.get_job(versioned_name(rec.base, latest))
         except errors.NotExistInStore:
             return False
-        victims = self._victims_for(self.weight(rec.klass), st.chip_count,
-                                    st.num_slices, rec.base)
-        if not victims:
+        weight = self.weight(rec.klass)
+        plan = self._preempt_plan(weight, st.chip_count, st.num_slices,
+                                  rec.base)
+        if not plan:
             return False
-        preempted = 0
-        for victim in victims:
-            if self._preempt_one(victim, for_base=rec.base,
-                                 requester_weight=self.weight(rec.klass)):
-                preempted += 1
-        return preempted > 0
+        acted = 0
+        for kind, victim, kept in plan:
+            if kind == "shrink":
+                if self._shrink_one(victim, kept, for_base=rec.base,
+                                    requester_weight=weight):
+                    acted += 1
+            elif self._preempt_one(victim, for_base=rec.base,
+                                   requester_weight=weight):
+                acted += 1
+        return acted > 0
+
+    def _shrink_one(self, base: str, keep_members: int, for_base: str,
+                    requester_weight: int) -> bool:
+        """Partially preempt one elastic gang: shrink it to
+        ``keep_members`` hosts through ``JobService.resize_gang`` (intent
+        persisted first, gang-ordered quiesce, ONE-apply release+claim
+        delta, grow-back record journaled) — the gang keeps training at
+        reduced batch size instead of dying. Eligibility (still running,
+        still strictly lower class, still above its floor) re-validates
+        under the victim's family lock inside resize_gang; a user stop or
+        priority retune that raced in wins."""
+        crash_point("admission.partial_preempt")
+        try:
+            st = self._svc.resize_gang(
+                base, keep_members, reason="partial-preemption",
+                require_weight_below=requester_weight)
+        except errors.ApiError as e:
+            log.info("admission: partial preemption of %s declined: %s",
+                     base, e)
+            return False
+        self._registry.counter_inc(
+            "preemptions_partial_total",
+            {"victim_class": st.priority_class},
+            help="Elastic gangs shrunk by partial preemption (spare "
+                 "members donated instead of whole-gang eviction)")
+        self._record("job-partially-preempted", base,
+                     klass=st.priority_class, for_job=for_base,
+                     keptMembers=len(st.placements))
+        log.info("admission: partially preempted %s (kept %d members) "
+                 "for %s", base, len(st.placements), for_base)
+        return True
 
     def _preempt_one(self, base: str, for_base: str,
                      requester_weight: int) -> bool:
@@ -675,14 +983,19 @@ class AdmissionController:
         - a record whose family is gone is purged;
         - a record whose job already left the queue (placed by a
           readmit-crash run, stopped, failed) is settled — the replay
-          never double-places;
+          never double-places; a grow-back record settles once the gang
+          is back at full size (or stopped being elastic/running);
         - a queued/preempted job that somehow lost its record (defensive:
           the enqueue/preempt applies are atomic, so this means manual
-          store surgery) is re-journaled so it cannot be stranded.
+          store surgery) is re-journaled so it cannot be stranded — and
+          so is a shrunken elastic gang with no grow-back record (the
+          resize-to-grow-back window is two applies; a daemon death
+          between them must not orphan the shrink).
 
         Returns the actions (performed, or planned under ``dry_run``)."""
         actions: list[dict] = []
         seen_bases: set[str] = set()
+        growback_bases: set[str] = set()
         for rec in self.records():
             seen_bases.add(rec.base)
             latest = self._versions.get(rec.base)
@@ -699,6 +1012,16 @@ class AdmissionController:
                 if not dry_run:
                     self._kv.delete(rec.key())
                 continue
+            if rec.kind == "growback":
+                if self._growback_stale(st):
+                    actions.append({"action": "settle-admission-record",
+                                    "target": rec.base, "phase": st.phase,
+                                    "seq": rec.seq})
+                    if not dry_run:
+                        self._kv.delete(rec.key())
+                else:
+                    growback_bases.add(rec.base)
+                continue
             if st.phase not in ("queued", "preempted"):
                 actions.append({"action": "settle-admission-record",
                                 "target": rec.base, "phase": st.phase,
@@ -706,8 +1029,6 @@ class AdmissionController:
                 if not dry_run:
                     self._kv.delete(rec.key())
         for base in self._versions.snapshot():
-            if base in seen_bases:
-                continue
             latest = self._versions.get(base)
             if latest is None:
                 continue
@@ -715,7 +1036,7 @@ class AdmissionController:
                 st = self._store.get_job(versioned_name(base, latest))
             except errors.NotExistInStore:
                 continue
-            if st.phase in ("queued", "preempted"):
+            if base not in seen_bases and st.phase in ("queued", "preempted"):
                 actions.append({"action": "rejournal-admission-record",
                                 "target": base, "phase": st.phase})
                 if not dry_run:
@@ -723,9 +1044,40 @@ class AdmissionController:
                         seq=self.next_seq(), base=base, kind=st.phase,
                         klass=st.priority_class, ts=time.time())
                     self._kv.put(rec.key(), rec.to_json())
+            elif base not in growback_bases and self._growback_wanted(st):
+                actions.append({"action": "rejournal-growback-record",
+                                "target": base,
+                                "members": len(st.placements),
+                                "want": st.members_desired})
+                if not dry_run:
+                    rec = AdmissionRecord(
+                        seq=self.next_seq(), base=base, kind="growback",
+                        klass=st.priority_class, ts=time.time())
+                    self._kv.put(rec.key(), rec.to_json())
         if actions and not dry_run:
             self._update_gauges()
         return actions
+
+    @staticmethod
+    def _growback_stale(st: JobState) -> bool:
+        """A grow-back record is stale once the gang no longer needs (or
+        can never use) a grow-back: back at full size, stopped, failed,
+        or not elastic. Dormant/mid-repair phases keep the record — the
+        gang still wants its members back after it settles."""
+        if not st.elastic or not st.desired_running or st.phase == "failed":
+            return True
+        return (st.phase == "running"
+                and len(st.placements) >= (st.members_desired or 0))
+
+    def _growback_wanted(self, st: JobState) -> bool:
+        """A running elastic gang below its desired member count wants a
+        grow-back record in the journal — but only while the market (and
+        resizing) is on: a record nothing will ever admit is a lie in
+        the queue gauges."""
+        return (self.enabled and st.elastic and st.desired_running
+                and st.phase == "running"
+                and 0 < len(st.placements) < (st.members_desired or 0)
+                and getattr(self._svc, "resize_enabled", True))
 
     # -- loop lifecycle -----------------------------------------------------------
 
@@ -811,6 +1163,7 @@ class AdmissionController:
             "entries": entries,
             # one set of books: the same counters /metrics exports
             "preemptionsTotal": self._preemptions_total(),
+            "partialPreemptionsTotal": self._partial_preemptions_total(),
             "admissionsTotal": self._admissions_total(),
         }
 
@@ -822,7 +1175,13 @@ class AdmissionController:
     def _admissions_total(self) -> int:
         return int(sum(self._registry.counter_value(
             "admissions_total", {"class": c, "kind": k})
-            for c in self.classes for k in ("queued", "preempted")))
+            for c in self.classes
+            for k in ("queued", "preempted", "growback")))
+
+    def _partial_preemptions_total(self) -> int:
+        return int(sum(self._registry.counter_value(
+            "preemptions_partial_total", {"victim_class": c})
+            for c in self.classes))
 
     def health_view(self) -> dict:
         """Compact /healthz rider (registry read-back, never a store
@@ -833,4 +1192,5 @@ class AdmissionController:
             depth = -1  # store unreachable; liveness must still render
         return {"enabled": self.enabled, "depth": depth,
                 "preemptionsTotal": self._preemptions_total(),
+                "partialPreemptionsTotal": self._partial_preemptions_total(),
                 "admissionsTotal": self._admissions_total()}

@@ -135,6 +135,37 @@ ADMISSION_CRASH_POINTS = (
     "admission.readmit",
 )
 
+#: elastic-gang resize lifecycle (service/job.py ``resize_gang`` +
+#: service/admission.py partial preemption): the resize chaos matrix
+#: kills the daemon at each of these and proves a fresh Program's
+#: reconcile converges to ONE live version with zero leaks and the gang
+#: at either the old or the new size — never half-resized — with the
+#: grow-back record surviving (or being re-journaled) so the gang still
+#: grows back once pressure lifts
+RESIZE_CRASH_POINTS = (
+    # the resize intent (phase scaling_down/scaling_up + last_resize) is
+    # durable; every member still runs at the old size
+    "job.resize.after_mark",
+    # the gang is quiesced (workers first, coordinator last) but the old
+    # version still owns every slice and port — the release+claim delta
+    # apply has not committed
+    "job.resize.after_quiesce",
+    # the ONE-apply delta (old version released + new smaller/larger
+    # version claimed) is durable and the new member containers exist
+    # (created, not started); the old version is not yet marked stopped
+    "job.resize.after_create_new",
+    # fires up to TWICE per shrink (target with armed(..., skip=k)):
+    # skip=0 — the resized gang is started (coordinator first) but the
+    # grow-back admission record is not yet journaled (reconcile must
+    # re-journal it); skip=1 — the grow-back record is durable, only the
+    # response/event bookkeeping is lost
+    "job.resize.after_start_new",
+    # partial preemption: victims and spare-member counts are chosen;
+    # NOTHING durable has changed — a crash here leaves every victim
+    # fully running at full size and the requester fully queued
+    "admission.partial_preempt",
+)
+
 #: Service / autoscaler lifecycle (service/serving.py): the chaos matrix
 #: kills the daemon at each of these and proves a fresh Program's
 #: reconcile converges to exactly ONE fully-owned replica set — every
@@ -186,7 +217,8 @@ COMPACTOR_CRASH_POINTS = (
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
                       + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS
-                      + ADMISSION_CRASH_POINTS + SERVICE_CRASH_POINTS
+                      + ADMISSION_CRASH_POINTS + RESIZE_CRASH_POINTS
+                      + SERVICE_CRASH_POINTS
                       + RECONCILE_CRASH_POINTS + COMPACTOR_CRASH_POINTS)
 
 

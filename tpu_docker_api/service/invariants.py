@@ -236,6 +236,24 @@ def check_job_invariants(
             continue
         if st.phase not in JOB_PHASES:
             problems.append(f"job {base}: unknown phase {st.phase!r}")
+        if st.phase in ("scaling_down", "scaling_up"):
+            # like a service stuck "deleting": the phase only exists
+            # mid-resize — at rest the reconciler/supervisor must have
+            # finished the resize forward (or parked/failed the gang)
+            problems.append(
+                f"job {base}: stuck in phase {st.phase} (resize "
+                f"unfinished)")
+        if st.elastic:
+            floor = max(st.min_members, 1)
+            if st.placements and len(st.placements) < floor:
+                problems.append(
+                    f"job {base}: elastic gang below minMembers "
+                    f"({len(st.placements)} < {floor})")
+            if (st.members_desired
+                    and len(st.placements) > st.members_desired):
+                problems.append(
+                    f"job {base}: elastic gang above membersDesired "
+                    f"({len(st.placements)} > {st.members_desired})")
 
         # queued/preempted are dormant like failed/stopped: no member may
         # run (the capacity-market quiesce is complete or never started)

@@ -38,14 +38,23 @@ from __future__ import annotations
 
 import logging
 import re
+import time
 
 from tpu_docker_api import errors
 from tpu_docker_api.runtime.fanout import SERIAL, Fanout
 from tpu_docker_api.runtime.spec import ContainerSpec
 from tpu_docker_api.scheduler.pod import Pod, PodScheduler, SliceAllocation
-from tpu_docker_api.schemas.job import JobDelete, JobPatchChips, JobRun, JobState
+from tpu_docker_api.scheduler.slices import candidate_shapes
+from tpu_docker_api.schemas.job import (
+    SCALING_PHASES,
+    JobDelete,
+    JobPatchChips,
+    JobRun,
+    JobState,
+)
 from tpu_docker_api.service.container import _FamilyLocks, resolve_latest
 from tpu_docker_api.service.crashpoints import crash_point
+from tpu_docker_api.telemetry.metrics import MetricsRegistry, REGISTRY
 from tpu_docker_api.state.keys import (
     BASE_NAME_RE,
     Resource,
@@ -70,6 +79,10 @@ _TPU_PORT = 8476
 #: (workload/jaxenv.py render_job_specs)
 _MEMBER_RE = re.compile(r"^(?P<job>.+)-p(?P<pid>\d+)$")
 
+#: resize_time_to_shrunk_ms histogram buckets (milliseconds)
+_RESIZE_BUCKETS = (5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+                   30000, 60000)
+
 
 class JobService:
     def __init__(
@@ -80,12 +93,27 @@ class JobService:
         versions: VersionMap,
         libtpu_path: str = "",
         fanout: Fanout | None = None,
+        registry: MetricsRegistry | None = None,
+        resize_enabled: bool = True,
+        resize_max: int = 8,
     ) -> None:
         self.pod = pod
         self.slices = slices
         self.store = store
         self.versions = versions
         self.libtpu_path = libtpu_path
+        self.registry = registry if registry is not None else REGISTRY
+        #: elastic-gang master gate (config ``job_resize_enabled``): when
+        #: False every resize DECISION site (supervisor shrink-vs-migrate,
+        #: drain shrink-first, admission partial preemption / grow-back)
+        #: falls back to the pre-elastic behavior byte-for-byte; the
+        #: resize primitive itself stays callable so adoption can always
+        #: finish an in-flight resize left by a previous configuration
+        self.resize_enabled = resize_enabled
+        #: loop bound for adoption retries (config ``job_resize_max``):
+        #: a gang that keeps failing to settle a resize converges to
+        #: terminal failed instead of thrashing forever
+        self.resize_max = resize_max
         #: runtime fan-out (runtime/fanout.py): every multi-member engine
         #: batch — create, start-workers, stop-workers, remove — routes
         #: through it. The default is the serial singleton, byte-for-byte
@@ -353,20 +381,33 @@ class JobService:
                      accelerator_type: str = "", start_now: bool = True,
                      num_slices: int = 1,
                      exclude_hosts: set[str] | None = None,
-                     carry: dict | None = None) -> JobState:
+                     carry: dict | None = None,
+                     release_old: JobState | None = None) -> JobState:
         """Version bump → ONE atomic claim txn (every slice's chips, the
         slice registry, every host's ports) → render → create[+start] →
         persist JobState (one more apply), with full rollback (the
         job-level _run_new_version). An N-member gang is O(1) store round
         trips, not O(N): bump, claim commit, state commit. ``carry`` merges
         extra JobState fields into the persisted record (migration carries
-        the budget counters onto the new version)."""
+        the budget counters onto the new version).
+
+        ``release_old`` (the resize path): the old version's slices and
+        ports are released INTO the same claim txn, so the store sees one
+        apply whose net effect is exactly the member delta — the new
+        version's claims and the old version's release can never disagree
+        across a crash. The release mutates in-memory scheduler state
+        eagerly (restores are owner-guarded, so a replayed release is a
+        no-op); if the claim then fails, nothing was persisted and the
+        caller compensates by re-launching the old shape — the next
+        full-snapshot commit reconverges the store to in-memory truth."""
         prev = self.versions.get(base)
         version = self.versions.next_version(base)
         job_versioned = versioned_name(base, version)
         crash_point("job.run.after_version_bump")
         txn = StoreTxn(self.store.kv)
         try:
+            if release_old is not None:
+                self._release_version_resources(release_old, txn=txn)
             grants = self._apply_slices(
                 n_chips, num_slices, accelerator_type, job_versioned,
                 exclude_hosts=exclude_hosts, txn=txn)
@@ -433,11 +474,16 @@ class JobService:
 
         def _quiesce_old() -> None:
             # gang ordering: workers flush their checkpoint shards first,
-            # the coordinator (the rendezvous point) last
+            # the coordinator (the rendezvous point) last. pointer=False:
+            # on the fast path the new version already took the family's
+            # latest pointer — recording the old quiesce must not rewind
+            # it (a bare-name GET would serve the retired version); on
+            # the in-place path the pointer already names the old
+            # version, so skipping the rewrite changes nothing
             self._stop_members(old, reverse=True)
             self.store.put_job(JobState.from_dict(
                 {**old.to_dict(), "desired_running": False,
-                 "phase": "stopped"}))
+                 "phase": "stopped"}), pointer=False)
 
         def _resume_old() -> None:
             # store record first: if the restart fails too, the family's
@@ -475,6 +521,259 @@ class JobService:
                                   num_slices=old.num_slices, carry=carry)
                 raise
         return st
+
+    @staticmethod
+    def _carry_identity(st: JobState, **overrides) -> dict:
+        """The JobState fields that travel with the FAMILY across
+        versions (rescale, migration, resize, re-admission): priority
+        identity, seniority, every budget counter, and the elastic
+        contract. One helper so a new identity field can never be dropped
+        by one of the five carry sites."""
+        out = {
+            "priority_class": st.priority_class,
+            "submitted_seq": st.submitted_seq,
+            "preemptions": st.preemptions,
+            "restarts": st.restarts,
+            "migrations": st.migrations,
+            "elastic": st.elastic,
+            "min_members": st.min_members,
+            "members_desired": st.members_desired,
+            "resizes": st.resizes,
+            "last_resize": dict(st.last_resize),
+        }
+        out.update(overrides)
+        return out
+
+    # -- elastic resize (docs/robustness.md "Elastic gangs") ---------------------
+
+    def resize_gang(self, name: str, to_members: int,
+                    exclude_hosts: set[str] | None = None,
+                    reason: str = "", count_resize: bool = True,
+                    require_weight_below: int | None = None) -> JobState:
+        """Resize an elastic data-parallel gang to ``to_members`` hosts —
+        the reaction that replaces binary failure: a host loss or a
+        partial preemption SHRINKS the gang to its surviving members
+        (never below ``min_members``), a grow-back admitted through the
+        capacity market restores them. Sequencing reuses the gang
+        primitives end to end:
+
+        1. persist intent FIRST (phase ``scaling_down``/``scaling_up`` +
+           ``last_resize`` with the target and excluded hosts) — a daemon
+           death anywhere below is adoptable: the reconciler/supervisor
+           finish the resize forward without re-counting it;
+        2. quiesce the whole gang (workers first, coordinator LAST —
+           checkpoint binds intact, stops best-effort on unreachable
+           hosts);
+        3. ONE atomic apply releases the old version's slices and ports
+           AND claims the new version's — the store sees exactly the
+           member delta, with no window where the gang owns neither (or
+           both) — then the new member containers are created;
+        4. start coordinator-first; the resized gang resumes from the
+           shared checkpoint binds, re-sharding its batch dimension over
+           the surviving hosts;
+        5. a shrink below ``members_desired`` journals a durable
+           grow-back admission record at the job's class, re-admitted
+           with preempted-grade precedence once pressure lifts.
+
+        A shrink whose exact target cannot place (axis-aligned block
+        fragmentation) steps down toward ``min_members``; exhausting the
+        ladder parks the gang ``preempted`` (admission enabled) or fails
+        it — the gang is never left half-sized. ``require_weight_below``
+        re-validates the partial-preemption eligibility (strictly-lower
+        class, still running) under the family lock, so a priority retune
+        or user stop that raced in wins."""
+        base, _, latest_name = self._resolve_latest(name)
+        with self._locks.hold(base):
+            base, _, latest_name = self._resolve_latest(name)
+            st = self.store.get_job(latest_name)
+            if not st.elastic:
+                raise errors.BadRequest(f"job {base} is not elastic")
+            if st.phase == "failed":
+                raise errors.BadRequest(
+                    f"job {base} is failed: {st.failure_reason}")
+            if st.phase in ("queued", "preempted"):
+                raise errors.BadRequest(
+                    f"job {base} is {st.phase}; admission re-places it")
+            if st.phase == "migrating":
+                raise errors.BadRequest(
+                    f"job {base} is migrating off unhealthy hosts")
+            if not st.desired_running:
+                raise errors.BadRequest(f"job {base} is stopped")
+            if st.num_slices != 1:
+                raise errors.BadRequest(
+                    f"job {base} is multislice; elastic resize is "
+                    "single-slice only")
+            finishing = st.phase in SCALING_PHASES
+            cur = len(st.placements)
+            per_host = self.pod.chips_per_host
+            desired = st.members_desired or cur
+            floor = max(st.min_members, 1)
+            if not floor <= to_members <= desired:
+                raise errors.BadRequest(
+                    f"job {base}: target {to_members} members outside "
+                    f"[{floor}, {desired}] (minMembers..membersDesired)")
+            if require_weight_below is not None:
+                # partial-preemption revalidation: the plan was computed
+                # lock-free — a stale snapshot must never shrink a gang
+                # that stopped being a legal victim, and a concurrent
+                # shrink that already took the gang below the plan's
+                # target must not turn the "preemption" into a GROW
+                w = (self.admission.weight(st.priority_class)
+                     if self.admission is not None else 0)
+                if (st.phase != "running" or w >= require_weight_below
+                        or to_members >= cur):
+                    raise errors.BadRequest(
+                        f"job {base} is no longer a preemption victim")
+            if to_members == cur and not finishing:
+                raise errors.NoPatchRequired(
+                    f"job {base} already has {cur} members")
+            direction = "down" if to_members < cur else "up"
+            exclude = set(exclude_hosts or ())
+            vname = st.job_name
+            if direction == "up" and not self.slices.fits(
+                    to_members * per_host, 1, assume_freed={vname},
+                    exclude_hosts=exclude):
+                # grow-back feasibility precheck BEFORE touching the
+                # running gang: a grow that cannot place must not bounce
+                # a healthy shrunken gang through quiesce/relaunch
+                if finishing:
+                    # adopting an interrupted grow whose window closed:
+                    # settle back to running at the CURRENT size (bounce
+                    # the gang through the restart primitive — the dead
+                    # daemon may have quiesced any subset) and leave the
+                    # grow-back record to retry when pressure lifts again
+                    st = JobState.from_dict(
+                        {**st.to_dict(), "phase": "running"})
+                    self.store.put_job(st)
+                    self._stop_members(st, reverse=True)
+                    self._start_members(st)
+                    self._emit("job-resize-reverted", st.job_name,
+                               reason="grow window closed")
+                    return st
+                raise errors.ChipNotEnough(
+                    f"job {base}: no capacity to grow back to "
+                    f"{to_members} members")
+            t0 = time.perf_counter()
+            intent = {
+                "direction": direction, "reason": reason,
+                "ts": time.time(), "fromMembers": cur,
+                "toMembers": to_members,
+                "excludeHosts": sorted(exclude),
+                # attempts of THIS resize (adoption retries bump it; the
+                # job_resize_max loop bound reads it) — distinct from the
+                # lifetime ``resizes`` observability counter, which a
+                # healthy long-lived elastic gang grows without limit
+                "attempts": ((st.last_resize or {}).get("attempts", 0) + 1
+                             if finishing else 1),
+            }
+            st = JobState.from_dict({
+                **st.to_dict(),
+                "phase": "scaling_down" if direction == "down"
+                else "scaling_up",
+                "resizes": st.resizes + (1 if count_resize
+                                         and not finishing else 0),
+                "last_resize": intent,
+            })
+            self.store.put_job(st)
+            crash_point("job.resize.after_mark")
+            # gang quiesce: workers flush their checkpoint shards first,
+            # the coordinator (the rendezvous point) strictly last
+            self._stop_members(st, reverse=True)
+            crash_point("job.resize.after_quiesce")
+            new_st = self._relaunch_resized(base, st, to_members, cur,
+                                            exclude, intent, reason)
+            crash_point("job.resize.after_create_new")
+            # retire the old version record so supervisors/invariants read
+            # it as settled (the resources were already released in the
+            # delta apply; pointer=False — the resized version owns the
+            # family's latest pointer)
+            self.store.put_job(JobState.from_dict(
+                {**st.to_dict(), "desired_running": False,
+                 "phase": "stopped"}), pointer=False)
+            self._start_members(new_st)
+            crash_point("job.resize.after_start_new")
+            got = len(new_st.placements)
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            self.registry.counter_inc(
+                "job_resizes_total",
+                {"direction": "down" if got < cur else "up",
+                 "reason": reason or "manual"},
+                help="Elastic gang resizes executed, by direction/reason")
+            if got < cur:
+                self.registry.observe(
+                    "resize_time_to_shrunk_ms", wall_ms, buckets=_RESIZE_BUCKETS,
+                    help="Wall time from resize intent to the shrunken "
+                         "gang running (ms)")
+            self._emit("job-resized", new_st.job_name,
+                       direction="down" if got < cur else "up",
+                       reason=reason, fromMembers=cur, toMembers=got,
+                       wallMs=round(wall_ms, 1))
+            log.info("resized job %s: %d → %d members (%s): %s", base,
+                     cur, got, new_st.job_name, reason or "requested")
+            if (got < desired and self.admission is not None
+                    and self.admission.enabled and self.resize_enabled):
+                # durable grow-back intent through the capacity market —
+                # the queue, not a private retry loop, decides when the
+                # lost members return (preempted-grade precedence). The
+                # job-growback-queued event is recorded ONCE, by the
+                # admission ring (enqueue_growback) — one entry per
+                # transition in the merged ring
+                self.admission.enqueue_growback(base, new_st.priority_class)
+                crash_point("job.resize.after_start_new")
+            return new_st
+
+    def _relaunch_resized(self, base: str, st: JobState, to_members: int,
+                          cur: int, exclude: set[str], intent: dict,
+                          reason: str) -> JobState:
+        """Claim-and-create the resized version, stepping down the member
+        ladder on capacity/fragmentation failure (a shrink must land on
+        whatever block shape the surviving hosts offer; a failed grow
+        first retries the CURRENT size — the compensation that leaves the
+        gang no worse). Exhausting the ladder parks the gang preempted
+        (admission enabled — it re-admits like any other victim) or fails
+        it. The old version's release rides each attempt's claim txn
+        (``release_old``); replayed releases are owner-guarded no-ops."""
+        per_host = self.pod.chips_per_host
+        floor = max(st.min_members, 1)
+        ladder = [to_members]
+        if to_members > cur:
+            # grow: fall back to the current size first (status quo), then
+            # shrink toward the floor only if even that cannot re-place
+            ladder += [m for m in range(cur, floor - 1, -1)
+                       if m != to_members]
+        else:
+            ladder += [m for m in range(to_members - 1, floor - 1, -1)]
+        grid = self.pod.host_grid
+        done = {k: v for k, v in intent.items() if k != "excludeHosts"}
+        for target in ladder:
+            if not candidate_shapes(target, grid):
+                continue  # no axis-aligned tiling for this member count
+            try:
+                return self._run_version(
+                    base, st.image, st.cmd, st.env, st.binds,
+                    target * per_host, start_now=False, num_slices=1,
+                    exclude_hosts=exclude or None,
+                    carry=self._carry_identity(
+                        st, last_resize={**done, "toMembers": target}),
+                    release_old=st)
+            except (errors.ChipNotEnough, errors.PortNotEnough) as e:
+                log.info("resize of %s to %d members blocked: %s", base,
+                         target, e)
+        # ladder exhausted: even min_members cannot place — the gang
+        # cannot run at any legal size right now
+        self._emit("job-resize-exhausted", st.job_name, reason=reason,
+                   floor=floor)
+        if self.admission is not None and self.admission.enabled:
+            parked = self.admission.park_preempted(
+                base, reason=f"resize exhausted: {reason or 'no capacity'}")
+            if parked is not None:
+                raise errors.ChipNotEnough(
+                    f"job {base}: no capacity at any size >= {floor}; "
+                    "parked preempted for re-admission")
+        self.fail_job(base, f"resize exhausted: no capacity at any size "
+                            f">= {floor} ({reason or 'resize'})")
+        raise errors.ChipNotEnough(
+            f"job {base}: no capacity at any size >= {floor}")
 
     # -- flows -------------------------------------------------------------------
 
@@ -519,6 +818,29 @@ class JobService:
             raise errors.BadRequest("chipCount or acceleratorType required")
         if req.num_slices < 1:
             raise errors.BadRequest("numSlices must be >= 1")
+        min_members = 0
+        if req.elastic:
+            # the elastic contract is only meaningful for a gang that CAN
+            # shrink in units of hosts: single-slice, whole-host members,
+            # at least two of them
+            if req.num_slices != 1:
+                raise errors.BadRequest(
+                    "elastic jobs are single-slice (numSlices == 1); "
+                    "multislice gangs cannot re-shard one slice away")
+            want = self._requested_chips(req)
+            per_host = self.pod.chips_per_host
+            if want % per_host or want // per_host < 2:
+                raise errors.BadRequest(
+                    f"elastic jobs must span >= 2 whole hosts: {want} "
+                    f"chips is not a >= 2x multiple of {per_host} "
+                    f"chips/host")
+            min_members = req.min_members or 1
+            if not 1 <= min_members <= want // per_host:
+                raise errors.BadRequest(
+                    f"minMembers must be in [1, {want // per_host}], "
+                    f"got {min_members}")
+        elif req.min_members:
+            raise errors.BadRequest("minMembers requires elastic: true")
         priority = self._resolve_priority(req.priority_class)
         seq = self.admission.next_seq() if self.admission is not None else 0
         with self._locks.hold(base):
@@ -530,7 +852,13 @@ class JobService:
                     req.chip_count, req.accelerator_type,
                     num_slices=req.num_slices,
                     carry={"priority_class": priority,
-                           "submitted_seq": seq},
+                           "submitted_seq": seq,
+                           "elastic": req.elastic,
+                           "min_members": min_members,
+                           "members_desired": (
+                               self._requested_chips(req)
+                               // self.pod.chips_per_host
+                               if req.elastic else 0)},
                 )
             except (errors.ChipNotEnough, errors.PortNotEnough) as e:
                 if self.admission is None or not self.admission.enabled:
@@ -574,6 +902,10 @@ class JobService:
                     f"job {base} is {old.phase} (admission queue); it has "
                     "no running gang to rescale — stop or delete it, or "
                     "wait for admission")
+            if old.phase in SCALING_PHASES:
+                raise errors.BadRequest(
+                    f"job {base} has an elastic resize in flight; retry "
+                    "after it settles")
             want = req.chip_count
             if req.accelerator_type:
                 from tpu_docker_api.scheduler.topology import parse_accelerator_type
@@ -594,11 +926,22 @@ class JobService:
 
             # identity travels with the family across versions: priority
             # class and seniority (and the budgets) must survive a rescale
-            carry = {"priority_class": old.priority_class,
-                     "submitted_seq": old.submitted_seq,
-                     "preemptions": old.preemptions,
-                     "restarts": old.restarts,
-                     "migrations": old.migrations}
+            carry = self._carry_identity(old)
+            if old.elastic:
+                # a user rescale rewrites the elastic contract's notion of
+                # "full size" — grow-back targets the new shape, and the
+                # shape must stay legal for it
+                per_host = self.pod.chips_per_host
+                if want % per_host or want // per_host < 2:
+                    raise errors.BadRequest(
+                        f"job {base} is elastic: chip counts must stay "
+                        f"whole-host multiples spanning >= 2 hosts "
+                        f"({per_host} chips/host)")
+                if want // per_host < max(old.min_members, 1):
+                    raise errors.BadRequest(
+                        f"job {base} is elastic: {want} chips is below "
+                        f"minMembers {old.min_members}")
+                carry["members_desired"] = want // per_host
             st = self._swap_version(
                 base, old, carry,
                 lambda start_now: self._run_version(
@@ -638,11 +981,11 @@ class JobService:
                     "env": list(env), "binds": list(binds)})
                 self.store.put_job(new)
                 return self._info_dict(new)
-            carry = {"priority_class": old.priority_class,
-                     "submitted_seq": old.submitted_seq,
-                     "preemptions": old.preemptions,
-                     "restarts": old.restarts,
-                     "migrations": old.migrations}
+            if old.phase in SCALING_PHASES:
+                raise errors.BadRequest(
+                    f"job {base} has an elastic resize in flight; retry "
+                    "after it settles")
+            carry = self._carry_identity(old)
             st = self._swap_version(
                 base, old, carry,
                 lambda start_now: self._run_version(
@@ -694,6 +1037,10 @@ class JobService:
                     f"job {base} is {st.phase} (admission queue); it starts "
                     "automatically when capacity allows — stop or delete "
                     "to cancel")
+            if st.phase in SCALING_PHASES:
+                raise errors.BadRequest(
+                    f"job {base} has an elastic resize in flight; the "
+                    "reconciler finishes it")
             # a stopped job normally RETAINS its grant for exactly this
             # resume — but one stopped out of queued/preempted owns
             # nothing (the market released it), and starting its old
@@ -718,7 +1065,8 @@ class JobService:
             self._stop_members(st, reverse=True)
             st = JobState.from_dict({**st.to_dict(), "desired_running": True,
                                      "phase": "running", "restarts": 0,
-                                     "migrations": 0, "failure_reason": ""})
+                                     "migrations": 0, "resizes": 0,
+                                     "failure_reason": ""})
             # store record first: if a member start fails below, the family
             # still wants to run and the supervisor/reconciler finish the gang
             self.store.put_job(st)
@@ -748,6 +1096,13 @@ class JobService:
                 # that still names the dead host
                 raise errors.BadRequest(
                     f"job {base} is migrating off unhealthy hosts")
+            if st.phase in SCALING_PHASES:
+                # same rule for an in-flight resize: finishing the resize
+                # IS the recovery (resize_gang restarts the gang at the
+                # target size); a bare gang restart would revive the old
+                # shape the resize already quiesced
+                raise errors.BadRequest(
+                    f"job {base} has an elastic resize in flight")
             if st.phase in ("queued", "preempted"):
                 # dormant: no gang exists (or it is already quiesced and
                 # released) — the admission loop owns the next transition
@@ -835,6 +1190,10 @@ class JobService:
                 raise errors.BadRequest(
                     f"job {base} is {old.phase}; it holds no placement "
                     "to migrate")
+            if old.phase in SCALING_PHASES:
+                raise errors.BadRequest(
+                    f"job {base} has an elastic resize in flight; the "
+                    "reconciler finishes it (excluding unreachable hosts)")
             if not old.desired_running:
                 raise errors.BadRequest(f"job {base} is stopped")
             finishing = old.phase == "migrating"
@@ -856,10 +1215,7 @@ class JobService:
                 })
                 self.store.put_job(old)
             crash_point("job.migrate.after_mark")
-            carry = {"restarts": old.restarts, "migrations": old.migrations,
-                     "priority_class": old.priority_class,
-                     "submitted_seq": old.submitted_seq,
-                     "preemptions": old.preemptions}
+            carry = self._carry_identity(old)
             released = False
             try:
                 # fast path: new slice + created-not-started containers
@@ -899,10 +1255,11 @@ class JobService:
                 # (same gang ordering / best-effort rules as above)
                 self._stop_members(old, reverse=True)
             # record the retirement so supervisors and invariants read the
-            # old version as settled
+            # old version as settled (pointer=False: the migrated version
+            # owns the family's latest pointer now)
             self.store.put_job(JobState.from_dict(
                 {**old.to_dict(), "desired_running": False,
-                 "phase": "stopped"}))
+                 "phase": "stopped"}), pointer=False)
             crash_point("job.migrate.after_quiesce_old")
             self._start_members(st)
             crash_point("job.migrate.after_start_new")
@@ -918,7 +1275,8 @@ class JobService:
 
     def fail_job(self, name: str, reason: str,
                  only_if_restarts_ge: int | None = None,
-                 only_if_migrations_ge: int | None = None) -> JobState:
+                 only_if_migrations_ge: int | None = None,
+                 only_if_resize_attempts_ge: int | None = None) -> JobState:
         """Terminal transition: the gang crash-looped through its restart
         budget (or lost a member container entirely). Stops any survivors and
         frees every slice and port the family holds — a ``failed`` job owns
@@ -938,6 +1296,10 @@ class JobService:
                 return st
             if (only_if_migrations_ge is not None
                     and st.migrations < only_if_migrations_ge):
+                return st
+            if (only_if_resize_attempts_ge is not None
+                    and (st.last_resize or {}).get("attempts", 0)
+                    < only_if_resize_attempts_ge):
                 return st
             if not st.desired_running or st.phase in ("failed", "queued",
                                                       "preempted"):
@@ -1086,6 +1448,43 @@ class JobService:
             raise errors.ContainerNotExist(f"job {name}") from None
         return self._info_dict(st, live=True)
 
+    def elastic_info(self, st: JobState) -> dict:
+        """The elastic-contract projection ({} for non-elastic jobs) —
+        ONE shape shared by ``GET /jobs/{name}`` and the supervisor's
+        ``/api/v1/health/jobs`` view: minMembers/membersDesired/
+        membersActual, the lastResize record, and — while shrunken — the
+        grow-back record's queue position."""
+        if not st.elastic:
+            return {}
+        out = {
+            "elastic": True,
+            "minMembers": max(st.min_members, 1),
+            "membersDesired": st.members_desired or len(st.placements),
+            "membersActual": len(st.placements),
+        }
+        if st.resizes:
+            out["resizes"] = st.resizes
+        if st.last_resize:
+            lr = st.last_resize
+            out["lastResize"] = {
+                "direction": lr.get("direction", ""),
+                "reason": lr.get("reason", ""),
+                "ts": lr.get("ts", 0.0),
+                "fromMembers": lr.get("fromMembers", 0),
+                "toMembers": lr.get("toMembers", 0),
+            }
+        if (st.phase == "running" and self.admission is not None
+                and len(st.placements) < (st.members_desired or 0)):
+            base, _ = split_versioned_name(st.job_name)
+            try:
+                pos = self.admission.position(base)
+            except Exception:  # noqa: BLE001 — a store hiccup must not
+                # break a read-only view
+                pos = None
+            if pos is not None:
+                out["growbackQueuePosition"] = pos
+        return out
+
     # -- internals ---------------------------------------------------------------
 
     def _start_members(self, st: JobState) -> None:
@@ -1180,6 +1579,7 @@ class JobService:
             out["migrations"] = st.migrations
         if st.preemptions:
             out["preemptions"] = st.preemptions
+        out.update(self.elastic_info(st))
         if st.phase in ("queued", "preempted") and self.admission is not None:
             base, _ = split_versioned_name(st.job_name)
             pos = self.admission.position(base)

@@ -113,6 +113,10 @@ class JobSupervisor:
         self._attempted: set[str] = set()
         #: same adoption bookkeeping for migrations (phase == "migrating")
         self._mig_attempted: set[str] = set()
+        #: and for elastic resizes (phase == "scaling_down"/"scaling_up"):
+        #: first sight finishes without re-counting, repeats count so the
+        #: job_resize_max bound converges a thrashing resize to failed
+        self._resize_attempted: set[str] = set()
         #: families currently observed behind an unreachable-but-not-down
         #: host — the host-blip event is recorded on ENTRY only, not every
         #: poll tick (a persistent blip must not evict the whole bounded
@@ -220,9 +224,23 @@ class JobSupervisor:
             # undo the preemption and double-bind the freed capacity
             self._note_obs(base, [], [])
             return
+        if st.phase in ("scaling_down", "scaling_up"):
+            # a resize is in flight (or awaiting adoption after a daemon
+            # death): finish it forward — liveness verdicts on a
+            # deliberately half-stopped gang would only misfire
+            self._finish_resize(base, st)
+            return
         dead, missing, crashed, unreachable = self._member_liveness(st)
         self._note_obs(base, dead, missing, unreachable)
         down = sorted(h for h in unreachable if self._host_down(h))
+        if down and st.phase != "migrating" and self._shrinkable(st, down,
+                                                                unreachable):
+            # elastic host-loss repair: SHRINK to the surviving hosts —
+            # no restart-budget burn, no whole-gang migration, fewer
+            # moved bytes; the lost members grow back through the
+            # admission queue once capacity returns
+            self._shrink_family(base, st, down, sorted(unreachable))
+            return
         if st.phase == "migrating" or down:
             # host-down (or an interrupted migration to adopt): the repair
             # is migration, never a restart — a gang restart would re-place
@@ -337,6 +355,81 @@ class JobSupervisor:
             # job to failed
             self._record("gang-migrate-failed", base, error=str(e))
 
+    def _shrinkable(self, st, down: list[str],
+                    unreachable: list[str]) -> bool:
+        """True when a host-loss can be absorbed by an elastic shrink:
+        resizing enabled, the gang is elastic and running (an interrupted
+        restart keeps its restart-path repair), the survivors stay at or
+        above ``min_members``, and the count heuristic says the shrunken
+        gang can re-place on the healthy hosts (own grant freed, bad
+        hosts excluded) — otherwise the migrate/fail path keeps
+        jurisdiction."""
+        if not (getattr(self._svc, "resize_enabled", True) and st.elastic
+                and st.num_slices == 1 and st.phase == "running"):
+            return False
+        bad = set(down) | set(unreachable)
+        survivors = sum(1 for h, *_ in st.placements if h not in bad)
+        if not max(st.min_members, 1) <= survivors < len(st.placements):
+            return False
+        per_host = self.pod.chips_per_host
+        return self._svc.slices.fits(
+            survivors * per_host, 1, assume_freed={st.job_name},
+            exclude_hosts=bad)
+
+    def _shrink_family(self, base: str, st, down: list[str],
+                       unreachable: list[str]) -> None:
+        """Elastic host-loss repair: resize to the surviving members.
+        Charged to NEITHER the restart nor the migration budget — a
+        shrink is the reaction that makes host loss survivable, and the
+        gang grows back through the admission queue."""
+        bad = set(down) | set(unreachable)
+        survivors = sum(1 for h, *_ in st.placements if h not in bad)
+        self._record("gang-shrinking", base, hosts=down,
+                     fromMembers=len(st.placements), toMembers=survivors)
+        self._resize_attempted.add(base)
+        try:
+            self._svc.resize_gang(
+                base, survivors, exclude_hosts=bad,
+                reason="host-down")
+            self._counter("gang_shrinks_total")
+        except errors.ApiError as e:
+            # the resize ladder already tried every legal size (and, with
+            # the market enabled, parked the gang preempted); anything
+            # else is retried next poll, falling back to migrate once the
+            # shrink stops being feasible
+            self._record("gang-shrink-failed", base, error=str(e))
+
+    def _finish_resize(self, base: str, st) -> None:
+        """Adopt an in-flight resize (daemon died mid-resize, or our own
+        last attempt failed): finish it forward toward the persisted
+        ``last_resize`` target, excluding the hosts the intent recorded.
+        The intent's ``attempts`` counter (bumped on every retry of THIS
+        resize — never the lifetime ``resizes`` count) bounds the loop:
+        past ``job_resize_max`` a never-settling resize converges to
+        terminal failed."""
+        finishing = base not in self._resize_attempted
+        resize_max = getattr(self._svc, "resize_max", 8)
+        lr = st.last_resize or {}
+        attempts = int(lr.get("attempts", 1))
+        if attempts >= resize_max and not finishing:
+            self._record("job-resize-loop", base, attempts=attempts)
+            self._try_repair(base, lambda: self._svc.fail_job(
+                base, f"resize loop: {attempts} attempts exhausted",
+                only_if_resize_attempts_ge=resize_max))
+            return
+        target = int(lr.get("toMembers") or len(st.placements) or 1)
+        exclude = set(lr.get("excludeHosts") or ())
+        self._record("gang-resize-adopted", base, toMembers=target,
+                     attempt=attempts + (0 if finishing else 1))
+        self._resize_attempted.add(base)
+        try:
+            self._svc.resize_gang(
+                base, target, exclude_hosts=exclude,
+                reason="adoption", count_resize=not finishing)
+            self._counter("gang_shrinks_total")
+        except errors.ApiError as e:
+            self._record("gang-resize-failed", base, error=str(e))
+
     def _host_down(self, host_id: str) -> bool:
         """Confirmed down = the monitor's verdict (grace window elapsed).
         Without a monitor, unreachability alone NEVER condemns a host —
@@ -415,6 +508,7 @@ class JobSupervisor:
             self._deadline.pop(base, None)
         self._attempted.discard(base)
         self._mig_attempted.discard(base)
+        self._resize_attempted.discard(base)
         self._blipped.discard(base)
 
     # -- events / views ----------------------------------------------------------
@@ -425,6 +519,9 @@ class JobSupervisor:
                         "Whole-gang restarts executed by the job supervisor",
                         "gang_migrations_total":
                         "Whole-gang migrations off unhealthy hosts",
+                        "gang_shrinks_total":
+                        "Elastic gang resizes driven by the supervisor "
+                        "(host-loss shrinks + resize adoptions)",
                         "jobs_failed_total":
                         "Jobs driven to the terminal failed phase"}[name])
 
@@ -485,6 +582,7 @@ class JobSupervisor:
                 "backoffRemainingS": round(max(0.0, deadline - now), 3),
                 **({"failureReason": st.failure_reason}
                    if st.failure_reason else {}),
+                **self._svc.elastic_info(st),
             }
         return {"jobs": out, "backoffBaseS": self._backoff_base_s,
                 "backoffMaxS": self._backoff_max_s}

@@ -221,6 +221,12 @@ class Reconciler:
         #: "migrating"): first sight finishes without counting, repeats
         #: count so a never-satisfiable migration converges to failed
         self._mig_adopted: set[str] = set()
+        #: and for interrupted elastic resizes (phase == "scaling_down"/
+        #: "scaling_up"): first sight finishes forward without counting
+        #: (releasing exactly the delta — the resize's one-apply contract
+        #: makes a replayed release an owner-guarded no-op), repeats count
+        #: toward ``job_resize_max`` so a thrashing resize converges
+        self._resize_adopted: set[str] = set()
         #: capacity-market admission controller (service/admission.py):
         #: the sweep adopts its journal — purging records whose family is
         #: gone, settling records whose job already placed (the
@@ -853,6 +859,43 @@ class Reconciler:
                     unreachable.append(host_id)
                 members.append((host, cname, info))
 
+            if st.desired_running and st.phase in ("scaling_down",
+                                                   "scaling_up"):
+                # daemon died mid-resize: finish it FORWARD toward the
+                # persisted last_resize target — the one-apply delta
+                # contract means the gang is at the old size (claims
+                # intact) or the new size (delta committed); either way
+                # resize_gang re-quiesces idempotently and releases
+                # exactly the delta (replayed releases are owner-guarded
+                # no-ops). First sight does not re-count; a repeat means
+                # OUR adoption failed and counts toward job_resize_max,
+                # converging a never-settling resize to terminal failed
+                finishing = base not in self._resize_adopted
+                resize_max = getattr(self._job_svc, "resize_max", 8)
+                lr = st.last_resize or {}
+                attempts = int(lr.get("attempts", 1))
+                if attempts >= resize_max and not finishing:
+                    self._act(actions, dry_run, "fail-job-resize-loop",
+                              latest_name, attempts=attempts,
+                              fn=lambda: self._job_svc.fail_job(
+                                  base, f"resize loop: {attempts} "
+                                  "attempts exhausted",
+                                  only_if_resize_attempts_ge=resize_max))
+                    return
+                if not dry_run:
+                    self._resize_adopted.add(base)
+                target = int(lr.get("toMembers")
+                             or max(len(st.placements), 1))
+                # exclude what the intent recorded PLUS whatever is
+                # unreachable now (the adoption-time rule migrations use)
+                excl = set(lr.get("excludeHosts") or ()) | set(unreachable)
+                self._act(actions, dry_run, "finish-resize", latest_name,
+                          toMembers=target, excluding=sorted(excl),
+                          fn=lambda: self._job_svc.resize_gang(
+                              base, target, exclude_hosts=excl,
+                              reason="adoption",
+                              count_resize=not finishing))
+                return
             if st.desired_running and st.phase == "migrating":
                 # daemon died mid-migration: finish it, excluding whatever
                 # is unreachable NOW (the original bad host, if still

@@ -23,23 +23,30 @@ class StateStore:
 
     # -- generic ----------------------------------------------------------------
 
-    def _put(self, resource: Resource, base: str, version: int, payload: dict) -> None:
+    def _put(self, resource: Resource, base: str, version: int, payload: dict,
+             pointer: bool = True) -> None:
         # one atomic apply, not two puts: the version record and the family's
         # latest pointer land together — no crash window where a pointer
         # names a spec that was never written (and one store round trip per
-        # version transition instead of two)
+        # version transition instead of two). ``pointer=False`` updates a
+        # RETIRED version's record (the quiesce bookkeeping a swap/migrate/
+        # resize writes after the new version took the pointer) without
+        # rewinding the family's latest back onto it.
         with trace.child("store.put", resource=resource.value, base=base,
                          version=version):
-            self.kv.apply(self._put_ops(resource, base, version, payload))
+            self.kv.apply(self._put_ops(resource, base, version, payload,
+                                        pointer=pointer))
 
     @staticmethod
     def _put_ops(resource: Resource, base: str, version: int,
-                 payload: dict) -> list[tuple]:
-        return [
+                 payload: dict, pointer: bool = True) -> list[tuple]:
+        ops = [
             ("put", keys.version_key(resource, base, version),
              json.dumps(payload)),
-            ("put", keys.latest_key(resource, base), str(version)),
         ]
+        if pointer:
+            ops.append(("put", keys.latest_key(resource, base), str(version)))
+        return ops
 
     def _get(self, resource: Resource, name: str) -> dict:
         """Fetch by versioned name, or by base name (⇒ latest version)."""
@@ -89,9 +96,14 @@ class StateStore:
 
     # -- jobs -------------------------------------------------------------------
 
-    def put_job(self, st) -> None:
+    def put_job(self, st, pointer: bool = True) -> None:
+        """``pointer=False`` rewrites a retired version's record (e.g. the
+        old gang marked stopped after a swap) without rewinding the
+        family's latest pointer onto it — a bare-name ``GET`` must keep
+        serving the version that actually superseded it."""
         base, _ = keys.split_versioned_name(st.job_name)
-        self._put(Resource.JOBS, base, st.version, st.to_dict())
+        self._put(Resource.JOBS, base, st.version, st.to_dict(),
+                  pointer=pointer)
 
     def get_job(self, name: str):
         from tpu_docker_api.schemas.job import JobState
