@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-scale openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-scale bench-shard openapi sample-interface run clean
 
 all: native openapi
 
@@ -86,6 +86,11 @@ bench-scale:                 ## O(100k)-object scale family, reduced world: O(ch
 	$(PY) bench.py --control-plane --cp-family scale --scale-objects 12000 --scale-small 600 --scale-gangs 60 > bench-scale.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-scale.json.tmp
 	mv bench-scale.json.tmp bench-scale.json
+
+bench-shard:                 ## sharded writer plane family: 3-shard vs 1-shard churn throughput + blast-radius gate (survivors unharmed, victim recovers <= TTL budget)
+	$(PY) bench.py --control-plane --cp-family shard > bench-shard.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-shard.json.tmp
+	mv bench-shard.json.tmp bench-shard.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

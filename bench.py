@@ -620,6 +620,317 @@ def measure_control_plane_failover(n_failovers: int = 5,
     }
 
 
+def measure_control_plane_shard(n_cycles: int = 60, shard_count: int = 3,
+                                ttl_s: float = 1.5,
+                                store_rtt_ms: float = 40.0,
+                                clients: int = 24,
+                                speedup_min: float = 2.2) -> dict:
+    """Control-plane shard family (``--control-plane --cp-family shard``):
+    the sharded writer plane measured (service/shard.py, docs/robustness.md
+    "Sharded writer plane"). Two cells over identical hardware and an
+    identical store model: a classic single-leader daemon
+    (``shard_count = 1``) versus a ``shard_count``-shard fleet — one real
+    daemon per shard over ONE shared store — churning the same total
+    number of chip-free container create/stop/delete cycles through the
+    full HTTP stack, each mutation routed to its family's owning shard.
+
+    The store is a MemoryKV wrapped with a modeled write round trip
+    (``store_rtt_ms`` of GIL-free sleep per atomic apply — the fanout
+    family's latency-injection idiom). That is the point, not a cheat: a
+    raw MemoryKV commits in microseconds, so an unmodeled run measures
+    Python request parsing, not the control plane. Against a real etcd's
+    millisecond RTTs the binding constraint is the per-shard writer
+    serialization — every version bump for a family holds that shard's
+    version-map lock across a store round trip — and THAT is exactly the
+    lock the shard map partitions. One shard ⇒ every family in the
+    keyspace queues on one lock; N shards ⇒ N independent queues.
+
+    Self-gating (ISSUE 17 acceptance): the sharded cell's churn
+    throughput must reach ≥ 2.2× the single-shard cell (near-linear
+    scaling for 3 shards), and a **blast-radius** phase hard-kills one
+    shard's leader mid-load — survivor shards' writes must see ZERO
+    failures with p95 inside budget while the victim shard recovers on a
+    surviving daemon within a TTL-derived budget. A violated gate flips
+    ``gates.ok``; main() turns that into a nonzero exit."""
+    import queue as queue_mod
+    import statistics
+    import threading
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.service.shard import ShardMap
+    from tpu_docker_api.state.kv import MemoryKV
+
+    if n_cycles < 2 or shard_count < 2:
+        raise ValueError("shard family needs >= 2 cycles and >= 2 shards")
+
+    class RttKV(MemoryKV):
+        """MemoryKV plus a modeled write round trip: every atomic apply
+        sleeps ``rtt`` OUTSIDE the store lock (concurrent writers overlap
+        their round trips, exactly like concurrent etcd requests)."""
+
+        def __init__(self, rtt_s: float) -> None:
+            super().__init__()
+            self._rtt_s = rtt_s
+
+        def _apply(self, ops, guards=None):
+            time.sleep(self._rtt_s)
+            super()._apply(ops, guards)
+
+    smap = ShardMap(shard_count)
+
+    def names_for_shard(shard: int, tag: str, n: int) -> list[str]:
+        out, i = [], 0
+        while len(out) < n:
+            name = f"{tag}{i}"
+            i += 1
+            if smap.shard_of(name) == shard:
+                out.append(name)
+        return out
+
+    def boot(kv, runtime, holder: str, shards: int,
+             preferred: tuple = ()) -> Program:
+        prg = Program(Config(
+            port=0, store_backend="memory", runtime_backend="fake",
+            start_port=45000, end_port=45999, health_watch_interval=0,
+            host_probe_interval_s=0, reconcile_interval=0,
+            job_supervise_interval=0, leader_election=True,
+            leader_ttl_s=ttl_s, leader_id=holder,
+            shard_count=shards, shard_preferred=list(preferred),
+            shard_standby_delay_s=(60.0 if shards > 1 else 0.0),
+        ), host="127.0.0.1", kv=kv, runtime=runtime)
+        prg.init()
+        prg.start()
+        return prg
+
+    def call(port: int, method, path, body=None, timeout=10.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def wait_ready(port: int, probe: str, timeout_s: float = 30.0) -> None:
+        """A daemon is ready when it ACCEPTS a mutation for a family it
+        owns (503s while the shard lease + writer boot settle)."""
+        deadline = time.monotonic() + timeout_s
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                call(port, "POST", "/api/v1/containers", {
+                    "imageName": "jax", "containerName": probe,
+                    "chipCount": 0})
+                call(port, "DELETE", f"/api/v1/containers/{probe}", {
+                    "force": True, "delEtcdInfoAndVersionRecord": True})
+                return
+            except Exception as e:  # noqa: BLE001 — 503 until the lease
+                # and writer boot settle
+                last = e
+                time.sleep(0.02)
+        raise RuntimeError(f"daemon on :{port} never accepted a {probe} "
+                           f"mutation within {timeout_s}s (last: {last})")
+
+    def cycle(port: int, base: str) -> None:
+        call(port, "POST", "/api/v1/containers", {
+            "imageName": "jax", "containerName": base, "chipCount": 0})
+        call(port, "POST", f"/api/v1/containers/{base}-0/stop")
+        call(port, "DELETE", f"/api/v1/containers/{base}", {
+            "force": True, "delEtcdInfoAndVersionRecord": True})
+
+    def run_cell(port_of_shard: dict, work: list) -> tuple[float, list]:
+        """Drive ``work`` (fresh family names) through ``clients`` client
+        threads, each mutation at its family's owning daemon. Returns
+        (wall seconds, errors)."""
+        qq = queue_mod.Queue()
+        for base in work:
+            qq.put(base)
+        errs: list[str] = []
+
+        def worker():
+            while True:
+                try:
+                    base = qq.get_nowait()
+                except queue_mod.Empty:
+                    return
+                try:
+                    cycle(port_of_shard[smap.shard_of(base)], base)
+                except Exception as e:  # noqa: BLE001 — a failed cycle is
+                    # itself a finding, reported via the gate
+                    errs.append(f"{base}: {type(e).__name__}: {e}")
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, errs
+
+    total_cycles = n_cycles * shard_count
+    rtt_s = store_rtt_ms / 1e3
+    results: dict = {}
+    cleanup: list[Program] = []
+    try:
+        # -- cell 1: the classic single-leader plane ------------------------------
+        one = boot(RttKV(rtt_s), FakeRuntime(), "bench-one", shards=1)
+        cleanup.append(one)
+        wait_ready(one.api_server.port, "probeone")
+        ports_one = {s: one.api_server.port for s in range(shard_count)}
+        work_one = [f"one{i}" for i in range(total_cycles)]
+        wall_one, errs_one = run_cell(ports_one, work_one)
+
+        # -- cell 2: one daemon per shard over ONE shared store -------------------
+        kv3, rt3 = RttKV(rtt_s), FakeRuntime()
+        fleet = [boot(kv3, rt3, f"bench-s{s}", shards=shard_count,
+                      preferred=(s,)) for s in range(shard_count)]
+        cleanup.extend(fleet)
+        ports = {}
+        for s, prg in enumerate(fleet):
+            wait_ready(prg.api_server.port, names_for_shard(
+                s, f"probes{s}x", 1)[0])
+            ports[s] = prg.api_server.port
+        # interleave round-robin across shards: the work queue is FIFO, so
+        # a shard-grouped list would drain shard 0 completely before shard
+        # 1 sees load — serializing the very parallelism under test
+        per_shard = [names_for_shard(s, f"sh{s}x", n_cycles)
+                     for s in range(shard_count)]
+        work_sharded = [n for group in zip(*per_shard) for n in group]
+        wall_sh, errs_sh = run_cell(ports, work_sharded)
+
+        rate_one = total_cycles / wall_one
+        rate_sh = total_cycles / wall_sh
+        speedup = rate_sh / rate_one
+
+        # -- blast radius: hard-kill one shard's leader mid-load ------------------
+        victim_shard = shard_count - 1
+        survivors = [s for s in range(shard_count) if s != victim_shard]
+        surv_stats = {"lat_ms": [], "failures": 0, "requests": 0}
+        surv_mu = threading.Lock()
+        stop_load = threading.Event()
+
+        def survivor_churn(shard: int) -> None:
+            pool = names_for_shard(shard, f"blast{shard}x", 4000)
+            k = 0
+            while not stop_load.is_set():
+                base, k = pool[k], k + 1
+                t0 = time.perf_counter()
+                try:
+                    cycle(ports[shard], base)
+                except Exception:  # noqa: BLE001
+                    with surv_mu:
+                        surv_stats["failures"] += 1
+                else:
+                    with surv_mu:
+                        surv_stats["lat_ms"].append(
+                            (time.perf_counter() - t0) * 1e3)
+                with surv_mu:
+                    surv_stats["requests"] += 1
+
+        load = [threading.Thread(target=survivor_churn, args=(s,),
+                                 daemon=True) for s in survivors]
+        for t in load:
+            t.start()
+        time.sleep(0.5)  # steady churn before the kill
+
+        victim = fleet[victim_shard]
+        # what SIGKILL leaves behind: lease NOT released, API gone
+        victim.shard_plane.close(release=False)
+        victim.api_server.close()
+
+        hard_timeout_s = max(ttl_s * 10, 30.0)
+        probe_pool = names_for_shard(victim_shard, "recover", 4000)
+        t0 = time.perf_counter()
+        recovered, attempt = False, 0
+        while time.perf_counter() - t0 < hard_timeout_s:
+            for s in survivors:
+                name, attempt = probe_pool[attempt], attempt + 1
+                try:
+                    call(ports[s], "POST", "/api/v1/containers", {
+                        "imageName": "jax", "containerName": name,
+                        "chipCount": 0}, timeout=5.0)
+                    recovered = True
+                    break
+                except Exception:  # noqa: BLE001 — 503 until stolen
+                    pass
+            if recovered:
+                break
+            time.sleep(0.02)
+        recovery_ms = (time.perf_counter() - t0) * 1e3
+        stop_load.set()
+        for t in load:
+            t.join(timeout=10)
+
+        if not recovered:
+            raise RuntimeError(
+                f"victim shard {victim_shard} never recovered on a "
+                f"survivor within {hard_timeout_s}s")
+        lat = surv_stats["lat_ms"]
+        if len(lat) >= 2:
+            qs = statistics.quantiles(lat, n=20)
+            surv_p95 = round(min(qs[18], max(lat)), 3)
+        else:
+            surv_p95 = round(max(lat), 3) if lat else 0.0
+
+        # lease remainder (≤ ttl) + detection lag + writer reseed slack
+        recovery_budget_ms = (ttl_s * 1.4 + 3.0) * 1e3
+        surv_p95_budget_ms = max(1000.0, store_rtt_ms * 25)
+        gates = {
+            "speedup_min": speedup_min,
+            "speedup_ok": speedup >= speedup_min,
+            "cells_error_free": not errs_one and not errs_sh,
+            "survivors_zero_failures": surv_stats["failures"] == 0,
+            "survivor_p95_budget_ms": surv_p95_budget_ms,
+            "survivor_p95_ok": surv_p95 <= surv_p95_budget_ms,
+            "recovery_budget_ms": round(recovery_budget_ms, 1),
+            "victim_recovered_in_budget": recovery_ms <= recovery_budget_ms,
+        }
+        gates["ok"] = bool(
+            gates["speedup_ok"] and gates["cells_error_free"]
+            and gates["survivors_zero_failures"] and gates["survivor_p95_ok"]
+            and gates["victim_recovered_in_budget"])
+        results = {
+            "family": "shard",
+            "iters": {"cycles_per_cell": total_cycles, "clients": clients},
+            "shard_count": shard_count,
+            "ttl_s": ttl_s,
+            "store_rtt_ms": store_rtt_ms,
+            "cells": {
+                "one_shard": {"cycles": total_cycles,
+                              "wall_s": round(wall_one, 3),
+                              "cycles_per_s": round(rate_one, 3),
+                              "errors": errs_one[:5]},
+                "sharded": {"cycles": total_cycles,
+                            "wall_s": round(wall_sh, 3),
+                            "cycles_per_s": round(rate_sh, 3),
+                            "errors": errs_sh[:5]},
+            },
+            "speedup": round(speedup, 3),
+            "blast_radius": {
+                "victim_shard": victim_shard,
+                "recovery_ms": round(recovery_ms, 3),
+                "survivor": {"requests": surv_stats["requests"],
+                             "failures": surv_stats["failures"],
+                             "p95_ms": surv_p95},
+            },
+            "gates": gates,
+        }
+    finally:
+        for prg in cleanup:
+            try:
+                prg.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+    return results
+
+
+
 def measure_control_plane_reads(n_reads: int = 2000, readers: int = 4,
                                 audit_reads: int = 25) -> dict:
     """Control-plane reads family (``--control-plane --cp-family reads``):
@@ -1915,7 +2226,7 @@ def measure_control_plane_scale(n_objects: int = 50000, n_small: int = 1000,
 
 
 CP_FAMILIES = ("create", "churn", "failover", "reads", "fanout",
-               "preempt", "resize", "serve-scale", "scale")
+               "preempt", "resize", "serve-scale", "scale", "shard")
 
 
 # control-plane family dispatch — shared by the --control-plane branch
@@ -1931,6 +2242,10 @@ def _run_cp_family(family: str, args) -> dict:
     if family == "failover":
         return measure_control_plane_failover(
             args.failovers, ttl_s=args.failover_ttl)
+    if family == "shard":
+        return measure_control_plane_shard(
+            n_cycles=args.shard_cycles, ttl_s=args.shard_ttl,
+            store_rtt_ms=args.shard_rtt_ms)
     if family == "reads":
         return measure_control_plane_reads(
             args.cp_iters, readers=args.read_workers)
@@ -1957,6 +2272,8 @@ def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
     if family == "failover":
         return ("control_plane_failover_recovery_ms_p50",
                 cp["recovery_ms"]["p50"], "ms")
+    if family == "shard":
+        return ("control_plane_shard_churn_speedup", cp["speedup"], "x")
     if family == "churn":
         return ("control_plane_churn_create_ready_ms_p50",
                 cp["create_ready_ms_p50"], "ms")
@@ -1991,7 +2308,7 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
     ``BENCH_DEGRADED_FAMILIES`` (comma list) overrides the default set."""
     families = [f.strip() for f in os.environ.get(
         "BENCH_DEGRADED_FAMILIES",
-        "churn,preempt,resize,serve-scale,scale").split(",")
+        "churn,preempt,resize,serve-scale,scale,shard").split(",")
         if f.strip()]
     green = 0
     for family in families:
@@ -2127,6 +2444,17 @@ def main() -> int | None:
     parser.add_argument("--failover-ttl", type=float, default=1.0,
                         help="leader lease TTL seconds for the failover "
                              "family (the recovery ceiling under test)")
+    parser.add_argument("--shard-cycles", type=int, default=60,
+                        help="churn cycles per shard per cell for the "
+                             "shard family")
+    parser.add_argument("--shard-ttl", type=float, default=1.5,
+                        help="per-shard lease TTL seconds for the shard "
+                             "family's blast-radius phase")
+    parser.add_argument("--shard-rtt-ms", type=float, default=40.0,
+                        help="modeled store write round trip for the "
+                             "shard family (an etcd-like regime; the "
+                             "per-shard writer serialization under test "
+                             "is invisible at MemoryKV microseconds)")
     parser.add_argument("--full", action="store_true",
                         help="also run the long-tail riders (the second "
                              "stream-count per serving point, unfused "

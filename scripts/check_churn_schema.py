@@ -456,6 +456,103 @@ def validate_trace(extra: dict) -> list[str]:
     return problems
 
 
+def validate_shard(extra: dict) -> list[str]:
+    """The sharded-writer-plane family headline payload. The speedup is
+    RECOMPUTED from the raw cell rates and every gate re-derived from its
+    inputs (not just ``gates.ok``): a cell that silently dropped cycles,
+    a speedup copied from stale arithmetic, or a blast-radius pass with
+    survivor failures must fail loudly at the schema layer too."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    if not (isinstance(it.get("cycles_per_cell"), int)
+            and it["cycles_per_cell"] >= 2):
+        problems.append(f"shard: iters.cycles_per_cell must be an int >= 2, "
+                        f"got {it.get('cycles_per_cell')!r}")
+    if not (isinstance(it.get("clients"), int) and it["clients"] >= 1):
+        problems.append(f"shard: iters.clients must be an int >= 1, "
+                        f"got {it.get('clients')!r}")
+    if not (isinstance(extra.get("shard_count"), int)
+            and extra["shard_count"] >= 2):
+        problems.append(f"shard: shard_count must be an int >= 2, got "
+                        f"{extra.get('shard_count')!r}")
+    cells = extra.get("cells") or {}
+    rates: dict[str, float] = {}
+    for cell in ("one_shard", "sharded"):
+        c = cells.get(cell) or {}
+        for key in ("cycles", "wall_s", "cycles_per_s"):
+            if not _num(c.get(key)) or c[key] <= 0:
+                problems.append(f"shard: cells.{cell}.{key} must be a "
+                                f"positive number, got {c.get(key)!r}")
+        if not isinstance(c.get("errors"), list):
+            problems.append(f"shard: cells.{cell}.errors must be a list")
+        if _num(c.get("cycles_per_s")):
+            rates[cell] = c["cycles_per_s"]
+    one, sh = cells.get("one_shard") or {}, cells.get("sharded") or {}
+    if _num(one.get("cycles")) and _num(sh.get("cycles")) \
+            and one["cycles"] != sh["cycles"]:
+        problems.append(f"shard: cells churned different totals "
+                        f"({one['cycles']} vs {sh['cycles']}) — the "
+                        f"speedup compares unequal work")
+    gates = extra.get("gates") or {}
+    for key in ("speedup_min", "speedup_ok", "cells_error_free",
+                "survivors_zero_failures", "survivor_p95_budget_ms",
+                "survivor_p95_ok", "recovery_budget_ms",
+                "victim_recovered_in_budget", "ok"):
+        if key not in gates:
+            problems.append(f"shard: gates.{key} missing")
+    speedup = extra.get("speedup")
+    if len(rates) == 2:
+        derived = rates["sharded"] / rates["one_shard"]
+        if not _num(speedup) or abs(speedup - derived) > 0.05 * derived:
+            problems.append(f"shard: speedup {speedup!r} does not match the "
+                            f"cell rates ({derived:.3f}) — stale arithmetic")
+        smin = gates.get("speedup_min")
+        if _num(smin) and bool(gates.get("speedup_ok")) \
+                != (derived >= smin - 1e-9):
+            problems.append(f"shard: gates.speedup_ok "
+                            f"{gates.get('speedup_ok')!r} contradicts "
+                            f"derived speedup {derived:.3f} vs min {smin}")
+    errs_free = (isinstance(one.get("errors"), list) and not one["errors"]
+                 and isinstance(sh.get("errors"), list) and not sh["errors"])
+    if bool(gates.get("cells_error_free")) != errs_free:
+        problems.append(f"shard: gates.cells_error_free "
+                        f"{gates.get('cells_error_free')!r} contradicts the "
+                        f"cell error lists")
+    blast = extra.get("blast_radius") or {}
+    surv = blast.get("survivor") or {}
+    if not (isinstance(surv.get("requests"), int) and surv["requests"] >= 1):
+        problems.append(f"shard: blast_radius.survivor.requests must be an "
+                        f"int >= 1, got {surv.get('requests')!r} — the "
+                        f"survivors were never driven")
+    fails = surv.get("failures")
+    if not isinstance(fails, int) or bool(
+            gates.get("survivors_zero_failures")) != (fails == 0):
+        problems.append(f"shard: gates.survivors_zero_failures "
+                        f"{gates.get('survivors_zero_failures')!r} "
+                        f"contradicts survivor failures {fails!r}")
+    p95, p95_budget = surv.get("p95_ms"), gates.get("survivor_p95_budget_ms")
+    if not _num(p95) or not _num(p95_budget) or bool(
+            gates.get("survivor_p95_ok")) != (p95 <= p95_budget):
+        problems.append(f"shard: gates.survivor_p95_ok "
+                        f"{gates.get('survivor_p95_ok')!r} contradicts "
+                        f"survivor p95 {p95!r} vs budget {p95_budget!r}")
+    rec, rec_budget = blast.get("recovery_ms"), gates.get("recovery_budget_ms")
+    if not _num(rec) or not _num(rec_budget) or bool(
+            gates.get("victim_recovered_in_budget")) != (rec <= rec_budget):
+        problems.append(f"shard: gates.victim_recovered_in_budget "
+                        f"{gates.get('victim_recovered_in_budget')!r} "
+                        f"contradicts recovery {rec!r}ms vs budget "
+                        f"{rec_budget!r}ms")
+    sub = ("speedup_ok", "cells_error_free", "survivors_zero_failures",
+           "survivor_p95_ok", "victim_recovered_in_budget")
+    if bool(gates.get("ok")) != all(gates.get(k) is True for k in sub):
+        problems.append(f"shard: gates.ok {gates.get('ok')!r} contradicts "
+                        f"its sub-gates {dict((k, gates.get(k)) for k in sub)}")
+    if gates.get("ok") is not True:
+        problems.append(f"shard: regression gate failed: {gates}")
+    return problems
+
+
 def validate_lines(lines: list[dict]) -> list[str]:
     """Return every schema violation found (empty = consumable)."""
     problems: list[str] = []
@@ -493,12 +590,16 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "scale"]
     if scale:
         return problems + validate_scale(scale[0]["extra"])
+    shard = [ln for ln in lines
+             if (ln.get("extra") or {}).get("family") == "shard"]
+    if shard:
+        return problems + validate_shard(shard[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
         return problems + ["no churn, failover, reads, fanout, preempt, "
-                           "resize, serve-scale or scale headline line "
-                           "(extra.family)"]
+                           "resize, serve-scale, scale or shard headline "
+                           "line (extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

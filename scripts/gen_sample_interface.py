@@ -217,6 +217,14 @@ def main() -> None:
          "replicated fleet one daemon reports `leader` and the rest "
          "`standby` (standbys answer mutations with 503 + the holder as "
          "redirect hint — see docs/robustness.md \"HA control plane\").")
+    call("GET", "/api/v1/shards", None,
+         "Sharded writer plane map (`shard_count` shards, each its own "
+         "lease + fencing epoch — docs/robustness.md \"Sharded writer "
+         "plane\"). Unsharded deployments answer with one implicit shard; "
+         "a sharded fleet lists every shard's heartbeat-observed holder, "
+         "epoch, deadline and advertise address, and mutations for a "
+         "family another shard owns 503 with that shard's holder as the "
+         "redirect hint.")
     emit("`GET /metrics` serves Prometheus text format (request counts, "
          "latency histograms, chip/port/queue gauges).")
 

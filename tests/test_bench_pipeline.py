@@ -565,6 +565,68 @@ class TestScaleFamily:
         assert scale["compact"]["trimmedTotal"] > 0
 
 
+class TestShardFamily:
+    """The sharded-writer-plane family (``make bench-shard``) at tiny
+    scale — pinning the artifact schema (scripts/check_churn_schema.py)
+    and the tentpole invariants: a 3-shard fleet over one shared store
+    out-churns the single-leader daemon (the partitioned version-lock
+    mechanism — the tiny cell gates a reduced floor; the make target's
+    default run self-gates the full 2.2x), every cell is error-free,
+    and hard-killing one shard's leader mid-load leaves the survivors
+    unharmed while the victim's keyspace recovers within the TTL-bounded
+    budget on a survivor."""
+
+    @pytest.fixture(scope="class")
+    def shard(self):
+        return bench.measure_control_plane_shard(
+            n_cycles=8, ttl_s=1.2, store_rtt_ms=30.0, clients=16,
+            speedup_min=1.5)
+
+    def test_schema_checker_accepts_the_emitted_line(self, shard):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_shard_churn_speedup",
+                "value": shard["speedup"], "unit": "x",
+                "vs_baseline": 1.0, "extra": shard}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... a speedup that contradicts the raw cell rates must fail
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["speedup"] = 99.0
+        assert any("stale arithmetic" in p for p in validate_lines([bad]))
+        # ... hidden survivor failures must fail
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["blast_radius"]["survivor"]["failures"] = 7
+        assert any("survivor failures" in p for p in validate_lines([bad]))
+        # ... and a blast phase that never drove the survivors is vacuous
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["blast_radius"]["survivor"]["requests"] = 0
+        assert any("never driven" in p for p in validate_lines([bad]))
+
+    def test_shard_gates_hold(self, shard):
+        gates = shard["gates"]
+        assert gates["ok"] is True
+        # the tentpole: partitioning the writer plane buys real
+        # throughput over ONE shared store (reduced tiny-scale floor)
+        assert shard["speedup"] >= 1.5
+        assert gates["cells_error_free"] is True
+        # blast radius: one dead shard leader harms <= 1/N of the
+        # keyspace and nothing else
+        assert gates["survivors_zero_failures"] is True
+        assert gates["survivor_p95_ok"] is True
+        assert gates["victim_recovered_in_budget"] is True
+        assert shard["blast_radius"]["survivor"]["requests"] >= 1
+        assert shard["cells"]["one_shard"]["cycles"] \
+            == shard["cells"]["sharded"]["cycles"]
+
+
 @pytest.mark.slow
 def test_headline_prints_first_end_to_end():
     """Full subprocess run on CPU: line 1 is the backend-boot diagnostic

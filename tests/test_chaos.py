@@ -138,13 +138,21 @@ def test_case_matrix_covers_every_crash_point():
     # sides of the trim and the dirty-driven reconcile mid-pass
     assert {p for p, _ in COMPACTOR_CASES} == set(COMPACTOR_CRASH_POINTS)
     assert set(RECONCILE_CRASH_POINTS) == {RECONCILE_DIRTY_POINT}
+    # the shard chaos matrix (tests/test_shard.py TestShardChaos) kills
+    # shard leaders at every leader.* AND shard.coord.* point
+    from tests.test_shard import SHARD_CHAOS_POINTS
+    from tpu_docker_api.service.crashpoints import SHARD_CRASH_POINTS
+
+    assert (set(SHARD_CHAOS_POINTS)
+            == set(LEADER_CRASH_POINTS) | set(SHARD_CRASH_POINTS))
     # the service matrix (tests/test_service.py TestServiceChaos) kills
     # the daemon at every service.* lifecycle point
     from tpu_docker_api.service.crashpoints import SERVICE_CRASH_POINTS
 
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
-            | set(LEADER_CRASH_POINTS) | set(FANOUT_CRASH_POINTS)
+            | set(LEADER_CRASH_POINTS) | set(SHARD_CRASH_POINTS)
+            | set(FANOUT_CRASH_POINTS)
             | set(ADMISSION_CRASH_POINTS) | set(RESIZE_CRASH_POINTS)
             | set(SERVICE_CRASH_POINTS)
             | set(RECONCILE_CRASH_POINTS) | set(COMPACTOR_CRASH_POINTS)

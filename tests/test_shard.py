@@ -1,0 +1,626 @@
+"""Sharded writer plane (service/shard.py, docs/robustness.md "Sharded
+writer plane").
+
+Three layers of proof:
+
+1. Property tests on the shard map itself — assignment is deterministic
+   and total, a service colocates with its replica gangs, a
+   ``shard_count`` change moves only the minimal family set (rendezvous),
+   and raw store keys classify back to exactly one owner (or global).
+2. Router + coordination-record contracts — mutations are always gated by
+   the owning shard's lease (creates by body name, named routes by path,
+   non-family ops by shard 0) while reads are NEVER gated; a lost
+   coordination CAS is retried while benign and surfaces as a typed
+   GuardFailed when genuinely contended; a lost shard FENCE is never
+   retried.
+3. The shard chaos matrix — two real Programs over one KV; the leader of
+   a shard portfolio is killed at every ``leader.*`` and ``shard.coord.*``
+   crash point; the survivor's shards never block, the victim's shards
+   recover within one lease TTL with exactly-once journal replay, and
+   every write the deposed leader still attempts is fenced by the store.
+
+Plus the compatibility pins: ``shard_count=1`` / ``leader_election=false``
+deployments must keep today's key layout and record bytes exactly.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_docker_api import config as config_mod, errors
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.schemas.container import Bind, ContainerPort, ContainerRun
+from tpu_docker_api.service.crashpoints import (
+    LEADER_CRASH_POINTS,
+    SHARD_CRASH_POINTS,
+    SimulatedCrash,
+    armed,
+)
+from tpu_docker_api.service.invariants import check_invariants
+from tpu_docker_api.service.shard import ShardMap, ShardedKV, ShardPlane, coord_seq
+from tpu_docker_api.state import keys
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.state.kv import MemoryKV
+
+pytestmark = pytest.mark.chaos
+
+#: the matrix this module drives — pinned against the registry by
+#: tests/test_chaos.py::test_case_matrix_covers_every_crash_point
+SHARD_CHAOS_POINTS = LEADER_CRASH_POINTS + SHARD_CRASH_POINTS
+
+
+def base_for_shard(smap: ShardMap, shard: int, tag: str = "f") -> str:
+    """A family base name owned by ``shard`` under ``smap`` (deterministic
+    scan, so tests never hardcode hash outputs)."""
+    for i in range(10_000):
+        name = f"{tag}{i}"
+        if smap.shard_of(name) == shard:
+            return name
+    raise AssertionError(f"no base found for shard {shard}")
+
+
+# -- 1. shard-map properties ---------------------------------------------------
+
+
+class TestShardMap:
+    def test_assignment_is_stable_total_and_single_shard_degenerate(self):
+        m3a, m3b = ShardMap(3), ShardMap(3)
+        for i in range(300):
+            base = f"fam{i}"
+            s = m3a.shard_of(base)
+            assert 0 <= s < 3
+            # deterministic across instances (and therefore processes)
+            assert s == m3b.shard_of(base)
+        # shard_count=1 is the degenerate total function: everything is 0
+        m1 = ShardMap(1)
+        assert {m1.shard_of(f"fam{i}") for i in range(300)} == {0}
+
+    def test_service_and_replica_gangs_colocate(self):
+        m = ShardMap(5)
+        for svc in ("api", "frontend", "ranker7"):
+            home = m.shard_of(svc)
+            for r in range(8):
+                assert m.shard_of(f"{svc}.r{r}") == home
+
+    def test_count_change_moves_only_the_minimal_family_set(self):
+        roots = [f"fam{i}" for i in range(400)]
+        m3 = ShardMap(3)
+        moved = m3.moved_families(roots, 4)
+        # rendezvous: growing 3 → 4 moves ~1/4 of roots, and every mover
+        # goes TO the new shard (an old shard can never newly win a
+        # contest it already lost)
+        assert len(moved) / len(roots) < 0.45
+        m4 = ShardMap(4)
+        for r in moved:
+            assert m4.shard_of(r) == 3
+        # the families that stayed kept their exact shard
+        for r in set(roots) - set(moved):
+            assert m4.shard_of(r) == m3.shard_of(r)
+        # shrinking back is the inverse: the SAME set moves, nothing else
+        assert sorted(m4.moved_families(roots, 3)) == sorted(moved)
+
+    def test_key_classification_round_trips_the_layout(self):
+        m = ShardMap(3)
+        s_train = m.shard_of("train")
+        # family keys classify to the family's shard
+        assert m.shard_of_key("/apis/v1/containers/train/latest") == s_train
+        assert m.shard_of_key(
+            "/apis/v1/containers/train/v/0000000001") == s_train
+        assert m.shard_of_key("/apis/v1/jobs/train/latest") == s_train
+        # queue + admission: flat = shard 0, s<i>/ = shard i
+        assert m.shard_of_key(keys.queue_task_key(7)) == 0
+        assert m.shard_of_key(keys.queue_task_key(7, 2)) == 2
+        assert m.shard_of_key(keys.queue_marker_key("t1", 1)) == 1
+        assert m.shard_of_key(keys.admission_record_key(3)) == 0
+        assert m.shard_of_key(keys.admission_record_key(3, 2)) == 2
+        # versions: legacy singleton = shard 0, shard subkeys = shard i
+        assert m.shard_of_key(keys.VERSIONS_CONTAINER_KEY) == 0
+        assert m.shard_of_key(
+            keys.versions_shard_key(keys.Resource.JOBS, 2)) == 2
+        # globals stay global: scheduler maps, cordons, leases, coord
+        for k in (keys.SCHEDULER_CHIPS_KEY, keys.SCHEDULER_PORTS_KEY,
+                  keys.HOSTS_CORDONED_KEY, keys.LEADER_LEASE_KEY,
+                  keys.shard_lease_key(1), keys.SHARD_COORD_KEY):
+            assert m.shard_of_key(k) is None, k
+
+    def test_shard_zero_owns_every_legacy_key(self):
+        """The migration-free adoption pin: a shard_count bump must read
+        an existing single-leader store as shard 0's keyspace."""
+        assert keys.shard_lease_key(0) == keys.LEADER_LEASE_KEY
+        assert keys.shard_epoch_key(0) == keys.LEADER_EPOCH_KEY
+        assert keys.queue_tasks_prefix(0) == keys.QUEUE_TASKS_PREFIX
+        assert keys.queue_markers_prefix(0) == keys.QUEUE_MARKERS_PREFIX
+        assert keys.admission_prefix(0) == keys.ADMISSION_PREFIX
+        assert (keys.versions_shard_key(keys.Resource.CONTAINERS, 0)
+                == keys.VERSIONS_CONTAINER_KEY)
+
+
+# -- 2a. router: mutations always routed, reads never -------------------------
+
+
+class TestMutationRouting:
+    def _plane(self, count=3):
+        import types
+
+        return types.SimpleNamespace(map=ShardMap(count))
+
+    def test_family_mutations_route_by_name(self):
+        from tpu_docker_api.api.app import _shard_for_request
+
+        plane = self._plane()
+        m = plane.map
+        for res, field in (("containers", "containerName"),
+                           ("volumes", "volumeName"),
+                           ("jobs", "jobName"),
+                           ("services", "serviceName")):
+            # named routes: the (version-stripped) path segment decides
+            assert _shard_for_request(
+                plane, f"/api/v1/{res}/train-3/stop", b"") \
+                == m.shard_of("train")
+            assert _shard_for_request(
+                plane, f"/api/v1/{res}/train", b"") == m.shard_of("train")
+            # creates: the body's *Name field decides
+            raw = json.dumps({field: "webapp"}).encode()
+            assert _shard_for_request(
+                plane, f"/api/v1/{res}", raw) == m.shard_of("webapp")
+
+    def test_non_family_mutations_belong_to_shard_zero(self):
+        from tpu_docker_api.api.app import _shard_for_request
+
+        plane = self._plane()
+        for path in ("/api/v1/hosts/h1/cordon", "/api/v1/hosts/h1/drain",
+                     "/api/v1/reconcile", "/api/v1/dead-letters/retry",
+                     "/api/v1/compact"):
+            assert _shard_for_request(plane, path, b"") == 0
+        # unparsable / nameless creates classify to 0 so the handler's own
+        # validation error surfaces (never masked by a wrong-shard 503)
+        assert _shard_for_request(plane, "/api/v1/containers", b"{nope") == 0
+        assert _shard_for_request(plane, "/api/v1/containers", b"{}") == 0
+
+    def test_reads_are_never_gated_and_wrong_shard_mutations_503(self):
+        """In-process HTTP round trip: a process holding only SOME shards
+        serves every read, owns its shards' mutations, and 503s the rest
+        with the owning shard named — zero store reads on the 503 path."""
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        clock = {"now": 100.0}
+        smap = ShardMap(3)
+        alpha = boot_shard(kv, rt, "alpha", clock, preferred=(0, 1))
+        beta = boot_shard(kv, rt, "beta", clock, preferred=(2,))
+        try:
+            alpha.start()
+            beta.start()
+            wait_until(lambda: sorted(alpha.shard_plane.held) == [0, 1],
+                       what="alpha holding shards 0,1")
+            wait_until(lambda: sorted(beta.shard_plane.held) == [2],
+                       what="beta holding shard 2")
+
+            mine = base_for_shard(smap, 0)
+            theirs = base_for_shard(smap, 2)
+            # owned mutation lands
+            status, out = http_call(
+                alpha, "POST", "/api/v1/containers",
+                {"imageName": "jax", "containerName": mine, "chipCount": 0})
+            assert (status, out["code"]) == (200, 200)
+            # wrong-shard mutation: 503 naming the owning shard + holder,
+            # counted with zero store reads once the heartbeat has
+            # observed the owning lease (the PR 7 hint contract per shard)
+            wait_until(lambda: alpha.shard_plane.electors[2]
+                       .leader_hint()["holderId"] == "beta",
+                       what="alpha observing beta's shard-2 lease")
+            reads = count_reads(kv)
+            status, out = http_call(
+                alpha, "POST", "/api/v1/containers",
+                {"imageName": "jax", "containerName": theirs, "chipCount": 0})
+            assert status == 503
+            assert out["code"] == errors.NotLeader.code
+            assert "shard 2" in out["msg"] and "beta" in out["msg"]
+            assert reads() == 0
+            assert alpha.container_versions.get(theirs) is None
+            # reads are never routed: any process answers any family
+            status, out = http_call(
+                beta, "GET", f"/api/v1/containers/{mine}-0")
+            assert (status, out["code"]) == (200, 200)
+            # the shard table is public and store-read-free
+            _, out = http_call(alpha, "GET", "/api/v1/shards")
+            view = out["data"]
+            assert view["sharded"] is True
+            assert view["shardCount"] == 3
+            assert view["held"] == [0, 1]
+            holders = {s["shard"]: s["holderId"] for s in view["shards"]}
+            assert holders == {0: "alpha", 1: "alpha", 2: "beta"}
+            _, out = http_call(alpha, "GET", "/healthz")
+            assert out["data"]["role"] == "leader"
+            assert out["data"]["shards"] == {"count": 3, "held": [0, 1]}
+            # leadership events are in the merged ring, shard-stamped
+            _, out = http_call(alpha, "GET", "/api/v1/events")
+            acquired = [e for e in out["data"]
+                        if e.get("event") == "shard-acquired"]
+            assert {e["shard"] for e in acquired} == {0, 1}
+        finally:
+            alpha.stop()
+            beta.stop()
+
+
+# -- 2b. cross-shard coordination record --------------------------------------
+
+
+class _StaleCoordKV(MemoryKV):
+    """get_or returns a stale coordination seq the first ``n`` times —
+    the deterministic stand-in for another shard leader winning the CAS
+    between our read and our apply."""
+
+    def __init__(self, stale_reads: int) -> None:
+        super().__init__()
+        self.stale_left = stale_reads
+
+    def get_or(self, key, default=None):
+        val = super().get_or(key, default)
+        if key == keys.SHARD_COORD_KEY and self.stale_left > 0:
+            self.stale_left -= 1
+            return None if val is None else json.dumps({"seq": -1})
+        return val
+
+
+class TestCoordinationRecord:
+    def _plane(self, kv, count=3):
+        plane = ShardPlane(kv, ShardMap(count), "me", ttl_s=30.0,
+                           clock=lambda: 100.0)
+        plane.step_all()
+        assert plane.held == frozenset(range(count))
+        return plane
+
+    def _two_shard_ops(self, smap):
+        a = base_for_shard(smap, 0)
+        b = base_for_shard(smap, 1)
+        return [("put", keys.latest_key(keys.Resource.CONTAINERS, a), "1"),
+                ("put", keys.latest_key(keys.Resource.CONTAINERS, b), "1")]
+
+    def test_cross_shard_batches_bump_the_seq_single_shard_do_not(self):
+        kv = MemoryKV()
+        plane = self._plane(kv)
+        skv = ShardedKV(kv, plane)
+        smap = plane.map
+        assert coord_seq(kv) == 0
+        # single-shard batch: no coordination involved
+        a = base_for_shard(smap, 0)
+        skv.apply([("put", keys.latest_key(keys.Resource.CONTAINERS, a), "0")])
+        assert coord_seq(kv) == 0
+        # two shards: one atomic apply carries the seq bump
+        skv.apply(self._two_shard_ops(smap))
+        assert coord_seq(kv) == 1
+        # shard + global singleton: also coordinated
+        skv.apply([
+            ("put", keys.latest_key(keys.Resource.CONTAINERS, a), "0"),
+            ("put", keys.SCHEDULER_CHIPS_KEY, "{}"),
+        ])
+        assert coord_seq(kv) == 2
+        # a pure global-singleton batch (a chip claim) coordinates too:
+        # several shard leaders write the ledgers concurrently, and the
+        # CAS is what serializes them
+        skv.apply([("put", keys.SCHEDULER_CHIPS_KEY, "{}")])
+        assert coord_seq(kv) == 3
+
+    @staticmethod
+    def _real_seq(kv) -> int:
+        # bypass the stale-read shim: the store's actual record
+        raw = MemoryKV.get_or(kv, keys.SHARD_COORD_KEY)
+        return json.loads(raw)["seq"] if raw else 0
+
+    def test_benign_cas_loss_is_retried_to_success(self):
+        kv = _StaleCoordKV(stale_reads=3)
+        kv.put(keys.SHARD_COORD_KEY, json.dumps({"seq": 5}, sort_keys=True))
+        plane = self._plane(kv)
+        skv = ShardedKV(kv, plane)
+        ops = self._two_shard_ops(plane.map)
+        skv.apply(ops)  # three lost races, then the re-read wins
+        assert kv.stale_left == 0
+        assert self._real_seq(kv) == 6
+        assert kv.get(ops[0][1]) == "1"
+
+    def test_contended_past_budget_is_a_typed_guard_failed(self):
+        kv = _StaleCoordKV(stale_reads=10_000)
+        kv.put(keys.SHARD_COORD_KEY, json.dumps({"seq": 5}, sort_keys=True))
+        plane = self._plane(kv)
+        skv = ShardedKV(kv, plane)
+        ops = self._two_shard_ops(plane.map)
+        with pytest.raises(errors.GuardFailed) as ei:
+            skv.apply(ops)
+        assert "coordination record" in str(ei.value)
+        # the loser left nothing behind
+        assert kv.get_or(ops[0][1]) is None
+        assert self._real_seq(kv) == 5
+
+    def test_fence_loss_is_never_retried_as_contention(self):
+        """A deposed shard leader's cross-shard batch must surface the
+        FENCE failure (and leave the seq unbumped) — retrying it as benign
+        contention would be split-brain with extra steps."""
+        kv = MemoryKV()
+        clock = {"now": 100.0}
+        plane_a = ShardPlane(kv, ShardMap(3), "a", ttl_s=30.0,
+                             clock=lambda: clock["now"])
+        plane_a.step_all()
+        skv_a = ShardedKV(kv, plane_a)
+        # b steals every shard after the TTL
+        clock["now"] += 31.0
+        plane_b = ShardPlane(kv, ShardMap(3), "b", ttl_s=30.0,
+                             clock=lambda: clock["now"])
+        plane_b.step_all()
+        assert plane_b.held == frozenset({0, 1, 2})
+        ops = TestCoordinationRecord._two_shard_ops(self, plane_a.map)
+        with pytest.raises(errors.GuardFailed) as ei:
+            skv_a.apply(ops)
+        assert keys.SHARD_COORD_KEY not in str(ei.value)
+        assert coord_seq(kv) == 0
+        assert kv.get_or(ops[0][1]) is None
+        # the rightful holder's identical batch sails
+        ShardedKV(kv, plane_b).apply(ops)
+        assert coord_seq(kv) == 1
+
+
+# -- compatibility pins --------------------------------------------------------
+
+
+class TestSingleShardCompat:
+    def test_task_and_admission_records_omit_shard_zero(self):
+        """Byte-for-byte pin: shard-0 (and therefore every unsharded)
+        record serializes exactly as before the sharded plane existed."""
+        from tpu_docker_api.service.admission import AdmissionRecord
+        from tpu_docker_api.state.workqueue import TaskRecord
+
+        rec = TaskRecord(task_id="t", kind="put_kv", params={}, seq=1)
+        assert "shard" not in json.loads(rec.to_json())
+        assert TaskRecord.from_json(rec.to_json()).shard == 0
+        rec2 = TaskRecord(task_id="t", kind="put_kv", params={}, seq=1,
+                          shard=2)
+        assert json.loads(rec2.to_json())["shard"] == 2
+        adm = AdmissionRecord(seq=1, base="b", kind="queued",
+                              klass="batch")
+        assert "shard" not in json.loads(adm.to_json())
+        assert AdmissionRecord.from_json(adm.to_json()).shard == 0
+
+    def test_unsharded_store_carries_no_shard_artifacts(self, tmp_path):
+        """leader_election=false (and implicitly shard_count=1): the store
+        a workload produces contains no shard leases, no coordination
+        record, no sub-prefixed journal keys — today's layout exactly."""
+        kv = MemoryKV()
+        rt = FakeRuntime(root=str(tmp_path / "rt"))
+        cfg = config_mod.Config(
+            store_backend="memory", runtime_backend="fake",
+            health_watch_interval=0, host_probe_interval_s=0,
+            job_supervise_interval=0, reconcile_interval=0)
+        prg = Program(cfg, kv=kv, runtime=rt)
+        prg.init()
+        assert prg.shard_plane is None and prg.shard_map is None
+        prg.container_svc.run_container(ContainerRun(
+            image_name="jax", container_name="web", chip_count=2,
+            container_ports=[ContainerPort(8080)]))
+        store = kv.range_prefix("/")
+        assert not any("/leader/" in k for k in store)
+        assert not any("/queue/tasks/s" in k or "/queue/markers/s" in k
+                       or "/admission/s" in k or "/versions/shards/" in k
+                       for k in store)
+        for k, v in store.items():
+            if k.startswith(keys.QUEUE_TASKS_PREFIX):
+                assert "shard" not in json.loads(v)
+
+
+# -- 3. the shard chaos matrix -------------------------------------------------
+
+
+def boot_shard(kv, runtime, holder, clock, preferred=(),
+               shard_count=3) -> Program:
+    """A sharded fleet member over the shared KV + runtime: three leases,
+    writer loops follow the shard portfolio, virtual clock drives TTL
+    expiry. Elector threads are never started unless the test calls
+    ``start()`` — the matrix steps them by hand."""
+    cfg = config_mod.Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099, host_probe_interval_s=0,
+        job_supervise_interval=0, reconcile_interval=0,
+        leader_election=True, leader_ttl_s=30.0, leader_id=holder,
+        leader_renew_interval_s=0.05,
+        shard_count=shard_count, shard_preferred=list(preferred),
+        shard_standby_delay_s=50.0,
+    )
+    prg = Program(cfg, host="127.0.0.1", kv=kv, runtime=runtime,
+                  leader_clock=lambda: clock["now"])
+    prg.init()
+    return prg
+
+
+def step_fleet(*progs):
+    for p in progs:
+        p.shard_plane.step_all()
+
+
+def http_call(prg, method, path, body=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{prg.api_server.port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def wait_until(fn, timeout_s=10.0, what="condition"):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.01)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def count_reads(kv):
+    """Returns a closure reporting how many get/range calls hit ``kv``
+    since construction (the zero-store-reads 503 pin)."""
+    calls = {"n": 0}
+    real_get, real_range = kv.get_or, kv.range_prefix
+
+    def get_or(key, default=None):
+        calls["n"] += 1
+        return real_get(key, default)
+
+    def range_prefix(prefix):
+        calls["n"] += 1
+        return real_range(prefix)
+
+    kv.get_or, kv.range_prefix = get_or, range_prefix
+    start = calls["n"]
+    return lambda: calls["n"] - start
+
+
+class TestShardChaos:
+    """Kill a shard-portfolio leader at every election and coordination
+    crash point. Throughout: the SURVIVING shard's writes never block, the
+    victim shards recover within one lease TTL, journal replay is
+    exactly-once, and the deposed leader is fenced out of everything it
+    no longer holds."""
+
+    @pytest.mark.parametrize("point", SHARD_CHAOS_POINTS)
+    def test_shard_leader_killed_survivors_unblocked_victim_recovers(
+            self, tmp_path, point):
+        kv = MemoryKV()
+        runtime = FakeRuntime(root=str(tmp_path / "rt"))
+        clock = {"now": 1000.0}
+        smap = ShardMap(3)
+
+        # beta: the survivor — holds shard 2 only (its preferred), defers
+        # the vacant rest long enough for alpha to claim them
+        beta = boot_shard(kv, runtime, "beta", clock, preferred=(2,))
+        step_fleet(beta)
+        assert sorted(beta.shard_plane.held) == [2]
+
+        # a PREVIOUS unsharded incarnation left an interrupted rolling
+        # replace: train-1 created, the copy+start record journaled (flat
+        # prefix ⇒ shard 0's journal) but never executed
+        seed = unsharded_seed(kv, runtime, tmp_path)
+
+        alpha = boot_shard(kv, runtime, "alpha", clock, preferred=(0, 1))
+        if point == "leader.after_renew":
+            # an ESTABLISHED portfolio: acquire 0+1 cleanly (replaying the
+            # seed under alpha's epochs), then die right after a renewal
+            step_fleet(alpha)
+            assert sorted(alpha.shard_plane.held) == [0, 1]
+            clock["now"] += 10.0
+            with armed(point):
+                with pytest.raises(SimulatedCrash):
+                    alpha.shard_plane.step_all()
+        elif point.startswith("leader."):
+            # dies mid-acquire of shard 0: the lease is durable; for
+            # after_acquire the takeover callbacks (journal replay) never
+            # ran, for after_start_writers they completed
+            with armed(point):
+                with pytest.raises(SimulatedCrash):
+                    alpha.shard_plane.step_all()
+            assert alpha.shard_plane.electors[0].epoch >= 1
+        else:
+            # shard.coord.*: alpha acquires its shards, then dies INSIDE a
+            # cross-shard apply — a chip-claiming create is family keys +
+            # the global chip map, so it coordinates
+            step_fleet(alpha)
+            assert sorted(alpha.shard_plane.held) == [0, 1]
+            victim_base = base_for_shard(smap, 1, tag="coordfam")
+            with armed(point):
+                with pytest.raises(SimulatedCrash):
+                    alpha.container_svc.run_container(ContainerRun(
+                        image_name="jax", container_name=victim_base,
+                        chip_count=2))
+
+        # the survivor's shard never blocks: while alpha's leases are
+        # still live (and alpha is dead), beta keeps writing to shard 2
+        survivor_base = base_for_shard(smap, 2, tag="live")
+        out = beta.container_svc.run_container(ContainerRun(
+            image_name="jax", container_name=survivor_base, chip_count=0))
+        assert out["name"] == f"{survivor_base}-0"
+
+        # beta steals alpha's shards at the first step past the deadline
+        # (≤ TTL) — and past its standby delay for the never-acquired ones
+        deadlines = [
+            json.loads(kv.get(keys.shard_lease_key(i)))["deadline"]
+            for i in range(2) if kv.get_or(keys.shard_lease_key(i))]
+        assert deadlines, "alpha died without any durable lease"
+        assert max(deadlines) - clock["now"] <= beta.cfg.leader_ttl_s
+        clock["now"] = max(deadlines + [clock["now"] + 50.0]) + 0.001
+        step_fleet(beta)
+        assert sorted(beta.shard_plane.held) == [0, 1, 2]
+
+        # exactly-once: the interrupted replace converged forward — one
+        # live version, checkpoint data carried, zero leaked chips/ports
+        problems = check_invariants(
+            runtime, beta.store, beta.container_versions,
+            beta.chip_scheduler, beta.port_scheduler)
+        assert problems == [], f"{point}: {problems}"
+        assert beta.container_versions.get(seed) == 1
+        running = [n for n in runtime.container_list()
+                   if runtime.container_inspect(n).running
+                   and n.startswith(seed)]
+        assert running == [f"{seed}-1"]
+        with open(f"{runtime.container_data_dir(seed + '-1')}/ckpt.txt") as f:
+            assert f.read() == "step=100"
+        stats = beta.wq.stats()
+        assert stats["journal"]["pending"] == 0
+        assert stats["journal"]["inflight"] == 0
+        # the repair is a fixpoint
+        assert beta.reconciler.reconcile()["actions"] == []
+
+        # the deposed leader still believes in its shards; the store does
+        # not. Single-shard puts, cross-shard applies, and writes to
+        # never-held shards all lose their compare
+        store_before = dict(kv.range_prefix("/"))
+        fam0 = keys.latest_key(keys.Resource.CONTAINERS,
+                               base_for_shard(smap, 0, tag="probe"))
+        fam1 = keys.latest_key(keys.Resource.CONTAINERS,
+                               base_for_shard(smap, 1, tag="probe"))
+        fam2 = keys.latest_key(keys.Resource.CONTAINERS,
+                               base_for_shard(smap, 2, tag="probe"))
+        for ops in ([("put", fam0, "stale")],
+                    [("put", fam1, "stale"), ("put", fam0, "stale")],
+                    [("put", fam2, "stale")]):
+            with pytest.raises(errors.GuardFailed):
+                alpha.kv.apply(ops)
+        assert dict(kv.range_prefix("/")) == store_before
+        # ... while the new holder's writes (all three shards) sail
+        beta.kv.apply([("put", fam0, "fresh"), ("put", fam1, "fresh")])
+        assert kv.get(fam0) == "fresh"
+
+        alpha.stop()
+        beta.stop()
+
+
+def unsharded_seed(kv, runtime, tmp_path) -> str:
+    """Seed the shared store with an interrupted rolling replace of family
+    ``train`` via a plain unsharded Program (its queue never runs, so the
+    copy+start record stays pending in shard 0's — the flat — journal).
+    Returns the family base."""
+    from tpu_docker_api.schemas.container import ContainerPatchChips
+
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099, host_probe_interval_s=0,
+        job_supervise_interval=0, reconcile_interval=0)
+    prg = Program(cfg, kv=kv, runtime=runtime)
+    prg.init()
+    (tmp_path / "v1").mkdir(exist_ok=True)
+    prg.container_svc.run_container(ContainerRun(
+        image_name="jax", container_name="train", chip_count=2,
+        container_ports=[ContainerPort(8080)],
+        binds=[Bind(str(tmp_path / "v1"), "/data")]))
+    with open(f"{runtime.container_data_dir('train-0')}/ckpt.txt", "w") as f:
+        f.write("step=100")
+    prg.container_svc.patch_container_chips(
+        "train", ContainerPatchChips(chip_count=4))
+    pending = kv.range_prefix(keys.QUEUE_TASKS_PREFIX)
+    assert pending, "seed produced no journaled intent"
+    return "train"

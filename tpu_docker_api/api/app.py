@@ -106,6 +106,39 @@ def _validate_ref_name(name: str) -> None:
         raise errors.BadRequest(f"invalid container/volume name {name!r}")
 
 
+#: resources whose mutation routes carry a family name (the shard unit)
+_FAMILY_SEGMENTS = frozenset(("containers", "volumes", "jobs", "services"))
+#: create bodies carry the family name under the resource's own field
+_CREATE_NAME_FIELDS = ("containerName", "volumeName", "jobName",
+                       "serviceName")
+
+
+def _shard_for_request(plane, path: str, raw: bytes) -> int:
+    """Owning shard for a mutation: family routes shard by the (version-
+    stripped) name in the path, creates by the name in the body; anything
+    else — host ops, reconcile, dead-letter retry — is shard 0. Unparsable
+    input classifies as shard 0 too: the gate must never mask the
+    validation error the handler would raise."""
+    from tpu_docker_api.state import keys
+
+    seg = path.split("/")
+    if len(seg) < 4 or seg[3] not in _FAMILY_SEGMENTS:
+        return 0
+    if len(seg) >= 5 and seg[4]:
+        base, _ = keys.split_versioned_name(seg[4])
+        return plane.map.shard_of(base)
+    try:
+        body = json.loads(raw) if raw else {}
+    except ValueError:
+        return 0
+    if isinstance(body, dict):
+        for field in _CREATE_NAME_FIELDS:
+            name = body.get(field)
+            if isinstance(name, str) and name:
+                return plane.map.shard_of(name)
+    return 0
+
+
 class Router:
     """Tiny method+pattern router; patterns use ``{name}`` segments. Carries
     its own metrics registry so each server instance exposes only its own
@@ -118,6 +151,9 @@ class Router:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         #: HA role gate; build_router sets it (None = no gating)
         self.leader_elector = None
+        #: sharded writer plane (service/shard.py); build_router sets it —
+        #: when present, the mutation gate routes per shard instead
+        self.shard_plane = None
         #: trace sink (telemetry/trace.py); build_router sets it (None =
         #: request tracing off)
         self.tracer = None
@@ -152,7 +188,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  health_watcher=None, metrics=None,
                  job_svc=None, pod_scheduler=None, reconciler=None,
                  job_supervisor=None, host_monitor=None,
-                 leader_elector=None, informer=None, fanout=None,
+                 leader_elector=None, shard_plane=None,
+                 informer=None, fanout=None,
                  admission=None, serving=None, compactor=None,
                  list_default_limit: int = 0,
                  list_max_limit: int = 5000,
@@ -191,6 +228,11 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     # stay local, mutations belong to the lease holder. None (single-
     # process, or election disabled) gates nothing.
     r.leader_elector = leader_elector
+    # sharded writer plane: same contract, per shard — a mutation is
+    # answered 503 + the OWNING shard's leader hint unless this process
+    # holds that shard's lease (api-layer routing is a redirect, never a
+    # proxy: the client retries against the advertised holder)
+    r.shard_plane = shard_plane
 
     # -- containers (reference api/container.go:19-38) ---------------------------
 
@@ -485,9 +527,18 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     def healthz(body, **_):
         # role surfaced next to liveness: load balancers route mutations by
         # it, and "single" keeps the no-election deployment unambiguous
-        role = ("single" if leader_elector is None
-                else ("leader" if leader_elector.is_leader else "standby"))
+        if shard_plane is not None:
+            held = sorted(shard_plane.held)
+            role = "leader" if held else "standby"
+        else:
+            role = ("single" if leader_elector is None
+                    else ("leader" if leader_elector.is_leader
+                          else "standby"))
         out = {"status": "ok", "role": role, **build_info()}
+        if shard_plane is not None:
+            # which slice of the writer plane this replica carries: load
+            # balancers shard mutations by it, operators eyeball spread
+            out["shards"] = {"count": shard_plane.map.count, "held": held}
         if informer is not None:
             # read-path health rides liveness: a standby whose informer is
             # degraded still serves (read-through fallback) but slower —
@@ -524,6 +575,17 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     r.add("GET", "/healthz", healthz)
 
     def leader_view(body, **_):
+        if shard_plane is not None:
+            # shard-aware: the single-lease fields generalize to the full
+            # per-shard table (satellite of docs/robustness.md "Sharded
+            # writer plane"); holder/epoch/deadline come from each
+            # elector's heartbeat-observed cache — zero store reads
+            out = shard_plane.status_view()
+            out["election"] = True
+            out["sharded"] = True
+            if informer is not None:
+                out["informer"] = informer.status_view()
+            return out
         if leader_elector is None:
             return {"election": False, "role": "single", "accepting": True,
                     "selfId": None, "holderId": None, "epoch": None,
@@ -535,8 +597,28 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         return out
 
     r.add("GET", "/api/v1/leader", leader_view)
+
+    def shards_view(body, **_):
+        if shard_plane is None:
+            # unsharded deployments still answer: one implicit shard whose
+            # lease state is the single elector's (or a bare single role)
+            out = {"sharded": False, "shardCount": 1,
+                   "held": [], "shards": []}
+            if leader_elector is not None:
+                sv = leader_elector.status_view()
+                sv["shard"] = 0
+                out["shards"] = [sv]
+                if leader_elector.is_leader:
+                    out["held"] = [0]
+            return out
+        out = shard_plane.status_view()
+        out["sharded"] = True
+        return out
+
+    r.add("GET", "/api/v1/shards", shards_view)
     if (health_watcher is not None or job_supervisor is not None
             or host_monitor is not None or leader_elector is not None
+            or shard_plane is not None
             or informer is not None or admission is not None
             or serving is not None or tracer is not None):
         # one events ring for the operator: container liveness transitions
@@ -568,8 +650,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             per_ring = 1 << 20 if trace_id else limit
             rings = [src.events_view(limit=per_ring)
                      for src in (health_watcher, job_supervisor,
-                                 host_monitor, leader_elector, informer,
-                                 admission, serving, tracer)
+                                 host_monitor, leader_elector, shard_plane,
+                                 informer, admission, serving, tracer)
                      if src is not None]
             merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
             if trace_id:
@@ -787,6 +869,19 @@ def build_handler(router: Router):
                     if (method != "GET" and elector is not None
                             and not elector.accepts_mutations):
                         raise errors.NotLeader(elector.standby_message())
+                    # sharded plane: the same gate per shard. The target
+                    # shard comes from the family name (path segment, or
+                    # the create body's *Name field) — zero store reads;
+                    # non-family mutations belong to shard 0, the
+                    # singleton-of-last-resort. Wrong shard ⇒ 503 naming
+                    # the OWNING shard's advertised holder (a redirect,
+                    # never a proxy).
+                    plane = router.shard_plane
+                    if method != "GET" and plane is not None:
+                        shard = _shard_for_request(plane, path, raw)
+                        if not plane.accepting(shard):
+                            raise errors.NotLeader(
+                                plane.standby_message(shard))
                     body = json.loads(raw) if raw else {}
                     if not isinstance(body, dict):
                         raise errors.BadRequest("body must be a JSON object")

@@ -321,6 +321,13 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
      "503 + this holder as the redirect hint. With read_cache=informer the "
      "watch-fed read-cache state rides along (synced, lastRev, watchLagMs, "
      "event/relist/cache-hit counters)", None),
+    ("GET", "/api/v1/shards", "getShards",
+     "Sharded writer plane map: every shard's lease holder, fencing epoch, "
+     "deadline and advertise address (heartbeat-observed — zero store "
+     "reads), plus which shards THIS replica holds. Mutations for a "
+     "family another shard owns 503 with that shard's holder as the "
+     "redirect hint. Unsharded deployments answer with one implicit "
+     "shard carrying the single election's state", None),
     ("GET", "/api/v1/admission", "getAdmissionQueue",
      "Capacity-market admission queue: depth, per-class counts, entry "
      "positions/skip budgets, the configured priority ladder, and the "

@@ -164,6 +164,20 @@ class Config:
     leader_renew_interval_s: float = 0.0
     # identity in the lease record; "" ⇒ hostname:pid
     leader_id: str = ""
+    # sharded writer plane (service/shard.py, docs/robustness.md "Sharded
+    # writer plane"; requires leader_election = true when > 1): partition
+    # the keyspace into this many shards, each with its own lease + epoch
+    # + writer loops, so one lease loss halts <= 1/N of the keyspace
+    # instead of every write. 1 (the default) keeps the single-lease
+    # PR 7 plane byte-for-byte — no shard keys, no coordination record.
+    shard_count: int = 1
+    # shards THIS replica should contest immediately at boot (by id);
+    # everything else waits shard_standby_delay_s before contesting a
+    # VACANT lease, so a fleet booting together spreads shards instead of
+    # the fastest process grabbing all of them. Expired leases are always
+    # contested immediately — failover never waits on this.
+    shard_preferred: list = dataclasses.field(default_factory=list)
+    shard_standby_delay_s: float = 0.0
     # standby read path (state/informer.py; only meaningful with
     # leader_election = true): "informer" (default) serves standby GETs
     # from a watch-fed local mirror — zero store round trips per request,
@@ -324,6 +338,24 @@ def load(path: str | None = None) -> Config:
     if cfg.trace_slow_ms < 0:
         raise ValueError(f"trace_slow_ms must be >= 0, "
                          f"got {cfg.trace_slow_ms}")
+    if isinstance(cfg.shard_count, bool) \
+            or not isinstance(cfg.shard_count, int) or cfg.shard_count < 1:
+        raise ValueError(
+            f"shard_count must be an integer >= 1, got {cfg.shard_count!r}")
+    if cfg.shard_count > 1 and not cfg.leader_election:
+        raise ValueError(
+            "shard_count > 1 requires leader_election = true "
+            "(each shard is a lease)")
+    if not isinstance(cfg.shard_preferred, list) or any(
+            isinstance(i, bool) or not isinstance(i, int)
+            or i < 0 or i >= cfg.shard_count
+            for i in cfg.shard_preferred):
+        raise ValueError(
+            f"shard_preferred must be a list of shard ids in "
+            f"[0, {cfg.shard_count - 1}], got {cfg.shard_preferred!r}")
+    if cfg.shard_standby_delay_s < 0:
+        raise ValueError(f"shard_standby_delay_s must be >= 0, "
+                         f"got {cfg.shard_standby_delay_s}")
     if cfg.autoscale_interval_s < 0:
         raise ValueError(f"autoscale_interval_s must be >= 0, "
                          f"got {cfg.autoscale_interval_s}")

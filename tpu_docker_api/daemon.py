@@ -85,7 +85,48 @@ class Program:
         )
         self._raw_kv = raw_kv
         self.leader_elector = None
-        if cfg.leader_election:
+        self.shard_plane = None
+        self.shard_map = None
+        #: serializes shard acquire/loss callbacks (each shard's elector
+        #: heartbeats on its own thread) against the shared writer loops
+        self._shard_mu = threading.Lock()
+        self._shard_writers_on = False
+        if cfg.leader_election and cfg.shard_count > 1:
+            # sharded writer plane (service/shard.py, docs/robustness.md
+            # "Sharded writer plane"): N leases instead of one. Every
+            # write batch is fenced on the epochs of exactly the shards
+            # it touches, cross-shard batches serialize through the
+            # coordination record, and the acquire/loss callbacks below
+            # start/stop the writer loops per shard-portfolio instead of
+            # per-lease. shard_count=1 never reaches this branch — the
+            # PR 7 single-elector path below stays byte-for-byte.
+            import os
+            import socket
+
+            from tpu_docker_api.service.shard import (ShardMap, ShardPlane,
+                                                      ShardedKV)
+
+            holder = cfg.leader_id or f"{socket.gethostname()}:{os.getpid()}"
+            plane_kwargs = {}
+            if self._injected_leader_clock is not None:
+                plane_kwargs["clock"] = self._injected_leader_clock
+            self.shard_map = ShardMap(cfg.shard_count)
+            # the plane rides the RAW store (lease writes carry their own
+            # CAS guards); callbacks resolve the subsystems built below
+            # lazily — electors only start in start()/step()
+            self.shard_plane = ShardPlane(
+                raw_kv, self.shard_map, holder,
+                ttl_s=cfg.leader_ttl_s,
+                renew_interval_s=cfg.leader_renew_interval_s or None,
+                advertise=f"{self.host}:{cfg.port}",
+                on_acquire=self._on_shard_acquire,
+                on_loss=self._on_shard_loss,
+                preferred=frozenset(cfg.shard_preferred),
+                defer_vacant_s=cfg.shard_standby_delay_s,
+                **plane_kwargs,
+            )
+            self.kv = ShardedKV(raw_kv, self.shard_plane)
+        elif cfg.leader_election:
             # HA fleet member: EVERY write this process issues — StoreTxn
             # commits, journal claim/ack, scheduler persists — carries an
             # epoch-fencing guard once the elector has held leadership, so
@@ -111,7 +152,8 @@ class Program:
         # pass through to self.kv byte-for-byte.
         self.informer = None
         read_kv = self.kv
-        if cfg.leader_election and cfg.read_cache == "informer":
+        if (cfg.leader_election and cfg.read_cache == "informer"
+                and self.shard_plane is None):
             from tpu_docker_api.state.informer import Informer, InformerReadKV
 
             self.informer = Informer(raw_kv, keys.PREFIX + "/",
@@ -141,12 +183,21 @@ class Program:
             if cfg.runtime_backend == "docker"
             else open_runtime("fake", allow_exec=True)
         )
+        wq_shard_kwargs = {}
+        if self.shard_plane is not None:
+            # journal records land in the owning shard's sub-prefix and
+            # replay/sweep only over shards this process leads
+            wq_shard_kwargs = {
+                "shard_fn": self._task_shard,
+                "owned_shards": lambda: self.shard_plane.held,
+            }
         self.wq = WorkQueue(
             self.kv,
             submit_timeout_s=cfg.queue_submit_timeout_s,
             close_deadline_s=cfg.queue_close_deadline_s,
             metrics=self.metrics,
             tracer=self.tracer,
+            **wq_shard_kwargs,
         )
         topology = self._discover_topology()
         self.chip_scheduler = ChipScheduler(topology, self.kv)
@@ -163,12 +214,31 @@ class Program:
             (lambda: self.leader_elector is not None
              and not self.leader_elector.is_leader)
             if cfg.leader_election else False)
-        self.container_versions = VersionMap(
-            read_kv, keys.VERSIONS_CONTAINER_KEY,
-            read_through=standby_read_through)
-        self.volume_versions = VersionMap(
-            read_kv, keys.VERSIONS_VOLUME_KEY,
-            read_through=standby_read_through)
+        if self.shard_plane is not None:
+            # per-shard version maps: each shard's snapshot persists at its
+            # own key (riding that shard's epoch fence), and reads on
+            # shards this process does NOT lead go read-through — the
+            # PR 7 leader/standby read contract applied per shard
+            from tpu_docker_api.state.version import ShardedVersionMap
+
+            def _svm(resource):
+                return ShardedVersionMap(read_kv, self.shard_map, resource,
+                                         self.shard_plane.is_leader)
+            self._make_versions = _svm
+        else:
+            _legacy_keys = {
+                keys.Resource.CONTAINERS: keys.VERSIONS_CONTAINER_KEY,
+                keys.Resource.VOLUMES: keys.VERSIONS_VOLUME_KEY,
+                keys.Resource.JOBS: keys.VERSIONS_JOB_KEY,
+                keys.Resource.SERVICES: keys.VERSIONS_SERVICE_KEY,
+            }
+
+            def _vm(resource):
+                return VersionMap(read_kv, _legacy_keys[resource],
+                                  read_through=standby_read_through)
+            self._make_versions = _vm
+        self.container_versions = self._make_versions(keys.Resource.CONTAINERS)
+        self.volume_versions = self._make_versions(keys.Resource.VOLUMES)
         self.container_svc = ContainerService(
             self.runtime, self.store, self.chip_scheduler, self.port_scheduler,
             self.container_versions, self.wq, libtpu_path=cfg.libtpu_path,
@@ -178,8 +248,7 @@ class Program:
         )
         self.pod = self._build_pod(topology)
         self.pod_scheduler = PodScheduler(self.pod, self.kv)
-        self.job_versions = VersionMap(read_kv, keys.VERSIONS_JOB_KEY,
-                                       read_through=standby_read_through)
+        self.job_versions = self._make_versions(keys.Resource.JOBS)
         if self.informer is not None:
             # standby version reads go fully watch-fed: zero store reads
             # AND zero JSON re-parses per request (the shadow updates on
@@ -205,6 +274,12 @@ class Program:
         # backfill); disabled keeps the legacy hard refusal byte-for-byte
         from tpu_docker_api.service.admission import AdmissionController
 
+        adm_shard_kwargs = {}
+        if self.shard_plane is not None:
+            adm_shard_kwargs = {
+                "shard_fn": self.shard_map.shard_of,
+                "owned_shards": lambda: self.shard_plane.held,
+            }
         self.admission = AdmissionController(
             self.job_svc, self.store, self.job_versions,
             self.pod_scheduler, self.kv,
@@ -215,6 +290,7 @@ class Program:
             interval_s=cfg.admission_interval_s,
             registry=self.metrics,
             tracer=self.tracer,
+            **adm_shard_kwargs,
         )
         self.job_svc.admission = self.admission
         # Service resource (service/serving.py): declarative replicated
@@ -222,9 +298,7 @@ class Program:
         # through the capacity market at the service's priority class
         from tpu_docker_api.service.serving import ServingService
 
-        self.service_versions = VersionMap(
-            read_kv, keys.VERSIONS_SERVICE_KEY,
-            read_through=standby_read_through)
+        self.service_versions = self._make_versions(keys.Resource.SERVICES)
         if self.informer is not None:
             self.service_versions.attach_informer(self.informer)
         self.serving = ServingService(
@@ -237,6 +311,7 @@ class Program:
             down_watermark=cfg.autoscale_down_watermark,
             registry=self.metrics,
             tracer=self.tracer,
+            owns=self._owns_or_none(),
         )
         # engine-pool saturation gauges: one set of books summed over the
         # distinct engines behind this pod (the local runtime is shared by
@@ -287,6 +362,7 @@ class Program:
             registry=self.metrics,
             host_monitor=self.host_monitor,
             fanout=self.fanout,
+            owns=self._owns_or_none(),
         )
         # job families allocate from the same local chip/port pools, so
         # their claims must be off-limits to the reconciler's leak sweep
@@ -314,6 +390,9 @@ class Program:
             serving=self.serving,
             full_interval_s=cfg.reconcile_full_interval_s,
             tracer=self.tracer,
+            owns=self._owns_or_none(),
+            owned_shards=(None if self.shard_plane is None
+                          else (lambda: self.shard_plane.held)),
         )
         # event-driven reconcile (ROADMAP item 4): feed the reconciler's
         # dirty-set from the store's watch stream so periodic passes are
@@ -354,6 +433,7 @@ class Program:
                        self.container_svc.family_lock,
                        keys.Resource.JOBS: self.job_svc.family_lock},
                 tracer=self.tracer,
+                owns=self._owns_or_none(),
             )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
@@ -379,7 +459,7 @@ class Program:
                 restart_backoff_max_s=cfg.restart_backoff_max_s,
                 registry=self.metrics,
             )
-        if cfg.leader_election:
+        if cfg.leader_election and self.shard_plane is None:
             import os
             import socket
 
@@ -412,6 +492,108 @@ class Program:
         for host in self.pod.hosts.values():
             host.chips.reload_from_store()
             host.ports.reload_from_store()
+
+    def _owns_or_none(self):
+        """Family-ownership filter handed to the writer loops: None in
+        unsharded mode (loops visit everything, today's behavior), else
+        the plane's lock-free owns() check."""
+        return None if self.shard_plane is None else self.shard_plane.owns
+
+    def _task_shard(self, kind: str, params: dict) -> int:
+        """WorkQueue shard classifier: journal a task under the shard
+        owning the family it mutates. Family-less tasks (raw put_kv) are
+        classified by their target key; anything global lands on shard 0,
+        the singleton-of-last-resort."""
+        base = params.get("base")
+        if base:
+            return self.shard_map.shard_of(base)
+        key = params.get("key")
+        if key:
+            shard = self.shard_map.shard_of_key(key)
+            return 0 if shard is None else shard
+        return 0
+
+    def _on_shard_acquire(self, shard: int, epoch: int) -> None:
+        """Shard-portfolio takeover. Per shard: reseed that shard's
+        version maps, drop its journal seq cache, then adopt + replay its
+        journal via a reconcile pass (exactly-once: markers + CAS claims,
+        same machinery as single-leader failover). Process-wide: the
+        writer loops start once, on the FIRST shard acquired — each loop
+        filters its families through plane.owns, so one set of threads
+        serves however many shards this process holds. Shard 0 is the
+        singleton-of-last-resort: its holder also runs the host monitor
+        and health watcher."""
+        with self._shard_mu:
+            for vm in (self.container_versions, self.volume_versions,
+                       self.job_versions, self.service_versions):
+                vm.reload_shard(shard)
+            self.wq.reset_shard_cache(shard)
+            self.admission.reset_seq_cache()
+            # global singletons (schedulers, cordons) may have moved under
+            # other shard leaders — or an earlier deployment — while we
+            # did not hold this slice; every acquire adopts keyspace we
+            # may never have observed, so reseed on every acquire
+            self.pod_scheduler.reload_from_store()
+            for host in self.pod.hosts.values():
+                host.chips.reload_from_store()
+                host.ports.reload_from_store()
+            if not self._shard_writers_on:
+                self.wq.start()
+                if self.cfg.reconcile_interval > 0:
+                    self.reconciler.start_periodic(self.cfg.reconcile_interval)
+                if self.cfg.job_supervise_interval > 0:
+                    self.job_supervisor.start()
+                if (self.cfg.admission_enabled
+                        and self.cfg.admission_interval_s > 0):
+                    self.admission.start()
+                if self.cfg.autoscale_interval_s > 0:
+                    self.serving.start()
+                if self.compactor is not None:
+                    self.compactor.start()
+                self._shard_writers_on = True
+            if shard == 0:
+                if self.host_monitor is not None:
+                    self.host_monitor.start()
+                if self.health_watcher is not None:
+                    self.health_watcher.start()
+        if self.cfg.reconcile_on_start:
+            # journal-ownership handoff for THIS shard: the reconcile pass
+            # replays the dead leader's pending records (owns-filtered, so
+            # it touches only families of shards we now hold). Outside the
+            # mutex — a long repair must not block another shard's
+            # elector callback
+            try:
+                report = self.reconciler.reconcile()
+                if report["actions"]:
+                    log.warning(
+                        "shard %d takeover reconcile repaired %d drift(s): %s",
+                        shard, report["driftCount"],
+                        [a["action"] for a in report["actions"]])
+            except Exception:  # noqa: BLE001
+                log.exception("shard %d takeover reconcile failed; serving "
+                              "anyway (rerun via /api/v1/reconcile)", shard)
+
+    def _on_shard_loss(self, shard: int, reason: str) -> None:
+        """Blast-radius containment, the loss side: losing ONE shard's
+        lease only narrows plane.owns — the loops keep running for the
+        shards still held. Only losing the LAST shard stops the writer
+        role (and losing shard 0 stops the singletons it carries)."""
+        with self._shard_mu:
+            if shard == 0:
+                if self.host_monitor is not None:
+                    self.host_monitor.close()
+                if self.health_watcher is not None:
+                    self.health_watcher.close()
+            still = self.shard_plane.held - {shard}
+            if self._shard_writers_on and not still:
+                self._shard_writers_on = False
+                if self.compactor is not None:
+                    self.compactor.close()
+                self.serving.close()
+                self.admission.close()
+                self.job_supervisor.close()
+                self.reconciler.close()
+                self.wq.close()
 
     def _engine_pool_stat(self, key: str) -> float:
         """Sum one connection-pool stat over the DISTINCT engines behind
@@ -627,7 +809,7 @@ class Program:
             # promoted later must not start its first dirty passes from a
             # cold, everything-is-dirty state
             self.reconcile_informer.start()
-        if self.leader_elector is None:
+        if self.leader_elector is None and self.shard_plane is None:
             # single-process: writers start unconditionally, as always
             self._start_writers()
         router = build_router(
@@ -638,6 +820,7 @@ class Program:
             reconciler=self.reconciler, job_supervisor=self.job_supervisor,
             host_monitor=self.host_monitor,
             leader_elector=self.leader_elector,
+            shard_plane=self.shard_plane,
             informer=self.informer,
             fanout=self.fanout,
             admission=self.admission,
@@ -654,6 +837,10 @@ class Program:
             # serving is up (reads + 503-with-hint on mutations) BEFORE the
             # election begins: a standby is useful from its first second
             self.leader_elector.start()
+        if self.shard_plane is not None:
+            # same contract per shard: the process answers reads and
+            # wrong-shard 503s before contesting any lease
+            self.shard_plane.start()
         log.info("tpu-docker-api %s (%s@%s) serving on %s:%d "
                  "(%d chips, ports %d-%d)%s",
                  bi["version"], bi["branch"], bi["commit"],
@@ -674,6 +861,10 @@ class Program:
             # instead of waiting out the TTL (the epoch key stays put —
             # fencing monotonicity)
             self.leader_elector.close(release=True)
+        if getattr(self, "shard_plane", None) is not None:
+            # same, per shard: every held lease is released so the
+            # survivors take over immediately
+            self.shard_plane.close(release=True)
         if getattr(self, "informer", None) is not None:
             self.informer.close()
         if getattr(self, "reconcile_informer", None) is not None:

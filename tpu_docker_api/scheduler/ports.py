@@ -144,11 +144,16 @@ class PortScheduler:
         With ``owner`` set, only ports still held by that owner are freed
         (double-free guard, mirroring ChipScheduler.restore_chips)."""
         with self._mu:
+            freed = False
             for p in ports:
                 if owner is not None and self._used.get(p) != owner:
                     continue
-                self._used.pop(p, None)
-            self._persist_locked(txn)
+                freed = self._used.pop(p, None) is not None or freed
+            # a no-op restore (portless container, double free) must not
+            # touch the store: the ledger write is what makes the flow a
+            # cross-shard batch under the sharded writer plane
+            if freed:
+                self._persist_locked(txn)
 
     def status(self) -> dict:
         """Snapshot for GET /resources/ports (reference GetPortStatus +

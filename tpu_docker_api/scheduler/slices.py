@@ -241,11 +241,16 @@ class ChipScheduler:
         chips that were re-allocated to another container in between.
         """
         with self._mu:
+            freed = False
             for cid in chip_ids:
                 if owner is not None and self._used.get(cid) != owner:
                     continue
-                self._used.pop(cid, None)
-            self._persist_locked(txn)
+                freed = self._used.pop(cid, None) is not None or freed
+            # a no-op restore (chip-free container, double free) must not
+            # touch the store: the ledger write is what makes the flow a
+            # cross-shard batch under the sharded writer plane
+            if freed:
+                self._persist_locked(txn)
 
     def _claim_locked(self, chip_ids: list[int], owner: str,
                       txn=None) -> None:

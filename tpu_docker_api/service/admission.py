@@ -95,11 +95,11 @@ class AdmissionRecord:
     seniority), and the durable skip counter for the starvation bound."""
 
     __slots__ = ("seq", "base", "kind", "klass", "skips", "ts", "accel",
-                 "trace_id")
+                 "trace_id", "shard")
 
     def __init__(self, seq: int, base: str, kind: str, klass: str,
                  skips: int = 0, ts: float = 0.0, accel: str = "",
-                 trace_id: str = "") -> None:
+                 trace_id: str = "", shard: int = 0) -> None:
         self.seq = seq
         self.base = base
         self.kind = kind          # "queued" | "preempted"
@@ -111,13 +111,20 @@ class AdmissionRecord:
         #: pass that preempted): a later placement — possibly by another
         #: daemon after a failover — LINKS back to it
         self.trace_id = trace_id
+        #: owning writer-plane shard: the record lives under that shard's
+        #: sub-prefix and only that shard's leader drains it (legacy
+        #: records parse to shard 0 — the flat prefix)
+        self.shard = shard
 
     def to_json(self) -> str:
-        return json.dumps({
+        d = {
             "seq": self.seq, "base": self.base, "kind": self.kind,
             "class": self.klass, "skips": self.skips, "ts": self.ts,
             "accel": self.accel, "traceId": self.trace_id,
-        }, sort_keys=True)
+        }
+        if self.shard:
+            d["shard"] = self.shard
+        return json.dumps(d, sort_keys=True)
 
     @classmethod
     def from_json(cls, raw: str) -> "AdmissionRecord":
@@ -125,10 +132,10 @@ class AdmissionRecord:
         return cls(seq=int(d["seq"]), base=d["base"], kind=d["kind"],
                    klass=d["class"], skips=int(d.get("skips", 0)),
                    ts=float(d.get("ts", 0.0)), accel=d.get("accel", ""),
-                   trace_id=d.get("traceId", ""))
+                   trace_id=d.get("traceId", ""), shard=int(d.get("shard", 0)))
 
     def key(self) -> str:
-        return keys.admission_record_key(self.seq)
+        return keys.admission_record_key(self.seq, self.shard)
 
 
 class AdmissionController:
@@ -145,7 +152,9 @@ class AdmissionController:
                  interval_s: float = 1.0,
                  registry: MetricsRegistry | None = None,
                  max_events: int = 256,
-                 tracer=None) -> None:
+                 tracer=None,
+                 shard_fn=None,
+                 owned_shards=None) -> None:
         self._svc = job_svc
         #: trace sink for self-rooted per-pass spans (idle passes trimmed)
         self._tracer = tracer
@@ -160,6 +169,12 @@ class AdmissionController:
         self.max_skips = max_skips
         self._interval = interval_s
         self._registry = registry if registry is not None else REGISTRY
+        #: sharded writer plane (daemon wiring): base → owning shard for
+        #: new records, and the shards THIS process leads — the drain and
+        #: the journal adoption touch only those (None ⇒ single-writer,
+        #: exactly today's behavior)
+        self._shard_fn = shard_fn
+        self._owned_shards = owned_shards
         self._events: collections.deque = collections.deque(maxlen=max_events)
         self._mu = threading.Lock()
         #: serializes admission passes (the loop vs an inline test/route
@@ -223,6 +238,27 @@ class AdmissionController:
             self._seq += 1
             return out
 
+    def _shard_for(self, base: str) -> int:
+        if self._shard_fn is None:
+            return 0
+        try:
+            return int(self._shard_fn(base))
+        except Exception:  # noqa: BLE001 — must not lose the record
+            log.exception("admission: shard classification failed for %s; "
+                          "routing to shard 0", base)
+            return 0
+
+    def _owned(self) -> frozenset | None:
+        return (self._owned_shards() if self._owned_shards is not None
+                else None)
+
+    def reset_seq_cache(self) -> None:
+        """Shard-takeover invalidation (daemon's on-acquire hook): the
+        previous holder allocated sequence numbers this process never
+        observed — re-seed from the journal before the next submit."""
+        with self._mu:
+            self._seq = None
+
     def records(self) -> list[AdmissionRecord]:
         out = []
         for key, raw in sorted(
@@ -278,7 +314,8 @@ class AdmissionController:
         rec = AdmissionRecord(seq=seq, base=base, kind="queued",
                               klass=priority_class, ts=time.time(),
                               accel=req.accelerator_type,
-                              trace_id=trace.current_trace_id())
+                              trace_id=trace.current_trace_id(),
+                              shard=self._shard_for(base))
         try:
             self._kv.apply(
                 StateStore._put_ops(Resource.JOBS, base, version,
@@ -332,7 +369,8 @@ class AdmissionController:
         seq = self.next_seq()
         rec = AdmissionRecord(seq=seq, base=base, kind="growback",
                               klass=klass, ts=time.time(),
-                              trace_id=trace.current_trace_id())
+                              trace_id=trace.current_trace_id(),
+                              shard=self._shard_for(base))
         self._kv.put(rec.key(), rec.to_json())
         pos = self.position(base) or 1
         self._record("job-growback-queued", base, klass=klass, seq=seq,
@@ -371,7 +409,8 @@ class AdmissionController:
             })
             rec = AdmissionRecord(seq=seq, base=base, kind="preempted",
                                   klass=st.priority_class, ts=time.time(),
-                                  trace_id=trace.current_trace_id())
+                                  trace_id=trace.current_trace_id(),
+                                  shard=self._shard_for(base))
             self._kv.apply(
                 StateStore._put_ops(Resource.JOBS, base, st.version,
                                     parked.to_dict())
@@ -414,14 +453,26 @@ class AdmissionController:
         back when pressure LIFTS, it does not create pressure of its own.
         """
         outcomes: list[dict] = []
+        owned = self._owned()
         with trace.pass_span(self._tracer, "admission.pass") as span, \
                 self._pass_mu:
+            if span is not None and owned is not None:
+                # bounded cardinality: shard ids, never family names
+                span.attrs["shard"] = ",".join(map(str, sorted(owned)))
             blocked: list[AdmissionRecord] = []
 
             def gated() -> bool:
                 return any(b.skips >= self.max_skips for b in blocked)
 
-            for rec in self._ordered():
+            records = self._ordered()
+            if owned is not None:
+                # sharded plane: drain ONLY the shards this process leads.
+                # Precedence within each shard is exact; cross-shard
+                # precedence is arbitrated by capacity itself — every
+                # placement's claim serializes through the coordination
+                # record (docs/robustness.md "Sharded writer plane")
+                records = [r for r in records if r.shard in owned]
+            for rec in records:
                 if rec.kind == "queued" and gated():
                     # starvation bound: queued work stalls behind a
                     # maximally-skipped head until it places
@@ -851,7 +902,8 @@ class AdmissionController:
             })
             rec = AdmissionRecord(seq=seq, base=base, kind="preempted",
                                   klass=st.priority_class, ts=time.time(),
-                                  trace_id=trace.current_trace_id())
+                                  trace_id=trace.current_trace_id(),
+                                  shard=self._shard_for(base))
             self._kv.apply(
                 StateStore._put_ops(Resource.JOBS, base, st.version,
                                     parked.to_dict())
@@ -994,9 +1046,14 @@ class AdmissionController:
 
         Returns the actions (performed, or planned under ``dry_run``)."""
         actions: list[dict] = []
+        owned = self._owned()
         seen_bases: set[str] = set()
         growback_bases: set[str] = set()
         for rec in self.records():
+            if owned is not None and rec.shard not in owned:
+                # another shard's leader adopts its own journal
+                seen_bases.add(rec.base)
+                continue
             seen_bases.add(rec.base)
             latest = self._versions.get(rec.base)
             st = None
@@ -1029,6 +1086,8 @@ class AdmissionController:
                 if not dry_run:
                     self._kv.delete(rec.key())
         for base in self._versions.snapshot():
+            if owned is not None and self._shard_for(base) not in owned:
+                continue  # that shard's leader re-journals its own
             latest = self._versions.get(base)
             if latest is None:
                 continue
@@ -1042,7 +1101,8 @@ class AdmissionController:
                 if not dry_run:
                     rec = AdmissionRecord(
                         seq=self.next_seq(), base=base, kind=st.phase,
-                        klass=st.priority_class, ts=time.time())
+                        klass=st.priority_class, ts=time.time(),
+                        shard=self._shard_for(base))
                     self._kv.put(rec.key(), rec.to_json())
             elif base not in growback_bases and self._growback_wanted(st):
                 actions.append({"action": "rejournal-growback-record",
@@ -1052,7 +1112,8 @@ class AdmissionController:
                 if not dry_run:
                     rec = AdmissionRecord(
                         seq=self.next_seq(), base=base, kind="growback",
-                        klass=st.priority_class, ts=time.time())
+                        klass=st.priority_class, ts=time.time(),
+                        shard=self._shard_for(base))
                     self._kv.put(rec.key(), rec.to_json())
         if actions and not dry_run:
             self._update_gauges()

@@ -61,7 +61,7 @@ class HistoryCompactor:
                  registry: MetricsRegistry | None = None,
                  chunk_ops: int = CHUNK_OPS,
                  locks: dict | None = None,
-                 tracer=None) -> None:
+                 tracer=None, owns=None) -> None:
         self._kv = kv
         self._store = store
         #: trace sink for self-rooted per-pass spans (idle passes trimmed)
@@ -81,6 +81,9 @@ class HistoryCompactor:
         self._runtime = runtime
         self._pod = pod
         self._wq = work_queue
+        #: sharded writer plane (daemon wiring): compact only families
+        #: whose shard this process leads; None ⇒ all (single-writer)
+        self._owns = owns
         self._interval_s = interval_s
         self._chunk_ops = max(1, chunk_ops)
         self._registry = registry if registry is not None else REGISTRY
@@ -142,7 +145,10 @@ class HistoryCompactor:
         for resource, vm in self._maps:
             lock_fn = self._locks.get(resource)
             count = 0
-            for base in sorted(vm.snapshot()):
+            bases = sorted(vm.snapshot())
+            if self._owns is not None:
+                bases = [b for b in bases if self._owns(b)]
+            for base in bases:
                 # selection AND delete under the family's service lock
                 # (where one exists): an in-flight rollback/replace that
                 # just confirmed a version must not lose its record to GC
@@ -272,6 +278,8 @@ class HistoryCompactor:
                 base = json.loads(raw)["base"]
             except (ValueError, KeyError):
                 continue  # foreign junk: not ours to judge
+            if self._owns is not None and not self._owns(base):
+                continue  # that shard's leader GCs its own records
             if base not in families:
                 ops.append(("delete", key))
                 purged += 1

@@ -710,14 +710,14 @@ class ContainerService:
                 # would resurrect a second live version
                 log.info("copy task for %s is obsolete; skipping", new_name)
                 return
-            if not self.wq.marker_done(rec.task_id):
+            if not self.wq.marker_done(rec.task_id, rec.shard):
                 if self.runtime.container_exists(p["copyFrom"]):
                     self.wq.copy_dirs(
                         self.runtime.container_data_dir(p["copyFrom"]),
                         self.runtime.container_data_dir(new_name))
                 # marker BEFORE start: the non-idempotent step is proven
                 # done before anything may write into the new container
-                self.wq.mark_done(rec.task_id)
+                self.wq.mark_done(rec.task_id, rec.shard)
             if p.get("startNew", True):
                 self.runtime.container_start(new_name)
                 log.info("rolling replace %s -> %s complete",

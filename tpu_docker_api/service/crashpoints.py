@@ -101,6 +101,19 @@ LEADER_CRASH_POINTS = (
     "leader.after_renew",
 )
 
+#: cross-shard coordination record (service/shard.py ShardedKV): a write
+#: batch spanning shards CAS-bumps keys.SHARD_COORD_KEY inside ONE atomic
+#: apply — so either crash side leaves the store consistent, and the shard
+#: chaos matrix proves a takeover from each converges (the batch is all-in
+#: with the seq bump, or absent entirely)
+SHARD_CRASH_POINTS = (
+    # seq re-read and the coordinated batch built; NOTHING applied yet
+    "shard.coord.before_apply",
+    # the batch + seq bump are durable in one apply; the caller's
+    # in-process follow-ups (response, cache updates) never ran
+    "shard.coord.after_apply",
+)
+
 #: runtime fan-out layer (runtime/fanout.py): fires after the FIRST call
 #: of a batch completes, while the rest are un-dispatched (serial mode) or
 #: genuinely in flight (parallel mode) — the "concurrent create batch is
@@ -216,7 +229,8 @@ COMPACTOR_CRASH_POINTS = (
 
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
-                      + LEADER_CRASH_POINTS + FANOUT_CRASH_POINTS
+                      + LEADER_CRASH_POINTS + SHARD_CRASH_POINTS
+                      + FANOUT_CRASH_POINTS
                       + ADMISSION_CRASH_POINTS + RESIZE_CRASH_POINTS
                       + SERVICE_CRASH_POINTS
                       + RECONCILE_CRASH_POINTS + COMPACTOR_CRASH_POINTS)

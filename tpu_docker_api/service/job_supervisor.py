@@ -82,6 +82,7 @@ class JobSupervisor:
         max_events: int = 512,
         host_monitor=None,
         fanout: Fanout | None = None,
+        owns=None,
     ) -> None:
         self.pod = pod
         #: runtime fan-out: per-member liveness inspects run as one
@@ -91,6 +92,9 @@ class JobSupervisor:
         self._svc = job_svc
         self._store = store
         self._versions = versions
+        #: sharded writer plane (daemon wiring): supervise only families
+        #: whose shard this process leads; None ⇒ all (single-writer)
+        self._owns = owns
         self._interval = interval_s
         self._max_restarts = max_restarts
         self._max_migrations = max_migrations
@@ -170,6 +174,8 @@ class JobSupervisor:
         """One liveness scan over every job family; separated from the loop
         for tests."""
         families = sorted(self._versions.snapshot())
+        if self._owns is not None:
+            families = [b for b in families if self._owns(b)]
         for base in families:
             try:
                 self._check_family(base)

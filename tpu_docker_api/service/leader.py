@@ -75,12 +75,32 @@ class LeaderElector:
                  on_acquire: Callable[[int], None] | None = None,
                  on_loss: Callable[[str], None] | None = None,
                  advertise: str = "",
-                 clock: Callable[[], float] = time.time) -> None:
+                 clock: Callable[[], float] = time.time,
+                 lease_key: str = keys.LEADER_LEASE_KEY,
+                 epoch_key: str = keys.LEADER_EPOCH_KEY,
+                 shard: int | None = None,
+                 defer_vacant_s: float = 0.0) -> None:
         if ttl_s <= 0:
             raise ValueError("leader ttl_s must be > 0")
         self._kv = kv
         self.holder_id = holder_id
         self.ttl_s = ttl_s
+        #: which lease this elector contests: the legacy singleton by
+        #: default, a per-shard lease/epoch pair in the sharded writer
+        #: plane (shard.py instantiates one elector per shard — same CAS,
+        #: same fencing, different keys)
+        self.lease_key = lease_key
+        self.epoch_key = epoch_key
+        #: shard id for telemetry (None = the unsharded singleton elector)
+        self.shard = shard
+        #: boot-spread knob: a NON-preferred elector defers contesting an
+        #: ABSENT lease by this much (measured from when it first saw the
+        #: vacancy), so each shard lands on its preferred process when the
+        #: fleet boots together — but an EXPIRED lease is contested
+        #: immediately, so failover after a leader death never waits on
+        #: this (recovery stays bounded by the TTL alone).
+        self.defer_vacant_s = defer_vacant_s
+        self._vacant_since: float | None = None
         # renew well inside the TTL: a single missed heartbeat must not
         # cost the lease
         self.renew_interval_s = (renew_interval_s if renew_interval_s
@@ -146,7 +166,7 @@ class LeaderElector:
         epoch = self._epoch
         if epoch <= 0:
             return []
-        return [("value", keys.LEADER_EPOCH_KEY, str(epoch))]
+        return [("value", self.epoch_key, str(epoch))]
 
     def leader_hint(self) -> dict:
         """Who holds the lease (for standby 503s and GET /api/v1/leader).
@@ -161,7 +181,7 @@ class LeaderElector:
             rec = self._observed
         else:
             try:
-                raw = self._kv.get_or(keys.LEADER_LEASE_KEY)
+                raw = self._kv.get_or(self.lease_key)
                 rec = json.loads(raw) if raw else None
             except Exception:  # noqa: BLE001 — a hint, never load-bearing
                 rec = None
@@ -188,7 +208,7 @@ class LeaderElector:
     def status_view(self) -> dict:
         """Operator view (GET /api/v1/leader) — lock-free like the other
         read paths, so a status probe never queues behind writer boot."""
-        return {
+        view = {
             "election": True,
             "role": "leader" if self._is_leader else "standby",
             "accepting": self.accepts_mutations,
@@ -197,11 +217,16 @@ class LeaderElector:
             "fencingEpoch": self._epoch,
             **self.leader_hint(),
         }
+        if self.shard is not None:
+            view["shard"] = self.shard
+        return view
 
     def events_view(self, limit: int = 100) -> list[dict]:
         return list(self._events)[-limit:]  # deque snapshots are thread-safe
 
     def _event(self, event: str, **extra) -> None:
+        if self.shard is not None:
+            extra = {"shard": self.shard, **extra}
         self._events.append(trace.stamp(
             {"ts": time.time(), "event": event,
              "holder": self.holder_id, **extra}))
@@ -230,8 +255,8 @@ class LeaderElector:
         new_raw = self._record(self._epoch, now)
         try:
             self._kv.apply(
-                [("put", keys.LEADER_LEASE_KEY, new_raw)],
-                guards=[("value", keys.LEADER_LEASE_KEY, self._lease_raw)])
+                [("put", self.lease_key, new_raw)],
+                guards=[("value", self.lease_key, self._lease_raw)])
         except errors.GuardFailed:
             # someone stole the lease (our old record is gone): deposed
             self._demote_locked("lease stolen: renew CAS lost")
@@ -257,7 +282,7 @@ class LeaderElector:
     def _try_acquire_locked(self) -> None:
         now = self._clock()
         try:
-            raw = self._kv.get_or(keys.LEADER_LEASE_KEY)
+            raw = self._kv.get_or(self.lease_key)
         except Exception as e:  # noqa: BLE001
             log.warning("elector %s: lease read failed: %s", self.holder_id, e)
             return
@@ -272,12 +297,22 @@ class LeaderElector:
         self._observed = cur
         self._has_observed = True
         if cur is not None and float(cur.get("deadline", 0)) > now:
+            self._vacant_since = None
             return  # a live lease is held: stay standby
+        if raw is None and self.defer_vacant_s > 0:
+            # vacancy (never held / gracefully released) is contested only
+            # after the deferral, so the preferred process wins a fleet
+            # boot; an EXPIRED lease (raw is not None) skips this branch
+            # entirely — dead-leader recovery must not wait
+            if self._vacant_since is None:
+                self._vacant_since = now
+            if now < self._vacant_since + self.defer_vacant_s:
+                return
         # absent, expired or unreadable: take it. The epoch must outgrow
         # BOTH the record's epoch and the standalone epoch key (a graceful
         # release deletes the lease but keeps the key — monotonicity).
         try:
-            key_epoch = int(self._kv.get_or(keys.LEADER_EPOCH_KEY) or 0)
+            key_epoch = int(self._kv.get_or(self.epoch_key) or 0)
         except Exception as e:  # noqa: BLE001
             log.warning("elector %s: epoch read failed: %s", self.holder_id, e)
             return
@@ -285,12 +320,12 @@ class LeaderElector:
         new_raw = self._record(epoch, now)
         try:
             self._kv.apply(
-                [("put", keys.LEADER_LEASE_KEY, new_raw),
-                 ("put", keys.LEADER_EPOCH_KEY, str(epoch))],
+                [("put", self.lease_key, new_raw),
+                 ("put", self.epoch_key, str(epoch))],
                 # CAS on the exact value we judged expired (None = create):
                 # of N racing standbys exactly one wins, the rest lose the
                 # compare and stay standby
-                guards=[("value", keys.LEADER_LEASE_KEY, raw)])
+                guards=[("value", self.lease_key, raw)])
         except errors.GuardFailed:
             return  # another standby won the steal; retry next tick
         except Exception as e:  # noqa: BLE001
@@ -299,11 +334,16 @@ class LeaderElector:
         self._is_leader = True
         self._epoch = epoch
         self._lease_raw = new_raw
+        self._vacant_since = None
         stolen_from = cur.get("holderId") if cur else None
-        log.info("elector %s: acquired leadership (epoch %d%s)",
-                 self.holder_id, epoch,
+        log.info("elector %s: acquired leadership%s (epoch %d%s)",
+                 self.holder_id,
+                 f" of shard {self.shard}" if self.shard is not None else "",
+                 epoch,
                  f", stolen from expired {stolen_from}" if stolen_from else "")
-        self._event("leader-acquired", epoch=epoch, stolenFrom=stolen_from)
+        self._event("shard-acquired" if self.shard is not None
+                    else "leader-acquired", epoch=epoch,
+                    stolenFrom=stolen_from)
         crash_point("leader.after_acquire")
         if self._on_acquire is not None:
             self._on_acquire(epoch)
@@ -318,7 +358,8 @@ class LeaderElector:
         self._lease_raw = None
         log.warning("elector %s: leadership lost (epoch %d): %s",
                     self.holder_id, self._epoch, reason)
-        self._event("leader-lost", epoch=self._epoch, reason=reason)
+        self._event("shard-lost" if self.shard is not None
+                    else "leader-lost", epoch=self._epoch, reason=reason)
         if self._on_loss is not None:
             try:
                 self._on_loss(reason)
@@ -361,10 +402,11 @@ class LeaderElector:
                 return
             try:
                 self._kv.apply(
-                    [("delete", keys.LEADER_LEASE_KEY)],
-                    guards=[("value", keys.LEADER_LEASE_KEY,
+                    [("delete", self.lease_key)],
+                    guards=[("value", self.lease_key,
                              self._lease_raw)])
-                self._event("leader-released", epoch=self._epoch)
+                self._event("shard-released" if self.shard is not None
+                            else "leader-released", epoch=self._epoch)
             except Exception as e:  # noqa: BLE001 — best effort: an
                 # unreleased lease just costs the standby one TTL
                 log.warning("elector %s: lease release failed: %s",
@@ -384,9 +426,17 @@ class FencedKV(KV):
     elector, or never-acquired) behavior matches the raw store."""
 
     def __init__(self, inner: KV,
-                 fence: Callable[[], list[tuple]]) -> None:
+                 fence: Callable[[], list[tuple]],
+                 fence_ops: Callable[[list[tuple]], list[tuple]] | None
+                 = None) -> None:
         self.inner = inner
         self._fence = fence
+        #: ops-aware fence (sharded writer plane): receives the batch and
+        #: returns the guards for exactly the shards it touches, so a
+        #: deposed shard-1 leader is fenced out of shard 1 while its
+        #: still-held shard 2 writes sail. When unset the zero-arg
+        #: ``fence`` applies to every write (the single-lease contract).
+        self._fence_ops = fence_ops
 
     def put(self, key: str, value: str) -> None:
         self.apply([("put", key, value)])
@@ -428,7 +478,9 @@ class FencedKV(KV):
         # the base template (our public ``apply``) already validated and
         # fired the txn crash points — delegate to the inner BACKEND's
         # atomic ``_apply`` so they never fire twice per batch
-        self.inner._apply(ops, list(guards or []) + self._fence())
+        fence = (self._fence_ops(ops) if self._fence_ops is not None
+                 else self._fence())
+        self.inner._apply(ops, list(guards or []) + fence)
 
     def close(self) -> None:
         self.inner.close()

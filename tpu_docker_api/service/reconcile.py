@@ -183,6 +183,8 @@ class Reconciler:
         serving=None,
         full_interval_s: float = 0.0,
         tracer=None,
+        owns=None,
+        owned_shards=None,
     ) -> None:
         self.runtime = runtime
         #: trace sink for self-rooted per-pass spans (daemon wires the
@@ -245,6 +247,14 @@ class Reconciler:
         #: ``full_interval_s`` seconds (<= 0: every pass is full — the
         #: legacy behavior, and the safe default without a feed)
         self._full_interval_s = full_interval_s
+        #: sharded writer plane (daemon wiring): ``owns(base)`` → does this
+        #: process lead the shard owning the family? The family passes
+        #: visit only owned families — the rest belong to their own (live)
+        #: shard leaders, whose sweeps see the same store. ``owned_shards``
+        #: feeds the pass span's bounded-cardinality shard attribute.
+        #: None ⇒ single-writer semantics, exactly today's behavior.
+        self._owns = owns
+        self._owned_shards = owned_shards
         self._dirty: DirtySet | None = None
         self._last_full: float | None = None
         self._mu = threading.Lock()
@@ -327,6 +337,10 @@ class Reconciler:
         # attributable too); via the HTTP route it rides the request trace
         with trace.pass_span(self._tracer, "reconcile.pass",
                              mode=effective, dryRun=dry_run) as span:
+            if span is not None and self._owned_shards is not None:
+                # bounded cardinality: shard ids, never family names
+                span.attrs["shard"] = ",".join(
+                    map(str, sorted(self._owned_shards())))
             if effective == "dirty":
                 visited = self._reconcile_dirty(actions, dry_run)
             else:
@@ -372,12 +386,17 @@ class Reconciler:
                 raise
         return self._full_body(actions, dry_run)
 
+    def _owned_only(self, bases) -> list[str]:
+        if self._owns is None:
+            return sorted(bases)
+        return sorted(b for b in bases if self._owns(b))
+
     def _full_body(self, actions: list[dict], dry_run: bool) -> int:
         self._replay_queue_journal(actions, dry_run)
         families = self.versions.snapshot()
         members = self._runtime_members()
 
-        for base in sorted(families):
+        for base in self._owned_only(families):
             if self._svc is not None and not dry_run:
                 with self._svc.family_lock(base):
                     # under the lock, re-probe fresh — the pre-lock
@@ -388,11 +407,11 @@ class Reconciler:
             else:
                 self._reconcile_family(base, actions, dry_run,
                                        members=members.get(base, {}))
-        for base in sorted(set(members) - set(families)):
+        for base in self._owned_only(set(members) - set(families)):
             self._reconcile_orphan(base, actions, dry_run,
                                    hint=members.get(base, {}))
         if self._job_svc is not None and self._job_versions is not None:
-            for base in sorted(self._job_versions.snapshot()):
+            for base in self._owned_only(self._job_versions.snapshot()):
                 try:
                     self._reconcile_job_family(base, actions, dry_run)
                 except Exception:  # noqa: BLE001 — one family must not
@@ -419,7 +438,7 @@ class Reconciler:
         try:
             crash_point("reconcile.dirty_drained")
             self._replay_queue_journal(actions, dry_run)
-            for base in sorted(drained[Resource.CONTAINERS.value]):
+            for base in self._owned_only(drained[Resource.CONTAINERS.value]):
                 if self.versions.get(base) is not None:
                     if self._svc is not None and not dry_run:
                         with self._svc.family_lock(base):
@@ -434,7 +453,7 @@ class Reconciler:
                     # therefore no event — the full pass removes those)
                     self._reconcile_orphan(base, actions, dry_run)
             if self._job_svc is not None and self._job_versions is not None:
-                for base in sorted(drained[Resource.JOBS.value]):
+                for base in self._owned_only(drained[Resource.JOBS.value]):
                     try:
                         self._reconcile_job_family(base, actions, dry_run)
                     except Exception:  # noqa: BLE001 — as in the full pass

@@ -110,7 +110,7 @@ class ServingService:
                  registry: MetricsRegistry | None = None,
                  max_events: int = 256,
                  clock=time.monotonic,
-                 tracer=None) -> None:
+                 tracer=None, owns=None) -> None:
         self._job = job_svc
         #: trace sink for self-rooted per-tick spans (idle ticks trimmed)
         self._tracer = tracer
@@ -118,6 +118,12 @@ class ServingService:
         self._versions = versions          # service VersionMap
         self._job_versions = job_versions
         self._admission = admission
+        #: sharded writer plane (daemon wiring): autoscale / adopt only
+        #: services whose shard this process leads. Root-segment hashing
+        #: (keys.shard_root) puts a service and all its <svc>.r<i> replica
+        #: gangs on ONE shard, so a fleet never straddles a boundary.
+        #: None ⇒ all services (single-writer).
+        self._owns = owns
         self.default_class = default_class
         self._interval = interval_s
         self.up_cooldown_s = up_cooldown_s
@@ -698,6 +704,8 @@ class ServingService:
 
     def _tick_inner(self) -> None:
         for base in sorted(self._versions.snapshot()):
+            if self._owns is not None and not self._owns(base):
+                continue
             try:
                 with self._locks.hold(base):
                     try:
@@ -740,6 +748,8 @@ class ServingService:
         """
         actions: list[dict] = []
         for base in sorted(self._versions.snapshot()):
+            if self._owns is not None and not self._owns(base):
+                continue
             lock = (self._locks.hold(base) if not dry_run
                     else contextlib.nullcontext())
             with lock:
@@ -776,6 +786,8 @@ class ServingService:
                                       dry_run=dry_run)
         known = set(self._versions.snapshot())
         for jb in sorted(self._job_versions.snapshot()):
+            if self._owns is not None and not self._owns(jb):
+                continue
             owner = self._job_owner(jb)
             if owner is not None and owner not in known:
                 actions.append({"action": "gc-orphan-replica", "target": jb,

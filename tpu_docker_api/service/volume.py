@@ -242,7 +242,7 @@ class VolumeService:
         have started writing to."""
         p = rec.params
         with self._hold(p["base"]):
-            if self.wq.marker_done(rec.task_id):
+            if self.wq.marker_done(rec.task_id, rec.shard):
                 return
             try:
                 src = self.runtime.volume_data_dir(p["copyFrom"])
@@ -256,7 +256,7 @@ class VolumeService:
             log.info("copying volume data %s -> %s (%s -> %s)",
                      p["copyFrom"], p["newName"], src, dst)
             self.wq.copy_dirs(src, dst)
-            self.wq.mark_done(rec.task_id)
+            self.wq.mark_done(rec.task_id, rec.shard)
 
     # -- info (GET /volumes/{name}; reference GetVolumeInfo :189-199) -------------
 

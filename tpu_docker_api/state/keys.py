@@ -107,6 +107,36 @@ LEADER_LEASE_KEY = f"{PREFIX}/leader/lease"
 LEADER_EPOCH_KEY = f"{PREFIX}/leader/epoch"
 
 
+# -- sharded writer plane (service/shard.py) -----------------------------------
+#: shard 0 maps to the LEGACY singleton keys above, so a ``shard_count=1``
+#: deployment is byte-for-byte identical to the unsharded layout (and a
+#: later ``shard_count`` bump adopts the existing store as shard 0's
+#: keyspace without migration). Shards i>0 get their own lease/epoch pair
+#: under ``/leader/shards/<i>/`` with the exact same CAS + fencing
+#: semantics — one epoch per shard, never deleted, monotonic forever.
+
+
+def shard_lease_key(shard: int) -> str:
+    if shard == 0:
+        return LEADER_LEASE_KEY
+    return f"{PREFIX}/leader/shards/{shard}/lease"
+
+
+def shard_epoch_key(shard: int) -> str:
+    if shard == 0:
+        return LEADER_EPOCH_KEY
+    return f"{PREFIX}/leader/shards/{shard}/epoch"
+
+
+#: cross-shard coordination record: JSON ``{"seq": N}``, CAS-bumped by any
+#: transaction whose invariants span shards (pod capacity, cross-shard
+#: admission precedence, service fleets whose replicas hash apart). Two
+#: shard leaders racing on a cross-shard invariant serialize here — the
+#: CAS loser gets a typed GuardFailed and re-reads, exactly the lease
+#: protocol's shape applied to data instead of leadership.
+SHARD_COORD_KEY = f"{PREFIX}/leader/coord"
+
+
 #: operator cordon set (service/host_health.py + scheduler/pod.py): JSON
 #: list of host ids that must receive no new placements; persisted so a
 #: cordon survives daemon restarts (uncordon is the only way out)
@@ -137,16 +167,54 @@ QUEUE_MARKERS_PREFIX = f"{PREFIX}/queue/markers/"
 ADMISSION_PREFIX = f"{PREFIX}/admission/"
 
 
-def admission_record_key(seq: int) -> str:
-    return f"{ADMISSION_PREFIX}{seq:012d}"
+def admission_prefix(shard: int = 0) -> str:
+    """Shard 0 owns the legacy flat prefix; shards i>0 nest under an
+    ``s<i>/`` segment, so each shard leader scans (and replays) only its
+    own records and a one-shard deployment keeps today's exact keys."""
+    if shard == 0:
+        return ADMISSION_PREFIX
+    return f"{ADMISSION_PREFIX}s{shard}/"
 
 
-def queue_task_key(seq: int) -> str:
-    return f"{QUEUE_TASKS_PREFIX}{seq:012d}"
+def admission_record_key(seq: int, shard: int = 0) -> str:
+    return f"{admission_prefix(shard)}{seq:012d}"
 
 
-def queue_marker_key(task_id: str) -> str:
-    return f"{QUEUE_MARKERS_PREFIX}{task_id}"
+def queue_tasks_prefix(shard: int = 0) -> str:
+    if shard == 0:
+        return QUEUE_TASKS_PREFIX
+    return f"{QUEUE_TASKS_PREFIX}s{shard}/"
+
+
+def queue_task_key(seq: int, shard: int = 0) -> str:
+    return f"{queue_tasks_prefix(shard)}{seq:012d}"
+
+
+def queue_markers_prefix(shard: int = 0) -> str:
+    if shard == 0:
+        return QUEUE_MARKERS_PREFIX
+    return f"{QUEUE_MARKERS_PREFIX}s{shard}/"
+
+
+def queue_marker_key(task_id: str, shard: int = 0) -> str:
+    return f"{queue_markers_prefix(shard)}{task_id}"
+
+
+def versions_shard_key(resource: Resource, shard: int) -> str:
+    """Per-shard version-map snapshot key. Shard 0 keeps the legacy
+    singleton key so the existing store needs no migration."""
+    if shard == 0:
+        return f"{PREFIX}/versions/{resource.value}"
+    return f"{PREFIX}/versions/shards/{shard}/{resource.value}"
+
+
+def shard_root(base: str) -> str:
+    """The shard-assignment unit for a family base name: its first
+    dot-segment. Replicated-service replica gangs are named
+    ``<service>.r<i>`` (service/serving.py), so hashing the root keeps a
+    service and every one of its replicas on ONE shard — the autoscaler
+    and fleet sweeps never straddle a shard boundary for a single fleet."""
+    return base.split(".", 1)[0]
 
 
 def host_chips_key(host_id: str) -> str:

@@ -127,3 +127,100 @@ class VersionMap:
             self.reload_from_store()
         with self._mu:
             return dict(self._m)
+
+
+class ShardedVersionMap:
+    """Version map partitioned across the sharded writer plane: one inner
+    :class:`VersionMap` per shard (shard 0 at the LEGACY singleton key, so
+    a ``shard_count`` bump adopts the existing snapshot as shard 0's
+    without migration; shards i>0 at ``keys.versions_shard_key``).
+
+    Writes delegate to the owning shard's map — and because each inner
+    map persists to a key that :class:`~tpu_docker_api.service.shard.ShardMap`
+    classifies back to that shard, every persist rides the shard's epoch
+    fence through ``ShardedKV``: a deposed shard leader's version bump
+    loses its compare instead of clobbering the new leader's snapshot.
+    Reads on shards this process does NOT lead go read-through (the
+    leader of that shard bumps versions behind our back), while led
+    shards keep the pure in-memory map — per-shard, the exact PR 7
+    leader/standby read contract.
+
+    Legacy adoption: a single-leader store's version snapshot lists EVERY
+    family in the shard-0 singleton, including families that hash to
+    other shards after a ``shard_count`` bump. Reads therefore fall back
+    to the shard-0 map on an owning-map miss, and the first write
+    re-homes the family: the owning shard's map adopts the legacy
+    version, then mutates its own copy (which shadows the stale legacy
+    entry from then on). ``remove`` also clears a surviving legacy entry
+    — that write rides shard 0's fence, so deleting a never-re-homed
+    legacy family from a leader that does not hold shard 0 surfaces a
+    typed GuardFailed rather than silently resurrecting the family.
+    """
+
+    def __init__(self, kv, shard_map, resource, leading) -> None:
+        """``leading(shard) -> bool`` is the per-shard read-through
+        inverter (typically ``plane.is_leader``)."""
+        from tpu_docker_api.state import keys as _keys
+        self._shard_map = shard_map
+        self._maps = [
+            VersionMap(kv, _keys.versions_shard_key(resource, i),
+                       read_through=(lambda i=i: not leading(i)))
+            for i in range(shard_map.count)
+        ]
+
+    def _of(self, name: str) -> VersionMap:
+        return self._maps[self._shard_map.shard_of(name)]
+
+    def _lookup(self, name: str) -> tuple[VersionMap, int | None]:
+        """Owning map first, then the legacy (shard 0) adoption home."""
+        owner = self._of(name)
+        v = owner.get(name)
+        if v is None and owner is not self._maps[0]:
+            v = self._maps[0].get(name)
+        return owner, v
+
+    def _rehome(self, name: str) -> VersionMap:
+        """Ensure ``name``'s owning map carries its current version before
+        a mutation — the first write after a shard_count bump adopts the
+        legacy entry into the owning shard's keyspace."""
+        owner, v = self._lookup(name)
+        if v is not None and owner.get(name) is None:
+            owner.set(name, v)
+        return owner
+
+    def reload_from_store(self) -> None:
+        for m in self._maps:
+            m.reload_from_store()
+
+    def reload_shard(self, shard: int) -> None:
+        """Takeover cache refresh for ONE shard (daemon on-acquire hook) —
+        the other shards' maps are not ours to reseed."""
+        self._maps[shard].reload_from_store()
+
+    def get(self, name: str) -> int | None:
+        return self._lookup(name)[1]
+
+    def contains(self, name: str) -> bool:
+        return self._lookup(name)[1] is not None
+
+    def next_version(self, name: str) -> int:
+        return self._rehome(name).next_version(name)
+
+    def set(self, name: str, version: int) -> None:
+        self._of(name).set(name, version)
+
+    def rollback(self, name: str, to_version: int | None) -> None:
+        self._rehome(name).rollback(name, to_version)
+
+    def remove(self, name: str) -> None:
+        owner = self._of(name)
+        owner.remove(name)
+        legacy = self._maps[0]
+        if owner is not legacy and legacy.get(name) is not None:
+            legacy.remove(name)
+
+    def snapshot(self) -> dict[str, int]:
+        merged: dict[str, int] = {}
+        for m in self._maps:
+            merged.update(m.snapshot())
+        return merged

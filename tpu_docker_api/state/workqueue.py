@@ -147,14 +147,21 @@ class TaskRecord:
     idempotency_key: str = ""
     trace_id: str = ""
     span_id: str = ""
+    #: owning writer-plane shard (sharded mode: each shard journals under
+    #: its own sub-prefix and replays only its own records on takeover);
+    #: legacy records with no field parse to shard 0 — the legacy keyspace
+    shard: int = 0
 
     def to_json(self) -> str:
-        return json.dumps({
+        d = {
             "id": self.task_id, "kind": self.kind, "params": self.params,
             "seq": self.seq, "state": self.state, "attempts": self.attempts,
             "error": self.error, "idempotencyKey": self.idempotency_key,
             "traceId": self.trace_id, "spanId": self.span_id,
-        }, sort_keys=True)
+        }
+        if self.shard:
+            d["shard"] = self.shard
+        return json.dumps(d, sort_keys=True)
 
     @classmethod
     def from_json(cls, raw: str) -> "TaskRecord":
@@ -165,7 +172,8 @@ class TaskRecord:
                    error=d.get("error", ""),
                    idempotency_key=d.get("idempotencyKey", ""),
                    trace_id=d.get("traceId", ""),
-                   span_id=d.get("spanId", ""))
+                   span_id=d.get("spanId", ""),
+                   shard=int(d.get("shard", 0)))
 
     def label(self) -> str:
         return f"{self.kind}:{self.task_id}"
@@ -194,6 +202,8 @@ class WorkQueue:
         close_deadline_s: float = DEFAULT_CLOSE_DEADLINE_S,
         metrics=None,
         tracer=None,
+        shard_fn: Callable[[str, dict], int] | None = None,
+        owned_shards: Callable[[], frozenset[int]] | None = None,
     ) -> None:
         from tpu_docker_api.utils.files import copy_dir_contents
 
@@ -232,10 +242,19 @@ class WorkQueue:
         #: installed as permanently stale key→task_id entries
         self._seeding = 0
         self._dropped_while_seeding: set[str] = set()
-        #: journal sequence counter; None until first scan (lazy so a store
-        #: outage at construction degrades instead of failing the boot)
-        self._seq: int | None = None
+        #: per-shard journal sequence counters; a shard is absent until its
+        #: first scan (lazy so a store outage at construction degrades
+        #: instead of failing the boot). The unsharded queue only ever
+        #: uses shard 0 — the legacy flat journal prefix.
+        self._seq: dict[int, int] = {}
         self._seq_mu = threading.Lock()
+        #: sharded writer plane (daemon wiring): maps a submit to its
+        #: owning shard (None ⇒ everything is shard 0), and names the
+        #: shards THIS process currently leads so replay adopts only its
+        #: own journal sub-prefixes (None ⇒ every record is adoptable —
+        #: single-writer semantics, exactly today's behavior)
+        self._shard_fn = shard_fn
+        self._owned_shards = owned_shards
         self._journal_failures = 0
         self._events: collections.deque = collections.deque(maxlen=128)
         if metrics is None:
@@ -282,11 +301,12 @@ class WorkQueue:
 
     # -- markers (exec-level idempotency for replayed records) --------------------
 
-    def marker_done(self, task_id: str) -> bool:
-        return self._kv.get_or(keys.queue_marker_key(task_id)) is not None
+    def marker_done(self, task_id: str, shard: int = 0) -> bool:
+        return (self._kv.get_or(keys.queue_marker_key(task_id, shard))
+                is not None)
 
-    def mark_done(self, task_id: str) -> None:
-        self._kv.put(keys.queue_marker_key(task_id), "1")
+    def mark_done(self, task_id: str, shard: int = 0) -> None:
+        self._kv.put(keys.queue_marker_key(task_id, shard), "1")
 
     def copy_dirs(self, src: str, dst: str) -> None:
         """The data-migration primitive (swappable via ``copy_fn``)."""
@@ -314,18 +334,22 @@ class WorkQueue:
                     log.info("workqueue: %s submit deduplicated against "
                              "active record %s:%s", kind, kind, dup_id)
                     return dup_id
+            shard = self._shard_of(kind, params)
             cur = trace_mod.current()
             rec = TaskRecord(task_id=uuid.uuid4().hex[:12], kind=kind,
-                             params=dict(params), seq=self._next_seq(),
+                             params=dict(params),
+                             seq=self._next_seq(shard),
                              idempotency_key=idempotency_key,
                              trace_id=cur.trace_id if cur else "",
-                             span_id=cur.span_id if cur else "")
+                             span_id=cur.span_id if cur else "",
+                             shard=shard)
             # claim local ownership BEFORE the journal write: once the
             # record is visible in KV, a concurrent reconcile's replay
             # must already see it as ours, or it would double-run it
             with self._local_mu:
                 self._local_ids.add(rec.task_id)
-            self._kv.put(keys.queue_task_key(rec.seq), rec.to_json())
+            self._kv.put(keys.queue_task_key(rec.seq, rec.shard),
+                         rec.to_json())
             journaled = True
         except Exception as e:  # noqa: BLE001 — durability degrades, loudly
             self._degrade("journal-write-failed", f"{kind}: {e}")
@@ -355,7 +379,7 @@ class WorkQueue:
                 # window where a concurrent replay adopts the still-
                 # journaled record after the caller was told 429
                 with contextlib.suppress(Exception):
-                    self._kv.delete(keys.queue_task_key(rec.seq))
+                    self._kv.delete(keys.queue_task_key(rec.seq, rec.shard))
             self._forget_local(rec)
             raise errors.QueueSaturated(
                 f"work queue full ({self._q.maxsize} tasks) after "
@@ -375,17 +399,43 @@ class WorkQueue:
                 f"work queue full ({self._q.maxsize} tasks) after "
                 f"{self._submit_timeout_s}s; retry later") from None
 
-    def _next_seq(self) -> int:
+    def reset_shard_cache(self, shard: int) -> None:
+        """Shard-takeover cache invalidation (daemon's on-acquire hook):
+        drop the shard's lazy seq counter and the idempotency-key map so
+        both re-seed from the journal — the previous holder appended
+        records this process never saw, and a stale counter would
+        overwrite them."""
         with self._seq_mu:
-            if self._seq is None:
+            self._seq.pop(shard, None)
+        with self._local_mu:
+            self._active_keys = None
+
+    def _shard_of(self, kind: str, params: dict) -> int:
+        if self._shard_fn is None:
+            return 0
+        try:
+            return int(self._shard_fn(kind, params))
+        except Exception:  # noqa: BLE001 — misclassification must not
+            # lose the task; shard 0 is the singleton-of-last-resort
+            log.exception("workqueue: shard classification failed for %s; "
+                          "routing to shard 0", kind)
+            return 0
+
+    def _next_seq(self, shard: int = 0) -> int:
+        with self._seq_mu:
+            if shard not in self._seq:
+                prefix = keys.queue_tasks_prefix(shard)
                 top = -1
-                for k in self._kv.range_prefix(keys.QUEUE_TASKS_PREFIX):
-                    tail = k.rsplit("/", 1)[-1]
+                for k in self._kv.range_prefix(prefix):
+                    tail = k[len(prefix):]
+                    # shard 0's flat prefix is the PARENT of the s<i>/
+                    # sub-prefixes: skip nested keys or a shard-0 scan
+                    # would absorb every other shard's counter
                     if tail.isdigit():
                         top = max(top, int(tail))
-                self._seq = top + 1
-            out = self._seq
-            self._seq += 1
+                self._seq[shard] = top + 1
+            out = self._seq[shard]
+            self._seq[shard] = out + 1
             return out
 
     def _find_active(self, idempotency_key: str) -> str | None:
@@ -610,9 +660,11 @@ class WorkQueue:
         try:
             ops: list[tuple] = []
             if rec.seq >= 0:
-                ops.append(("delete", keys.queue_task_key(rec.seq)))
+                ops.append(("delete",
+                            keys.queue_task_key(rec.seq, rec.shard)))
             # degraded (seq<0) records may still have written a marker
-            ops.append(("delete", keys.queue_marker_key(rec.task_id)))
+            ops.append(("delete",
+                        keys.queue_marker_key(rec.task_id, rec.shard)))
             self._kv.apply(ops)
         except Exception as e:  # noqa: BLE001
             self._degrade("journal-ack-failed", f"{rec.label()}: {e}")
@@ -623,7 +675,8 @@ class WorkQueue:
         if rec.seq < 0:
             return  # degraded at submit: in-memory only
         try:
-            self._kv.put(keys.queue_task_key(rec.seq), rec.to_json())
+            self._kv.put(keys.queue_task_key(rec.seq, rec.shard),
+                         rec.to_json())
         except Exception as e:  # noqa: BLE001
             self._degrade("journal-write-failed", f"{rec.label()}: {e}")
 
@@ -718,9 +771,15 @@ class WorkQueue:
                            include_local: bool) -> list[TaskRecord]:
         with self._local_mu:
             local = set() if include_local else set(self._local_ids)
+        owned = (self._owned_shards() if self._owned_shards is not None
+                 else None)
         return [rec for rec in records
                 if rec.state in ("pending", "inflight")
-                and rec.task_id not in local]
+                and rec.task_id not in local
+                # sharded plane: adopt ONLY the shards this process leads
+                # — another shard's journal belongs to its own (live!)
+                # leader, and replaying it here would double-run work
+                and (owned is None or rec.shard in owned)]
 
     def replay_journal(self, include_local: bool = False) -> list[dict]:
         """Adopt the journal: execute every replayable record inline, in
@@ -744,7 +803,8 @@ class WorkQueue:
                 # local-ownership snapshot was taken
                 if rec.seq >= 0:
                     try:
-                        raw = self._kv.get_or(keys.queue_task_key(rec.seq))
+                        raw = self._kv.get_or(
+                            keys.queue_task_key(rec.seq, rec.shard))
                         if (raw is None or TaskRecord.from_json(raw).state
                                 not in ("pending", "inflight")):
                             continue
@@ -790,11 +850,16 @@ class WorkQueue:
             live = {rec.task_id for rec in records}
             with self._local_mu:
                 live |= self._local_ids
+            owned = (self._owned_shards() if self._owned_shards is not None
+                     else None)
             doomed = [
                 # keys-only: marker values are never inspected here, and at
                 # scale the orphan sweep must not deserialize the backlog
                 key for key in self._kv.keys_prefix(keys.QUEUE_MARKERS_PREFIX)
                 if key.rsplit("/", 1)[-1] not in live
+                # sharded plane: GC only our own shards' markers — another
+                # shard's fence would (rightly) reject the delete anyway
+                and (owned is None or _marker_shard(key) in owned)
             ]
             # batched deletes, chunked under etcd's max-txn-ops (default
             # 128) so a huge orphan backlog still GCs incrementally instead
@@ -858,9 +923,13 @@ class WorkQueue:
                 # behind the shutdown sentinel in a consumerless queue
                 return 0
             n = 0
+            owned = (self._owned_shards() if self._owned_shards is not None
+                     else None)
             for rec in self._journal_records():
                 if rec.state != "dead":
                     continue
+                if owned is not None and rec.shard not in owned:
+                    continue  # that shard's leader revives its own dead
                 rec.state = "pending"
                 rec.error = ""
                 rec.attempts = 0
@@ -925,6 +994,17 @@ class WorkQueue:
         if journal_error:
             out["journal"]["error"] = journal_error
         return out
+
+
+def _marker_shard(marker_key: str) -> int:
+    """Owning shard of a marker key: ``.../markers/s<i>/<tid>`` → i,
+    the legacy flat layout → 0."""
+    rest = marker_key[len(keys.QUEUE_MARKERS_PREFIX):]
+    if rest.startswith("s"):
+        sid, sep, _ = rest[1:].partition("/")
+        if sep and sid.isdigit():
+            return int(sid)
+    return 0
 
 
 def queue_depth(wq: WorkQueue) -> int:
