@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-scale bench-shard openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-serve-traffic bench-scale bench-shard openapi sample-interface run clean
 
 all: native openapi
 
@@ -81,6 +81,11 @@ bench-serve-scale:           ## service autoscaling family: offered-load step ->
 	$(PY) bench.py --control-plane --cp-family serve-scale > bench-serve-scale.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-serve-scale.json.tmp
 	mv bench-serve-scale.json.tmp bench-serve-scale.json
+
+bench-serve-traffic:         ## serving gateway family: open-loop streamed load across autoscale + rolling update + hard kill -> zero-drop, TTFT overhead, affinity, roll-ack and typed-shed gates
+	$(PY) bench.py --control-plane --cp-family serve-traffic > bench-serve-traffic.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-serve-traffic.json.tmp
+	mv bench-serve-traffic.json.tmp bench-serve-traffic.json
 
 bench-scale:                 ## O(100k)-object scale family, reduced world: O(changes) reconcile reads, flat list p95, retention-bounded history + schema gate
 	$(PY) bench.py --control-plane --cp-family scale --scale-objects 12000 --scale-small 600 --scale-gangs 60 > bench-scale.json.tmp

@@ -35,6 +35,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 
@@ -1897,6 +1898,384 @@ def measure_control_plane_serve_scale(iters: int = 3,
     }
 
 
+def measure_control_plane_serve_traffic(
+        duration_s: float = 4.0, rps: float = 40.0,
+        ttft_overhead_budget_ms: float = 75.0, interval_s: float = 0.05,
+        timeout_s: float = 30.0) -> dict:
+    """L7 gateway traffic family (``--control-plane --cp-family
+    serve-traffic`` / ``make bench-serve-traffic``): open-loop streaming
+    load through the REAL gateway listener against real (stub) replica
+    HTTP servers, while the control plane rolls the service, autoscales
+    it, and a replica is hard-killed mid-load. Self-gating on:
+
+    - **zero dropped requests**: across the rolling update, the
+      autoscale event and the hard-kill, every request completes 200
+      with an intact stream — no 5xx, no connect error surfaced, no
+      truncation, no unexpected shed;
+    - **TTFT overhead**: p95 time-to-first-token through the gateway
+      minus p95 direct-to-replica stays within ``ttft_overhead_budget_ms``
+      (the proxy hop must be cheap, not a second queue);
+    - **prefix affinity beats random**: the per-key modal-endpoint hit
+      rate exceeds the 1/replicas random-routing baseline (rendezvous
+      hashing actually pins prefixes);
+    - **shed is typed**: an over-capacity probe returns HTTP 429 with a
+      Retry-After header and the typed error code — back-pressure,
+      never collapse.
+
+    A violated gate flips ``gates.ok``; main() turns that into a nonzero
+    exit."""
+    import http.client as hc
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tpu_docker_api import errors as _errors
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+
+    prog = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=49000, end_port=49999, health_watch_interval=0,
+        host_probe_interval_s=0, job_supervise_interval=interval_s,
+        reconcile_interval=0, admission_enabled=True,
+        admission_interval_s=interval_s,
+        autoscale_interval_s=interval_s,
+        autoscale_up_cooldown_s=interval_s,
+        autoscale_down_cooldown_s=interval_s * 2,
+        gateway_enabled=True, gateway_port=0,
+        gateway_heartbeat_s=0.05, gateway_drain_deadline_s=5.0,
+        gateway_retry_limit=3, gateway_retry_budget_ratio=1.0,
+        gateway_connect_timeout_s=1.0, gateway_request_timeout_s=10.0,
+        gateway_breaker_threshold=5, gateway_breaker_cooldown_s=0.1,
+    ), host="127.0.0.1")
+    prog.init()
+    prog.start()
+
+    # -- stub replica data plane -------------------------------------------
+    class _StubHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _chunk(self, data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+
+        def do_GET(self):
+            body = b'{"status":"ok"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            if n:
+                self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for i in range(3):
+                self._chunk(json.dumps({"t": i}).encode() + b"\n")
+                time.sleep(0.002)
+            self._chunk(b"")
+
+    class _ReplicaSyncer:
+        """Binds a stub HTTP server on every routable endpoint's
+        coordinator port the moment the routing table folds it in — the
+        data-plane half of each fake-runtime replica. A quarantined port
+        (hard-kill window) is left dead until its deadline so the
+        gateway genuinely has to route around the corpse."""
+
+        def __init__(self, gw):
+            self.gw = gw
+            self.servers: dict[int, ThreadingHTTPServer] = {}
+            self.quarantine: dict[int, float] = {}
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            while not self._stop.wait(0.005):
+                try:
+                    desired = {ep.port for ep in
+                               self.gw.table.endpoints("svc")
+                               if ep.routable and ep.port > 0}
+                except Exception:  # pragma: no cover — table mid-fold
+                    continue
+                now = time.monotonic()
+                for port in desired - set(self.servers):
+                    if self.quarantine.get(port, 0) > now:
+                        continue
+                    try:
+                        srv = ThreadingHTTPServer(("127.0.0.1", port),
+                                                  _StubHandler)
+                    except OSError:
+                        continue  # port race with a closing server
+                    threading.Thread(target=srv.serve_forever,
+                                     daemon=True).start()
+                    self.servers[port] = srv
+                for port in set(self.servers) - desired:
+                    self.kill(port, quarantine_s=0.0)
+
+        def kill(self, port: int, quarantine_s: float) -> None:
+            srv = self.servers.pop(port, None)
+            if quarantine_s > 0:
+                self.quarantine[port] = time.monotonic() + quarantine_s
+            if srv is not None:
+                threading.Thread(target=lambda: (srv.shutdown(),
+                                                 srv.server_close()),
+                                 daemon=True).start()
+
+        def close(self):
+            self._stop.set()
+            self._thread.join(timeout=2)
+            for port in list(self.servers):
+                self.kill(port, quarantine_s=0.0)
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out["data"]
+
+    def wait_until(cond, what: str) -> bool:
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if cond():
+                return True
+            time.sleep(0.005)
+        return False
+
+    # -- open-loop generator -----------------------------------------------
+    results: list[dict] = []
+    results_mu = threading.Lock()
+    prefix_keys = [f"prefix-{i}" for i in range(8)]
+    gen_stop = threading.Event()
+    workers: list[threading.Thread] = []
+
+    def one_request(key: str):
+        rec = {"key": key, "status": 0, "endpoint": "", "ttft_ms": None,
+               "truncated": False, "error": ""}
+        t0 = time.perf_counter()
+        try:
+            conn = hc.HTTPConnection("127.0.0.1", gw_port, timeout=15)
+            conn.request("POST", "/v1/svc/generate", body=b"{}",
+                         headers={"Idempotency-Key": f"{key}-{t0}",
+                                  "X-Prefix-Key": key})
+            resp = conn.getresponse()
+            rec["status"] = resp.status
+            rec["endpoint"] = resp.getheader("X-Gateway-Endpoint") or ""
+            body = b""
+            while True:
+                chunk = resp.read1(65536)
+                if rec["ttft_ms"] is None:
+                    rec["ttft_ms"] = (time.perf_counter() - t0) * 1e3
+                if not chunk:
+                    break
+                body += chunk
+            rec["truncated"] = b"gatewayTruncated" in body
+            conn.close()
+        except Exception as e:  # noqa: BLE001 — a failure IS the datum
+            rec["error"] = f"{type(e).__name__}: {str(e)[:120]}"
+        with results_mu:
+            results.append(rec)
+
+    def generator():
+        i = 0
+        period = 1.0 / rps
+        while not gen_stop.is_set():
+            t = threading.Thread(target=one_request,
+                                 args=(prefix_keys[i % len(prefix_keys)],),
+                                 daemon=True)
+            t.start()
+            workers.append(t)
+            i += 1
+            time.sleep(period)
+
+    syncer = _ReplicaSyncer(prog.gateway)
+    events = {"scaled": False, "rolled": False, "kill_recovered": False}
+    try:
+        gw_port = prog.gateway_server.port
+        call("POST", "/api/v1/services", {
+            "serviceName": "svc", "imageName": "serve",
+            "chipsPerReplica": 2, "replicas": 1, "minReplicas": 1,
+            "maxReplicas": 3, "ttftP95TargetMs": 200,
+            "queueDepthTarget": 4, "replicaCapacityRps": 100.0})
+        if not wait_until(lambda: syncer.servers, "first replica bound"):
+            raise RuntimeError("first replica's stub never came up")
+
+        gen = threading.Thread(target=generator, daemon=True)
+        gen.start()
+        slice_s = max(duration_s / 4, 0.3)
+        time.sleep(slice_s)                      # steady on 1 replica
+
+        # event 1: autoscale 1 -> 3 THROUGH the market, under live load
+        call("POST", "/api/v1/services/svc/load", {"rps": 280.0})
+        events["scaled"] = wait_until(
+            lambda: len([ep for ep in prog.gateway.table.endpoints("svc")
+                         if ep.routable]) >= 3 and len(syncer.servers) >= 3,
+            "3 routable replicas")
+        time.sleep(slice_s)
+
+        # event 2: rolling spec update, replica by replica, under load
+        # (job versions start at 0: "rolled" = every family's table
+        # version moved PAST where it was before the PATCH)
+        pre_roll = {ep.family: ep.version
+                    for ep in prog.gateway.table.endpoints("svc")}
+        t_roll = time.perf_counter()
+        call("PATCH", "/api/v1/services/svc", {"imageName": "serve:v2"})
+        roll_s = time.perf_counter() - t_roll
+        events["rolled"] = wait_until(
+            lambda: all(ep.version > pre_roll.get(ep.family, -1) for ep in
+                        prog.gateway.table.endpoints("svc"))
+            and len([ep for ep in prog.gateway.table.endpoints("svc")
+                     if ep.routable]) >= 3,
+            "all replicas rolled and routable")
+        time.sleep(slice_s)
+
+        # event 3: hard-kill one replica mid-load — data plane first
+        # (connects refused for the quarantine window), then the
+        # containers, so the supervisor must also notice and restart
+        victim = next(ep for ep in prog.gateway.table.endpoints("svc")
+                      if ep.routable)
+        syncer.kill(victim.port, quarantine_s=0.3)
+        st = prog.store.get_job(
+            f"{victim.family}-{prog.job_versions.get(victim.family)}")
+        for _, cname, *_rest in st.placements:
+            prog.runtime.crash_container(cname)
+        events["kill_recovered"] = wait_until(
+            lambda: len([ep for ep in prog.gateway.table.endpoints("svc")
+                         if ep.routable and ep.port in syncer.servers]) >= 3,
+            "killed replica recovered")
+        time.sleep(slice_s)
+
+        gen_stop.set()
+        gen.join(timeout=5)
+        for w in workers:
+            w.join(timeout=15)
+
+        # direct-to-replica TTFT baseline with the SAME client code
+        direct_ttfts: list[float] = []
+        direct_port = next(iter(syncer.servers))
+        for _ in range(40):
+            t0 = time.perf_counter()
+            conn = hc.HTTPConnection("127.0.0.1", direct_port, timeout=15)
+            conn.request("POST", "/generate", body=b"{}")
+            resp = conn.getresponse()
+            resp.read1(65536)
+            direct_ttfts.append((time.perf_counter() - t0) * 1e3)
+            resp.read()
+            conn.close()
+
+        # shed probe: force the global in-flight cap to zero — the
+        # refusal must be HTTP 429 + Retry-After + the typed error code
+        old_cap = prog.gateway.max_inflight
+        prog.gateway.max_inflight = 0
+        try:
+            conn = hc.HTTPConnection("127.0.0.1", gw_port, timeout=15)
+            conn.request("POST", "/v1/svc/generate", body=b"{}",
+                         headers={"Idempotency-Key": "shed-probe"})
+            resp = conn.getresponse()
+            shed_body = json.loads(resp.read())
+            shed = {"status": resp.status,
+                    "retry_after": resp.getheader("Retry-After"),
+                    "code": shed_body.get("code")}
+            conn.close()
+        finally:
+            prog.gateway.max_inflight = old_cap
+        gateway_status = prog.gateway.status_view()
+    finally:
+        gen_stop.set()
+        syncer.close()
+        prog.stop()
+
+    def p(ms: list[float], q: float) -> float:
+        if not ms:
+            return 0.0
+        s = sorted(ms)
+        return round(s[min(len(s) - 1, int(len(s) * q))], 3)
+
+    ok = [r for r in results if r["status"] == 200 and not r["truncated"]
+          and not r["error"]]
+    failed = [r for r in results if r["error"] or r["status"] >= 500]
+    sheds_inline = [r for r in results if r["status"] == 429]
+    truncated = [r for r in results if r["truncated"]]
+    ttfts = [r["ttft_ms"] for r in ok if r["ttft_ms"] is not None]
+    ttft_p95 = p(ttfts, 0.95)
+    direct_p95 = p(direct_ttfts, 0.95)
+
+    by_key: dict[str, dict[str, int]] = {}
+    for r in ok:
+        if r["endpoint"]:
+            by_key.setdefault(r["key"], {})
+            by_key[r["key"]][r["endpoint"]] = (
+                by_key[r["key"]].get(r["endpoint"], 0) + 1)
+    modal = sum(max(eps.values()) for eps in by_key.values())
+    keyed = sum(sum(eps.values()) for eps in by_key.values())
+    affinity = round(modal / keyed, 4) if keyed else 0.0
+    random_baseline = round(1 / 3, 4)
+
+    gates = {
+        "requests_total": len(results),
+        "zero_dropped": (len(failed) == 0 and len(truncated) == 0
+                         and len(sheds_inline) == 0 and len(ok) > 0),
+        "scaled_under_load": events["scaled"],
+        "rolled_under_load": events["rolled"],
+        "roll_patch_s": round(roll_s, 3),
+        # roll acks (not deadline expiry) must release each replica: a
+        # 3-replica roll that burns even ONE full drain deadline is the
+        # marker-behind-the-pointer regression
+        "roll_acked_fast": roll_s < 5.0,
+        "kill_recovered": events["kill_recovered"],
+        "ttft_p95_ms": ttft_p95,
+        "ttft_direct_p95_ms": direct_p95,
+        "ttft_overhead_ms": round(ttft_p95 - direct_p95, 3),
+        "ttft_overhead_budget_ms": ttft_overhead_budget_ms,
+        "ttft_overhead_ok": ttft_p95 - direct_p95 <= ttft_overhead_budget_ms,
+        "affinity_rate": affinity,
+        "affinity_random_baseline": random_baseline,
+        "affinity_beats_random": affinity > random_baseline,
+        "shed_typed": (shed["status"] == 429
+                       and shed["retry_after"] is not None
+                       and shed["code"] == _errors.GatewayShed.code),
+    }
+    gates["ok"] = bool(
+        gates["zero_dropped"] and gates["scaled_under_load"]
+        and gates["rolled_under_load"] and gates["roll_acked_fast"]
+        and gates["kill_recovered"]
+        and gates["ttft_overhead_ok"] and gates["affinity_beats_random"]
+        and gates["shed_typed"] and len(results) >= 20)
+    return {
+        "family": "serve-traffic",
+        "iters": {"duration_s": duration_s, "rps": rps,
+                  "prefix_keys": len(prefix_keys)},
+        "requests": {"total": len(results), "ok": len(ok),
+                     "failed": len(failed), "shed": len(sheds_inline),
+                     "truncated": len(truncated),
+                     "errors": sorted({r["error"] for r in failed
+                                       if r["error"]})[:5]},
+        "ttft_ms": {"p50": p(ttfts, 0.5), "p95": ttft_p95,
+                    "direct_p95": direct_p95,
+                    "overhead_p95": round(ttft_p95 - direct_p95, 3)},
+        "affinity": {"rate": affinity, "random": random_baseline,
+                     "keys": len(by_key)},
+        "events": events,
+        "shed_probe": shed,
+        "gateway": {"retries": gateway_status["counters"].get(
+                        "retries", 0),
+                    "hedges": gateway_status["counters"].get("hedges", 0),
+                    "breakerOpens": gateway_status["counters"].get(
+                        "breakerOpens", 0)},
+        "gates": gates,
+    }
+
+
 #: every control-plane family name — the one list argparse, the degraded
 #: path and the dispatchers validate against (a typo'd family must fail
 #: loudly, never silently fall through to a different benchmark)
@@ -2226,7 +2605,8 @@ def measure_control_plane_scale(n_objects: int = 50000, n_small: int = 1000,
 
 
 CP_FAMILIES = ("create", "churn", "failover", "reads", "fanout",
-               "preempt", "resize", "serve-scale", "scale", "shard")
+               "preempt", "resize", "serve-scale", "serve-traffic",
+               "scale", "shard")
 
 
 # control-plane family dispatch — shared by the --control-plane branch
@@ -2259,11 +2639,59 @@ def _run_cp_family(family: str, args) -> dict:
         return measure_control_plane_resize(iters=args.resize_iters)
     if family == "serve-scale":
         return measure_control_plane_serve_scale(iters=args.serve_iters)
+    if family == "serve-traffic":
+        return measure_control_plane_serve_traffic(
+            duration_s=args.traffic_duration, rps=args.traffic_rps)
     if family == "scale":
         return measure_control_plane_scale(
             n_objects=args.scale_objects, n_small=args.scale_small,
             n_gangs=args.scale_gangs, retention=args.scale_retention)
     return measure_control_plane(args.cp_iters, args.cp_runtime)
+
+
+def _run_cp_family_budgeted(family: str, args, budget_s: float) -> dict:
+    """Run one control-plane family under its own WALL budget. The family
+    runs in a worker thread; when the budget expires the caller gets a
+    ``TimeoutError`` immediately instead of blocking until the driver's
+    hard kill — so this family's structured line (and every later
+    family's) reaches the artifact before the deadline. The abandoned
+    worker is a daemon thread: it dies with the process and its result,
+    if one ever materializes, is discarded."""
+    box: dict = {}
+
+    def run():
+        try:
+            box["cp"] = _run_cp_family(family, args)
+        except Exception as e:  # noqa: BLE001 — re-raised on the caller
+            box["err"] = e
+
+    t0 = time.monotonic()
+    worker = threading.Thread(target=run, daemon=True,
+                              name=f"cp-family-{family}")
+    worker.start()
+    worker.join(timeout=max(budget_s, 1e-3))
+    if "err" in box:
+        raise box["err"]
+    if "cp" not in box:
+        raise TimeoutError(
+            f"family wall budget exhausted after {budget_s:.1f}s")
+    cp = box["cp"]
+    if isinstance(cp, dict):
+        cp.setdefault("wall_s", round(time.monotonic() - t0, 3))
+    return cp
+
+
+def _family_budget_s(args, fallback_s: float) -> float:
+    """Per-family budget: ``--family-budget`` wins, then
+    ``BENCH_FAMILY_BUDGET_S``, then the caller's fallback (the remaining
+    share of the run's total budget)."""
+    if getattr(args, "family_budget", 0.0):
+        return float(args.family_budget)
+    try:
+        env = float(os.environ.get("BENCH_FAMILY_BUDGET_S", 0) or 0)
+    except ValueError:
+        env = 0.0
+    return env if env > 0 else fallback_s
 
 
 def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
@@ -2292,6 +2720,9 @@ def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
     if family == "serve-scale":
         return ("control_plane_serve_scale_time_to_scaled_ms_p50",
                 cp["time_to_scaled_ms"]["p50"], "ms")
+    if family == "serve-traffic":
+        return ("control_plane_serve_traffic_ttft_p95_ms",
+                cp["ttft_ms"]["p95"], "ms")
     if family == "scale":
         return ("control_plane_scale_steady_reconcile_reads",
                 cp["steady_reads"], "reads")
@@ -2308,10 +2739,11 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
     ``BENCH_DEGRADED_FAMILIES`` (comma list) overrides the default set."""
     families = [f.strip() for f in os.environ.get(
         "BENCH_DEGRADED_FAMILIES",
-        "churn,preempt,resize,serve-scale,scale,shard").split(",")
+        "churn,preempt,resize,serve-scale,serve-traffic,scale,shard"
+        ).split(",")
         if f.strip()]
     green = 0
-    for family in families:
+    for idx, family in enumerate(families):
         if family not in CP_FAMILIES:
             emit({"metric": f"control_plane_{family}", "value": None,
                   "unit": "ms", "vs_baseline": None, "rc": 1,
@@ -2325,8 +2757,14 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
                   "unit": "ms", "vs_baseline": None, "rc": 1,
                   "error": {"error": "budget exhausted", "family": family}})
             continue
+        # each family gets an equal share of what's left, so one slow
+        # family consumes ITS slice of the wall, never the families
+        # behind it in line
+        remaining = max(deadline - time.monotonic(), 1e-3)
+        share = remaining / max(len(families) - idx, 1)
         try:
-            cp = _run_cp_family(family, args)
+            cp = _run_cp_family_budgeted(
+                family, args, min(_family_budget_s(args, share), remaining))
         except Exception as e:  # noqa: BLE001 — one family must not
             # erase the others' evidence
             emit({"metric": f"control_plane_{family}", "value": None,
@@ -2425,6 +2863,13 @@ def main() -> int | None:
     parser.add_argument("--serve-iters", type=int, default=3,
                         help="offered-load step cycles for the serve-scale "
                              "family")
+    parser.add_argument("--traffic-duration", type=float, default=4.0,
+                        help="open-loop load seconds for the serve-traffic "
+                             "family (split across steady / autoscale / "
+                             "roll / hard-kill phases)")
+    parser.add_argument("--traffic-rps", type=float, default=40.0,
+                        help="open-loop request rate through the gateway "
+                             "for the serve-traffic family")
     parser.add_argument("--scale-objects", type=int, default=50000,
                         help="container families seeded for the scale "
                              "family's big world")
@@ -2463,6 +2908,12 @@ def main() -> int | None:
     parser.add_argument("--budget", type=float, default=0.0,
                         help="total seconds budget; 0 = env BENCH_BUDGET_S "
                              "or 1500")
+    parser.add_argument("--family-budget", type=float, default=0.0,
+                        help="per-control-plane-family wall budget "
+                             "seconds; a family that exceeds it emits a "
+                             "structured timeout line and the run moves "
+                             "on. 0 = env BENCH_FAMILY_BUDGET_S, else an "
+                             "equal share of the remaining total budget")
     args = parser.parse_args()
     try:
         budget_s = args.budget or float(
@@ -2476,7 +2927,8 @@ def main() -> int | None:
         # probe must exit nonzero with a structured line, never silently
         # produce an empty artifact the driver reads as "pass"
         try:
-            cp = _run_cp_family(args.cp_family, args)
+            cp = _run_cp_family_budgeted(
+                args.cp_family, args, _family_budget_s(args, budget_s))
         except Exception as e:
             emit({"metric": f"control_plane_{args.cp_family}", "value": None,
                   "unit": "ms", "vs_baseline": None, "rc": 1,

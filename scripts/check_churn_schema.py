@@ -283,6 +283,92 @@ def validate_serve_scale(extra: dict) -> list[str]:
     return problems
 
 
+def validate_serve_traffic(extra: dict) -> list[str]:
+    """The serving-gateway traffic family headline payload: open-loop
+    streamed requests across an autoscale, a rolling spec update and a
+    hard replica kill. The zero-drop, TTFT-overhead, affinity, roll-ack
+    and typed-shed gates are re-DERIVED from their raw inputs here (not
+    just gates.ok): a run that dropped requests, burned a drain deadline
+    per rolled replica, or shed with an untyped refusal must fail loudly
+    at the schema layer too."""
+    problems: list[str] = []
+    req = extra.get("requests") or {}
+    total = req.get("total")
+    if not (isinstance(total, int) and total >= 20):
+        problems.append(f"serve-traffic: requests.total must be an int "
+                        f">= 20, got {total!r} (the load loop never ran)")
+    for key in ("ok", "failed", "shed", "truncated"):
+        if not isinstance(req.get(key), int):
+            problems.append(f"serve-traffic: requests.{key} missing")
+    gates = extra.get("gates") or {}
+    for key in ("zero_dropped", "scaled_under_load", "rolled_under_load",
+                "roll_patch_s", "roll_acked_fast", "kill_recovered",
+                "ttft_p95_ms", "ttft_direct_p95_ms", "ttft_overhead_ms",
+                "ttft_overhead_budget_ms", "ttft_overhead_ok",
+                "affinity_rate", "affinity_random_baseline",
+                "affinity_beats_random", "shed_typed", "ok"):
+        if key not in gates:
+            problems.append(f"serve-traffic: gates.{key} missing")
+    dropped = sum(req.get(k) or 0 for k in ("failed", "shed", "truncated"))
+    if bool(gates.get("zero_dropped")) != (dropped == 0
+                                           and (req.get("ok") or 0) > 0):
+        problems.append(f"serve-traffic: gates.zero_dropped "
+                        f"{gates.get('zero_dropped')!r} contradicts the "
+                        f"request counts {req}")
+    if dropped:
+        problems.append(f"serve-traffic: {dropped} requests dropped across "
+                        f"roll/autoscale/kill ({req}) — the zero-drop "
+                        f"contract is broken")
+    ttft = extra.get("ttft_ms") or {}
+    for key in ("p50", "p95", "direct_p95"):
+        if not _num(ttft.get(key)) or ttft[key] <= 0:
+            problems.append(f"serve-traffic: ttft_ms.{key} must be a "
+                            f"positive number, got {ttft.get(key)!r}")
+    over = gates.get("ttft_overhead_ms")
+    budget = gates.get("ttft_overhead_budget_ms")
+    if _num(over) and _num(budget) and bool(
+            gates.get("ttft_overhead_ok")) != (over <= budget):
+        problems.append(f"serve-traffic: gates.ttft_overhead_ok "
+                        f"{gates.get('ttft_overhead_ok')!r} contradicts "
+                        f"overhead {over!r}ms vs budget {budget!r}ms")
+    aff = extra.get("affinity") or {}
+    rate, rand = aff.get("rate"), aff.get("random")
+    if not _num(rate) or not _num(rand) or bool(
+            gates.get("affinity_beats_random")) != (rate > rand):
+        problems.append(f"serve-traffic: gates.affinity_beats_random "
+                        f"{gates.get('affinity_beats_random')!r} "
+                        f"contradicts rate {rate!r} vs random {rand!r}")
+    roll_s = gates.get("roll_patch_s")
+    if not _num(roll_s) or bool(gates.get("roll_acked_fast")) \
+            != (roll_s < 5.0):
+        problems.append(f"serve-traffic: gates.roll_acked_fast "
+                        f"{gates.get('roll_acked_fast')!r} contradicts "
+                        f"roll_patch_s {roll_s!r} — a roll that burns a "
+                        f"drain deadline means gateway acks are broken")
+    shed = extra.get("shed_probe") or {}
+    typed = (shed.get("status") == 429
+             and shed.get("retry_after") is not None
+             and isinstance(shed.get("code"), int))
+    if bool(gates.get("shed_typed")) != typed:
+        problems.append(f"serve-traffic: gates.shed_typed "
+                        f"{gates.get('shed_typed')!r} contradicts the "
+                        f"probe reply {shed!r}")
+    for key in ("scaled_under_load", "rolled_under_load", "kill_recovered"):
+        if gates.get(key) is not True:
+            problems.append(f"serve-traffic: gates.{key} is "
+                            f"{gates.get(key)!r}")
+    sub = ("zero_dropped", "scaled_under_load", "rolled_under_load",
+           "roll_acked_fast", "kill_recovered", "ttft_overhead_ok",
+           "affinity_beats_random", "shed_typed")
+    if bool(gates.get("ok")) != all(gates.get(k) is True for k in sub):
+        problems.append(f"serve-traffic: gates.ok {gates.get('ok')!r} "
+                        f"contradicts its sub-gates "
+                        f"{dict((k, gates.get(k)) for k in sub)}")
+    if gates.get("ok") is not True:
+        problems.append(f"serve-traffic: regression gate failed: {gates}")
+    return problems
+
+
 def validate_scale(extra: dict) -> list[str]:
     """The O(100k)-object scale family headline payload. The O(changes)
     read-count, the flat-list ratio and the retention bound are
@@ -586,6 +672,10 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "serve-scale"]
     if serve:
         return problems + validate_serve_scale(serve[0]["extra"])
+    traffic = [ln for ln in lines
+               if (ln.get("extra") or {}).get("family") == "serve-traffic"]
+    if traffic:
+        return problems + validate_serve_traffic(traffic[0]["extra"])
     scale = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "scale"]
     if scale:
@@ -598,8 +688,8 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
         return problems + ["no churn, failover, reads, fanout, preempt, "
-                           "resize, serve-scale, scale or shard headline "
-                           "line (extra.family)"]
+                           "resize, serve-scale, serve-traffic, scale or "
+                           "shard headline line (extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

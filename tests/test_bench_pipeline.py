@@ -501,6 +501,71 @@ class TestServeScaleFamily:
         assert len(serve["scaled_ms"]) == 2
 
 
+class TestServeTrafficFamily:
+    """The serving-gateway traffic family (``make bench-serve-traffic``)
+    at tiny scale — pinning the artifact schema and the tentpole
+    invariants: open-loop streamed load rides through an autoscale, a
+    rolling spec update and a hard replica kill with ZERO dropped
+    requests, the gateway's TTFT overhead stays in budget, prefix
+    affinity beats random, rolls are released by gateway acks (not by
+    burning the drain deadline), and overload sheds with a typed 429."""
+
+    @pytest.fixture(scope="class")
+    def traffic(self):
+        return bench.measure_control_plane_serve_traffic(
+            duration_s=2.0, rps=30.0)
+
+    def test_schema_checker_accepts_the_emitted_line(self, traffic):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_serve_traffic_ttft_p95_ms",
+                "value": traffic["ttft_ms"]["p95"],
+                "unit": "ms", "vs_baseline": 1.0, "extra": traffic}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... a dropped request must fail even if gates.ok still lies
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["requests"]["failed"] = 3
+        assert any("zero-drop" in p or "zero_dropped" in p
+                   for p in validate_lines([bad]))
+        # ... and a roll that burned a drain deadline is the ack
+        # regression this family exists to catch
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["roll_patch_s"] = 10.0
+        assert any("ack" in p for p in validate_lines([bad]))
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["shed_probe"]["status"] = 503
+        assert any("shed_typed" in p for p in validate_lines([bad]))
+
+    def test_serve_traffic_gates_hold(self, traffic):
+        gates = traffic["gates"]
+        assert gates["ok"] is True
+        # the tentpole: zero dropped requests across all three events
+        req = traffic["requests"]
+        assert req["failed"] == 0 and req["truncated"] == 0 \
+            and req["shed"] == 0 and req["ok"] >= 20
+        assert gates["scaled_under_load"] is True
+        assert gates["rolled_under_load"] is True
+        assert gates["kill_recovered"] is True
+        # rolls are released by gateway roll-acks, not deadline expiry
+        assert gates["roll_acked_fast"] is True
+        assert gates["roll_patch_s"] < 5.0
+        assert gates["ttft_overhead_ok"] is True
+        assert gates["affinity_beats_random"] is True
+        # the shed probe got the typed refusal contract
+        shed = traffic["shed_probe"]
+        assert shed["status"] == 429
+        assert shed["retry_after"] is not None
+        assert isinstance(shed["code"], int)
+
+
 class TestScaleFamily:
     """The O(100k)-object scale family (``make bench-scale``) at tiny
     scale — pinning the artifact schema (scripts/check_churn_schema.py)
@@ -658,6 +723,73 @@ def test_headline_prints_first_end_to_end():
     # the tail-parse anchor stays compact (r3's parsed:null was a
     # multi-KB line overflowing the driver's bounded tail read)
     assert len(json.dumps(last)) < 1024
+
+
+class TestFamilyBudget:
+    """Per-family wall budgets: a hung control-plane family must emit a
+    structured timeout line within ITS slice of the wall — incrementally,
+    before the driver's hard kill — and the families queued behind it
+    still run."""
+
+    def test_fast_family_passes_through_with_wall_stamp(self, monkeypatch):
+        monkeypatch.setattr(bench, "_run_cp_family",
+                            lambda fam, args: {"family": fam})
+        cp = bench._run_cp_family_budgeted("churn", None, 5.0)
+        assert cp["family"] == "churn"
+        assert cp["wall_s"] >= 0
+
+    def test_hung_family_raises_timeout_within_budget(self, monkeypatch):
+        monkeypatch.setattr(bench, "_run_cp_family",
+                            lambda fam, args: time.sleep(60))
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="wall budget"):
+            bench._run_cp_family_budgeted("churn", None, 0.1)
+        assert time.monotonic() - t0 < 5
+
+    def test_family_error_propagates_untouched(self, monkeypatch):
+        def boom(fam, args):
+            raise RuntimeError("family exploded")
+
+        monkeypatch.setattr(bench, "_run_cp_family", boom)
+        with pytest.raises(RuntimeError, match="family exploded"):
+            bench._run_cp_family_budgeted("churn", None, 5.0)
+
+    def test_degraded_path_contains_the_hang_and_keeps_going(
+            self, monkeypatch):
+        """End-to-end through degraded_control_plane_evidence: family one
+        hangs past its budget → rc-1 timeout line on the artifact; family
+        two still runs green; the summary line closes the artifact."""
+        calls = []
+
+        def fake_run(fam, args):
+            calls.append(fam)
+            if fam == "churn":
+                time.sleep(30)
+            return {"family": fam, "gates": {"ok": True},
+                    "create_ready_ms_p50": 1.0,
+                    "time_to_shrunk_ms": {"p50": 1.0}}
+
+        monkeypatch.setattr(bench, "_run_cp_family", fake_run)
+        monkeypatch.setenv("BENCH_DEGRADED_FAMILIES", "churn,resize")
+        monkeypatch.setenv("BENCH_FAMILY_BUDGET_S", "0.1")
+        args = bench.argparse.Namespace(family_budget=0.0)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = bench.degraded_control_plane_evidence(
+                args, time.monotonic() + 60)
+        lines = [json.loads(ln) for ln in buf.getvalue().splitlines() if ln]
+        assert rc == 0  # resize was green, so the artifact is partial-green
+        assert calls == ["churn", "resize"]
+        assert all(SCHEMA_KEYS <= set(ln) for ln in lines)
+        churn_ln = next(ln for ln in lines
+                        if (ln.get("error") or {}).get("family") == "churn")
+        assert churn_ln["rc"] == 1
+        assert "wall budget exhausted" in churn_ln["error"]["error"]
+        resize_ln = next(ln for ln in lines
+                         if (ln.get("extra") or {}).get("family") == "resize")
+        assert resize_ln["rc"] == 0
+        assert lines[-1]["metric"] == "bench_degraded"
+        assert lines[-1]["value"] == 1
 
 
 def test_bench_boot_line_fails_fast_on_backend_init_error():

@@ -149,12 +149,16 @@ def test_case_matrix_covers_every_crash_point():
     # the daemon at every service.* lifecycle point
     from tpu_docker_api.service.crashpoints import SERVICE_CRASH_POINTS
 
+    # the gateway matrix (tests/test_gateway.py TestGatewayChaos) kills
+    # the daemon at every gateway.* drain-handshake point
+    from tpu_docker_api.service.crashpoints import GATEWAY_CRASH_POINTS
+
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
             | set(LEADER_CRASH_POINTS) | set(SHARD_CRASH_POINTS)
             | set(FANOUT_CRASH_POINTS)
             | set(ADMISSION_CRASH_POINTS) | set(RESIZE_CRASH_POINTS)
-            | set(SERVICE_CRASH_POINTS)
+            | set(SERVICE_CRASH_POINTS) | set(GATEWAY_CRASH_POINTS)
             | set(RECONCILE_CRASH_POINTS) | set(COMPACTOR_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 

@@ -530,6 +530,46 @@ class TestConnectionPool:
         rt.close()
         assert rt.pool_view()["idle"] == 0
 
+    def test_stale_socket_storm_cannot_exhaust_pool(self, rt):
+        """Regression: a dockerd restart kills EVERY idle connection at
+        once. A concurrent request burst right after must (a) drop each
+        corpse exactly once, (b) surface zero errors, (c) dial a bounded
+        number of replacements — never a connection per request — and
+        (d) leave no leaked in-use slots behind."""
+        pool = rt._pool
+        conns = [pool.acquire(rt._open_connection, 5.0)[0]
+                 for _ in range(pool.size)]
+        for c in conns:
+            c.connect()  # sock is dialed lazily; a parked conn has one
+            pool.release(c, reusable=True)
+        assert rt.pool_view()["idle"] == pool.size
+        for c in list(pool._idle):
+            c.sock.shutdown(socket.SHUT_RDWR)
+        created_before = rt.pool_view()["created"]
+        errs: list[Exception] = []
+
+        def worker():
+            try:
+                for _ in range(5):
+                    rt.container_list()
+            except Exception as e:  # noqa: BLE001 — collected for assert
+                errs.append(e)
+
+        workers = [threading.Thread(target=worker) for _ in range(8)]
+        for t in workers:
+            t.start()
+        for t in workers:
+            t.join(timeout=30)
+        assert errs == []
+        view = rt.pool_view()
+        assert view["staleDropped"] == pool.size
+        assert view["inUse"] == 0
+        assert view["idle"] <= view["size"]
+        # 8 workers can race past the idle list simultaneously, but the
+        # storm's dial count is bounded by concurrency, not by the 40
+        # requests served
+        assert view["created"] - created_before <= 8
+
 
 DOCKER_SOCK = "/var/run/docker.sock"
 

@@ -1,0 +1,944 @@
+"""Serving-gateway tests (docs/robustness.md "Serving gateway").
+
+Property tier, pinned:
+
+- the routing table folds watch events only (phase + ``draining`` +
+  ``desired_running`` + placement → routable), zero store reads per pick;
+- prefix-affine rendezvous hashing is STABLE: draining one replica moves
+  only the keys that hashed onto it;
+- retry budget: idempotent-only, capped, and exhaustion surfaces the
+  LAST upstream error verbatim — never a generic 502;
+- circuit breaker: consecutive failures open it; the half-open probe is
+  single-flight even while the probe itself is a live streaming request;
+- hedging: first byte wins, the loser is cancelled and never pooled;
+- load shedding is TYPED (429 GatewayShed / 503 GatewayNoEndpoints);
+- streaming passthrough: mid-stream upstream death yields one final
+  ``{"gatewayTruncated": ...}`` line, never a silent EOF;
+- drain handshake: the durable ``draining`` marker lands strictly
+  BEFORE the first member stop, live gateways ack at zero in-flight,
+  the control plane's wait is deadline-bounded and vacuous with no
+  gateways, and reconcile adopts a crash-abandoned marker;
+- chaos: a daemon kill at every ``gateway.*`` crash point converges.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from tpu_docker_api import config as config_mod
+from tpu_docker_api import errors
+from tpu_docker_api.api.gateway_app import GatewayServer
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.schemas.job import JobRun
+from tpu_docker_api.schemas.service import SERVICE_OWNER_ENV, ServiceCreate
+from tpu_docker_api.service.crashpoints import (
+    GATEWAY_CRASH_POINTS,
+    SimulatedCrash,
+    armed,
+)
+from tpu_docker_api.service.gateway import (
+    DrainCoordinator,
+    Gateway,
+    rendezvous_order,
+)
+from tpu_docker_api.service.invariants import (
+    check_invariants,
+    check_job_invariants,
+    check_service_invariants,
+)
+from tpu_docker_api.state import keys
+from tpu_docker_api.state.kv import MemoryKV
+from tpu_docker_api.telemetry.trace import Tracer
+
+# ---------------------------------------------------------------------------
+# harness
+
+
+class StubReplica:
+    """One fake replica endpoint speaking the serve/__main__.py protocol
+    shapes the gateway proxies: buffered JSON, typed errors, chunked
+    streams — plus failure injection (hold, die mid-stream)."""
+
+    def __init__(self, mode: str = "json", status: int = 503,
+                 delay_s: float = 0.0, fail_times: int = 0):
+        self.mode = mode
+        self.status = status
+        self.delay_s = delay_s
+        self.fail_times = fail_times
+        self.hits = 0
+        self.headers_seen: list[dict] = []
+        self.release = threading.Event()
+        self.release.set()
+        self._mu = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _respond(self):
+                with outer._mu:
+                    outer.hits += 1
+                    n = outer.hits
+                    outer.headers_seen.append(dict(self.headers.items()))
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+                if outer.delay_s:
+                    time.sleep(outer.delay_s)
+                mode = outer.mode
+                if mode == "fail_then_ok" and n <= outer.fail_times:
+                    mode = "error"
+                if mode == "fail_then_held_stream":
+                    mode = "error" if n <= outer.fail_times \
+                        else "held_stream"
+                if mode == "json":
+                    body = json.dumps({"server": outer.port,
+                                       "hit": n}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif mode == "error":
+                    body = json.dumps({"boom": n}).encode()
+                    self.send_response(outer.status)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif mode == "hang":
+                    outer.release.wait(10)
+                    body = json.dumps({"server": outer.port,
+                                       "hit": n}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif mode == "held_stream":
+                    # headers withheld until release: the request has no
+                    # first byte while held (half-open probe window)
+                    outer.release.wait(10)
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._chunk(json.dumps({"t": 0}).encode() + b"\n")
+                    self._chunk(b"")
+                elif mode == "stream":
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    for i in range(3):
+                        self._chunk(json.dumps({"t": i}).encode() + b"\n")
+                    self._chunk(b"")
+                elif mode == "die_mid_stream":
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._chunk(json.dumps({"t": 0}).encode() + b"\n")
+                    self.wfile.flush()
+                    # kill the socket without the terminating chunk: the
+                    # reader sees a protocol-violating EOF (shutdown, not
+                    # close — rfile/wfile hold dup'd fds, so only a
+                    # shutdown actually puts the FIN on the wire)
+                    import socket as _s
+
+                    self.connection.shutdown(_s.SHUT_RDWR)
+                    self.close_connection = True
+                else:  # pragma: no cover
+                    raise AssertionError(f"unknown mode {outer.mode}")
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data)
+                self.wfile.write(b"\r\n")
+
+            do_GET = do_POST = do_DELETE = _respond
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.release.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def mk_gw(kv=None, **kw) -> Gateway:
+    kw.setdefault("retry_limit", 2)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.005)
+    kw.setdefault("breaker_cooldown_s", 0.05)
+    kw.setdefault("heartbeat_s", 0.05)
+    return Gateway(kv if kv is not None else MemoryKV(),
+                   resolve_addr=lambda hid: "127.0.0.1",
+                   tracer=Tracer(), **kw)
+
+
+def feed(gw: Gateway, base: str, port: int, version: int = 1,
+         service: str = "web", **over) -> None:
+    """Push one replica's job version record + latest pointer through
+    the routing table exactly as the informer would."""
+    d = {"env": [f"{SERVICE_OWNER_ENV}={service}"], "phase": "running",
+         "desired_running": True, "placements": [["h0", f"{base}-c0"]],
+         "coordinator_port": port, **over}
+    gw.table._observe_job(SimpleNamespace(
+        op="put", key=f"{keys.PREFIX}/jobs/{base}/v/{version:010d}",
+        value=json.dumps(d)))
+    gw.table._observe_job(SimpleNamespace(
+        op="put", key=f"{keys.PREFIX}/jobs/{base}/latest",
+        value=str(version)))
+
+
+def wait_for(cond, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- Program harness (chaos + mark-before-stop), test_service.py shape --------
+
+
+def boot(kv=None, runtimes=None) -> Program:
+    kv = kv if kv is not None else MemoryKV()
+    runtimes = runtimes or {"h0": FakeRuntime()}
+    cfg = config_mod.Config(
+        store_backend="memory", runtime_backend="fake",
+        health_watch_interval=0, end_port=40099,
+        admission_enabled=True, admission_interval_s=0,
+        autoscale_interval_s=0,
+        autoscale_up_cooldown_s=0, autoscale_down_cooldown_s=0,
+    )
+    prg = Program(cfg, kv=kv, runtime=runtimes["h0"],
+                  pod_runtimes={h: r for h, r in runtimes.items()
+                                if h != "h0"})
+    prg.init()
+    return prg
+
+
+def create(prg, name="web", chips=2, replicas=1, max_replicas=3, **kw):
+    return prg.serving.create_service(ServiceCreate(
+        service_name=name, image_name="serve", chips_per_replica=chips,
+        replicas=replicas, max_replicas=max_replicas, **kw))
+
+
+def oracle(prg) -> list[str]:
+    problems = check_service_invariants(
+        prg.store, prg.service_versions, prg.job_versions)
+    problems += check_job_invariants(
+        prg.pod, prg.pod_scheduler, prg.store, prg.job_versions)
+    problems += check_invariants(
+        prg.runtime, prg.store, prg.container_versions,
+        prg.chip_scheduler, prg.port_scheduler,
+        job_versions=prg.job_versions)
+    return problems
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestRoutingTable:
+    def test_running_replica_is_routable(self):
+        gw = mk_gw()
+        feed(gw, "web.r0", 40001)
+        [ep] = gw.table.endpoints("web")
+        assert ep.routable and ep.address == "127.0.0.1" \
+            and ep.port == 40001
+
+    def test_draining_marker_and_preempted_phase_unroutable(self):
+        gw = mk_gw()
+        feed(gw, "web.r0", 40001, draining=True)
+        feed(gw, "web.r1", 40002, phase="preempted")
+        feed(gw, "web.r2", 40003)
+        routable = [ep.family for ep in gw.table.endpoints("web")
+                    if ep.routable]
+        assert routable == ["web.r2"]
+        # both shapes count as draining (the preempted flip IS the
+        # admission path's mark-before-stop)
+        assert gw.table.draining_families() == ["web.r0", "web.r1"]
+
+    def test_latest_pointer_wins_over_max_version(self):
+        gw = mk_gw()
+        feed(gw, "web.r0", 40001, version=1)
+        feed(gw, "web.r0", 40002, version=2, phase="queued")
+        # pointer still at 1 (roll in flight): v1 is authoritative
+        gw.table._observe_job(SimpleNamespace(
+            op="put", key=f"{keys.PREFIX}/jobs/web.r0/latest", value="1"))
+        ep = gw.table.endpoint("web.r0")
+        assert ep.version == 1 and ep.routable and ep.port == 40001
+
+    def test_plain_gang_never_enters_table(self):
+        gw = mk_gw()
+        feed(gw, "train", 40001, env=[])
+        assert gw.table.endpoints("web") == []
+        assert gw.table.endpoint("train") is None
+
+    def test_delete_drops_endpoint(self):
+        gw = mk_gw()
+        feed(gw, "web.r0", 40001)
+        gw.table._observe_job(SimpleNamespace(
+            op="delete", key=f"{keys.PREFIX}/jobs/web.r0/v/0000000001",
+            value=None))
+        gw.table._observe_job(SimpleNamespace(
+            op="delete", key=f"{keys.PREFIX}/jobs/web.r0/latest",
+            value=None))
+        assert gw.table.endpoint("web.r0") is None
+
+    def test_new_version_resets_breaker_state(self):
+        """A rolled replica is a NEW server — its predecessor's failure
+        history must not follow it."""
+        gw = mk_gw()
+        feed(gw, "web.r0", 40001)
+        ep = gw.table.endpoint("web.r0")
+        ep.consecutive_failures = 5
+        ep.breaker_open_since = 1.0
+        feed(gw, "web.r0", 40002, version=2)
+        ep = gw.table.endpoint("web.r0")
+        assert ep.consecutive_failures == 0 \
+            and ep.breaker_open_since is None
+
+
+class TestRendezvousStability:
+    def test_drain_moves_only_the_drained_keys(self):
+        fams = [f"web.r{i}" for i in range(4)]
+        keys_ = [f"prefix-{i}" for i in range(200)]
+        before = {k: rendezvous_order(fams, k)[0] for k in keys_}
+        removed = "web.r2"
+        after = {k: rendezvous_order(
+            [f for f in fams if f != removed], k)[0] for k in keys_}
+        moved = [k for k in keys_ if before[k] != after[k]]
+        # exactly the keys whose first choice drained move — and they
+        # move to their SECOND rendezvous choice, nothing reshuffles
+        assert set(moved) == {k for k in keys_ if before[k] == removed}
+        for k in moved:
+            assert after[k] == rendezvous_order(fams, k)[1]
+
+    def test_prefix_key_is_affine_and_falls_through_on_drain(self):
+        a, b = StubReplica(), StubReplica()
+        gw = mk_gw()
+        try:
+            feed(gw, "web.r0", a.port)
+            feed(gw, "web.r1", b.port)
+            key = "prompt-prefix-7"
+            first = rendezvous_order(["web.r0", "web.r1"], key)[0]
+            target = {"web.r0": a, "web.r1": b}[first]
+            other = b if target is a else a
+            for _ in range(3):
+                r = gw.request("web", "GET", "/metrics", {}, b"",
+                               prefix_key=key)
+                assert r.status == 200 and r.endpoint == first
+            assert (target.hits, other.hits) == (3, 0)
+            # drain the affine replica: the key falls through to the
+            # rendezvous runner-up; un-keyed traffic was never pinned
+            feed(gw, first, target.port, draining=True)
+            r = gw.request("web", "GET", "/metrics", {}, b"",
+                           prefix_key=key)
+            assert r.endpoint != first and other.hits == 1
+        finally:
+            a.close(), b.close()
+
+
+class TestRetryBudget:
+    def test_exhaustion_returns_last_upstream_error_verbatim(self):
+        stub = StubReplica(mode="error", status=503)
+        gw = mk_gw(retry_limit=2)
+        try:
+            feed(gw, "web.r0", stub.port)
+            r = gw.request("web", "GET", "/healthz", {}, b"")
+            # 1 try + 2 retries; the FINAL reply rides back untouched —
+            # status, body and all — never a synthesized 502
+            assert stub.hits == 3
+            assert r.status == 503
+            assert json.loads(r.body) == {"boom": 3}
+            assert r.attempts == 3
+        finally:
+            stub.close()
+
+    def test_non_idempotent_never_retried(self):
+        stub = StubReplica(mode="error", status=500)
+        gw = mk_gw(retry_limit=2)
+        try:
+            feed(gw, "web.r0", stub.port)
+            r = gw.request("web", "POST", "/generate", {}, b"{}")
+            assert stub.hits == 1 and r.status == 500
+        finally:
+            stub.close()
+
+    def test_idempotency_key_opts_posts_in(self):
+        stub = StubReplica(mode="error", status=500)
+        gw = mk_gw(retry_limit=2)
+        try:
+            feed(gw, "web.r0", stub.port)
+            r = gw.request("web", "POST", "/generate",
+                           {"Idempotency-Key": "abc"}, b"{}")
+            assert stub.hits == 3 and r.status == 500
+        finally:
+            stub.close()
+
+    def test_token_budget_bounds_retry_amplification(self):
+        stub = StubReplica(mode="error", status=503)
+        # no completion dividend: the initial retry_limit tokens are all
+        # the budget there ever is
+        gw = mk_gw(retry_limit=2, retry_budget_ratio=0.0,
+                   breaker_threshold=0)
+        try:
+            feed(gw, "web.r0", stub.port)
+            gw.request("web", "GET", "/a", {}, b"")     # spends 2 tokens
+            assert stub.hits == 3
+            gw.request("web", "GET", "/b", {}, b"")     # bucket empty
+            assert stub.hits == 4
+            assert gw.registry.counter_sum(
+                "gateway_retry_budget_exhausted_total") >= 1
+        finally:
+            stub.close()
+
+    def test_connect_error_fails_over_to_peer(self):
+        stub = StubReplica()
+        gw = mk_gw(retry_limit=2, connect_timeout_s=0.3)
+        try:
+            # r0 is a dead port (nothing listening); r1 is live. The
+            # connect failure burns attempt 1, the retry excludes r0
+            feed(gw, "web.r0", 1)
+            feed(gw, "web.r1", stub.port)
+            r = gw.request("web", "GET", "/healthz", {}, b"")
+            assert r.status == 200 and r.endpoint == "web.r1"
+            assert r.attempts == 2
+        finally:
+            stub.close()
+
+
+class TestBreaker:
+    def test_consecutive_failures_open_then_typed_503(self):
+        stub = StubReplica(mode="error", status=500)
+        gw = mk_gw(retry_limit=0, breaker_threshold=2,
+                   breaker_cooldown_s=60)
+        try:
+            feed(gw, "web.r0", stub.port)
+            gw.request("web", "GET", "/a", {}, b"")
+            gw.request("web", "GET", "/a", {}, b"")
+            assert gw.table.endpoint("web.r0").breaker_open_since \
+                is not None
+            with pytest.raises(errors.GatewayNoEndpoints):
+                gw.request("web", "GET", "/a", {}, b"")
+            assert stub.hits == 2  # the open breaker blocked attempt 3
+            assert gw.registry.counter_sum(
+                "gateway_breaker_opens_total") == 1
+        finally:
+            stub.close()
+
+    def test_half_open_probe_is_single_flight_under_streaming(self):
+        stub = StubReplica(mode="fail_then_held_stream", status=500,
+                           fail_times=1)
+        stub.release.clear()
+        gw = mk_gw(retry_limit=0, breaker_threshold=1,
+                   breaker_cooldown_s=0.03)
+        try:
+            feed(gw, "web.r0", stub.port)
+            gw.request("web", "GET", "/a", {}, b"")       # opens breaker
+            time.sleep(0.05)                              # past cooldown
+            results = []
+
+            def probe():
+                r = gw.request("web", "GET", "/stream", {}, b"")
+                results.append(b"".join(r.stream))
+
+            t = threading.Thread(target=probe, daemon=True)
+            t.start()
+            # the probe holds before its first byte; every concurrent
+            # request must be refused — the probe slot is single-flight
+            wait_for(lambda: stub.hits == 2, what="probe to reach stub")
+            for _ in range(4):
+                with pytest.raises(errors.GatewayNoEndpoints):
+                    gw.request("web", "GET", "/a", {}, b"")
+            assert stub.hits == 2
+            stub.release.set()
+            t.join(timeout=5)
+            assert results and b'{"t": 0}' in results[0]
+            # probe succeeded: breaker closed, traffic flows again
+            r = gw.request("web", "GET", "/a", {}, b"")
+            assert r.status == 200 and stub.hits == 3
+        finally:
+            stub.close()
+
+
+class TestHedging:
+    def test_hedge_cancels_loser_on_first_byte_win(self):
+        slow = StubReplica(mode="json", delay_s=0.5)
+        fast = StubReplica(mode="json")
+        gw = mk_gw(retry_limit=0, hedge_ms=40)
+        try:
+            # least-loaded tie-break is family order → r0 (slow) is the
+            # primary; its first byte misses the hedge window
+            feed(gw, "web.r0", slow.port)
+            feed(gw, "web.r1", fast.port)
+            r = gw.request("web", "GET", "/gen", {}, b"")
+            assert r.status == 200 and r.hedged
+            assert r.endpoint == "web.r1"
+            assert json.loads(r.body)["server"] == fast.port
+            wait_for(lambda: gw.registry.counter_sum(
+                "gateway_hedge_cancelled_total") == 1,
+                what="hedge loser cancellation")
+            assert slow.hits == 1 and fast.hits == 1
+        finally:
+            slow.close(), fast.close()
+
+    def test_no_hedge_for_non_idempotent(self):
+        slow = StubReplica(mode="json", delay_s=0.2)
+        fast = StubReplica(mode="json")
+        gw = mk_gw(retry_limit=0, hedge_ms=20)
+        try:
+            feed(gw, "web.r0", slow.port)
+            feed(gw, "web.r1", fast.port)
+            r = gw.request("web", "POST", "/gen", {}, b"{}")
+            assert r.status == 200 and not r.hedged
+            assert fast.hits == 0
+        finally:
+            slow.close(), fast.close()
+
+
+class TestLoadShedding:
+    def test_global_cap_sheds_typed_429(self):
+        stub = StubReplica(mode="hang")
+        stub.release.clear()
+        gw = mk_gw(max_inflight=1, retry_limit=0)
+        try:
+            feed(gw, "web.r0", stub.port)
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(
+                    gw.request("web", "GET", "/a", {}, b"")),
+                daemon=True)
+            t.start()
+            wait_for(lambda: stub.hits == 1, what="first request upstream")
+            with pytest.raises(errors.GatewayShed) as ei:
+                gw.request("web", "GET", "/a", {}, b"")
+            assert ei.value.http_status == 429
+            stub.release.set()
+            t.join(timeout=5)
+            assert done and done[0].status == 200
+            # the slot came back: admitted again
+            assert gw.request("web", "GET", "/a", {}, b"").status == 200
+        finally:
+            stub.close()
+
+    def test_no_routable_endpoint_is_typed_503(self):
+        gw = mk_gw()
+        feed(gw, "web.r0", 40001, draining=True)
+        with pytest.raises(errors.GatewayNoEndpoints) as ei:
+            gw.request("web", "GET", "/a", {}, b"")
+        assert ei.value.http_status == 503
+        assert gw.registry.counter_sum("gateway_shed_total") == 1
+
+    def test_saturated_endpoint_skipped_even_for_affine_key(self):
+        hang, ok = StubReplica(mode="hang"), StubReplica()
+        hang.release.clear()
+        gw = mk_gw(max_inflight_per_endpoint=1, retry_limit=0)
+        try:
+            feed(gw, "web.r0", hang.port)
+            feed(gw, "web.r1", ok.port)
+            key = next(k for k in (f"k{i}" for i in range(64))
+                       if rendezvous_order(
+                           ["web.r0", "web.r1"], k)[0] == "web.r0")
+            t = threading.Thread(
+                target=lambda: gw.request("web", "GET", "/a", {}, b""),
+                daemon=True)
+            t.start()
+            wait_for(lambda: hang.hits == 1, what="r0 saturated")
+            # the key's affine home is full: spill to the runner-up
+            # instead of queueing behind it
+            r = gw.request("web", "GET", "/a", {}, b"", prefix_key=key)
+            assert r.endpoint == "web.r1"
+            hang.release.set()
+            t.join(timeout=5)
+        finally:
+            hang.close(), ok.close()
+
+
+class TestStreaming:
+    def test_chunked_passthrough(self):
+        stub = StubReplica(mode="stream")
+        gw = mk_gw()
+        try:
+            feed(gw, "web.r0", stub.port)
+            r = gw.request("web", "POST", "/generate", {}, b"{}")
+            assert r.stream is not None
+            body = b"".join(r.stream)
+            assert body == b'{"t": 0}\n{"t": 1}\n{"t": 2}\n'
+            assert gw.status_view()["inFlight"] == 0
+        finally:
+            stub.close()
+
+    def test_mid_stream_death_yields_typed_truncation(self):
+        stub = StubReplica(mode="die_mid_stream")
+        gw = mk_gw()
+        try:
+            feed(gw, "web.r0", stub.port)
+            r = gw.request("web", "POST", "/generate", {}, b"{}")
+            lines = b"".join(r.stream).splitlines()
+            assert lines[0] == b'{"t": 0}'
+            final = json.loads(lines[-1])
+            assert final["gatewayTruncated"] is True
+            assert final["endpoint"] == "web.r0"
+            assert final["reason"]
+            assert gw.registry.counter_sum(
+                "gateway_truncated_streams_total") == 1
+            assert any(e["event"] == "gateway-stream-truncated"
+                       for e in gw.events_view())
+            # the dead upstream conn was closed, never pooled, and the
+            # in-flight slot came back — no orphan connections
+            ep = gw.table.endpoint("web.r0")
+            assert ep.pool.view()["idle"] == 0
+            assert gw.status_view()["inFlight"] == 0
+        finally:
+            stub.close()
+
+
+class TestDrainHandshake:
+    def test_vacuous_with_zero_live_gateways(self):
+        kv = MemoryKV()
+        coord = DrainCoordinator(kv, heartbeat_s=0.05)
+        assert coord.wait_drained("web.r0", 0.2) is True
+
+    def test_stale_heartbeat_not_waited_on(self):
+        kv = MemoryKV()
+        kv.put(keys.gateway_instance_key("gw-dead"),
+               json.dumps({"id": "gw-dead", "ts": time.time() - 3600}))
+        coord = DrainCoordinator(kv, heartbeat_s=0.05)
+        assert coord.live_instances() == []
+        assert coord.wait_drained("web.r0", 0.2) is True
+
+    def test_idle_gateway_acks_promptly(self):
+        kv = MemoryKV()
+        gw = mk_gw(kv=kv)
+        gw.start()
+        try:
+            feed(gw, "web.r0", 40001)
+            coord = DrainCoordinator(kv, heartbeat_s=gw.heartbeat_s)
+            wait_for(lambda: coord.live_instances(), what="heartbeat")
+            feed(gw, "web.r0", 40001, draining=True)
+            assert coord.wait_drained("web.r0", 5.0) is True
+            # acks are consumed by the wait: clean slate for the next
+            # drain cycle of a recreated namesake
+            assert coord.acks("web.r0") == set()
+        finally:
+            gw.close()
+
+    def test_ack_waits_for_inflight_stream_then_lands(self):
+        kv = MemoryKV()
+        stub = StubReplica(mode="hang")
+        stub.release.clear()
+        gw = mk_gw(kv=kv, retry_limit=0)
+        gw.start()
+        try:
+            feed(gw, "web.r0", stub.port)
+            coord = DrainCoordinator(kv, heartbeat_s=gw.heartbeat_s)
+            wait_for(lambda: coord.live_instances(), what="heartbeat")
+            t = threading.Thread(
+                target=lambda: gw.request("web", "GET", "/a", {}, b""),
+                daemon=True)
+            t.start()
+            wait_for(lambda: stub.hits == 1, what="in-flight request")
+            feed(gw, "web.r0", stub.port, draining=True)
+            # a request is in flight: the deadline-bounded wait reports
+            # NOT drained rather than blocking forever
+            assert coord.wait_drained("web.r0", 0.3) is False
+            stub.release.set()
+            t.join(timeout=5)
+            assert coord.wait_drained("web.r0", 5.0) is True
+            assert gw.registry.counter_sum(
+                "gateway_drain_acks_total") >= 1
+        finally:
+            gw.close()
+            stub.close()
+
+    def test_roll_acks_promptly_without_visible_marker(self):
+        # THE roll-drain gap: during a spec roll the draining marker is
+        # written to the OLD version record while the latest pointer
+        # already moved, so the table never folds ``draining``. The
+        # generation roll-ack must land anyway — an idle gateway that
+        # folded the new version acks immediately instead of letting
+        # every replica roll burn the full drain deadline.
+        kv = MemoryKV()
+        gw = mk_gw(kv=kv)
+        gw.start()
+        try:
+            feed(gw, "web.r0", 40001, version=1)
+            coord = DrainCoordinator(kv, heartbeat_s=gw.heartbeat_s)
+            wait_for(lambda: coord.live_instances(), what="heartbeat")
+            feed(gw, "web.r0", 40002, version=2)  # no draining marker
+            t0 = time.monotonic()
+            assert coord.wait_drained("web.r0", 5.0, version=1) is True
+            assert time.monotonic() - t0 < 2.0
+            assert gw.registry.counter_sum("gateway_roll_acks_total") >= 1
+        finally:
+            gw.close()
+
+    def test_roll_ack_waits_for_lame_inflight(self):
+        # an attempt issued against the OLD generation holds the roll
+        # ack until it lands — that's the zero-drop half of the contract
+        kv = MemoryKV()
+        stub = StubReplica(mode="hang")
+        stub.release.clear()
+        gw = mk_gw(kv=kv, retry_limit=0)
+        gw.start()
+        try:
+            feed(gw, "web.r0", stub.port, version=1)
+            coord = DrainCoordinator(kv, heartbeat_s=gw.heartbeat_s)
+            wait_for(lambda: coord.live_instances(), what="heartbeat")
+            t = threading.Thread(
+                target=lambda: gw.request("web", "GET", "/a", {}, b""),
+                daemon=True)
+            t.start()
+            wait_for(lambda: stub.hits == 1, what="in-flight request")
+            feed(gw, "web.r0", stub.port, version=2)
+            assert coord.wait_drained("web.r0", 0.3, version=1) is False
+            stub.release.set()
+            t.join(timeout=5)
+            assert coord.wait_drained("web.r0", 5.0, version=1) is True
+        finally:
+            gw.close()
+            stub.close()
+
+    def test_stale_roll_ack_cannot_satisfy_newer_drain(self):
+        # version scoping: an ack that observed v1 must not satisfy a
+        # later wait for v1's own drain (needs drained==1 or rolledTo>1)
+        kv = MemoryKV()
+        kv.put(keys.gateway_instance_key("gw-1"),
+               json.dumps({"id": "gw-1", "ts": time.time()}))
+        kv.put(keys.gateway_ack_key("web.r0", "gw-1"),
+               json.dumps({"id": "gw-1", "ts": time.time(),
+                           "rolledTo": 1}))
+        coord = DrainCoordinator(kv, heartbeat_s=10.0)
+        assert coord.acks("web.r0") == {"gw-1"}
+        assert coord.acks("web.r0", version=1) == set()
+        assert coord.acks("web.r0", version=0) == {"gw-1"}
+        assert coord.wait_drained("web.r0", 0.2, version=1) is False
+
+    def test_dead_gateway_stops_blocking_drains(self):
+        kv = MemoryKV()
+        gw = mk_gw(kv=kv)
+        gw.start()
+        coord = DrainCoordinator(kv, heartbeat_s=gw.heartbeat_s)
+        wait_for(lambda: coord.live_instances(), what="heartbeat")
+        gw.close()  # deregisters the instance record
+        assert coord.live_instances() == []
+        assert coord.wait_drained("web.r0", 0.2) is True
+
+
+class TestGatewayAppHTTP:
+    """End-to-end through the listener (api/gateway_app.py)."""
+
+    def _client(self, port):
+        import http.client
+
+        return http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+
+    def test_proxy_affinity_shed_and_trace(self):
+        stub = StubReplica()
+        gw = mk_gw()
+        feed(gw, "web.r0", stub.port)
+        srv = GatewayServer(gw, port=0)
+        srv.start()
+        try:
+            c = self._client(srv.port)
+            tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+            c.request("GET", "/v1/web/healthz",
+                      headers={"traceparent": tp, "X-Prefix-Key": "p1"})
+            resp = c.getresponse()
+            body = resp.read()
+            assert resp.status == 200
+            assert json.loads(body)["server"] == stub.port
+            assert resp.getheader("X-Gateway-Endpoint") == "web.r0"
+            assert resp.getheader("X-Gateway-Attempts") == "1"
+            # the upstream hop carries the CONTINUED trace: same trace
+            # id, a new (gateway) parent span id
+            up_tp = stub.headers_seen[0].get("traceparent", "")
+            assert up_tp.split("-")[1] == "ab" * 16
+            assert up_tp != tp
+            # unknown service → typed 503 + Retry-After on the wire
+            c.request("GET", "/v1/nosuch/healthz")
+            resp = c.getresponse()
+            shed = json.loads(resp.read())
+            assert resp.status == 503
+            assert resp.getheader("Retry-After")
+            assert shed["code"] == errors.GatewayNoEndpoints.code
+            # non-API path → 404, not a proxy attempt
+            c.request("GET", "/wrong")
+            resp = c.getresponse()
+            resp.read()
+            assert resp.status == 404
+            # own observability endpoints
+            c.request("GET", "/healthz")
+            resp = c.getresponse()
+            health = json.loads(resp.read())
+            assert health["status"] == "ok"
+            assert health["gateway"]["instanceId"] == gw.instance_id
+            c.request("GET", "/metrics")
+            resp = c.getresponse()
+            metrics = resp.read().decode()
+            assert "gateway_requests_total" in metrics
+            assert "gateway_request_ms" in metrics
+        finally:
+            srv.close()
+            stub.close()
+
+    def test_streaming_relay_over_the_wire(self):
+        stub = StubReplica(mode="stream")
+        gw = mk_gw()
+        feed(gw, "web.r0", stub.port)
+        srv = GatewayServer(gw, port=0)
+        srv.start()
+        try:
+            c = self._client(srv.port)
+            c.request("POST", "/v1/web/generate", body=b"{}")
+            resp = c.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Transfer-Encoding") == "chunked"
+            assert resp.read() == b'{"t": 0}\n{"t": 1}\n{"t": 2}\n'
+        finally:
+            srv.close()
+            stub.close()
+
+
+class _StopSpyRuntime(FakeRuntime):
+    """Records, at the instant of each container_stop of a family,
+    what the STORE says about that family — the mark-before-stop pin."""
+
+    def __init__(self):
+        super().__init__()
+        self.prg = None
+        self.observed = []
+
+    def container_stop(self, name: str, timeout_s: int = 10) -> None:
+        if self.prg is not None:
+            base = name.rsplit("-", 2)[0] if "-p" in name else name
+            for fam, latest in self.prg.job_versions.snapshot().items():
+                if name.startswith(fam):
+                    st = self.prg.store.get_job(f"{fam}-{latest}")
+                    self.observed.append(
+                        (name, fam, st.draining, st.phase))
+                    break
+        super().container_stop(name, timeout_s)
+
+
+class TestMarkBeforeStop:
+    """Satellite pin: the durable ``draining`` marker (or the admission
+    path's preempted flip) is visible in the store STRICTLY before the
+    first member stop of a service-owned replica; plain gangs never get
+    the marker."""
+
+    def test_service_replica_stop_marks_before_first_stop(self):
+        rt = _StopSpyRuntime()
+        prg = boot(runtimes={"h0": rt})
+        create(prg, replicas=1)
+        rt.prg = prg
+        prg.job_svc.stop_job("web.r0")
+        assert rt.observed, "no member stops recorded"
+        for name, fam, draining, phase in rt.observed:
+            assert draining is True, (
+                f"stop of {name} observed draining={draining}")
+        # ...and the marker does not outlive the quiesce
+        latest = prg.job_versions.get("web.r0")
+        st = prg.store.get_job(f"web.r0-{latest}")
+        assert st.phase == "stopped" and st.draining is False
+
+    def test_plain_gang_stop_never_marked(self):
+        rt = _StopSpyRuntime()
+        prg = boot(runtimes={"h0": rt})
+        prg.job_svc.run_job(JobRun(image_name="jax", job_name="train",
+                                   chip_count=2))
+        rt.prg = prg
+        prg.job_svc.stop_job("train")
+        assert rt.observed
+        assert all(d is False for _, _, d, _ in rt.observed)
+
+    def test_quiesce_waits_on_coordinator_before_stopping(self):
+        """The drain-ack wait slots between the marker write and the
+        first member stop — and its verdict events are emitted."""
+        seq = []
+
+        class Coord:
+            def wait_drained(self, base, deadline_s, version=None):
+                seq.append(("wait", base, deadline_s))
+                return True
+
+        class SeqRuntime(FakeRuntime):
+            def container_stop(self, name, timeout_s=10):
+                seq.append(("stop", name))
+                super().container_stop(name, timeout_s)
+
+        prg = boot(runtimes={"h0": SeqRuntime()})
+        create(prg, replicas=1)
+        prg.job_svc.drain_coordinator = Coord()
+        prg.job_svc.drain_deadline_s = 7.5
+        prg.job_svc.stop_job("web.r0")
+        assert seq[0] == ("wait", "web.r0", 7.5)
+        assert all(step[0] == "stop" for step in seq[1:]) and len(seq) > 1
+
+
+class TestReconcileAdoption:
+    def test_draining_at_rest_is_invariant_violation_and_adopted(self):
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        prg = boot(kv=kv, runtimes={"h0": rt})
+        create(prg, replicas=1)
+        with armed("gateway.drain.after_mark"):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.stop_job("web.r0")
+        # marker durable, members still running: at rest this is a
+        # violation the oracle must name
+        prg2 = boot(kv=kv, runtimes={"h0": rt})
+        assert any("draining marker at rest" in p for p in oracle(prg2))
+        for _ in range(3):
+            if not prg2.reconciler.reconcile()["actions"]:
+                break
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        assert oracle(prg2) == []
+        assert prg2.reconciler.reconcile()["actions"] == []
+
+
+class TestGatewayChaos:
+    """Kill the daemon at every gateway.* drain-handshake point; the
+    next boot's reconcile must converge with no half-drained replicas
+    (referenced by tests/test_chaos.py's matrix-coverage assertion)."""
+
+    @pytest.mark.parametrize("point", GATEWAY_CRASH_POINTS)
+    def test_crash_converges(self, point):
+        kv = MemoryKV()
+        rt = FakeRuntime()
+        prg = boot(kv=kv, runtimes={"h0": rt})
+        create(prg, replicas=1)
+        with armed(point):
+            with pytest.raises(SimulatedCrash):
+                prg.job_svc.stop_job("web.r0")
+
+        prg2 = boot(kv=kv, runtimes={"h0": rt})
+        for _ in range(3):
+            if not prg2.reconciler.reconcile()["actions"]:
+                break
+        for _ in range(4):
+            if not prg2.admission.admit_once():
+                break
+        problems = oracle(prg2)
+        assert problems == [], f"{point}: {problems}"
+        # no half-drained replica anywhere: every latest version is
+        # either cleanly running (recreated by the service) or dormant
+        for fam, latest in prg2.job_versions.snapshot().items():
+            st = prg2.store.get_job(f"{fam}-{latest}")
+            assert not (st.draining and st.phase == "running"), fam
+        assert prg2.reconciler.reconcile()["actions"] == [], point

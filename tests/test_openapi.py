@@ -23,7 +23,8 @@ def _live_routes():
     r = build_router(svc, svc, svc, svc, work_queue=svc, health_watcher=svc,
                      metrics=None, job_svc=svc, pod_scheduler=svc,
                      reconciler=svc, job_supervisor=svc, host_monitor=svc,
-                     admission=svc, serving=svc, compactor=svc, tracer=svc)
+                     admission=svc, serving=svc, compactor=svc, tracer=svc,
+                     gateway=svc)
     routes = {(m, p) for m, _, p, _ in r._routes}
     routes.add(("GET", "/metrics"))
     return routes

@@ -191,6 +191,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  leader_elector=None, shard_plane=None,
                  informer=None, fanout=None,
                  admission=None, serving=None, compactor=None,
+                 gateway=None,
                  list_default_limit: int = 0,
                  list_max_limit: int = 5000,
                  tracer=None) -> Router:
@@ -570,6 +571,12 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                 pools[hid] = view
             if pools:
                 out["enginePools"] = pools
+        if gateway is not None:
+            # serving-ingress health next to liveness: in-flight load,
+            # retry-budget level, breaker/shed counters and the routing
+            # table's per-endpoint view (one set of books — identical to
+            # the gateway listener's own /healthz)
+            out["gateway"] = gateway.status_view()
         return out
 
     r.add("GET", "/healthz", healthz)
@@ -616,11 +623,20 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         return out
 
     r.add("GET", "/api/v1/shards", shards_view)
+    if gateway is not None:
+        # serving-gateway introspection (docs/robustness.md "Serving
+        # gateway"): instance identity, the watch-fed routing table with
+        # per-endpoint breaker/drain/in-flight state, budget levels and
+        # the shed/retry/hedge counters — read straight from the gateway
+        # engine, zero store reads
+        r.add("GET", "/api/v1/gateway",
+              lambda body, **_: gateway.status_view())
     if (health_watcher is not None or job_supervisor is not None
             or host_monitor is not None or leader_elector is not None
             or shard_plane is not None
             or informer is not None or admission is not None
-            or serving is not None or tracer is not None):
+            or serving is not None or tracer is not None
+            or gateway is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
         # supervisor), host health transitions (host monitor), leadership
@@ -651,7 +667,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             rings = [src.events_view(limit=per_ring)
                      for src in (health_watcher, job_supervisor,
                                  host_monitor, leader_elector, shard_plane,
-                                 informer, admission, serving, tracer)
+                                 informer, admission, serving, tracer,
+                                 gateway)
                      if src is not None]
             merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
             if trace_id:

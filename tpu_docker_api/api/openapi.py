@@ -269,6 +269,11 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("POST", "/api/v1/services/{name}/load", "setServiceLoad",
      "Synthetic traffic injection: offered requests/s for the fake-runtime "
      "signal model (bench/test load generators)", "ServiceLoad"),
+    ("GET", "/api/v1/gateway", "getGatewayStatus",
+     "Serving-gateway introspection: instance identity, the watch-fed "
+     "routing table (per-endpoint breaker/EWMA/in-flight/generation), "
+     "draining families, and the shed/retry/hedge/drain-ack counters; "
+     "present only when gateway_enabled", None),
     ("GET", "/api/v1/resources/tpus", "getTpus",
      "Chip map: coords, owner, fragmentation (largest free block)", None),
     ("GET", "/api/v1/resources/gpus", "getTpusCompat",

@@ -244,6 +244,44 @@ class Config:
     # slow-trace threshold (ms): a root span slower than this emits a
     # "slow-trace" event into the merged /api/v1/events ring; 0 disables
     trace_slow_ms: float = 0.0
+    # L7 serving gateway (service/gateway.py, docs/robustness.md
+    # "Serving gateway"): a stateless ingress in front of Service
+    # replicas — drain-aware routing, retry/hedge budgets, breakers,
+    # outlier ejection and typed load shedding. Off by default: gateway
+    # deployments opt in, everything else keeps the direct-to-replica
+    # path byte-for-byte.
+    gateway_enabled: bool = False
+    # gateway listener port; 0 = ephemeral (tests), daemon default 2380
+    gateway_port: int = 0
+    # end-to-end deadline per proxied request (connect + retries +
+    # upstream headers); streams are bounded per-read, not end-to-end
+    gateway_request_timeout_s: float = 30.0
+    gateway_connect_timeout_s: float = 2.0
+    # retries per request (idempotent requests only), and the token
+    # budget that bounds retry AMPLIFICATION fleet-wide: each completed
+    # request earns `ratio` tokens, each retry spends one
+    gateway_retry_limit: int = 2
+    gateway_retry_budget_ratio: float = 0.2
+    # hedge: fire a second attempt at a different replica when the first
+    # byte hasn't arrived within this many ms (0 = off; idempotent only)
+    gateway_hedge_ms: float = 0.0
+    # per-endpoint circuit breaker: open after N consecutive failures,
+    # half-open single-flight probe after the cooldown
+    gateway_breaker_threshold: int = 3
+    gateway_breaker_cooldown_s: float = 5.0
+    # eject an endpoint whose EWMA latency exceeds factor x the fleet
+    # median (0 = off); ejection lasts one breaker cooldown
+    gateway_outlier_latency_factor: float = 0.0
+    # load shedding: global and per-endpoint in-flight caps (typed 429 /
+    # skip-in-pick respectively), and the bounded upstream conn pool
+    gateway_max_inflight: int = 256
+    gateway_max_inflight_per_endpoint: int = 64
+    gateway_pool_size: int = 8
+    # drain handshake: how long a roll/scale-down/preemption waits for
+    # every live gateway to ack zero in-flight before the first member
+    # stop, and how often gateways heartbeat/sweep acks
+    gateway_drain_deadline_s: float = 10.0
+    gateway_heartbeat_s: float = 1.0
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container
@@ -356,6 +394,58 @@ def load(path: str | None = None) -> Config:
     if cfg.shard_standby_delay_s < 0:
         raise ValueError(f"shard_standby_delay_s must be >= 0, "
                          f"got {cfg.shard_standby_delay_s}")
+    if not isinstance(cfg.gateway_enabled, bool):
+        raise ValueError(f"gateway_enabled must be a boolean, "
+                         f"got {cfg.gateway_enabled!r}")
+    if isinstance(cfg.gateway_port, bool) \
+            or not isinstance(cfg.gateway_port, int) \
+            or not 0 <= cfg.gateway_port <= 65535:
+        raise ValueError(f"gateway_port must be an integer in [0, 65535], "
+                         f"got {cfg.gateway_port!r}")
+    if cfg.gateway_request_timeout_s <= 0:
+        raise ValueError(f"gateway_request_timeout_s must be > 0, "
+                         f"got {cfg.gateway_request_timeout_s}")
+    if cfg.gateway_connect_timeout_s <= 0:
+        raise ValueError(f"gateway_connect_timeout_s must be > 0, "
+                         f"got {cfg.gateway_connect_timeout_s}")
+    if isinstance(cfg.gateway_retry_limit, bool) \
+            or not isinstance(cfg.gateway_retry_limit, int) \
+            or cfg.gateway_retry_limit < 0:
+        raise ValueError(f"gateway_retry_limit must be an integer >= 0, "
+                         f"got {cfg.gateway_retry_limit!r}")
+    if cfg.gateway_retry_budget_ratio < 0:
+        raise ValueError(f"gateway_retry_budget_ratio must be >= 0, "
+                         f"got {cfg.gateway_retry_budget_ratio}")
+    if cfg.gateway_hedge_ms < 0:
+        raise ValueError(f"gateway_hedge_ms must be >= 0, "
+                         f"got {cfg.gateway_hedge_ms}")
+    if isinstance(cfg.gateway_breaker_threshold, bool) \
+            or not isinstance(cfg.gateway_breaker_threshold, int) \
+            or cfg.gateway_breaker_threshold < 0:
+        raise ValueError(
+            f"gateway_breaker_threshold must be an integer >= 0, "
+            f"got {cfg.gateway_breaker_threshold!r}")
+    if cfg.gateway_breaker_cooldown_s < 0:
+        raise ValueError(f"gateway_breaker_cooldown_s must be >= 0, "
+                         f"got {cfg.gateway_breaker_cooldown_s}")
+    if cfg.gateway_outlier_latency_factor < 0:
+        raise ValueError(f"gateway_outlier_latency_factor must be >= 0, "
+                         f"got {cfg.gateway_outlier_latency_factor}")
+    for knob in ("gateway_max_inflight", "gateway_max_inflight_per_endpoint"):
+        v = getattr(cfg, knob)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            raise ValueError(f"{knob} must be an integer >= 1, got {v!r}")
+    if isinstance(cfg.gateway_pool_size, bool) \
+            or not isinstance(cfg.gateway_pool_size, int) \
+            or cfg.gateway_pool_size < 0:
+        raise ValueError(f"gateway_pool_size must be an integer >= 0, "
+                         f"got {cfg.gateway_pool_size!r}")
+    if cfg.gateway_drain_deadline_s < 0:
+        raise ValueError(f"gateway_drain_deadline_s must be >= 0, "
+                         f"got {cfg.gateway_drain_deadline_s}")
+    if cfg.gateway_heartbeat_s <= 0:
+        raise ValueError(f"gateway_heartbeat_s must be > 0, "
+                         f"got {cfg.gateway_heartbeat_s}")
     if cfg.autoscale_interval_s < 0:
         raise ValueError(f"autoscale_interval_s must be >= 0, "
                          f"got {cfg.autoscale_interval_s}")

@@ -228,6 +228,26 @@ class ServiceNotExist(ApiError):
     code = 11002
 
 
+class GatewayShed(ApiError):
+    """The serving gateway refused admission under load — the global
+    in-flight cap is reached or every candidate endpoint is saturated.
+    Surfaced as HTTP 429 with Retry-After so callers treat it as
+    retryable backpressure (shed, don't collapse), never as a
+    connection-level failure."""
+    code = 11201
+    http_status = 429
+
+
+class GatewayNoEndpoints(ApiError):
+    """The serving gateway has no routable replica for the service —
+    every endpoint is draining, ejected, or breaker-open (or the service
+    has no ready replicas at all). Surfaced as HTTP 503 with Retry-After:
+    the condition is transient by construction (drains finish, breakers
+    half-open, the autoscaler reacts)."""
+    code = 11202
+    http_status = 503
+
+
 class HostUnreachable(ApiError):
     """A pod host's container engine cannot be reached — connection refused,
     socket timeout, or the host's circuit breaker is open and fast-failing.

@@ -193,6 +193,15 @@ class JobState:
     # ``job_resize_max`` bounds (never the lifetime counter); {} = never
     # resized
     last_resize: dict = dataclasses.field(default_factory=dict)
+    # serving-gateway drain handshake (service/gateway.py): persisted
+    # BEFORE the first member stop of a service-owned replica quiesce so
+    # the gateway (and GET /services/{name}) see the replica leave the
+    # routing table while it still serves in-flight streams. Durable stop
+    # intent: reconcile adopts a draining non-dormant job by finishing
+    # the stop, and invariants.py flags draining at rest (like the
+    # scaling phases). Cleared by the same write that lands the job in a
+    # dormant phase.
+    draining: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -224,4 +233,5 @@ class JobState:
             members_desired=int(d.get("members_desired", 0)),
             resizes=int(d.get("resizes", 0)),
             last_resize=dict(d.get("last_resize") or {}),
+            draining=bool(d.get("draining", False)),
         )

@@ -381,6 +381,29 @@ class AdmissionController:
                  "position %d)", base, klass, seq, pos)
         return pos
 
+    def _await_gateway_drain(self, base: str, st: JobState) -> None:
+        """Drain-aware preemption (service/gateway.py): the atomic
+        phase→preempted flip just applied IS the gateway's drain signal
+        — the routing table folds the phase and stops picking the
+        replica immediately. For service-owned replicas behind a
+        gateway, give every live gateway instance a deadline-bounded
+        window to finish in-flight streams before the first member stop
+        so a preemption drops zero requests. Plain gangs and
+        gateway-less deployments skip this entirely (no store reads, no
+        sleeps — preemption latency is unchanged)."""
+        coord = getattr(self._svc, "drain_coordinator", None)
+        if coord is None:
+            return
+        from tpu_docker_api.schemas.service import owner_from_env
+
+        if owner_from_env(st.env) is None:
+            return
+        deadline_s = getattr(self._svc, "drain_deadline_s", 0.0)
+        version = keys.split_versioned_name(st.job_name)[1]
+        acked = coord.wait_drained(base, deadline_s, version=version)
+        self._record("job-drain-acked" if acked else "job-drain-deadline",
+                     base, klass=st.priority_class)
+
     def park_preempted(self, base: str, reason: str = "") -> JobState | None:
         """Park a gang as ``preempted`` outside the victim-selection path
         — the resize-exhaustion fallback (service/job.py): an elastic gang
@@ -415,6 +438,7 @@ class AdmissionController:
                 StateStore._put_ops(Resource.JOBS, base, st.version,
                                     parked.to_dict())
                 + [("put", rec.key(), rec.to_json())])
+            self._await_gateway_drain(base, st)
             self._svc._stop_members(st, reverse=True)
             self._svc._release_version_resources(st)
             self._registry.counter_inc(
@@ -909,6 +933,7 @@ class AdmissionController:
                                     parked.to_dict())
                 + [("put", rec.key(), rec.to_json())])
             crash_point("admission.preempt")
+            self._await_gateway_drain(base, st)
             self._svc._stop_members(st, reverse=True)
             crash_point("admission.preempt")
             self._svc._release_version_resources(st)

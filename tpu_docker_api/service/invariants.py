@@ -243,6 +243,14 @@ def check_job_invariants(
             problems.append(
                 f"job {base}: stuck in phase {st.phase} (resize "
                 f"unfinished)")
+        if st.draining and st.phase not in DORMANT_PHASES:
+            # the gateway drain marker only exists between mark and the
+            # stopped write — at rest the reconciler must have finished
+            # the stop the marker recorded (a half-drained replica would
+            # sit unroutable yet holding its slice forever)
+            problems.append(
+                f"job {base}: draining marker at rest (quiesce "
+                f"unfinished)")
         if st.elastic:
             floor = max(st.min_members, 1)
             if st.placements and len(st.placements) < floor:

@@ -130,6 +130,14 @@ class JobService:
         #: None keeps the legacy refusal byte-for-byte (and validates
         #: classes against the default ladder)
         self.admission = None
+        #: serving-gateway drain handshake (service/gateway.py
+        #: DrainCoordinator), wired by the daemon when the gateway
+        #: listener is enabled. When set, ``_predrain`` blocks (deadline-
+        #: bounded) until every live gateway instance acked the family's
+        #: drain marker before the first member stop. None = mark-only:
+        #: the durable marker still lands, nothing waits.
+        self.drain_coordinator = None
+        self.drain_deadline_s = 0.0
 
     # -- helpers -----------------------------------------------------------------
 
@@ -454,6 +462,39 @@ class JobService:
         self.store.put_job(st)
         return st
 
+    def _predrain(self, st: JobState, pointer: bool = True) -> JobState:
+        """Persist the gateway ``draining`` marker BEFORE the first member
+        stop of a service-owned replica quiesce, then wait (deadline-
+        bounded) for every live gateway instance to ack it — so in-flight
+        streamed responses finish before the members die and zero
+        requests drop across rolls, scale-downs and stops.
+
+        Gated on service ownership (``owner_from_env``): plain gangs keep
+        their exact store-apply counts — no gateway routes to them, so
+        the extra write would buy nothing. Preemptions don't come through
+        here: their atomic phase→preempted flip (admission.py) IS the
+        mark-before-stop, folded by the routing table the same way."""
+        from tpu_docker_api.schemas.service import owner_from_env
+
+        if (st.draining or not st.placements or st.phase != "running"
+                or owner_from_env(st.env) is None):
+            return st
+        st = JobState.from_dict({**st.to_dict(), "draining": True})
+        self.store.put_job(st, pointer=pointer)
+        crash_point("gateway.drain.after_mark")
+        base, version = split_versioned_name(st.job_name)
+        self._emit("job-draining", st.job_name)
+        if self.drain_coordinator is not None:
+            # version-scoped: only an ack that quiesced THIS version (or
+            # observed a newer one — the roll path, where the marker
+            # lands on the old record behind the latest pointer) counts
+            acked = self.drain_coordinator.wait_drained(
+                base, self.drain_deadline_s, version=version)
+            self._emit("job-drain-acked" if acked else "job-drain-deadline",
+                       st.job_name)
+        crash_point("gateway.drain.after_ack")
+        return st
+
     def _swap_version(self, base: str, old: JobState, carry: dict,
                       run_new) -> JobState:
         """THE rolling-replace state machine — one copy, shared by the
@@ -480,10 +521,11 @@ class JobService:
             # it (a bare-name GET would serve the retired version); on
             # the in-place path the pointer already names the old
             # version, so skipping the rewrite changes nothing
-            self._stop_members(old, reverse=True)
+            drained = self._predrain(old, pointer=False)
+            self._stop_members(drained, reverse=True)
             self.store.put_job(JobState.from_dict(
-                {**old.to_dict(), "desired_running": False,
-                 "phase": "stopped"}), pointer=False)
+                {**drained.to_dict(), "desired_running": False,
+                 "phase": "stopped", "draining": False}), pointer=False)
 
         def _resume_old() -> None:
             # store record first: if the restart fails too, the family's
@@ -1000,6 +1042,10 @@ class JobService:
         base, _, latest_name = self._resolve_latest(name)
         with self._locks.hold(base):
             st = self.store.get_job(latest_name)
+            # gateway handshake first: a service-owned replica's draining
+            # marker is durable (and acked by live gateways) strictly
+            # before the first member stop; plain gangs skip the write
+            st = self._predrain(st)
             # gang quiesce: workers drain first, the coordinator last, so
             # collective peers never outlive their rendezvous point (a
             # queued job has no members — the batch is empty — and a
@@ -1007,7 +1053,8 @@ class JobService:
             # "stopped" below, which is what DEQUEUES them)
             self._stop_members(st, reverse=True)
             self.store.put_job(JobState.from_dict(
-                {**st.to_dict(), "desired_running": False, "phase": "stopped"}
+                {**st.to_dict(), "desired_running": False, "phase": "stopped",
+                 "draining": False}
             ))
             if self.admission is not None and self.admission.enabled:
                 # stop dequeues: a deliberately stopped job must not be

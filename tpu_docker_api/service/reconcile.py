@@ -964,6 +964,19 @@ class Reconciler:
                         "hosts": sorted(unreachable)}))
                 return
 
+            if st.draining and st.phase not in DORMANT_PHASES:
+                # the gateway drain marker is durable stop intent: a
+                # daemon that died between marking and quiescing left a
+                # half-drained replica serving nothing (the gateway
+                # already stopped picking it) — finish the stop. stop_job
+                # re-runs the gateway handshake (idempotent: the marker
+                # is already set, so only the stop itself runs) and
+                # clears the marker with the stopped write
+                self._act(actions, dry_run, "finish-draining-job-stop",
+                          latest_name,
+                          fn=lambda: self._job_svc.stop_job(base))
+                return
+
             if st.desired_running and st.phase not in DORMANT_PHASES:
                 missing = [c for _, c, i in members if i is None]
                 dead = [c for _, c, i in members if i is not None

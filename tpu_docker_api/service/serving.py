@@ -142,6 +142,11 @@ class ServingService:
         self._offered: dict[str, float] = {}
         #: last aggregated signal per service (operator audit surface)
         self._last_sig: dict[str, dict] = {}
+        #: last PER-REPLICA signal (replica family base → metrics dict),
+        #: written by the same scrape `_signals` aggregates from — one
+        #: set of books: the gateway's least-loaded pick reads exactly
+        #: what the autoscaler decided on (service/gateway.py)
+        self._replica_sig: dict[str, dict] = {}
         #: cooldown stamps (monotonic clock; in-memory — a restart resets
         #: cooldowns, which only delays the next decision one window)
         self._last_up: dict[str, float] = {}
@@ -362,6 +367,9 @@ class ServingService:
         for d in (self._offered, self._last_sig, self._last_up,
                   self._last_down, self._pending_up):
             d.pop(base, None)
+        for rb in [k for k in self._replica_sig
+                   if k.split(".", 1)[0] == base]:
+            self._replica_sig.pop(rb, None)
         for gauge in ("service_replicas_desired", "service_replicas_ready",
                       "service_ttft_p95_ms", "service_queue_depth"):
             self._registry.gauge_set(gauge, 0, {"service": base})
@@ -503,10 +511,18 @@ class ServingService:
             if idx >= st.replicas:
                 continue
             jst = self._job_state(rb)
+            # draining replicas are mid-quiesce: the gateway already
+            # stopped picking them, so readiness (and the autoscale
+            # signal scrape) must not count them either
             if (jst is not None and jst.desired_running
-                    and jst.phase in _READY_PHASES):
+                    and jst.phase in _READY_PHASES and not jst.draining):
                 out.append(rb)
         return out
+
+    def replica_signal(self, rb: str) -> dict | None:
+        """Last scraped/synthesized SLO signal for one replica family, or
+        None when it never reported (gateway least-loaded input)."""
+        return self._replica_sig.get(rb)
 
     def _scrape_http(self, st: ServiceState, jst) -> dict | None:
         """The real signal path: GET the replica-reported metrics endpoint
@@ -572,10 +588,15 @@ class ServingService:
                 m = self._scrape_http(st, jst)
                 if m is not None:
                     per.append(m)
+                    self._replica_sig[rb] = m
+                else:
+                    self._replica_sig.pop(rb, None)
         else:
             offered = self._offered.get(base)
             if offered is not None and ready:
                 per = [self._synth(st, offered, len(ready))] * len(ready)
+                for rb in ready:
+                    self._replica_sig[rb] = per[0]
             elif offered and st.replicas == 0:
                 # scale-from-zero: traffic against an EMPTY fleet is a
                 # breach by definition — without this, a service scaled
@@ -863,14 +884,17 @@ class ServingService:
             if jst is None:
                 continue
             # surplus gangs (mid-teardown) are listed but never READY —
-            # one set of books with _ready_replicas and the gauge
+            # one set of books with _ready_replicas and the gauge; a
+            # draining replica is likewise not ready (the gateway already
+            # stopped picking it — the two surfaces must agree)
             if (idx < st.replicas and jst.desired_running
-                    and jst.phase in _READY_PHASES):
+                    and jst.phase in _READY_PHASES and not jst.draining):
                 ready += 1
             entry = {
                 "index": idx, "family": rb, "jobName": jst.job_name,
                 "phase": jst.phase, "chipCount": jst.chip_count,
                 "surplus": idx >= st.replicas,
+                "draining": jst.draining,
             }
             if jst.phase in ("queued", "preempted") \
                     and self._admission is not None:

@@ -200,6 +200,36 @@ def queue_marker_key(task_id: str, shard: int = 0) -> str:
     return f"{queue_markers_prefix(shard)}{task_id}"
 
 
+# -- serving-gateway drain handshake (service/gateway.py) ----------------------
+#: live gateway-instance registry: each stateless gateway heartbeats a
+#: JSON record {"id", "ts", "advertise"} under its own key. A record is
+#: LIVE while its ts is within 3x the heartbeat interval — a killed
+#: gateway simply stops renewing, and the control plane's drain wait
+#: ignores stale entries (bounded by the drain deadline either way)
+GATEWAY_INSTANCES_PREFIX = f"{PREFIX}/gateway/instances/"
+#: per-family drain acks: a gateway that has (a) observed the family's
+#: durable ``draining`` marker in its routing table and (b) finished every
+#: in-flight request it was proxying to that family writes
+#: ``{prefix}{family}/{gateway_id}``. The control plane's quiesce waits
+#: until every live instance acked (or the deadline passes), then deletes
+#: the family's ack prefix — zero live gateways ⇒ vacuously drained
+GATEWAY_ACKS_PREFIX = f"{PREFIX}/gateway/acks/"
+
+
+def gateway_instance_key(gateway_id: str) -> str:
+    return f"{GATEWAY_INSTANCES_PREFIX}{gateway_id}"
+
+
+def gateway_acks_prefix(base: str) -> str:
+    """Every ack for one replica family, prefix-scannable and
+    prefix-deletable as a unit."""
+    return f"{GATEWAY_ACKS_PREFIX}{base}/"
+
+
+def gateway_ack_key(base: str, gateway_id: str) -> str:
+    return f"{gateway_acks_prefix(base)}{gateway_id}"
+
+
 def versions_shard_key(resource: Resource, shard: int) -> str:
     """Per-shard version-map snapshot key. Shard 0 keeps the legacy
     singleton key so the existing store needs no migration."""

@@ -51,6 +51,7 @@ class MetricsRegistry:
         self._hist_buckets: dict[str, tuple[float, ...]] = {}
         self._help: dict[str, tuple[str, str]] = {}  # name -> (type, help)
         self._gauge_fns: dict[str, Callable[[], float]] = {}
+        self._gauge_series_fns: dict[str, Callable[[], list]] = {}
         self._counter_fns: dict[str, Callable[[], float]] = {}
         self._label_names: dict[str, tuple[str, ...]] = {}
 
@@ -93,6 +94,19 @@ class MetricsRegistry:
             self._declare(name, "gauge", help)
             self._gauge_fns[name] = fn
 
+    def gauge_series_fn(self, name: str,
+                        fn: Callable[[], list], help: str = "") -> None:
+        """Register a pull-time LABELED gauge family: ``fn()`` returns
+        ``[(labels_dict, value), ...]`` rendered fresh at every scrape.
+        For per-entity views whose entity set changes at runtime (e.g.
+        per-endpoint connection-pool stats) — a plain ``gauge_set``
+        would leave stale series behind when an entity disappears.
+        Callers must keep the label set BOUNDED (hosts, endpoints — not
+        request ids)."""
+        with self._lock:
+            self._declare(name, "gauge", help)
+            self._gauge_series_fns[name] = fn
+
     def counter_value(self, name: str, labels: dict | None = None) -> float:
         """Read a counter's current value (0.0 if never incremented).
         Lets a subsystem keep the registry as its ONE set of books — the
@@ -102,6 +116,15 @@ class MetricsRegistry:
         with self._lock:
             series = self._counters.get(name, {})
             return series.get(tuple(sorted((labels or {}).items())), 0.0)
+
+    def counter_sum(self, name: str) -> float:
+        """Sum a counter across ALL label series (0.0 if never
+        incremented). The labeled-counter analogue of
+        :meth:`counter_value` — status views that aggregate a labeled
+        family (gateway requests by service/code) read the same books
+        they export."""
+        with self._lock:
+            return sum(self._counters.get(name, {}).values())
 
     def counter_fn(self, name: str, fn: Callable[[], float],
                    help: str = "") -> None:
@@ -155,6 +178,15 @@ class MetricsRegistry:
                         except Exception:  # pragma: no cover — never break /metrics
                             continue
                         out.append(f"{name} {v:g}")
+                    if name in self._gauge_series_fns:
+                        try:
+                            series = list(self._gauge_series_fns[name]())
+                        except Exception:  # pragma: no cover — never break /metrics
+                            series = []
+                        for labels, v in sorted(
+                                series, key=lambda s: sorted(s[0].items())):
+                            out.append(
+                                f"{name}{_fmt_labels(labels)} {float(v):g}")
                     for key, v in sorted(self._gauges.get(name, {}).items()):
                         out.append(f"{name}{_fmt_labels(dict(key))} {v:g}")
                 else:  # histogram
