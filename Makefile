@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-serve-traffic bench-scale bench-shard openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-serve-traffic bench-scale bench-shard bench-workflow openapi sample-interface run clean
 
 all: native openapi
 
@@ -96,6 +96,11 @@ bench-shard:                 ## sharded writer plane family: 3-shard vs 1-shard 
 	$(PY) bench.py --control-plane --cp-family shard > bench-shard.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-shard.json.tmp
 	mv bench-shard.json.tmp bench-shard.json
+
+bench-workflow:              ## durable-workflow family: train->eval->promote DAG over real HTTP; time-to-DAG-complete + exactly-once step effects, promote-through-roll and admission-queue gates
+	$(PY) bench.py --control-plane --cp-family workflow > bench-workflow.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-workflow.json.tmp
+	mv bench-workflow.json.tmp bench-workflow.json
 
 run:                         ## serve with baked build identification
 	$(BUILDINFO_ENV) $(PY) -m tpu_docker_api -c etc/config.toml

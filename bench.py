@@ -1898,6 +1898,210 @@ def measure_control_plane_serve_scale(iters: int = 3,
     }
 
 
+def measure_control_plane_workflow(
+        iters: int = 3, interval_s: float = 0.02,
+        budget_ms: float = 20000.0, timeout_s: float = 20.0) -> dict:
+    """Durable-workflow family (``--control-plane --cp-family workflow``
+    / ``make bench-workflow``): a train → eval → promote DAG submitted
+    over real HTTP against an in-process Program with every writer loop
+    live (admission, supervision, the workflow engine). Step gangs run on
+    the fake runtime; the bench simulates the WORKLOAD finishing (each
+    member exits 0 via the runtime fault seam) and everything after that
+    is the control plane's job: the supervisor marks the gang completed,
+    the engine journals the completion marker, launches the successor,
+    and the promote step rolls the target Service through the
+    rolling-update machinery. Self-gating on:
+
+    - **time-to-DAG-complete**: POST /workflows → phase ``succeeded``,
+      p50 under ``budget_ms``;
+    - **exactly-once step effects**: every member container created
+      exactly once across the run (the runtime create ledger holds no
+      duplicate names) and no step burned a retry attempt — the journal
+      markers, not luck, carried each effect;
+    - **promote rolled the service**: after each DAG the target Service
+      reports the step's image with its replica ready — the roll went
+      through the real update path, not a spec overwrite;
+    - **admitted via the queue**: step gangs entered through the
+      admission journal (queued → admitted events present) — workflows
+      pay for capacity like everyone else;
+    - **zero manual operations**: the bench touches jobs only by
+      simulating container exits; no job/step API mutation is issued.
+
+    A violated gate flips ``gates.ok``; main() turns that into a nonzero
+    exit."""
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+
+    if iters < 1:
+        raise ValueError("workflow family needs iters >= 1")
+
+    prog = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=49000, end_port=49999, health_watch_interval=0,
+        host_probe_interval_s=0, job_supervise_interval=interval_s,
+        reconcile_interval=0, admission_enabled=True,
+        admission_interval_s=interval_s,
+        workflow_interval_s=interval_s,
+        workflow_backoff_base_s=0.0, workflow_backoff_max_s=0.0,
+    ), host="127.0.0.1")
+    prog.init()
+    prog.start()
+
+    def call(method, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prog.api_server.port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if out["code"] != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out["data"]
+
+    def wait_until(cond, what: str) -> bool:
+        """False on timeout — recorded as a failed gate observation, not
+        raised: a wedged DAG must yield a red ARTIFACT (gates.ok false
+        with the observations that failed), not a stack trace."""
+        deadline = time.perf_counter() + timeout_s
+        while time.perf_counter() < deadline:
+            if cond():
+                return True
+            time.sleep(0.005)
+        return False
+
+    crashed: set[str] = set()
+
+    def finish_running_steps(wf: str) -> None:
+        """Simulate the workload of every currently-running step gang
+        exiting 0 — the only 'completion' signal the control plane gets,
+        exactly as a real training process would deliver it."""
+        info = call("GET", f"/api/v1/workflows/{wf}")
+        for step in info["steps"]:
+            if step["state"] != "running" or step.get("jobPhase") != "running":
+                continue
+            job = call("GET", f"/api/v1/jobs/{step['job']}")
+            if job["phase"] != "running":
+                continue
+            for proc in job["processes"]:
+                cname = proc["container"]
+                if cname in crashed:
+                    continue
+                crashed.add(cname)
+                prog.runtime.crash_container(cname, exit_code=0)
+
+    try:
+        call("POST", "/api/v1/services", {
+            "serviceName": "web", "imageName": "model:v1",
+            "chipsPerReplica": 1, "replicas": 1})
+        if not wait_until(
+                lambda: call("GET", "/api/v1/services/web")[
+                    "readyReplicas"] >= 1,
+                "promote target service ready"):
+            raise RuntimeError("promote target service never became ready")
+        # a preemptible filler holds every remaining chip: the first step
+        # of each DAG can only place by queueing and preempting it
+        # through the market — the admission path proven, not assumed
+        filler_chips = prog.pod.n_chips - 1
+        call("POST", "/api/v1/jobs", {
+            "imageName": "jax", "jobName": "filler",
+            "chipCount": filler_chips, "priorityClass": "preemptible"})
+
+        dag_ms: list[float] = []
+        completed_flags: list[bool] = []
+        promote_flags: list[bool] = []
+        retry_attempts = 0
+        for i in range(iters):
+            wf = f"pipe{i}"
+            target_image = f"model:v{i + 2}"
+            call("POST", "/api/v1/workflows", {
+                "workflowName": wf,
+                "priorityClass": "production",
+                "binds": ["/mnt/artifacts:/artifacts"],
+                "steps": [
+                    {"name": "train", "image": "jax:train", "chipCount": 1},
+                    {"name": "evaluate", "image": "jax:eval", "chipCount": 1,
+                     "deps": ["train"]},
+                    {"name": "promote", "kind": "promote", "service": "web",
+                     "image": target_image, "deps": ["evaluate"]},
+                ]})
+            t0 = time.perf_counter()
+
+            def dag_done():
+                finish_running_steps(wf)
+                return call("GET",
+                            f"/api/v1/workflows/{wf}")["phase"] == "succeeded"
+
+            done = wait_until(dag_done, f"{wf} DAG complete")
+            completed_flags.append(done)
+            if done:
+                dag_ms.append((time.perf_counter() - t0) * 1e3)
+            info = call("GET", f"/api/v1/workflows/{wf}")
+            retry_attempts += sum(s["attempts"] for s in info["steps"])
+            svc = call("GET", "/api/v1/services/web")
+            promote_flags.append(
+                done and svc["image"] == target_image
+                and wait_until(
+                    lambda: call("GET", "/api/v1/services/web")[
+                        "readyReplicas"] >= 1,
+                    "rolled replica ready"))
+            if not done:
+                break  # the engine is wedged; later DAGs would only time out
+
+        events = call("GET", "/api/v1/events?limit=500")
+        queued = [e for e in events if e.get("event") == "job-queued"
+                  and ".s" in str(e.get("job", ""))]
+        admitted = [e for e in events if e.get("event") == "job-admitted"
+                    and ".s" in str(e.get("job", ""))]
+        # exactly-once audit over WORKFLOW-owned containers only: the
+        # preempted filler legitimately re-creates its members on every
+        # re-admission, so it must not pollute the step-effect ledger
+        creates = [c[1] for c in prog.runtime.calls
+                   if c[0] == "create" and c[1].startswith("pipe")]
+    finally:
+        prog.stop()
+
+    def quantiles(ms: list[float]) -> dict:
+        if not ms:
+            return {"p50": 0, "p95": 0, "max": 0}
+        s = sorted(ms)
+        return {"p50": round(s[len(s) // 2], 3),
+                "p95": round(s[min(len(s) - 1, int(len(s) * 0.95))], 3),
+                "max": round(s[-1], 3)}
+
+    ttq = quantiles(dag_ms)
+    gates = {
+        "dag_completed_all": (len(completed_flags) == iters
+                              and all(completed_flags)),
+        "dag_complete_p50_ms": ttq["p50"],
+        "dag_complete_budget_ms": budget_ms,
+        "promote_rolled_all": (len(promote_flags) == iters
+                               and all(promote_flags)),
+        "member_creates": len(creates),
+        "steps_exactly_once": (len(creates) == len(set(creates))
+                               and len(creates) >= 1),
+        "step_retries": retry_attempts,
+        "zero_step_retries": retry_attempts == 0,
+        "admitted_via_queue": len(admitted),
+    }
+    gates["ok"] = bool(
+        gates["dag_completed_all"] and gates["promote_rolled_all"]
+        and len(dag_ms) == iters and 0 < ttq["p50"] <= budget_ms
+        and gates["steps_exactly_once"] and gates["zero_step_retries"]
+        and gates["admitted_via_queue"] >= 1)
+    return {
+        "family": "workflow",
+        "iters": {"dags": iters, "steps_per_dag": 3,
+                  "tick_interval_s": interval_s},
+        "dag_complete_ms": ttq,
+        "dag_ms": [round(v, 3) for v in dag_ms],
+        "admission": {"queued_events": len(queued),
+                      "admitted_events": len(admitted)},
+        "gates": gates,
+    }
+
+
 def measure_control_plane_serve_traffic(
         duration_s: float = 4.0, rps: float = 40.0,
         ttft_overhead_budget_ms: float = 75.0, interval_s: float = 0.05,
@@ -2606,7 +2810,7 @@ def measure_control_plane_scale(n_objects: int = 50000, n_small: int = 1000,
 
 CP_FAMILIES = ("create", "churn", "failover", "reads", "fanout",
                "preempt", "resize", "serve-scale", "serve-traffic",
-               "scale", "shard")
+               "scale", "shard", "workflow")
 
 
 # control-plane family dispatch — shared by the --control-plane branch
@@ -2639,6 +2843,8 @@ def _run_cp_family(family: str, args) -> dict:
         return measure_control_plane_resize(iters=args.resize_iters)
     if family == "serve-scale":
         return measure_control_plane_serve_scale(iters=args.serve_iters)
+    if family == "workflow":
+        return measure_control_plane_workflow(iters=args.workflow_iters)
     if family == "serve-traffic":
         return measure_control_plane_serve_traffic(
             duration_s=args.traffic_duration, rps=args.traffic_rps)
@@ -2726,6 +2932,9 @@ def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
     if family == "scale":
         return ("control_plane_scale_steady_reconcile_reads",
                 cp["steady_reads"], "reads")
+    if family == "workflow":
+        return ("control_plane_workflow_dag_complete_ms_p50",
+                cp["dag_complete_ms"]["p50"], "ms")
     return ("container_create_ready_ms_p50", cp["create_ready_ms_p50"], "ms")
 
 
@@ -2739,7 +2948,7 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
     ``BENCH_DEGRADED_FAMILIES`` (comma list) overrides the default set."""
     families = [f.strip() for f in os.environ.get(
         "BENCH_DEGRADED_FAMILIES",
-        "churn,preempt,resize,serve-scale,serve-traffic,scale,shard"
+        "churn,preempt,resize,serve-scale,serve-traffic,scale,shard,workflow"
         ).split(",")
         if f.strip()]
     green = 0
@@ -2832,7 +3041,11 @@ def main() -> int | None:
                              "zero-change reconcile reads O(changes) vs "
                              "the measured O(N) full scan, flat list p95 "
                              "1k->N, and version history <= retention "
-                             "under churn")
+                             "under churn; workflow = train->eval->promote "
+                             "DAG over real HTTP, gating "
+                             "time-to-DAG-complete, exactly-once step "
+                             "effects, promote-through-rolling-update and "
+                             "admission-queue entry")
     parser.add_argument("--cp-iters", type=int, default=100,
                         help="iterations (create family) / container "
                              "cycles (churn family) / total GETs per role "
@@ -2863,6 +3076,9 @@ def main() -> int | None:
     parser.add_argument("--serve-iters", type=int, default=3,
                         help="offered-load step cycles for the serve-scale "
                              "family")
+    parser.add_argument("--workflow-iters", type=int, default=3,
+                        help="train->eval->promote DAG runs for the "
+                             "workflow family")
     parser.add_argument("--traffic-duration", type=float, default=4.0,
                         help="open-loop load seconds for the serve-traffic "
                              "family (split across steady / autoscale / "

@@ -639,6 +639,71 @@ def validate_shard(extra: dict) -> list[str]:
     return problems
 
 
+def validate_workflow(extra: dict) -> list[str]:
+    """The durable-workflow family headline payload: time-to-DAG-complete
+    quantiles over train→eval→promote runs and a passing gate. The
+    exactly-once, zero-retry and admitted-via-queue contracts are
+    re-checked here (not just gates.ok): a run whose runtime ledger holds
+    a duplicate member create, whose steps burned retry attempts on a
+    healthy fleet, or whose gangs bypassed the admission journal must
+    fail loudly at the schema layer too."""
+    problems: list[str] = []
+    it = extra.get("iters") or {}
+    dags = it.get("dags")
+    if not (isinstance(dags, int) and dags >= 1):
+        problems.append(f"workflow: iters.dags must be an int >= 1, "
+                        f"got {dags!r}")
+    ttq = extra.get("dag_complete_ms") or {}
+    for q in QUANTS:
+        if not _num(ttq.get(q)) or ttq[q] <= 0:
+            problems.append(f"workflow: dag_complete_ms.{q} must be a "
+                            f"positive number, got {ttq.get(q)!r}")
+    series = extra.get("dag_ms")
+    if (not isinstance(series, list)
+            or (isinstance(dags, int) and len(series) != dags)
+            or not all(_num(v) and v > 0 for v in series)):
+        problems.append("workflow: dag_ms must list one positive "
+                        "time-to-complete per DAG run")
+    gates = extra.get("gates") or {}
+    for key in ("dag_completed_all", "dag_complete_p50_ms",
+                "dag_complete_budget_ms", "promote_rolled_all",
+                "member_creates", "steps_exactly_once", "step_retries",
+                "zero_step_retries", "admitted_via_queue", "ok"):
+        if key not in gates:
+            problems.append(f"workflow: gates.{key} missing")
+    p50 = gates.get("dag_complete_p50_ms")
+    budget = gates.get("dag_complete_budget_ms")
+    if _num(p50) and _num(budget) and p50 > budget:
+        problems.append(f"workflow: time-to-DAG-complete p50 {p50}ms blew "
+                        f"the {budget}ms budget")
+    creates = gates.get("member_creates")
+    if not (isinstance(creates, int) and creates >= 1):
+        problems.append(f"workflow: gates.member_creates must be an int "
+                        f">= 1, got {creates!r} — no step gang ever "
+                        f"launched, so exactly-once would pass vacuously")
+    if gates.get("steps_exactly_once") is not True:
+        problems.append("workflow: a member container was created more "
+                        "than once — a step effect ran twice")
+    retries = gates.get("step_retries")
+    if not isinstance(retries, int) or bool(
+            gates.get("zero_step_retries")) != (retries == 0):
+        problems.append(f"workflow: gates.zero_step_retries "
+                        f"{gates.get('zero_step_retries')!r} contradicts "
+                        f"step_retries {retries!r}")
+    via_queue = gates.get("admitted_via_queue")
+    if not (isinstance(via_queue, int) and via_queue >= 1):
+        problems.append(f"workflow: admitted_via_queue must be an int "
+                        f">= 1, got {via_queue!r} (no step gang entered "
+                        f"through the admission journal — the market path "
+                        f"is unproven)")
+    if gates.get("promote_rolled_all") is not True:
+        problems.append("workflow: the promote step did not roll the "
+                        "target service on every run")
+    if gates.get("ok") is not True:
+        problems.append(f"workflow: regression gate failed: {gates}")
+    return problems
+
+
 def validate_lines(lines: list[dict]) -> list[str]:
     """Return every schema violation found (empty = consumable)."""
     problems: list[str] = []
@@ -684,12 +749,16 @@ def validate_lines(lines: list[dict]) -> list[str]:
              if (ln.get("extra") or {}).get("family") == "shard"]
     if shard:
         return problems + validate_shard(shard[0]["extra"])
+    workflow = [ln for ln in lines
+                if (ln.get("extra") or {}).get("family") == "workflow"]
+    if workflow:
+        return problems + validate_workflow(workflow[0]["extra"])
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
         return problems + ["no churn, failover, reads, fanout, preempt, "
-                           "resize, serve-scale, serve-traffic, scale or "
-                           "shard headline line (extra.family)"]
+                           "resize, serve-scale, serve-traffic, scale, "
+                           "shard or workflow headline line (extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

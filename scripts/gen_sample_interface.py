@@ -10,6 +10,7 @@ from __future__ import annotations
 import json
 import pathlib
 import sys
+import time
 import urllib.request
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
@@ -201,6 +202,59 @@ def main() -> None:
     call("DELETE", "/api/v1/services/llm", None,
          "Tears down every replica gang (workers-first quiesce, one-batch "
          "release) and drops the family — no orphan fleet.")
+    emit("## Workflows (durable DAG orchestration — docs/robustness.md "
+         "\"Workflows\")")
+    emit()
+    call("POST", "/api/v1/services",
+         {"serviceName": "web", "imageName": "model:v1",
+          "chipsPerReplica": 4, "replicas": 1},
+         "The promote target: a serving fleet the pipeline below rolls "
+         "to each newly trained image.")
+    call("POST", "/api/v1/workflows",
+         {"workflowName": "pipeline", "cronIntervalS": 86400,
+          "binds": ["/nfs/artifacts:/artifacts"],
+          "steps": [
+              {"name": "train", "imageName": "maxtext:tpu", "chipCount": 8},
+              {"name": "evaluate", "imageName": "eval:tpu", "chipCount": 4,
+               "deps": ["train"]},
+              {"name": "promote", "kind": "promote", "service": "web",
+               "imageName": "model:v2", "deps": ["evaluate"]},
+          ]},
+         "A train → evaluate → promote DAG, re-fired daily. Job steps "
+         "admit through the capacity market at the workflow's class with "
+         "the shared artifact bind mounted into each gang; the promote "
+         "step rolls `web` through the Service rolling-update machinery. "
+         "Every step transition is journaled with an idempotency key and "
+         "the completion marker lands BEFORE the successor launches, so "
+         "a daemon crash at any point replays the DAG forward without "
+         "re-running a completed effect.")
+
+    def quiet_get(path: str) -> dict:
+        req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+        return json.loads(urllib.request.urlopen(req).read())
+
+    # settle: capture the info payload once train is running (the launch
+    # rides the async work queue, so poll instead of racing it)
+    for _ in range(200):
+        info = quiet_get("/api/v1/workflows/pipeline").get("data") or {}
+        steps = {s["name"]: s for s in info.get("steps", [])}
+        if steps.get("train", {}).get("jobPhase") == "running":
+            break
+        time.sleep(0.01)
+    call("GET", "/api/v1/workflows/pipeline", None,
+         "Per-step status with the live gang phase (queued steps show "
+         "their admission-queue position), plus cron bookkeeping "
+         "(lastFireTs, firedRuns, suppressed/skipped ticks) — the "
+         "no-log-reading audit of where the DAG stands.")
+    call("PATCH", "/api/v1/workflows/pipeline", {"cronEnabled": False},
+         "Park the cron without deleting the DAG: the current run "
+         "finishes, no new runs fire. Steps are immutable once created; "
+         "only the cron fields patch.")
+    call("DELETE", "/api/v1/workflows/pipeline", None,
+         "Mid-flight teardown: mark deleting (durable), stop + delete "
+         "every owned step gang, drop the family — a crash halfway "
+         "leaves a journal record the reconciler finishes.")
+    call("DELETE", "/api/v1/services/web", None)
     emit("## Resources & observability")
     emit()
     call("GET", "/api/v1/resources/tpus", None,

@@ -47,6 +47,7 @@ from tpu_docker_api.service.crashpoints import (
     RECONCILE_CRASH_POINTS,
     RESIZE_CRASH_POINTS,
     TXN_CRASH_POINTS,
+    WORKFLOW_CRASH_POINTS,
     SimulatedCrash,
     armed,
 )
@@ -153,6 +154,12 @@ def test_case_matrix_covers_every_crash_point():
     # the daemon at every gateway.* drain-handshake point
     from tpu_docker_api.service.crashpoints import GATEWAY_CRASH_POINTS
 
+    # the workflow matrix (tests/test_workflow.py TestWorkflowChaos) kills
+    # the daemon at every workflow.* DAG-lifecycle point
+    from tests.test_workflow import WORKFLOW_CASES
+
+    assert {p for p, _ in WORKFLOW_CASES} == set(WORKFLOW_CRASH_POINTS)
+
     assert (set(CONTAINER_CRASH_POINTS) | set(JOB_CRASH_POINTS)
             | set(QUEUE_CRASH_POINTS) | set(TXN_CRASH_POINTS)
             | set(LEADER_CRASH_POINTS) | set(SHARD_CRASH_POINTS)
@@ -160,6 +167,7 @@ def test_case_matrix_covers_every_crash_point():
             | set(ADMISSION_CRASH_POINTS) | set(RESIZE_CRASH_POINTS)
             | set(SERVICE_CRASH_POINTS) | set(GATEWAY_CRASH_POINTS)
             | set(RECONCILE_CRASH_POINTS) | set(COMPACTOR_CRASH_POINTS)
+            | set(WORKFLOW_CRASH_POINTS)
             == set(KNOWN_CRASH_POINTS))
 
 

@@ -24,7 +24,7 @@ def _live_routes():
                      metrics=None, job_svc=svc, pod_scheduler=svc,
                      reconciler=svc, job_supervisor=svc, host_monitor=svc,
                      admission=svc, serving=svc, compactor=svc, tracer=svc,
-                     gateway=svc)
+                     gateway=svc, workflow_svc=svc)
     routes = {(m, p) for m, _, p, _ in r._routes}
     routes.add(("GET", "/metrics"))
     return routes

@@ -35,6 +35,11 @@ Route table:
     PATCH  /api/v1/services/{name}             manual scale / policy / spec roll
     DELETE /api/v1/services/{name}             tear down every replica
     POST   /api/v1/services/{name}/load        synthetic offered-load injection
+    POST   /api/v1/workflows                   create a DAG workflow (steps + cron)
+    GET    /api/v1/workflows                   list workflows
+    GET    /api/v1/workflows/{name}            per-step status + cron state
+    PATCH  /api/v1/workflows/{name}            cron enable/interval/catch-up
+    DELETE /api/v1/workflows/{name}            tear down the DAG + step gangs
     GET    /api/v1/resources/tpus              chip scheduler view (alias: /gpus)
     GET    /api/v1/resources/ports             port scheduler view
     POST   /api/v1/hosts/{name}/cordon         no new placements on the host
@@ -107,10 +112,11 @@ def _validate_ref_name(name: str) -> None:
 
 
 #: resources whose mutation routes carry a family name (the shard unit)
-_FAMILY_SEGMENTS = frozenset(("containers", "volumes", "jobs", "services"))
+_FAMILY_SEGMENTS = frozenset(("containers", "volumes", "jobs", "services",
+                              "workflows"))
 #: create bodies carry the family name under the resource's own field
 _CREATE_NAME_FIELDS = ("containerName", "volumeName", "jobName",
-                       "serviceName")
+                       "serviceName", "workflowName")
 
 
 def _shard_for_request(plane, path: str, raw: bytes) -> int:
@@ -190,7 +196,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  job_supervisor=None, host_monitor=None,
                  leader_elector=None, shard_plane=None,
                  informer=None, fanout=None,
-                 admission=None, serving=None, compactor=None,
+                 admission=None, serving=None, workflow_svc=None,
+                 compactor=None,
                  gateway=None,
                  list_default_limit: int = 0,
                  list_max_limit: int = 5000,
@@ -492,6 +499,53 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
         r.add("PATCH", "/api/v1/services/{name}", s_patch)
         r.add("DELETE", "/api/v1/services/{name}", s_delete)
         r.add("POST", "/api/v1/services/{name}/load", s_load)
+
+    # -- Workflows (durable DAG orchestration, service/workflow.py) ---------------
+
+    if workflow_svc is not None:
+        from tpu_docker_api.schemas.workflow import (WorkflowCreate,
+                                                     WorkflowPatch)
+
+        def w_create(body, **_):
+            req = WorkflowCreate.from_dict(body)
+            _validate_base_name(req.workflow_name)
+            return workflow_svc.create_workflow(req)
+
+        def w_info(body, name):
+            _validate_ref_name(name)
+            return workflow_svc.workflow_info(name)
+
+        def w_patch(body, name):
+            _validate_ref_name(name)
+            return workflow_svc.patch_workflow(name,
+                                               WorkflowPatch.from_dict(body))
+
+        def w_delete(body, name):
+            _validate_ref_name(name)
+            workflow_svc.delete_workflow(name)
+            return None
+
+        def w_list(body, **_):
+            limit, token = _page_params(body)
+            if limit <= 0 and not token:
+                # legacy shape: the unbounded flat list
+                return workflow_svc.list_workflows()
+            page = pager.list_families(
+                container_svc.store.kv, Resource.WORKFLOWS,
+                limit=limit, token=token)
+            items = []
+            for it in page["items"]:
+                s = workflow_svc.workflow_summary(it["name"])
+                if s is not None:
+                    items.append(s)
+            return {"items": items, "continue": page["continue"],
+                    "rev": page["rev"]}
+
+        r.add("POST", "/api/v1/workflows", w_create)
+        r.add("GET", "/api/v1/workflows", w_list)
+        r.add("GET", "/api/v1/workflows/{name}", w_info)
+        r.add("PATCH", "/api/v1/workflows/{name}", w_patch)
+        r.add("DELETE", "/api/v1/workflows/{name}", w_delete)
     if pod_scheduler is not None:
         r.add("GET", "/api/v1/resources/slices",
               lambda body, **_: pod_scheduler.status())
@@ -667,8 +721,8 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             rings = [src.events_view(limit=per_ring)
                      for src in (health_watcher, job_supervisor,
                                  host_monitor, leader_elector, shard_plane,
-                                 informer, admission, serving, tracer,
-                                 gateway)
+                                 informer, admission, serving, workflow_svc,
+                                 tracer, gateway)
                      if src is not None]
             merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
             if trace_id:

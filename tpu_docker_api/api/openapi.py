@@ -161,6 +161,59 @@ _SCHEMAS: dict[str, dict] = {
                  "model; fake-runtime replicas synthesize TTFT/queue "
                  "signals from it"}},
         ["rps"]),
+    "WorkflowStep": _obj(
+        {"name": {**_STR, "description": "DAG node name, unique per "
+                  "workflow, [a-zA-Z0-9_.]+"},
+         "kind": {**_STR, "enum": ["job", "promote"], "default": "job",
+                  "description": "job = run a gang to completion; "
+                  "promote = roll `service` to `image` through the "
+                  "Service rolling-update machinery"},
+         "deps": _arr({**_STR, "description": "step names that must "
+                       "succeed before this step launches"}),
+         "imageName": _STR,
+         "chipCount": {**_INT, "description": "gang chip ask "
+                       "(kind job)"},
+         "acceleratorType": {**_STR, "description":
+                             "alternative ask, e.g. \"v5e-8\""},
+         "binds": _arr({**_STR, "description": "\"src:dest\", on top "
+                        "of the workflow's shared binds"}),
+         "env": _arr(_STR), "cmd": _arr(_STR),
+         "service": {**_STR, "description":
+                     "promote target Service (kind promote)"},
+         "maxRetries": {**_INT, "description":
+                        "per-step retry budget; -1 = config "
+                        "workflow_max_step_retries. Exhausting it "
+                        "settles the WHOLE workflow \"failed\""}},
+        ["name"]),
+    "WorkflowCreate": _obj(
+        {"workflowName": {**_STR, "description":
+                          "base name, [a-zA-Z0-9_.]+"},
+         "steps": _arr({"$ref": "#/components/schemas/WorkflowStep"}),
+         "priorityClass": {**_STR, "description":
+                           "capacity-market class every step gang admits "
+                           "at (\"\" = config workflow_default_class)"},
+         "binds": _arr({**_STR, "description":
+                        "artifact hand-off volume: \"src:dest\" mounted "
+                        "into EVERY job step"}),
+         "cronIntervalS": {"type": "number", "description":
+                           "re-fire the DAG every N seconds (0 = one "
+                           "run, no cron)"},
+         "cronCatchup": {**_STR, "enum": ["fire_once", "skip"],
+                         "default": "skip", "description":
+                         "missed-tick policy across downtime: skip = "
+                         "drop missed ticks entirely (default); "
+                         "fire_once = one catch-up run, the remaining "
+                         "missed ticks counted skipped"},
+         "cronEnabled": {**_BOOL, "description":
+                         "false parks the cron without deleting the "
+                         "workflow (default true when cronIntervalS > 0)"}},
+        ["workflowName", "steps"]),
+    "WorkflowPatch": _obj(
+        {"cronIntervalS": {"type": "number"},
+         "cronEnabled": _BOOL,
+         "cronCatchup": {**_STR, "enum": ["fire_once", "skip"]}},
+        desc="Cron retune only — steps are immutable once created "
+             "(delete and recreate to change the DAG)"),
     "Rollback": _obj(
         {"version": {**_INT, "description": "stored version to roll back to"},
          "dataFrom": {**_STR, "enum": ["latest", "target"],
@@ -269,6 +322,31 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
     ("POST", "/api/v1/services/{name}/load", "setServiceLoad",
      "Synthetic traffic injection: offered requests/s for the fake-runtime "
      "signal model (bench/test load generators)", "ServiceLoad"),
+    ("POST", "/api/v1/workflows", "createWorkflow",
+     "Declare a DAG workflow: job steps (gangs admitted through the "
+     "capacity market at the workflow's class) and promote steps (roll a "
+     "Service through the rolling-update machinery), with shared "
+     "artifact binds and optional cron re-fire. Every step transition is "
+     "journaled with an idempotency key — a crashed daemon's replacement "
+     "replays the DAG forward, never re-running a completed effect",
+     "WorkflowCreate"),
+    ("GET", "/api/v1/workflows", "listWorkflows",
+     "Every workflow: phase, run counter, priority class, last "
+     "transition; with ?limit=/?continue= the same rev-anchored "
+     "pagination contract as GET /api/v1/containers "
+     "({items, continue, rev})", None),
+    ("GET", "/api/v1/workflows/{name}", "getWorkflowInfo",
+     "Per-step status (state/attempts/error, live gang phase + queue "
+     "position, promote target) and cron bookkeeping (lastFireTs, "
+     "firedRuns, suppressed/skipped ticks) — the no-log-reading audit of "
+     "where the DAG stands", None),
+    ("PATCH", "/api/v1/workflows/{name}", "patchWorkflow",
+     "Cron retune: interval, enable/disable, catch-up policy; steps are "
+     "immutable once created", "WorkflowPatch"),
+    ("DELETE", "/api/v1/workflows/{name}", "deleteWorkflow",
+     "Tear down the DAG: mark deleting (durable), stop + delete every "
+     "owned step gang, drop the family — mid-flight deletes are crash-"
+     "safe (reconcile finishes a half-done teardown)", None),
     ("GET", "/api/v1/gateway", "getGatewayStatus",
      "Serving-gateway introspection: instance identity, the watch-fed "
      "routing table (per-endpoint breaker/EWMA/in-flight/generation), "
@@ -379,7 +457,8 @@ _ROUTES: list[tuple[str, str, str, str, str | None]] = [
 
 #: GET list endpoints carrying the limit/continue pagination contract
 _PAGED_LIST_PATHS = {"/api/v1/containers", "/api/v1/volumes",
-                     "/api/v1/jobs", "/api/v1/services"}
+                     "/api/v1/jobs", "/api/v1/services",
+                     "/api/v1/workflows"}
 
 
 def build_spec() -> dict:

@@ -282,6 +282,27 @@ class Config:
     # stop, and how often gateways heartbeat/sweep acks
     gateway_drain_deadline_s: float = 10.0
     gateway_heartbeat_s: float = 1.0
+    # Workflow resource (service/workflow.py, docs/robustness.md
+    # "Workflows"): DAG engine tick (a writer: leader-only under
+    # leader_election); 0 disables the loop — workflows still converge via
+    # the reconciler's adoption and explicit tick() calls (test/bench hook)
+    workflow_interval_s: float = 2.0
+    # class assigned when POST /workflows carries no priorityClass —
+    # batch by default: pipelines are throughput work, a production
+    # serving scale-up should outrank them in the capacity market
+    workflow_default_class: str = "batch"
+    # per-step retry budget when a step spec carries no maxRetries: failed
+    # attempts beyond this settle the WHOLE workflow terminal "failed"
+    workflow_max_step_retries: int = 2
+    # exponential backoff between step retry attempts: base·2^n seconds,
+    # clamped to the max
+    workflow_backoff_base_s: float = 0.5
+    workflow_backoff_max_s: float = 30.0
+    # dead-letter hygiene (state/workqueue.py): how many times one dead
+    # record may be revived through POST /api/v1/dead-letters/retry before
+    # the typed RetryBudgetExhausted refusal — the count is durable on the
+    # record, so the cap survives restarts
+    queue_dead_letter_retry_budget: int = 3
     # multi-host pod: [[pod_hosts]] tables, each {host_id, address,
     # grid_coord=[x,y,z], docker_host?, runtime_backend?, local?}. Set
     # local=true on the entry for THIS machine so it shares the container
@@ -449,6 +470,38 @@ def load(path: str | None = None) -> Config:
     if cfg.autoscale_interval_s < 0:
         raise ValueError(f"autoscale_interval_s must be >= 0, "
                          f"got {cfg.autoscale_interval_s}")
+    if cfg.workflow_interval_s < 0:
+        raise ValueError(f"workflow_interval_s must be >= 0, "
+                         f"got {cfg.workflow_interval_s}")
+    if cfg.workflow_default_class not in cfg.priority_class_weights:
+        if "workflow_default_class" in data:
+            raise ValueError(
+                f"workflow_default_class {cfg.workflow_default_class!r} is "
+                f"not in priority_class_weights "
+                f"{sorted(cfg.priority_class_weights)}")
+        # a custom ladder without "batch": the un-set workflow default
+        # follows the job default instead of failing the whole config
+        cfg.workflow_default_class = cfg.priority_class_default
+    if isinstance(cfg.workflow_max_step_retries, bool) \
+            or not isinstance(cfg.workflow_max_step_retries, int) \
+            or cfg.workflow_max_step_retries < 0:
+        raise ValueError(
+            f"workflow_max_step_retries must be an integer >= 0, "
+            f"got {cfg.workflow_max_step_retries!r}")
+    if cfg.workflow_backoff_base_s < 0:
+        raise ValueError(f"workflow_backoff_base_s must be >= 0, "
+                         f"got {cfg.workflow_backoff_base_s}")
+    if cfg.workflow_backoff_max_s < cfg.workflow_backoff_base_s:
+        raise ValueError(
+            f"workflow_backoff_max_s must be >= workflow_backoff_base_s, "
+            f"got {cfg.workflow_backoff_max_s} < "
+            f"{cfg.workflow_backoff_base_s}")
+    if isinstance(cfg.queue_dead_letter_retry_budget, bool) \
+            or not isinstance(cfg.queue_dead_letter_retry_budget, int) \
+            or cfg.queue_dead_letter_retry_budget < 1:
+        raise ValueError(
+            f"queue_dead_letter_retry_budget must be an integer >= 1, "
+            f"got {cfg.queue_dead_letter_retry_budget!r}")
     if cfg.autoscale_up_cooldown_s < 0 or cfg.autoscale_down_cooldown_s < 0:
         raise ValueError("autoscale cooldowns must be >= 0")
     if not 0 < cfg.autoscale_down_watermark <= 1:

@@ -195,6 +195,7 @@ class Program:
             self.kv,
             submit_timeout_s=cfg.queue_submit_timeout_s,
             close_deadline_s=cfg.queue_close_deadline_s,
+            dead_letter_retry_budget=cfg.queue_dead_letter_retry_budget,
             metrics=self.metrics,
             tracer=self.tracer,
             **wq_shard_kwargs,
@@ -231,6 +232,7 @@ class Program:
                 keys.Resource.VOLUMES: keys.VERSIONS_VOLUME_KEY,
                 keys.Resource.JOBS: keys.VERSIONS_JOB_KEY,
                 keys.Resource.SERVICES: keys.VERSIONS_SERVICE_KEY,
+                keys.Resource.WORKFLOWS: keys.VERSIONS_WORKFLOW_KEY,
             }
 
             def _vm(resource):
@@ -309,6 +311,28 @@ class Program:
             up_cooldown_s=cfg.autoscale_up_cooldown_s,
             down_cooldown_s=cfg.autoscale_down_cooldown_s,
             down_watermark=cfg.autoscale_down_watermark,
+            registry=self.metrics,
+            tracer=self.tracer,
+            owns=self._owns_or_none(),
+        )
+        # Workflow resource (service/workflow.py): durable DAG orchestration
+        # over job steps — every step transition a journaled task record
+        # (exactly-once across crashes), promote steps rolling Services,
+        # cron re-fires with explicit catch-up semantics
+        from tpu_docker_api.service.workflow import WorkflowService
+
+        self.workflow_versions = self._make_versions(keys.Resource.WORKFLOWS)
+        if self.informer is not None:
+            self.workflow_versions.attach_informer(self.informer)
+        self.workflow = WorkflowService(
+            self.job_svc, self.store, self.workflow_versions,
+            self.job_versions, work_queue=self.wq, serving=self.serving,
+            admission=self.admission,
+            default_class=cfg.workflow_default_class,
+            max_step_retries=cfg.workflow_max_step_retries,
+            backoff_base_s=cfg.workflow_backoff_base_s,
+            backoff_max_s=cfg.workflow_backoff_max_s,
+            interval_s=cfg.workflow_interval_s,
             registry=self.metrics,
             tracer=self.tracer,
             owns=self._owns_or_none(),
@@ -392,6 +416,9 @@ class Program:
             # replica set after a crash (missing/surplus/orphan replicas,
             # interrupted deletes and spec rolls)
             serving=self.serving,
+            # Workflow adoption: finish interrupted step transitions, GC
+            # finished/orphan step gangs, settle terminal workflows
+            workflow=self.workflow,
             full_interval_s=cfg.reconcile_full_interval_s,
             tracer=self.tracer,
             owns=self._owns_or_none(),
@@ -481,7 +508,8 @@ class Program:
                 maps=[(keys.Resource.CONTAINERS, self.container_versions),
                       (keys.Resource.VOLUMES, self.volume_versions),
                       (keys.Resource.JOBS, self.job_versions),
-                      (keys.Resource.SERVICES, self.service_versions)],
+                      (keys.Resource.SERVICES, self.service_versions),
+                      (keys.Resource.WORKFLOWS, self.workflow_versions)],
                 retention=cfg.history_retention_versions,
                 runtime=self.runtime, pod=self.pod, work_queue=self.wq,
                 interval_s=cfg.history_compact_interval_s,
@@ -545,7 +573,8 @@ class Program:
         cordons, per-host chip/port maps — the local host's schedulers are
         shared with the pod, so the host walk covers them)."""
         for vm in (self.container_versions, self.volume_versions,
-                   self.job_versions, self.service_versions):
+                   self.job_versions, self.service_versions,
+                   self.workflow_versions):
             vm.reload_from_store()
         self.pod_scheduler.reload_from_store()
         for host in self.pod.hosts.values():
@@ -584,7 +613,8 @@ class Program:
         and health watcher."""
         with self._shard_mu:
             for vm in (self.container_versions, self.volume_versions,
-                       self.job_versions, self.service_versions):
+                       self.job_versions, self.service_versions,
+                       self.workflow_versions):
                 vm.reload_shard(shard)
             self.wq.reset_shard_cache(shard)
             self.admission.reset_seq_cache()
@@ -607,6 +637,8 @@ class Program:
                     self.admission.start()
                 if self.cfg.autoscale_interval_s > 0:
                     self.serving.start()
+                if self.cfg.workflow_interval_s > 0:
+                    self.workflow.start()
                 if self.compactor is not None:
                     self.compactor.start()
                 self._shard_writers_on = True
@@ -648,6 +680,7 @@ class Program:
                 self._shard_writers_on = False
                 if self.compactor is not None:
                     self.compactor.close()
+                self.workflow.close()
                 self.serving.close()
                 self.admission.close()
                 self.job_supervisor.close()
@@ -840,6 +873,11 @@ class Program:
             # records) — a writer like the admission loop, leader-only in
             # an HA fleet
             self.serving.start()
+        if self.cfg.workflow_interval_s > 0:
+            # the DAG engine mutates shared state (step gangs, workflow
+            # records) — a writer like the autoscaler, leader-only in an
+            # HA fleet
+            self.workflow.start()
         if self.compactor is not None:
             # history compaction deletes shared state — a writer like the
             # loops above, leader-only in an HA fleet
@@ -851,6 +889,8 @@ class Program:
         again on the same instances."""
         if getattr(self, "compactor", None) is not None:
             self.compactor.close()
+        if getattr(self, "workflow", None) is not None:
+            self.workflow.close()
         if getattr(self, "serving", None) is not None:
             self.serving.close()
         if getattr(self, "admission", None) is not None:
@@ -899,6 +939,7 @@ class Program:
             fanout=self.fanout,
             admission=self.admission,
             serving=self.serving,
+            workflow_svc=self.workflow,
             compactor=self.compactor,
             gateway=self.gateway,
             list_default_limit=self.cfg.list_default_limit,

@@ -248,6 +248,26 @@ class GatewayNoEndpoints(ApiError):
     http_status = 503
 
 
+class WorkflowExisted(ApiError):
+    """POST /workflows of a name that already has a workflow family."""
+    code = 11301
+
+
+class WorkflowNotExist(ApiError):
+    """A /workflows/{name} op on an unknown workflow family."""
+    code = 11302
+
+
+class RetryBudgetExhausted(ApiError):
+    """POST /api/v1/dead-letters/retry refused for a record whose durable
+    operator-retry count reached the cap — a permanently-poisoned task
+    must not be re-driven forever. HTTP 409: the refusal is final for
+    this record until it is deleted or the cap is raised, not transient
+    backpressure."""
+    code = 10803
+    http_status = 409
+
+
 class HostUnreachable(ApiError):
     """A pod host's container engine cannot be reached — connection refused,
     socket timeout, or the host's circuit breaker is open and fast-failing.

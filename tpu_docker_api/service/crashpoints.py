@@ -241,13 +241,48 @@ COMPACTOR_CRASH_POINTS = (
     "compact.mid_trim",
 )
 
+#: durable Workflow DAG lifecycle (service/workflow.py): the chaos matrix
+#: kills the daemon at each of these and proves a fresh Program's
+#: reconcile drives the DAG forward to completion (or terminal failure) —
+#: every step effect applied exactly once (the step-complete marker is
+#: written BEFORE the successor launches, the PR 5 copy-marker pattern),
+#: zero orphan gangs, failed-past-budget workflows settle terminal
+WORKFLOW_CRASH_POINTS = (
+    # the v0 WorkflowState (full DAG spec) is durable in ONE apply; no
+    # step has been considered yet — reconcile starts the roots
+    "workflow.create.after_record",
+    # a step's launch TaskRecord is journaled (idempotency-keyed) and the
+    # step is durably marked "launching"; the gang was never submitted —
+    # replay/reconcile submits it exactly once
+    "workflow.enqueue_step",
+    # the step's gang exists (run or queued through admission) but the
+    # control record still says "launching" — reconcile adopts the gang
+    # instead of double-launching
+    "workflow.after_launch",
+    # the step-complete marker + control-record flip are durable; the
+    # successor steps have NOT been launched — reconcile launches them,
+    # and the marker proves the finished step never re-runs
+    "workflow.after_complete_marker",
+    # the promote step's replace_job_spec rolled the Service, but the
+    # step is not yet marked complete — the marker protocol must prove
+    # the roll happened and not roll again
+    "workflow.after_promote",
+    # a cron tick durably recorded its fire (lastFire + run spawn) —
+    # restart must not double-fire the same tick
+    "workflow.cron_fire",
+    # phase "deleting" is durable; step gangs may still exist —
+    # reconcile finishes the teardown and drops the family
+    "workflow.delete.after_mark",
+)
+
 KNOWN_CRASH_POINTS = (CONTAINER_CRASH_POINTS + JOB_CRASH_POINTS
                       + QUEUE_CRASH_POINTS + TXN_CRASH_POINTS
                       + LEADER_CRASH_POINTS + SHARD_CRASH_POINTS
                       + FANOUT_CRASH_POINTS
                       + ADMISSION_CRASH_POINTS + RESIZE_CRASH_POINTS
                       + SERVICE_CRASH_POINTS + GATEWAY_CRASH_POINTS
-                      + RECONCILE_CRASH_POINTS + COMPACTOR_CRASH_POINTS)
+                      + RECONCILE_CRASH_POINTS + COMPACTOR_CRASH_POINTS
+                      + WORKFLOW_CRASH_POINTS)
 
 
 class SimulatedCrash(BaseException):

@@ -181,6 +181,7 @@ class Reconciler:
         fanout: Fanout | None = None,
         admission=None,
         serving=None,
+        workflow=None,
         full_interval_s: float = 0.0,
         tracer=None,
         owns=None,
@@ -240,6 +241,10 @@ class Reconciler:
         #: replicas created, surplus/orphan fleets torn down, interrupted
         #: deletes and spec rolls finished
         self._serving = serving
+        #: Workflow adoption (service/workflow.py): after services settled,
+        #: the DAG engine's sweep finishes interrupted step transitions,
+        #: GCs finished/orphan step gangs and settles terminal workflows
+        self._workflow = workflow
         self._registry = registry if registry is not None else REGISTRY
         #: event-driven mode (ROADMAP item 4): with a dirty feed attached,
         #: periodic passes visit only watch-dirtied families and the full
@@ -496,6 +501,19 @@ class Reconciler:
             except Exception as e:  # noqa: BLE001 — a store outage must
                 # not abort the sweep; records are re-read next pass
                 log.warning("reconcile: admission adoption failed: %s", e)
+        if self._workflow is not None:
+            # workflow adoption LAST: it drives the DAG engine over the
+            # post-repair world — step gangs already adopted by the job
+            # passes, services already converged (a replayed promote
+            # patches a settled service), admission records settled
+            try:
+                for a in self._workflow.reconcile_workflows(dry_run=dry_run):
+                    a = dict(a)
+                    self._act(actions, dry_run, a.pop("action"),
+                              a.pop("target"), **a)
+            except Exception as e:  # noqa: BLE001 — one subsystem must
+                # not abort the sweep; workflows are re-read next pass
+                log.warning("reconcile: workflow adoption failed: %s", e)
 
     def _replay_queue_journal(self, actions: list[dict],
                               dry_run: bool) -> None:
@@ -573,6 +591,31 @@ class Reconciler:
             self._events.append(trace.stamp(
                 {"ts": time.time(), "dryRun": dry_run, **entry}))
 
+    #: what a CORRUPT stored record raises, as opposed to absent
+    #: (NotExistInStore) or unreachable (StoreUnavailable): truncated/
+    #: garbage JSON is json.JSONDecodeError (a ValueError); a structurally
+    #: wrong payload trips from_dict's KeyError/TypeError/AttributeError
+    POISON_ERRORS = (ValueError, KeyError, TypeError, AttributeError)
+
+    def _quarantine(self, actions: list[dict], dry_run: bool,
+                    resource: str, target: str, exc: BaseException) -> None:
+        """Poison-record quarantine: one family whose latest record cannot
+        even be PARSED must not wedge the whole sweep (before this, a
+        corrupt container record aborted the full pass — every family
+        after it, job repair and all adoption included, silently skipped
+        forever). The family is skipped — loudly: a typed event, the
+        ``reconcile_quarantined_total`` counter, a WARNING — and every
+        other family still converges. No automatic rollback: destroying a
+        version record the operator may be able to repair by hand is not
+        the sweep's call."""
+        self._registry.counter_inc(
+            "reconcile_quarantined_total", {"resource": resource},
+            help="Families skipped because their stored record is corrupt")
+        self._act(actions, dry_run, "quarantine-poison-record", target,
+                  resource=resource, error=f"{type(exc).__name__}: {exc}")
+        log.warning("reconcile: quarantined %s (%s record unparseable: %s)",
+                    target, resource, exc)
+
     def _family_members(self, base: str,
                         hint=None) -> dict[int, str]:
         """Runtime members of one family, by BOUNDED candidate probing:
@@ -629,6 +672,9 @@ class Reconciler:
 
         try:
             state = self.store.get_container(latest_name)
+        except self.POISON_ERRORS as e:
+            self._quarantine(actions, dry_run, "containers", latest_name, e)
+            return False
         except errors.NotExistInStore:
             # crash between version bump and spec persist: pointer with no
             # spec — roll back to the newest version that is stored
@@ -834,6 +880,9 @@ class Reconciler:
             latest_name = versioned_name(base, latest)
             try:
                 st = self.store.get_job(latest_name)
+            except self.POISON_ERRORS as e:
+                self._quarantine(actions, dry_run, "jobs", latest_name, e)
+                return
             except errors.NotExistInStore:
                 self._act(actions, dry_run, "scrub-half-created-job",
                           latest_name,

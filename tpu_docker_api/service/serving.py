@@ -780,6 +780,19 @@ class ServingService:
                 latest_name = versioned_name(base, latest)
                 try:
                     st = self._store.get_service(latest_name)
+                except (ValueError, KeyError, TypeError, AttributeError) as e:
+                    # poison-record quarantine: an unparseable record must
+                    # skip THIS family loudly, not abort the serving sweep
+                    actions.append({"action": "quarantine-poison-record",
+                                    "target": latest_name,
+                                    "resource": "services",
+                                    "error": f"{type(e).__name__}: {e}"})
+                    self._registry.counter_inc(
+                        "reconcile_quarantined_total",
+                        {"resource": "services"},
+                        help="Families skipped because their stored record "
+                             "is corrupt")
+                    continue
                 except errors.NotExistInStore:
                     stored = self._store.history(Resource.SERVICES, base)
                     prev = max((v for v in stored if v < latest),

@@ -36,6 +36,7 @@ class Resource(str, enum.Enum):
     VOLUMES = "volumes"
     JOBS = "jobs"
     SERVICES = "services"
+    WORKFLOWS = "workflows"
 
 
 def split_versioned_name(name: str) -> tuple[str, int | None]:
@@ -91,6 +92,7 @@ VERSIONS_CONTAINER_KEY = f"{PREFIX}/versions/containers"
 VERSIONS_VOLUME_KEY = f"{PREFIX}/versions/volumes"
 VERSIONS_JOB_KEY = f"{PREFIX}/versions/jobs"
 VERSIONS_SERVICE_KEY = f"{PREFIX}/versions/services"
+VERSIONS_WORKFLOW_KEY = f"{PREFIX}/versions/workflows"
 
 
 # -- leader election (service/leader.py) ---------------------------------------

@@ -121,6 +121,17 @@ class StateStore:
 
         return ServiceState.from_dict(self._get(Resource.SERVICES, name))
 
+    # -- workflows --------------------------------------------------------------
+
+    def put_workflow(self, st) -> None:
+        base, _ = keys.split_versioned_name(st.workflow_name)
+        self._put(Resource.WORKFLOWS, base, st.version, st.to_dict())
+
+    def get_workflow(self, name: str):
+        from tpu_docker_api.schemas.workflow import WorkflowState
+
+        return WorkflowState.from_dict(self._get(Resource.WORKFLOWS, name))
+
     # -- volumes ----------------------------------------------------------------
 
     def put_volume(self, st: VolumeState) -> None:

@@ -79,6 +79,12 @@ DEFAULT_SUBMIT_TIMEOUT_S = 5.0
 #: abandoning the loop thread (config queue_close_deadline_s); journaled
 #: records survive for the next daemon's replay either way
 DEFAULT_CLOSE_DEADLINE_S = 10.0
+#: dead-letter hygiene: how many times the OPERATOR may re-drive one dead
+#: record through POST /api/v1/dead-letters/retry before the typed
+#: RetryBudgetExhausted refusal (config queue_dead_letter_retry_budget) —
+#: the count is durable on the record, so the cap survives daemon restarts
+#: and a permanently-poisoned task can't be blind-retried forever
+DEFAULT_DEAD_LETTER_RETRY_BUDGET = 3
 
 
 # -- legacy ephemeral tasks (tests / ad-hoc chains; NOT journaled) -------------
@@ -151,6 +157,12 @@ class TaskRecord:
     #: its own sub-prefix and replays only its own records on takeover);
     #: legacy records with no field parse to shard 0 — the legacy keyspace
     shard: int = 0
+    #: durable operator-retry count: how many times this record has been
+    #: revived through POST /api/v1/dead-letters/retry. Distinct from
+    #: ``attempts`` (the per-revival automatic retry loop, which resets on
+    #: revival): this one only grows, so the retry budget holds across
+    #: restarts. Legacy records with no field parse to 0 — full budget.
+    op_retries: int = 0
 
     def to_json(self) -> str:
         d = {
@@ -161,6 +173,8 @@ class TaskRecord:
         }
         if self.shard:
             d["shard"] = self.shard
+        if self.op_retries:
+            d["opRetries"] = self.op_retries
         return json.dumps(d, sort_keys=True)
 
     @classmethod
@@ -173,7 +187,8 @@ class TaskRecord:
                    idempotency_key=d.get("idempotencyKey", ""),
                    trace_id=d.get("traceId", ""),
                    span_id=d.get("spanId", ""),
-                   shard=int(d.get("shard", 0)))
+                   shard=int(d.get("shard", 0)),
+                   op_retries=int(d.get("opRetries", 0)))
 
     def label(self) -> str:
         return f"{self.kind}:{self.task_id}"
@@ -200,6 +215,7 @@ class WorkQueue:
         seed: int | None = None,
         submit_timeout_s: float = DEFAULT_SUBMIT_TIMEOUT_S,
         close_deadline_s: float = DEFAULT_CLOSE_DEADLINE_S,
+        dead_letter_retry_budget: int = DEFAULT_DEAD_LETTER_RETRY_BUDGET,
         metrics=None,
         tracer=None,
         shard_fn: Callable[[str, dict], int] | None = None,
@@ -218,6 +234,7 @@ class WorkQueue:
         self._rng = random.Random(seed)
         self._submit_timeout_s = submit_timeout_s
         self._close_deadline_s = close_deadline_s
+        self._dl_retry_budget = dead_letter_retry_budget
         self._thread: threading.Thread | None = None
         self._closed = False
         #: ephemeral dead letters (legacy closure tasks only; records
@@ -895,6 +912,8 @@ class WorkQueue:
                         "params": rec.params, "attempts": rec.attempts,
                         "task": f"{rec.kind}({json.dumps(rec.params, sort_keys=True)})",
                         "error": rec.error, "durable": True,
+                        "opRetries": rec.op_retries,
+                        "retryable": rec.op_retries < self._dl_retry_budget,
                     })
         with self._dl_mu:
             for t, e in self._ephemeral_dead:
@@ -911,11 +930,17 @@ class WorkQueue:
         return out
 
     def retry_dead_letters(self) -> int:
-        """Re-enqueue every dead-lettered task (POST /api/v1/dead-letters/
-        retry) — the operator fixed the underlying fault (disk full, engine
-        down) and wants the lost work to run, not a process restart. Each
-        task gets a fresh retry budget; tasks that fail again dead-letter
-        again. Returns how many were re-enqueued."""
+        """Re-enqueue dead-lettered tasks (POST /api/v1/dead-letters/retry)
+        — the operator fixed the underlying fault (disk full, engine down)
+        and wants the lost work to run, not a process restart. Each task
+        gets a fresh AUTOMATIC retry budget, but its durable operator-retry
+        count (``opRetries``) only grows: a record past
+        ``dead_letter_retry_budget`` revivals is refused, and when EVERY
+        dead letter is past budget the call raises the typed
+        :class:`errors.RetryBudgetExhausted` instead of silently requeueing
+        nothing — a permanently-poisoned task must be deleted or fixed, not
+        re-driven forever. Returns how many were re-enqueued."""
+        exhausted: list[str] = []
         with self._lifecycle_mu:
             if self._thread is None:
                 # queue closed: durable letters stay observable in the
@@ -930,9 +955,13 @@ class WorkQueue:
                     continue
                 if owned is not None and rec.shard not in owned:
                     continue  # that shard's leader revives its own dead
+                if rec.op_retries >= self._dl_retry_budget:
+                    exhausted.append(rec.label())
+                    continue  # refused: stays dead, stays observable
                 rec.state = "pending"
                 rec.error = ""
                 rec.attempts = 0
+                rec.op_retries += 1
                 # claim local ownership BEFORE the record becomes pending
                 # in the journal: a concurrent reconcile replay must see
                 # it as ours, or it double-runs the revived task
@@ -968,6 +997,13 @@ class WorkQueue:
                         self._ephemeral_dead.extend(entries[i:])
                     return n
                 n += 1
+            if n == 0 and exhausted:
+                # nothing revived and at least one letter was refused:
+                # surface the refusal as a typed 409, not {"requeued": 0}
+                raise errors.RetryBudgetExhausted(
+                    f"{len(exhausted)} dead letter(s) past the "
+                    f"operator-retry budget ({self._dl_retry_budget}): "
+                    + ", ".join(sorted(exhausted)[:5]))
             return n
 
     # -- stats (GET /api/v1/queue) -------------------------------------------------
