@@ -13,7 +13,7 @@ BUILDINFO_ENV = \
   TPU_DOCKER_API_BRANCH=$(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown) \
   TPU_DOCKER_API_COMMIT=$(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-serve-traffic bench-scale bench-shard bench-workflow openapi sample-interface run clean
+.PHONY: all native test test-fast chaos bench bench-churn trace-check bench-failover bench-brownout bench-reads bench-fanout bench-preempt bench-resize bench-serve-scale bench-serve-traffic bench-scale bench-shard bench-workflow openapi sample-interface run clean
 
 all: native openapi
 
@@ -56,6 +56,11 @@ bench-failover:              ## HA failover family: kill the leader under churn,
 	$(PY) bench.py --control-plane --cp-family failover --failovers 4 > bench-failover.json.tmp
 	$(PY) scripts/check_churn_schema.py bench-failover.json.tmp
 	mv bench-failover.json.tmp bench-failover.json
+
+bench-brownout:              ## store brownout family: slow then kill the STORE under churn; typed+bounded calls, marked stale reads, zero spurious restarts, recovery-to-writes + schema gate
+	$(PY) bench.py --control-plane --cp-family brownout > bench-brownout.json.tmp
+	$(PY) scripts/check_churn_schema.py bench-brownout.json.tmp
+	mv bench-brownout.json.tmp bench-brownout.json
 
 bench-reads:                 ## HA reads family: GET throughput per role + store-reads-per-request audit + schema gate
 	$(PY) bench.py --control-plane --cp-family reads --cp-iters 400 > bench-reads.json.tmp

@@ -621,6 +621,289 @@ def measure_control_plane_failover(n_failovers: int = 5,
     }
 
 
+def measure_control_plane_brownout(n_cycles: int = 12,
+                                   latency_ms: float = 30.0,
+                                   n_outages: int = 3,
+                                   outage_s: float = 0.8,
+                                   deadline_s: float = 2.0) -> dict:
+    """Control-plane brownout family (``--control-plane --cp-family
+    brownout``): ONE daemon (``leader_election = true`` so the informer
+    mirror is live) over a :class:`~tpu_docker_api.state.faulty.FaultyKV`,
+    churning containers through the full HTTP stack while the STORE — not
+    a daemon, not an engine — is taken through the three acts of a real
+    brownout (docs/robustness.md "Store brownouts"):
+
+    1. **baseline** — healthy store, every churn cycle must land;
+    2. **latency window** — every op slowed ``latency_ms``: a slow store
+       is NOT a failure, every cycle must still land (the degraded-mode
+       machinery must add zero false positives under mere slowness);
+    3. **hard outage × heal, ``n_outages`` times** — every API call made
+       mid-outage must RESOLVE (typed, bounded — never hang): GETs serve
+       from the informer mirror with the staleness EXPLICITLY marked
+       (envelope ``stale`` + ``X-Stale-Read``), mutations fail fast with
+       the typed refusal (10506 + ``Retry-After``) or the single
+       heal-probe's typed ``StoreUnavailable`` (10502); the steady gang
+       pinned under the job supervisor must see ZERO engine calls (a
+       store outage must never become a spurious gang restart); then the
+       store heals and **time-to-recovered-writes** is measured from heal
+       to the first accepted+committed mutation.
+
+    Self-gating: all of the above as booleans, plus recovery p95 inside a
+    probe-interval-derived budget and stale-read lag bounded by the outage
+    duration. A violated gate flips ``gates.ok`` — main() turns that into
+    a nonzero exit, so "rides through the store outage" stays a measured
+    invariant, not an adjective."""
+    import statistics
+    import urllib.error
+    import urllib.request
+
+    from tpu_docker_api.config import Config
+    from tpu_docker_api.daemon import Program
+    from tpu_docker_api.runtime.fake import FakeRuntime
+    from tpu_docker_api.state.faulty import FaultyKV
+    from tpu_docker_api.state.kv import MemoryKV
+
+    if n_cycles < 2 or n_outages < 2:
+        raise ValueError("brownout needs >= 2 cycles and >= 2 outages "
+                         "for quantiles")
+    probe_interval_s = 0.2
+    outage_grace_s = 0.25
+    kv = FaultyKV(MemoryKV())
+    runtime = FakeRuntime()
+    prg = Program(Config(
+        port=0, store_backend="memory", runtime_backend="fake",
+        start_port=45000, end_port=45999, health_watch_interval=0,
+        reconcile_interval=0, leader_election=True,
+        # the lease must RIDE THROUGH the whole storm (renew failures are
+        # typed and tolerated until expiry, and the short healthy gaps
+        # between rounds can miss every ttl/3 renew tick): leadership
+        # churn under a dead store is the failover family's subject, not
+        # this one's
+        leader_ttl_s=60.0, leader_id="bench-brownout",
+        store_health_outage_grace_s=outage_grace_s,
+        store_health_probe_interval_s=probe_interval_s,
+    ), host="127.0.0.1", kv=kv, runtime=runtime)
+    prg.init()
+    prg.start()
+    port = prg.api_server.port
+
+    def call(method, path, body=None, timeout=deadline_s + 3.0):
+        """Raw call: returns (app_code, headers, envelope) — outage-phase
+        responses are typed refusals, not transport errors, so the
+        non-200 app codes are data here, not exceptions."""
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out = json.loads(resp.read())
+                return out["code"], dict(resp.headers), out
+        except urllib.error.HTTPError as e:
+            out = json.loads(e.read())
+            return out["code"], dict(e.headers), out
+
+    def must(method, path, body=None):
+        code, _, out = call(method, path, body)
+        if code != 200:
+            raise RuntimeError(f"{method} {path}: {out}")
+        return out
+
+    def cycle(name: str) -> float:
+        t0 = time.perf_counter()
+        must("POST", "/api/v1/containers",
+             {"imageName": "jax", "containerName": name, "chipCount": 1})
+        must("DELETE", f"/api/v1/containers/{name}",
+             {"force": True, "delEtcdInfoAndVersionRecord": True})
+        return (time.perf_counter() - t0) * 1e3
+
+    def quants(ms: list[float]) -> dict:
+        qs = statistics.quantiles(ms, n=20)
+        return {"p50": round(statistics.median(ms), 3),
+                "p95": round(min(qs[18], max(ms)), 3),
+                "max": round(max(ms), 3)}
+
+    deadline = time.monotonic() + 10.0
+    while (not prg.leader_elector.is_leader
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    if not prg.leader_elector.is_leader:
+        raise RuntimeError("brownout daemon never acquired the lease")
+
+    recoveries_ms: list[float] = []
+    outage_call_ms: list[float] = []
+    stale_lags_ms: list[float] = []
+    outage_codes: dict[str, int] = {}
+    all_resolved = True
+    mutations_typed = True
+    stale_marked = True
+    steady_untouched = True
+    try:
+        # a steady one-chip gang the supervisor owns for the whole run:
+        # the canary for "a store outage must not restart healthy work"
+        # (one chip, so the churn load beside it never starves). The
+        # fresh leader refuses writes (10901) until its writer subsystems
+        # finish booting — wait that window out, it is not under test
+        t0 = time.monotonic()
+        while True:
+            code, _, out = call(
+                "POST", "/api/v1/jobs",
+                {"imageName": "jax", "jobName": "steady", "chipCount": 1})
+            if code == 200:
+                break
+            if code != 10901 or time.monotonic() - t0 > 10.0:
+                raise RuntimeError(f"steady gang create failed: {out}")
+            time.sleep(0.02)
+        steady = must("GET", "/api/v1/jobs/steady")["data"]
+        if steady.get("phase") != "running":
+            raise RuntimeError(f"steady gang not running: {steady}")
+
+        baseline_ms = [cycle(f"bw{i}") for i in range(n_cycles)]
+
+        kv.set_latency(latency_ms / 1e3)
+        latency_cycles = max(n_cycles // 3, 4)
+        latency_ms_samples = [cycle(f"lw{i}") for i in range(latency_cycles)]
+        kv.set_latency(0.0)
+
+        # staleness bound: a stale read's lag may never exceed how long
+        # the storm has been running (plus pre-storm poll slack) — the
+        # informer backoff can span a short heal window, so lag legally
+        # accumulates ACROSS rounds, but never past the storm itself
+        t_storm0 = time.monotonic()
+        stale_margin_ms = 0.0
+        probe_seq = 0
+        for k in range(n_outages):
+            engine_calls_before = len(runtime.calls)
+            kv.set_outage(True)
+            t0 = time.monotonic()
+            while (prg.store_health.mode != "outage"
+                   and time.monotonic() - t0 < 10.0):
+                time.sleep(0.01)
+            if prg.store_health.mode != "outage":
+                raise RuntimeError(
+                    f"outage {k}: mode stuck at {prg.store_health.mode}")
+            hold_until = time.monotonic() + outage_s
+            while time.monotonic() < hold_until:
+                t = time.perf_counter()
+                code, hdr, out = call("GET", "/api/v1/jobs/steady")
+                wall = (time.perf_counter() - t) * 1e3
+                outage_call_ms.append(wall)
+                all_resolved &= wall <= (deadline_s + 1.0) * 1e3
+                if code == 200 and out.get("stale"):
+                    lag = float(out["stale"]["lagMs"])
+                    stale_lags_ms.append(lag)
+                    storm_ms = (time.monotonic() - t_storm0) * 1e3
+                    stale_margin_ms = max(stale_margin_ms, lag - storm_ms)
+                else:
+                    stale_marked = False
+                # unique name per attempt: a heal-probe mutation may have
+                # HALF-landed (runtime container created, store write
+                # refused) — reusing the name would collide on the orphan
+                # and report the wrong error class
+                probe_seq += 1
+                t = time.perf_counter()
+                code, hdr, out = call(
+                    "POST", "/api/v1/containers",
+                    {"imageName": "jax", "containerName": f"ow{probe_seq}",
+                     "chipCount": 1})
+                wall = (time.perf_counter() - t) * 1e3
+                outage_call_ms.append(wall)
+                all_resolved &= wall <= (deadline_s + 1.0) * 1e3
+                outage_codes[str(code)] = outage_codes.get(str(code), 0) + 1
+                mutations_typed &= code in (10502, 10506)
+                time.sleep(0.05)
+            # the canary: no engine mutation may have touched the steady
+            # gang while the store was dark (inspect is not journaled)
+            steady_untouched &= not any(
+                name.startswith("steady")
+                for _, name in runtime.calls[engine_calls_before:])
+            t_heal = time.perf_counter()
+            kv.set_outage(False)
+            recovered = False
+            probe = f"rw{k}"
+            while time.perf_counter() - t_heal < 15.0:
+                code, _, _ = call(
+                    "POST", "/api/v1/containers",
+                    {"imageName": "jax", "containerName": probe,
+                     "chipCount": 1})
+                if code == 200:
+                    recovered = True
+                    break
+                time.sleep(0.01)
+            if not recovered:
+                raise RuntimeError(f"outage {k}: writes never recovered")
+            recoveries_ms.append((time.perf_counter() - t_heal) * 1e3)
+            must("DELETE", f"/api/v1/containers/{probe}",
+                 {"force": True, "delEtcdInfoAndVersionRecord": True})
+
+        # post-storm: the steady gang is still running and churn still lands
+        final_ms = cycle("bwfinal")
+        steady_after = must("GET", "/api/v1/jobs/steady")["data"]
+        steady_alive = steady_after.get("phase") == "running"
+        health = prg.store_health.status_view()
+    finally:
+        try:
+            prg.leader_elector.close(release=True)
+            prg.api_server.close()
+            prg._stop_writers()
+        except Exception:
+            pass
+
+    rq = quants(recoveries_ms)
+    # recovery is driven by the heal probe: one probe slot to reach the
+    # store and flip the mode, the probe itself IS the first accepted
+    # mutation — probe interval + slack for a loaded CI host
+    recovery_budget_ms = (probe_interval_s + 3.0) * 1e3
+    # staleness can only accumulate while the store has been misbehaving:
+    # each read's lag must stay within the storm's own elapsed time, plus
+    # pre-storm watch-poll slack (the stale_margin_ms computed per read)
+    stale_budget_ms = 3000.0
+    stale_lag_ok = (bool(stale_lags_ms)
+                    and stale_margin_ms <= stale_budget_ms)
+    mode_healthy = health["mode"] == "healthy"
+    outages_counted = health["outagesTotal"] == n_outages
+    return {
+        "family": "brownout",
+        "iters": {"cycles": n_cycles, "latency_cycles": latency_cycles,
+                  "outages": n_outages},
+        "latency_ms_injected": latency_ms,
+        "outage_s": outage_s,
+        "deadline_s": deadline_s,
+        "baseline_cycle_ms": quants(baseline_ms),
+        "latency_cycle_ms": quants(latency_ms_samples),
+        "final_cycle_ms": round(final_ms, 3),
+        "outage_calls": len(outage_call_ms),
+        "outage_call_ms": quants(outage_call_ms),
+        "outage_mutation_codes": outage_codes,
+        "stale_reads": len(stale_lags_ms),
+        "stale_lag_ms_max": round(max(stale_lags_ms), 3) if stale_lags_ms
+        else None,
+        "stale_margin_ms": round(stale_margin_ms, 3),
+        "recovery_ms": rq,
+        "recoveries_ms": [round(v, 3) for v in recoveries_ms],
+        "store_health": {k: health[k] for k in
+                         ("mode", "outagesTotal", "opsOk",
+                          "opsUnavailable", "staleReads")},
+        "gates": {
+            "all_calls_resolved": all_resolved,
+            "mutations_typed": mutations_typed,
+            "stale_reads_marked": stale_marked,
+            "stale_lag_budget_ms": round(stale_budget_ms, 1),
+            "stale_lag_bounded": stale_lag_ok,
+            "steady_gang_untouched": steady_untouched,
+            "steady_gang_alive": steady_alive,
+            "mode_healed": mode_healthy,
+            "outages_counted": outages_counted,
+            "recovery_p95_budget_ms": round(recovery_budget_ms, 1),
+            "ok": bool(all_resolved and mutations_typed and stale_marked
+                       and stale_lag_ok and steady_untouched
+                       and steady_alive and mode_healthy
+                       and outages_counted
+                       and rq["p95"] <= recovery_budget_ms),
+        },
+    }
+
+
 def measure_control_plane_shard(n_cycles: int = 60, shard_count: int = 3,
                                 ttl_s: float = 1.5,
                                 store_rtt_ms: float = 40.0,
@@ -2808,7 +3091,7 @@ def measure_control_plane_scale(n_objects: int = 50000, n_small: int = 1000,
     }
 
 
-CP_FAMILIES = ("create", "churn", "failover", "reads", "fanout",
+CP_FAMILIES = ("create", "churn", "failover", "brownout", "reads", "fanout",
                "preempt", "resize", "serve-scale", "serve-traffic",
                "scale", "shard", "workflow")
 
@@ -2826,6 +3109,11 @@ def _run_cp_family(family: str, args) -> dict:
     if family == "failover":
         return measure_control_plane_failover(
             args.failovers, ttl_s=args.failover_ttl)
+    if family == "brownout":
+        return measure_control_plane_brownout(
+            n_cycles=args.brownout_cycles, n_outages=args.brownout_outages,
+            outage_s=args.brownout_outage_s,
+            latency_ms=args.brownout_latency_ms)
     if family == "shard":
         return measure_control_plane_shard(
             n_cycles=args.shard_cycles, ttl_s=args.shard_ttl,
@@ -2906,6 +3194,9 @@ def _cp_headline(family: str, cp: dict) -> tuple[str, float, str]:
     if family == "failover":
         return ("control_plane_failover_recovery_ms_p50",
                 cp["recovery_ms"]["p50"], "ms")
+    if family == "brownout":
+        return ("control_plane_brownout_recovery_ms_p50",
+                cp["recovery_ms"]["p50"], "ms")
     if family == "shard":
         return ("control_plane_shard_churn_speedup", cp["speedup"], "x")
     if family == "churn":
@@ -2948,7 +3239,8 @@ def degraded_control_plane_evidence(args, deadline: float) -> int:
     ``BENCH_DEGRADED_FAMILIES`` (comma list) overrides the default set."""
     families = [f.strip() for f in os.environ.get(
         "BENCH_DEGRADED_FAMILIES",
-        "churn,preempt,resize,serve-scale,serve-traffic,scale,shard,workflow"
+        "churn,preempt,resize,serve-scale,serve-traffic,scale,shard,"
+        "workflow,brownout"
         ).split(",")
         if f.strip()]
     green = 0
@@ -3016,7 +3308,11 @@ def main() -> int | None:
                              "AND gangs with store round-trips per flow; "
                              "failover = kill the HA leader under churn "
                              "load, time-to-recovered-writes on the "
-                             "standby; reads = hammer the GET surface on "
+                             "standby; brownout = slow then kill the "
+                             "STORE under churn, gating typed+bounded "
+                             "calls, marked stale reads, zero spurious "
+                             "gang restarts and time-to-recovered-writes "
+                             "after heal; reads = hammer the GET surface on "
                              "leader + informer standby + read-through "
                              "standby, with a store-reads-per-request "
                              "audit; fanout = gang lifecycle at member "
@@ -3058,6 +3354,18 @@ def main() -> int | None:
                              "cp-iters // 10 (min 2)")
     parser.add_argument("--failovers", type=int, default=5,
                         help="leader kills for the failover family")
+    parser.add_argument("--brownout-cycles", type=int, default=12,
+                        help="baseline churn cycles for the brownout "
+                             "family (latency window runs a third)")
+    parser.add_argument("--brownout-outages", type=int, default=3,
+                        help="hard outage + heal rounds for the brownout "
+                             "family")
+    parser.add_argument("--brownout-outage-s", type=float, default=0.8,
+                        help="seconds the store stays dark per brownout "
+                             "round")
+    parser.add_argument("--brownout-latency-ms", type=float, default=30.0,
+                        help="injected per-op store latency for the "
+                             "brownout family's slow-store window")
     parser.add_argument("--fanout-iters", type=int, default=3,
                         help="gang lifecycle cycles per member count for "
                              "the fanout family (min wall is gated)")

@@ -72,6 +72,79 @@ def validate_failover(extra: dict) -> list[str]:
     return problems
 
 
+def validate_brownout(extra: dict) -> list[str]:
+    """The brownout-family headline payload: churn quantiles for the three
+    store acts (healthy / slow / dark), the outage-window call audit, the
+    stale-read proof and recovery quantiles. The load-bearing gates are
+    RE-DERIVED here from the payload, not just read back — a bench edit
+    that pins ``gates.ok`` true while the evidence rots must fail the
+    schema check."""
+    problems: list[str] = []
+    iters = extra.get("iters") or {}
+    n_outages = iters.get("outages")
+    if not (isinstance(n_outages, int) and n_outages >= 2):
+        problems.append(f"brownout: iters.outages must be an int >= 2, "
+                        f"got {n_outages!r}")
+    for block in ("baseline_cycle_ms", "latency_cycle_ms",
+                  "outage_call_ms", "recovery_ms"):
+        q = extra.get(block) or {}
+        for key in QUANTS:
+            if not _num(q.get(key)):
+                problems.append(f"brownout: {block}.{key} missing")
+    series = extra.get("recoveries_ms")
+    if (not isinstance(series, list) or len(series) != n_outages
+            or not all(_num(v) and v > 0 for v in series)):
+        problems.append("brownout: recoveries_ms must list one positive "
+                        "recovery per outage")
+    # the outage-window audit must have actually run: calls were made,
+    # every mutation's app code was one of the two typed refusals, and
+    # stale reads were both present and marked
+    if not (isinstance(extra.get("outage_calls"), int)
+            and extra["outage_calls"] >= 2 * n_outages):
+        problems.append(f"brownout: outage_calls too few "
+                        f"({extra.get('outage_calls')!r}) — the outage "
+                        f"window was not exercised")
+    codes = extra.get("outage_mutation_codes") or {}
+    bad = {c: n for c, n in codes.items() if c not in ("10502", "10506")}
+    if not codes:
+        problems.append("brownout: outage_mutation_codes empty — no "
+                        "mutation was attempted against the dark store")
+    if bad:
+        problems.append(f"brownout: untyped outage mutation codes {bad} "
+                        f"(only 10502/10506 prove the refusal is typed)")
+    stale = extra.get("stale_reads")
+    if not (isinstance(stale, int) and stale > 0):
+        problems.append(f"brownout: stale_reads = {stale!r} — no read was "
+                        f"served from the mirror, so 'reads ride through' "
+                        f"proves nothing")
+    if not _num(extra.get("stale_lag_ms_max")):
+        problems.append("brownout: stale_lag_ms_max missing")
+    health = extra.get("store_health") or {}
+    if health.get("mode") != "healthy":
+        problems.append(f"brownout: store_health.mode must end healthy, "
+                        f"got {health.get('mode')!r}")
+    if health.get("outagesTotal") != n_outages:
+        problems.append(f"brownout: store_health.outagesTotal "
+                        f"{health.get('outagesTotal')!r} != outages "
+                        f"{n_outages!r} — the monitor missed a round")
+    gates = extra.get("gates") or {}
+    for key in ("all_calls_resolved", "mutations_typed",
+                "stale_reads_marked", "stale_lag_bounded",
+                "steady_gang_untouched", "steady_gang_alive",
+                "mode_healed", "outages_counted",
+                "recovery_p95_budget_ms", "ok"):
+        if key not in gates:
+            problems.append(f"brownout: gates.{key} missing")
+    budget = gates.get("recovery_p95_budget_ms")
+    p95 = (extra.get("recovery_ms") or {}).get("p95")
+    if _num(budget) and _num(p95) and p95 > budget:
+        problems.append(f"brownout: recovery p95 {p95} over budget "
+                        f"{budget} but gate not tripped")
+    if gates.get("ok") is not True:
+        problems.append(f"brownout: regression gate failed: {gates}")
+    return problems
+
+
 def validate_reads(extra: dict) -> list[str]:
     """The reads-family headline payload: per-role throughput/latency, the
     store-reads-per-request audit, and a passing gate. The audit gates are
@@ -717,6 +790,10 @@ def validate_lines(lines: list[dict]) -> list[str]:
                 if (ln.get("extra") or {}).get("family") == "failover"]
     if failover:
         return problems + validate_failover(failover[0]["extra"])
+    brownout = [ln for ln in lines
+                if (ln.get("extra") or {}).get("family") == "brownout"]
+    if brownout:
+        return problems + validate_brownout(brownout[0]["extra"])
     reads = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "reads"]
     if reads:
@@ -756,9 +833,10 @@ def validate_lines(lines: list[dict]) -> list[str]:
     churn = [ln for ln in lines
              if (ln.get("extra") or {}).get("family") == "churn"]
     if not churn:
-        return problems + ["no churn, failover, reads, fanout, preempt, "
-                           "resize, serve-scale, serve-traffic, scale, "
-                           "shard or workflow headline line (extra.family)"]
+        return problems + ["no churn, failover, brownout, reads, fanout, "
+                           "preempt, resize, serve-scale, serve-traffic, "
+                           "scale, shard or workflow headline line "
+                           "(extra.family)"]
     extra = churn[0]["extra"]
 
     num = _num

@@ -195,6 +195,69 @@ class TestFailoverFamily:
         assert len(failover["recoveries_ms"]) == 2
 
 
+class TestBrownoutFamily:
+    """The store-brownout family (``make bench-brownout``) at tiny scale —
+    pinning both the artifact schema (scripts/check_churn_schema.py) and
+    the tentpole invariants: with the STORE slow and then dark under churn,
+    every call resolves typed and bounded (no hangs), reads ride the
+    informer mirror explicitly marked stale, the steady gang is never
+    touched by a spurious repair, and writes recover within the
+    probe-derived budget after every heal."""
+
+    @pytest.fixture(scope="class")
+    def brownout(self):
+        return bench.measure_control_plane_brownout(
+            n_cycles=6, n_outages=2, outage_s=0.5)
+
+    def test_schema_checker_accepts_the_emitted_line(self, brownout):
+        sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                               / "scripts"))
+        try:
+            from check_churn_schema import validate_lines
+        finally:
+            sys.path.pop(0)
+        line = {"metric": "control_plane_brownout_recovery_ms_p50",
+                "value": brownout["recovery_ms"]["p50"], "unit": "ms",
+                "vs_baseline": 1.0, "extra": brownout}
+        assert validate_lines([line]) == []
+        # the checker is not a rubber stamp: a broken gate must fail it
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["gates"]["ok"] = False
+        assert any("gate" in p for p in validate_lines([bad]))
+        # ... an untyped refusal leaking through must fail
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["outage_mutation_codes"]["10301"] = 1
+        assert any("untyped" in p for p in validate_lines([bad]))
+        # ... an outage window that never served a stale read is vacuous
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["stale_reads"] = 0
+        assert any("stale_reads" in p for p in validate_lines([bad]))
+        # ... and a run that ends with the store still dark must fail
+        bad = json.loads(json.dumps(line))
+        bad["extra"]["store_health"]["mode"] = "outage"
+        assert any("end healthy" in p for p in validate_lines([bad]))
+
+    def test_brownout_gates_hold(self, brownout):
+        gates = brownout["gates"]
+        assert gates["ok"] is True
+        assert gates["all_calls_resolved"] is True
+        assert gates["mutations_typed"] is True
+        assert gates["stale_reads_marked"] is True
+        assert gates["stale_lag_bounded"] is True
+        assert gates["steady_gang_untouched"] is True
+        assert gates["steady_gang_alive"] is True
+        assert gates["mode_healed"] is True
+        assert gates["outages_counted"] is True
+        rec = brownout["recovery_ms"]
+        assert rec["p50"] <= rec["p95"] <= rec["max"]
+        assert rec["p95"] <= gates["recovery_p95_budget_ms"]
+        assert len(brownout["recoveries_ms"]) == 2
+        # every mutation thrown at the dark store was refused typed
+        assert set(brownout["outage_mutation_codes"]) <= {"10502", "10506"}
+        assert brownout["stale_reads"] > 0
+        assert brownout["store_health"]["outagesTotal"] == 2
+
+
 class TestReadsFamily:
     """The watch-fed read-path family (``make bench-reads``): leader +
     informer standby + read-through standby over one store at tiny scale —

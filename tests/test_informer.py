@@ -24,6 +24,7 @@ from tpu_docker_api import errors
 from tpu_docker_api.daemon import Program
 from tpu_docker_api.runtime.fake import FakeRuntime
 from tpu_docker_api.state import keys
+from tpu_docker_api.state.faulty import FaultyKV
 from tpu_docker_api.state.informer import Informer, InformerReadKV
 from tpu_docker_api.state.kv import CountingKV, MemoryKV
 from tpu_docker_api.state.version import VersionMap
@@ -103,20 +104,11 @@ class TestInformerReflector:
             inf.close()
 
     def test_store_outage_degrades_then_recovers(self):
-        class _OutageKV(MemoryKV):
-            def __init__(self):
-                super().__init__()
-                self.fail_lists = 0
-
-            def range_prefix_with_rev(self, prefix):
-                if self.fail_lists > 0:
-                    self.fail_lists -= 1
-                    raise errors.StoreUnavailable("injected outage")
-                return super().range_prefix_with_rev(prefix)
-
-        kv = _OutageKV()
+        kv = FaultyKV(MemoryKV())
         kv.put(f"{keys.PREFIX}/x", "1")
-        kv.fail_lists = 2
+        # the next two relist attempts fail typed, then the store heals
+        kv.fail_nth("range_prefix_with_rev", kv.op_count(
+            "range_prefix_with_rev") + 1, times=2)
         inf = make_informer(kv)
         inf.start()
         try:

@@ -383,6 +383,10 @@ class TestDaemonRoleSplit:
             _, out = call(port, "GET", "/healthz")
             assert out["data"]["role"] == "single"
             _, out = call(port, "GET", "/api/v1/leader")
+            # storeHealth reports on every role (a single-process daemon
+            # browns out too); the election surface itself is unchanged
+            store_health = out["data"].pop("storeHealth")
+            assert store_health["mode"] == "healthy"
             assert out["data"] == {
                 "election": False, "role": "single", "accepting": True,
                 "selfId": None, "holderId": None, "epoch": None,

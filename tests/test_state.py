@@ -144,8 +144,10 @@ class TestKVApply:
     def test_sqlite_foreign_lock_is_bounded_and_atomic(self, tmp_path):
         """A foreign writer holding the database (backup tooling, a second
         daemon by mistake) makes the batched mutations fail after the
-        bounded busy wait — with the whole batch rolled back, never half of
-        it (the sqlite-layer analog of the PR 5 ``_OutageKV`` tests)."""
+        bounded busy wait — as the TYPED ``StoreUnavailable`` (so
+        StoreHealth classifies it like any other store failure, never a
+        raw ``sqlite3.OperationalError`` leaking backend vocabulary) and
+        with the whole batch rolled back, never half of it."""
         path = str(tmp_path / "locked.db")
         s = SqliteKV(path, busy_timeout_s=0.05)
         s.put("/fam/a", "1")
@@ -154,9 +156,9 @@ class TestKVApply:
         foreign.execute("BEGIN IMMEDIATE")  # foreign write lock
         try:
             t0 = time.monotonic()
-            with pytest.raises(sqlite3.OperationalError):
+            with pytest.raises(errors.StoreUnavailable):
                 s.delete_prefix("/fam/")
-            with pytest.raises(sqlite3.OperationalError):
+            with pytest.raises(errors.StoreUnavailable):
                 s.apply([("put", "/fam/c", "3"), ("delete", "/fam/a")])
             assert time.monotonic() - t0 < 5.0  # bounded wait, not a hang
         finally:

@@ -1,0 +1,633 @@
+"""Store brownout tier (docs/robustness.md "Store brownouts"):
+``FaultyKV`` fault injection (state/faulty.py), the ``StoreHealth`` mode
+machine + ``StoreHealthKV`` feed (service/store_health.py), deadline
+threading (config ``store_op_deadline_s`` → backend budgets), the writer
+-loop outage gates across every loop, the leader-lease-under-outage pin,
+the API surfacing contract (typed 503 + Retry-After, stale envelope +
+``X-Stale-Read``, /healthz + /metrics + events), and mid-flow chaos:
+a mutation the outage interrupts converges after the heal.
+"""
+
+import json
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_docker_api import errors
+from tpu_docker_api.config import Config
+from tpu_docker_api.daemon import Program
+from tpu_docker_api.runtime.fake import FakeRuntime
+from tpu_docker_api.service.host_health import HostMonitor
+from tpu_docker_api.service.store_health import (
+    StoreHealth,
+    StoreHealthKV,
+    consume_stale_read,
+    mark_stale_read,
+)
+from tpu_docker_api.state.faulty import FaultyKV
+from tpu_docker_api.state.kv import MemoryKV, SqliteKV, open_store
+from tpu_docker_api.telemetry.metrics import MetricsRegistry
+
+
+def wait_until(fn, timeout_s=10.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(0.005)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+class TestFaultyKV:
+    def test_fail_nth_is_deterministic_and_typed(self):
+        kv = FaultyKV(MemoryKV())
+        kv.put("/a", "1")
+        kv.fail_nth("get", 2)
+        assert kv.get("/a") == "1"          # call 1: healthy
+        with pytest.raises(errors.StoreUnavailable):
+            kv.get("/a")                     # call 2: scripted failure
+        assert kv.get("/a") == "1"          # call 3: healed
+        outcomes = [o for op, _, o in kv.calls if op == "get"]
+        assert outcomes == ["ok", "fail", "ok"]
+
+    def test_ambiguous_write_lands_then_errors(self):
+        """The classic timeout-after-commit: the caller sees a store error
+        but the write took effect — exactly what an idempotent retry (or
+        the journal replay) must absorb."""
+        kv = FaultyKV(MemoryKV())
+        kv.fail_nth("put", 1, mode="ambiguous")
+        with pytest.raises(errors.StoreUnavailable):
+            kv.put("/amb", "landed")
+        assert kv.inner.get("/amb") == "landed"
+        assert ("put", "/amb", "ambiguous") in kv.calls
+
+    def test_partition_overlaps_both_directions(self):
+        """A scan of a broader prefix must fail too — it would otherwise
+        silently exclude the partitioned subtree from its result."""
+        kv = FaultyKV(MemoryKV())
+        kv.put("/q/a", "1")
+        kv.put("/other", "2")
+        kv.set_partition("/q/")
+        with pytest.raises(errors.StoreUnavailable):
+            kv.put("/q/b", "x")              # under the partition
+        with pytest.raises(errors.StoreUnavailable):
+            kv.range_prefix("/")             # scan OVERLAPS the partition
+        assert kv.get("/other") == "2"       # disjoint keys stay healthy
+        kv.set_partition("/q/", active=False)
+        assert kv.get("/q/a") == "1"
+
+    def test_outage_covers_watch_poll(self):
+        """A dead store cannot stream events: an informer that kept
+        draining a live watch through an "outage" would never degrade."""
+        kv = FaultyKV(MemoryKV())
+        w = kv.watch("/")
+        kv.put("/w/a", "1")
+        assert [e.key for e in w.poll(0.01)] == ["/w/a"]
+        kv.set_outage(True)
+        with pytest.raises(errors.StoreUnavailable):
+            w.poll(0.01)
+        with pytest.raises(errors.StoreUnavailable):
+            kv.get("/w/a")
+        kv.set_outage(False)
+        assert w.poll(0.01) == []            # drained again after heal
+        w.close()
+
+    def test_latency_window_slows_but_succeeds(self):
+        kv = FaultyKV(MemoryKV())
+        kv.put("/slow", "1")
+        kv.set_latency(0.05)
+        t0 = time.perf_counter()
+        assert kv.get("/slow") == "1"
+        assert time.perf_counter() - t0 >= 0.05
+        kv.set_latency(0.0)
+        t0 = time.perf_counter()
+        assert kv.get("/slow") == "1"
+        assert time.perf_counter() - t0 < 0.05
+
+    def test_inner_passthrough(self):
+        """Backend helpers reach around the fault layer (the test-harness
+        seam _records(kv.inner) depends on), and unknown attrs delegate."""
+        kv = FaultyKV(MemoryKV())
+        kv.put("/p", "1")
+        kv.set_outage(True)
+        assert kv.inner.get("/p") == "1"     # the harness reaches around
+        kv.set_outage(False)
+        assert kv.current_rev() >= 1         # KV surface intact
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestStoreHealth:
+    def _health(self, **kw):
+        clock = _Clock()
+        kw.setdefault("fail_threshold", 3)
+        kw.setdefault("outage_grace_s", 2.0)
+        kw.setdefault("probe_interval_s", 1.0)
+        h = StoreHealth(clock=clock, registry=MetricsRegistry(), **kw)
+        return h, clock
+
+    def _fail(self, h, n=1):
+        for _ in range(n):
+            h.observe("get", 1.0, ok=False, error="refused")
+
+    def test_blips_below_threshold_never_flip(self):
+        h, _ = self._health()
+        self._fail(h, 2)
+        assert h.mode == "healthy"
+        h.observe("get", 1.0, ok=True)
+        self._fail(h, 2)                     # streak reset: still healthy
+        assert h.mode == "healthy"
+        assert h.status_view()["consecutiveFailures"] == 2
+
+    def test_degraded_then_outage_after_grace(self):
+        h, clock = self._health()
+        self._fail(h, 3)
+        assert h.mode == "degraded"
+        assert h.allows_writes()             # degraded still writes
+        clock.now += 1.9
+        self._fail(h)
+        assert h.mode == "degraded"          # inside the grace window
+        clock.now += 0.2
+        self._fail(h)
+        assert h.mode == "outage"
+        assert not h.allows_writes()
+        assert h.serve_stale_reads()
+        kinds = [e["event"] for e in h.events_view()]
+        assert kinds == ["store-mode-degraded", "store-mode-outage"]
+
+    def test_success_heals_and_fires_on_recover(self):
+        h, clock = self._health()
+        fired = []
+        h.on_recover(lambda: fired.append(1))
+        self._fail(h, 3)
+        clock.now += 3.0
+        self._fail(h)
+        assert h.mode == "outage"
+        h.observe("get", 1.0, ok=True)
+        assert h.mode == "healthy"
+        assert fired == [1]                  # outage → healthy fires hooks
+        assert h.status_view()["outagesTotal"] == 1
+        # degraded → healthy does NOT fire (nothing was held)
+        self._fail(h, 3)
+        h.observe("get", 1.0, ok=True)
+        assert fired == [1]
+
+    def test_app_errors_count_as_alive(self):
+        """NotExistInStore & co. prove the store answered — three of them
+        must not push the machine toward degraded."""
+        h, _ = self._health()
+        kv = StoreHealthKV(MemoryKV(), h)
+        for _ in range(5):
+            with pytest.raises(errors.NotExistInStore):
+                kv.get("/missing")
+        assert h.mode == "healthy"
+        assert h.status_view()["opsOk"] == 5
+
+    def test_admit_mutation_probe_slot_then_typed_503(self):
+        h, clock = self._health()
+        self._fail(h, 3)
+        clock.now += 3.0
+        self._fail(h)
+        assert h.mode == "outage"
+        h.admit_mutation()                   # first caller IS the probe
+        with pytest.raises(errors.StoreDegraded) as ei:
+            h.admit_mutation()               # single-flight: held
+        assert ei.value.http_status == 503
+        assert ei.value.code == 10506
+        assert 0 < ei.value.retry_after_s <= 1.0
+        assert ei.value.data == {"storeMode": "outage"}
+        clock.now += 1.1                     # probe interval elapsed
+        h.admit_mutation()                   # next probe admitted
+        h.observe("get", 1.0, ok=True)
+        h.admit_mutation()                   # healthy: free passage
+
+    def test_healthy_path_admits_without_probe_accounting(self):
+        h, _ = self._health()
+        for _ in range(10):
+            h.admit_mutation()
+        assert h.mode == "healthy"
+
+
+class TestStaleMarker:
+    def test_consume_pops(self):
+        """Pop semantics: a keep-alive HTTP thread serves many requests
+        and a marker must never leak into the next one."""
+        consume_stale_read()                 # clear any test residue
+        mark_stale_read(42.0)
+        assert consume_stale_read() == 42.0
+        assert consume_stale_read() is None
+
+    def test_note_stale_read_counts_and_marks(self):
+        h = StoreHealth(registry=MetricsRegistry())
+        consume_stale_read()
+        h.note_stale_read(17.0)
+        assert consume_stale_read() == 17.0
+        assert h.status_view()["staleReads"] == 1
+
+
+class TestDeadlineThreading:
+    def test_open_store_threads_deadline_to_sqlite(self, tmp_path):
+        s = open_store("sqlite", sqlite_path=str(tmp_path / "d.db"),
+                       op_deadline_s=0.07)
+        assert s._busy_timeout_s == 0.07
+        s.close()
+
+    def test_default_zero_keeps_legacy_budgets(self, tmp_path):
+        s = open_store("sqlite", sqlite_path=str(tmp_path / "d.db"))
+        assert s._busy_timeout_s == SqliteKV.BUSY_TIMEOUT_S
+        s.close()
+
+    def test_etcd_deadline_overrides_op_timeout(self, monkeypatch):
+        from tpu_docker_api.state.kv import EtcdKV
+        monkeypatch.setattr(EtcdKV, "_post", lambda self, *a, **k: {})
+        e = EtcdKV("http://127.0.0.1:1", op_deadline_s=0.25)
+        assert e._op_timeout_s == 0.25
+        e = EtcdKV("http://127.0.0.1:1")     # default: legacy 1 s budget
+        assert e._op_timeout_s == EtcdKV.OP_TIMEOUT_S
+
+
+class TestHostMonitorGate:
+    """A store outage defers the DOWN verdict (a store-mutating cascade)
+    but never stops the grace clock: heal ⇒ immediate confirmation."""
+
+    def _monitor(self, gate):
+        clock = _Clock()
+
+        class _Sched:
+            def __init__(self):
+                self.down = {}
+
+            def set_host_down(self, hid, flag):
+                self.down[hid] = flag
+
+        runtime = types.SimpleNamespace(
+            container_list=lambda: (_ for _ in ()).throw(
+                OSError("connection refused")))
+        pod = types.SimpleNamespace(
+            hosts={"h1": types.SimpleNamespace(runtime=runtime)})
+        sched = _Sched()
+        mon = HostMonitor(pod, sched, down_grace_s=5.0, clock=clock,
+                          registry=MetricsRegistry(), store_gate=gate)
+        return mon, sched, clock
+
+    def test_down_verdict_held_then_confirmed_after_heal(self):
+        store_up = {"up": True}
+        mon, sched, clock = self._monitor(lambda: store_up["up"])
+        mon.probe_once()                     # healthy → suspect
+        assert mon.host_state("h1") == "suspect"
+        clock.now += 6.0                     # grace elapsed
+        store_up["up"] = False               # store outage begins
+        mon.probe_once()
+        assert mon.host_state("h1") == "suspect"    # verdict DEFERRED
+        assert mon.store_skips == 1
+        assert sched.down == {}
+        kinds = [e["event"] for e in mon.events_view()]
+        assert "store-outage-hold" in kinds
+        store_up["up"] = True                # store heals
+        mon.probe_once()                     # still failing ⇒ down NOW
+        assert mon.host_state("h1") == "down"
+        assert sched.down == {"h1": True}
+        kinds = [e["event"] for e in mon.events_view()]
+        assert "store-outage-over" in kinds
+
+    def test_ungated_monitor_unchanged(self):
+        mon, sched, clock = self._monitor(None)
+        mon.probe_once()
+        clock.now += 6.0
+        mon.probe_once()
+        assert mon.host_state("h1") == "down"
+        assert mon.store_skips == 0
+
+
+def _boot(**overrides) -> tuple[Program, FaultyKV]:
+    kv = FaultyKV(MemoryKV())
+    cfg = dict(port=0, store_backend="memory", runtime_backend="fake",
+               start_port=46000, end_port=46999, health_watch_interval=0,
+               reconcile_interval=0, leader_election=True,
+               leader_ttl_s=30.0, leader_id="brownout-test",
+               store_health_fail_threshold=3,
+               store_health_outage_grace_s=0.15,
+               store_health_probe_interval_s=0.1)
+    cfg.update(overrides)
+    prg = Program(Config(**cfg), host="127.0.0.1", kv=kv,
+                  runtime=FakeRuntime())
+    prg.init()
+    prg.start()
+    wait_until(lambda: prg.leader_elector.is_leader, what="lease acquire")
+    wait_until(lambda: prg.leader_elector.accepts_mutations,
+               what="writer boot")
+    return prg, kv
+
+
+def _shutdown(prg: Program) -> None:
+    try:
+        prg.leader_elector.close(release=True)
+        prg.api_server.close()
+        prg._stop_writers()
+    except Exception:
+        pass
+
+
+def _force_outage(prg: Program, kv: FaultyKV) -> None:
+    kv.set_outage(True)
+    wait_until(lambda: prg.store_health.mode == "outage",
+               what="outage mode")
+
+
+def _call(prg, method, path, body=None, timeout=5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{prg.api_server.port}{path}", method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+class TestWriterLoopGates:
+    """Every writer loop holds during a store outage: observes, skips,
+    emits the edge event — and resumes after the heal."""
+
+    @pytest.fixture(scope="class")
+    def booted(self):
+        prg, kv = _boot(history_retention_versions=2)
+        yield prg, kv
+        kv.set_outage(False)
+        _shutdown(prg)
+
+    def test_all_loops_hold_and_resume(self, booted):
+        prg, kv = booted
+        _force_outage(prg, kv)
+        try:
+            # supervisor: poll_once returns without store traffic
+            prg.job_supervisor.poll_once()
+            prg.job_supervisor.poll_once()
+            assert prg.job_supervisor.store_skips >= 2
+            kinds = [e["event"]
+                     for e in prg.job_supervisor.events_view(limit=200)]
+            assert kinds.count("store-outage-hold") == 1  # edge, not level
+            # reconciler: mutating pass reports itself skipped...
+            out = prg.reconciler.reconcile()
+            assert out["mode"] == "skipped"
+            assert out["skipped"] == "store-outage"
+            assert out["actions"] == []
+            # ...but a dry run still sweeps (observation is free)
+            dry = prg.reconciler.reconcile(dry_run=True)
+            assert dry.get("skipped") != "store-outage"
+            # admission, autoscaler, workflow engine, compactor
+            assert prg.admission.admit_once() == []
+            assert prg.admission.store_skips >= 1
+            prg.serving.tick()
+            assert prg.serving.store_skips >= 1
+            prg.workflow.tick()
+            assert prg.workflow.store_skips >= 1
+            out = prg.compactor.compact_once()
+            assert out["skipped"] == "store-outage"
+            assert out["trimmed"] == {}
+        finally:
+            kv.set_outage(False)
+        wait_until(lambda: prg.store_health.mode == "healthy",
+                   what="heal")
+        # resumed: the loops run for real again and emit the over-edge
+        prg.job_supervisor.poll_once()
+        kinds = [e["event"]
+                 for e in prg.job_supervisor.events_view(limit=200)]
+        assert "store-outage-over" in kinds
+        out = prg.reconciler.reconcile()
+        assert out.get("skipped") != "store-outage"
+
+    def test_workqueue_holds_execution_until_heal(self, booted):
+        prg, kv = booted
+        ran = []
+        prg.wq.register("brownout-probe", lambda rec: ran.append(1))
+        _force_outage(prg, kv)
+        try:
+            skips0 = prg.wq.store_skips
+            # enqueued mid-outage: journal write degrades loudly, and the
+            # sync loop HOLDS before executing (close overrides the hold)
+            prg.wq.submit_record("brownout-probe", {})
+            deadline = time.monotonic() + 0.5
+            while time.monotonic() < deadline and not ran:
+                time.sleep(0.01)
+            assert ran == []                 # held, not executed
+            assert prg.wq.store_skips >= skips0 + 1
+        finally:
+            kv.set_outage(False)
+        wait_until(lambda: prg.store_health.mode == "healthy", what="heal")
+        wait_until(lambda: ran == [1], what="held record executes on heal")
+        events = [e["event"] for e in prg.wq.stats()["events"]]
+        assert "store-outage-hold" in events
+        assert "store-outage-over" in events
+
+
+class TestLeaderLeaseUnderOutage:
+    def test_renew_holds_until_own_deadline_then_demotes(self):
+        """The elector's outage contract, pinned: renew failures before
+        the lease's own deadline keep leadership (the lease is still
+        legally ours — no standby may steal it yet); past the deadline a
+        standby MAY have stolen it, so the leader demotes itself."""
+        prg, kv = _boot(leader_ttl_s=1.0)
+        try:
+            _force_outage(prg, kv)
+            assert prg.leader_elector.is_leader   # deadline not reached
+            prg.leader_elector.step()             # renew fails typed
+            assert prg.leader_elector.is_leader
+            deadline = json.loads(
+                prg.leader_elector._lease_raw)["deadline"]
+            wait_until(lambda: time.time() > deadline + 0.05,
+                       timeout_s=5.0, what="lease deadline")
+            prg.leader_elector.step()             # past OWN deadline
+            assert not prg.leader_elector.is_leader
+            assert not prg.leader_elector.accepts_mutations
+        finally:
+            kv.set_outage(False)
+            _shutdown(prg)
+
+
+class TestApiSurfacing:
+    @pytest.fixture(scope="class")
+    def booted(self):
+        prg, kv = _boot()
+        _, _, out = _call(prg, "POST", "/api/v1/containers",
+                          {"imageName": "jax", "containerName": "canary",
+                           "chipCount": 1})
+        assert out["code"] == 200
+        yield prg, kv
+        kv.set_outage(False)
+        _shutdown(prg)
+
+    def test_healthy_surface_has_no_stale_and_reports_mode(self, booted):
+        prg, kv = booted
+        st, hdr, out = _call(prg, "GET", "/api/v1/containers/canary")
+        assert out["code"] == 200
+        assert "stale" not in out            # legacy envelope byte-for-byte
+        assert "X-Stale-Read" not in hdr
+        _, _, hz = _call(prg, "GET", "/healthz")
+        assert hz["data"]["storeHealth"]["mode"] == "healthy"
+        _, _, ld = _call(prg, "GET", "/api/v1/leader")
+        assert ld["data"]["storeHealth"]["mode"] == "healthy"
+
+    def test_outage_contract_stale_reads_typed_mutations(self, booted):
+        prg, kv = booted
+        _force_outage(prg, kv)
+        try:
+            # reads ride the mirror, explicitly marked
+            st, hdr, out = _call(prg, "GET", "/api/v1/containers/canary")
+            assert out["code"] == 200
+            assert out["stale"]["lagMs"] >= 0
+            assert float(hdr["X-Stale-Read"]) == out["stale"]["lagMs"]
+            # first mutation is the admitted probe: typed StoreUnavailable
+            st, hdr, out = _call(prg, "POST", "/api/v1/containers",
+                                 {"imageName": "jax",
+                                  "containerName": "denied",
+                                  "chipCount": 1})
+            assert out["code"] == 10502
+            # immediately after: fail-fast 503 with Retry-After, and ZERO
+            # store round trips for the refusal
+            n0 = len(kv.calls)
+            st, hdr, out = _call(prg, "POST", "/api/v1/containers",
+                                 {"imageName": "jax",
+                                  "containerName": "denied2",
+                                  "chipCount": 1})
+            assert st == 503
+            assert out["code"] == 10506
+            assert out["data"] == {"storeMode": "outage"}
+            assert int(hdr["Retry-After"]) >= 1
+            assert len(kv.calls) == n0
+            # mode + episode surfaced on /healthz and the events ring
+            _, _, hz = _call(prg, "GET", "/healthz")
+            sh = hz["data"]["storeHealth"]
+            assert sh["mode"] == "outage"
+            assert sh["outagesTotal"] >= 1
+            _, _, ev = _call(prg, "GET", "/api/v1/events?limit=200")
+            kinds = [e.get("event") for e in ev["data"]]
+            assert "store-mode-degraded" in kinds
+            assert "store-mode-outage" in kinds
+        finally:
+            kv.set_outage(False)
+        wait_until(lambda: prg.store_health.mode == "healthy", what="heal")
+        st, hdr, out = _call(prg, "GET", "/api/v1/containers/canary")
+        assert out["code"] == 200 and "stale" not in out
+
+    def test_metrics_export_store_series(self, booted):
+        prg, kv = booted
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{prg.api_server.port}/metrics")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            body = r.read().decode()
+        assert 'store_ops_total{outcome="ok"}' in body
+        assert "store_mode" in body
+        assert "store_op_ms_bucket" in body
+
+
+class TestChaosMidflow:
+    """The matrix point the bench churns statistically, pinned
+    deterministically: a store outage interrupting a mutation mid-flow
+    leaves typed errors and a world that converges after the heal."""
+
+    def test_container_replace_midflow_converges(self):
+        # ttl 600 keeps the elector's renew off the apply stream so the
+        # scripted fail-nth window hits the replace flow, not the lease
+        prg, kv = _boot(leader_ttl_s=600.0)
+        try:
+            _, _, out = _call(prg, "POST", "/api/v1/containers",
+                              {"imageName": "jax", "containerName": "vic",
+                               "chipCount": 2})
+            assert out["code"] == 200
+            # the NEXT batched store writes die: the rolling replace is
+            # interrupted partway — version pointer advanced, record write
+            # refused — and the caller sees a typed error, not a hang
+            kv.fail_nth("apply", kv.op_count("apply") + 1, times=2)
+            st, _, out = _call(prg, "PATCH", "/api/v1/containers/vic/tpu",
+                               {"chipCount": 1})
+            assert out["code"] == errors.StoreUnavailable.code
+            # reads still serve the last consistent version
+            _, _, info = _call(prg, "GET", "/api/v1/containers/vic")
+            assert info["code"] == 200
+            # heal: burn the remaining scripted failures on a scratch key
+            for i in range(10):
+                try:
+                    kv.apply([("put", "/chaos/drain", str(i))])
+                    break
+                except errors.StoreUnavailable:
+                    continue
+            # the anti-entropy pass repairs the dangling version pointer...
+            rec = prg.reconciler.reconcile()
+            repairs = [a["action"] for a in rec["actions"]]
+            assert "rollback-version-pointer" in repairs
+            # ...after which the same intent lands cleanly
+            st, _, out = _call(prg, "PATCH", "/api/v1/containers/vic/tpu",
+                               {"chipCount": 1})
+            assert out["code"] == 200
+            _, _, info = _call(prg, "GET", "/api/v1/containers/vic")
+            assert info["code"] == 200
+            assert len(info["data"]["state"]["spec"]["chip_ids"]) == 1
+        finally:
+            kv.set_outage(False)
+            _shutdown(prg)
+
+    def test_gang_mutation_during_outage_refused_then_lands(self):
+        prg, kv = _boot()
+        try:
+            _, _, out = _call(prg, "POST", "/api/v1/jobs",
+                              {"imageName": "jax", "jobName": "gang",
+                               "chipCount": 1})
+            assert out["code"] == 200
+            _force_outage(prg, kv)
+            # burn the probe slot, then the delete is refused typed+fast
+            _call(prg, "POST", "/api/v1/containers",
+                  {"imageName": "jax", "containerName": "burn",
+                   "chipCount": 1})
+            st, _, out = _call(prg, "DELETE", "/api/v1/jobs/gang",
+                               {"force": True,
+                                "delStateAndVersionRecord": True})
+            assert out["code"] in (10502, 10506)
+            _, _, info = _call(prg, "GET", "/api/v1/jobs/gang")
+            assert info["code"] == 200       # stale-served, still there
+            kv.set_outage(False)
+            wait_until(lambda: prg.store_health.mode == "healthy",
+                       what="heal")
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st, _, out = _call(prg, "DELETE", "/api/v1/jobs/gang",
+                                   {"force": True,
+                                    "delStateAndVersionRecord": True})
+                if out["code"] == 200:
+                    break
+                time.sleep(0.05)
+            assert out["code"] == 200
+        finally:
+            kv.set_outage(False)
+            _shutdown(prg)
+
+    def test_recovery_hook_marks_world_dirty(self):
+        # reconcile_full_interval_s > 0 wires the event-driven dirty feed —
+        # the configuration where a swallowed watch event COULD cause a
+        # missed repair, and exactly what the recovery hook guards
+        prg, kv = _boot(reconcile_full_interval_s=60.0)
+        try:
+            _force_outage(prg, kv)
+            kv.set_outage(False)
+            wait_until(lambda: prg.store_health.mode == "healthy",
+                       what="heal")
+            # loss-free recovery: the heal demands a FULL next pass (the
+            # hook marks store-recovered; the informer's own relist may
+            # re-mark it — either reason proves nothing can be missed)
+            wait_until(lambda: prg.reconciler.dirty_view()["fullPending"],
+                       timeout_s=5.0, what="dirty-all after heal")
+            assert prg.reconciler.dirty_view()["fullReason"] in (
+                "store-recovered", "relist")
+        finally:
+            kv.set_outage(False)
+            _shutdown(prg)

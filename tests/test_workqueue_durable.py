@@ -11,7 +11,8 @@ import pytest
 
 from tpu_docker_api import errors
 from tpu_docker_api.state import keys
-from tpu_docker_api.state.kv import KV, MemoryKV
+from tpu_docker_api.state.faulty import FaultyKV
+from tpu_docker_api.state.kv import MemoryKV
 from tpu_docker_api.state.workqueue import (
     FnTask,
     TaskRecord,
@@ -338,46 +339,24 @@ class TestBoundedSubmitAndClose:
         assert len(_records(kv)) == 1
 
 
-class _OutageKV(KV):
-    """Wrapper that fails every op touching the queue journal while
-    ``broken`` is set — the store-outage the queue must degrade through."""
-
-    def __init__(self, inner):
-        self.inner = inner
-        self.broken = False
-
-    def _gate(self, key: str):
-        if self.broken and key.startswith(keys.QUEUE_PREFIX):
-            raise errors.StoreUnavailable("injected outage")
-
-    def put(self, key, value):
-        self._gate(key)
-        self.inner.put(key, value)
-
-    def get(self, key):
-        self._gate(key)
-        return self.inner.get(key)
-
-    def delete(self, key):
-        self._gate(key)
-        self.inner.delete(key)
-
-    def range_prefix(self, prefix):
-        self._gate(prefix)
-        return self.inner.range_prefix(prefix)
+def _journal_outage(kv: FaultyKV, down: bool = True) -> None:
+    """Partition the queue journal's keyspace — the store-outage the
+    queue must degrade through, scoped so everything else stays healthy
+    (state/faulty.py replaces the old ad-hoc ``_OutageKV`` wrapper)."""
+    kv.set_partition(keys.QUEUE_PREFIX, active=down)
 
 
 class TestStoreOutageDegradation:
     def test_submit_degrades_loudly_and_still_executes(self):
-        kv = _OutageKV(MemoryKV())
+        kv = FaultyKV(MemoryKV())
         ran = []
         wq = WorkQueue(kv)
         wq.register("probe", lambda rec: ran.append(rec.params["i"]))
         wq.start()
-        kv.broken = True
+        _journal_outage(kv)
         wq.submit_record("probe", {"i": 1})  # journal write fails — LOUDLY
         wq.drain()
-        kv.broken = False
+        _journal_outage(kv, down=False)
         wq.submit_record("probe", {"i": 2})  # back to durable
         wq.drain()
         wq.close()
@@ -389,14 +368,14 @@ class TestStoreOutageDegradation:
         assert _records(kv.inner) == []  # the durable one was acked
 
     def test_degraded_submit_dead_letter_stays_observable(self):
-        kv = _OutageKV(MemoryKV())
+        kv = FaultyKV(MemoryKV())
         wq = WorkQueue(kv, max_retries=1, backoff_base_s=0.001)
         wq.register("boom", lambda rec: (_ for _ in ()).throw(OSError("x")))
         wq.start()
-        kv.broken = True  # journal write fails: the record is in-memory only
+        _journal_outage(kv)  # journal write fails: the record is in-memory only
         wq.submit_record("boom", {"who": "t"})
         wq.drain()
-        kv.broken = False
+        _journal_outage(kv, down=False)
         # exhausted: with no journal entry to hold state="dead", the record
         # must land with the ephemeral letters, never vanish silently
         letters = wq.dead_letter_view()
@@ -432,23 +411,23 @@ class TestStoreOutageDegradation:
         wq.close()
 
     def test_stats_survive_journal_outage(self):
-        kv = _OutageKV(MemoryKV())
+        kv = FaultyKV(MemoryKV())
         wq = WorkQueue(kv)
-        kv.broken = True
+        _journal_outage(kv)
         out = wq.stats()
         assert "error" in out["journal"]
 
     def test_ack_outage_leaves_entry_for_replay(self):
-        kv = _OutageKV(MemoryKV())
+        kv = FaultyKV(MemoryKV())
         wq = WorkQueue(kv)
         ran = []
         wq.register("probe", lambda rec: ran.append(1))
         wq.start()
         tid = wq.submit_record("probe", {})
-        kv.broken = True  # the ack delete will fail
+        _journal_outage(kv)  # the ack delete will fail
         wq.drain()
         wq.close()
-        kv.broken = False
+        _journal_outage(kv, down=False)
         assert ran == [1]
         recs = _records(kv.inner)
         # the claim write failed too, so the entry survives as pending

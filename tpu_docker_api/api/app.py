@@ -60,6 +60,7 @@ import collections
 import heapq
 import json
 import logging
+import math
 import re
 import threading
 import time
@@ -199,6 +200,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                  admission=None, serving=None, workflow_svc=None,
                  compactor=None,
                  gateway=None,
+                 store_health=None,
                  list_default_limit: int = 0,
                  list_max_limit: int = 5000,
                  tracer=None) -> Router:
@@ -241,6 +243,12 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
     # holds that shard's lease (api-layer routing is a redirect, never a
     # proxy: the client retries against the advertised holder)
     r.shard_plane = shard_plane
+    # store brownout gate (service/store_health.py): while the store is in
+    # outage mode every mutation is refused up front — typed 503 +
+    # Retry-After, zero store round trips — except the single-flight heal
+    # probe; reads served from the informer mirror are marked stale
+    # (envelope field + X-Stale-Read header). None gates nothing.
+    r.store_health = store_health
 
     # -- containers (reference api/container.go:19-38) ---------------------------
 
@@ -631,11 +639,24 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             # table's per-endpoint view (one set of books — identical to
             # the gateway listener's own /healthz)
             out["gateway"] = gateway.status_view()
+        if store_health is not None:
+            # the store failure domain rides liveness too: mode (healthy/
+            # degraded/outage), failure streak and the op/outage counters
+            # read back from the registry — load balancers can stop
+            # routing mutations at a replica whose store is browned out
+            out["storeHealth"] = store_health.status_view()
         return out
 
     r.add("GET", "/healthz", healthz)
 
     def leader_view(body, **_):
+        def _with_store(out):
+            # lease health and store health are one story: a leader whose
+            # renewals are failing IS a store brownout in progress
+            if store_health is not None:
+                out["storeHealth"] = store_health.status_view()
+            return out
+
         if shard_plane is not None:
             # shard-aware: the single-lease fields generalize to the full
             # per-shard table (satellite of docs/robustness.md "Sharded
@@ -646,16 +667,17 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             out["sharded"] = True
             if informer is not None:
                 out["informer"] = informer.status_view()
-            return out
+            return _with_store(out)
         if leader_elector is None:
-            return {"election": False, "role": "single", "accepting": True,
-                    "selfId": None, "holderId": None, "epoch": None,
-                    "deadline": None, "advertise": "", "ttlS": None,
-                    "fencingEpoch": 0}
+            return _with_store(
+                {"election": False, "role": "single", "accepting": True,
+                 "selfId": None, "holderId": None, "epoch": None,
+                 "deadline": None, "advertise": "", "ttlS": None,
+                 "fencingEpoch": 0})
         out = leader_elector.status_view()
         if informer is not None:
             out["informer"] = informer.status_view()
-        return out
+        return _with_store(out)
 
     r.add("GET", "/api/v1/leader", leader_view)
 
@@ -690,7 +712,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
             or shard_plane is not None
             or informer is not None or admission is not None
             or serving is not None or tracer is not None
-            or gateway is not None):
+            or gateway is not None or store_health is not None):
         # one events ring for the operator: container liveness transitions
         # (health watcher) merged with gang lifecycle events (job
         # supervisor), host health transitions (host monitor), leadership
@@ -722,7 +744,7 @@ def build_router(container_svc: ContainerService, volume_svc: VolumeService,
                      for src in (health_watcher, job_supervisor,
                                  host_monitor, leader_elector, shard_plane,
                                  informer, admission, serving, workflow_svc,
-                                 tracer, gateway)
+                                 tracer, gateway, store_health)
                      if src is not None]
             merged = heapq.merge(*rings, key=lambda e: e.get("ts", 0))
             if trace_id:
@@ -870,7 +892,14 @@ def build_handler(router: Router):
             log.debug("http: " + fmt, *args)
 
         def _handle(self, method: str) -> None:
+            from tpu_docker_api.service.store_health import consume_stale_read
             from tpu_docker_api.telemetry import trace
+
+            # drop any stale-read marker a previous request on this
+            # keep-alive thread left behind (e.g. its handler errored
+            # after a mirror read) — staleness must never bleed across
+            # requests
+            consume_stale_read()
 
             # request identity (SURVEY.md §5.1 — absent in the reference):
             # a W3C traceparent names the remote trace context exactly;
@@ -901,6 +930,8 @@ def build_handler(router: Router):
             t0 = time.perf_counter()
             app_code = codes.SUCCESS
             http_status = 200
+            stale_lag_ms = None
+            retry_after_s = None
             # root span per request: the trace id continues the remote
             # context (traceparent wins, then X-Request-Id); the span
             # brackets everything from body read to envelope build, and
@@ -953,6 +984,16 @@ def build_handler(router: Router):
                         if not plane.accepting(shard):
                             raise errors.NotLeader(
                                 plane.standby_message(shard))
+                    # store brownout gate: in outage mode a mutation that
+                    # cannot journal its intent must never half-apply —
+                    # fail fast with the typed 503 + Retry-After (zero
+                    # store round trips), except the single-flight heal
+                    # probe admit_mutation lets through. Reads pass: they
+                    # serve the informer mirror (marked stale below) or
+                    # pay the deadline-bounded store attempt
+                    store_health = getattr(router, "store_health", None)
+                    if method != "GET" and store_health is not None:
+                        store_health.admit_mutation()
                     body = json.loads(raw) if raw else {}
                     if not isinstance(body, dict):
                         raise errors.BadRequest("body must be a JSON object")
@@ -962,13 +1003,23 @@ def build_handler(router: Router):
                         body.setdefault(k, vs[-1])
                     with trace.child(f"dispatch:{route}"):
                         data = handler(body=body, **params)
-                    payload = response.success(data)
+                    # a read the handler served from the informer mirror
+                    # during a store outage marked this thread — surface
+                    # the staleness explicitly (envelope + header below)
+                    stale_lag_ms = consume_stale_read()
+                    payload = response.success(
+                        data, stale=(None if stale_lag_ms is None
+                                     else {"lagMs": stale_lag_ms}))
                 except errors.ApiError as e:
                     app_code = e.code
                     # the one deviation from always-200: backpressure errors
                     # (QueueSaturated) carry a real 429 so clients and
                     # proxies treat them as retryable, never as success
                     http_status = e.http_status or 200
+                    # typed backoff hint (StoreDegraded): surfaced as the
+                    # Retry-After header so retry-aware clients hold off
+                    # instead of burning their budget against a brownout
+                    retry_after_s = getattr(e, "retry_after_s", None)
                     payload = response.error(e.code, str(e), data=e.data,
                                              request_id=req_id)
                 except json.JSONDecodeError as e:
@@ -1011,6 +1062,15 @@ def build_handler(router: Router):
             self.send_response(http_status)
             self.send_header("Content-Type", "application/json")
             self.send_header("X-Request-Id", req_id)
+            if retry_after_s is not None:
+                # integer seconds, never 0 — Retry-After: 0 reads as
+                # "retry immediately", the opposite of the hint
+                self.send_header("Retry-After",
+                                 str(max(1, math.ceil(retry_after_s))))
+            if stale_lag_ms is not None:
+                # the header twin of the envelope's stale field, for
+                # clients and proxies that only look at headers
+                self.send_header("X-Stale-Read", str(stale_lag_ms))
             if root_span is not None:
                 # the W3C echo: tell the caller which span served them
                 # (only emittable when the trace id is wire-legal 32-hex —

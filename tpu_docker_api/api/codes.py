@@ -26,6 +26,7 @@ MESSAGES: dict[int, str] = {
     10501: "not found in state store",
     10502: "state store unavailable",
     10503: "guarded write lost its compare",
+    10506: "state store degraded; mutations held until it heals",
     10601: "not enough free TPU chips",
     10602: "not enough free host ports",
     10603: "unknown TPU topology",

@@ -147,6 +147,21 @@ class Config:
     store_retry_attempts: int = 3
     store_retry_base_s: float = 0.05
     store_retry_max_s: float = 1.0
+    # per-op store deadline (state/kv.py): bounds EVERY store round trip —
+    # the EtcdKV socket timeout, the SqliteKV busy wait — so a hung store
+    # surfaces as a typed StoreUnavailable instead of a wedged thread.
+    # 0 (default) keeps each backend's historical timeout byte-for-byte
+    store_op_deadline_s: float = 0.0
+    # store brownout machine (service/store_health.py, docs/robustness.md
+    # "Store brownouts"): consecutive StoreUnavailable failures before
+    # healthy → degraded (blips below the threshold cause zero mode flips) …
+    store_health_fail_threshold: int = 3
+    # … continuous failure past the threshold for this long ⇒ outage
+    # (mutations fail fast 503, reads serve stale, writer loops hold) …
+    store_health_outage_grace_s: float = 2.0
+    # … and while in outage, one probe mutation per interval is admitted
+    # through so a healed store is re-detected even without elector traffic
+    store_health_probe_interval_s: float = 1.0
     # HA control plane (service/leader.py): when true, this daemon is one
     # replica of a fleet sharing the state store — API serving is always-on,
     # but the writer subsystems (work-queue sync loop, reconciler, job
@@ -391,6 +406,21 @@ def load(path: str | None = None) -> Config:
         raise ValueError(
             f"list_default_limit must be in [0, list_max_limit], "
             f"got {cfg.list_default_limit} (max {cfg.list_max_limit})")
+    if cfg.store_op_deadline_s < 0:
+        raise ValueError(f"store_op_deadline_s must be >= 0 (0 = backend "
+                         f"default), got {cfg.store_op_deadline_s}")
+    if isinstance(cfg.store_health_fail_threshold, bool) \
+            or not isinstance(cfg.store_health_fail_threshold, int) \
+            or cfg.store_health_fail_threshold < 1:
+        raise ValueError(
+            f"store_health_fail_threshold must be an integer >= 1, "
+            f"got {cfg.store_health_fail_threshold!r}")
+    if cfg.store_health_outage_grace_s < 0:
+        raise ValueError(f"store_health_outage_grace_s must be >= 0, "
+                         f"got {cfg.store_health_outage_grace_s}")
+    if cfg.store_health_probe_interval_s <= 0:
+        raise ValueError(f"store_health_probe_interval_s must be > 0, "
+                         f"got {cfg.store_health_probe_interval_s}")
     if cfg.trace_buffer_size < 1:
         raise ValueError(f"trace_buffer_size must be >= 1, "
                          f"got {cfg.trace_buffer_size}")

@@ -82,7 +82,30 @@ class Program:
             retry_attempts=cfg.store_retry_attempts,
             retry_base_s=cfg.store_retry_base_s,
             retry_max_s=cfg.store_retry_max_s,
+            # per-op deadline: a hung store surfaces as a typed
+            # StoreUnavailable in bounded time instead of a wedged thread
+            # (0 = each backend's historical timeout, byte-for-byte)
+            op_deadline_s=cfg.store_op_deadline_s,
         )
+        # store failure domain (service/store_health.py, docs/robustness.md
+        # "Store brownouts"): every op through this daemon is measured and
+        # classified — purely observational on the healthy path (zero extra
+        # round trips) — feeding the healthy→degraded→outage machine that
+        # gates mutations, writer loops and the stale-read contract below.
+        # Installed UNDER every other wrapper (fencing, sharding, informer),
+        # so leader renewals and informer relists double as outage probes.
+        from tpu_docker_api.service.store_health import (StoreHealth,
+                                                         StoreHealthKV)
+
+        self.store_health = StoreHealth(
+            fail_threshold=cfg.store_health_fail_threshold,
+            outage_grace_s=cfg.store_health_outage_grace_s,
+            probe_interval_s=cfg.store_health_probe_interval_s,
+            registry=self.metrics,
+        )
+        raw_kv = StoreHealthKV(raw_kv, self.store_health)
+        #: the writer-loop hold: observe, don't act, while the store is out
+        store_gate = self.store_health.allows_writes
         self._raw_kv = raw_kv
         self.leader_elector = None
         self.shard_plane = None
@@ -158,8 +181,12 @@ class Program:
 
             self.informer = Informer(raw_kv, keys.PREFIX + "/",
                                      registry=self.metrics)
+            # store_health hookup: during a store OUTAGE reads ride the
+            # (possibly stale) mirror with explicit staleness, instead of
+            # burning a deadline-bounded store failure per GET
             read_kv = InformerReadKV(self.kv, self.informer,
-                                     active=self._standby_reads_active)
+                                     active=self._standby_reads_active,
+                                     store_health=self.store_health)
         self.read_kv = read_kv
         self.store = StateStore(read_kv)
         # runtime fan-out: ONE bounded pool for the whole process (job
@@ -198,6 +225,7 @@ class Program:
             dead_letter_retry_budget=cfg.queue_dead_letter_retry_budget,
             metrics=self.metrics,
             tracer=self.tracer,
+            store_gate=store_gate,
             **wq_shard_kwargs,
         )
         topology = self._discover_topology()
@@ -292,6 +320,7 @@ class Program:
             interval_s=cfg.admission_interval_s,
             registry=self.metrics,
             tracer=self.tracer,
+            store_gate=store_gate,
             **adm_shard_kwargs,
         )
         self.job_svc.admission = self.admission
@@ -314,6 +343,7 @@ class Program:
             registry=self.metrics,
             tracer=self.tracer,
             owns=self._owns_or_none(),
+            store_gate=store_gate,
         )
         # Workflow resource (service/workflow.py): durable DAG orchestration
         # over job steps — every step transition a journaled task record
@@ -336,6 +366,7 @@ class Program:
             registry=self.metrics,
             tracer=self.tracer,
             owns=self._owns_or_none(),
+            store_gate=store_gate,
         )
         # engine-pool saturation gauges: one labeled sample per DISTINCT
         # engine behind this pod (the local runtime is shared by several
@@ -374,6 +405,7 @@ class Program:
                 # a confirmed-down host must wake it immediately, not
                 # wait out the poll interval
                 on_down=lambda hid: self.job_supervisor.wake(hid),
+                store_gate=store_gate,
             )
         # gang supervision (whole-gang restart with backoff, crash-loop →
         # terminal failed; host-down → migration): built in init so the
@@ -391,6 +423,7 @@ class Program:
             host_monitor=self.host_monitor,
             fanout=self.fanout,
             owns=self._owns_or_none(),
+            store_gate=store_gate,
         )
         # job families allocate from the same local chip/port pools, so
         # their claims must be off-limits to the reconciler's leak sweep
@@ -424,7 +457,13 @@ class Program:
             owns=self._owns_or_none(),
             owned_shards=(None if self.shard_plane is None
                           else (lambda: self.shard_plane.held)),
+            store_gate=store_gate,
         )
+        # loss-free recovery: the instant the store heals, treat EVERYTHING
+        # as changed (an outage swallows an unknown set of events) and wake
+        # the supervisor — the next reconcile pass relists, replays the
+        # journal and repairs whatever drifted while the writers held
+        self.store_health.on_recover(self._on_store_recover)
         # event-driven reconcile (ROADMAP item 4): feed the reconciler's
         # dirty-set from the store's watch stream so periodic passes are
         # O(changes). Reuses the read-path informer when one exists;
@@ -521,6 +560,7 @@ class Program:
                        keys.Resource.JOBS: self.job_svc.family_lock},
                 tracer=self.tracer,
                 owns=self._owns_or_none(),
+                store_gate=store_gate,
             )
         # constructed here (not in start) so the router always has the
         # instance regardless of role: on an HA standby the watcher exists
@@ -580,6 +620,15 @@ class Program:
         for host in self.pod.hosts.values():
             host.chips.reload_from_store()
             host.ports.reload_from_store()
+
+    def _on_store_recover(self) -> None:
+        """StoreHealth outage→healthy hook (fires on the thread whose op
+        proved the heal — must stay cheap and non-blocking): mark every
+        family dirty and cut the writer loops' intervals short. The actual
+        repair work — informer relist, journal replay, drift sweep — rides
+        the loops' own threads."""
+        self.reconciler.mark_all_dirty("store-recovered")
+        self.job_supervisor.wake()
 
     def _owns_or_none(self):
         """Family-ownership filter handed to the writer loops: None in
@@ -942,6 +991,7 @@ class Program:
             workflow_svc=self.workflow,
             compactor=self.compactor,
             gateway=self.gateway,
+            store_health=self.store_health,
             list_default_limit=self.cfg.list_default_limit,
             list_max_limit=self.cfg.list_max_limit,
             tracer=self.tracer,

@@ -167,6 +167,27 @@ class ContinueExpired(ApiError):
     http_status = 410
 
 
+class StoreDegraded(ApiError):
+    """The control plane is riding through a store outage (StoreHealth mode
+    ``outage``, service/store_health.py): mutations are refused up front —
+    typed, bounded, and with zero store round trips — because an intent
+    that cannot be journaled must never half-apply. HTTP 503 with a
+    ``Retry-After`` hint (``retry_after_s``, surfaced as the response
+    header) so retry-aware clients back off until the store heals instead
+    of burning their budget against a brownout. Reads are NOT gated: they
+    serve from the informer mirror with explicit staleness, or pay the
+    deadline-bounded store attempt."""
+    code = 10506
+    http_status = 503
+
+    def __init__(self, msg: str = "", retry_after_s: float = 1.0,
+                 data=None) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        if data is not None:
+            self.data = data
+
+
 # --- schedulers (xerrors/scheduler.go:8-10) -----------------------------------
 
 class ChipNotEnough(ApiError):

@@ -154,7 +154,8 @@ class AdmissionController:
                  max_events: int = 256,
                  tracer=None,
                  shard_fn=None,
-                 owned_shards=None) -> None:
+                 owned_shards=None,
+                 store_gate=None) -> None:
         self._svc = job_svc
         #: trace sink for self-rooted per-pass spans (idle passes trimmed)
         self._tracer = tracer
@@ -192,6 +193,13 @@ class AdmissionController:
         #: snapshot, loop forever. Any real change (a placement, a
         #: release, a delete) produces a new snapshot and re-arms.
         self._preempt_futile: dict[str, frozenset] = {}
+        #: store-outage hold (service/store_health.py): an admission or
+        #: preemption decided while its journal write cannot land would
+        #: place/evict gangs with no durable record — the exactly-once
+        #: ledger breaks. None ⇒ ungated (pre-brownout behavior).
+        self._store_gate = store_gate
+        self.store_skips = 0
+        self._store_held = False
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -476,6 +484,17 @@ class AdmissionController:
         Grow-backs additionally never preempt or defragment: a gang grows
         back when pressure LIFTS, it does not create pressure of its own.
         """
+        if self._store_gate is not None and not self._store_gate():
+            # store outage: hold the pass — every admission/preemption
+            # must journal before it acts. Edge-triggered event.
+            self.store_skips += 1
+            if not self._store_held:
+                self._store_held = True
+                self._record("store-outage-hold", "*")
+            return []
+        if self._store_held:
+            self._store_held = False
+            self._record("store-outage-over", "*")
         outcomes: list[dict] = []
         owned = self._owned()
         with trace.pass_span(self._tracer, "admission.pass") as span, \

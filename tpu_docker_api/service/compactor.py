@@ -61,7 +61,7 @@ class HistoryCompactor:
                  registry: MetricsRegistry | None = None,
                  chunk_ops: int = CHUNK_OPS,
                  locks: dict | None = None,
-                 tracer=None, owns=None) -> None:
+                 tracer=None, owns=None, store_gate=None) -> None:
         self._kv = kv
         self._store = store
         #: trace sink for self-rooted per-pass spans (idle passes trimmed)
@@ -87,6 +87,11 @@ class HistoryCompactor:
         self._interval_s = interval_s
         self._chunk_ops = max(1, chunk_ops)
         self._registry = registry if registry is not None else REGISTRY
+        #: store-outage hold (service/store_health.py): GC deletes history
+        #: records — destructive writes have no business racing a store
+        #: that cannot confirm them. None ⇒ ungated.
+        self._store_gate = store_gate
+        self.store_skips = 0
         self._mu = threading.Lock()
         self._last_report: dict | None = None
         self._stop = threading.Event()
@@ -118,6 +123,10 @@ class HistoryCompactor:
     def compact_once(self) -> dict:
         """One full compaction pass; returns the report (also kept for
         :meth:`last_report` / the POST /api/v1/compact route)."""
+        if self._store_gate is not None and not self._store_gate():
+            self.store_skips += 1
+            return {"skipped": "store-outage", "trimmed": {},
+                    "protected": 0, "chunks": 0, "durationMs": 0.0}
         with trace.pass_span(self._tracer, "compact.pass"):
             return self._compact_once_inner()
 

@@ -275,7 +275,7 @@ class HostMonitor:
                  job_svc=None, job_versions=None, work_queue=None,
                  on_down=None, registry: MetricsRegistry | None = None,
                  max_events: int = 256,
-                 fanout: Fanout | None = None) -> None:
+                 fanout: Fanout | None = None, store_gate=None) -> None:
         self.pod = pod
         #: runtime fan-out: all hosts are probed as ONE concurrent batch,
         #: so detection wall time is O(slowest host), not O(sum) — one
@@ -290,6 +290,15 @@ class HostMonitor:
         self._job_versions = job_versions
         self._wq = work_queue
         self._on_down = on_down
+        #: store-outage hold (service/store_health.py): probing continues
+        #: (observation), but the DOWN verdict — which cordons the host and
+        #: wakes gang migration, a store-mutating cascade — is deferred
+        #: while the gate holds. The grace clock keeps running: the instant
+        #: the store heals, an still-failing host is confirmed down on the
+        #: next probe. None ⇒ ungated.
+        self._store_gate = store_gate
+        self.store_skips = 0
+        self._store_held = False
         self._registry = registry if registry is not None else REGISTRY
         self._mu = threading.Lock()
         now = self._clock()
@@ -376,6 +385,7 @@ class HostMonitor:
     def _probe_failed(self, hid: str, err: str) -> None:
         now = self._clock()
         newly_down = False
+        held = False
         with self._mu:
             st = self._hosts[hid]
             prev = st["state"]
@@ -387,8 +397,23 @@ class HostMonitor:
                 if first is None:
                     st["firstFailAt"] = first = now
                 if now - first >= self._grace:
-                    st.update(state="down", since=now)
-                    newly_down = True
+                    if (self._store_gate is not None
+                            and not self._store_gate()):
+                        # store outage: the verdict would cascade into
+                        # migration writes that cannot land — stay suspect,
+                        # grace clock running, and confirm after the heal
+                        held = True
+                    else:
+                        st.update(state="down", since=now)
+                        newly_down = True
+        if held:
+            self.store_skips += 1
+            if not self._store_held:
+                self._store_held = True
+                self._record("store-outage-hold", hid, error=err)
+        elif self._store_held and (newly_down or prev == "suspect"):
+            self._store_held = False
+            self._record("store-outage-over", hid)
         if prev == "healthy":
             self._record("host-suspect", hid, error=err)
         if newly_down:

@@ -83,6 +83,7 @@ class JobSupervisor:
         host_monitor=None,
         fanout: Fanout | None = None,
         owns=None,
+        store_gate=None,
     ) -> None:
         self.pod = pod
         #: runtime fan-out: per-member liveness inspects run as one
@@ -129,6 +130,14 @@ class JobSupervisor:
         #: base → last poll's {deadMembers, missingMembers} — status_view
         #: serves this instead of re-inspecting every member per request
         self._last_obs: dict[str, dict] = {}
+        #: store-outage hold (service/store_health.py): while the gate says
+        #: the store cannot journal intent, the supervisor OBSERVES but does
+        #: not act — a gang restart decided on state we cannot re-read or
+        #: record would be indistinguishable from a spurious one. None ⇒
+        #: ungated (byte-for-byte the pre-brownout behavior).
+        self._store_gate = store_gate
+        self.store_skips = 0
+        self._store_held = False
         self._events: collections.deque = collections.deque(maxlen=max_events)
         self._stop = threading.Event()
         #: set by handle_member_death to cut the poll interval short — the
@@ -173,6 +182,18 @@ class JobSupervisor:
     def poll_once(self) -> None:
         """One liveness scan over every job family; separated from the loop
         for tests."""
+        if self._store_gate is not None and not self._store_gate():
+            # store outage: hold the whole scan — recovery actions mutate
+            # gang records, and a mutation that cannot land half-applies
+            # the restart. Edge-triggered event; per-skip counter.
+            self.store_skips += 1
+            if not self._store_held:
+                self._store_held = True
+                self._record("store-outage-hold", "*")
+            return
+        if self._store_held:
+            self._store_held = False
+            self._record("store-outage-over", "*")
         families = sorted(self._versions.snapshot())
         if self._owns is not None:
             families = [b for b in families if self._owns(b)]

@@ -186,6 +186,7 @@ class Reconciler:
         tracer=None,
         owns=None,
         owned_shards=None,
+        store_gate=None,
     ) -> None:
         self.runtime = runtime
         #: trace sink for self-rooted per-pass spans (daemon wires the
@@ -260,6 +261,14 @@ class Reconciler:
         #: None ⇒ single-writer semantics, exactly today's behavior.
         self._owns = owns
         self._owned_shards = owned_shards
+        #: store-outage hold (service/store_health.py): a repair decided on
+        #: state the sweep cannot re-read — and recorded nowhere — is drift
+        #: manufactured, not drift repaired. While gated, non-dry-run passes
+        #: return a skipped-shape report; dry runs still sweep (they mutate
+        #: nothing). None ⇒ ungated, the pre-brownout behavior.
+        self._store_gate = store_gate
+        self.store_skips = 0
+        self._store_held = False
         self._dirty: DirtySet | None = None
         self._last_full: float | None = None
         self._mu = threading.Lock()
@@ -282,6 +291,14 @@ class Reconciler:
 
     def dirty_view(self) -> dict | None:
         return None if self._dirty is None else self._dirty.status_view()
+
+    def mark_all_dirty(self, reason: str) -> None:
+        """Demand that the next pass be full (no-op without a dirty feed —
+        every pass is full already). The store-recovery hook: an outage's
+        end means an unknown set of events was swallowed, so the loss-free
+        recovery contract is relist + treat-everything-as-changed."""
+        if self._dirty is not None:
+            self._dirty.mark_all(reason)
 
     # -- lifecycle (periodic mode) ------------------------------------------------
 
@@ -322,6 +339,26 @@ class Reconciler:
         the scale benchmark can assert which cost model they measured."""
         if mode not in ("auto", "full", "dirty"):
             raise ValueError(f"mode must be auto|full|dirty, got {mode!r}")
+        if (not dry_run and self._store_gate is not None
+                and not self._store_gate()):
+            # store outage: hold the sweep (dry runs still pass — they
+            # mutate nothing). Edge-triggered event; per-skip counter.
+            self.store_skips += 1
+            if not self._store_held:
+                self._store_held = True
+                with self._mu:
+                    self._events.append(trace.stamp(
+                        {"ts": time.time(), "dryRun": dry_run,
+                         "action": "store-outage-hold"}))
+            return {"dryRun": dry_run, "mode": "skipped",
+                    "skipped": "store-outage", "visitedFamilies": 0,
+                    "actions": [], "driftCount": 0, "durationMs": 0.0}
+        if self._store_held:
+            self._store_held = False
+            with self._mu:
+                self._events.append(trace.stamp(
+                    {"ts": time.time(), "dryRun": dry_run,
+                     "action": "store-outage-over"}))
         effective = mode
         if self._dirty is None:
             effective = "full"

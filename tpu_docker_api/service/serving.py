@@ -110,7 +110,7 @@ class ServingService:
                  registry: MetricsRegistry | None = None,
                  max_events: int = 256,
                  clock=time.monotonic,
-                 tracer=None, owns=None) -> None:
+                 tracer=None, owns=None, store_gate=None) -> None:
         self._job = job_svc
         #: trace sink for self-rooted per-tick spans (idle ticks trimmed)
         self._tracer = tracer
@@ -154,6 +154,12 @@ class ServingService:
         #: scale-up in flight: base → (decision monotonic ts, target) for
         #: the time-to-scaled histogram
         self._pending_up: dict[str, tuple[float, int]] = {}
+        #: store-outage hold (service/store_health.py): a scale decision
+        #: whose spec write cannot land would create/destroy replica gangs
+        #: with no durable record of why. None ⇒ ungated.
+        self._store_gate = store_gate
+        self.store_skips = 0
+        self._store_held = False
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -720,6 +726,17 @@ class ServingService:
         """One autoscaler pass over every service: converge the fleet,
         read signals, decide. Public — tests and the bench drive it
         inline the way ``admit_once`` is driven."""
+        if self._store_gate is not None and not self._store_gate():
+            # store outage: hold the autoscaler — converge/scale actions
+            # mutate service specs and replica gangs. Edge-triggered event.
+            self.store_skips += 1
+            if not self._store_held:
+                self._store_held = True
+                self._record("store-outage-hold", "*")
+            return
+        if self._store_held:
+            self._store_held = False
+            self._record("store-outage-over", "*")
         with trace.pass_span(self._tracer, "autoscale.tick"):
             self._tick_inner()
 

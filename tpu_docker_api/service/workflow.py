@@ -124,7 +124,7 @@ class WorkflowService:
                  registry: MetricsRegistry | None = None,
                  max_events: int = 256,
                  clock=time.time,
-                 tracer=None, owns=None) -> None:
+                 tracer=None, owns=None, store_gate=None) -> None:
         self._job = job_svc
         self._store = store
         self._versions = versions          # workflow VersionMap
@@ -149,6 +149,13 @@ class WorkflowService:
         self._locks = _FamilyLocks()
         self._mu = threading.Lock()
         self._events: collections.deque = collections.deque(maxlen=max_events)
+        #: store-outage hold (service/store_health.py): a step transition
+        #: is a journaled two-phase effect — with no journal there is no
+        #: exactly-once, so the engine observes but does not advance.
+        #: None ⇒ ungated.
+        self._store_gate = store_gate
+        self.store_skips = 0
+        self._store_held = False
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -732,6 +739,17 @@ class WorkflowService:
         """One engine pass over every workflow: cron check + DAG advance.
         Public — tests and the bench drive it inline the way the
         autoscaler's ``tick`` is driven."""
+        if self._store_gate is not None and not self._store_gate():
+            # store outage: hold the engine — cron fires and step
+            # transitions journal before acting. Edge-triggered event.
+            self.store_skips += 1
+            if not self._store_held:
+                self._store_held = True
+                self._record("store-outage-hold", "*")
+            return
+        if self._store_held:
+            self._store_held = False
+            self._record("store-outage-over", "*")
         with trace.pass_span(self._tracer, "workflow.tick"):
             self._tick_inner()
 

@@ -277,13 +277,32 @@ class InformerReadKV(KV):
     write — and every read while inactive or degraded — delegates to the
     inner store unchanged. The mirror is authoritative for ABSENCE too: a
     key the synced mirror lacks raises NotExistInStore without a store
-    round trip (that is a cache hit, not a miss)."""
+    round trip (that is a cache hit, not a miss).
+
+    One more mode when a ``store_health`` monitor is attached
+    (service/store_health.py): while the store is in **outage**, reads
+    serve from the mirror EVEN THOUGH it is unsynced — and regardless of
+    role, leader included — with the staleness marked per request
+    (``note_stale_read`` → envelope ``stale`` field + ``X-Stale-Read``
+    header). An explicitly-stale answer beats burning a deadline-bounded
+    store attempt per GET against a store known to be down; absence stays
+    authoritative against the last-known mirror. Paginated walks are the
+    exception — they are rev-anchored against the store's history, which
+    a stale mirror cannot prove, so they keep paying the bounded attempt."""
 
     def __init__(self, inner: KV, informer: Informer,
-                 active: Callable[[], bool]) -> None:
+                 active: Callable[[], bool], store_health=None) -> None:
         self.inner = inner
         self.informer = informer
         self._active = active
+        self.store_health = store_health
+
+    def _stale(self) -> bool:
+        return (self.store_health is not None
+                and self.store_health.serve_stale_reads())
+
+    def _stale_hit(self) -> None:
+        self.store_health.note_stale_read(self.informer.watch_lag_ms())
 
     def _serving(self) -> bool:
         if not self._active():
@@ -305,6 +324,12 @@ class InformerReadKV(KV):
                  "store round trips)")
 
     def get(self, key: str) -> str:
+        if self._stale():
+            self._stale_hit()
+            value = self.informer.get(key)
+            if value is None:
+                raise errors.NotExistInStore(key)
+            return value
         if self._serving():
             self._hit()
             value = self.informer.get(key)
@@ -314,12 +339,18 @@ class InformerReadKV(KV):
         return self.inner.get(key)
 
     def range_prefix(self, prefix: str) -> dict[str, str]:
+        if self._stale():
+            self._stale_hit()
+            return self.informer.range_prefix(prefix)
         if self._serving():
             self._hit()
             return self.informer.range_prefix(prefix)
         return self.inner.range_prefix(prefix)
 
     def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
+        if self._stale():
+            self._stale_hit()
+            return self.informer.range_prefix_with_rev(prefix)
         if self._serving():
             self._hit()
             # one informer lock hold: snapshot and rev must be atomic or
@@ -329,6 +360,11 @@ class InformerReadKV(KV):
 
     def keys_prefix(self, prefix: str, limit: int = 0,
                     start_after: str = "") -> list[str]:
+        if self._stale():
+            self._stale_hit()
+            ks = [k for k in self.informer.range_prefix(prefix)
+                  if k > start_after]
+            return ks[:limit] if limit > 0 else ks
         if self._serving():
             self._hit()
             ks = [k for k in self.informer.range_prefix(prefix)

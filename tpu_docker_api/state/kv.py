@@ -42,6 +42,7 @@ import abc
 import base64
 import bisect
 import collections
+import contextlib
 import sqlite3
 import threading
 import time
@@ -524,6 +525,7 @@ class SqliteKV(KV):
         self._conn = sqlite3.connect(
             path, timeout=busy_timeout_s, check_same_thread=False
         )
+        self._busy_timeout_s = busy_timeout_s
         self._mu = threading.Lock()
         self._log_retain = log_retain
         self._trim_every = max(1, trim_every)
@@ -550,11 +552,30 @@ class SqliteKV(KV):
             )
             self._conn.commit()
 
+    @contextlib.contextmanager
+    def _busy_guard(self):
+        """Normalize a busy/locked exhaustion (a foreign writer held the
+        database past ``busy_timeout_s``) to the typed
+        :class:`errors.StoreUnavailable` — the sqlite analog of EtcdKV's
+        connection-class normalization, so callers classify store-path
+        failures with ONE except clause instead of matching sqlite3
+        internals. Other OperationalErrors (corruption, disk I/O) still
+        surface raw: they are not an availability condition."""
+        try:
+            yield
+        except sqlite3.OperationalError as e:
+            msg = str(e).lower()
+            if "locked" in msg or "busy" in msg:
+                raise errors.StoreUnavailable(
+                    f"sqlite busy past the {self._busy_timeout_s}s bounded "
+                    f"wait: {e}") from e
+            raise
+
     def put(self, key: str, value: str) -> None:
         self._apply([("put", key, value)])
 
     def get(self, key: str) -> str:
-        with self._mu:
+        with self._busy_guard(), self._mu:
             row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
         if row is None:
             raise errors.NotExistInStore(key)
@@ -583,7 +604,7 @@ class SqliteKV(KV):
 
     def range_prefix(self, prefix: str) -> dict[str, str]:
         where, params = self._prefix_where(prefix)
-        with self._mu:
+        with self._busy_guard(), self._mu:
             rows = self._conn.execute(
                 f"SELECT k, v FROM kv WHERE {where} ORDER BY k", params,
             ).fetchall()
@@ -601,7 +622,7 @@ class SqliteKV(KV):
         if limit > 0:
             sql += " LIMIT ?"
             params = params + (limit,)
-        with self._mu:
+        with self._busy_guard(), self._mu:
             rows = self._conn.execute(sql, params).fetchall()
         return [k for (k,) in rows]
 
@@ -620,7 +641,7 @@ class SqliteKV(KV):
         if start_after:
             page_where += " AND k > ?"
             page_params = page_params + (start_after,)
-        with self._mu:
+        with self._busy_guard(), self._mu:
             try:
                 self._conn.execute("BEGIN")
                 if at_rev > 0:
@@ -656,7 +677,7 @@ class SqliteKV(KV):
         self._apply([("delete_prefix", prefix)])
 
     def current_rev(self) -> int:
-        with self._mu:
+        with self._busy_guard(), self._mu:
             return self._current_rev_locked()
 
     def _current_rev_locked(self) -> int:
@@ -669,7 +690,7 @@ class SqliteKV(KV):
 
     def range_prefix_with_rev(self, prefix: str) -> tuple[dict[str, str], int]:
         where, params = self._prefix_where(prefix)
-        with self._mu:
+        with self._busy_guard(), self._mu:
             try:
                 # explicit txn: the snapshot and its rev are one consistent
                 # read even with a foreign process writing concurrently
@@ -697,7 +718,7 @@ class SqliteKV(KV):
         between them, passing the staleness check against the old
         watermark while the row scan already reflects the post-trim log —
         a silent, permanently undetected gap."""
-        with self._mu:
+        with self._busy_guard(), self._mu:
             try:
                 self._conn.execute("BEGIN")
                 trim_rev = int(self._conn.execute(
@@ -728,7 +749,7 @@ class SqliteKV(KV):
         BEGIN IMMEDIATE takes the write lock up front, so even a foreign
         process (second daemon, backup tooling) cannot change a guarded
         key between the compare and the commit."""
-        with self._mu:
+        with self._busy_guard(), self._mu:
             try:
                 self._conn.execute("BEGIN IMMEDIATE")
                 for _, key, expected in guards or []:
@@ -814,7 +835,8 @@ class EtcdKV(KV):
 
     def __init__(self, addr: str, retry_attempts: int = RETRY_ATTEMPTS,
                  retry_base_s: float = RETRY_BASE_S,
-                 retry_max_s: float = RETRY_MAX_S) -> None:
+                 retry_max_s: float = RETRY_MAX_S,
+                 op_deadline_s: float = 0.0) -> None:
         import requests  # lazy: hermetic paths never import it
 
         self._requests = requests
@@ -823,6 +845,12 @@ class EtcdKV(KV):
         self._retry_attempts = max(1, retry_attempts)
         self._retry_base_s = retry_base_s
         self._retry_max_s = retry_max_s
+        # per-op deadline (config store_op_deadline_s): the socket timeout
+        # every request rides, so a hung store surfaces as a typed
+        # StoreUnavailable in bounded time instead of wedging an API
+        # thread that holds a family lock. <= 0 keeps the reference's 1 s
+        # OP_TIMEOUT_S — the default path byte-for-byte
+        self._op_timeout_s = op_deadline_s if op_deadline_s > 0 else self.OP_TIMEOUT_S
         # fail fast if unreachable, like the reference's blocking dial
         # (no retry: a daemon pointed at a dead store must error at boot,
         # not spin through a backoff schedule before reporting it)
@@ -839,7 +867,7 @@ class EtcdKV(KV):
             try:
                 r = self._session.post(
                     self._addr + path, json=body,
-                    timeout=timeout or self.OP_TIMEOUT_S,
+                    timeout=timeout or self._op_timeout_s,
                 )
                 r.raise_for_status()
                 return r.json()
@@ -1255,14 +1283,21 @@ def _prefix_end(prefix: str) -> str:
 def open_store(backend: str, *, etcd_addr: str = "", sqlite_path: str = "",
                retry_attempts: int = EtcdKV.RETRY_ATTEMPTS,
                retry_base_s: float = EtcdKV.RETRY_BASE_S,
-               retry_max_s: float = EtcdKV.RETRY_MAX_S) -> KV:
+               retry_max_s: float = EtcdKV.RETRY_MAX_S,
+               op_deadline_s: float = 0.0) -> KV:
     """Open a KV backend by name (config.store_backend); ``retry_*`` maps
-    from the ``store_retry_*`` config keys (etcd idempotent-read retry)."""
+    from the ``store_retry_*`` config keys (etcd idempotent-read retry).
+    ``op_deadline_s`` (config ``store_op_deadline_s``) bounds every op: the
+    etcd socket timeout and the sqlite busy wait. <= 0 keeps each backend's
+    historical budget (1 s etcd ops, 5 s sqlite busy) byte-for-byte."""
     if backend == "memory":
         return MemoryKV()
     if backend == "sqlite":
-        return SqliteKV(sqlite_path)
+        return SqliteKV(sqlite_path,
+                        busy_timeout_s=(op_deadline_s if op_deadline_s > 0
+                                        else SqliteKV.BUSY_TIMEOUT_S))
     if backend == "etcd":
         return EtcdKV(etcd_addr, retry_attempts=retry_attempts,
-                      retry_base_s=retry_base_s, retry_max_s=retry_max_s)
+                      retry_base_s=retry_base_s, retry_max_s=retry_max_s,
+                      op_deadline_s=op_deadline_s)
     raise ValueError(f"unknown store backend {backend!r}")

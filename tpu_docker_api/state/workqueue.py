@@ -220,6 +220,7 @@ class WorkQueue:
         tracer=None,
         shard_fn: Callable[[str, dict], int] | None = None,
         owned_shards: Callable[[], frozenset[int]] | None = None,
+        store_gate=None,
     ) -> None:
         from tpu_docker_api.utils.files import copy_dir_contents
 
@@ -272,6 +273,14 @@ class WorkQueue:
         #: single-writer semantics, exactly today's behavior)
         self._shard_fn = shard_fn
         self._owned_shards = owned_shards
+        #: store-outage hold (service/store_health.py): the sync loop keeps
+        #: draining submits into its hands but PAUSES execution while the
+        #: gate holds — a task run against a dead store would burn its
+        #: bounded retries on guaranteed failures and dead-letter work that
+        #: only needed to wait. Close overrides the hold: an unexecuted
+        #: journaled record is exactly what replay adopts. None ⇒ ungated.
+        self._store_gate = store_gate
+        self.store_skips = 0
         self._journal_failures = 0
         self._events: collections.deque = collections.deque(maxlen=128)
         if metrics is None:
@@ -561,6 +570,21 @@ class WorkQueue:
 
     # -- consumer side ------------------------------------------------------------
 
+    def _hold_for_store(self) -> None:
+        """Pause task execution while the store gate holds (edge-triggered
+        event, per-episode counter). Returns immediately once the gate
+        lifts OR the queue is closing — a task executed against a down
+        store on shutdown simply fails into the journal for replay."""
+        if self._store_gate is None or self._store_gate():
+            return
+        self.store_skips += 1
+        self._events.append(trace_mod.stamp(
+            {"ts": time.time(), "event": "store-outage-hold", "detail": ""}))
+        while not self._closed and not self._store_gate():
+            time.sleep(0.05)
+        self._events.append(trace_mod.stamp(
+            {"ts": time.time(), "event": "store-outage-over", "detail": ""}))
+
     def _sync_loop(self) -> None:
         while True:
             task = self._q.get()
@@ -568,6 +592,7 @@ class WorkQueue:
                 self._q.task_done()
                 return
             try:
+                self._hold_for_store()
                 if isinstance(task, TaskRecord):
                     self._run_record(task)
                 else:
